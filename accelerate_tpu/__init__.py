@@ -1,0 +1,65 @@
+"""accelerate_tpu — a TPU-native (JAX/XLA/pjit) training & inference framework with
+the capabilities of HuggingFace Accelerate.
+
+Public surface mirrors the reference facade (``src/accelerate/__init__.py:16-46``):
+``Accelerator``, ``PartialState``, big-modeling helpers, utils — re-architected
+around one ``jax.sharding.Mesh`` and compiled train steps instead of wrapped
+torch modules.
+"""
+
+__version__ = "0.1.0"
+
+from .state import AcceleratorState, DistributedType, GradientState, PartialState
+from .parallel.mesh import ParallelismConfig
+from .utils.dataclasses import (
+    AutocastKwargs,
+    DataLoaderConfiguration,
+    FullyShardedDataParallelPlugin,
+    GradientAccumulationPlugin,
+    JaxShardingKwargs,
+    MegatronStylePlugin,
+    PipelineParallelPlugin,
+    ProfileKwargs,
+    SequenceParallelPlugin,
+    TensorParallelPlugin,
+)
+
+
+def __getattr__(name):
+    # Lazy imports keep `import accelerate_tpu` light and avoid circulars.
+    if name == "Accelerator":
+        from .accelerator import Accelerator
+
+        return Accelerator
+    if name in ("notebook_launcher", "debug_launcher"):
+        from . import launchers
+
+        return getattr(launchers, name)
+    if name in (
+        "init_empty_weights",
+        "init_on_device",
+        "dispatch_model",
+        "load_checkpoint_and_dispatch",
+        "cpu_offload",
+        "disk_offload",
+    ):
+        from . import big_modeling
+
+        return getattr(big_modeling, name)
+    if name == "infer_auto_device_map":
+        from .utils.modeling import infer_auto_device_map
+
+        return infer_auto_device_map
+    if name == "find_executable_batch_size":
+        from .utils.memory import find_executable_batch_size
+
+        return find_executable_batch_size
+    if name == "skip_first_batches":
+        from .data_loader import skip_first_batches
+
+        return skip_first_batches
+    if name == "prepare_pippy":
+        from .inference import prepare_pippy
+
+        return prepare_pippy
+    raise AttributeError(f"module 'accelerate_tpu' has no attribute {name!r}")

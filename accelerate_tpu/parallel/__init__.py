@@ -1,0 +1,1 @@
+from .mesh import ParallelismConfig, batch_sharding_size, default_mesh, mesh_axis_size

@@ -1,0 +1,127 @@
+"""Device-mesh construction — the substrate for every parallelism strategy.
+
+Where the reference maps each strategy onto a different runtime (DDP process groups,
+FSDP flat-params, DeepSpeed engines, Megatron mpu groups — see
+``src/accelerate/state.py:743-809`` and ``accelerator.py:1614-2238``), here every
+strategy is an **axis of one** ``jax.sharding.Mesh``:
+
+- ``dp``   — pure data parallelism (params replicated, batch sharded) ≈ DDP
+- ``fsdp`` — fully-sharded data parallelism (params+opt state sharded, batch sharded)
+             ≈ FSDP2 FULL_SHARD ≈ DeepSpeed ZeRO-3
+- ``tp``   — tensor parallelism (weight matrices sharded head-/hidden-wise)
+- ``pp``   — pipeline parallelism (layer groups staged across devices)
+- ``sp``   — sequence/context parallelism (activations sharded along sequence;
+             the reference has no native implementation — SURVEY.md §2.4)
+
+Axis order puts ``tp`` innermost so tensor-parallel collectives ride the
+fastest-varying ICI neighbors, then ``sp``, then ``fsdp``/``dp``, with ``pp``
+outermost (suited to DCN between slices on multi-slice deployments).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+from ..utils.constants import ENV_MESH_SHAPE, MESH_AXIS_ORDER
+
+
+@dataclass
+class ParallelismConfig:
+    """Declarative mesh shape. ``-1`` for ``dp_size`` means "use all remaining devices".
+
+    Plays the role of the reference's strategy plugins
+    (``FullyShardedDataParallelPlugin`` dataclasses.py:1481, ``TorchTensorParallelPlugin``
+    :2062, ``MegatronLMPlugin`` tp/pp degrees :2110-2111) collapsed into one object.
+    """
+
+    dp_size: int = -1
+    fsdp_size: int = 1
+    tp_size: int = 1
+    pp_size: int = 1
+    sp_size: int = 1
+
+    def __post_init__(self):
+        for name in ("fsdp_size", "tp_size", "pp_size", "sp_size"):
+            if getattr(self, name) < 1:
+                raise ValueError(f"{name} must be >= 1, got {getattr(self, name)}")
+
+    @classmethod
+    def from_env(cls) -> "ParallelismConfig":
+        """Parse ``ACCELERATE_MESH_SHAPE=dp:2,fsdp:2,tp:2`` style env contract."""
+        spec = os.environ.get(ENV_MESH_SHAPE, "")
+        kwargs = {}
+        if spec:
+            for part in spec.split(","):
+                axis, _, size = part.partition(":")
+                axis = axis.strip()
+                if axis not in ("dp", "fsdp", "tp", "pp", "sp"):
+                    raise ValueError(f"Unknown mesh axis {axis!r} in {ENV_MESH_SHAPE}")
+                kwargs[f"{axis}_size"] = int(size)
+        return cls(**kwargs)
+
+    def resolved_sizes(self, num_devices: int) -> dict[str, int]:
+        """Resolve ``dp_size=-1`` against the device count and validate divisibility."""
+        model_degree = self.fsdp_size * self.tp_size * self.pp_size * self.sp_size
+        dp = self.dp_size
+        if dp == -1:
+            if num_devices % model_degree != 0:
+                raise ValueError(
+                    f"{num_devices} devices not divisible by fsdp*tp*pp*sp={model_degree}"
+                )
+            dp = num_devices // model_degree
+        total = dp * model_degree
+        if total != num_devices:
+            raise ValueError(
+                f"Mesh {dict(pp=self.pp_size, dp=dp, fsdp=self.fsdp_size, sp=self.sp_size, tp=self.tp_size)} "
+                f"needs {total} devices but {num_devices} are available."
+            )
+        return {"pp": self.pp_size, "dp": dp, "fsdp": self.fsdp_size, "sp": self.sp_size, "tp": self.tp_size}
+
+    def build_mesh(self, devices=None) -> Mesh:
+        """Build the ``jax.sharding.Mesh``.
+
+        Uses ``mesh_utils.create_device_mesh`` when possible so the logical axes map
+        onto the physical ICI torus with nearest-neighbor adjacency for the inner
+        axes; falls back to a plain reshape on virtual/CPU device sets.
+        """
+        if devices is None:
+            devices = jax.devices()
+        sizes = self.resolved_sizes(len(devices))
+        shape = tuple(sizes[a] for a in MESH_AXIS_ORDER)
+        try:
+            from jax.experimental import mesh_utils
+
+            dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+        except Exception:
+            dev_array = np.asarray(devices).reshape(shape)
+        return Mesh(dev_array, MESH_AXIS_ORDER)
+
+    @property
+    def is_trivial(self) -> bool:
+        return (
+            self.fsdp_size == 1
+            and self.tp_size == 1
+            and self.pp_size == 1
+            and self.sp_size == 1
+            and self.dp_size in (-1, 1)
+        )
+
+
+def default_mesh(devices=None) -> Mesh:
+    """All devices on the ``dp`` axis — the DDP-equivalent default."""
+    return ParallelismConfig().build_mesh(devices)
+
+
+def mesh_axis_size(mesh: Mesh, axis: str) -> int:
+    return mesh.shape[axis] if axis in mesh.shape else 1
+
+
+def batch_sharding_size(mesh: Mesh) -> int:
+    """Number of ways the global batch is split (dp × fsdp)."""
+    return mesh_axis_size(mesh, "dp") * mesh_axis_size(mesh, "fsdp")

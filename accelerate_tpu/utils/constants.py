@@ -1,0 +1,52 @@
+"""Constants shared across the framework.
+
+Reference parity: ``src/accelerate/utils/constants.py:20-33`` defines the checkpoint
+file-name contract (model/optimizer/scheduler/sampler/scaler/rng file names). We keep
+the same folder layout and naming so checkpoints are navigable by users coming from
+the reference, while the array payloads are sharding-aware (orbax/tensorstore) rather
+than pickled torch tensors.
+"""
+
+MODEL_NAME = "model"
+OPTIMIZER_NAME = "optimizer"
+SCHEDULER_NAME = "scheduler"
+SAMPLER_NAME = "sampler"
+DATALOADER_NAME = "dataloader"
+RNG_STATE_NAME = "random_states"
+PARAMS_NAME = "params"
+
+SAFE_WEIGHTS_NAME = "model.safetensors"
+SAFE_WEIGHTS_INDEX_NAME = "model.safetensors.index.json"
+WEIGHTS_NAME = "model.msgpack"
+WEIGHTS_INDEX_NAME = "model.msgpack.index.json"
+SAFE_WEIGHTS_PATTERN_NAME = "model{suffix}.safetensors"
+WEIGHTS_PATTERN_NAME = "model{suffix}.msgpack"
+
+# Sharded (orbax-style) checkpoint directory names inside a checkpoint folder.
+SHARDED_MODEL_DIR = "model_sharded"
+SHARDED_OPTIMIZER_DIR = "optimizer_sharded"
+
+# Environment-variable contract (consumed by PartialState / AcceleratorState and set
+# by the launcher, mirroring the reference's ACCELERATE_* contract set in
+# src/accelerate/utils/launch.py:100-352).
+ENV_PREFIX = "ACCELERATE_"
+ENV_COORDINATOR = "ACCELERATE_COORDINATOR_ADDRESS"
+ENV_NUM_PROCESSES = "ACCELERATE_NUM_PROCESSES"
+ENV_PROCESS_ID = "ACCELERATE_PROCESS_ID"
+ENV_MIXED_PRECISION = "ACCELERATE_MIXED_PRECISION"
+ENV_CPU = "ACCELERATE_USE_CPU"
+ENV_DEBUG_MODE = "ACCELERATE_DEBUG_MODE"
+ENV_MESH_SHAPE = "ACCELERATE_MESH_SHAPE"
+
+MESH_AXIS_ORDER = ("pp", "dp", "fsdp", "sp", "tp")
+BATCH_SHARDING_AXES = ("dp", "fsdp")
+
+# Default config location, mirroring the reference's
+# ~/.cache/huggingface/accelerate/default_config.yaml
+# (src/accelerate/commands/config/config_args.py:30-41).
+DEFAULT_CONFIG_FOLDER = "accelerate_tpu"
+DEFAULT_CONFIG_FILE = "default_config.yaml"
+
+CHECKPOINT_DIR_PREFIX = "checkpoint"
+
+MITA_PROFILE_DIR = "profile_trace"

@@ -1,0 +1,208 @@
+"""Plugin & kwargs-handler dataclasses — the L3 configuration surface.
+
+Reference parity: ``src/accelerate/utils/dataclasses.py`` (2,823 LoC). The reference
+needs a large adapter surface because each strategy drives a different external
+engine; here strategies collapse onto mesh axes, so plugins mostly *declare shape*
+and the engine is always GSPMD. Handlers kept:
+
+- ``KwargsHandler`` base with ``to_kwargs()`` default-diffing (reference :64-78)
+- ``GradientAccumulationPlugin`` (reference :734-760)
+- ``FullyShardedDataParallelPlugin`` equivalent (reference :1481) → fsdp axis size +
+  remat/offload policy
+- ``TorchTensorParallelPlugin`` equivalent (reference :2062) → tp axis size
+- ``MegatronLMPlugin`` equivalent (reference :2102) → tp×pp×dp + sp
+- ``AutocastKwargs``/``DistributedDataParallelKwargs``-analogue slots where they
+  still mean something on TPU.
+"""
+
+from __future__ import annotations
+
+import copy
+import enum
+from dataclasses import dataclass
+
+
+class KwargsHandler:
+    """Base: diff against defaults, mirroring reference ``dataclasses.py:64-78``."""
+
+    def to_dict(self):
+        return copy.deepcopy(self.__dict__)
+
+    def to_kwargs(self):
+        default_dict = self.__class__().to_dict()
+        this_dict = self.to_dict()
+        return {k: v for k, v in this_dict.items() if default_dict[k] != v}
+
+
+class EnumWithContains(enum.EnumMeta):
+    def __contains__(cls, item):
+        try:
+            cls(item)
+        except ValueError:
+            return False
+        return True
+
+
+class BaseEnum(enum.Enum, metaclass=EnumWithContains):
+    def __str__(self):
+        return self.value
+
+    @classmethod
+    def list(cls):
+        return list(map(str, cls))
+
+
+class PrecisionType(str, BaseEnum):
+    NO = "no"
+    BF16 = "bf16"
+    FP16 = "fp16"
+    FP8 = "fp8"
+
+
+class RNGType(str, BaseEnum):
+    """Which RNG streams to synchronize across processes at epoch boundaries
+    (reference ``utils/dataclasses.py:613-620``). JAX's explicit keys make GENERATOR
+    the only stream that matters; the others are kept for API parity with host-side
+    numpy/python shuffling."""
+
+    JAX = "jax"
+    NUMPY = "numpy"
+    PYTHON = "python"
+    GENERATOR = "generator"
+
+
+@dataclass
+class GradientAccumulationPlugin(KwargsHandler):
+    """Reference ``dataclasses.py:734-760``."""
+
+    num_steps: int = None
+    adjust_scheduler: bool = True
+    sync_with_dataloader: bool = True
+    sync_each_batch: bool = False
+
+    def __post_init__(self):
+        if self.sync_with_dataloader is None:
+            self.sync_with_dataloader = True
+
+
+@dataclass
+class AutocastKwargs(KwargsHandler):
+    """Reference ``dataclasses.py:228-245``. On TPU "autocast" is a dtype policy
+    applied when params are cast into the jitted step, not a context manager."""
+
+    enabled: bool = True
+    cache_enabled: bool = None  # parity slot; meaningless under XLA
+
+
+@dataclass
+class JaxShardingKwargs(KwargsHandler):
+    """Knobs for the compiled train step — the analog of
+    ``DistributedDataParallelKwargs`` (reference :151-226): what that handler tunes
+    about NCCL bucketing/overlap, XLA's latency-hiding scheduler does automatically;
+    what remains user-meaningful is donation and remat."""
+
+    donate_params: bool = True  # donate param/opt buffers to the step (halves HBM)
+    remat_policy: str | None = None  # None|'minimal'|'full'|'dots_saveable'...
+    spmd_auto: bool = False  # let XLA auto-partition instead of explicit rules
+
+
+@dataclass
+class FullyShardedDataParallelPlugin(KwargsHandler):
+    """GSPMD full-shard config — reference ``dataclasses.py:1481`` distilled to the
+    fields that mean something under XLA SPMD:
+
+    - sharding happens via the ``fsdp`` mesh axis (≈ FULL_SHARD / ZeRO-3);
+      ``reshard_after_forward`` ≈ XLA's default behavior (all-gather per use).
+    - ``min_shard_size`` plays auto_wrap_policy's role: tensors smaller than this
+      stay replicated (sharding tiny tensors wastes collective latency).
+    - ``cpu_offload`` → host-memory offload of the sharded optimizer state.
+    - ``activation_checkpointing`` → ``jax.checkpoint`` policy on block boundaries.
+    """
+
+    fsdp_size: int = -1  # -1: all non-tp/pp devices
+    reshard_after_forward: bool = True
+    min_shard_size: int = 2**14
+    shard_axis_preference: tuple = ()  # param dims preferred for sharding, default largest
+    cpu_offload: bool = False
+    activation_checkpointing: bool = False
+    state_dict_type: str = "SHARDED_STATE_DICT"  # or FULL_STATE_DICT on save
+
+    def __post_init__(self):
+        if self.state_dict_type not in ("SHARDED_STATE_DICT", "FULL_STATE_DICT"):
+            raise ValueError(f"invalid state_dict_type {self.state_dict_type}")
+
+
+@dataclass
+class TensorParallelPlugin(KwargsHandler):
+    """Reference ``TorchTensorParallelPlugin`` (``dataclasses.py:2062-2098``). The
+    reference requires models pre-sharded by transformers' tp_plan; here the plan is
+    our logical sharding rules applied to any param pytree (parallel/sharding.py)."""
+
+    tp_size: int = 1
+
+    def __post_init__(self):
+        if self.tp_size < 1:
+            raise ValueError("tp_size must be >= 1")
+
+
+@dataclass
+class PipelineParallelPlugin(KwargsHandler):
+    """Pipeline stages over the ``pp`` mesh axis (reference exposes PP only through
+    Megatron ``pp_degree`` dataclasses.py:2110 and inference pippy inference.py:124)."""
+
+    pp_size: int = 1
+    num_microbatches: int = 1
+    schedule: str = "gpipe"  # or '1f1b' (scan-based)
+
+
+@dataclass
+class SequenceParallelPlugin(KwargsHandler):
+    """Sequence/context parallelism over the ``sp`` axis — ring attention. The
+    reference has NO native implementation (SURVEY.md §2.4): this exceeds parity."""
+
+    sp_size: int = 1
+    ring_attention: bool = True  # ppermute ring; False = all-gather KV
+
+
+@dataclass
+class MegatronStylePlugin(KwargsHandler):
+    """Composed 3-D parallelism (reference ``MegatronLMPlugin`` dataclasses.py:2102)."""
+
+    tp_size: int = 1
+    pp_size: int = 1
+    sp_size: int = 1
+    fsdp_size: int = 1
+    sequence_parallelism: bool = False
+
+
+@dataclass
+class ProfileKwargs(KwargsHandler):
+    """Reference ``dataclasses.py:438-552`` builds torch.profiler; here it drives
+    ``jax.profiler`` (perfetto/tensorboard trace)."""
+
+    output_trace_dir: str | None = None
+    with_flops: bool = False  # cost analysis via jax.stages cost_analysis
+    record_shapes: bool = False  # parity slot
+    profile_memory: bool = False  # parity slot — device memory profile
+
+    def build(self):
+        import jax.profiler
+
+        return jax.profiler
+
+
+@dataclass
+class DataLoaderConfiguration(KwargsHandler):
+    """Reference ``dataclasses.py:791-860``."""
+
+    split_batches: bool = False
+    dispatch_batches: bool | None = None
+    even_batches: bool = True
+    use_seedable_sampler: bool = False
+    non_blocking: bool = False  # parity slot; device feed is always async in JAX
+    data_seed: int | None = None
+    use_stateful_dataloader: bool = False
+
+
+def add_model_config_to_megatron_parser(*a, **k):  # pragma: no cover - parity stub
+    raise NotImplementedError("Megatron arg-parsing is not applicable on TPU")

@@ -1,0 +1,103 @@
+"""Environment parsing/patching helpers.
+
+Reference parity: ``src/accelerate/utils/environment.py`` — ``parse_flag_from_env``,
+``parse_choice_from_env``, ``patch_environment`` (:326), ``clear_environment`` (:291),
+``purge_accelerate_environment`` (:362-420). NUMA-affinity and CUDA-P2P checks are
+GPU-specific and intentionally absent; the TPU analog (megacore/ICI layout) is owned
+by the XLA runtime.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from contextlib import contextmanager
+
+from .constants import ENV_PREFIX
+
+
+def str_to_bool(value: str) -> int:
+    """Convert a string (env var) to 1/0. Accepts y/yes/t/true/on/1 and n/no/f/false/off/0."""
+    value = value.lower()
+    if value in ("y", "yes", "t", "true", "on", "1"):
+        return 1
+    if value in ("n", "no", "f", "false", "off", "0"):
+        return 0
+    raise ValueError(f"invalid truth value {value!r}")
+
+
+def parse_flag_from_env(key: str, default: bool = False) -> bool:
+    value = os.environ.get(key, str(default))
+    try:
+        return bool(str_to_bool(value))
+    except ValueError:
+        raise ValueError(f"If set, {key} must be yes/no/1/0/true/false, got {value!r}.")
+
+
+def parse_choice_from_env(key: str, default: str = "no") -> str:
+    return os.environ.get(key, str(default))
+
+
+def get_int_from_env(env_keys, default: int) -> int:
+    """Return the first positive int found among env_keys."""
+    for key in env_keys:
+        val = int(os.environ.get(key, -1))
+        if val >= 0:
+            return val
+    return default
+
+
+@contextmanager
+def patch_environment(**kwargs):
+    """Temporarily set environment variables; restores previous values on exit.
+
+    Mirrors ``src/accelerate/utils/environment.py:326``.
+    """
+    existing = {}
+    for key, value in kwargs.items():
+        key = key.upper()
+        if key in os.environ:
+            existing[key] = os.environ[key]
+        os.environ[key] = str(value)
+    try:
+        yield
+    finally:
+        for key in kwargs:
+            key = key.upper()
+            if key in existing:
+                os.environ[key] = existing[key]
+            else:
+                os.environ.pop(key, None)
+
+
+@contextmanager
+def clear_environment():
+    """Temporarily empty ``os.environ``; restores on exit (reference :291)."""
+    saved = dict(os.environ)
+    os.environ.clear()
+    try:
+        yield
+    finally:
+        os.environ.clear()
+        os.environ.update(saved)
+
+
+def purge_accelerate_environment(fn):
+    """Decorator that runs ``fn`` with all ``ACCELERATE_*`` vars removed and restores
+    them afterwards (reference :362-420). Used by the test harness for state hygiene.
+    """
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        saved = {k: v for k, v in os.environ.items() if k.startswith(ENV_PREFIX)}
+        for k in saved:
+            del os.environ[k]
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            for k in list(os.environ):
+                if k.startswith(ENV_PREFIX):
+                    del os.environ[k]
+            os.environ.update(saved)
+
+    return wrapper

@@ -1,0 +1,436 @@
+"""Pytree collectives & data-movement veneer — the L2 communication layer.
+
+Reference parity: ``src/accelerate/utils/operations.py`` (866 LoC). The reference
+wraps torch.distributed point ops (all_gather/broadcast/all_reduce) applied
+recursively over nested containers; each rank holds a *local* tensor. Under JAX
+there are two regimes and this module bridges both:
+
+- **host-level** (outside jit, one value per process on a pod):
+  ``jax.experimental.multihost_utils`` — ``process_allgather`` /
+  ``broadcast_one_to_all`` ride a tiny compiled collective over ICI/DCN. These are
+  the direct analogs of the reference's eager NCCL calls.
+- **global arrays** (the steady state inside our framework): a ``jax.Array`` is
+  already global across the mesh; ``gather`` just makes it fully addressable.
+
+Collectives *inside* the compiled step (psum/all_gather/ppermute) are not here —
+XLA inserts them from sharding annotations (GSPMD), or ``parallel/`` modules spell
+them with ``shard_map``. That split — eager veneer here, compiled collectives by
+annotation — is the TPU-native answer to the reference's single eager API.
+
+The reference's nested-container idiom (``recursively_apply`` :84-133) maps to
+``jax.tree_util``; the debug-mode shape sanitizer (``verify_operation`` :363-415)
+is reimplemented on process_allgather.
+"""
+
+from __future__ import annotations
+
+import pickle
+from functools import wraps
+from typing import Any, Callable, Mapping
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .environment import parse_flag_from_env
+from .constants import ENV_DEBUG_MODE
+
+
+def PartialState():
+    """Lazy accessor for the state singleton (breaks the utils↔state import cycle)."""
+    from ..state import PartialState as _PartialState
+
+    return _PartialState()
+
+
+class DistributedOperationException(Exception):
+    """Raised by debug-mode pre-checks when processes would call a collective with
+    mismatched structure (reference ``operations.py:354-360``)."""
+
+
+def is_tensor_like(x) -> bool:
+    return isinstance(x, (jax.Array, np.ndarray)) or hasattr(x, "__jax_array__")
+
+
+def recursively_apply(
+    func: Callable,
+    data: Any,
+    *args,
+    test_type: Callable = is_tensor_like,
+    error_on_other_type: bool = False,
+    **kwargs,
+):
+    """Apply ``func`` to every tensor leaf of a nested list/tuple/dict structure.
+
+    Reference ``operations.py:84-133``. Non-tensor leaves pass through unless
+    ``error_on_other_type``.
+    """
+    if isinstance(data, (list, tuple)):
+        out = [
+            recursively_apply(
+                func, o, *args, test_type=test_type, error_on_other_type=error_on_other_type, **kwargs
+            )
+            for o in data
+        ]
+        if isinstance(data, tuple):
+            if hasattr(data, "_fields"):  # namedtuple
+                return type(data)(*out)
+            return tuple(out)
+        return out
+    if isinstance(data, Mapping):
+        return type(data)(
+            {
+                k: recursively_apply(
+                    func, v, *args, test_type=test_type, error_on_other_type=error_on_other_type, **kwargs
+                )
+                for k, v in data.items()
+            }
+        )
+    if test_type(data):
+        return func(data, *args, **kwargs)
+    if error_on_other_type:
+        raise TypeError(
+            f"Unsupported type {type(data)} passed — only nested containers of arrays are supported."
+        )
+    return data
+
+
+# --------------------------------------------------------------------- movement
+def send_to_device(data, device=None, non_blocking: bool = False, skip_keys=None):
+    """Recursively place arrays on a device or sharding (reference :135-185).
+
+    ``device`` may be a ``jax.Device``, a ``jax.sharding.Sharding``, or the strings
+    ``"cpu"`` / ``"device"``. JAX transfers are always async; ``non_blocking`` is a
+    parity slot.
+    """
+    state = PartialState()
+    if device is None or device == "device":
+        device = state.device
+    elif device == "cpu":
+        device = jax.local_devices(backend="cpu")[0] if jax.default_backend() != "cpu" else state.device
+    if isinstance(skip_keys, str):
+        skip_keys = [skip_keys]
+
+    def _put(t):
+        return jax.device_put(t, device)
+
+    if skip_keys:
+        # Propagate skip_keys through every nesting level (reference :164-177).
+        if isinstance(data, Mapping):
+            return type(data)(
+                {
+                    k: (v if k in skip_keys else send_to_device(v, device, skip_keys=skip_keys))
+                    for k, v in data.items()
+                }
+            )
+        if isinstance(data, (list, tuple)):
+            out = [send_to_device(v, device, skip_keys=skip_keys) for v in data]
+            if isinstance(data, tuple):
+                return type(data)(*out) if hasattr(data, "_fields") else tuple(out)
+            return out
+    return recursively_apply(_put, data)
+
+
+def get_data_structure(data):
+    """Shapes+dtypes pytree describing ``data`` (reference :188-210)."""
+    return recursively_apply(lambda t: jax.ShapeDtypeStruct(np.shape(t), np.asarray(t).dtype if not isinstance(t, jax.Array) else t.dtype), data)
+
+
+def find_batch_size(data):
+    """First dimension of the first array leaf (reference :254-274)."""
+    leaves = [l for l in jax.tree_util.tree_leaves(data) if is_tensor_like(l)]
+    if not leaves:
+        raise ValueError(f"Cannot find batch size in {type(data)}")
+    if leaves[0].ndim == 0:
+        raise ValueError("0-d array has no batch dimension")
+    return leaves[0].shape[0]
+
+
+def ignorant_find_batch_size(data):
+    try:
+        return find_batch_size(data)
+    except (ValueError, TypeError):
+        return None
+
+
+def listify(data):
+    """Arrays → nested Python lists (reference :277-292)."""
+    return recursively_apply(lambda t: np.asarray(t).tolist(), data)
+
+
+def slice_tensors(data, tensor_slice, process_index=None, num_processes=None):
+    """Apply ``[tensor_slice]`` to every array leaf (reference :570-585)."""
+    return recursively_apply(lambda t: t[tensor_slice], data)
+
+
+def concatenate(data, dim: int = 0):
+    """Concatenate a list of structurally-identical pytrees leafwise (reference :587-610)."""
+    first = data[0]
+    if isinstance(first, (list, tuple)):
+        return type(first)(concatenate([d[i] for d in data], dim=dim) for i in range(len(first)))
+    if isinstance(first, Mapping):
+        return type(first)({k: concatenate([d[k] for d in data], dim=dim) for k in first.keys()})
+    return jnp.concatenate([jnp.asarray(d) for d in data], axis=dim)
+
+
+def convert_to_fp32(data):
+    """Cast half-precision leaves to fp32 (reference :764-786)."""
+
+    def _cast(t):
+        t = jnp.asarray(t)
+        if t.dtype in (jnp.bfloat16, jnp.float16):
+            return t.astype(jnp.float32)
+        return t
+
+    return recursively_apply(_cast, data)
+
+
+class ConvertOutputsToFp32:
+    """Picklable post-step fp32 cast wrapper (reference :788-823)."""
+
+    def __init__(self, model_forward):
+        self.model_forward = model_forward
+        wraps(model_forward)(self)
+
+    def __call__(self, *args, **kwargs):
+        return convert_to_fp32(self.model_forward(*args, **kwargs))
+
+    def __getstate__(self):
+        raise pickle.PicklingError(
+            "Cannot pickle a wrapped forward; unwrap with extract_model_from_parallel first."
+        )
+
+
+convert_outputs_to_fp32 = ConvertOutputsToFp32
+
+
+# -------------------------------------------------------------- debug sanitizer
+def _operation_signature(data) -> list:
+    return [
+        (tuple(np.shape(l)), str(np.asarray(l).dtype) if not isinstance(l, jax.Array) else str(l.dtype))
+        for l in jax.tree_util.tree_leaves(data)
+        if is_tensor_like(l)
+    ]
+
+
+def verify_operation(function):
+    """Debug-mode collective pre-check (reference ``operations.py:363-396``): with
+    ``ACCELERATE_DEBUG_MODE=1`` every process gathers every process's leaf
+    shapes/dtypes before the collective and raises ``DistributedOperationException``
+    on mismatch — turning a hang into an error message."""
+
+    @wraps(function)
+    def wrapper(*args, **kwargs):
+        state = PartialState()
+        if not (getattr(state, "debug", False) or parse_flag_from_env(ENV_DEBUG_MODE)) or state.num_processes == 1:
+            return function(*args, **kwargs)
+        tensor = kwargs.get("tensor", args[0] if args else None)
+        sig = _operation_signature(tensor)
+        sigs = gather_object([sig])
+        if not all(s == sigs[0] for s in sigs):
+            raise DistributedOperationException(
+                f"Cannot apply {function.__name__}: process shapes/dtypes mismatch.\n"
+                + "\n".join(f"  - Process {i}: {s}" for i, s in enumerate(sigs))
+            )
+        return function(*args, **kwargs)
+
+    return wrapper
+
+
+# ----------------------------------------------------------------- collectives
+def _is_global_unaddressable(x) -> bool:
+    return isinstance(x, jax.Array) and not x.is_fully_addressable
+
+
+def _host_allgather(t, tiled: bool):
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.process_allgather(np.asarray(t), tiled=tiled)
+
+
+@verify_operation
+def gather(tensor):
+    """All-gather along dim 0 (reference :418-434).
+
+    - Global (multi-host-sharded) ``jax.Array`` → materialized everywhere.
+    - Host-local array on a pod → concatenation of every process's value
+      (shape ``(num_processes * B, ...)``), matching the reference contract.
+    - Single process → unchanged.
+    """
+    state = PartialState()
+
+    def _gather_one(t):
+        if _is_global_unaddressable(t):
+            from jax.experimental import multihost_utils
+
+            return multihost_utils.process_allgather(t, tiled=True)
+        if state.num_processes > 1:
+            return _host_allgather(t, tiled=True)
+        return t
+
+    return recursively_apply(_gather_one, tensor)
+
+
+def gather_object(object: Any):
+    """Gather arbitrary picklable objects from every process into a list
+    (reference :444-461; notably *not* implemented for torch-XLA there — native
+    JAX multihost makes it straightforward, via length-padded pickle buffers)."""
+    state = PartialState()
+    if state.num_processes == 1:
+        return list(object) if isinstance(object, list) else [object]
+    payload = np.frombuffer(pickle.dumps(object), dtype=np.uint8)
+    length = np.array([payload.size], dtype=np.int64)
+    lengths = _host_allgather(length, tiled=True)
+    max_len = int(lengths.max())
+    padded = np.zeros(max_len, dtype=np.uint8)
+    padded[: payload.size] = payload
+    buffers = _host_allgather(padded, tiled=False)  # (num_processes, max_len)
+    out = []
+    for i in range(state.num_processes):
+        obj = pickle.loads(buffers[i, : int(lengths[i])].tobytes())
+        if isinstance(object, list):
+            out.extend(obj)
+        else:
+            out.append(obj)
+    return out
+
+
+@verify_operation
+def broadcast(tensor, from_process: int = 0):
+    """Broadcast array leaves from one process to all (reference :538-557)."""
+    state = PartialState()
+    if state.num_processes == 1:
+        return tensor
+    from jax.experimental import multihost_utils
+
+    def _bcast(t):
+        if _is_global_unaddressable(t):
+            return t  # a global sharded array is already consistent on all hosts
+        return multihost_utils.broadcast_one_to_all(
+            np.asarray(t), is_source=state.process_index == from_process
+        )
+
+    return recursively_apply(_bcast, tensor)
+
+
+def broadcast_object_list(object_list: list, from_process: int = 0):
+    """Broadcast a list of picklable objects (reference :560-577). In-place like
+    the reference: returns the list with every slot replaced by rank
+    ``from_process``'s value."""
+    state = PartialState()
+    if state.num_processes == 1:
+        return object_list
+    from jax.experimental import multihost_utils
+
+    payload = np.frombuffer(pickle.dumps(list(object_list)), dtype=np.uint8)
+    size = multihost_utils.broadcast_one_to_all(
+        np.array([payload.size], dtype=np.int64), is_source=state.process_index == from_process
+    )
+    buf = np.zeros(int(size[0]), dtype=np.uint8)
+    if state.process_index == from_process:
+        buf[:] = payload
+    buf = multihost_utils.broadcast_one_to_all(buf, is_source=state.process_index == from_process)
+    received = pickle.loads(buf.tobytes())
+    for i, v in enumerate(received):
+        object_list[i] = v
+    return object_list
+
+
+@verify_operation
+def reduce(tensor, reduction: str = "mean", scale: float = 1.0):
+    """Elementwise cross-process reduce of host-local values (reference :723-761).
+    Used e.g. by LocalSGD parameter averaging. ``reduction`` ∈ {"sum", "mean",
+    "none"} — "none" returns the input unchanged, matching the reference."""
+    if reduction not in ("sum", "mean", "none"):
+        raise ValueError(f"reduction must be sum/mean/none, got {reduction!r}")
+    if reduction == "none":
+        return tensor
+    state = PartialState()
+
+    def _reduce_one(t):
+        if _is_global_unaddressable(t):
+            # A global sharded array is one logical value — already "reduced".
+            out = jnp.asarray(t)
+        elif state.num_processes == 1:
+            out = jnp.asarray(t)
+        else:
+            stacked = _host_allgather(t, tiled=False)
+            out = jnp.sum(jnp.asarray(stacked), axis=0)
+            if reduction == "mean":
+                out = out / state.num_processes
+        return out * scale
+
+    return recursively_apply(_reduce_one, tensor)
+
+
+@verify_operation
+def pad_across_processes(tensor, dim: int = 0, pad_index: int = 0, pad_first: bool = False):
+    """Pad each process's array along ``dim`` to the global max size so a gather is
+    rectangular (reference :627-679)."""
+    state = PartialState()
+
+    def _pad_one(t):
+        if _is_global_unaddressable(t):
+            return t  # global arrays are rectangular by construction
+        t = np.asarray(t)
+        if dim >= t.ndim:
+            return t
+        size = np.array(t.shape, dtype=np.int64)
+        sizes = _host_allgather(size, tiled=False) if state.num_processes > 1 else size[None]
+        max_size = int(np.max(sizes[:, dim]))
+        if max_size == t.shape[dim]:
+            return t
+        new_shape = list(t.shape)
+        new_shape[dim] = max_size
+        out = np.full(new_shape, pad_index, dtype=t.dtype)
+        sl = [slice(None)] * t.ndim
+        if pad_first:
+            sl[dim] = slice(max_size - t.shape[dim], max_size)
+        else:
+            sl[dim] = slice(0, t.shape[dim])
+        out[tuple(sl)] = t
+        return out
+
+    return recursively_apply(_pad_one, tensor)
+
+
+def pad_input_tensors(tensor, batch_size: int, num_processes: int, dim: int = 0):
+    """Pad batch so it divides evenly across processes (reference :682-720),
+    repeating the final row(s)."""
+    remainder = batch_size % num_processes
+    if remainder == 0:
+        return tensor
+    to_pad = num_processes - remainder
+
+    def _pad_one(t):
+        t = np.asarray(t)
+        if t.shape[0] != batch_size:
+            return t
+        pad_rows = np.repeat(t[-1:], to_pad, axis=0)
+        return np.concatenate([t, pad_rows], axis=0)
+
+    return recursively_apply(_pad_one, tensor)
+
+
+class GatheredParameters:
+    """No-op parity shim for DeepSpeed zero3's param-gather context
+    (reference :848-866): under GSPMD a sharded param is usable directly — XLA
+    all-gathers on demand — so user code written against this context just works."""
+
+    def __init__(self, params, modifier_rank=None, fwd_module=None, enabled=True):
+        self.params = params
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def _tpu_gather(tensor):  # parity alias (reference :300-313)
+    return gather(tensor)
+
+
+def _gpu_gather(tensor):  # parity alias (reference :315-351)
+    return gather(tensor)

@@ -1,0 +1,37 @@
+"""Test harness: run the whole suite on a virtual 8-device CPU mesh.
+
+This is the JAX-native analog of the reference's gloo-on-CPU trick
+(``tests/test_cpu.py`` + ``debug_launcher`` ``launchers.py:269-302``): 8 fake
+devices exercise every sharding/collective path with zero hardware (SURVEY.md §4).
+"""
+
+import os
+import sys
+
+# Must run before any jax backend initialization. The axon TPU plugin overrides the
+# JAX_PLATFORMS env var at import time, so we pin the platform via jax.config (which
+# wins) in addition to the env contract.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_singletons():
+    """State hygiene between tests — reference ``AccelerateTestCase``
+    (``test_utils/testing.py:618-629``) resets singletons the same way."""
+    yield
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    PartialState._reset_state()
+    GradientState._reset_state()

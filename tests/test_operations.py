@@ -1,0 +1,140 @@
+"""Collectives-veneer tests (tier 1: single process; cross-process semantics get
+tier-2 subprocess coverage in test_multiprocess.py).
+
+Mirrors reference ``tests/test_operations`` coverage via
+``test_utils/scripts/test_ops.py`` (:181).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from accelerate_tpu.utils.operations import (
+    concatenate,
+    convert_to_fp32,
+    find_batch_size,
+    gather,
+    gather_object,
+    ignorant_find_batch_size,
+    listify,
+    pad_across_processes,
+    pad_input_tensors,
+    recursively_apply,
+    send_to_device,
+    slice_tensors,
+)
+
+
+def test_recursively_apply_nested():
+    data = {"a": np.ones((2, 2)), "b": [np.zeros(3), (np.ones(1), "keep")]}
+    out = recursively_apply(lambda t: t + 1, data)
+    assert np.all(out["a"] == 2)
+    assert np.all(out["b"][0] == 1)
+    assert out["b"][1][1] == "keep"
+
+
+def test_recursively_apply_namedtuple():
+    import collections
+
+    Point = collections.namedtuple("Point", ["x", "y"])
+    p = Point(np.ones(2), np.zeros(2))
+    out = recursively_apply(lambda t: t * 3, p)
+    assert isinstance(out, Point)
+    assert np.all(out.x == 3)
+
+
+def test_send_to_device():
+    batch = {"x": np.ones((4, 2)), "y": np.arange(4)}
+    out = send_to_device(batch)
+    assert isinstance(out["x"], jax.Array)
+    assert out["x"].shape == (4, 2)
+
+
+def test_send_to_device_skip_keys():
+    batch = {"x": np.ones(2), "meta": np.zeros(1)}
+    out = send_to_device(batch, skip_keys="meta")
+    assert isinstance(out["x"], jax.Array)
+    assert isinstance(out["meta"], np.ndarray)
+
+
+def test_gather_single_process_identity():
+    x = jnp.arange(8.0)
+    assert np.all(np.asarray(gather(x)) == np.arange(8.0))
+
+
+def test_gather_global_sharded_array():
+    # A sharded global array is gathered to a fully-addressable value.
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from accelerate_tpu.parallel.mesh import default_mesh
+
+    mesh = default_mesh()
+    x = jax.device_put(jnp.arange(16.0).reshape(8, 2), NamedSharding(mesh, P("dp", None)))
+    g = gather(x)
+    assert np.asarray(g).shape == (8, 2)
+
+
+def test_gather_object_single():
+    assert gather_object(["a", "b"]) == ["a", "b"]
+
+
+def test_find_batch_size():
+    assert find_batch_size({"x": np.ones((5, 3))}) == 5
+    assert ignorant_find_batch_size("nope") is None
+    with pytest.raises(ValueError):
+        find_batch_size({"x": np.float32(1.0).reshape(())})
+
+
+def test_pad_across_processes_noop_single():
+    x = np.ones((3, 2))
+    out = pad_across_processes(x, dim=0)
+    assert out.shape == (3, 2)
+
+
+def test_pad_input_tensors():
+    batch = {"x": np.arange(10).reshape(5, 2)}
+    out = pad_input_tensors(batch, batch_size=5, num_processes=4)
+    assert out["x"].shape == (8, 2)
+    assert np.all(out["x"][5:] == out["x"][4])  # repeats last row
+    same = pad_input_tensors(batch, batch_size=5, num_processes=5)
+    assert same["x"].shape == (5, 2)
+
+
+def test_concatenate():
+    a = {"x": jnp.ones((2, 3))}
+    b = {"x": jnp.zeros((4, 3))}
+    out = concatenate([a, b])
+    assert out["x"].shape == (6, 3)
+
+
+def test_slice_and_listify():
+    data = {"x": np.arange(6).reshape(3, 2)}
+    sliced = slice_tensors(data, slice(0, 1))
+    assert sliced["x"].shape == (1, 2)
+    assert listify(data) == {"x": [[0, 1], [2, 3], [4, 5]]}
+
+
+def test_convert_to_fp32():
+    data = {"a": jnp.ones(2, dtype=jnp.bfloat16), "b": jnp.ones(2, dtype=jnp.int32)}
+    out = convert_to_fp32(data)
+    assert out["a"].dtype == jnp.float32
+    assert out["b"].dtype == jnp.int32
+
+
+def test_send_to_device_skip_keys_nested():
+    batch = {"outputs": {"cache": np.ones(2), "logits": np.ones(2)}}
+    out = send_to_device(batch, skip_keys="cache")
+    assert isinstance(out["outputs"]["logits"], jax.Array)
+    assert isinstance(out["outputs"]["cache"], np.ndarray)
+
+
+def test_reduce_modes():
+    from accelerate_tpu.utils.operations import reduce
+
+    x = jnp.ones(3)
+    assert np.all(np.asarray(reduce(x, "sum")) == 1)
+    assert reduce(x, "none") is x
+    with pytest.raises(ValueError, match="reduction"):
+        reduce(x, "max")

@@ -1,0 +1,158 @@
+"""State-layer tests (tier 1, pure logic on the 8-device CPU mesh).
+
+Mirrors reference coverage in ``tests/test_state_checkpointing.py`` /
+``tests/test_utils.py`` singleton behavior and ``PartialState`` helpers.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from accelerate_tpu.state import (
+    AcceleratorState,
+    DistributedType,
+    GradientState,
+    PartialState,
+)
+from accelerate_tpu.parallel.mesh import ParallelismConfig
+
+
+def test_virtual_devices_present():
+    assert jax.device_count() == 8
+
+
+def test_partial_state_singleton():
+    s1 = PartialState()
+    s2 = PartialState()
+    assert s1.__dict__ is s2.__dict__
+    assert s1.num_processes == 1
+    assert s1.process_index == 0
+    assert s1.is_main_process
+    assert s1.is_local_main_process
+    assert s1.is_last_process
+    assert s1.use_distributed  # 8 devices
+    assert s1.num_devices == 8
+
+
+def test_partial_state_reset_raises_on_known_attr():
+    s = PartialState()
+    PartialState._reset_state()
+    # The pre-reset handle now points at the cleared shared dict: known attrs raise
+    # with a pointer to _reset_state (reference state.py __getattr__ behavior).
+    with pytest.raises(AttributeError, match="_reset_state"):
+        _ = s.device
+    # Constructing again re-initializes cleanly.
+    s2 = PartialState()
+    assert s2.device is not None
+
+
+def test_default_mesh_is_dp():
+    s = PartialState()
+    mesh = s.mesh
+    assert mesh.shape["dp"] == 8
+    assert mesh.shape["tp"] == 1
+
+
+def test_split_between_processes_single():
+    s = PartialState()
+    with s.split_between_processes([1, 2, 3]) as shard:
+        assert shard == [1, 2, 3]
+
+
+def test_accelerator_state_mixed_precision():
+    state = AcceleratorState(mixed_precision="bf16")
+    assert state.mixed_precision == "bf16"
+    import jax.numpy as jnp
+
+    assert state.compute_dtype == jnp.bfloat16
+    assert state.num_processes == 1  # delegated to PartialState
+
+
+def test_accelerator_state_rejects_bad_precision():
+    with pytest.raises(ValueError, match="mixed_precision"):
+        AcceleratorState(mixed_precision="fp64")
+
+
+def test_accelerator_state_distributed_type_mutation():
+    # fsdp axis > 1 mutates distributed_type like reference state.py:977-981
+    cfg = ParallelismConfig(fsdp_size=4)
+    state = AcceleratorState(parallelism_config=cfg)
+    assert state.distributed_type == DistributedType.FSDP
+    assert state.mesh.shape["fsdp"] == 4
+    assert state.mesh.shape["dp"] == 2
+    assert state.global_batch_divisor == 8
+
+
+def test_accelerator_state_tp_and_3d():
+    state = AcceleratorState(parallelism_config=ParallelismConfig(tp_size=8))
+    assert state.distributed_type == DistributedType.TP
+    AcceleratorState._reset_state(reset_partial_state=True)
+    state = AcceleratorState(parallelism_config=ParallelismConfig(tp_size=2, fsdp_size=2))
+    assert state.distributed_type == DistributedType.MEGATRON_STYLE
+
+
+def test_mesh_invalid_shape_raises():
+    with pytest.raises(ValueError, match="devices"):
+        ParallelismConfig(tp_size=3).build_mesh()
+
+
+def test_mesh_env_parsing(monkeypatch):
+    monkeypatch.setenv("ACCELERATE_MESH_SHAPE", "fsdp:2,tp:2")
+    cfg = ParallelismConfig.from_env()
+    assert cfg.fsdp_size == 2 and cfg.tp_size == 2
+    mesh = cfg.build_mesh()
+    assert mesh.shape["dp"] == 2
+
+
+def test_gradient_state_defaults():
+    gs = GradientState()
+    assert gs.sync_gradients
+    assert gs.num_steps == 1
+    assert gs.end_of_dataloader is False
+    assert gs.remainder == -1
+
+
+def test_gradient_state_plugin():
+    from accelerate_tpu.utils.dataclasses import GradientAccumulationPlugin
+
+    gs = GradientState(GradientAccumulationPlugin(num_steps=4))
+    assert gs.num_steps == 4
+    gs2 = GradientState()
+    assert gs2.num_steps == 4  # singleton
+
+
+def test_gradient_state_dataloader_registry():
+    class FakeDL:
+        end_of_dataloader = True
+        remainder = 3
+
+    gs = GradientState()
+    dl = FakeDL()
+    gs._add_dataloader(dl)
+    assert gs.active_dataloader is dl
+    assert gs.end_of_dataloader is True
+    assert gs.remainder == 3
+    gs._remove_dataloader(dl)
+    assert gs.active_dataloader is None
+
+
+def test_accelerator_state_failed_ctor_does_not_poison_singleton():
+    with pytest.raises(ValueError):
+        AcceleratorState(mixed_precision="fp64")
+    state = AcceleratorState(mixed_precision="bf16")
+    assert state.mixed_precision == "bf16"
+    with pytest.raises(ValueError):
+        # bad mesh also must not poison
+        AcceleratorState._reset_state(reset_partial_state=True)
+        AcceleratorState(parallelism_config=ParallelismConfig(tp_size=3))
+    state = AcceleratorState()
+    assert state.mesh.shape["dp"] == 8
+
+
+def test_split_between_processes_padding_helper():
+    from accelerate_tpu.state import _pad_with_last
+
+    out = _pad_with_last([], 2, fallback=[1, 2, 3])
+    assert out == [3, 3]
+    out = _pad_with_last(np.array([[1, 2]]), 1, fallback=np.array([[0, 0], [9, 9]]))
+    assert out.shape == (2, 2) and np.all(out[1] == [1, 2])
