@@ -1,0 +1,879 @@
+"""Accelerator — the orchestration facade (L5).
+
+Reference parity: ``src/accelerate/accelerator.py`` (3,952 LoC, class at :180).
+The public surface is kept — ``prepare`` (:1289), ``backward`` (:2502),
+``accumulate`` (:1122), ``gather``/``gather_for_metrics`` (:2719/:2751),
+``clip_grad_norm_`` (:2630), ``save_state``/``load_state`` (:3260/:3426),
+``autocast`` (:3770), ``set_trigger``/``check_trigger`` (:2536-2593) — but the
+engine is inverted:
+
+- The reference wraps live modules (DDP/FSDP/engine wrappers) and lets backward
+  hooks fire NCCL collectives. Here ``prepare`` lowers the model into a **pure
+  function + sharded param pytree** on the state's mesh, and every forward/backward
+  is a cached, jitted XLA program in which GSPMD has already inserted the
+  cross-device reductions. ``backward(loss)`` therefore doesn't *run* autodiff —
+  gradients were produced by the same compiled call that produced ``loss``
+  (``jax.value_and_grad``) — it *banks* them into the optimizer's accumulation
+  buffer (the explicit-pytree analog of ``.grad +=``).
+- DDP's ``no_sync`` dance (:1007-1045) vanishes: gradient accumulation is a
+  device-side buffer add; the cross-device reduce rides each compiled step.
+- The fused path ``build_train_step`` goes further and compiles forward+backward+
+  accumulation+update into ONE XLA program with donated buffers — that is the
+  shape the hardware wants, and what ``bench.py`` measures.
+
+Imperative-compat contract (SURVEY.md §7 hard part 1): the pattern
+
+    model, optimizer, loader, scheduler = accelerator.prepare(...)
+    for batch in loader:
+        with accelerator.accumulate(model):
+            outputs = model(**batch)
+            accelerator.backward(outputs.loss)
+            optimizer.step(); scheduler.step(); optimizer.zero_grad()
+
+works unmodified: prepared models in train mode compute grads at forward time
+(same cost as torch's fwd+bwd — one fwd, one bwd, fused by XLA), and the loss
+object returned carries the association to those banked grads.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import math
+import os
+from functools import partial
+from typing import Any, Callable
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .data_loader import DataLoaderDispatcher, DataLoaderShard, prepare_data_loader, skip_first_batches
+from .modules import Module, ModelOutput, as_module, default_loss_extractor
+from .optimizer import AcceleratedOptimizer, GradScalerState
+from .parallel.mesh import ParallelismConfig
+from .parallel.sharding import (
+    apply_shardings,
+    batch_sharding,
+    make_global_batch,
+    plan_param_shardings,
+)
+from .scheduler import AcceleratedScheduler
+from .state import AcceleratorState, DistributedType, GradientState, PartialState
+from .utils.dataclasses import (
+    DataLoaderConfiguration,
+    FullyShardedDataParallelPlugin,
+    GradientAccumulationPlugin,
+    JaxShardingKwargs,
+    MegatronStylePlugin,
+    PipelineParallelPlugin,
+    ProjectConfiguration,
+    SequenceParallelPlugin,
+    TensorParallelPlugin,
+)
+from .utils import operations as ops
+
+logger = logging.getLogger(__name__)
+
+
+class TrainHandle:
+    """Shared mutable cell binding a PreparedModel to its optimizer(s): holds the
+    *current* sharded params so ``optimizer.step()`` visibly updates what
+    ``model(...)`` uses next — the stateful shim over the functional core."""
+
+    def __init__(self, module: Module, params, param_shardings, mesh, compute_dtype, rng):
+        self.module = module
+        self.params = params
+        self.param_shardings = param_shardings
+        self.mesh = mesh
+        self.compute_dtype = compute_dtype
+        self.rng = rng
+        self.step_counter = 0
+        self.last_grad_norm = None
+        self.pending = None  # (loss jax.Array, grads pytree) from last train forward
+
+
+class PreparedModel:
+    """The object handed back by ``prepare`` in a model's slot (reference returns
+    the DDP/FSDP-wrapped module, ``accelerator.py:1515``)."""
+
+    def __init__(self, handle: TrainHandle, accelerator: "Accelerator", loss_fn=None):
+        self.handle = handle
+        self.accelerator = accelerator
+        self.loss_fn = loss_fn or default_loss_extractor
+        self.training = True
+        self._train_call = None
+        self._eval_call = None
+
+    # ------------------------------------------------------------------ modes
+    def train(self, mode: bool = True):
+        self.training = mode
+        return self
+
+    def eval(self):
+        return self.train(False)
+
+    # ------------------------------------------------------------- unwrapping
+    @property
+    def module(self) -> Module:
+        return self.handle.module
+
+    @property
+    def params(self):
+        return self.handle.params
+
+    @params.setter
+    def params(self, value):
+        self.handle.params = value
+
+    def state_dict(self):
+        return self.handle.params
+
+    def load_state_dict(self, params):
+        self.handle.params = apply_shardings(params, self.handle.param_shardings)
+
+    # ---------------------------------------------------------------- compile
+    def _cast(self, params):
+        dtype = self.handle.compute_dtype
+        if dtype == jnp.float32:
+            return params
+        return jax.tree_util.tree_map(
+            lambda p: p.astype(dtype) if jnp.issubdtype(p.dtype, jnp.floating) else p, params
+        )
+
+    def _build_calls(self):
+        module = self.handle.module
+        loss_fn = self.loss_fn
+        cast = self._cast
+
+        def fwd(params, args, kwargs, rng):
+            return module.apply(cast(params), *args, train=False, rngs=None, **kwargs)
+
+        def loss_and_out(params, args, kwargs, rng, loss_scale):
+            outputs = module.apply(
+                cast(params), *args, train=True, rngs={"dropout": rng}, **kwargs
+            )
+            loss = loss_fn(outputs, kwargs if kwargs else args)
+            return loss * loss_scale, outputs
+
+        def train_fwd(params, args, kwargs, rng, loss_scale):
+            (scaled_loss, outputs), grads = jax.value_and_grad(loss_and_out, has_aux=True)(
+                params, args, kwargs, rng, loss_scale
+            )
+            return scaled_loss / loss_scale, outputs, grads
+
+        self._eval_call = jax.jit(fwd)
+        self._train_call = jax.jit(train_fwd)
+
+    def __call__(self, *args, **kwargs):
+        if self._train_call is None:
+            self._build_calls()
+        handle = self.handle
+        handle.step_counter += 1
+        rng = jax.random.fold_in(handle.rng, handle.step_counter)
+        args, kwargs = self.accelerator._place_batch((args, kwargs))
+        if self.training:
+            scaler = self.accelerator.scaler
+            loss_scale = jnp.float32(scaler.scale if scaler is not None else 1.0)
+            loss, outputs, grads = self._train_call(handle.params, args, kwargs, rng, loss_scale)
+            handle.pending = (loss, grads)
+            if isinstance(outputs, dict) and "loss" in outputs:
+                # Hand the *differentiated* loss object out so backward() can match it.
+                outputs = ModelOutput(outputs)
+                outputs["loss"] = loss
+            return outputs
+        return self._eval_call(handle.params, args, kwargs, rng)
+
+    def forward(self, *args, **kwargs):
+        return self(*args, **kwargs)
+
+
+class Accelerator:
+    """See module docstring. Constructor mirrors reference ``accelerator.py:271``."""
+
+    def __init__(
+        self,
+        device_placement: bool = True,
+        split_batches: bool = False,
+        mixed_precision: str | None = None,
+        gradient_accumulation_steps: int = 1,
+        cpu: bool = False,
+        dataloader_config: DataLoaderConfiguration | None = None,
+        fsdp_plugin: FullyShardedDataParallelPlugin | None = None,
+        tp_plugin: TensorParallelPlugin | None = None,
+        pp_plugin: PipelineParallelPlugin | None = None,
+        sp_plugin: SequenceParallelPlugin | None = None,
+        megatron_plugin: MegatronStylePlugin | None = None,
+        parallelism_config: ParallelismConfig | None = None,
+        rng_types: list | None = None,
+        log_with=None,
+        project_dir: str | os.PathLike | None = None,
+        project_config: ProjectConfiguration | None = None,
+        gradient_accumulation_plugin: GradientAccumulationPlugin | None = None,
+        step_scheduler_with_optimizer: bool = True,
+        kwargs_handlers: list | None = None,
+        dynamo_backend=None,  # parity slot: XLA always compiles
+    ):
+        self.project_configuration = project_config or ProjectConfiguration(project_dir=project_dir)
+        if project_dir is not None and self.project_configuration.project_dir is None:
+            self.project_configuration.set_directories(project_dir)
+        self.sharding_kwargs = JaxShardingKwargs()
+        for handler in kwargs_handlers or []:
+            if isinstance(handler, JaxShardingKwargs):
+                self.sharding_kwargs = handler
+
+        if parallelism_config is None:
+            parallelism_config = self._resolve_parallelism(
+                fsdp_plugin, tp_plugin, pp_plugin, sp_plugin, megatron_plugin
+            )
+        self.fsdp_plugin = fsdp_plugin
+        self.state = AcceleratorState(
+            mixed_precision=mixed_precision, cpu=cpu, parallelism_config=parallelism_config
+        )
+
+        if gradient_accumulation_plugin is None:
+            steps = int(os.environ.get("ACCELERATE_GRADIENT_ACCUMULATION_STEPS", gradient_accumulation_steps))
+            gradient_accumulation_plugin = GradientAccumulationPlugin(num_steps=steps)
+        self.gradient_state = GradientState(gradient_accumulation_plugin)
+
+        self.device_placement = device_placement
+        self.split_batches = split_batches
+        self.dataloader_config = dataloader_config or DataLoaderConfiguration(split_batches=split_batches)
+        self.step_scheduler_with_optimizer = step_scheduler_with_optimizer
+        self.rng_types = rng_types or ["generator"]
+
+        self.scaler = GradScalerState() if self.state.mixed_precision == "fp16" else None
+        self.step = 0
+        self.flag_tensor = None
+        self._models: list[PreparedModel] = []
+        self._optimizers: list[AcceleratedOptimizer] = []
+        self._schedulers: list[AcceleratedScheduler] = []
+        self._dataloaders: list = []
+        self._custom_objects: list = []
+        self._loss_fn = None
+        self._rng_seed_counter = 0
+
+        self.log_with = []
+        self.trackers = []
+        if log_with is not None:
+            from .tracking import filter_trackers
+
+            self.log_with = filter_trackers(log_with, self.logging_dir)
+
+    # ------------------------------------------------------------- properties
+    def _resolve_parallelism(self, fsdp_plugin, tp_plugin, pp_plugin, sp_plugin, megatron_plugin):
+        if megatron_plugin is not None:
+            return ParallelismConfig(
+                fsdp_size=megatron_plugin.fsdp_size,
+                tp_size=megatron_plugin.tp_size,
+                pp_size=megatron_plugin.pp_size,
+                sp_size=megatron_plugin.sp_size,
+            )
+        cfg = ParallelismConfig.from_env()
+        if fsdp_plugin is not None:
+            cfg.fsdp_size = fsdp_plugin.fsdp_size if fsdp_plugin.fsdp_size > 0 else -1
+            if cfg.fsdp_size == -1:
+                cfg.fsdp_size, cfg.dp_size = 1, cfg.dp_size  # resolved against devices below
+                import jax as _jax
+
+                denom = cfg.tp_size * cfg.pp_size * cfg.sp_size
+                cfg.fsdp_size = max(_jax.device_count() // denom, 1)
+                cfg.dp_size = 1
+        if tp_plugin is not None:
+            cfg.tp_size = tp_plugin.tp_size
+        if pp_plugin is not None:
+            cfg.pp_size = pp_plugin.pp_size
+        if sp_plugin is not None:
+            cfg.sp_size = sp_plugin.sp_size
+        return cfg
+
+    @property
+    def distributed_type(self) -> DistributedType:
+        return self.state.distributed_type
+
+    @property
+    def mesh(self):
+        return self.state.mesh
+
+    @property
+    def device(self):
+        return self.state.device
+
+    @property
+    def num_processes(self):
+        return self.state.num_processes
+
+    @property
+    def process_index(self):
+        return self.state.process_index
+
+    @property
+    def local_process_index(self):
+        return self.state.local_process_index
+
+    @property
+    def is_main_process(self):
+        return self.state.is_main_process
+
+    @property
+    def is_local_main_process(self):
+        return self.state.is_local_main_process
+
+    @property
+    def is_last_process(self):
+        return self.state.is_last_process
+
+    @property
+    def mixed_precision(self):
+        return self.state.mixed_precision
+
+    @property
+    def gradient_accumulation_steps(self):
+        return self.gradient_state.num_steps
+
+    @gradient_accumulation_steps.setter
+    def gradient_accumulation_steps(self, value):
+        self.gradient_state.plugin_kwargs.update({"num_steps": value})
+
+    @property
+    def sync_gradients(self):
+        return self.gradient_state.sync_gradients
+
+    @property
+    def use_distributed(self):
+        return self.state.use_distributed
+
+    @property
+    def project_dir(self):
+        return self.project_configuration.project_dir
+
+    @property
+    def logging_dir(self):
+        return self.project_configuration.logging_dir
+
+    @property
+    def save_iteration(self):
+        return self.project_configuration.iteration
+
+    # --------------------------------------------------------------- plumbing
+    def print(self, *args, **kwargs):
+        self.state.print(*args, **kwargs)
+
+    def wait_for_everyone(self):
+        self.state.wait_for_everyone()
+
+    def on_main_process(self, f):
+        return self.state.on_main_process(f)
+
+    def on_local_main_process(self, f):
+        return self.state.on_local_main_process(f)
+
+    def on_process(self, f=None, process_index=None):
+        return self.state.on_process(f, process_index)
+
+    def split_between_processes(self, inputs, apply_padding: bool = False):
+        return self.state.split_between_processes(inputs, apply_padding=apply_padding)
+
+    @contextlib.contextmanager
+    def main_process_first(self):
+        with self.state.main_process_first():
+            yield
+
+    @contextlib.contextmanager
+    def local_main_process_first(self):
+        with self.state.local_main_process_first():
+            yield
+
+    def _place_batch(self, batch):
+        """Ensure host arrays in a forward call are global mesh arrays."""
+        if not self.device_placement:
+            return batch
+
+        mesh = self.mesh
+
+        def _one(x):
+            if isinstance(x, jax.Array):
+                return x
+            if isinstance(x, np.ndarray):
+                return make_global_batch(x, mesh)
+            return x
+
+        return jax.tree_util.tree_map(_one, batch)
+
+    # ---------------------------------------------------------------- prepare
+    def prepare(self, *args, device_placement=None):
+        """Classify & lower each object (reference ``prepare`` :1289-1443).
+
+        models → ``PreparedModel`` (sharded params), optax transforms →
+        ``AcceleratedOptimizer``, dataloaders → sharded device-feeding loaders,
+        schedules → ``AcceleratedScheduler``. Order is preserved.
+        """
+        import optax
+
+        result = []
+        prepared_model = None
+        prepared_opts = []
+        for obj in args:
+            kind = self._classify(obj)
+            if kind == "model":
+                prepared = self.prepare_model(obj)
+                prepared_model = prepared
+            elif kind == "optimizer":
+                prepared = AcceleratedOptimizer(obj, scaler=self.scaler)
+                prepared_opts.append(prepared)
+                self._optimizers.append(prepared)
+            elif kind == "dataloader":
+                prepared = self.prepare_data_loader(obj)
+            elif kind == "scheduler":
+                prepared = obj  # bound after optimizers exist
+            else:
+                prepared = obj
+            result.append((kind, obj, prepared))
+
+        # Bind optimizers to the model handle (single-model case; multi-model users
+        # call prepare separately per pair, as in the reference's deepspeed guard).
+        if prepared_model is not None:
+            for opt in prepared_opts:
+                opt.handle = prepared_model.handle
+        elif prepared_opts and self._models:
+            for opt in prepared_opts:
+                opt.handle = self._models[-1].handle
+
+        final = []
+        for kind, obj, prepared in result:
+            if kind == "scheduler":
+                opts = prepared_opts or self._optimizers
+                prepared = AcceleratedScheduler(
+                    obj,
+                    opts,
+                    step_with_optimizer=self.step_scheduler_with_optimizer,
+                    split_batches=self.dataloader_config.split_batches,
+                )
+                self._schedulers.append(prepared)
+            final.append(prepared)
+        return final[0] if len(final) == 1 else tuple(final)
+
+    def _classify(self, obj) -> str:
+        import optax
+
+        if isinstance(obj, optax.GradientTransformation):
+            return "optimizer"
+        if isinstance(obj, (PreparedModel,)):
+            return "model"
+        if isinstance(obj, Module) or type(obj).__module__.startswith("flax"):
+            return "model"
+        if isinstance(obj, tuple) and len(obj) == 2 and (
+            isinstance(obj[0], Module) or hasattr(obj[0], "apply")
+        ):
+            return "model"
+        if hasattr(obj, "init") and hasattr(obj, "apply"):
+            return "model"
+        if hasattr(obj, "__iter__") and not callable(obj):
+            return "dataloader"
+        if _is_torch_dataloader(obj):
+            return "dataloader"
+        if callable(obj):
+            return "scheduler"
+        return "other"
+
+    def prepare_model(self, model, device_placement=None, evaluation_mode: bool = False):
+        """Lower a model to (module, sharded params) and wrap (reference
+        ``prepare_model`` :1515-1800 — where DDP/FSDP wrapping happened, here the
+        param pytree is placed onto the mesh by the sharding planner)."""
+        if isinstance(model, PreparedModel):
+            return model
+        params = None
+        if isinstance(model, tuple) and len(model) == 2:
+            model, params = model
+        module = as_module(model)
+        if params is None:
+            params = getattr(model, "params", None)
+        if params is None:
+            raise ValueError(
+                "Model has no parameters: pass `(module, params)` to prepare(), or set "
+                "`model.params` (model-zoo modules do this via `model.init_params(rng, ...)`)."
+            )
+        rules = None
+        if isinstance(module, Module):
+            rules = module.sharding_rules()
+        min_shard = self.fsdp_plugin.min_shard_size if self.fsdp_plugin is not None else 2**14
+        shardings = plan_param_shardings(params, self.mesh, rules=rules, min_shard_size=min_shard)
+        params = apply_shardings(params, shardings)
+        rng = jax.random.key(int(os.environ.get("ACCELERATE_SEED", 0)) + 7919)
+        handle = TrainHandle(module, params, shardings, self.mesh, self.state.compute_dtype, rng)
+        prepared = PreparedModel(handle, self, loss_fn=self._loss_fn)
+        prepared.train(not evaluation_mode)
+        self._models.append(prepared)
+        # Keep the user's handle usable: reflect params back onto the original
+        # object so `model.params` stays meaningful after prepare.
+        try:
+            model.params = params
+        except (AttributeError, TypeError):
+            pass
+        return prepared
+
+    def prepare_data_loader(self, data_loader, device_placement=None, slice_fn_for_dispatch=None):
+        if isinstance(data_loader, (DataLoaderShard, DataLoaderDispatcher)):
+            self._dataloaders.append(data_loader)
+            return data_loader
+        cfg = self.dataloader_config
+        prepared = prepare_data_loader(
+            data_loader,
+            device=self.device,
+            split_batches=cfg.split_batches,
+            put_on_device=self.device_placement if device_placement is None else device_placement,
+            rng_types=self.rng_types if _is_torch_dataloader(data_loader) else None,
+            dispatch_batches=cfg.dispatch_batches,
+            even_batches=cfg.even_batches,
+            slice_fn_for_dispatch=slice_fn_for_dispatch,
+            use_seedable_sampler=cfg.use_seedable_sampler,
+            data_seed=cfg.data_seed,
+            non_blocking=cfg.non_blocking,
+            use_stateful_dataloader=cfg.use_stateful_dataloader,
+        )
+        self._dataloaders.append(prepared)
+        return prepared
+
+    def prepare_optimizer(self, optimizer, device_placement=None):
+        prepared = AcceleratedOptimizer(optimizer, scaler=self.scaler)
+        if self._models:
+            prepared.handle = self._models[-1].handle
+        self._optimizers.append(prepared)
+        return prepared
+
+    def prepare_scheduler(self, scheduler):
+        prepared = AcceleratedScheduler(
+            scheduler,
+            self._optimizers,
+            step_with_optimizer=self.step_scheduler_with_optimizer,
+            split_batches=self.dataloader_config.split_batches,
+        )
+        self._schedulers.append(prepared)
+        return prepared
+
+    def set_loss_fn(self, loss_fn: Callable):
+        """Register a custom loss: ``loss_fn(outputs, batch) -> scalar`` (jittable).
+        Needed when the model returns logits and the loss lives in user code —
+        the analog of computing ``F.cross_entropy`` outside the model in torch."""
+        self._loss_fn = loss_fn
+        for m in self._models:
+            m.loss_fn = loss_fn
+            m._train_call = None  # force recompile with the new loss
+
+    # ------------------------------------------------------- training facade
+    def backward(self, loss, **kwargs):
+        """Bank the gradients already produced with ``loss`` (see module docstring;
+        reference ``backward`` :2502-2534 divides by accum steps — we fold that
+        into the accumulation scale)."""
+        model = self._find_model_for_loss(loss)
+        if model is None or model.handle.pending is None:
+            raise RuntimeError(
+                "backward() found no gradients: call it with the loss from a train-mode "
+                "forward of a prepared model (or use build_train_step for the fused path)."
+            )
+        _, grads = model.handle.pending
+        model.handle.pending = None
+        opt = self._optimizer_for_handle(model.handle)
+        if opt is None:
+            raise RuntimeError("No prepared optimizer is bound to this model.")
+        opt._accumulate(grads, scale=1.0 / self.gradient_accumulation_steps)
+
+    def _find_model_for_loss(self, loss):
+        for m in self._models:
+            if m.handle.pending is not None and m.handle.pending[0] is loss:
+                return m
+        pending = [m for m in self._models if m.handle.pending is not None]
+        if len(pending) == 1:
+            return pending[0]
+        return None
+
+    def _optimizer_for_handle(self, handle):
+        for opt in self._optimizers:
+            if opt.handle is handle:
+                return opt
+        return self._optimizers[-1] if self._optimizers else None
+
+    def _do_sync(self):
+        """Reference ``_do_sync`` :1096-1103."""
+        if self.gradient_state.sync_with_dataloader and self.gradient_state.end_of_dataloader:
+            self.step = 0
+            self.gradient_state._set_sync_gradients(True)
+        else:
+            self.step += 1
+            self.gradient_state._set_sync_gradients(
+                (self.step % self.gradient_state.num_steps) == 0
+            )
+
+    @contextlib.contextmanager
+    def accumulate(self, *models):
+        """Reference ``accumulate`` :1122-1166."""
+        self._do_sync()
+        yield
+
+    @contextlib.contextmanager
+    def no_sync(self, model):
+        """DDP ``no_sync`` parity (:1007-1045). Under GSPMD the grad reduction is
+        part of the compiled step, so there is nothing to suppress — accumulation
+        correctness comes from the buffer add, and this context is a no-op."""
+        yield
+
+    @contextlib.contextmanager
+    def join_uneven_inputs(self, joinables, even_batches=None):
+        """DDP-join parity (:1167-1265): uneven tails never reach the mesh — the
+        data layer pads to static shapes and records ``remainder`` — so joining is
+        a no-op context."""
+        yield
+
+    @contextlib.contextmanager
+    def autocast(self, autocast_handler=None):
+        """Parity context (:3770): dtype policy is applied inside compiled calls;
+        nothing dynamic to toggle here."""
+        yield
+
+    def clip_grad_norm_(self, parameters=None, max_norm: float = 1.0, norm_type: int = 2):
+        """Register clipping for the pending update and return the pre-clip global
+        norm of the currently-banked grads (reference :2630-2690; the XLA branch
+        there hand-rolls all_reduce — GSPMD already made our grads global)."""
+        if norm_type != 2:
+            raise NotImplementedError("only the L2 global norm is supported on TPU")
+        opt = self._optimizers[-1] if self._optimizers else None
+        if opt is None or opt.grads is None:
+            return jnp.float32(0.0)
+        opt._pending_clip_norm = float(max_norm)
+        from .optimizer import _global_norm
+
+        return _global_norm(opt.grads)
+
+    def clip_grad_value_(self, parameters, clip_value: float):
+        opt = self._optimizers[-1] if self._optimizers else None
+        if opt is None or opt.grads is None:
+            return
+        opt._accum_grads = jax.tree_util.tree_map(
+            lambda g: jnp.clip(g, -clip_value, clip_value), opt._accum_grads
+        )
+
+    # ----------------------------------------------------------- fused step
+    def build_train_step(self, model: PreparedModel, optimizer: AcceleratedOptimizer, loss_fn=None):
+        """ONE compiled XLA program per microbatch: forward + backward + buffer
+        accumulation + (conditional) optimizer update, with params/opt-state/grad
+        buffers donated. This is the TPU-shaped hot loop — no host round-trips, no
+        retraces across accumulation boundaries (SURVEY.md §7 hard part 3).
+
+        Returns ``step(batch) -> loss`` operating on the shared handle state.
+        """
+        import optax
+
+        handle = model.handle
+        optimizer._ensure_initialized()
+        module = handle.module
+        extract = loss_fn or model.loss_fn
+        accum = self.gradient_accumulation_steps
+        tx = optimizer.tx
+        cast = model._cast
+
+        def loss_of(params, batch, rng):
+            outputs = module.apply(cast(params), train=True, rngs={"dropout": rng}, **batch)
+            return extract(outputs, batch)
+
+        @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+        def _step(params, opt_state, accum_grads, count, batch, rng, clip_norm):
+            loss, grads = jax.value_and_grad(loss_of)(params, batch, rng)
+            accum_grads = jax.tree_util.tree_map(
+                lambda a, g: a + g / accum, accum_grads, grads
+            )
+            count = count + 1
+            do_update = (count % accum) == 0
+
+            def upd(operand):
+                params, opt_state, grads = operand
+                gnorm = jnp.sqrt(
+                    sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in jax.tree_util.tree_leaves(grads))
+                )
+                factor = jnp.where((clip_norm > 0) & (gnorm > clip_norm), clip_norm / (gnorm + 1e-6), 1.0)
+                grads = jax.tree_util.tree_map(lambda g: g * factor, grads)
+                updates, new_opt = tx.update(grads, opt_state, params)
+                new_params = optax.apply_updates(params, updates)
+                zero = jax.tree_util.tree_map(jnp.zeros_like, grads)
+                return new_params, new_opt, zero
+
+            def keep(operand):
+                return operand
+
+            params, opt_state, accum_grads = jax.lax.cond(
+                do_update, upd, keep, (params, opt_state, accum_grads)
+            )
+            return params, opt_state, accum_grads, count, loss
+
+        if optimizer._accum_grads is None:
+            optimizer._accum_grads = jax.tree_util.tree_map(jnp.zeros_like, handle.params)
+        count_box = [jnp.int32(0)]
+
+        def step(batch, clip_norm: float = 0.0):
+            batch = self._place_batch(batch)
+            handle.step_counter += 1
+            rng = jax.random.fold_in(handle.rng, handle.step_counter)
+            (handle.params, optimizer.opt_state, optimizer._accum_grads,
+             count_box[0], loss) = _step(
+                handle.params, optimizer.opt_state, optimizer._accum_grads,
+                count_box[0], batch, rng, jnp.float32(clip_norm),
+            )
+            return loss
+
+        return step
+
+    # ------------------------------------------------------------ collectives
+    def gather(self, tensor):
+        return ops.gather(tensor)
+
+    def gather_for_metrics(self, input_data, use_gather_object: bool = False):
+        """Gather and drop the duplicated tail samples of the final batch
+        (reference :2751-2823)."""
+        try:
+            all_tensors = ops.gather(input_data) if not use_gather_object else ops.gather_object(input_data)
+        except Exception:
+            all_tensors = ops.gather_object(input_data)
+            use_gather_object = True
+        if not self.gradient_state.end_of_dataloader:
+            return all_tensors
+        remainder = self.gradient_state.remainder
+        if remainder is None or remainder <= 0:
+            return all_tensors
+        if use_gather_object:
+            return all_tensors[:remainder]
+
+        def _trim(t):
+            return t[:remainder] if hasattr(t, "shape") and np.ndim(t) > 0 else t
+
+        return ops.recursively_apply(_trim, all_tensors)
+
+    def reduce(self, tensor, reduction="sum", scale=1.0):
+        return ops.reduce(tensor, reduction=reduction, scale=scale)
+
+    def pad_across_processes(self, tensor, dim=0, pad_index=0, pad_first=False):
+        return ops.pad_across_processes(tensor, dim=dim, pad_index=pad_index, pad_first=pad_first)
+
+    # ------------------------------------------------------------ early stop
+    def set_trigger(self):
+        """Cross-process early-stop flag (reference :2536-2563)."""
+        self.flag_tensor = np.ones((), dtype=np.int32)
+
+    def check_trigger(self) -> bool:
+        local = self.flag_tensor if self.flag_tensor is not None else np.zeros((), dtype=np.int32)
+        total = ops.reduce(local, reduction="sum")
+        if float(np.asarray(total)) >= 1:
+            self.flag_tensor = None
+            return True
+        return False
+
+    # -------------------------------------------------------------- unwrap &c
+    def unwrap_model(self, model, keep_fp32_wrapper: bool = True):
+        """Return (module, params) behind a PreparedModel (reference
+        ``extract_model_from_parallel``, utils/other.py:197)."""
+        if isinstance(model, PreparedModel):
+            return model.module
+        return model
+
+    def get_state_dict(self, model, unwrap: bool = True):
+        """Full (host) state dict — always gatherable here because params are
+        global arrays (the zero3/FSDP special-casing at :3661 dissolves)."""
+        if isinstance(model, PreparedModel):
+            params = model.params
+        else:
+            params = getattr(model, "params", model)
+        return jax.tree_util.tree_map(lambda p: np.asarray(jax.device_get(p)), params)
+
+    def free_memory(self, *objects):
+        """Release prepared references & buffers (reference :3570-3608)."""
+        self._models.clear()
+        self._optimizers.clear()
+        self._schedulers.clear()
+        self._dataloaders.clear()
+        self.step = 0
+        import gc
+
+        gc.collect()
+        try:
+            jax.clear_caches()
+        except Exception:
+            pass
+        return objects
+
+    def clear(self, *objects):
+        return self.free_memory(*objects)
+
+    # ------------------------------------------------------- trackers / log
+    def init_trackers(self, project_name: str, config: dict | None = None, init_kwargs: dict | None = None):
+        from .tracking import init_trackers as _init
+
+        self.trackers = _init(self.log_with, project_name, self.logging_dir, config, init_kwargs, self)
+
+    def get_tracker(self, name: str, unwrap: bool = False):
+        for tracker in self.trackers:
+            if tracker.name == name:
+                return tracker.tracker if unwrap else tracker
+        raise ValueError(f"Tracker {name} not found: available {[t.name for t in self.trackers]}")
+
+    def log(self, values: dict, step: int | None = None, log_kwargs: dict | None = None):
+        if self.is_main_process:
+            for tracker in self.trackers:
+                tracker.log(values, step=step, **((log_kwargs or {}).get(tracker.name, {})))
+
+    def end_training(self):
+        if self.is_main_process:
+            for tracker in self.trackers:
+                tracker.finish()
+        self.wait_for_everyone()
+
+    # ----------------------------------------------------------- checkpointing
+    def register_for_checkpointing(self, *objects):
+        """Objects with state_dict/load_state_dict saved in save_state (reference :3733)."""
+        invalid = [o for o in objects if not (hasattr(o, "state_dict") and hasattr(o, "load_state_dict"))]
+        if invalid:
+            raise ValueError(f"Objects lack state_dict/load_state_dict: {invalid}")
+        self._custom_objects.extend(objects)
+
+    def save_state(self, output_dir: str | None = None, **save_model_func_kwargs):
+        from .checkpointing import save_accelerator_state
+
+        return save_accelerator_state(self, output_dir, **save_model_func_kwargs)
+
+    def load_state(self, input_dir: str | None = None, **load_model_func_kwargs):
+        from .checkpointing import load_accelerator_state
+
+        return load_accelerator_state(self, input_dir, **load_model_func_kwargs)
+
+    def save_model(self, model, save_directory, max_shard_size="10GB", safe_serialization=True):
+        from .checkpointing import save_model as _save_model
+
+        return _save_model(self, model, save_directory, max_shard_size, safe_serialization)
+
+    def skip_first_batches(self, dataloader, num_batches: int = 0):
+        return skip_first_batches(dataloader, num_batches)
+
+    # ---------------------------------------------------------------- profile
+    @contextlib.contextmanager
+    def profile(self, profile_handler=None):
+        """``jax.profiler`` trace context (reference ``profile`` :3797-3856 builds
+        torch.profiler; output opens in TensorBoard/perfetto)."""
+        from .utils.dataclasses import ProfileKwargs
+
+        handler = profile_handler or ProfileKwargs()
+        trace_dir = handler.output_trace_dir
+        if trace_dir is None:
+            yield None
+            return
+        with jax.profiler.trace(trace_dir):
+            yield None
+
+    def __repr__(self):
+        return f"Accelerator(state={self.state!r})"
+
+
+def _is_torch_dataloader(obj) -> bool:
+    try:
+        import torch.utils.data as tud
+
+        return isinstance(obj, tud.DataLoader)
+    except ImportError:
+        return False

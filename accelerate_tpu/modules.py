@@ -1,0 +1,138 @@
+"""Model protocol & adapters — how user models enter the compiled world.
+
+The reference wraps live ``nn.Module`` objects (``accelerator.py:1515-1800``).
+TPU-native, a model is a *pure function plus a parameter pytree*; this module
+defines that protocol and adapters so users can bring:
+
+- an ``accelerate_tpu.Module`` subclass (our model zoo in ``models/``),
+- a ``flax.linen.Module``,
+- a bare ``(init_fn, apply_fn)`` pair via ``FunctionalModel``.
+
+The ``PreparedModel`` returned by ``Accelerator.prepare`` keeps the imperative feel
+of the reference API — ``model(**batch)`` works, ``model.train()/.eval()`` work —
+while everything under the call is a cached, jitted, sharded pure function.
+
+HF-style convention: when the batch contains labels the forward returns an output
+structure with a ``loss`` field; that is what powers the reference-shaped
+``accelerator.backward(loss)`` flow (see ``accelerator.py`` here).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Mapping
+
+import jax
+import jax.numpy as jnp
+
+
+class ModelOutput(dict):
+    """Dict with attribute access (``out.loss``, ``out.logits``) — pytree-friendly
+    stand-in for transformers' ModelOutput."""
+
+    def __getattr__(self, name):
+        try:
+            return self[name]
+        except KeyError:
+            raise AttributeError(name)
+
+    def __setattr__(self, name, value):
+        self[name] = value
+
+
+jax.tree_util.register_pytree_node(
+    ModelOutput,
+    lambda mo: (tuple(mo.values()), tuple(mo.keys())),
+    lambda keys, vals: ModelOutput(zip(keys, vals)),
+)
+
+
+class Module:
+    """Base for the model zoo: stateless config object + pure init/apply.
+
+    Subclasses implement ``init(rng, *example_inputs) -> params`` and
+    ``apply(params, *args, train=False, rngs=None, **kwargs)``.
+    """
+
+    def init(self, rng, *example_inputs, **kwargs):
+        raise NotImplementedError
+
+    def apply(self, params, *args, train: bool = False, rngs=None, **kwargs):
+        raise NotImplementedError
+
+    # Optional: logical sharding rules {param-path-regex: PartitionSpec-template}
+    # consumed by parallel/sharding.py. Default: automatic rules by shape.
+    def sharding_rules(self):
+        return None
+
+
+@dataclasses.dataclass
+class FunctionalModel(Module):
+    """Adapter for a bare (init_fn, apply_fn) pair."""
+
+    init_fn: Callable
+    apply_fn: Callable
+
+    def init(self, rng, *example_inputs, **kwargs):
+        return self.init_fn(rng, *example_inputs, **kwargs)
+
+    def apply(self, params, *args, train: bool = False, rngs=None, **kwargs):
+        return self.apply_fn(params, *args, **kwargs)
+
+
+class FlaxLinenAdapter(Module):
+    """Adapter for ``flax.linen.Module`` instances.
+
+    Forwards ``train`` as the conventional ``deterministic``/``train`` kwarg only
+    when the module accepts it, and threads dropout rngs.
+    """
+
+    def __init__(self, linen_module):
+        self.linen_module = linen_module
+
+    def init(self, rng, *example_inputs, **kwargs):
+        return self.linen_module.init(rng, *example_inputs, **kwargs)
+
+    def apply(self, params, *args, train: bool = False, rngs=None, **kwargs):
+        call_kwargs = dict(kwargs)
+        if rngs is not None:
+            call_kwargs["rngs"] = rngs
+        try:
+            return self.linen_module.apply(params, *args, **call_kwargs)
+        except TypeError:
+            call_kwargs.pop("rngs", None)
+            return self.linen_module.apply(params, *args, **call_kwargs)
+
+
+def as_module(model) -> Module:
+    """Coerce any supported model object to the Module protocol."""
+    if isinstance(model, Module):
+        return model
+    try:
+        import flax.linen as nn
+
+        if isinstance(model, nn.Module):
+            return FlaxLinenAdapter(model)
+    except ImportError:
+        pass
+    if callable(getattr(model, "init", None)) and callable(getattr(model, "apply", None)):
+        return FunctionalModel(model.init, model.apply)
+    raise TypeError(
+        f"Cannot prepare model of type {type(model)}: expected an accelerate_tpu.Module, "
+        "a flax.linen.Module, or an object with init/apply."
+    )
+
+
+def default_loss_extractor(outputs, batch):
+    """Pull the scalar loss out of a forward result (HF convention)."""
+    if isinstance(outputs, Mapping) and "loss" in outputs:
+        return outputs["loss"]
+    if hasattr(outputs, "loss"):
+        return outputs.loss
+    if isinstance(outputs, jax.Array) and outputs.ndim == 0:
+        return outputs
+    raise ValueError(
+        "Could not extract a loss from the model outputs. Either return an output "
+        "with a `loss` field (pass labels in the batch), or register a custom loss "
+        "with `accelerator.set_loss_fn(lambda outputs, batch: ...)`."
+    )

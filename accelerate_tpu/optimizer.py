@@ -1,0 +1,253 @@
+"""AcceleratedOptimizer — imperative facade over an optax transform.
+
+Reference parity: ``src/accelerate/optimizer.py:57`` wraps a torch optimizer to
+(1) skip stepping while gradients accumulate, (2) integrate the GradScaler for
+fp16, (3) all-reduce XLA gradients before stepping (:149-155). Here:
+
+- gradients arrive already globally correct: the compiled forward/backward runs
+  under GSPMD, which inserts the cross-device reduction the reference does by hand
+  with ``xm.all_reduce`` — so (3) disappears by construction;
+- (1) is the same bookkeeping against ``GradientState``;
+- (2) is a dynamic loss-scaler maintained as device-side state inside the jitted
+  update (overflow check + conditional skip via ``lax.cond`` — no host sync).
+
+The wrapped object is an ``optax.GradientTransformation``; parameters live in the
+shared ``TrainHandle`` (see ``accelerator.py``) that the prepared model also
+points at, so ``optimizer.step()`` visibly updates what ``model(...)`` uses next —
+preserving the reference's mutable-object feel over pure-functional cores.
+"""
+
+from __future__ import annotations
+
+import logging
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .state import AcceleratorState, GradientState
+
+logger = logging.getLogger(__name__)
+
+
+def _tree_zeros_like(tree):
+    return jax.tree_util.tree_map(jnp.zeros_like, tree)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _accumulate_grads(accum, new, scale):
+    return jax.tree_util.tree_map(lambda a, g: a + g * scale, accum, new)
+
+
+@jax.jit
+def _scale_grads(grads, scale):
+    return jax.tree_util.tree_map(lambda g: g * scale, grads)
+
+
+def _global_norm(grads):
+    return jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in jax.tree_util.tree_leaves(grads)))
+
+
+class GradScalerState:
+    """Dynamic loss-scaler (fp16) state, mirroring torch GradScaler semantics the
+    reference relies on (``optimizer.py:162-177``): on non-finite grads the step is
+    skipped and the scale halves; after ``growth_interval`` good steps it doubles."""
+
+    def __init__(self, init_scale=2.0**15, growth_factor=2.0, backoff_factor=0.5, growth_interval=2000):
+        self.scale = float(init_scale)
+        self.growth_factor = growth_factor
+        self.backoff_factor = backoff_factor
+        self.growth_interval = growth_interval
+        self._good_steps = 0
+
+    def update(self, found_inf: bool):
+        if found_inf:
+            self.scale *= self.backoff_factor
+            self._good_steps = 0
+        else:
+            self._good_steps += 1
+            if self._good_steps >= self.growth_interval:
+                self.scale *= self.growth_factor
+                self._good_steps = 0
+
+
+class AcceleratedOptimizer:
+    """Wraps ``optax.GradientTransformation``. Constructed by ``Accelerator.prepare``."""
+
+    def __init__(self, tx, handle=None, scaler: GradScalerState | None = None):
+        import optax
+
+        if not isinstance(tx, optax.GradientTransformation):
+            raise TypeError(f"expected an optax.GradientTransformation, got {type(tx)}")
+        self.tx = tx
+        self.handle = handle  # TrainHandle: .params, .param_shardings, .mesh
+        self.scaler = scaler
+        self.gradient_state = GradientState()
+        self.accelerator_state = AcceleratorState()
+        self.opt_state = None
+        self._accum_grads = None
+        self._pending_clip_norm = None
+        self._step_was_skipped = False
+        self._update_fn = None
+        self._step_count = 0  # optimizer steps actually applied
+
+    # ------------------------------------------------------------------ setup
+    def _ensure_initialized(self):
+        if self.opt_state is None:
+            params = self.handle.params
+            # Opt-state leaves that mirror a param shape inherit that param's
+            # sharding (ZeRO-style sharded optimizer state under fsdp); scalars and
+            # the rest replicate. This is the GSPMD answer to DeepSpeed's
+            # partitioned optimizer (SURVEY.md §2.4 ZeRO row).
+            shape_to_sharding = {}
+            for p, s in zip(
+                jax.tree_util.tree_leaves(params),
+                jax.tree_util.tree_leaves(self.handle.param_shardings),
+            ):
+                shape_to_sharding.setdefault(np.shape(p), s)
+
+            opt_shapes = jax.eval_shape(self.tx.init, params)
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            replicated = NamedSharding(self.handle.mesh, P())
+            opt_shardings = jax.tree_util.tree_map(
+                lambda l: shape_to_sharding.get(tuple(l.shape), replicated), opt_shapes
+            )
+            self.opt_state = jax.jit(self.tx.init, out_shardings=opt_shardings)(params)
+            self.opt_shardings = opt_shardings
+
+    def _build_update_fn(self):
+        import optax
+
+        tx = self.tx
+
+        @partial(jax.jit, donate_argnums=(0, 1, 2))
+        def _update(params, opt_state, grads, max_clip_norm, inv_scale):
+            grads = jax.tree_util.tree_map(lambda g: g * inv_scale, grads)
+            gnorm = _global_norm(grads)
+            # clip_grad_norm_ semantics (reference accelerator.py:2630): scale down
+            # when over the limit; max_clip_norm<=0 disables.
+            clip_factor = jnp.where(
+                (max_clip_norm > 0) & (gnorm > max_clip_norm),
+                max_clip_norm / (gnorm + 1e-6),
+                1.0,
+            )
+            grads = jax.tree_util.tree_map(lambda g: g * clip_factor, grads)
+            finite = jnp.isfinite(gnorm)
+
+            def do_step(_):
+                updates, new_opt = tx.update(grads, opt_state, params)
+                return optax.apply_updates(params, updates), new_opt
+
+            def skip(_):
+                return params, opt_state
+
+            new_params, new_opt = jax.lax.cond(finite, do_step, skip, None)
+            return new_params, new_opt, gnorm, finite
+
+        return _update
+
+    # -------------------------------------------------------------- grad flow
+    def _accumulate(self, grads, scale: float = 1.0):
+        """Add freshly computed grads (already globally reduced by GSPMD) into the
+        accumulation buffer — the explicit-pytree version of torch's ``.grad +=``."""
+        self._ensure_initialized()
+        if self._accum_grads is None:
+            self._accum_grads = _scale_grads(grads, jnp.float32(scale)) if scale != 1.0 else grads
+        else:
+            self._accum_grads = _accumulate_grads(self._accum_grads, grads, jnp.float32(scale))
+
+    @property
+    def grads(self):
+        return self._accum_grads
+
+    # --------------------------------------------------------------- stepping
+    def step(self, closure=None):
+        if closure is not None:
+            raise NotImplementedError("closures are not supported")
+        if not self.gradient_state.sync_gradients:
+            return  # accumulating: reference optimizer.py:162 skips the real step
+        if self._accum_grads is None:
+            logger.warning("optimizer.step() called with no accumulated gradients; skipping")
+            return
+        self._ensure_initialized()
+        if self._update_fn is None:
+            self._update_fn = self._build_update_fn()
+        inv_scale = 1.0 / self.scaler.scale if self.scaler is not None else 1.0
+        clip = self._pending_clip_norm if self._pending_clip_norm is not None else -1.0
+        new_params, new_opt, gnorm, finite = self._update_fn(
+            self.handle.params, self.opt_state, self._accum_grads, jnp.float32(clip), jnp.float32(inv_scale)
+        )
+        self.handle.params = new_params
+        self.opt_state = new_opt
+        self._accum_grads = None
+        self._pending_clip_norm = None
+        self.handle.last_grad_norm = gnorm
+        if self.scaler is not None:
+            found_inf = not bool(finite)  # one scalar host sync per real step
+            self._step_was_skipped = found_inf
+            self.scaler.update(found_inf)
+        else:
+            self._step_was_skipped = False
+        if not self._step_was_skipped:
+            self._step_count += 1
+
+    @property
+    def step_was_skipped(self) -> bool:
+        """Whether the last ``step()`` was skipped on overflow (reference :186-189)."""
+        return self._step_was_skipped
+
+    def zero_grad(self, set_to_none: bool = True):
+        """Drop accumulated grads — a no-op while accumulating (reference :114-122)."""
+        if self.gradient_state.sync_gradients:
+            self._accum_grads = None
+
+    # ------------------------------------------------------------- inspection
+    @property
+    def param_groups(self):
+        """Torch-flavored introspection: one group with current lr if discoverable."""
+        lr = self.learning_rate
+        return [{"params": jax.tree_util.tree_leaves(self.handle.params), "lr": lr}]
+
+    @property
+    def learning_rate(self):
+        state = self.opt_state
+        if state is None:
+            return None
+        hp = getattr(state, "hyperparams", None)
+        if isinstance(state, tuple):
+            for s in state:
+                hp = getattr(s, "hyperparams", None) or hp
+        if hp and "learning_rate" in hp:
+            return float(np.asarray(hp["learning_rate"]))
+        return None
+
+    def set_learning_rate(self, lr: float):
+        """Write through to ``optax.inject_hyperparams`` state if present."""
+        state = self.opt_state
+        if state is None:
+            return False
+
+        def visit(s):
+            hp = getattr(s, "hyperparams", None)
+            if hp is not None and "learning_rate" in hp:
+                hp["learning_rate"] = jnp.asarray(lr, dtype=jnp.asarray(hp["learning_rate"]).dtype)
+                return True
+            return False
+
+        if visit(state):
+            return True
+        if isinstance(state, tuple):
+            return any(visit(s) for s in state)
+        return False
+
+    def state_dict(self):
+        return {"opt_state": self.opt_state, "step_count": self._step_count,
+                "scale": self.scaler.scale if self.scaler else None}
+
+    def load_state_dict(self, state_dict):
+        self.opt_state = state_dict["opt_state"]
+        self._step_count = state_dict.get("step_count", 0)
+        if self.scaler is not None and state_dict.get("scale") is not None:
+            self.scaler.scale = state_dict["scale"]

@@ -1,0 +1,73 @@
+"""AcceleratedScheduler — LR schedule bookkeeping tied to real optimizer steps.
+
+Reference parity: ``src/accelerate/scheduler.py:25`` steps the torch scheduler only
+when every wrapped optimizer actually stepped (grad-accumulation skips, fp16
+overflow skips), and advances by ``num_processes`` when batches aren't split so a
+schedule authored for single-process step counts lands at the same lr-vs-samples
+curve (:60-81).
+
+Here the schedule is an optax-style ``Callable[[int], float]``. If the optimizer
+was built with ``optax.inject_hyperparams`` the new lr is written through into the
+optimizer's device state; otherwise the wrapper only tracks the count (useful when
+the schedule is already baked into the transform via ``scale_by_schedule`` — then
+``step()`` is pure bookkeeping and ``get_last_lr`` still reports the curve).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class AcceleratedScheduler:
+    def __init__(self, schedule, optimizers, step_with_optimizer: bool = True, split_batches: bool = False):
+        if not callable(schedule):
+            raise TypeError(f"expected a schedule callable (int -> float), got {type(schedule)}")
+        self.schedule = schedule
+        self.optimizers = optimizers if isinstance(optimizers, (list, tuple)) else [optimizers]
+        self.step_with_optimizer = step_with_optimizer
+        self.split_batches = split_batches
+        self.step_count = 0
+        self._last_lr = float(np.asarray(schedule(0)))
+        from .state import AcceleratorState, GradientState
+
+        self.gradient_state = GradientState()
+        self.accelerator_state = AcceleratorState() if AcceleratorState._shared_state else None
+
+    def step(self, *args, **kwargs):
+        if not self.step_with_optimizer:
+            self._advance(1)
+            return
+        # Accumulation: only count on sync boundaries (reference :63-69).
+        if not self.gradient_state.sync_gradients:
+            return
+        # Skip if any optimizer skipped (fp16 overflow; reference :73-81).
+        if any(opt.step_was_skipped for opt in self.optimizers):
+            return
+        if self.split_batches:
+            increment = 1
+        else:
+            # One global step consumes data-parallel-degree process-batches; a
+            # schedule authored in per-process steps advances that much (reference
+            # multiplies by num_processes for the same reason).
+            increment = (
+                self.accelerator_state.global_batch_divisor if self.accelerator_state is not None else 1
+            )
+        self._advance(increment)
+
+    def _advance(self, increment: int):
+        self.step_count += increment
+        self._last_lr = float(np.asarray(self.schedule(self.step_count)))
+        for opt in self.optimizers:
+            opt.set_learning_rate(self._last_lr)
+
+    def get_last_lr(self):
+        return [self._last_lr]
+
+    def state_dict(self):
+        return {"step_count": self.step_count, "last_lr": self._last_lr}
+
+    def load_state_dict(self, state_dict):
+        self.step_count = state_dict["step_count"]
+        self._last_lr = state_dict["last_lr"]
+        for opt in self.optimizers:
+            opt.set_learning_rate(self._last_lr)

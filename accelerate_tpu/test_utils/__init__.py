@@ -1,0 +1,1 @@
+from .training import RegressionDataset, RegressionModel, regression_batches

@@ -1,0 +1,63 @@
+"""Tiny training fixtures.
+
+Reference parity: ``src/accelerate/test_utils/training.py:162`` —
+``RegressionDataset``/``RegressionModel`` fit ``y = a*x + b`` so correctness is
+checkable as exact parameter values with no accelerator-hours.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..modules import ModelOutput, Module
+
+
+class RegressionDataset:
+    """Map-style dataset of (x, y) pairs with y = a*x + b + noise."""
+
+    def __init__(self, a: float = 2.0, b: float = 3.0, length: int = 64, seed: int = 42):
+        rng = np.random.default_rng(seed)
+        self.length = length
+        self.x = rng.normal(size=(length,)).astype(np.float32)
+        self.y = (a * self.x + b + 0.01 * rng.normal(size=(length,))).astype(np.float32)
+
+    def __len__(self):
+        return self.length
+
+    def __getitem__(self, i):
+        return {"x": self.x[i], "y": self.y[i]}
+
+
+class RegressionModel(Module):
+    """y_hat = a*x + b; returns MSE loss when labels present (HF convention)."""
+
+    def __init__(self, a: float = 0.0, b: float = 0.0):
+        self.a0 = a
+        self.b0 = b
+        self.params = None
+
+    def init(self, rng, *example_inputs, **kwargs):
+        return {"a": jnp.asarray(self.a0, jnp.float32), "b": jnp.asarray(self.b0, jnp.float32)}
+
+    def init_params(self, rng=None):
+        self.params = self.init(rng)
+        return self.params
+
+    def apply(self, params, x=None, y=None, train: bool = False, rngs=None, **kwargs):
+        pred = params["a"] * x + params["b"]
+        out = ModelOutput(prediction=pred)
+        if y is not None:
+            out["loss"] = jnp.mean((pred - y) ** 2)
+        return out
+
+
+def regression_batches(dataset: RegressionDataset, batch_size: int, drop_last: bool = True):
+    """Plain-python iterable of numpy batches (a non-torch dataloader)."""
+    batches = []
+    n = len(dataset) - (len(dataset) % batch_size if drop_last else 0)
+    for start in range(0, n, batch_size):
+        idx = slice(start, min(start + batch_size, len(dataset)))
+        batches.append({"x": dataset.x[idx], "y": dataset.y[idx]})
+    return batches

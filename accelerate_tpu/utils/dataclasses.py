@@ -192,6 +192,27 @@ class ProfileKwargs(KwargsHandler):
 
 
 @dataclass
+class ProjectConfiguration(KwargsHandler):
+    """Checkpoint/logging folder layout (reference ``dataclasses.py:862-922``)."""
+
+    project_dir: str = None
+    logging_dir: str = None
+    automatic_checkpoint_naming: bool = False
+    total_limit: int = None
+    iteration: int = 0
+    save_on_each_node: bool = False  # parity slot: ckpt I/O is per-process-sharded here
+
+    def set_directories(self, project_dir: str = None):
+        self.project_dir = project_dir
+        if self.logging_dir is None:
+            self.logging_dir = project_dir
+
+    def __post_init__(self):
+        if self.logging_dir is None:
+            self.logging_dir = self.project_dir
+
+
+@dataclass
 class DataLoaderConfiguration(KwargsHandler):
     """Reference ``dataclasses.py:791-860``."""
 

@@ -1,0 +1,68 @@
+"""Seeding & cross-process RNG synchronization.
+
+Reference parity: ``src/accelerate/utils/random.py`` — ``set_seed`` (:39-76) and
+``synchronize_rng_state(s)`` (:78-156), which broadcast rank-0's RNG state so all
+ranks shuffle identically at each epoch (used by ``DataLoaderShard.__iter__``,
+``data_loader.py:558-559``).
+
+JAX's explicit PRNG keys make most of this trivial (SURVEY.md §2.7 rng row): a key
+is data, deterministic everywhere by construction — so "synchronizing" the JAX
+stream means agreeing on a seed once. What still needs real synchronization is
+host-side numpy/python RNG used by samplers and user code on a pod.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Iterable
+
+import numpy as np
+
+import jax
+
+from .dataclasses import RNGType
+
+
+def set_seed(seed: int, device_specific: bool = False, deterministic: bool = False):
+    """Seed python/numpy and return a fresh JAX key (reference :39-76).
+
+    ``device_specific`` offsets by process index so each host draws different data
+    noise while model init stays controlled by explicit keys.
+    """
+    if device_specific:
+        seed += jax.process_index()
+    random.seed(seed)
+    np.random.seed(seed % (2**32))
+    return jax.random.key(seed)
+
+
+def synchronize_rng_state(rng_type: RNGType | str | None = None, generator=None):
+    """Broadcast process-0's RNG state for one stream (reference :78-130)."""
+    from .operations import broadcast_object_list
+
+    rng_type = RNGType(rng_type) if rng_type is not None else None
+    if rng_type == RNGType.PYTHON:
+        state = [random.getstate()]
+        broadcast_object_list(state, from_process=0)
+        random.setstate(state[0])
+    elif rng_type == RNGType.NUMPY:
+        state = [np.random.get_state()]
+        broadcast_object_list(state, from_process=0)
+        np.random.set_state(state[0])
+    elif rng_type == RNGType.JAX:
+        # JAX keys are pure data: nothing process-local to synchronize. Kept for
+        # API parity; generators below cover the stateful host streams.
+        pass
+    elif rng_type == RNGType.GENERATOR:
+        if generator is None:
+            return
+        state = [generator.bit_generator.state if isinstance(generator, np.random.Generator) else None]
+        broadcast_object_list(state, from_process=0)
+        if state[0] is not None and isinstance(generator, np.random.Generator):
+            generator.bit_generator.state = state[0]
+
+
+def synchronize_rng_states(rng_types: Iterable[str], generator=None):
+    """Reference :132-156."""
+    for rng_type in rng_types:
+        synchronize_rng_state(rng_type, generator=generator)

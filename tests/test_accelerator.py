@@ -1,0 +1,181 @@
+"""End-to-end Accelerator slice tests on the 8-device CPU mesh.
+
+Mirrors the reference's training-parity strategy (``test_utils/scripts/test_script.py``
+:58-75 asserts training equivalence at tight tolerance with the Regression fixtures).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from accelerate_tpu import Accelerator, GradientAccumulationPlugin
+from accelerate_tpu.test_utils import RegressionDataset, RegressionModel, regression_batches
+
+
+def make_setup(lr=0.1, **accel_kwargs):
+    accelerator = Accelerator(**accel_kwargs)
+    model = RegressionModel()
+    model.init_params(jax.random.key(0))
+    tx = optax.sgd(lr)
+    ds = RegressionDataset(length=64)
+    dl = regression_batches(ds, batch_size=16)
+    return accelerator, model, tx, dl
+
+
+def test_prepare_classification_and_types():
+    accelerator, model, tx, dl = make_setup()
+    sched = optax.constant_schedule(0.1)
+    pmodel, popt, pdl, psched = accelerator.prepare(model, tx, dl, sched)
+    from accelerate_tpu.accelerator import PreparedModel
+    from accelerate_tpu.data_loader import DataLoaderShard
+    from accelerate_tpu.optimizer import AcceleratedOptimizer
+    from accelerate_tpu.scheduler import AcceleratedScheduler
+
+    assert isinstance(pmodel, PreparedModel)
+    assert isinstance(popt, AcceleratedOptimizer)
+    assert isinstance(pdl, DataLoaderShard)
+    assert isinstance(psched, AcceleratedScheduler)
+
+
+def test_imperative_training_converges():
+    accelerator, model, tx, dl = make_setup(lr=0.2)
+    pmodel, popt, pdl = accelerator.prepare(model, tx, dl)
+    for _epoch in range(40):
+        for batch in pdl:
+            with accelerator.accumulate(pmodel):
+                outputs = pmodel(**batch)
+                accelerator.backward(outputs.loss)
+                popt.step()
+                popt.zero_grad()
+    params = accelerator.get_state_dict(pmodel)
+    assert abs(float(params["a"]) - 2.0) < 0.1
+    assert abs(float(params["b"]) - 3.0) < 0.1
+
+
+def test_forward_returns_global_sharded_outputs():
+    accelerator, model, tx, dl = make_setup()
+    pmodel, popt, pdl = accelerator.prepare(model, tx, dl)
+    batch = next(iter(pdl))
+    assert isinstance(batch["x"], jax.Array)
+    out = pmodel(**batch)
+    assert out.prediction.shape == (16,)
+    assert float(out.loss) > 0
+
+
+def test_gradient_accumulation_matches_large_batch():
+    # grads(2 microbatches of 8, accum=2) == grads(1 batch of 16) — the semantic
+    # the reference asserts in test_sync.py.
+    ds = RegressionDataset(length=16)
+    big = regression_batches(ds, batch_size=16)[0]
+    micro = regression_batches(ds, batch_size=8)
+
+    def run(batches, accum_steps):
+        from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+        AcceleratorState._reset_state(reset_partial_state=True)
+        GradientState._reset_state()
+        accelerator = Accelerator(gradient_accumulation_steps=accum_steps)
+        model = RegressionModel(a=0.5, b=0.5)
+        model.init_params(None)
+        pmodel, popt = accelerator.prepare(model, optax.sgd(0.5))
+        for batch in batches:
+            with accelerator.accumulate(pmodel):
+                out = pmodel(**batch)
+                accelerator.backward(out.loss)
+                popt.step()
+                popt.zero_grad()
+        return accelerator.get_state_dict(pmodel)
+
+    p_big = run([big], 1)
+    p_micro = run(micro, 2)
+    assert np.allclose(p_big["a"], p_micro["a"], atol=1e-6)
+    assert np.allclose(p_big["b"], p_micro["b"], atol=1e-6)
+
+
+def test_optimizer_noop_while_accumulating():
+    accelerator, model, tx, dl = make_setup(gradient_accumulation_steps=4)
+    pmodel, popt, pdl = make_prepared = accelerator.prepare(model, tx, dl)
+    batch = next(iter(pdl))
+    before = accelerator.get_state_dict(pmodel)
+    with accelerator.accumulate(pmodel):
+        out = pmodel(**batch)
+        accelerator.backward(out.loss)
+        assert not accelerator.sync_gradients
+        popt.step()  # must be a no-op
+        popt.zero_grad()
+    after = accelerator.get_state_dict(pmodel)
+    assert np.allclose(before["a"], after["a"])
+
+
+def test_fused_train_step_converges_and_matches_imperative():
+    accelerator, model, tx, dl = make_setup(lr=0.2)
+    pmodel, popt, pdl = accelerator.prepare(model, tx, dl)
+    step = accelerator.build_train_step(pmodel, popt)
+    losses = []
+    for _epoch in range(40):
+        for batch in pdl:
+            losses.append(float(step(batch)))
+    params = accelerator.get_state_dict(pmodel)
+    assert abs(float(params["a"]) - 2.0) < 0.1
+    assert abs(float(params["b"]) - 3.0) < 0.1
+    assert losses[-1] < losses[0]
+
+
+def test_scheduler_steps_with_optimizer():
+    accelerator, model, tx_unused, dl = make_setup(gradient_accumulation_steps=2)
+    schedule = optax.linear_schedule(0.1, 0.0, 100)
+    tx = optax.inject_hyperparams(optax.sgd)(learning_rate=0.1)
+    pmodel, popt, pdl, psched = accelerator.prepare(model, tx, dl, schedule)
+    it = iter(pdl)
+    b1, b2 = next(it), next(it)
+    for batch in (b1, b2):
+        with accelerator.accumulate(pmodel):
+            out = pmodel(**batch)
+            accelerator.backward(out.loss)
+            popt.step()
+            psched.step()
+            popt.zero_grad()
+    # Two microbatches = one real step; scheduler advanced once (by dp degree 8).
+    assert psched.step_count == 8
+    assert popt.learning_rate is not None
+
+
+def test_clip_grad_norm():
+    accelerator, model, tx, dl = make_setup()
+    pmodel, popt, pdl = accelerator.prepare(model, tx, dl)
+    batch = next(iter(pdl))
+    with accelerator.accumulate(pmodel):
+        out = pmodel(**batch)
+        accelerator.backward(out.loss)
+        norm = accelerator.clip_grad_norm_(max_norm=1e-8)
+        assert float(norm) > 0
+        popt.step()
+        popt.zero_grad()
+    # With a tiny max_norm the update must be microscopic.
+    params = accelerator.get_state_dict(pmodel)
+    assert abs(float(params["a"])) < 1e-6
+
+
+def test_gather_for_metrics_trims_remainder():
+    accelerator = Accelerator()
+    ds = RegressionDataset(length=20)  # 20 = 16 + tail of 4
+    dl = regression_batches(ds, batch_size=16, drop_last=False)
+    pdl = accelerator.prepare(dl)
+    seen = []
+    for batch in pdl:
+        preds = batch["x"]  # stand-in for model outputs
+        seen.append(np.asarray(accelerator.gather_for_metrics(preds)))
+    total = np.concatenate(seen)
+    assert total.shape[0] == 20  # padding dropped
+    assert np.allclose(total, ds.x)
+
+
+def test_set_trigger_roundtrip():
+    accelerator = Accelerator()
+    assert not accelerator.check_trigger()
+    accelerator.set_trigger()
+    assert accelerator.check_trigger()
+    assert not accelerator.check_trigger()

@@ -1,0 +1,217 @@
+"""Data-layer semantics tests — pure index logic with explicit num_processes/
+process_index, no distributed runtime needed (the reference's approach in
+``tests/test_data_loader.py``, 897 LoC)."""
+
+import numpy as np
+import pytest
+
+from accelerate_tpu.data_loader import (
+    BatchSamplerShard,
+    DataLoaderShard,
+    IterableDatasetShard,
+    SeedableRandomSampler,
+    SkipBatchSampler,
+    SkipDataLoader,
+    prepare_data_loader,
+    skip_first_batches,
+)
+
+
+class SimpleBatchSampler:
+    """Yields index batches like torch.utils.data.BatchSampler."""
+
+    def __init__(self, length, batch_size, drop_last=False):
+        self.length = length
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+
+    def __iter__(self):
+        batch = []
+        for i in range(self.length):
+            batch.append(i)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        import math
+
+        return (self.length // self.batch_size) if self.drop_last else math.ceil(self.length / self.batch_size)
+
+
+def shards(length, batch_size, n, split_batches=False, even_batches=True, drop_last=False):
+    return [
+        list(
+            BatchSamplerShard(
+                SimpleBatchSampler(length, batch_size, drop_last),
+                num_processes=n,
+                process_index=i,
+                split_batches=split_batches,
+                even_batches=even_batches,
+            )
+        )
+        for i in range(n)
+    ]
+
+
+def test_batch_sampler_shard_even_division():
+    # 24 samples, batch 4, 2 procs, stride mode: proc0 gets batches 0,2,4; proc1 1,3,5
+    result = shards(24, 4, 2)
+    assert result[0] == [[0, 1, 2, 3], [8, 9, 10, 11], [16, 17, 18, 19]]
+    assert result[1] == [[4, 5, 6, 7], [12, 13, 14, 15], [20, 21, 22, 23]]
+
+
+def test_batch_sampler_shard_wraparound_even_batches():
+    # 20 samples, batch 4, 2 procs: 5 batches; the dangling 5th batch group is
+    # completed by wrapping to the epoch's first batches.
+    result = shards(20, 4, 2)
+    assert len(result[0]) == len(result[1]) == 3
+    assert result[0][-1] == [16, 17, 18, 19]
+    assert result[1][-1] == [0, 1, 2, 3]  # wrapped around
+
+
+def test_batch_sampler_shard_partial_final_batch_filled():
+    # 18 samples, batch 4, 2 procs: batches [0-3],[4-7],[8-11],[12-15],[16,17]
+    # proc0 gets the short final batch → filled from first batch's samples.
+    result = shards(18, 4, 2)
+    assert result[0][-1] == [16, 17, 0, 1]
+    assert result[1][-1] == [0, 1, 2, 3]
+
+
+def test_batch_sampler_shard_uneven_no_even_batches():
+    result = shards(20, 4, 2, even_batches=False)
+    assert len(result[0]) == 3  # got the dangling batch
+    assert len(result[1]) == 2
+    assert result[0][-1] == [16, 17, 18, 19]
+
+
+def test_batch_sampler_shard_split_mode():
+    # split_batches: each global batch of 4 is sliced into 2 halves.
+    result = shards(16, 4, 2, split_batches=True)
+    assert result[0] == [[0, 1], [4, 5], [8, 9], [12, 13]]
+    assert result[1] == [[2, 3], [6, 7], [10, 11], [14, 15]]
+
+
+def test_batch_sampler_shard_split_mode_partial_tail():
+    # 18 samples: final global batch [16,17] is completed from first samples then split.
+    result = shards(18, 4, 2, split_batches=True)
+    assert result[0][-1] == [16, 17]
+    assert result[1][-1] == [0, 1]
+
+
+def test_batch_sampler_shard_split_requires_divisible():
+    with pytest.raises(ValueError, match="divisible"):
+        BatchSamplerShard(SimpleBatchSampler(16, 3), num_processes=2, split_batches=True)
+
+
+def test_batch_sampler_shard_lengths():
+    sampler = SimpleBatchSampler(20, 4)
+    for n in (1, 2, 3):
+        for i in range(n):
+            s = BatchSamplerShard(sampler, num_processes=n, process_index=i)
+            assert len(list(s)) == len(s), (n, i)
+
+
+def test_iterable_dataset_shard():
+    data = list(range(22))
+    out = [
+        list(IterableDatasetShard(data, batch_size=4, num_processes=2, process_index=i))
+        for i in range(2)
+    ]
+    # chunks of 8: [0-7] -> p0 [0-3] p1 [4-7]; [8-15]; [16-21]+pad[0,1] from head
+    assert out[0][:8] == [0, 1, 2, 3, 8, 9, 10, 11]
+    assert out[1][:8] == [4, 5, 6, 7, 12, 13, 14, 15]
+    assert out[0][8:] == [16, 17, 18, 19]
+    assert out[1][8:] == [20, 21, 0, 1]  # padded from stream head
+
+
+def test_iterable_dataset_shard_drop_last():
+    data = list(range(22))
+    out = list(IterableDatasetShard(data, batch_size=4, drop_last=True, num_processes=2, process_index=0))
+    assert out == [0, 1, 2, 3, 8, 9, 10, 11]
+
+
+def test_seedable_random_sampler_deterministic():
+    s1 = SeedableRandomSampler(list(range(10)), seed=7)
+    s2 = SeedableRandomSampler(list(range(10)), seed=7)
+    assert list(iter(s1)) == list(iter(s2))
+    # epoch advanced internally → next epoch differs
+    assert list(iter(s1)) != list(iter(s2.__class__(list(range(10)), seed=7, epoch=0)))
+    s3 = SeedableRandomSampler(list(range(10)), seed=7, epoch=5)
+    assert list(iter(s3)) != list(iter(SeedableRandomSampler(list(range(10)), seed=7)))
+
+
+def test_skip_batch_sampler_and_loader():
+    sampler = SimpleBatchSampler(16, 4)
+    skip = SkipBatchSampler(sampler, skip_batches=2)
+    assert list(skip) == [[8, 9, 10, 11], [12, 13, 14, 15]]
+    loader = SkipDataLoader([1, 2, 3, 4], skip_batches=2)
+    assert list(loader) == [3, 4]
+    assert len(loader) == 2
+
+
+def test_skip_first_batches_on_shard():
+    batches = [{"x": np.full((8,), i, np.float32)} for i in range(4)]
+    dl = DataLoaderShard(batches)
+    skipped = skip_first_batches(dl, 2)
+    out = [float(np.asarray(b["x"])[0]) for b in skipped]
+    assert out == [2.0, 3.0]
+    # original untouched
+    assert len(list(dl)) == 4
+
+
+def test_torch_dataloader_integration():
+    torch = pytest.importorskip("torch")
+    import torch.utils.data as tud
+
+    class DS(tud.Dataset):
+        def __len__(self):
+            return 24
+
+        def __getitem__(self, i):
+            return {"x": np.float32(i), "y": np.float32(2 * i)}
+
+    loader = tud.DataLoader(DS(), batch_size=8, shuffle=False)
+    prepared = prepare_data_loader(loader)
+    batches = list(prepared)
+    assert len(batches) == 3
+    import jax
+
+    assert isinstance(batches[0]["x"], jax.Array)
+    assert np.allclose(np.asarray(batches[0]["x"]), np.arange(8))
+    assert prepared.total_batch_size == 8
+
+
+def test_torch_dataloader_seedable_sampler():
+    torch = pytest.importorskip("torch")
+    import torch.utils.data as tud
+
+    class DS(tud.Dataset):
+        def __len__(self):
+            return 16
+
+        def __getitem__(self, i):
+            return np.float32(i)
+
+    loader = tud.DataLoader(DS(), batch_size=4, shuffle=True)
+    p1 = prepare_data_loader(loader, use_seedable_sampler=True, data_seed=123)
+    p2 = prepare_data_loader(loader, use_seedable_sampler=True, data_seed=123)
+    e1 = [np.asarray(b).tolist() for b in p1]
+    e2 = [np.asarray(b).tolist() for b in p2]
+    assert e1 == e2  # same seed, same epoch → identical shuffle
+
+
+def test_dataloader_shard_end_flags():
+    from accelerate_tpu.state import GradientState
+
+    batches = [{"x": np.ones((8,), np.float32)} for _ in range(3)]
+    dl = DataLoaderShard(batches)
+    gs = GradientState()
+    flags = []
+    for _b in dl:
+        flags.append(gs.end_of_dataloader)
+    assert flags == [False, False, True]
+    # after iteration the loader deregisters
+    assert gs.active_dataloader is None
