@@ -1,0 +1,2 @@
+from .bert import BertConfig, BertForSequenceClassification
+from .llama import Llama, LlamaConfig

@@ -1,0 +1,204 @@
+"""BERT-style encoder + sequence-classification head.
+
+Workload parity with the reference's flagship example (``examples/nlp_example.py``
+— bert-base-cased on GLUE/MRPC, BASELINE.json configs[0]). Architecture follows
+the standard transformer encoder recipe (post-LN, learned positions, GELU MLP,
+pooler over [CLS]) implemented TPU-first: scan over stacked layers, bf16 matmuls
+with fp32 norms/softmax, same sharding-rule scheme as the Llama model.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..modules import ModelOutput, Module
+from ..ops.losses import cross_entropy_loss
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    num_labels: int = 2
+    hidden_dropout_prob: float = 0.1
+    remat: bool = False
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+    @classmethod
+    def tiny(cls, **kw):
+        defaults = dict(
+            vocab_size=512,
+            hidden_size=64,
+            intermediate_size=128,
+            num_hidden_layers=2,
+            num_attention_heads=4,
+            max_position_embeddings=128,
+        )
+        defaults.update(kw)
+        return cls(**defaults)
+
+    @classmethod
+    def base(cls, **kw):
+        return cls(**kw)
+
+
+def layer_norm(x, scale, bias, eps):
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + eps) * scale + bias
+    return out.astype(dtype)
+
+
+class BertForSequenceClassification(Module):
+    def __init__(self, config: BertConfig):
+        self.config = config
+        self.params = None
+
+    def init(self, rng, *example_inputs, **kwargs):
+        cfg = self.config
+        h, inter, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_hidden_layers
+        keys = iter(jax.random.split(rng, 16))
+
+        def dense(shape, scale_dim=None):
+            scale = 0.02
+            return jax.random.normal(next(keys), shape, jnp.float32) * scale
+
+        def ln(shape_last):
+            return {"scale": jnp.ones(shape_last, jnp.float32), "bias": jnp.zeros(shape_last, jnp.float32)}
+
+        params = {
+            "embeddings": {
+                "word": dense((cfg.vocab_size, h)),
+                "position": dense((cfg.max_position_embeddings, h)),
+                "token_type": dense((cfg.type_vocab_size, h)),
+                "norm": ln((h,)),
+            },
+            "layers": {
+                "attn": {
+                    "wq": dense((L, h, h)),
+                    "bq": jnp.zeros((L, h), jnp.float32),
+                    "wk": dense((L, h, h)),
+                    "bk": jnp.zeros((L, h), jnp.float32),
+                    "wv": dense((L, h, h)),
+                    "bv": jnp.zeros((L, h), jnp.float32),
+                    "wo": dense((L, h, h)),
+                    "bo": jnp.zeros((L, h), jnp.float32),
+                },
+                "attn_norm": {"scale": jnp.ones((L, h)), "bias": jnp.zeros((L, h))},
+                "mlp": {
+                    "w_in": dense((L, h, inter)),
+                    "b_in": jnp.zeros((L, inter), jnp.float32),
+                    "w_out": dense((L, inter, h)),
+                    "b_out": jnp.zeros((L, h), jnp.float32),
+                },
+                "mlp_norm": {"scale": jnp.ones((L, h)), "bias": jnp.zeros((L, h))},
+            },
+            "pooler": {"w": dense((h, h)), "b": jnp.zeros((h,), jnp.float32)},
+            "classifier": {"w": dense((h, cfg.num_labels)), "b": jnp.zeros((cfg.num_labels,), jnp.float32)},
+        }
+        return params
+
+    def init_params(self, rng=None):
+        self.params = self.init(rng if rng is not None else jax.random.key(0))
+        return self.params
+
+    def sharding_rules(self):
+        return [
+            (r"embeddings/word", P("tp", "fsdp")),
+            (r"attn/w[qkv]", P(None, "fsdp", "tp")),
+            (r"attn/b[qkv]", P(None, "tp")),
+            (r"attn/wo", P(None, "tp", "fsdp")),
+            (r"mlp/w_in", P(None, "fsdp", "tp")),
+            (r"mlp/b_in", P(None, "tp")),
+            (r"mlp/w_out", P(None, "tp", "fsdp")),
+            (r"norm|pooler|classifier", P()),
+        ]
+
+    def apply(
+        self,
+        params,
+        input_ids=None,
+        attention_mask=None,
+        token_type_ids=None,
+        labels=None,
+        train: bool = False,
+        rngs=None,
+        **kwargs,
+    ):
+        cfg = self.config
+        B, S = input_ids.shape
+        emb = params["embeddings"]
+        compute_dtype = emb["word"].dtype
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        x = (
+            jnp.take(emb["word"], input_ids, axis=0)
+            + emb["position"][None, :S]
+            + jnp.take(emb["token_type"], token_type_ids, axis=0)
+        ).astype(compute_dtype)
+        x = layer_norm(x, emb["norm"]["scale"], emb["norm"]["bias"], cfg.layer_norm_eps)
+
+        if attention_mask is None:
+            attention_mask = jnp.ones((B, S), jnp.int32)
+        bias = jnp.where(attention_mask[:, None, None, :].astype(bool), 0.0, -1e30).astype(jnp.float32)
+
+        nh, hd = cfg.num_attention_heads, cfg.head_dim
+        dropout_rng = (rngs or {}).get("dropout") if train else None
+        drop_rate = cfg.hidden_dropout_prob if train else 0.0
+
+        def maybe_dropout(x, rng):
+            if drop_rate == 0.0 or rng is None:
+                return x
+            keep = jax.random.bernoulli(rng, 1.0 - drop_rate, x.shape)
+            return jnp.where(keep, x / (1.0 - drop_rate), 0.0).astype(x.dtype)
+
+        def block(carry, layer):
+            x, rng = carry
+            if rng is not None:
+                rng, r1, r2 = jax.random.split(rng, 3)
+            else:
+                r1 = r2 = None
+            a = layer["attn"]
+            q = (x @ a["wq"] + a["bq"]).reshape(B, S, nh, hd)
+            k = (x @ a["wk"] + a["bk"]).reshape(B, S, nh, hd)
+            v = (x @ a["wv"] + a["bv"]).reshape(B, S, nh, hd)
+            scale = 1.0 / np.sqrt(hd)
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale + bias
+            probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+            attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, S, nh * hd)
+            attn = maybe_dropout(attn @ a["wo"] + a["bo"], r1)
+            x = layer_norm(x + attn, layer["attn_norm"]["scale"], layer["attn_norm"]["bias"], cfg.layer_norm_eps)
+            m = layer["mlp"]
+            hdn = jax.nn.gelu(x @ m["w_in"] + m["b_in"], approximate=False)
+            hdn = maybe_dropout(hdn @ m["w_out"] + m["b_out"], r2)
+            x = layer_norm(x + hdn, layer["mlp_norm"]["scale"], layer["mlp_norm"]["bias"], cfg.layer_norm_eps)
+            return (x, rng), None
+
+        body = block
+        if cfg.remat:
+            body = jax.checkpoint(block)
+        (x, _), _ = jax.lax.scan(body, (x, dropout_rng), params["layers"])
+
+        pooled = jnp.tanh(x[:, 0] @ params["pooler"]["w"] + params["pooler"]["b"])
+        logits = (pooled @ params["classifier"]["w"] + params["classifier"]["b"]).astype(jnp.float32)
+        out = ModelOutput(logits=logits)
+        if labels is not None:
+            out["loss"] = cross_entropy_loss(logits, labels)
+        return out

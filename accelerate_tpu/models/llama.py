@@ -1,0 +1,277 @@
+"""Llama-family decoder — the flagship model (BASELINE.json fsdp2 target).
+
+Designed TPU-first rather than translated:
+
+- **scan over stacked layers**: all per-layer weights carry a leading ``L`` dim and
+  the block runs under ``jax.lax.scan`` — one compilation of one block instead of
+  ``L`` inlined copies (fast compiles, and the natural substrate for pipeline
+  parallelism later).
+- **MXU-shaped matmuls**: weights stored (in_dim, out_dim) so every projection is
+  a single ``x @ W``; attention uses one fused einsum per score/mix; all compute
+  in bf16 under mixed precision with fp32 softmax/logits.
+- **GQA**: ``n_kv_heads <= n_heads`` with repeated KV — matches Llama-2/3 shapes.
+- **remat**: optional ``jax.checkpoint`` around each scanned block trades FLOPs
+  for HBM (the reference delegates this to torch's activation checkpointing,
+  ``accelerator.py:1698-1712``).
+- **sharding rules**: Megatron-style tp (column-parallel QKV/up, row-parallel
+  O/down), fsdp on the complementary dim, seq axis ``sp`` for long context.
+
+Reference context: the reference trains Llama through FSDP2 wrappers
+(``benchmarks/fsdp2/main.py``), never defining the model itself (it comes from
+transformers). Here the model is part of the framework so the full stack —
+kernels to collectives — is TPU-native.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..modules import ModelOutput, Module
+from ..ops.losses import cross_entropy_loss
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 2048
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    remat: bool = False
+    attention_impl: str = "auto"  # 'auto' | 'dense' | 'flash' | 'ring'
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @classmethod
+    def tiny(cls, **kw):
+        defaults = dict(
+            vocab_size=256,
+            hidden_size=64,
+            intermediate_size=128,
+            num_hidden_layers=2,
+            num_attention_heads=4,
+            num_key_value_heads=2,
+            max_position_embeddings=128,
+        )
+        defaults.update(kw)
+        return cls(**defaults)
+
+    @classmethod
+    def llama2_7b(cls, **kw):
+        return cls(**{**dict(), **kw})
+
+    @classmethod
+    def llama3_8b(cls, **kw):
+        defaults = dict(
+            vocab_size=128256,
+            hidden_size=4096,
+            intermediate_size=14336,
+            num_hidden_layers=32,
+            num_attention_heads=32,
+            num_key_value_heads=8,
+            rope_theta=500000.0,
+            max_position_embeddings=8192,
+        )
+        defaults.update(kw)
+        return cls(**defaults)
+
+
+def rms_norm(x, weight, eps):
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    x = x * jax.lax.rsqrt(jnp.mean(jnp.square(x), axis=-1, keepdims=True) + eps)
+    return (x * weight).astype(dtype)
+
+
+def rope_tables(positions, head_dim, theta):
+    """cos/sin tables for rotary embeddings, fp32. positions: (B, S) int."""
+    inv_freq = 1.0 / (theta ** (np.arange(0, head_dim, 2, dtype=np.float32) / head_dim))
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # (B,S,D/2)
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x, cos, sin):
+    """x: (B, S, H, D). Rotate pairs (even, odd) halves interleaved as
+    [:D/2], [D/2:] (Llama convention)."""
+    d2 = x.shape[-1] // 2
+    x1, x2 = x[..., :d2], x[..., d2:]
+    cos = cos[:, :, None, :]
+    sin = sin[:, :, None, :]
+    out1 = x1 * cos - x2 * sin
+    out2 = x2 * cos + x1 * sin
+    return jnp.concatenate([out1, out2], axis=-1).astype(x.dtype)
+
+
+def _dense_attention(q, k, v, mask_bias):
+    """q: (B,S,H,D) k/v: (B,S,KV,D) already head-repeated. fp32 softmax."""
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    scores = scores + mask_bias
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+class Llama(Module):
+    def __init__(self, config: LlamaConfig):
+        self.config = config
+        self.params = None
+
+    # ------------------------------------------------------------------- init
+    def init(self, rng, *example_inputs, **kwargs):
+        cfg = self.config
+        h, inter = cfg.hidden_size, cfg.intermediate_size
+        hd = cfg.head_dim
+        nh, nkv, L = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.num_hidden_layers
+        keys = jax.random.split(rng, 10)
+
+        def dense(key, shape, scale_dim=None):
+            scale = 1.0 / np.sqrt(scale_dim if scale_dim is not None else shape[0])
+            return (jax.random.normal(key, shape, jnp.float32) * scale).astype(jnp.float32)
+
+        params = {
+            "embed": {"weight": dense(keys[0], (cfg.vocab_size, h), h)},
+            "layers": {
+                "attn": {
+                    "wq": dense(keys[1], (L, h, nh * hd)),
+                    "wk": dense(keys[2], (L, h, nkv * hd)),
+                    "wv": dense(keys[3], (L, h, nkv * hd)),
+                    "wo": dense(keys[4], (L, nh * hd, h)),
+                },
+                "mlp": {
+                    "w_gate": dense(keys[5], (L, h, inter)),
+                    "w_up": dense(keys[6], (L, h, inter)),
+                    "w_down": dense(keys[7], (L, inter, h)),
+                },
+                "input_norm": {"weight": jnp.ones((L, h), jnp.float32)},
+                "post_attn_norm": {"weight": jnp.ones((L, h), jnp.float32)},
+            },
+            "final_norm": {"weight": jnp.ones((h,), jnp.float32)},
+        }
+        if not cfg.tie_word_embeddings:
+            params["lm_head"] = {"weight": dense(keys[8], (h, cfg.vocab_size))}
+        return params
+
+    def init_params(self, rng=None):
+        self.params = self.init(rng if rng is not None else jax.random.key(0))
+        return self.params
+
+    # --------------------------------------------------------------- sharding
+    def sharding_rules(self):
+        """Megatron-style tp + complementary fsdp. Leading scan dim unsharded."""
+        return [
+            (r"embed/weight", P("tp", "fsdp")),
+            (r"attn/w[qkv]", P(None, "fsdp", "tp")),
+            (r"attn/wo", P(None, "tp", "fsdp")),
+            (r"mlp/w_(gate|up)", P(None, "fsdp", "tp")),
+            (r"mlp/w_down", P(None, "tp", "fsdp")),
+            (r"norm", P()),
+            (r"lm_head/weight", P("fsdp", "tp")),
+        ]
+
+    # ---------------------------------------------------------------- forward
+    def apply(
+        self,
+        params,
+        input_ids=None,
+        labels=None,
+        attention_mask=None,
+        positions=None,
+        train: bool = False,
+        rngs=None,
+        **kwargs,
+    ):
+        cfg = self.config
+        B, S = input_ids.shape
+        x = jnp.take(params["embed"]["weight"], input_ids, axis=0)
+        compute_dtype = params["embed"]["weight"].dtype
+        x = x.astype(compute_dtype)
+
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (B, S))
+        cos, sin = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
+
+        # Causal + padding bias, fp32, (B, 1, S, S) broadcast over heads.
+        causal = jnp.tril(jnp.ones((S, S), bool))
+        bias = jnp.where(causal, 0.0, -1e30).astype(jnp.float32)[None, None]
+        if attention_mask is not None:
+            pad = jnp.where(attention_mask[:, None, None, :].astype(bool), 0.0, -1e30)
+            bias = bias + pad.astype(jnp.float32)
+
+        nh, nkv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+
+        def block(x, layer):
+            h = rms_norm(x, layer["input_norm"]["weight"], cfg.rms_norm_eps)
+            q = (h @ layer["attn"]["wq"]).reshape(B, S, nh, hd)
+            k = (h @ layer["attn"]["wk"]).reshape(B, S, nkv, hd)
+            v = (h @ layer["attn"]["wv"]).reshape(B, S, nkv, hd)
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
+            if nkv != nh:
+                rep = nh // nkv
+                k = jnp.repeat(k, rep, axis=2)
+                v = jnp.repeat(v, rep, axis=2)
+            attn_out = _dense_attention(q, k, v, bias).reshape(B, S, nh * hd)
+            x = x + attn_out @ layer["attn"]["wo"]
+            h2 = rms_norm(x, layer["post_attn_norm"]["weight"], cfg.rms_norm_eps)
+            gated = jax.nn.silu(h2 @ layer["mlp"]["w_gate"]) * (h2 @ layer["mlp"]["w_up"])
+            x = x + gated @ layer["mlp"]["w_down"]
+            return x
+
+        body = block
+        if cfg.remat:
+            body = jax.checkpoint(block, policy=jax.checkpoint_policies.nothing_saveable)
+
+        def scan_step(x, layer):
+            return body(x, layer), None
+
+        x, _ = jax.lax.scan(scan_step, x, params["layers"])
+        x = rms_norm(x, params["final_norm"]["weight"], cfg.rms_norm_eps)
+
+        if cfg.tie_word_embeddings:
+            logits = x @ params["embed"]["weight"].T.astype(compute_dtype)
+        else:
+            logits = x @ params["lm_head"]["weight"]
+
+        out = ModelOutput(logits=logits)
+        if labels is not None:
+            # Shift: predict token t+1 from position t; final position has no target.
+            shifted = jnp.concatenate(
+                [labels[:, 1:], jnp.full((B, 1), -100, labels.dtype)], axis=1
+            )
+            if attention_mask is not None:
+                shifted = jnp.where(attention_mask.astype(bool), shifted, -100)
+            out["loss"] = cross_entropy_loss(logits, shifted)
+        return out
+
+    # -------------------------------------------------------------- estimation
+    def num_params(self) -> int:
+        cfg = self.config
+        h, inter, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_hidden_layers
+        attn = h * (cfg.num_attention_heads + 2 * cfg.num_key_value_heads) * cfg.head_dim + cfg.num_attention_heads * cfg.head_dim * h
+        mlp = 3 * h * inter
+        norms = 2 * h
+        total = L * (attn + mlp + norms) + cfg.vocab_size * h + h
+        if not cfg.tie_word_embeddings:
+            total += h * cfg.vocab_size
+        return total
+
+    def flops_per_token(self) -> float:
+        """Approximate forward+backward FLOPs per token (6N + attention)."""
+        cfg = self.config
+        n = self.num_params()
+        attn_extra = 12 * cfg.num_hidden_layers * cfg.hidden_size * cfg.max_position_embeddings
+        return 6 * n + attn_extra

@@ -1,0 +1,1 @@
+from .losses import cross_entropy_loss, mse_loss

@@ -1,0 +1,44 @@
+"""Loss primitives shared by the model zoo.
+
+TPU notes: cross-entropy is computed from logits in fp32 regardless of the compute
+dtype (bf16 logits lose too much precision in the logsumexp), with optional z-loss
+regularization and an ignore index for padded positions — the XLA-fused analog of
+``torch.nn.functional.cross_entropy(ignore_index=-100)`` the reference examples use.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def cross_entropy_loss(
+    logits: jax.Array,
+    labels: jax.Array,
+    ignore_index: int = -100,
+    z_loss: float = 0.0,
+    label_smoothing: float = 0.0,
+):
+    """Mean token cross-entropy over non-ignored positions.
+
+    logits: (..., V) float; labels: (...) int. Ignored positions contribute zero
+    and are excluded from the mean's denominator.
+    """
+    logits = logits.astype(jnp.float32)
+    valid = labels != ignore_index
+    safe_labels = jnp.where(valid, labels, 0)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    label_logits = jnp.take_along_axis(logits, safe_labels[..., None], axis=-1)[..., 0]
+    nll = logz - label_logits
+    if label_smoothing > 0.0:
+        smooth = -jnp.mean(jax.nn.log_softmax(logits, axis=-1), axis=-1)
+        nll = (1.0 - label_smoothing) * nll + label_smoothing * smooth
+    if z_loss > 0.0:
+        nll = nll + z_loss * jnp.square(logz)
+    nll = jnp.where(valid, nll, 0.0)
+    denom = jnp.maximum(jnp.sum(valid), 1)
+    return jnp.sum(nll) / denom
+
+
+def mse_loss(pred: jax.Array, target: jax.Array):
+    return jnp.mean(jnp.square(pred.astype(jnp.float32) - target.astype(jnp.float32)))

@@ -1,0 +1,110 @@
+"""Benchmark: flagship Llama training throughput on the available chip(s).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where value is
+model FLOPs utilization (MFU) of the fused train step and vs_baseline compares to
+the BASELINE.json north-star of 45% MFU (reference fsdp2 target).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+
+def peak_flops_per_chip() -> float:
+    """bf16 peak for the local chip generation (fallback: v5e)."""
+    import jax
+
+    kind = jax.devices()[0].device_kind.lower()
+    table = {
+        "v5 lite": 197e12,  # v5e bf16
+        "v5litepod": 197e12,
+        "v4": 275e12,
+        "v5p": 459e12,
+        "v5": 459e12,
+        "v6 lite": 918e12,  # trillium
+        "v6e": 918e12,
+    }
+    for key, val in table.items():
+        if key in kind:
+            return val
+    return 197e12
+
+
+def main():
+    import jax
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.models import Llama, LlamaConfig
+
+    on_tpu = jax.default_backend() == "tpu"
+    # ~340M-param model that fits one v5e chip with Adam state; smaller on CPU.
+    if on_tpu:
+        cfg = LlamaConfig(
+            vocab_size=32000,
+            hidden_size=1024,
+            intermediate_size=4096,
+            num_hidden_layers=16,
+            num_attention_heads=16,
+            num_key_value_heads=16,
+            max_position_embeddings=1024,
+            remat=True,  # dense-attention activations OOM one chip without remat
+        )
+        batch, seq, steps, warmup = 8, 1024, 20, 3
+    else:
+        cfg = LlamaConfig.tiny()
+        batch, seq, steps, warmup = 8, 128, 5, 2
+
+    accelerator = Accelerator(mixed_precision="bf16")
+    model = Llama(cfg)
+    model.init_params(jax.random.key(0))
+    pmodel, popt = accelerator.prepare(model, optax.adamw(3e-4))
+    step = accelerator.build_train_step(pmodel, popt)
+
+    ids = np.random.default_rng(0).integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+    data = {"input_ids": ids, "labels": ids}
+
+    for _ in range(warmup):
+        loss = step(data)
+    float(loss)  # hard host sync: block_until_ready does not block through axon
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(data)
+    final_loss = float(loss)  # sync end of timed region
+    dt = time.perf_counter() - t0
+
+    steps_per_sec = steps / dt
+    tokens_per_sec = steps_per_sec * batch * seq
+    n_params = model.num_params()
+    # 6N per token fwd+bwd plus attention score/mix FLOPs.
+    attn_flops = 12 * cfg.num_hidden_layers * cfg.hidden_size * seq
+    flops_per_token = 6 * n_params + attn_flops
+    mfu = tokens_per_sec * flops_per_token / (peak_flops_per_chip() * jax.device_count())
+
+    print(
+        json.dumps(
+            {
+                "metric": "llama340m_train_mfu_per_chip",
+                "value": round(float(mfu), 4),
+                "unit": "fraction_of_peak_bf16",
+                "vs_baseline": round(float(mfu) / 0.45, 4),
+                "detail": {
+                    "steps_per_sec": round(steps_per_sec, 3),
+                    "tokens_per_sec": round(tokens_per_sec, 1),
+                    "params": n_params,
+                    "final_loss": round(final_loss, 4),
+                    "backend": jax.default_backend(),
+                    "device": str(jax.devices()[0].device_kind),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
