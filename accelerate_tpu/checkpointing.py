@@ -1,0 +1,300 @@
+"""Checkpoint save/load — sharded arrays, reference folder layout.
+
+Reference parity: ``src/accelerate/checkpointing.py`` (:61-177 save, :179-311 load)
+and the ``Accelerator.save_state/load_state`` drivers (``accelerator.py:3260/3426``)
+with automatic ``checkpoints/checkpoint_<i>`` naming and ``total_limit`` rotation
+(:3301-3323). Same folder layout and file names (``utils/constants.py:20-33``
+there); array payloads differ by design:
+
+- model/optimizer state → **orbax/tensorstore sharded checkpoints**: every process
+  writes exactly its own shards, no host ever gathers the full model (the property
+  FSDP's SHARDED_STATE_DICT buys in ``utils/fsdp_utils.py:101-325``, here for free
+  because params are global sharded arrays);
+- ``save_model`` → consolidated **safetensors** export with ``max_shard_size``
+  file splitting + index json, byte-compatible with the HF ecosystem
+  (reference ``accelerator.py:3117-3227``);
+- RNG state → the JAX key + host numpy/python streams (reference saves
+  torch/cuda/xla RNG, :174).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import random
+import shutil
+from pathlib import Path
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .logging import get_logger
+from .utils.constants import (
+    CHECKPOINT_DIR_PREFIX,
+    MODEL_NAME,
+    OPTIMIZER_NAME,
+    RNG_STATE_NAME,
+    SAFE_WEIGHTS_INDEX_NAME,
+    SAFE_WEIGHTS_NAME,
+    SAMPLER_NAME,
+    SCHEDULER_NAME,
+)
+
+logger = get_logger(__name__)
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.StandardCheckpointer()
+
+
+def _flatten_params(params, prefix=""):
+    """pytree → {'a.b.c': leaf} with dot-joined paths (HF-style keys)."""
+    flat = {}
+    items = jax.tree_util.tree_flatten_with_path(params)[0]
+    from .parallel.sharding import path_str
+
+    for path, leaf in items:
+        flat[path_str(path).replace("/", ".")] = leaf
+    return flat
+
+
+def save_accelerator_state(accelerator, output_dir: str | None = None, safe_serialization: bool = True):
+    """Save everything (reference ``save_accelerator_state`` :61 + driver :3260)."""
+    project = accelerator.project_configuration
+    if output_dir is None:
+        if project.automatic_checkpoint_naming:
+            output_dir = os.path.join(accelerator.project_dir, "checkpoints")
+        else:
+            raise ValueError("output_dir required unless automatic_checkpoint_naming is set")
+    output_dir = os.path.abspath(output_dir)
+    if project.automatic_checkpoint_naming:
+        folders = [
+            f for f in (os.listdir(output_dir) if os.path.isdir(output_dir) else [])
+            if f.startswith(f"{CHECKPOINT_DIR_PREFIX}_")
+        ]
+        if (
+            project.total_limit is not None
+            and len(folders) + 1 > project.total_limit
+            and accelerator.is_main_process
+        ):
+            # Rotation: drop oldest (reference :3301-3323).
+            folders.sort(key=lambda f: int(f.rsplit("_", 1)[-1]))
+            for stale in folders[: len(folders) + 1 - project.total_limit]:
+                shutil.rmtree(os.path.join(output_dir, stale), ignore_errors=True)
+        output_dir = os.path.join(output_dir, f"{CHECKPOINT_DIR_PREFIX}_{project.iteration}")
+        if os.path.isdir(output_dir):
+            raise ValueError(f"Checkpoint directory {output_dir} already exists.")
+    accelerator.wait_for_everyone()
+    if accelerator.is_main_process:
+        os.makedirs(output_dir, exist_ok=True)
+    accelerator.wait_for_everyone()
+
+    ckptr = _checkpointer()
+    # Sharded model params, one dir per model.
+    for i, model in enumerate(accelerator._models):
+        suffix = "" if i == 0 else f"_{i}"
+        ckptr.save(os.path.join(output_dir, f"{MODEL_NAME}{suffix}"), model.handle.params)
+    # Sharded optimizer state.
+    for i, opt in enumerate(accelerator._optimizers):
+        suffix = "" if i == 0 else f"_{i}"
+        if opt.opt_state is not None:
+            ckptr.save(os.path.join(output_dir, f"{OPTIMIZER_NAME}{suffix}"), opt.opt_state)
+        _host_pickle(
+            os.path.join(output_dir, f"{OPTIMIZER_NAME}{suffix}.meta.pkl"),
+            {"step_count": opt._step_count, "scale": opt.scaler.scale if opt.scaler else None},
+            accelerator,
+        )
+    ckptr.wait_until_finished()
+    # Schedulers / samplers / dataloaders / custom objects: host-side pickles.
+    for i, sched in enumerate(accelerator._schedulers):
+        suffix = "" if i == 0 else f"_{i}"
+        _host_pickle(os.path.join(output_dir, f"{SCHEDULER_NAME}{suffix}.bin"), sched.state_dict(), accelerator)
+    for i, dl in enumerate(accelerator._dataloaders):
+        suffix = "" if i == 0 else f"_{i}"
+        if hasattr(dl, "state_dict"):
+            _host_pickle(os.path.join(output_dir, f"{SAMPLER_NAME}{suffix}.bin"), dl.state_dict(), accelerator)
+    for i, obj in enumerate(accelerator._custom_objects):
+        _host_pickle(os.path.join(output_dir, f"custom_checkpoint_{i}.pkl"), obj.state_dict(), accelerator)
+    # RNG streams (reference :146-177).
+    rng_state = {
+        "python": random.getstate(),
+        "numpy": np.random.get_state(),
+        "step": accelerator.step,
+    }
+    for i, model in enumerate(accelerator._models):
+        rng_state[f"model_{i}_key_counter"] = model.handle.step_counter
+    _host_pickle(os.path.join(output_dir, f"{RNG_STATE_NAME}_{accelerator.process_index}.pkl"),
+                 rng_state, accelerator, all_processes=True)
+    if project.automatic_checkpoint_naming:
+        project.iteration += 1
+    logger.info(f"Saved accelerator state to {output_dir}")
+    return output_dir
+
+
+def _host_pickle(path, obj, accelerator, all_processes: bool = False):
+    if accelerator.is_main_process or all_processes:
+        with open(path, "wb") as f:
+            pickle.dump(obj, f)
+
+
+def load_accelerator_state(accelerator, input_dir: str | None = None, **kwargs):
+    """Reference ``load_accelerator_state`` :179 + driver :3426."""
+    project = accelerator.project_configuration
+    if input_dir is None:
+        if not project.automatic_checkpoint_naming:
+            raise ValueError("input_dir required unless automatic_checkpoint_naming is set")
+        base = os.path.join(accelerator.project_dir, "checkpoints")
+        folders = sorted(
+            (f for f in os.listdir(base) if f.startswith(f"{CHECKPOINT_DIR_PREFIX}_")),
+            key=lambda f: int(f.rsplit("_", 1)[-1]),
+        )
+        input_dir = os.path.join(base, folders[-1])
+    input_dir = os.path.abspath(input_dir)
+
+    ckptr = _checkpointer()
+    for i, model in enumerate(accelerator._models):
+        suffix = "" if i == 0 else f"_{i}"
+        abstract = jax.tree_util.tree_map(
+            lambda p: jax.ShapeDtypeStruct(p.shape, p.dtype, sharding=p.sharding),
+            model.handle.params,
+        )
+        model.handle.params = ckptr.restore(os.path.join(input_dir, f"{MODEL_NAME}{suffix}"), abstract)
+    for i, opt in enumerate(accelerator._optimizers):
+        suffix = "" if i == 0 else f"_{i}"
+        opt_dir = os.path.join(input_dir, f"{OPTIMIZER_NAME}{suffix}")
+        if os.path.isdir(opt_dir):
+            opt._ensure_initialized()
+            abstract = jax.tree_util.tree_map(
+                lambda p: jax.ShapeDtypeStruct(p.shape, p.dtype, sharding=p.sharding),
+                opt.opt_state,
+            )
+            opt.opt_state = ckptr.restore(opt_dir, abstract)
+        meta_path = os.path.join(input_dir, f"{OPTIMIZER_NAME}{suffix}.meta.pkl")
+        if os.path.isfile(meta_path):
+            with open(meta_path, "rb") as f:
+                meta = pickle.load(f)
+            opt._step_count = meta.get("step_count", 0)
+            if opt.scaler is not None and meta.get("scale"):
+                opt.scaler.scale = meta["scale"]
+    for i, sched in enumerate(accelerator._schedulers):
+        suffix = "" if i == 0 else f"_{i}"
+        path = os.path.join(input_dir, f"{SCHEDULER_NAME}{suffix}.bin")
+        if os.path.isfile(path):
+            with open(path, "rb") as f:
+                sched.load_state_dict(pickle.load(f))
+    for i, dl in enumerate(accelerator._dataloaders):
+        suffix = "" if i == 0 else f"_{i}"
+        path = os.path.join(input_dir, f"{SAMPLER_NAME}{suffix}.bin")
+        if os.path.isfile(path) and hasattr(dl, "load_state_dict"):
+            with open(path, "rb") as f:
+                dl.load_state_dict(pickle.load(f))
+    for i, obj in enumerate(accelerator._custom_objects):
+        path = os.path.join(input_dir, f"custom_checkpoint_{i}.pkl")
+        if os.path.isfile(path):
+            with open(path, "rb") as f:
+                obj.load_state_dict(pickle.load(f))
+    rng_path = os.path.join(input_dir, f"{RNG_STATE_NAME}_{accelerator.process_index}.pkl")
+    if os.path.isfile(rng_path):
+        with open(rng_path, "rb") as f:
+            rng_state = pickle.load(f)
+        random.setstate(rng_state["python"])
+        np.random.set_state(rng_state["numpy"])
+        accelerator.step = rng_state.get("step", 0)
+        for i, model in enumerate(accelerator._models):
+            if f"model_{i}_key_counter" in rng_state:
+                model.handle.step_counter = rng_state[f"model_{i}_key_counter"]
+    logger.info(f"Loaded accelerator state from {input_dir}")
+    return input_dir
+
+
+# ------------------------------------------------------------- model exports
+def parse_shard_size(max_shard_size) -> int:
+    if isinstance(max_shard_size, int):
+        return max_shard_size
+    units = {"KB": 10**3, "MB": 10**6, "GB": 10**9, "KIB": 2**10, "MIB": 2**20, "GIB": 2**30}
+    s = str(max_shard_size).upper().replace(" ", "")
+    for unit, mult in units.items():
+        if s.endswith(unit):
+            return int(float(s[: -len(unit)]) * mult)
+    return int(s)
+
+
+def save_model(accelerator, model, save_directory, max_shard_size="10GB", safe_serialization=True):
+    """Consolidated safetensors export with HF-compatible sharding/index
+    (reference ``save_model`` :3117-3227)."""
+    os.makedirs(save_directory, exist_ok=True)
+    params = accelerator.get_state_dict(model)  # host numpy tree
+    flat = _flatten_params(params)
+    if not accelerator.is_main_process:
+        accelerator.wait_for_everyone()
+        return
+    limit = parse_shard_size(max_shard_size)
+    shards, current, size = [], {}, 0
+    for key, val in flat.items():
+        nbytes = np.asarray(val).nbytes
+        if current and size + nbytes > limit:
+            shards.append(current)
+            current, size = {}, 0
+        current[key] = np.ascontiguousarray(val)
+        size += nbytes
+    if current:
+        shards.append(current)
+
+    from safetensors.numpy import save_file
+
+    if len(shards) == 1:
+        save_file(shards[0], os.path.join(save_directory, SAFE_WEIGHTS_NAME))
+    else:
+        index = {"metadata": {"total_size": sum(np.asarray(v).nbytes for v in flat.values())}, "weight_map": {}}
+        for i, shard in enumerate(shards):
+            name = SAFE_WEIGHTS_NAME.replace(".safetensors", f"-{i + 1:05d}-of-{len(shards):05d}.safetensors")
+            save_file(shard, os.path.join(save_directory, name))
+            for key in shard:
+                index["weight_map"][key] = name
+        with open(os.path.join(save_directory, SAFE_WEIGHTS_INDEX_NAME), "w") as f:
+            json.dump(index, f, indent=2)
+    accelerator.wait_for_everyone()
+
+
+def load_model_weights(save_directory, template_params):
+    """Inverse of ``save_model``: read (possibly sharded) safetensors back into the
+    structure of ``template_params``."""
+    from safetensors.numpy import load_file
+
+    save_directory = Path(save_directory)
+    flat = {}
+    index_file = save_directory / SAFE_WEIGHTS_INDEX_NAME
+    if index_file.is_file():
+        index = json.loads(index_file.read_text())
+        for name in sorted(set(index["weight_map"].values())):
+            flat.update(load_file(save_directory / name))
+    else:
+        flat.update(load_file(save_directory / SAFE_WEIGHTS_NAME))
+
+    from .parallel.sharding import path_str
+
+    items = jax.tree_util.tree_flatten_with_path(template_params)
+    leaves = []
+    for path, leaf in items[0]:
+        key = path_str(path).replace("/", ".")
+        if key not in flat:
+            raise KeyError(f"weight {key} missing from checkpoint {save_directory}")
+        leaves.append(flat[key])
+    return jax.tree_util.tree_unflatten(items[1], leaves)
+
+
+def save_custom_state(obj, path, index: int = 0):
+    """Reference ``save_custom_state`` :313."""
+    with open(os.path.join(path, f"custom_checkpoint_{index}.pkl"), "wb") as f:
+        pickle.dump(obj.state_dict(), f)
+
+
+def load_custom_state(obj, path, index: int = 0):
+    """Reference ``load_custom_state`` :323."""
+    with open(os.path.join(path, f"custom_checkpoint_{index}.pkl"), "rb") as f:
+        obj.load_state_dict(pickle.load(f))

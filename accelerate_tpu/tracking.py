@@ -1,0 +1,328 @@
+"""Experiment trackers.
+
+Reference parity: ``src/accelerate/tracking.py`` (1,127 LoC) — ``GeneralTracker``
+(:93-172) with ``name``/``requires_logging_directory``/``main_process_only`` and a
+start/log/finish lifecycle; implementations for TensorBoard (:174), WandB (:289),
+CometML (:414), Aim (:508), MLflow (:611), ClearML (:818), DVCLive (:976); and
+``filter_trackers`` (~:1090). All host-side Python — ported in design, with a
+native always-available ``JSONTracker`` (metrics.jsonl) since TPU pods often run
+without any tracking service installed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from functools import wraps
+from typing import Any
+
+from .logging import get_logger
+from .state import PartialState
+
+logger = get_logger(__name__)
+
+_available_trackers = []
+
+
+def _register(cls):
+    _available_trackers.append(cls)
+    return cls
+
+
+def on_main_process(function):
+    """Run only on the main process unless the tracker opts out (reference :55-76)."""
+
+    @wraps(function)
+    def execute_on_main_process(self, *args, **kwargs):
+        if getattr(self, "main_process_only", True):
+            state = PartialState()
+            if state.is_main_process:
+                return function(self, *args, **kwargs)
+            return None
+        return function(self, *args, **kwargs)
+
+    return execute_on_main_process
+
+
+class GeneralTracker:
+    """Base tracker API (reference :93-172)."""
+
+    main_process_only = True
+
+    def __init__(self, _blank=False):
+        self._started = False
+
+    @property
+    def name(self) -> str:
+        raise NotImplementedError
+
+    @property
+    def requires_logging_directory(self) -> bool:
+        raise NotImplementedError
+
+    @property
+    def tracker(self):
+        raise NotImplementedError
+
+    def store_init_configuration(self, values: dict):
+        pass
+
+    def log(self, values: dict, step: int | None = None, **kwargs):
+        pass
+
+    def log_images(self, values: dict, step: int | None = None, **kwargs):
+        raise NotImplementedError(f"{self.name} does not support image logging")
+
+    def finish(self):
+        pass
+
+
+@_register
+class JSONTracker(GeneralTracker):
+    """Native tracker: appends one JSON line per log call to
+    ``<logging_dir>/<run_name>/metrics.jsonl``. Always available."""
+
+    name = "json"
+    requires_logging_directory = True
+
+    @on_main_process
+    def __init__(self, run_name: str, logging_dir: str, **kwargs):
+        super().__init__()
+        self.run_name = run_name
+        self.dir = os.path.join(logging_dir, run_name)
+        os.makedirs(self.dir, exist_ok=True)
+        self.path = os.path.join(self.dir, "metrics.jsonl")
+        self._t0 = time.time()
+
+    @property
+    def tracker(self):
+        return self.path
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        with open(os.path.join(self.dir, "config.json"), "w") as f:
+            json.dump(values, f, indent=2, default=str)
+
+    @on_main_process
+    def log(self, values: dict, step: int | None = None, **kwargs):
+        record = {"_step": step, "_time": round(time.time() - self._t0, 3)}
+        record.update({k: (float(v) if hasattr(v, "__float__") else v) for k, v in values.items()})
+        with open(self.path, "a") as f:
+            f.write(json.dumps(record, default=str) + "\n")
+
+
+@_register
+class TensorBoardTracker(GeneralTracker):
+    """Reference :174-287; uses tensorboardX or torch.utils.tensorboard."""
+
+    name = "tensorboard"
+    requires_logging_directory = True
+
+    @on_main_process
+    def __init__(self, run_name: str, logging_dir: str, **kwargs):
+        super().__init__()
+        try:
+            from torch.utils import tensorboard
+        except ImportError:
+            import tensorboardX as tensorboard  # type: ignore
+        self.run_name = run_name
+        self.logging_dir = os.path.join(logging_dir, run_name)
+        self.writer = tensorboard.SummaryWriter(self.logging_dir, **kwargs)
+
+    @classmethod
+    def is_available(cls) -> bool:
+        try:
+            from torch.utils import tensorboard  # noqa
+
+            return True
+        except ImportError:
+            try:
+                import tensorboardX  # noqa
+
+                return True
+            except ImportError:
+                return False
+
+    @property
+    def tracker(self):
+        return self.writer
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        self.writer.add_hparams(
+            {k: v for k, v in values.items() if isinstance(v, (int, float, str, bool))}, metric_dict={}
+        )
+        self.writer.flush()
+
+    @on_main_process
+    def log(self, values: dict, step: int | None = None, **kwargs):
+        for k, v in values.items():
+            if isinstance(v, (int, float)) or hasattr(v, "__float__"):
+                self.writer.add_scalar(k, float(v), global_step=step, **kwargs)
+            elif isinstance(v, str):
+                self.writer.add_text(k, v, global_step=step, **kwargs)
+            elif isinstance(v, dict):
+                self.writer.add_scalars(k, {kk: float(vv) for kk, vv in v.items()}, global_step=step)
+        self.writer.flush()
+
+    @on_main_process
+    def finish(self):
+        self.writer.close()
+
+
+@_register
+class WandBTracker(GeneralTracker):
+    """Reference :289-412."""
+
+    name = "wandb"
+    requires_logging_directory = False
+
+    @on_main_process
+    def __init__(self, run_name: str, **kwargs):
+        super().__init__()
+        import wandb
+
+        self.run = wandb.init(project=run_name, **kwargs)
+
+    @classmethod
+    def is_available(cls) -> bool:
+        try:
+            import wandb  # noqa
+
+            return True
+        except ImportError:
+            return False
+
+    @property
+    def tracker(self):
+        return self.run
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        import wandb
+
+        wandb.config.update(values, allow_val_change=True)
+
+    @on_main_process
+    def log(self, values: dict, step: int | None = None, **kwargs):
+        self.run.log(values, step=step, **kwargs)
+
+    @on_main_process
+    def log_images(self, values: dict, step: int | None = None, **kwargs):
+        import wandb
+
+        self.run.log({k: [wandb.Image(img) for img in v] for k, v in values.items()}, step=step)
+
+    @on_main_process
+    def finish(self):
+        self.run.finish()
+
+
+@_register
+class MLflowTracker(GeneralTracker):
+    """Reference :611-816."""
+
+    name = "mlflow"
+    requires_logging_directory = False
+
+    @on_main_process
+    def __init__(self, run_name: str, logging_dir: str | None = None, **kwargs):
+        super().__init__()
+        import mlflow
+
+        self.active_run = mlflow.start_run(run_name=run_name, **kwargs)
+
+    @classmethod
+    def is_available(cls) -> bool:
+        try:
+            import mlflow  # noqa
+
+            return True
+        except ImportError:
+            return False
+
+    @property
+    def tracker(self):
+        return self.active_run
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        import mlflow
+
+        for k, v in values.items():
+            mlflow.log_param(k, v)
+
+    @on_main_process
+    def log(self, values: dict, step: int | None = None, **kwargs):
+        import mlflow
+
+        metrics = {k: float(v) for k, v in values.items() if isinstance(v, (int, float)) or hasattr(v, "__float__")}
+        mlflow.log_metrics(metrics, step=step)
+
+    @on_main_process
+    def finish(self):
+        import mlflow
+
+        mlflow.end_run()
+
+
+LOGGER_TYPE_TO_CLASS = {"json": JSONTracker, "tensorboard": TensorBoardTracker, "wandb": WandBTracker, "mlflow": MLflowTracker}
+
+
+def filter_trackers(log_with, logging_dir: str | None = None):
+    """Resolve requested trackers to available classes (reference ~:1090):
+    'all' → every importable tracker; unavailable ones are skipped with a warning;
+    a ``GeneralTracker`` instance passes through."""
+    loggers = []
+    if log_with is None:
+        return []
+    if not isinstance(log_with, (list, tuple)):
+        log_with = [log_with]
+    for tracker in log_with:
+        if isinstance(tracker, GeneralTracker):
+            loggers.append(tracker)
+        elif str(tracker) == "all":
+            for cls in _available_trackers:
+                if getattr(cls, "is_available", lambda: True)():
+                    loggers.append(cls.name)
+        else:
+            name = str(tracker).lower()
+            if name not in LOGGER_TYPE_TO_CLASS:
+                raise ValueError(f"Unknown tracker {name!r}; choose from {sorted(LOGGER_TYPE_TO_CLASS)}")
+            cls = LOGGER_TYPE_TO_CLASS[name]
+            if not getattr(cls, "is_available", lambda: True)():
+                logger.warning(f"Tracker {name} requested but its package is not installed; skipping.")
+                continue
+            if cls.requires_logging_directory and logging_dir is None:
+                raise ValueError(f"Tracker {name} requires a logging_dir/project_dir.")
+            loggers.append(name)
+    # dedup, keep order
+    seen, out = set(), []
+    for l in loggers:
+        key = l if isinstance(l, str) else id(l)
+        if key not in seen:
+            seen.add(key)
+            out.append(l)
+    return out
+
+
+def init_trackers(log_with, project_name, logging_dir, config, init_kwargs, accelerator):
+    """Instantiate trackers & store the run config (driver for
+    ``Accelerator.init_trackers``, reference ``accelerator.py:2954``)."""
+    init_kwargs = init_kwargs or {}
+    trackers = []
+    for entry in log_with or []:
+        if isinstance(entry, GeneralTracker):
+            trackers.append(entry)
+            continue
+        cls = LOGGER_TYPE_TO_CLASS[entry]
+        kwargs = init_kwargs.get(entry, {})
+        if cls.requires_logging_directory:
+            trackers.append(cls(project_name, logging_dir, **kwargs))
+        else:
+            trackers.append(cls(project_name, **kwargs))
+    if config is not None:
+        for tracker in trackers:
+            tracker.store_init_configuration(config)
+    return trackers

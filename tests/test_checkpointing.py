@@ -1,0 +1,179 @@
+"""Checkpoint/resume + tracking + logging tests (reference test_state_checkpointing
+coverage: save→perturb→load→exact-match)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from accelerate_tpu import Accelerator, ParallelismConfig
+from accelerate_tpu.test_utils import RegressionDataset, RegressionModel, regression_batches
+from accelerate_tpu.utils.dataclasses import ProjectConfiguration
+
+
+def _train_some(accelerator, pmodel, popt, pdl, steps=3):
+    it = iter(pdl)
+    for _ in range(steps):
+        batch = next(it)
+        with accelerator.accumulate(pmodel):
+            out = pmodel(**batch)
+            accelerator.backward(out.loss)
+            popt.step()
+            popt.zero_grad()
+
+
+def test_save_load_state_roundtrip(tmp_path):
+    accelerator = Accelerator()
+    model = RegressionModel()
+    model.init_params(None)
+    ds = RegressionDataset(length=64)
+    pmodel, popt, pdl = accelerator.prepare(model, optax.adam(0.1), regression_batches(ds, 16))
+    sched = accelerator.prepare_scheduler(optax.constant_schedule(0.1))
+    _train_some(accelerator, pmodel, popt, pdl)
+    saved_params = accelerator.get_state_dict(pmodel)
+    out = accelerator.save_state(str(tmp_path / "ckpt"))
+    assert os.path.isdir(out)
+
+    # Perturb, then restore.
+    pmodel.handle.params = jax.tree_util.tree_map(lambda p: p * 0 + 123.0, pmodel.handle.params)
+    accelerator.load_state(str(tmp_path / "ckpt"))
+    restored = accelerator.get_state_dict(pmodel)
+    for key in saved_params:
+        assert np.allclose(saved_params[key], restored[key]), key
+    # optimizer state restored too (adam has mu/nu)
+    assert popt.opt_state is not None
+
+
+def test_save_state_preserves_sharding(tmp_path):
+    from accelerate_tpu.models import Llama, LlamaConfig
+
+    accelerator = Accelerator(parallelism_config=ParallelismConfig(fsdp_size=2, tp_size=2))
+    model = Llama(LlamaConfig.tiny())
+    model.init_params(jax.random.key(0))
+    pmodel, popt = accelerator.prepare(model, optax.sgd(0.01))
+    ids = np.ones((4, 8), np.int32)
+    step = accelerator.build_train_step(pmodel, popt)
+    step({"input_ids": ids, "labels": ids})
+    before = pmodel.params["layers"]["attn"]["wq"].sharding
+    accelerator.save_state(str(tmp_path / "c"))
+    accelerator.load_state(str(tmp_path / "c"))
+    after = pmodel.params["layers"]["attn"]["wq"].sharding
+    assert before == after
+
+
+def test_automatic_checkpoint_naming_and_rotation(tmp_path):
+    cfg = ProjectConfiguration(
+        project_dir=str(tmp_path), automatic_checkpoint_naming=True, total_limit=2
+    )
+    accelerator = Accelerator(project_config=cfg)
+    model = RegressionModel()
+    model.init_params(None)
+    pmodel, popt = accelerator.prepare(model, optax.sgd(0.1))
+    for _ in range(3):
+        accelerator.save_state()
+    folders = sorted(os.listdir(tmp_path / "checkpoints"))
+    assert folders == ["checkpoint_1", "checkpoint_2"]  # checkpoint_0 rotated out
+
+
+def test_save_model_safetensors_roundtrip(tmp_path):
+    from accelerate_tpu.checkpointing import load_model_weights
+
+    accelerator = Accelerator()
+    model = RegressionModel()
+    model.init_params(None)
+    pmodel = accelerator.prepare_model(model)
+    accelerator.save_model(pmodel, str(tmp_path))
+    assert os.path.isfile(tmp_path / "model.safetensors")
+    loaded = load_model_weights(tmp_path, pmodel.params)
+    assert np.allclose(np.asarray(loaded["a"]), np.asarray(pmodel.params["a"]))
+
+
+def test_save_model_sharded_export(tmp_path):
+    from accelerate_tpu.checkpointing import load_model_weights
+    from accelerate_tpu.models import Llama, LlamaConfig
+
+    accelerator = Accelerator()
+    model = Llama(LlamaConfig.tiny())
+    model.init_params(jax.random.key(0))
+    pmodel = accelerator.prepare_model(model)
+    accelerator.save_model(pmodel, str(tmp_path), max_shard_size="100KB")
+    assert os.path.isfile(tmp_path / "model.safetensors.index.json")
+    index = json.loads((tmp_path / "model.safetensors.index.json").read_text())
+    assert len(set(index["weight_map"].values())) > 1
+    loaded = load_model_weights(tmp_path, pmodel.params)
+    assert np.allclose(
+        np.asarray(loaded["embed"]["weight"]), np.asarray(jax.device_get(pmodel.params["embed"]["weight"]))
+    )
+
+
+def test_register_for_checkpointing_custom_object(tmp_path):
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def state_dict(self):
+            return {"n": self.n}
+
+        def load_state_dict(self, sd):
+            self.n = sd["n"]
+
+    accelerator = Accelerator()
+    model = RegressionModel()
+    model.init_params(None)
+    accelerator.prepare_model(model)
+    c = Counter()
+    c.n = 7
+    accelerator.register_for_checkpointing(c)
+    accelerator.save_state(str(tmp_path / "ck"))
+    c.n = 0
+    accelerator.load_state(str(tmp_path / "ck"))
+    assert c.n == 7
+
+
+def test_json_tracker(tmp_path):
+    accelerator = Accelerator(log_with="json", project_dir=str(tmp_path))
+    accelerator.init_trackers("myrun", config={"lr": 0.1})
+    accelerator.log({"loss": 1.5}, step=0)
+    accelerator.log({"loss": 0.5}, step=1)
+    accelerator.end_training()
+    lines = (tmp_path / "myrun" / "metrics.jsonl").read_text().strip().splitlines()
+    assert len(lines) == 2
+    assert json.loads(lines[1])["loss"] == 0.5
+    config = json.loads((tmp_path / "myrun" / "config.json").read_text())
+    assert config["lr"] == 0.1
+
+
+def test_filter_trackers_unknown_raises():
+    from accelerate_tpu.tracking import filter_trackers
+
+    with pytest.raises(ValueError, match="Unknown tracker"):
+        filter_trackers("definitely_not_a_tracker", "/tmp")
+
+
+def test_get_logger_main_process_only(caplog):
+    from accelerate_tpu.logging import get_logger
+
+    logger = get_logger("test_logger", log_level="INFO")
+    import logging as _l
+
+    with caplog.at_level(_l.INFO, logger="test_logger"):
+        logger.info("hello")
+    assert any("hello" in r.message for r in caplog.records)
+
+
+def test_skip_first_batches_resume_via_state_dict():
+    accelerator = Accelerator()
+    ds = RegressionDataset(length=64)
+    pdl = accelerator.prepare(regression_batches(ds, 16))
+    it = iter(pdl)
+    next(it), next(it)
+    sd = pdl.state_dict()
+    assert sd["num_batches_fetched"] == 2
+    resumed = accelerator.skip_first_batches(pdl, sd["num_batches_fetched"])
+    remaining = list(resumed)
+    assert len(remaining) == 2
