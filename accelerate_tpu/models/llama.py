@@ -50,6 +50,7 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     tie_word_embeddings: bool = False
     remat: bool = False
+    remat_policy: str = "nothing_saveable"  # any jax.checkpoint_policies name
     attention_impl: str = "auto"  # 'auto' | 'dense' | 'flash' | 'ring'
 
     @property
@@ -116,13 +117,7 @@ def apply_rope(x, cos, sin):
     return jnp.concatenate([out1, out2], axis=-1).astype(x.dtype)
 
 
-def _dense_attention(q, k, v, mask_bias):
-    """q: (B,S,H,D) k/v: (B,S,KV,D) already head-repeated. fp32 softmax."""
-    scale = 1.0 / np.sqrt(q.shape[-1])
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
-    scores = scores + mask_bias
-    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+from ..ops.attention import attention as _attention
 
 
 class Llama(Module):
@@ -204,13 +199,6 @@ class Llama(Module):
             positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (B, S))
         cos, sin = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
 
-        # Causal + padding bias, fp32, (B, 1, S, S) broadcast over heads.
-        causal = jnp.tril(jnp.ones((S, S), bool))
-        bias = jnp.where(causal, 0.0, -1e30).astype(jnp.float32)[None, None]
-        if attention_mask is not None:
-            pad = jnp.where(attention_mask[:, None, None, :].astype(bool), 0.0, -1e30)
-            bias = bias + pad.astype(jnp.float32)
-
         nh, nkv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
 
         def block(x, layer):
@@ -224,7 +212,9 @@ class Llama(Module):
                 rep = nh // nkv
                 k = jnp.repeat(k, rep, axis=2)
                 v = jnp.repeat(v, rep, axis=2)
-            attn_out = _dense_attention(q, k, v, bias).reshape(B, S, nh * hd)
+            attn_out = _attention(
+                q, k, v, causal=True, mask=attention_mask, impl=cfg.attention_impl
+            ).reshape(B, S, nh * hd)
             x = x + attn_out @ layer["attn"]["wo"]
             h2 = rms_norm(x, layer["post_attn_norm"]["weight"], cfg.rms_norm_eps)
             gated = jax.nn.silu(h2 @ layer["mlp"]["w_gate"]) * (h2 @ layer["mlp"]["w_up"])
@@ -233,7 +223,8 @@ class Llama(Module):
 
         body = block
         if cfg.remat:
-            body = jax.checkpoint(block, policy=jax.checkpoint_policies.nothing_saveable)
+            policy = getattr(jax.checkpoint_policies, cfg.remat_policy)
+            body = jax.checkpoint(block, policy=policy)
 
         def scan_step(x, layer):
             return body(x, layer), None

@@ -1,0 +1,109 @@
+"""Attention dispatch: dense / pallas-flash / ring.
+
+The hot op of every transformer. Three implementations behind one interface
+(layout (B, S, H, D), GQA-aware, causal + padding mask):
+
+- ``dense``  — einsum attention, fp32 softmax. Runs anywhere; O(S²) HBM.
+- ``flash``  — Pallas TPU flash kernel (block-streamed, O(S) HBM, fwd+bwd in
+  VMEM). We use the Mosaic flash kernel shipped *inside JAX*
+  (``jax.experimental.pallas.ops.tpu.flash_attention``) — it is part of the
+  platform, tuned per TPU generation, with a custom-VJP backward.
+- ``ring``   — sequence-parallel ring attention over the mesh ``sp`` axis
+  (``parallel/ring.py``): each device holds a sequence chunk, KV chunks rotate
+  via ``ppermute`` while flash-style running-softmax statistics merge. The
+  reference framework has NO native sequence parallelism (SURVEY.md §2.4) —
+  this is the long-context story.
+
+Padding is encoded as segment ids (padding tokens live in their own segment so
+real↔pad pairs are masked inside the kernel).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def repeat_kv(k, v, n_rep: int):
+    if n_rep == 1:
+        return k, v
+    return jnp.repeat(k, n_rep, axis=2), jnp.repeat(v, n_rep, axis=2)
+
+
+def dense_attention(q, k, v, *, causal=True, mask=None, positions_q=None, positions_kv=None):
+    """q: (B,S,H,D), k/v: (B,Skv,H,D); mask: (B,Skv) 1=real. fp32 softmax."""
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    bias = jnp.zeros_like(scores)
+    if causal:
+        if positions_q is None:
+            positions_q = jnp.arange(q.shape[1])
+        if positions_kv is None:
+            positions_kv = jnp.arange(k.shape[1])
+        causal_mask = positions_q[:, None] >= positions_kv[None, :]
+        bias = jnp.where(causal_mask[None, None], bias, -1e30)
+    if mask is not None:
+        bias = bias + jnp.where(mask[:, None, None, :].astype(bool), 0.0, -1e30)
+    probs = jax.nn.softmax(scores + bias, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _flash_available() -> bool:
+    if jax.default_backend() != "tpu":
+        return False
+    try:
+        from jax.experimental.pallas.ops.tpu import flash_attention  # noqa
+
+        return True
+    except ImportError:
+        return False
+
+
+def flash_attention(q, k, v, *, causal=True, mask=None):
+    """Pallas TPU flash attention; layout (B,S,H,D) in, internally (B,H,S,D)."""
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        SegmentIds,
+        flash_attention as _flash,
+    )
+
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    segment_ids = None
+    if mask is not None:
+        seg = mask.astype(jnp.int32) + 1  # real tokens: 2, padding: 1 — pads only see pads
+        seg = jnp.where(mask.astype(bool), 2, 1).astype(jnp.int32)
+        segment_ids = SegmentIds(q=seg, kv=seg)
+    out = _flash(qt, kt, vt, segment_ids=segment_ids, causal=causal, sm_scale=scale)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def attention(q, k, v, *, causal=True, mask=None, impl: str = "auto", mesh=None):
+    """Unified entry used by the model zoo. ``impl``: auto|dense|flash|ring."""
+    if impl == "auto":
+        # Measured on v5e: the Mosaic flash kernel beats dense einsum attention
+        # from ~2k sequence length; below that the S² matmul rides the MXU faster
+        # than the block-streamed kernel (and remat of dense attention is cheap).
+        impl = "flash" if _flash_available() and _flash_shapes_ok(q, k) and q.shape[1] >= 2048 else "dense"
+    if impl == "flash":
+        if not _flash_available():
+            impl = "dense"
+        else:
+            return flash_attention(q, k, v, causal=causal, mask=mask)
+    if impl == "ring":
+        from ..parallel.ring import ring_attention
+
+        return ring_attention(q, k, v, causal=causal, mask=mask, mesh=mesh)
+    return dense_attention(q, k, v, causal=causal, mask=mask)
+
+
+def _flash_shapes_ok(q, k) -> bool:
+    # Mosaic flash wants seq multiples of the block sizes (min 128) and head_dim
+    # aligned to lanes; fall back for tiny/test shapes.
+    B, S, H, D = q.shape
+    return S >= 128 and S % 128 == 0 and D % 128 == 0 or (D in (64, 96, 128, 256) and S % 128 == 0 and S >= 128)

@@ -1,0 +1,131 @@
+"""Ring attention — sequence/context parallelism over the mesh ``sp`` axis.
+
+Long-context training support the reference does NOT have (SURVEY.md §2.4: no
+ring/Ulysses/blockwise/context-parallel code anywhere in accelerate itself — only
+a Megatron passthrough flag). Here it is first-class and TPU-shaped:
+
+- activations are sharded along the *sequence* dimension, so a context of length
+  S costs each chip S/sp of activation memory;
+- KV chunks rotate around the ``sp`` ring with ``lax.ppermute`` — neighbor
+  point-to-point hops that map 1:1 onto the ICI torus, overlapping each hop with
+  the attention compute of the resident chunk (the RingAttention recipe);
+- softmax is streamed: each visiting KV chunk updates running (max, sum, acc)
+  statistics exactly like flash attention's inner loop, so no device ever holds a
+  full S×S score matrix — numerics match dense attention to fp32 tolerance.
+
+Causality is enforced with *global* positions (chunk offsets), so the result is
+bit-for-bit the same function as dense causal attention on the unsharded sequence.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+def _chunk_scores(q, k, bias):
+    """q (b,s,h,d) k (b,skv,h,d) → fp32 scores (b,h,s,skv) + bias."""
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    return scores + bias
+
+
+def _streaming_merge(m, l, acc, scores, v):
+    """Flash-style running softmax update with one incoming score block."""
+    valid = scores > _NEG_INF / 2
+    m_j = jnp.max(scores, axis=-1)  # (b,h,s)
+    m_new = jnp.maximum(m, m_j)
+    # Guard: rows with no valid key this block contribute nothing.
+    p = jnp.exp(scores - m_new[..., None]) * valid
+    l_j = jnp.sum(p, axis=-1)
+    alpha = jnp.exp(m - m_new)
+    o_j = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v).astype(jnp.float32)
+    l_new = l * alpha + l_j
+    acc_new = acc * jnp.swapaxes(alpha, 1, 2)[..., None] + o_j
+    return m_new, l_new, acc_new
+
+
+def _ring_attention_local(q, k, v, mask, q_offset_chunks, axis_name: str, causal: bool):
+    """Body run per-device under shard_map. q/k/v: (b, s_loc, h, d) local chunks."""
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, s_loc, h, d = q.shape
+    pos_q = idx * s_loc + jnp.arange(s_loc)
+
+    m = jnp.full((b, h, s_loc), _NEG_INF, jnp.float32)
+    l = jnp.zeros((b, h, s_loc), jnp.float32)
+    acc = jnp.zeros((b, s_loc, h, d), jnp.float32)
+
+    def body(step, carry):
+        m, l, acc, k_cur, v_cur, mask_cur, kv_idx = carry
+        pos_k = kv_idx * s_loc + jnp.arange(s_loc)
+        bias = jnp.zeros((b, 1, s_loc, s_loc), jnp.float32)
+        if causal:
+            visible = pos_q[:, None] >= pos_k[None, :]
+            bias = jnp.where(visible[None, None], bias, _NEG_INF)
+        if mask_cur is not None:
+            bias = bias + jnp.where(mask_cur[:, None, None, :].astype(bool), 0.0, _NEG_INF)
+        scores = _chunk_scores(q, k_cur, bias)
+        m, l, acc = _streaming_merge(m, l, acc, scores, v_cur)
+        # Rotate KV (and its metadata) to the next ring neighbor — a pure ICI hop.
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        mask_nxt = jax.lax.ppermute(mask_cur, axis_name, perm) if mask_cur is not None else None
+        kv_nxt = jax.lax.ppermute(kv_idx, axis_name, perm)
+        return m, l, acc, k_nxt, v_nxt, mask_nxt, kv_nxt
+
+    carry = (m, l, acc, k, v, mask, idx)
+    carry = jax.lax.fori_loop(0, n, body, carry)
+    m, l, acc = carry[0], carry[1], carry[2]
+    l_safe = jnp.swapaxes(jnp.where(l > 0, l, 1.0), 1, 2)[..., None]
+    return (acc / l_safe).astype(q.dtype)
+
+
+def ring_attention(q, k, v, *, causal=True, mask=None, mesh=None, axis_name: str = "sp"):
+    """Sequence-parallel attention. q/k/v: (B, S, H, D) global arrays with S
+    sharded on ``axis_name``; heads may simultaneously be sharded on ``tp``."""
+    if mesh is None:
+        from ..state import PartialState
+
+        mesh = PartialState().mesh
+    if mesh.shape.get(axis_name, 1) == 1:
+        from ..ops.attention import dense_attention
+
+        return dense_attention(q, k, v, causal=causal, mask=mask)
+
+    n_batch = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+    batch_axes = ("dp", "fsdp") if q.shape[0] % n_batch == 0 else None
+    head_axis = "tp" if q.shape[2] % mesh.shape.get("tp", 1) == 0 else None
+    qkv_spec = P(batch_axes, axis_name, head_axis, None)
+    mask_spec = P(batch_axes, axis_name)
+
+    from jax import shard_map
+
+    if mask is None:
+        fn = shard_map(
+            partial(_ring_attention_local, mask=None, q_offset_chunks=None,
+                    axis_name=axis_name, causal=causal),
+            mesh=mesh,
+            in_specs=(qkv_spec, qkv_spec, qkv_spec),
+            out_specs=qkv_spec,
+            check_vma=False,
+        )
+        return fn(q, k, v)
+    fn = shard_map(
+        lambda q, k, v, mask: _ring_attention_local(
+            q, k, v, mask, None, axis_name=axis_name, causal=causal
+        ),
+        mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
+        out_specs=qkv_spec,
+        check_vma=False,
+    )
+    return fn(q, k, v, mask)

@@ -50,10 +50,10 @@ def main():
             hidden_size=1024,
             intermediate_size=4096,
             num_hidden_layers=16,
-            num_attention_heads=16,
-            num_key_value_heads=16,
+            num_attention_heads=8,  # head_dim 128: fills the MXU/VPU lanes
+            num_key_value_heads=8,
             max_position_embeddings=1024,
-            remat=True,  # dense-attention activations OOM one chip without remat
+            remat=True,
         )
         batch, seq, steps, warmup = 8, 1024, 20, 3
     else:
