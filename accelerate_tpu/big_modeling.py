@@ -1,0 +1,435 @@
+"""Big-model loading & offloaded inference.
+
+Reference parity: ``src/accelerate/big_modeling.py`` — ``init_empty_weights``/
+``init_on_device`` (:61-170), ``cpu_offload``/``disk_offload``/
+``cpu_offload_with_hook`` (:173-307), ``dispatch_model`` (:309-526),
+``load_checkpoint_and_dispatch`` (:529-668), ``attach_layerwise_casting_hooks``
+(:670-766).
+
+TPU re-design:
+
+- **empty init** — the reference monkeypatches ``nn.Module.register_parameter`` to
+  allocate on the meta device. Functionally pure models make this trivial:
+  ``jax.eval_shape`` traces ``init`` without running it, yielding a pytree of
+  ``ShapeDtypeStruct`` (zero bytes). The context manager here just flips the flag
+  ``Module.init_params`` consults.
+- **dispatch** — a device_map's chip entries become ``jax.device_put`` placements
+  (or a NamedSharding over the whole mesh — on TPU, *sharding* across chips via
+  GSPMD replaces the reference's per-GPU block placement as the preferred layout);
+  ``"cpu"``/``"disk"`` entries stay host-side and are streamed per layer by
+  ``StreamedScanModel`` — the hook hot loop of the reference (hooks.py:328-402
+  there), reshaped into one compiled block program + just-in-time DMA.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+from typing import Mapping
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .hooks import AlignDevicesHook, CpuOffload, UserCpuOffloadHook, add_hook_to_module
+from .modules import ModelOutput, Module
+from .utils.modeling import (
+    check_device_map,
+    device_for_target,
+    get_balanced_memory,
+    get_max_memory,
+    infer_auto_device_map,
+    load_checkpoint_in_model,
+    named_parameters,
+    param_target,
+    unflatten_names,
+)
+from .utils.offload import OffloadedWeightsLoader, offload_state_dict
+
+logger = logging.getLogger(__name__)
+
+_EMPTY_INIT_DEPTH = 0
+
+
+def _empty_init_active() -> bool:
+    return _EMPTY_INIT_DEPTH > 0
+
+
+@contextlib.contextmanager
+def init_empty_weights(include_buffers: bool = False):
+    """Under this context ``model.init_params(...)`` produces abstract
+    ``ShapeDtypeStruct`` leaves instead of real arrays (reference
+    ``init_empty_weights`` :61-110 allocates on the meta device).
+
+    70B-parameter models can be planned (``infer_auto_device_map``,
+    ``estimate-memory``) without a byte of array storage.
+    """
+    global _EMPTY_INIT_DEPTH
+    _EMPTY_INIT_DEPTH += 1
+    try:
+        yield
+    finally:
+        _EMPTY_INIT_DEPTH -= 1
+
+
+@contextlib.contextmanager
+def init_on_device(device):
+    """Initialize params directly onto ``device`` (reference ``init_on_device``
+    :113-170). ``device`` must be a ``jax.Device``; for sharded initialization
+    use ``Accelerator.prepare`` (the sharding planner), not this context."""
+    if not hasattr(device, "platform"):
+        raise TypeError(
+            f"init_on_device expects a jax.Device, got {type(device).__name__}; "
+            "for sharded placement pass the model through Accelerator.prepare()."
+        )
+    default = jax.config.jax_default_device
+    try:
+        jax.config.update("jax_default_device", device)
+        yield
+    finally:
+        jax.config.update("jax_default_device", default)
+
+
+# ------------------------------------------------------------------ offload APIs
+def cpu_offload(model, execution_device=None, offload_buffers: bool = False, state_dict=None):
+    """Whole-model host offload: params live on host RAM, move to HBM per forward
+    (reference ``cpu_offload`` :173-212)."""
+    if execution_device is None:
+        execution_device = jax.local_devices()[0]
+    params = getattr(model, "params", None)
+    if params is not None:
+        model.params = jax.tree_util.tree_map(
+            lambda p: np.asarray(jax.device_get(p)) if isinstance(p, jax.Array) else p, params
+        )
+    add_hook_to_module(model, AlignDevicesHook(execution_device=execution_device, io_same_device=True))
+    return model
+
+
+def cpu_offload_with_hook(model, execution_device=None, prev_module_hook=None):
+    """Host offload with a user-controlled eviction handle, for model chains
+    (reference ``cpu_offload_with_hook`` :215-254)."""
+    hook = CpuOffload(execution_device=execution_device, prev_module_hook=prev_module_hook)
+    add_hook_to_module(model, hook)
+    user_hook = UserCpuOffloadHook(model, hook)
+    return model, user_hook
+
+
+def disk_offload(model, offload_dir: str, execution_device=None, offload_buffers: bool = False):
+    """Whole-model disk offload via memmap folder (reference ``disk_offload``
+    :257-307)."""
+    params = getattr(model, "params", None)
+    if params is None:
+        raise ValueError("Model has no params to offload; call model.init_params() first.")
+    flat = {
+        k: np.asarray(jax.device_get(v)) for k, v in named_parameters(params).items()
+        if isinstance(v, (jax.Array, np.ndarray))
+    }
+    offload_state_dict(offload_dir, flat)
+    weights_map = OffloadedWeightsLoader(save_folder=offload_dir)
+    # Keep only abstract leaves in memory.
+    model.params = jax.tree_util.tree_map(
+        lambda p: jax.ShapeDtypeStruct(p.shape, p.dtype) if hasattr(p, "shape") else p, params
+    )
+    if execution_device is None:
+        execution_device = jax.local_devices()[0]
+    add_hook_to_module(
+        model,
+        AlignDevicesHook(
+            execution_device=execution_device, weights_map=weights_map, io_same_device=True
+        ),
+    )
+    return model
+
+
+def attach_layerwise_casting_hooks(
+    module,
+    storage_dtype=None,
+    compute_dtype=jnp.bfloat16,
+    skip_modules_pattern=None,
+    skip_modules_classes=None,
+    non_blocking: bool = False,
+):
+    """Store params in a narrow dtype, upcast inside the forward (reference
+    ``attach_layerwise_casting_hooks`` :670-766)."""
+    from .hooks import LayerwiseCastingHook
+
+    if storage_dtype is None:
+        storage_dtype = jnp.bfloat16
+    add_hook_to_module(module, LayerwiseCastingHook(storage_dtype, compute_dtype))
+    return module
+
+
+# ---------------------------------------------------------------------- dispatch
+def dispatch_model(
+    model,
+    device_map: Mapping[str, str],
+    main_device=None,
+    state_dict=None,
+    offload_dir: str | None = None,
+    offload_index: Mapping | None = None,
+    offload_buffers: bool = False,
+    skip_keys=None,
+    preload_module_classes=None,
+    force_hooks: bool = False,
+):
+    """Execute a placement plan (reference ``dispatch_model`` :309-526).
+
+    Chip-resident blocks are ``device_put`` where the plan says; ``cpu``/``disk``
+    blocks stay host/memmap-resident. When any block is offloaded the returned
+    model runs via ``StreamedScanModel`` (layer streaming) if the model exposes the
+    embed/block/head protocol, else a whole-tree ``AlignDevicesHook``.
+    """
+    params = getattr(model, "params", None)
+    if params is None:
+        raise ValueError("Model has no params; call model.init_params() (possibly under init_empty_weights).")
+    check_device_map(params, dict(device_map))
+
+    flat = named_parameters(params)
+    targets = {name: param_target(name, dict(device_map)) for name in flat}
+    has_offload = any(t in ("cpu", "disk") for t in targets.values())
+    has_disk = any(t == "disk" for t in targets.values())
+
+    if has_disk and offload_dir is None and offload_index is None:
+        raise ValueError(
+            "Disk offload requested in device_map but no offload_dir was given "
+            "(reference raises the same, big_modeling.py:377-381)."
+        )
+
+    # Chip placement policy (the TPU-first divergence from the reference): a plan
+    # spanning MULTIPLE chips is executed as GSPMD *sharding* over a mesh of those
+    # chips — XLA inserts the inter-chip transfers/collectives — rather than the
+    # reference's block-per-device placement with hook-driven activation moves
+    # (hooks.py:373-402 there), which has no compiled-graph analog.
+    chip_targets = sorted({t for t in targets.values() if t not in ("cpu", "disk")})
+    chip_sharding = None
+    if len(chip_targets) > 1:
+        from jax.sharding import Mesh
+
+        from .parallel.sharding import plan_param_shardings
+
+        plan_devices = [device_for_target(t) for t in chip_targets]
+        chip_mesh = Mesh(np.array(plan_devices), ("fsdp",))
+        sharding_tree = plan_param_shardings(params, chip_mesh)
+        chip_sharding = dict(
+            zip(
+                named_parameters(params).keys(),
+                jax.tree_util.tree_leaves(
+                    sharding_tree, is_leaf=lambda x: hasattr(x, "spec")
+                ),
+            )
+        )
+
+    new_flat = {}
+    disk_spill = {}
+    for name, leaf in flat.items():
+        t = targets[name]
+        if t == "disk":
+            if isinstance(leaf, (jax.Array, np.ndarray)):
+                disk_spill[name] = np.asarray(jax.device_get(leaf))
+            new_flat[name] = jax.ShapeDtypeStruct(leaf.shape, leaf.dtype)
+        elif t == "cpu":
+            new_flat[name] = (
+                np.asarray(jax.device_get(leaf)) if isinstance(leaf, jax.Array) else leaf
+            )
+        elif not isinstance(leaf, (jax.Array, np.ndarray)):
+            new_flat[name] = leaf  # abstract leaf without weights: left for load_checkpoint
+        elif chip_sharding is not None:
+            new_flat[name] = jax.device_put(leaf, chip_sharding[name])
+        else:
+            new_flat[name] = jax.device_put(leaf, device_for_target(t))
+    if disk_spill:
+        if offload_dir is None:
+            raise ValueError(
+                "device_map sends live weights to 'disk' but no offload_dir was given; "
+                "pass offload_dir= (offload_index alone only covers weights already on disk)."
+            )
+        offload_state_dict(offload_dir, disk_spill)
+    model.params = unflatten_names(new_flat, params)
+    model._at_device_map = dict(device_map)
+
+    if not has_offload and not force_hooks:
+        return model
+
+    weights_map = OffloadedWeightsLoader(
+        state_dict={k: v for k, v in new_flat.items() if isinstance(v, np.ndarray)},
+        save_folder=offload_dir if has_disk else None,
+        index=offload_index,
+    )
+    execution_device = main_device or jax.local_devices()[0]
+
+    if _supports_streaming(model, targets):
+        return StreamedScanModel(model, weights_map, execution_device)
+    add_hook_to_module(
+        model,
+        AlignDevicesHook(
+            execution_device=execution_device, weights_map=weights_map, io_same_device=True
+        ),
+    )
+    return model
+
+
+def _supports_streaming(model, targets) -> bool:
+    """Layer streaming needs the embed/block/head protocol + stacked layers, and
+    only the 'layers' subtree offloaded (embed/head resident)."""
+    if not all(hasattr(model, m) for m in ("embed", "block", "head")):
+        return False
+    params = getattr(model, "params", None)
+    if not isinstance(params, dict) or "layers" not in params:
+        return False
+    offloaded_nonlayers = [
+        n for n, t in targets.items()
+        if t in ("cpu", "disk") and not n.startswith("layers.")
+    ]
+    return not offloaded_nonlayers
+
+
+class StreamedScanModel:
+    """Layer-streamed execution for stacked-scan decoder models.
+
+    The TPU-shaped replacement for per-module AlignDevicesHooks (reference
+    hooks.py:328-402): ONE compiled block program, and per layer a just-in-time
+    ``jax.device_put`` of that layer's weight slice. ``device_put`` is async, so
+    layer ``i+1``'s host→HBM DMA overlaps layer ``i``'s compute (double
+    buffering) — the same overlap the reference approximates with
+    ``non_blocking=True`` copies.
+    """
+
+    def __init__(self, model, weights_map, execution_device):
+        self.model = model
+        self.weights_map = weights_map
+        self.execution_device = execution_device
+        # jit caches are keyed on the function object — build each wrapper ONCE so
+        # repeated inference calls reuse the compiled programs.
+        self._block_fn = jax.jit(lambda layer, x, ctx: model.block(layer, x, ctx))
+        self._embed_fn = jax.jit(lambda p, ids, pos, am: model.embed(p, ids, pos, am))
+        self._head_fn = jax.jit(
+            lambda p, x, lab, am: model.head(p, x, labels=lab, attention_mask=am)
+        )
+        cfg = getattr(model, "config", None)
+        self.num_layers = getattr(cfg, "num_hidden_layers", None) or getattr(
+            cfg, "num_layers", None
+        )
+        if self.num_layers is None:
+            # Infer from any stacked leaf's leading dim.
+            leaf = jax.tree_util.tree_leaves(model.params["layers"])[0]
+            self.num_layers = leaf.shape[0]
+
+    @property
+    def config(self):
+        return self.model.config
+
+    @property
+    def params(self):
+        return self.model.params
+
+    def _layer_host_slice(self, i: int):
+        """Layer i's weights as host arrays, read lazily (memmap slice reads only
+        that layer's bytes from disk)."""
+        template = self.model.params["layers"]
+        flat = {}
+        for name, leaf in named_parameters(template).items():
+            full_name = f"layers.{name}"
+            if full_name in self.weights_map:
+                stacked = self.weights_map[full_name]
+                flat[name] = np.asarray(stacked[i])
+            elif isinstance(leaf, jax.Array):
+                flat[name] = leaf[i]
+            else:
+                raise KeyError(f"No weights available for {full_name}")
+        return unflatten_names(flat, template)
+
+    def _resident_nonlayer_params(self):
+        out = dict(self.model.params)
+        out.pop("layers", None)
+        return jax.device_put(out, self.execution_device)
+
+    def __call__(self, input_ids=None, labels=None, attention_mask=None, positions=None, **kw):
+        nonlayer = self._resident_nonlayer_params()
+        x, ctx = self._embed_fn(nonlayer, input_ids, positions, attention_mask)
+        # Double-buffered streaming: prefetch layer i+1 while layer i computes.
+        next_layer = jax.device_put(self._layer_host_slice(0), self.execution_device)
+        for i in range(self.num_layers):
+            layer = next_layer
+            if i + 1 < self.num_layers:
+                next_layer = jax.device_put(
+                    self._layer_host_slice(i + 1), self.execution_device
+                )
+            x = self._block_fn(layer, x, ctx)
+        return self._head_fn(nonlayer, x, labels, attention_mask)
+
+    def apply(self, params, *args, **kwargs):
+        return self(*args, **kwargs)
+
+    def eval(self):
+        return self
+
+    def train(self, mode: bool = True):
+        if mode:
+            raise RuntimeError("StreamedScanModel is inference-only (offloaded dispatch).")
+        return self
+
+
+# --------------------------------------------------------- load-and-dispatch
+def load_checkpoint_and_dispatch(
+    model,
+    checkpoint: str,
+    device_map: Mapping[str, str] | str | None = None,
+    max_memory: Mapping | None = None,
+    no_split_module_classes=None,
+    offload_folder: str | None = None,
+    offload_buffers: bool = False,
+    dtype=None,
+    offload_state_dict: bool | None = None,
+    skip_keys=None,
+    preload_module_classes=None,
+    force_hooks: bool = False,
+    strict: bool = False,
+):
+    """infer plan → load shards → dispatch (reference ``load_checkpoint_and_dispatch``
+    :529-668). ``device_map='auto'|'balanced'|'balanced_low_0'|'sequential'``
+    mirrors the reference's accepted strings (:600-610)."""
+    params = getattr(model, "params", None)
+    if params is None:
+        raise ValueError("Call model.init_params() (ideally under init_empty_weights()) first.")
+    if isinstance(device_map, str):
+        if device_map not in ("auto", "balanced", "balanced_low_0", "sequential"):
+            raise ValueError(
+                "If passing a string for `device_map`, please choose 'auto', 'balanced', "
+                "'balanced_low_0' or 'sequential'."
+            )
+        if device_map != "sequential":
+            max_memory = get_balanced_memory(
+                params, max_memory=max_memory, dtype=dtype,
+                low_zero=(device_map == "balanced_low_0"),
+            )
+        device_map = infer_auto_device_map(params, max_memory=max_memory, dtype=dtype)
+    loaded = load_checkpoint_in_model(
+        params,
+        checkpoint,
+        device_map=device_map,
+        offload_folder=offload_folder,
+        dtype=dtype,
+        strict=strict,
+    )
+    model.params = loaded
+    if device_map is None:
+        model.params = jax.device_put(loaded, jax.local_devices()[0])
+        return model
+    offload_index = None
+    import os
+
+    if offload_folder is not None and os.path.isfile(os.path.join(offload_folder, "index.json")):
+        import json
+
+        with open(os.path.join(offload_folder, "index.json")) as fh:
+            offload_index = json.load(fh)
+    return dispatch_model(
+        model,
+        device_map=device_map,
+        offload_dir=offload_folder,
+        offload_index=offload_index,
+        offload_buffers=offload_buffers,
+        skip_keys=skip_keys,
+        force_hooks=force_hooks,
+    )

@@ -1,0 +1,234 @@
+"""Forward-interception hooks — the offload/dispatch runtime.
+
+Reference parity: ``src/accelerate/hooks.py`` — ``ModelHook``/``SequentialHook``
+(:43-99), ``add_hook_to_module`` (:130-186, replaces ``module.forward``),
+``AlignDevicesHook`` (:225-410: pre_forward moves weights in, post_forward offloads),
+``attach_align_device_hook_on_blocks`` (:555-687), ``CpuOffload``/
+``UserCpuOffloadHook`` (:689-739), ``LayerwiseCastingHook`` (:741-765).
+
+TPU re-design: the reference intercepts stateful ``nn.Module.forward`` and mutates
+``module.weight.data`` in place. Our models are pure functions over param pytrees,
+so a hook intercepts ``module.apply`` and transforms **(params, args, kwargs)** on
+the way in and **outputs** on the way out. Weight movement becomes ``jax.device_put``
+of pytree leaves (host↔HBM DMA), and "remove from device" is dropping the device
+reference (XLA frees the buffer) — no ``.data`` mutation exists or is needed.
+
+The per-block streaming runtime for disk/host-offloaded inference lives in
+``big_modeling.StreamedScanModel`` which exploits the zoo's stacked-layer layout
+(leading ``L`` dim) instead of per-module hook attachment: one compiled block
+program + a double-buffered device_put pipeline — the TPU-shaped version of the
+reference's AlignDevicesHook hot loop (hooks.py:328-402 there).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Mapping
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+class ModelHook:
+    """Hook protocol (reference ``ModelHook`` :43-99). All methods are pure-ish:
+    they receive and return the values rather than mutating modules."""
+
+    no_grad = False
+
+    def init_hook(self, module):
+        return module
+
+    def pre_forward(self, module, params, args, kwargs):
+        return params, args, kwargs
+
+    def post_forward(self, module, output):
+        return output
+
+    def detach_hook(self, module):
+        return module
+
+
+class SequentialHook(ModelHook):
+    """Compose hooks in order (reference ``SequentialHook`` :84-99)."""
+
+    def __init__(self, *hooks):
+        self.hooks = hooks
+
+    def init_hook(self, module):
+        for hook in self.hooks:
+            module = hook.init_hook(module)
+        return module
+
+    def pre_forward(self, module, params, args, kwargs):
+        for hook in self.hooks:
+            params, args, kwargs = hook.pre_forward(module, params, args, kwargs)
+        return params, args, kwargs
+
+    def post_forward(self, module, output):
+        for hook in self.hooks:
+            output = hook.post_forward(module, output)
+        return output
+
+    def detach_hook(self, module):
+        for hook in self.hooks:
+            module = hook.detach_hook(module)
+        return module
+
+
+def add_hook_to_module(module, hook: ModelHook, append: bool = False):
+    """Wrap ``module.apply`` with the hook (reference ``add_hook_to_module``
+    :130-186 wraps ``module.forward``). Idempotent-composable via ``append``."""
+    if append and getattr(module, "_at_hook", None) is not None:
+        old = module._at_hook
+        remove_hook_from_module(module)
+        hook = SequentialHook(old, hook)
+
+    if getattr(module, "_at_old_apply", None) is None:
+        module._at_old_apply = module.apply
+    old_apply = module._at_old_apply
+    module = hook.init_hook(module)
+    module._at_hook = hook
+
+    @functools.wraps(old_apply)
+    def new_apply(params, *args, **kwargs):
+        params, args, kwargs = hook.pre_forward(module, params, args, kwargs)
+        output = old_apply(params, *args, **kwargs)
+        return hook.post_forward(module, output)
+
+    module.apply = new_apply
+    return module
+
+
+def remove_hook_from_module(module, recurse: bool = False):
+    """Restore the original apply (reference ``remove_hook_from_module`` :189-222)."""
+    if getattr(module, "_at_hook", None) is not None:
+        module._at_hook.detach_hook(module)
+        module._at_hook = None
+    if getattr(module, "_at_old_apply", None) is not None:
+        module.apply = module._at_old_apply
+        module._at_old_apply = None
+    return module
+
+
+class AlignDevicesHook(ModelHook):
+    """Move params onto the execution device before forward; optionally release
+    them after (reference ``AlignDevicesHook`` :225-410).
+
+    ``weights_map``: optional lazy host/disk mapping (``OffloadedWeightsLoader``)
+    consulted by name when a leaf is not already device-resident — the offload
+    case. Leaves are placed with ``jax.device_put`` (sharded placement when a
+    NamedSharding is given as ``execution_device``).
+    """
+
+    def __init__(
+        self,
+        execution_device=None,
+        offload: bool = False,
+        io_same_device: bool = False,
+        weights_map: Mapping | None = None,
+        skip_keys=None,
+        place_submodules: bool = True,
+    ):
+        self.execution_device = execution_device
+        self.offload = offload
+        self.io_same_device = io_same_device
+        self.weights_map = weights_map
+        self.skip_keys = skip_keys
+        self.input_device = None
+
+    def pre_forward(self, module, params, args, kwargs):
+        if self.weights_map is not None:
+            from .utils.modeling import named_parameters, unflatten_names
+
+            flat = {}
+            for name, leaf in named_parameters(params).items():
+                if isinstance(leaf, jax.ShapeDtypeStruct) or not isinstance(leaf, jax.Array):
+                    if name in self.weights_map:
+                        flat[name] = np.asarray(self.weights_map[name])
+                        continue
+                flat[name] = leaf
+            params = unflatten_names(flat, params)
+        if self.execution_device is not None:
+            if self.io_same_device:
+                leaves = [x for x in jax.tree_util.tree_leaves((args, kwargs)) if isinstance(x, jax.Array)]
+                self.input_device = leaves[0].sharding if leaves else None
+            params = jax.device_put(params, self.execution_device)
+            args, kwargs = jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, self.execution_device)
+                if isinstance(x, (jax.Array, np.ndarray)) else x,
+                (args, kwargs),
+            )
+        return params, args, kwargs
+
+    def post_forward(self, module, output):
+        if self.io_same_device and self.input_device is not None:
+            output = jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, self.input_device) if isinstance(x, jax.Array) else x,
+                output,
+            )
+        return output
+
+
+class CpuOffload(ModelHook):
+    """Keep params on host between calls; move to device for each forward
+    (reference ``CpuOffload`` :689-714). ``prev_module_hook`` lets chained models
+    (e.g. SD UNet/VAE) evict the previous one when this one runs."""
+
+    def __init__(self, execution_device=None, prev_module_hook=None):
+        self.execution_device = execution_device
+        self.prev_module_hook = prev_module_hook
+
+    def pre_forward(self, module, params, args, kwargs):
+        if self.prev_module_hook is not None:
+            self.prev_module_hook.offload()
+        device = self.execution_device or jax.local_devices()[0]
+        return jax.device_put(params, device), args, kwargs
+
+
+class UserCpuOffloadHook:
+    """User-facing handle pairing a model and its hook (reference
+    ``UserCpuOffloadHook`` :717-739)."""
+
+    def __init__(self, model, hook):
+        self.model = model
+        self.hook = hook
+
+    def offload(self):
+        # Drop device buffers by pulling params back to host numpy.
+        if getattr(self.model, "params", None) is not None:
+            self.model.params = jax.tree_util.tree_map(
+                lambda p: np.asarray(jax.device_get(p)) if isinstance(p, jax.Array) else p,
+                self.model.params,
+            )
+
+    def remove(self):
+        remove_hook_from_module(self.model)
+
+
+class LayerwiseCastingHook(ModelHook):
+    """Store in ``storage_dtype``, compute in ``compute_dtype`` (reference
+    ``LayerwiseCastingHook`` :741-765). The params stay small in HBM; the upcast
+    happens inside the compiled forward and fuses into the first consumer op."""
+
+    def __init__(self, storage_dtype=jnp.float8_e4m3fn, compute_dtype=jnp.bfloat16):
+        self.storage_dtype = storage_dtype
+        self.compute_dtype = compute_dtype
+
+    def init_hook(self, module):
+        if getattr(module, "params", None) is not None:
+            module.params = jax.tree_util.tree_map(
+                lambda p: p.astype(self.storage_dtype)
+                if hasattr(p, "dtype") and jnp.issubdtype(p.dtype, jnp.floating) else p,
+                module.params,
+            )
+        return module
+
+    def pre_forward(self, module, params, args, kwargs):
+        params = jax.tree_util.tree_map(
+            lambda p: p.astype(self.compute_dtype)
+            if hasattr(p, "dtype") and jnp.issubdtype(p.dtype, jnp.floating) else p,
+            params,
+        )
+        return params, args, kwargs

@@ -115,10 +115,6 @@ class BertForSequenceClassification(Module):
         }
         return params
 
-    def init_params(self, rng=None):
-        self.params = self.init(rng if rng is not None else jax.random.key(0))
-        return self.params
-
     def sharding_rules(self):
         return [
             (r"embeddings/word", P("tp", "fsdp")),

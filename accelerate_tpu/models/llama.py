@@ -160,10 +160,6 @@ class Llama(Module):
             params["lm_head"] = {"weight": dense(keys[8], (h, cfg.vocab_size))}
         return params
 
-    def init_params(self, rng=None):
-        self.params = self.init(rng if rng is not None else jax.random.key(0))
-        return self.params
-
     # --------------------------------------------------------------- sharding
     def sharding_rules(self):
         """Megatron-style tp + complementary fsdp. Leading scan dim unsharded."""
@@ -178,6 +174,66 @@ class Llama(Module):
         ]
 
     # ---------------------------------------------------------------- forward
+    # The forward is decomposed into embed/block/head so the same code paths serve
+    # the fused scan (training) and the layer-streamed offloaded-inference runtime
+    # (``big_modeling.StreamedScanModel`` runs ``block`` once per layer with weights
+    # DMA'd in just-in-time).
+    def embed(self, params, input_ids, positions=None, attention_mask=None):
+        """Token embedding + rotary tables. Returns (hidden, ctx)."""
+        cfg = self.config
+        B, S = input_ids.shape
+        x = jnp.take(params["embed"]["weight"], input_ids, axis=0)
+        x = x.astype(params["embed"]["weight"].dtype)
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (B, S))
+        cos, sin = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
+        return x, {"cos": cos, "sin": sin, "attention_mask": attention_mask}
+
+    def block(self, layer, x, ctx):
+        """One decoder layer on the residual stream (runs under scan or streamed)."""
+        cfg = self.config
+        nh, nkv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+        B, S, _ = x.shape
+        cos, sin = ctx["cos"], ctx["sin"]
+        h = rms_norm(x, layer["input_norm"]["weight"], cfg.rms_norm_eps)
+        q = (h @ layer["attn"]["wq"]).reshape(B, S, nh, hd)
+        k = (h @ layer["attn"]["wk"]).reshape(B, S, nkv, hd)
+        v = (h @ layer["attn"]["wv"]).reshape(B, S, nkv, hd)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        if nkv != nh:
+            rep = nh // nkv
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        attn_out = _attention(
+            q, k, v, causal=True, mask=ctx["attention_mask"], impl=cfg.attention_impl
+        ).reshape(B, S, nh * hd)
+        x = x + attn_out @ layer["attn"]["wo"]
+        h2 = rms_norm(x, layer["post_attn_norm"]["weight"], cfg.rms_norm_eps)
+        gated = jax.nn.silu(h2 @ layer["mlp"]["w_gate"]) * (h2 @ layer["mlp"]["w_up"])
+        x = x + gated @ layer["mlp"]["w_down"]
+        return x
+
+    def head(self, params, x, labels=None, attention_mask=None):
+        """Final norm + LM head (+ shifted-label loss)."""
+        cfg = self.config
+        x = rms_norm(x, params["final_norm"]["weight"], cfg.rms_norm_eps)
+        if cfg.tie_word_embeddings:
+            logits = x @ params["embed"]["weight"].T.astype(x.dtype)
+        else:
+            logits = x @ params["lm_head"]["weight"]
+        out = ModelOutput(logits=logits)
+        if labels is not None:
+            B = labels.shape[0]
+            # Shift: predict token t+1 from position t; final position has no target.
+            shifted = jnp.concatenate(
+                [labels[:, 1:], jnp.full((B, 1), -100, labels.dtype)], axis=1
+            )
+            if attention_mask is not None:
+                shifted = jnp.where(attention_mask.astype(bool), shifted, -100)
+            out["loss"] = cross_entropy_loss(logits, shifted)
+        return out
+
     def apply(
         self,
         params,
@@ -190,63 +246,18 @@ class Llama(Module):
         **kwargs,
     ):
         cfg = self.config
-        B, S = input_ids.shape
-        x = jnp.take(params["embed"]["weight"], input_ids, axis=0)
-        compute_dtype = params["embed"]["weight"].dtype
-        x = x.astype(compute_dtype)
+        x, ctx = self.embed(params, input_ids, positions, attention_mask)
 
-        if positions is None:
-            positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (B, S))
-        cos, sin = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
-
-        nh, nkv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
-
-        def block(x, layer):
-            h = rms_norm(x, layer["input_norm"]["weight"], cfg.rms_norm_eps)
-            q = (h @ layer["attn"]["wq"]).reshape(B, S, nh, hd)
-            k = (h @ layer["attn"]["wk"]).reshape(B, S, nkv, hd)
-            v = (h @ layer["attn"]["wv"]).reshape(B, S, nkv, hd)
-            q = apply_rope(q, cos, sin)
-            k = apply_rope(k, cos, sin)
-            if nkv != nh:
-                rep = nh // nkv
-                k = jnp.repeat(k, rep, axis=2)
-                v = jnp.repeat(v, rep, axis=2)
-            attn_out = _attention(
-                q, k, v, causal=True, mask=attention_mask, impl=cfg.attention_impl
-            ).reshape(B, S, nh * hd)
-            x = x + attn_out @ layer["attn"]["wo"]
-            h2 = rms_norm(x, layer["post_attn_norm"]["weight"], cfg.rms_norm_eps)
-            gated = jax.nn.silu(h2 @ layer["mlp"]["w_gate"]) * (h2 @ layer["mlp"]["w_up"])
-            x = x + gated @ layer["mlp"]["w_down"]
-            return x
-
-        body = block
+        body = lambda x, layer: self.block(layer, x, ctx)
         if cfg.remat:
             policy = getattr(jax.checkpoint_policies, cfg.remat_policy)
-            body = jax.checkpoint(block, policy=policy)
+            body = jax.checkpoint(body, policy=policy)
 
         def scan_step(x, layer):
             return body(x, layer), None
 
         x, _ = jax.lax.scan(scan_step, x, params["layers"])
-        x = rms_norm(x, params["final_norm"]["weight"], cfg.rms_norm_eps)
-
-        if cfg.tie_word_embeddings:
-            logits = x @ params["embed"]["weight"].T.astype(compute_dtype)
-        else:
-            logits = x @ params["lm_head"]["weight"]
-
-        out = ModelOutput(logits=logits)
-        if labels is not None:
-            # Shift: predict token t+1 from position t; final position has no target.
-            shifted = jnp.concatenate(
-                [labels[:, 1:], jnp.full((B, 1), -100, labels.dtype)], axis=1
-            )
-            if attention_mask is not None:
-                shifted = jnp.where(attention_mask.astype(bool), shifted, -100)
-            out["loss"] = cross_entropy_loss(logits, shifted)
-        return out
+        return self.head(params, x, labels=labels, attention_mask=attention_mask)
 
     # -------------------------------------------------------------- estimation
     def num_params(self) -> int:

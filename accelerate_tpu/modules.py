@@ -60,6 +60,24 @@ class Module:
     def apply(self, params, *args, train: bool = False, rngs=None, **kwargs):
         raise NotImplementedError
 
+    def init_params(self, rng=None, *example_inputs, **kwargs):
+        """Materialize (or, under ``init_empty_weights``, abstractly shape) the
+        parameter pytree and remember it on the model object.
+
+        Under the ``big_modeling.init_empty_weights`` context the tree's leaves are
+        ``jax.ShapeDtypeStruct`` — zero memory, the analog of the reference's
+        meta-device allocation (``big_modeling.py:61-170`` there).
+        """
+        if rng is None:
+            rng = jax.random.key(0)
+        from .big_modeling import _empty_init_active
+
+        if _empty_init_active():
+            self.params = jax.eval_shape(self.init, rng, *example_inputs, **kwargs)
+        else:
+            self.params = self.init(rng, *example_inputs, **kwargs)
+        return self.params
+
     # Optional: logical sharding rules {param-path-regex: PartitionSpec-template}
     # consumed by parallel/sharding.py. Default: automatic rules by shape.
     def sharding_rules(self):

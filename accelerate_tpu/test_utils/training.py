@@ -41,10 +41,6 @@ class RegressionModel(Module):
     def init(self, rng, *example_inputs, **kwargs):
         return {"a": jnp.asarray(self.a0, jnp.float32), "b": jnp.asarray(self.b0, jnp.float32)}
 
-    def init_params(self, rng=None):
-        self.params = self.init(rng)
-        return self.params
-
     def apply(self, params, x=None, y=None, train: bool = False, rngs=None, **kwargs):
         pred = params["a"] * x + params["b"]
         out = ModelOutput(prediction=pred)

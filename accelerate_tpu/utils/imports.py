@@ -1,0 +1,177 @@
+"""Availability probes.
+
+Reference parity: ``src/accelerate/utils/imports.py`` (542 LoC of ``is_*_available``
+probes, :61-250+). The TPU build's dependency surface is much smaller — JAX is the
+substrate, not an optional backend — so probes cover the libraries this framework
+can *optionally* use, and GPU-era probes exist as honest ``False`` parity slots.
+"""
+
+from __future__ import annotations
+
+import functools
+import importlib.metadata
+import importlib.util
+
+
+@functools.lru_cache(maxsize=None)
+def _is_package_available(pkg_name: str, metadata_name: str | None = None) -> bool:
+    if importlib.util.find_spec(pkg_name) is None:
+        return False
+    try:
+        importlib.metadata.version(metadata_name or pkg_name)
+        return True
+    except importlib.metadata.PackageNotFoundError:
+        # Namespace/source-only packages have a spec but no dist metadata.
+        return True
+
+
+def is_jax_available() -> bool:
+    return _is_package_available("jax")
+
+
+def is_flax_available() -> bool:
+    return _is_package_available("flax")
+
+
+def is_optax_available() -> bool:
+    return _is_package_available("optax")
+
+
+def is_orbax_available() -> bool:
+    return _is_package_available("orbax")
+
+
+def is_chex_available() -> bool:
+    return _is_package_available("chex")
+
+
+def is_torch_available() -> bool:
+    return _is_package_available("torch")
+
+
+def is_transformers_available() -> bool:
+    return _is_package_available("transformers")
+
+
+def is_datasets_available() -> bool:
+    return _is_package_available("datasets")
+
+
+def is_safetensors_available() -> bool:
+    return _is_package_available("safetensors")
+
+
+def is_einops_available() -> bool:
+    return _is_package_available("einops")
+
+
+def is_torchdata_stateful_dataloader_available() -> bool:
+    if not _is_package_available("torchdata"):
+        return False
+    try:
+        from torchdata.stateful_dataloader import StatefulDataLoader  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+def is_tpu_available(check_device: bool = True) -> bool:
+    """Whether a real TPU backend is reachable (reference ``is_torch_xla_available``)."""
+    if not is_jax_available():
+        return False
+    if not check_device:
+        return True
+    import jax
+
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def is_pallas_available() -> bool:
+    """Whether jax.experimental.pallas imports (the custom-kernel path)."""
+    try:
+        from jax.experimental import pallas  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+# Tracker backends (reference tracking.py guards on these).
+def is_tensorboard_available() -> bool:
+    return _is_package_available("tensorboardX") or _is_package_available(
+        "tensorboard", "tensorboard"
+    )
+
+
+def is_wandb_available() -> bool:
+    return _is_package_available("wandb")
+
+
+def is_comet_ml_available() -> bool:
+    return _is_package_available("comet_ml")
+
+
+def is_mlflow_available() -> bool:
+    return _is_package_available("mlflow")
+
+
+def is_aim_available() -> bool:
+    return _is_package_available("aim")
+
+
+def is_clearml_available() -> bool:
+    return _is_package_available("clearml")
+
+
+def is_dvclive_available() -> bool:
+    return _is_package_available("dvclive")
+
+
+def is_rich_available() -> bool:
+    return _is_package_available("rich")
+
+
+def is_tqdm_available() -> bool:
+    return _is_package_available("tqdm")
+
+
+def is_pandas_available() -> bool:
+    return _is_package_available("pandas")
+
+
+def is_matplotlib_available() -> bool:
+    return _is_package_available("matplotlib")
+
+
+# GPU-era parity slots: these backends do not exist in the TPU stack. Honest False
+# keeps downstream feature-gating code portable from the reference ecosystem.
+def is_cuda_available() -> bool:
+    return False
+
+
+def is_deepspeed_available() -> bool:
+    return False
+
+
+def is_megatron_lm_available() -> bool:
+    return False
+
+
+def is_bnb_available() -> bool:
+    return False
+
+
+def is_transformer_engine_available() -> bool:
+    return False
+
+
+def is_msamp_available() -> bool:
+    return False
+
+
+def is_torchao_available() -> bool:
+    return False

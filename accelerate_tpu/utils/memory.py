@@ -1,0 +1,105 @@
+"""OOM-resilient execution helpers.
+
+Reference parity: ``src/accelerate/utils/memory.py`` — ``find_executable_batch_size``
+(:119-183), ``release_memory`` (:70), ``clear_device_cache`` (:43). The reference
+retries on CUDA OOM; on TPU the equivalent failure is an ``XlaRuntimeError`` whose
+message carries ``RESOURCE_EXHAUSTED`` (HBM oversubscription detected at compile or
+run time). The retry loop halves the batch size exactly like the reference.
+"""
+
+from __future__ import annotations
+
+import functools
+import gc
+import inspect
+
+import jax
+
+
+def clear_device_cache(garbage_collection: bool = False) -> None:
+    """Drop cached compiled programs and (optionally) force a GC pass.
+
+    Reference ``clear_device_cache`` :43-67 calls per-backend ``empty_cache``; XLA has
+    no user-managed allocator cache, but dropping dead compilation-cache entries and
+    deleted-array references frees HBM held by live executables' donated aliases.
+    """
+    if garbage_collection:
+        gc.collect()
+    try:
+        jax.clear_caches()
+    except Exception:  # pragma: no cover - defensive; clear_caches is best-effort
+        pass
+
+
+def release_memory(*objects):
+    """Drop references and clear caches; returns Nones in place of the inputs
+    (reference ``release_memory`` :70-101 usage: ``a, b = release_memory(a, b)``)."""
+    if not isinstance(objects, list):
+        objects = list(objects)
+    for i in range(len(objects)):
+        objects[i] = None
+    clear_device_cache(garbage_collection=True)
+    return objects
+
+
+def is_oom_exception(exception: BaseException) -> bool:
+    """Whether an exception is an HBM/RAM exhaustion we can retry past.
+
+    Reference ``should_reduce_batch_size`` :104-116 string-matches CUDA/CPU OOM; the
+    XLA analogs are RESOURCE_EXHAUSTED statuses and allocation-failure messages.
+    """
+    statuses = (
+        "RESOURCE_EXHAUSTED",
+        "Out of memory",
+        "out of memory",
+        "Attempting to allocate",
+        "Failed to allocate",
+    )
+    if isinstance(exception, MemoryError):
+        return True
+    msg = str(exception)
+    return isinstance(exception, Exception) and any(s in msg for s in statuses)
+
+
+def find_executable_batch_size(function=None, starting_batch_size: int = 128):
+    """Decorator retrying ``function(batch_size, ...)`` with halved batch sizes on OOM.
+
+    Mirrors reference :119-183 including the introspective error when the wrapped
+    function doesn't take ``batch_size`` first. Each retry clears device caches so a
+    previous attempt's compiled executable doesn't hold the HBM that made it fail.
+    """
+    if function is None:
+        return functools.partial(find_executable_batch_size, starting_batch_size=starting_batch_size)
+
+    batch_size_box = [starting_batch_size]
+
+    @functools.wraps(function)
+    def wrapper(*args, **kwargs):
+        nonlocal batch_size_box
+        batch_size_box[0] = starting_batch_size
+        clear_device_cache(garbage_collection=True)
+        params = list(inspect.signature(function).parameters.keys())
+        if len(params) < (1 + len(args)) or params[0] != "batch_size":
+            arg_str = ", ".join([f"{arg}={value}" for arg, value in zip(params[1:], args[1:])])
+            raise TypeError(
+                f"Batch size was passed into `{function.__name__}` as the first argument "
+                f"when called.\nRemove this as the decorator already does so: "
+                f"`{function.__name__}({arg_str})`"
+            )
+        while True:
+            if batch_size_box[0] == 0:
+                raise RuntimeError("No executable batch size found, reached zero.")
+            try:
+                return function(batch_size_box[0], *args, **kwargs)
+            except Exception as e:
+                if is_oom_exception(e):
+                    clear_device_cache(garbage_collection=True)
+                    batch_size_box[0] //= 2
+                else:
+                    raise
+
+    return wrapper
+
+
+def get_xpu_available_memory():  # pragma: no cover - parity stub
+    raise NotImplementedError("XPU is not a TPU-framework target")

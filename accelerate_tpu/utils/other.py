@@ -1,0 +1,158 @@
+"""Miscellaneous utilities.
+
+Reference parity: ``src/accelerate/utils/other.py`` — ``save``/``load`` (:330-411),
+``extract_model_from_parallel`` (:197-280), ``convert_bytes`` (:467),
+``check_os_kernel`` (:477), ``merge_dicts``, ``is_port_in_use``. Torch-specific
+pieces (``wait_for_everyone`` re-export, TE recipe handling) live elsewhere here.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import pickle
+import platform
+import re
+import socket
+from pathlib import Path
+
+import numpy as np
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+
+def extract_model_from_parallel(model, keep_fp32_wrapper: bool = True, recursive: bool = False):
+    """Peel framework wrappers off a model (reference :197-280 unwraps DDP/FSDP/
+    compiled modules). Here the only wrapper is ``PreparedModel``."""
+    from ..accelerator import PreparedModel
+
+    while isinstance(model, PreparedModel):
+        model = model.module
+    return model
+
+
+def save(obj, f, save_on_each_node: bool = False, safe_serialization: bool = False):
+    """Save ``obj`` only on the main process (per node if ``save_on_each_node``),
+    mirroring reference ``save`` :330-364. Arrays are materialized to host first.
+
+    With ``safe_serialization`` a flat dict of arrays is written as safetensors;
+    otherwise pickle (covering arbitrary Python state, like the reference's
+    ``torch.save`` default path).
+    """
+    from ..state import PartialState
+
+    state = PartialState()
+    obj = jax.tree_util.tree_map(
+        lambda x: np.asarray(jax.device_get(x)) if isinstance(x, jax.Array) else x, obj
+    )
+    should = state.is_local_main_process if save_on_each_node else state.is_main_process
+    if not should:
+        return
+    if safe_serialization:
+        from safetensors.numpy import save_file
+
+        from ..checkpointing import _flatten_params
+
+        save_file(_flatten_params(obj), f, metadata={"format": "np"})
+    else:
+        with open(f, "wb") as fh:
+            pickle.dump(obj, fh)
+
+
+def load(f, map_location=None, **kwargs):
+    """Load a file written by :func:`save` (reference ``load`` :367-411)."""
+    f = str(f)
+    if f.endswith(".safetensors"):
+        from safetensors.numpy import load_file
+
+        return load_file(f)
+    with open(f, "rb") as fh:
+        return pickle.load(fh)
+
+
+def merge_dicts(source: dict, destination: dict) -> dict:
+    """Recursively merge ``source`` into ``destination`` (reference :446-464)."""
+    for key, value in source.items():
+        if isinstance(value, dict):
+            node = destination.setdefault(key, {})
+            merge_dicts(value, node)
+        else:
+            destination[key] = value
+    return destination
+
+
+def is_port_in_use(port: int | str | None = None) -> bool:
+    """Reference :451-458 — used by the launcher to pick a free coordinator port."""
+    if port is None:
+        port = 29500
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        return s.connect_ex(("localhost", int(port))) == 0
+
+
+def convert_bytes(size: float) -> str:
+    """Human-readable bytes (reference :467-474): 1024 -> '1.0 KB'."""
+    for unit in ["bytes", "KB", "MB", "GB", "TB"]:
+        if size < 1024.0:
+            return f"{round(size, 2)} {unit}"
+        size /= 1024.0
+    return f"{round(size, 2)} PB"
+
+
+def check_os_kernel():
+    """Warn on Linux kernels < 5.5 (reference :477-494: pre-5.5 kernels hang
+    multi-host rendezvous)."""
+    info = platform.uname()
+    if info.system != "Linux":
+        return
+    _, version, *_ = re.split(r"(\d+\.\d+\.\d+)", info.release)
+    major, minor, _ = (int(x) for x in version.split("."))
+    if (major, minor) < (5, 5):
+        logger.warning(
+            "Detected kernel version %s, which is below the recommended minimum of 5.5.0; "
+            "this can cause the process to hang.",
+            version,
+        )
+
+
+def write_basic_config(mixed_precision: str = "no", save_location: str | None = None):
+    """Create a minimal default config yaml non-interactively (reference
+    ``utils/other.py:414-443``) — used by notebook/CI setups."""
+    from ..commands.config.config_args import ClusterConfig, default_config_file
+
+    path = Path(save_location) if save_location is not None else default_config_file()
+    if path.exists():
+        logger.warning("Config file already exists at %s; skipping.", path)
+        return False
+    path.parent.mkdir(parents=True, exist_ok=True)
+    config = ClusterConfig(
+        compute_environment="LOCAL_MACHINE",
+        distributed_type="JAX_TPU",
+        mixed_precision=mixed_precision,
+        num_processes=1,
+    )
+    config.to_yaml_file(path)
+    return path
+
+
+def get_pretty_name(obj) -> str:
+    """Best-effort display name for checkpoint registration (reference :497-508)."""
+    if not hasattr(obj, "__qualname__") and not hasattr(obj, "__name__"):
+        obj = getattr(obj, "__class__", obj)
+    if hasattr(obj, "__qualname__"):
+        return obj.__qualname__
+    if hasattr(obj, "__name__"):
+        return obj.__name__
+    return str(obj)
+
+
+def save_json(obj, path: str | os.PathLike, indent: int = 2) -> None:
+    with open(path, "w") as fh:
+        json.dump(obj, fh, indent=indent, sort_keys=True)
+
+
+def load_json(path: str | os.PathLike):
+    with open(path) as fh:
+        return json.load(fh)

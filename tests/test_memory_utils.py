@@ -1,0 +1,125 @@
+"""Memory/OOM-retry + misc utils tests (reference: ``tests/test_memory_utils.py``,
+``tests/test_utils.py``)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from accelerate_tpu.utils.memory import (
+    clear_device_cache,
+    find_executable_batch_size,
+    is_oom_exception,
+    release_memory,
+)
+from accelerate_tpu.utils.other import convert_bytes, get_pretty_name, is_port_in_use, merge_dicts
+
+
+def _oom():
+    raise RuntimeError("RESOURCE_EXHAUSTED: Out of memory while trying to allocate 1 bytes.")
+
+
+def test_find_executable_batch_size_halves():
+    sizes = []
+
+    @find_executable_batch_size(starting_batch_size=128)
+    def run(batch_size):
+        sizes.append(batch_size)
+        if batch_size > 16:
+            _oom()
+        return batch_size
+
+    assert run() == 16
+    assert sizes == [128, 64, 32, 16]
+
+
+def test_find_executable_batch_size_passes_args():
+    @find_executable_batch_size(starting_batch_size=8)
+    def run(batch_size, a, b=2):
+        return batch_size + a + b
+
+    assert run(1, b=3) == 12
+
+
+def test_find_executable_batch_size_rejects_explicit_batch():
+    @find_executable_batch_size(starting_batch_size=8)
+    def run(batch_size, a):
+        return batch_size
+
+    with pytest.raises(TypeError):
+        run(4, 5)
+
+
+def test_find_executable_batch_size_exhausts():
+    @find_executable_batch_size(starting_batch_size=2)
+    def run(batch_size):
+        _oom()
+
+    with pytest.raises(RuntimeError, match="No executable batch size"):
+        run()
+
+
+def test_non_oom_errors_propagate():
+    @find_executable_batch_size(starting_batch_size=4)
+    def run(batch_size):
+        raise ValueError("unrelated")
+
+    with pytest.raises(ValueError, match="unrelated"):
+        run()
+
+
+def test_is_oom_exception():
+    assert is_oom_exception(RuntimeError("RESOURCE_EXHAUSTED: ..."))
+    assert is_oom_exception(MemoryError())
+    assert not is_oom_exception(ValueError("nope"))
+
+
+def test_release_memory():
+    a, b = np.ones(4), np.ones(4)
+    a, b = release_memory(a, b)
+    assert a is None and b is None
+    clear_device_cache(garbage_collection=True)
+
+
+def test_convert_bytes():
+    assert convert_bytes(1024) == "1.0 KB"
+    assert convert_bytes(5_000_000) == "4.77 MB"
+    assert convert_bytes(10) == "10 bytes"
+
+
+def test_merge_dicts():
+    assert merge_dicts({"a": {"b": 1}}, {"a": {"c": 2}, "d": 3}) == {"a": {"b": 1, "c": 2}, "d": 3}
+
+
+def test_get_pretty_name():
+    class Foo:
+        pass
+
+    assert get_pretty_name(Foo) .endswith("Foo")
+    assert get_pretty_name(Foo()).endswith("Foo")
+
+
+def test_is_port_in_use():
+    assert isinstance(is_port_in_use(19999), bool)
+
+
+def test_local_sgd_roundtrip():
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.local_sgd import LocalSGD
+    from accelerate_tpu.test_utils.training import RegressionDataset, RegressionModel
+
+    accelerator = Accelerator()
+    model = RegressionModel()
+    model.init_params()
+    pmodel, opt = accelerator.prepare(model, optax.sgd(0.1))
+    with LocalSGD(accelerator=accelerator, model=pmodel, local_sgd_steps=2) as lsgd:
+        for step in range(4):
+            batch = {"x": np.ones((4,), np.float32), "y": np.full((4,), 2.0, np.float32)}
+            out = pmodel(**batch)
+            accelerator.backward(out.loss)
+            opt.step()
+            opt.zero_grad()
+            lsgd.step()
+    assert float(np.asarray(pmodel.params["a"])) != 0.0
