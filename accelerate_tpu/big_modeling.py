@@ -359,6 +359,15 @@ class StreamedScanModel:
         return self._head_fn(nonlayer, x, labels, attention_mask)
 
     def apply(self, params, *args, **kwargs):
+        if params is not None and params is not self.model.params:
+            # Honor the Module.apply(params, ...) contract: run with the caller's
+            # tree (layers still stream from it / the weights_map per slice).
+            saved = self.model.params
+            self.model.params = params
+            try:
+                return self(*args, **kwargs)
+            finally:
+                self.model.params = saved
         return self(*args, **kwargs)
 
     def eval(self):

@@ -227,11 +227,28 @@ def parse_shard_size(max_shard_size) -> int:
 def save_model(accelerator, model, save_directory, max_shard_size="10GB", safe_serialization=True):
     """Consolidated safetensors export with HF-compatible sharding/index
     (reference ``save_model`` :3117-3227)."""
-    os.makedirs(save_directory, exist_ok=True)
     params = accelerator.get_state_dict(model)  # host numpy tree
-    flat = _flatten_params(params)
     if not accelerator.is_main_process:
         accelerator.wait_for_everyone()
+        return
+    export_full_weights(params, save_directory, max_shard_size=max_shard_size,
+                        safe_serialization=safe_serialization)
+    accelerator.wait_for_everyone()
+
+
+def export_full_weights(params, save_directory, max_shard_size="10GB", safe_serialization=True):
+    """Write a consolidated weight export from a (host) param tree — the shared
+    engine behind ``save_model`` and `accelerate-tpu merge-weights` (reference
+    ``merge_fsdp_weights`` fsdp_utils.py:354-407)."""
+    os.makedirs(save_directory, exist_ok=True)
+    flat = _flatten_params(params)
+    if not safe_serialization:
+        from flax import serialization
+
+        from .utils.constants import WEIGHTS_NAME
+
+        with open(os.path.join(save_directory, WEIGHTS_NAME), "wb") as f:
+            f.write(serialization.msgpack_serialize({k: np.asarray(v) for k, v in flat.items()}))
         return
     limit = parse_shard_size(max_shard_size)
     shards, current, size = [], {}, 0
@@ -258,7 +275,6 @@ def save_model(accelerator, model, save_directory, max_shard_size="10GB", safe_s
                 index["weight_map"][key] = name
         with open(os.path.join(save_directory, SAFE_WEIGHTS_INDEX_NAME), "w") as f:
             json.dump(index, f, indent=2)
-    accelerator.wait_for_everyone()
 
 
 def load_model_weights(save_directory, template_params):
@@ -273,8 +289,14 @@ def load_model_weights(save_directory, template_params):
         index = json.loads(index_file.read_text())
         for name in sorted(set(index["weight_map"].values())):
             flat.update(load_file(save_directory / name))
-    else:
+    elif (save_directory / SAFE_WEIGHTS_NAME).is_file():
         flat.update(load_file(save_directory / SAFE_WEIGHTS_NAME))
+    else:
+        from flax import serialization
+
+        from .utils.constants import WEIGHTS_NAME
+
+        flat.update(serialization.msgpack_restore((save_directory / WEIGHTS_NAME).read_bytes()))
 
     from .parallel.sharding import path_str
 

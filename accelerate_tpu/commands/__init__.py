@@ -1,0 +1,8 @@
+"""Command-line interface — the L7 layer.
+
+Reference parity: ``src/accelerate/commands/accelerate_cli.py:28-50`` registers
+subcommands {config, env, launch, test, estimate-memory, merge-weights, tpu}.
+Here the same verbs exist but the launcher speaks the JAX multi-host contract
+(one process per host, ``jax.distributed.initialize`` rendezvous) instead of
+torchrun/NCCL.
+"""

@@ -1,0 +1,39 @@
+"""`accelerate-tpu` — top-level CLI dispatcher.
+
+Reference parity: ``src/accelerate/commands/accelerate_cli.py:28-50``.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from .config import config_command_parser
+from .env import env_command_parser
+from .estimate import estimate_command_parser
+from .launch import launch_command_parser
+from .merge import merge_command_parser
+from .test import test_command_parser
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(
+        "accelerate-tpu", usage="accelerate-tpu <command> [<args>]", allow_abbrev=False
+    )
+    subparsers = parser.add_subparsers(help="accelerate-tpu command helpers")
+
+    config_command_parser(subparsers=subparsers)
+    env_command_parser(subparsers=subparsers)
+    launch_command_parser(subparsers=subparsers)
+    estimate_command_parser(subparsers=subparsers)
+    merge_command_parser(subparsers=subparsers)
+    test_command_parser(subparsers=subparsers)
+
+    args = parser.parse_args()
+    if not hasattr(args, "func"):
+        parser.print_help()
+        raise SystemExit(1)
+    args.func(args)
+
+
+if __name__ == "__main__":
+    main()

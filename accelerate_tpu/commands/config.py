@@ -1,0 +1,142 @@
+"""`accelerate-tpu config` — interactive wizard + `--default` quick path.
+
+Reference parity: ``src/accelerate/commands/config/cluster.py:57`` (an 869-LoC
+questionnaire) and ``config/default.py``. The TPU build asks the questions that
+matter on a pod: topology (hosts/coordinator), mesh axis sizes (dp/fsdp/tp/pp/sp),
+and precision — there are no NCCL/fsdp/deepspeed backend menus because those
+choices collapse into mesh shape under GSPMD.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from .config_args import ClusterConfig, default_config_file
+
+
+def _ask(prompt: str, default, cast=str, choices=None):
+    suffix = f" [{default}]" if default is not None else ""
+    while True:
+        raw = input(f"{prompt}{suffix}: ").strip()
+        if not raw:
+            return default
+        try:
+            val = cast(raw)
+        except (TypeError, ValueError):
+            print(f"  invalid value {raw!r}, expected {cast.__name__}")
+            continue
+        if choices is not None and val not in choices:
+            print(f"  choose one of {choices}")
+            continue
+        return val
+
+
+def _yesno(prompt: str, default: bool = False) -> bool:
+    raw = input(f"{prompt} [{'yes' if default else 'no'}]: ").strip().lower()
+    if not raw:
+        return default
+    return raw in ("y", "yes", "true", "1")
+
+
+def get_user_input() -> ClusterConfig:
+    """The wizard (reference ``cluster.py:57`` `get_cluster_input`)."""
+    compute_env = _ask(
+        "In which compute environment are you running? (LOCAL_MACHINE/TPU_POD)",
+        "LOCAL_MACHINE",
+        str,
+        ["LOCAL_MACHINE", "TPU_POD"],
+    )
+    use_cpu = _yesno("Do you want to run your training on CPU only (e.g. for debugging)?", False)
+    distributed_type = "MULTI_CPU" if use_cpu else "JAX_TPU"
+    num_machines, machine_rank, ip, port = 1, 0, None, None
+    if compute_env == "TPU_POD":
+        num_machines = _ask("How many hosts are in your TPU pod slice?", 1, int)
+        if num_machines > 1:
+            machine_rank = _ask("What is the rank of this host?", 0, int)
+            ip = _ask("What is the IP address of the host that will run the JAX coordinator?", "127.0.0.1")
+            port = _ask("What is the port the coordinator will listen on?", 8476, int)
+    cpu_virtual = 0
+    if use_cpu:
+        cpu_virtual = _ask(
+            "How many virtual devices should the CPU host expose (xla_force_host_platform_device_count)?",
+            8,
+            int,
+        )
+    print("Mesh axis sizes (1 disables an axis; dp=0 lets dp absorb all remaining devices):")
+    dp = _ask("  data-parallel (dp) size", 0, int)
+    fsdp = _ask("  fully-sharded (fsdp/ZeRO) size", 1, int)
+    tp = _ask("  tensor-parallel (tp) size", 1, int)
+    pp = _ask("  pipeline-parallel (pp) size", 1, int)
+    sp = _ask("  sequence-parallel (sp) size", 1, int)
+    mixed_precision = _ask(
+        "Do you wish to use mixed precision? (no/bf16/fp16)", "bf16", str, ["no", "bf16", "fp16"]
+    )
+    return ClusterConfig(
+        compute_environment=compute_env,
+        distributed_type=distributed_type,
+        num_machines=num_machines,
+        machine_rank=machine_rank,
+        num_processes=max(num_machines, 1),
+        main_process_ip=ip,
+        main_process_port=port,
+        mixed_precision=mixed_precision,
+        use_cpu=use_cpu,
+        cpu_virtual_devices=cpu_virtual,
+        dp_size=dp,
+        fsdp_size=fsdp,
+        tp_size=tp,
+        pp_size=pp,
+        sp_size=sp,
+    )
+
+
+def write_default_config(path: str | None = None) -> str:
+    """`accelerate-tpu config --default` (reference ``config/default.py:28-107``)."""
+    cfg = ClusterConfig()
+    path = path or default_config_file
+    if path.endswith(".json"):
+        cfg.to_json_file(path)
+    else:
+        cfg.to_yaml_file(path)
+    return path
+
+
+def config_command_parser(subparsers=None) -> argparse.ArgumentParser:
+    description = "Create a launch configuration for accelerate-tpu"
+    if subparsers is not None:
+        parser = subparsers.add_parser("config", description=description)
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu config", description=description)
+    parser.add_argument(
+        "--config_file",
+        default=None,
+        help=f"Where to save the config (default: {default_config_file})",
+    )
+    parser.add_argument(
+        "--default", action="store_true", help="Write the default config without prompting"
+    )
+    if subparsers is not None:
+        parser.set_defaults(func=config_command)
+    return parser
+
+
+def config_command(args) -> None:
+    if args.default:
+        path = write_default_config(args.config_file)
+    else:
+        cfg = get_user_input()
+        path = args.config_file or default_config_file
+        if path.endswith(".json"):
+            cfg.to_json_file(path)
+        else:
+            cfg.to_yaml_file(path)
+    print(f"accelerate-tpu configuration saved at {path}")
+
+
+def main() -> None:  # pragma: no cover - thin shim
+    parser = config_command_parser()
+    config_command(parser.parse_args())
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

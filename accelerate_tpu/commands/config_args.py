@@ -1,0 +1,110 @@
+"""Config-file dataclasses + yaml round-trip.
+
+Reference parity: ``src/accelerate/commands/config/config_args.py:44-252`` —
+``BaseConfig``/``ClusterConfig`` persisted as yaml at
+``~/.cache/huggingface/accelerate/default_config.yaml`` (:30-41). Same idea here
+with TPU-pod fields: mesh axis sizes instead of fsdp/deepspeed plugin blobs, and
+a JAX coordinator address instead of MASTER_ADDR/PORT.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import asdict, dataclass, field
+
+from ..utils.constants import DEFAULT_CONFIG_FILE, DEFAULT_CONFIG_FOLDER
+
+try:
+    import yaml
+
+    _HAS_YAML = True
+except Exception:  # pragma: no cover - yaml ships with the image
+    _HAS_YAML = False
+
+cache_home = os.environ.get(
+    "ACCELERATE_TPU_HOME",
+    os.path.join(os.environ.get("XDG_CACHE_HOME", os.path.join(os.path.expanduser("~"), ".cache")), DEFAULT_CONFIG_FOLDER),
+)
+default_config_file = os.path.join(cache_home, DEFAULT_CONFIG_FILE)
+
+
+def load_config_from_file(config_file: str | None):
+    """Load a ClusterConfig from yaml/json (reference ``config_args.py:44-75``)."""
+    path = config_file if config_file is not None else default_config_file
+    if config_file is not None and not os.path.isfile(path):
+        raise FileNotFoundError(
+            f"The passed configuration file `{path}` does not exist. "
+            "Run `accelerate-tpu config` to create one."
+        )
+    if not os.path.isfile(path):
+        return None
+    with open(path, encoding="utf-8") as f:
+        if path.endswith(".json"):
+            data = json.load(f)
+        else:
+            if not _HAS_YAML:
+                raise ImportError("pyyaml is required to read yaml config files")
+            data = yaml.safe_load(f)
+    if data is None:
+        return None
+    known = {f_.name for f_ in ClusterConfig.__dataclass_fields__.values()}
+    extras = {k: v for k, v in data.items() if k not in known}
+    kept = {k: v for k, v in data.items() if k in known}
+    cfg = ClusterConfig(**kept)
+    cfg.extra = extras
+    return cfg
+
+
+@dataclass
+class ClusterConfig:
+    """One host-cluster launch configuration (reference ``ClusterConfig`` :116-252)."""
+
+    compute_environment: str = "LOCAL_MACHINE"
+    distributed_type: str = "JAX_TPU"  # JAX_TPU | MULTI_CPU | NO
+    num_machines: int = 1
+    machine_rank: int = 0
+    num_processes: int = 1  # processes per launch on this machine (CPU sim) or total hosts
+    main_process_ip: str | None = None
+    main_process_port: int | None = None
+    mixed_precision: str = "no"  # no | bf16 | fp16
+    use_cpu: bool = False
+    debug: bool = False
+    # Mesh axis sizes; 0/1 = unused axis. The launcher exports these as
+    # ACCELERATE_MESH_SHAPE for AcceleratorState to build the default mesh.
+    dp_size: int = 0  # 0 → infer (fill remaining devices)
+    fsdp_size: int = 1
+    tp_size: int = 1
+    pp_size: int = 1
+    sp_size: int = 1
+    # Host-side virtual device count for CPU simulation (xla_force_host_platform_device_count)
+    cpu_virtual_devices: int = 0
+    downcast_bf16: bool = False
+    extra: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        d = asdict(self)
+        extras = d.pop("extra", {}) or {}
+        d.update(extras)
+        return {k: v for k, v in d.items() if v is not None}
+
+    def to_yaml_file(self, path: str) -> None:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w", encoding="utf-8") as f:
+            if _HAS_YAML:
+                yaml.safe_dump(self.to_dict(), f, sort_keys=True)
+            else:  # pragma: no cover
+                json.dump(self.to_dict(), f, indent=2)
+
+    def to_json_file(self, path: str) -> None:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(self.to_dict(), f, indent=2)
+
+    def mesh_shape_env(self) -> str:
+        """Serialize mesh axes for ACCELERATE_MESH_SHAPE (`axis:size,...`)."""
+        axes = []
+        for name in ("pp", "dp", "fsdp", "sp", "tp"):
+            size = getattr(self, f"{name}_size")
+            axes.append(f"{name}:{size}")
+        return ",".join(axes)

@@ -1,0 +1,74 @@
+"""`accelerate-tpu env` — environment report (reference ``commands/env.py:131``)."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import platform
+
+from .. import __version__
+from .config_args import default_config_file, load_config_from_file
+
+
+def env_command_parser(subparsers=None) -> argparse.ArgumentParser:
+    description = "Print the accelerate-tpu environment (for bug reports)"
+    if subparsers is not None:
+        parser = subparsers.add_parser("env", description=description)
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu env", description=description)
+    parser.add_argument("--config_file", default=None, help="Config file to display")
+    if subparsers is not None:
+        parser.set_defaults(func=env_command)
+    return parser
+
+
+def env_command(args) -> None:
+    import jax
+    import jaxlib
+
+    info = {
+        "`accelerate_tpu` version": __version__,
+        "Platform": platform.platform(),
+        "Python version": platform.python_version(),
+        "JAX version": jax.__version__,
+        "jaxlib version": jaxlib.__version__,
+        "JAX backend": jax.default_backend(),
+        "Device count": jax.device_count(),
+        "Local device count": jax.local_device_count(),
+        "Process count": jax.process_count(),
+    }
+    try:
+        import flax
+
+        info["Flax version"] = flax.__version__
+    except Exception:
+        pass
+    try:
+        import optax
+
+        info["Optax version"] = optax.__version__
+    except Exception:
+        pass
+    accelerate_env = {k: v for k, v in os.environ.items() if k.startswith("ACCELERATE_")}
+
+    print("\nCopy-and-paste the text below in your GitHub issue\n")
+    print("\n".join(f"- {k}: {v}" for k, v in info.items()))
+    if accelerate_env:
+        print("- ACCELERATE_* environment:")
+        print("\n".join(f"\t- {k}: {v}" for k, v in sorted(accelerate_env.items())))
+    path = args.config_file or default_config_file
+    cfg = load_config_from_file(args.config_file) if (args.config_file or os.path.isfile(path)) else None
+    if cfg is not None:
+        print(f"- `accelerate-tpu` config ({path}):")
+        print("\n".join(f"\t- {k}: {v}" for k, v in cfg.to_dict().items()))
+    else:
+        print("- `accelerate-tpu` config: not found")
+
+
+def main() -> None:  # pragma: no cover
+    parser = env_command_parser()
+    env_command(parser.parse_args())
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -1,0 +1,137 @@
+"""`accelerate-tpu estimate-memory` — parameter/gradient/optimizer memory table.
+
+Reference parity: ``src/accelerate/commands/estimate.py:230-312`` loads a model on
+the meta device and prints per-dtype size tables via ``calculate_maximum_sizes``.
+Here the meta device is ``jax.eval_shape`` — shapes come from the model zoo's
+abstract init, so nothing touches HBM. Accepts either a zoo preset name
+(``llama-7b``) or a local HF-format ``config.json``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+from ..utils.modeling import calculate_maximum_sizes
+from ..utils.other import convert_bytes
+
+# Zoo presets: name → (family, config kwargs). Sizes follow the public LLaMA /
+# BERT architecture tables.
+PRESETS = {
+    "llama-7b": ("llama", dict(vocab_size=32000, hidden_size=4096, intermediate_size=11008,
+                               num_hidden_layers=32, num_attention_heads=32, num_key_value_heads=32)),
+    "llama-13b": ("llama", dict(vocab_size=32000, hidden_size=5120, intermediate_size=13824,
+                                num_hidden_layers=40, num_attention_heads=40, num_key_value_heads=40)),
+    "llama-70b": ("llama", dict(vocab_size=32000, hidden_size=8192, intermediate_size=28672,
+                                num_hidden_layers=80, num_attention_heads=64, num_key_value_heads=8)),
+    "bert-base": ("bert", dict(vocab_size=30522, hidden_size=768, num_hidden_layers=12,
+                               num_attention_heads=12, intermediate_size=3072)),
+    "bert-large": ("bert", dict(vocab_size=30522, hidden_size=1024, num_hidden_layers=24,
+                                num_attention_heads=16, intermediate_size=4096)),
+}
+
+DTYPE_BYTES = {"float32": 4, "bf16": 2, "int8": 1, "int4": 0.5}
+
+
+def create_empty_model(model_name: str):
+    """Abstract (shape-only) params for a preset or local config.json — the
+    ``jax.eval_shape`` analog of reference ``estimate.py:60-150`` meta-device load."""
+    import jax
+
+    if os.path.isfile(model_name):
+        with open(model_name, encoding="utf-8") as f:
+            hf = json.load(f)
+        arch = (hf.get("architectures") or [""])[0].lower()
+        if "llama" in arch or hf.get("model_type") == "llama":
+            family, kw = "llama", dict(
+                vocab_size=hf["vocab_size"], hidden_size=hf["hidden_size"],
+                intermediate_size=hf["intermediate_size"], num_hidden_layers=hf["num_hidden_layers"],
+                num_attention_heads=hf["num_attention_heads"],
+                num_key_value_heads=hf.get("num_key_value_heads", hf["num_attention_heads"]),
+            )
+        elif "bert" in arch or hf.get("model_type") == "bert":
+            family, kw = "bert", dict(
+                vocab_size=hf["vocab_size"], hidden_size=hf["hidden_size"],
+                num_hidden_layers=hf["num_hidden_layers"],
+                num_attention_heads=hf["num_attention_heads"],
+                intermediate_size=hf["intermediate_size"],
+            )
+        else:
+            raise ValueError(f"Unsupported architecture in {model_name}: {arch or hf.get('model_type')}")
+    elif model_name in PRESETS:
+        family, kw = PRESETS[model_name]
+    else:
+        raise ValueError(
+            f"Unknown model {model_name!r}. Pass a config.json path or one of {sorted(PRESETS)}"
+        )
+
+    if family == "llama":
+        from ..models import Llama, LlamaConfig
+
+        model = Llama(LlamaConfig(**kw))
+    else:
+        from ..models import BertConfig, BertForSequenceClassification
+
+        model = BertForSequenceClassification(BertConfig(**kw))
+    return jax.eval_shape(lambda: model.init_params(jax.random.key(0)))
+
+
+def estimate_command_parser(subparsers=None) -> argparse.ArgumentParser:
+    description = "Estimate model memory per dtype (params / gradients / optimizer states)"
+    if subparsers is not None:
+        parser = subparsers.add_parser("estimate-memory", description=description)
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu estimate-memory", description=description)
+    parser.add_argument("model_name", help="Zoo preset (e.g. llama-7b) or path to a config.json")
+    parser.add_argument(
+        "--dtypes", nargs="+", default=list(DTYPE_BYTES), choices=list(DTYPE_BYTES),
+        help="Dtypes to include in the table",
+    )
+    if subparsers is not None:
+        parser.set_defaults(func=estimate_command)
+    return parser
+
+
+def estimate_training_usage(total_fp32: int, dtype: str) -> int:
+    """Rough Adam training footprint (reference ``estimate.py`` table's 'Total Size
+    Using Adam' column): params + grads in compute dtype, fp32 master + 2 moments."""
+    scale = DTYPE_BYTES[dtype] / 4
+    return int(total_fp32 * scale * 2 + total_fp32 * 3)
+
+
+def gather_data(args):
+    params = create_empty_model(args.model_name)
+    total_size, largest_layer = calculate_maximum_sizes(params)
+    rows = []
+    for dtype in args.dtypes:
+        scale = DTYPE_BYTES[dtype] / 4
+        rows.append(
+            [
+                dtype,
+                int(largest_layer[0] * scale),
+                int(total_size * scale),
+                estimate_training_usage(total_size, dtype),
+            ]
+        )
+    return rows, largest_layer
+
+
+def estimate_command(args) -> None:
+    rows, largest_layer = gather_data(args)
+    header = ["dtype", "Largest Layer", "Total Size", "Training w/ Adam"]
+    widths = [max(len(header[i]), 14) for i in range(4)]
+    print(f"Memory estimate for {args.model_name} (largest layer: {largest_layer[1]})")
+    print("  ".join(h.ljust(w) for h, w in zip(header, widths)))
+    for row in rows:
+        cells = [row[0]] + [convert_bytes(v) for v in row[1:]]
+        print("  ".join(str(c).ljust(w) for c, w in zip(cells, widths)))
+
+
+def main() -> None:  # pragma: no cover
+    parser = estimate_command_parser()
+    estimate_command(parser.parse_args())
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

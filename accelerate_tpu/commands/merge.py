@@ -1,0 +1,70 @@
+"""`accelerate-tpu merge-weights` — consolidate a sharded checkpoint to safetensors.
+
+Reference parity: ``src/accelerate/commands/merge.py:26-61`` →
+``merge_fsdp_weights`` (``utils/fsdp_utils.py:354-407``), which gathers FSDP
+distributed-checkpoint shards into one ``model.safetensors``. Here the sharded
+format is an orbax/tensorstore directory written by ``save_accelerator_state``;
+restore runs on host CPU so no accelerator is needed to merge.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+
+def merge_command_parser(subparsers=None) -> argparse.ArgumentParser:
+    description = "Merge a sharded (orbax) model checkpoint into safetensors/msgpack files"
+    if subparsers is not None:
+        parser = subparsers.add_parser("merge-weights", description=description)
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu merge-weights", description=description)
+    parser.add_argument("checkpoint_dir", help="Sharded checkpoint directory (e.g. .../checkpoint_0/model)")
+    parser.add_argument("output_path", help="Directory to write the merged weights into")
+    parser.add_argument(
+        "--unsafe_serialization", action="store_true",
+        help="Write msgpack instead of safetensors",
+    )
+    parser.add_argument("--max_shard_size", default="10GB", help="Split output above this size")
+    if subparsers is not None:
+        parser.set_defaults(func=merge_command)
+    return parser
+
+
+def merge_weights(checkpoint_dir: str, output_path: str, safe_serialization: bool = True,
+                  max_shard_size: str = "10GB") -> None:
+    """Restore the sharded tree on host CPU and export consolidated weights
+    (reference ``merge_fsdp_weights`` fsdp_utils.py:354-407)."""
+    import jax
+    import orbax.checkpoint as ocp
+
+    from ..checkpointing import export_full_weights
+
+    checkpoint_dir = os.path.abspath(checkpoint_dir)
+    if not os.path.isdir(checkpoint_dir):
+        raise FileNotFoundError(f"No sharded checkpoint at {checkpoint_dir}")
+    with jax.default_device(jax.devices("cpu")[0]):
+        ckptr = ocp.StandardCheckpointer()
+        params = ckptr.restore(checkpoint_dir)
+    os.makedirs(output_path, exist_ok=True)
+    export_full_weights(params, output_path, max_shard_size=max_shard_size,
+                        safe_serialization=safe_serialization)
+    print(f"Merged weights from {checkpoint_dir} written to {output_path}")
+
+
+def merge_command(args) -> None:
+    merge_weights(
+        args.checkpoint_dir,
+        args.output_path,
+        safe_serialization=not args.unsafe_serialization,
+        max_shard_size=args.max_shard_size,
+    )
+
+
+def main() -> None:  # pragma: no cover
+    parser = merge_command_parser()
+    merge_command(parser.parse_args())
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

