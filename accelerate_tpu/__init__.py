@@ -50,6 +50,10 @@ def __getattr__(name):
         from .utils.modeling import infer_auto_device_map
 
         return infer_auto_device_map
+    if name in ("load_and_quantize_model", "QuantizationConfig"):
+        from .utils import quantization
+
+        return getattr(quantization, name)
     if name == "find_executable_batch_size":
         from .utils.memory import find_executable_batch_size
 
@@ -62,4 +66,8 @@ def __getattr__(name):
         from .inference import prepare_pippy
 
         return prepare_pippy
+    if name in ("GPTTrainStep", "BertTrainStep", "T5TrainStep", "get_train_step"):
+        from . import train_steps
+
+        return getattr(train_steps, name)
     raise AttributeError(f"module 'accelerate_tpu' has no attribute {name!r}")

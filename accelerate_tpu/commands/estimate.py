@@ -29,6 +29,12 @@ PRESETS = {
                                num_attention_heads=12, intermediate_size=3072)),
     "bert-large": ("bert", dict(vocab_size=30522, hidden_size=1024, num_hidden_layers=24,
                                 num_attention_heads=16, intermediate_size=4096)),
+    "t5-small": ("t5", dict(vocab_size=32128, d_model=512, d_kv=64, d_ff=2048,
+                            num_layers=6, num_decoder_layers=6, num_heads=8)),
+    "t5-base": ("t5", dict(vocab_size=32128, d_model=768, d_kv=64, d_ff=3072,
+                           num_layers=12, num_decoder_layers=12, num_heads=12)),
+    "t5-large": ("t5", dict(vocab_size=32128, d_model=1024, d_kv=64, d_ff=4096,
+                            num_layers=24, num_decoder_layers=24, num_heads=16)),
 }
 
 DTYPE_BYTES = {"float32": 4, "bf16": 2, "int8": 1, "int4": 0.5}
@@ -50,6 +56,13 @@ def create_empty_model(model_name: str):
                 num_attention_heads=hf["num_attention_heads"],
                 num_key_value_heads=hf.get("num_key_value_heads", hf["num_attention_heads"]),
             )
+        elif "t5" in arch or hf.get("model_type") == "t5":
+            family, kw = "t5", dict(
+                vocab_size=hf["vocab_size"], d_model=hf["d_model"], d_kv=hf["d_kv"],
+                d_ff=hf["d_ff"], num_layers=hf["num_layers"],
+                num_decoder_layers=hf.get("num_decoder_layers", hf["num_layers"]),
+                num_heads=hf["num_heads"],
+            )
         elif "bert" in arch or hf.get("model_type") == "bert":
             family, kw = "bert", dict(
                 vocab_size=hf["vocab_size"], hidden_size=hf["hidden_size"],
@@ -70,6 +83,10 @@ def create_empty_model(model_name: str):
         from ..models import Llama, LlamaConfig
 
         model = Llama(LlamaConfig(**kw))
+    elif family == "t5":
+        from ..models import T5Config, T5ForConditionalGeneration
+
+        model = T5ForConditionalGeneration(T5Config(**kw))
     else:
         from ..models import BertConfig, BertForSequenceClassification
 

@@ -216,6 +216,21 @@ class UserCpuOffloadHook:
         remove_hook_from_module(self.model)
 
 
+class DequantizeHook(ModelHook):
+    """Rebuild full-precision weights at forward entry for a quantized param tree
+    (the compute side of ``utils/quantization.py``; reference bnb does this inside
+    CUDA Linear8bitLt/Linear4bit layers — here the dequant scale-multiply fuses
+    into the consuming matmul under jit)."""
+
+    def __init__(self, compute_dtype=jnp.bfloat16):
+        self.compute_dtype = compute_dtype
+
+    def pre_forward(self, module, params, args, kwargs):
+        from .utils.quantization import dequantize_tree
+
+        return dequantize_tree(params, self.compute_dtype), args, kwargs
+
+
 class LayerwiseCastingHook(ModelHook):
     """Store in ``storage_dtype``, compute in ``compute_dtype`` (reference
     ``LayerwiseCastingHook`` :741-765). The params stay small in HBM; the upcast

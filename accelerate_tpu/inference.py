@@ -1,0 +1,229 @@
+"""Pipeline-parallel inference — the ``prepare_pippy`` analog.
+
+Reference parity: ``src/accelerate/inference.py:124-184`` — auto layer split via a
+device-map planner (:31-56), ``torch.distributed.pipelining`` ``pipeline`` +
+``ScheduleGPipe`` (:73-96), microbatched forward (:99-121), and output broadcast
+(``copy_tensor_to_devices`` operations.py:520-535).
+
+TPU-native design: the reference builds an MPMD pipeline of N worker processes
+exchanging activations over NCCL. A single JAX process already addresses every
+local chip, so the pipeline is expressed as **placement + async dispatch**:
+
+- the model's stacked layer weights (leading ``L`` dim, see models/llama.py) are
+  split into ``num_stages`` contiguous slices, each ``device_put`` onto its
+  stage's device;
+- the forward for one microbatch runs stage programs in order; ``jax.device_put``
+  of activations between stages is an ICI transfer, and because dispatch is
+  asynchronous, stage ``s`` starts microbatch ``m+1`` while stage ``s+1`` still
+  computes microbatch ``m`` — GPipe overlap without a scheduler thread;
+- each stage's block program is jitted once and reused for every layer slice in
+  that stage and every microbatch (compile once, run L×M times).
+
+Models must expose the ``embed(params, ...)`` / ``block(layer, x, ctx)`` /
+``head(params, x, ...)`` stage protocol (models/llama.py:181-235).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .state import PartialState
+from .utils.modeling import named_parameters, unflatten_names
+
+
+def generate_device_map(num_layers: int, num_stages: int) -> list[tuple[int, int]]:
+    """Even contiguous [start, stop) layer ranges per stage (reference
+    ``generate_device_map`` inference.py:31-56 splits by parameter count; layer
+    count is the equivalent for homogeneous decoder stacks)."""
+    if num_stages < 1:
+        raise ValueError(f"num_stages must be >= 1, got {num_stages}")
+    if num_stages > num_layers:
+        raise ValueError(f"Cannot split {num_layers} layers into {num_stages} stages")
+    base, extra = divmod(num_layers, num_stages)
+    ranges, start = [], 0
+    for s in range(num_stages):
+        stop = start + base + (1 if s < extra else 0)
+        ranges.append((start, stop))
+        start = stop
+    return ranges
+
+
+def _slice_stacked(tree, start: int, stop: int):
+    return jax.tree_util.tree_map(lambda leaf: leaf[start:stop], tree)
+
+
+class PipelinedModel:
+    """Stage-placed, microbatched forward wrapper (the object ``prepare_pippy``
+    returns; reference wraps the pipeline driver into ``model.forward``
+    inference.py:99-121)."""
+
+    def __init__(self, model, num_stages: int, devices, num_chunks: int, gather_output: bool):
+        self.model = model
+        self.num_chunks = num_chunks
+        self.gather_output = gather_output
+        self.devices = list(devices)[:num_stages]
+        cfg = model.config
+        num_layers = getattr(cfg, "num_hidden_layers", None) or getattr(cfg, "num_layers", None)
+        self.stage_ranges = generate_device_map(num_layers, num_stages)
+        params = model.params
+        if params is None:
+            raise ValueError("Model has no params; call init_params / load weights first")
+        # Stage s owns layers[start:stop] on devices[s]; embed params live with
+        # stage 0, head params with the last stage (reference puts them in the
+        # first/last pipeline module).
+        self.stage_layers = [
+            jax.device_put(_slice_stacked(params["layers"], a, b), self.devices[s])
+            for s, (a, b) in enumerate(self.stage_ranges)
+        ]
+        nonlayer = {k: v for k, v in params.items() if k != "layers"}
+        self.first_params = jax.device_put(nonlayer, self.devices[0])
+        self.last_params = (
+            self.first_params if len(self.devices) == 1
+            else jax.device_put(nonlayer, self.devices[-1])
+        )
+
+        # One compiled block-scan per stage shape (shapes are identical across
+        # stages up to slice length; jit caches by shape).
+        def run_stage(layers, x, ctx):
+            def step(h, layer):
+                return model.block(layer, h, ctx), None
+
+            out, _ = jax.lax.scan(step, x, layers)
+            return out
+
+        self._run_stage = jax.jit(run_stage)
+        self._embed = jax.jit(lambda p, ids, pos, am: model.embed(p, ids, pos, am))
+        self._head = jax.jit(lambda p, x, lab, am: model.head(p, x, labels=lab, attention_mask=am))
+
+    @property
+    def config(self):
+        return self.model.config
+
+    def _forward_chunk(self, input_ids, positions, attention_mask, labels):
+        x, ctx = self._embed(self.first_params, input_ids, positions, attention_mask)
+        for s, layers in enumerate(self.stage_layers):
+            x = jax.device_put(x, self.devices[s])  # ICI hop between stages
+            ctx_s = jax.device_put(ctx, self.devices[s]) if ctx is not None else None
+            x = self._run_stage(layers, x, ctx_s)
+        return self._head(self.last_params, x, labels, attention_mask)
+
+    def __call__(self, input_ids=None, labels=None, attention_mask=None, positions=None, **kw):
+        n = input_ids.shape[0]
+        chunks = min(self.num_chunks, n)
+        if n % chunks != 0:
+            raise ValueError(
+                f"Batch size {n} must be divisible by num_chunks {chunks} "
+                "(reference pipelining has the same constraint)"
+            )
+        outs = []
+        for ids, pos, am, lab in zip(
+            jnp.split(input_ids, chunks),
+            _split_opt(positions, chunks),
+            _split_opt(attention_mask, chunks),
+            _split_opt(labels, chunks),
+        ):
+            # Async dispatch: this Python loop enqueues work; stage s computes
+            # chunk m while stage s-1 already runs chunk m+1.
+            outs.append(self._forward_chunk(ids, pos, am, lab))
+        out = _concat_outputs(outs)
+        if self.gather_output:
+            out = jax.tree_util.tree_map(
+                lambda v: jax.device_put(v, self.devices[0]) if isinstance(v, jax.Array) else v,
+                out,
+            )
+        return out
+
+    def apply(self, params, *args, **kwargs):
+        if params is not None and params is not self.model.params:
+            raise ValueError(
+                "PipelinedModel weights are staged at prepare_pippy() time; "
+                "re-prepare to run with different params."
+            )
+        return self(*args, **kwargs)
+
+    def eval(self):
+        return self
+
+    def train(self, mode: bool = True):
+        if mode:
+            raise RuntimeError("prepare_pippy is inference-only (reference inference.py:124)")
+        return self
+
+
+def _split_opt(x, chunks):
+    if x is None:
+        return [None] * chunks
+    return jnp.split(x, chunks)
+
+
+def _concat_outputs(outs):
+    first = outs[0]
+    if isinstance(first, dict):
+        merged = type(first)()
+        for key in first:
+            vals = [o[key] for o in outs]
+            if vals[0] is None:
+                merged[key] = None
+            elif getattr(vals[0], "ndim", 0) == 0:
+                merged[key] = jnp.stack(vals).mean()  # per-chunk scalar losses
+            else:
+                merged[key] = jnp.concatenate(vals)
+        return merged
+    if getattr(first, "ndim", 0) == 0:
+        return jnp.stack(outs).mean()
+    return jnp.concatenate(outs)
+
+
+def prepare_pippy(
+    model,
+    split_points="auto",
+    no_split_module_classes=None,
+    example_args=(),
+    example_kwargs=None,
+    num_chunks: int | None = None,
+    gather_output: bool = False,
+):
+    """Split ``model`` into pipeline stages over the local devices and return a
+    microbatching wrapper (reference ``prepare_pippy`` inference.py:124-184).
+
+    ``split_points='auto'`` stages evenly over all local devices; an int selects
+    the stage count; a list of layer indices sets explicit boundaries.
+    ``num_chunks`` defaults to the number of stages (reference defaults to
+    num_processes, :158).
+    """
+    state = PartialState()
+    devices = jax.local_devices()
+    cfg = getattr(model, "config", None)
+    num_layers = getattr(cfg, "num_hidden_layers", None) or getattr(cfg, "num_layers", None)
+    if num_layers is None or not hasattr(model, "block"):
+        raise ValueError(
+            "prepare_pippy requires a stage-protocol model (embed/block/head with "
+            "stacked layers); got " + type(model).__name__
+        )
+    if split_points == "auto":
+        num_stages = min(len(devices), num_layers)
+    elif isinstance(split_points, int):
+        num_stages = split_points
+    elif isinstance(split_points, (list, tuple)):
+        # Explicit boundaries — validate then stage count is len+1.
+        bounds = sorted(split_points)
+        if any(b <= 0 or b >= num_layers for b in bounds):
+            raise ValueError(f"split points {split_points} out of range (0, {num_layers})")
+        num_stages = len(bounds) + 1
+        model_ranges = [0] + bounds + [num_layers]
+        wrapper = PipelinedModel(model, num_stages, devices, num_chunks or num_stages, gather_output)
+        wrapper.stage_ranges = [(model_ranges[i], model_ranges[i + 1]) for i in range(num_stages)]
+        params = model.params
+        wrapper.stage_layers = [
+            jax.device_put(_slice_stacked(params["layers"], a, b), wrapper.devices[s])
+            for s, (a, b) in enumerate(wrapper.stage_ranges)
+        ]
+        return wrapper
+    else:
+        raise ValueError(f"Unsupported split_points: {split_points!r}")
+    if num_stages > len(devices):
+        raise ValueError(f"{num_stages} stages > {len(devices)} local devices")
+    return PipelinedModel(model, num_stages, devices, num_chunks or num_stages, gather_output)

@@ -1,2 +1,3 @@
 from .bert import BertConfig, BertForSequenceClassification
 from .llama import Llama, LlamaConfig
+from .t5 import T5Config, T5ForConditionalGeneration

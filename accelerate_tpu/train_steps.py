@@ -1,0 +1,128 @@
+"""Per-architecture train-step library — the Megatron-parity layer.
+
+Reference parity: ``src/accelerate/utils/megatron_lm.py`` ships per-arch train
+steps (``BertTrainStep`` :445, ``GPTTrainStep`` :587, ``T5TrainStep`` ~:700)
+that package batch keys, the loss function, and the forward driver for
+Megatron's scheduler. Here the "scheduler" is ``Accelerator.build_train_step``'s
+single compiled XLA program, so a TrainStep reduces to what it really is: the
+arch's batch contract + loss — handed to ``build_train_step(loss_fn=...)`` or
+``set_loss_fn``.
+
+Usage::
+
+    step_def = GPTTrainStep()
+    step = accelerator.build_train_step(model, opt, loss_fn=step_def.loss_fn)
+    loss = step(step_def.get_batch(raw))
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .ops.losses import cross_entropy_loss, mse_loss
+
+
+class AbstractTrainStep:
+    """Base class mirroring ``AbstractTrainStep`` (megatron_lm.py:430-443)."""
+
+    name = "abstract"
+    batch_keys: tuple = ()
+
+    def get_batch(self, data: dict) -> dict:
+        """Project a raw example dict onto the model's batch contract."""
+        return {k: data[k] for k in self.batch_keys if k in data}
+
+    def loss_fn(self, outputs, batch):
+        raise NotImplementedError
+
+
+class GPTTrainStep(AbstractTrainStep):
+    """Causal-LM step (reference ``GPTTrainStep`` :587): next-token cross-entropy
+    with ignore-index masking; the shift lives here so models can emit aligned
+    logits."""
+
+    name = "gpt"
+    batch_keys = ("input_ids", "labels", "attention_mask")
+
+    def __init__(self, z_loss: float = 0.0, label_smoothing: float = 0.0):
+        self.z_loss = z_loss
+        self.label_smoothing = label_smoothing
+
+    def get_batch(self, data: dict) -> dict:
+        batch = super().get_batch(data)
+        if "labels" not in batch:
+            batch["labels"] = batch["input_ids"]
+        return batch
+
+    def loss_fn(self, outputs, batch):
+        if "loss" in outputs and outputs["loss"] is not None:
+            return outputs["loss"]
+        logits = outputs["logits"][:, :-1]
+        labels = batch["labels"][:, 1:]
+        if "attention_mask" in batch and batch["attention_mask"] is not None:
+            labels = jnp.where(batch["attention_mask"][:, 1:].astype(bool), labels, -100)
+        return cross_entropy_loss(
+            logits, labels, z_loss=self.z_loss, label_smoothing=self.label_smoothing
+        )
+
+
+class BertTrainStep(AbstractTrainStep):
+    """BERT pretraining step (reference ``BertTrainStep`` :445): masked-LM loss
+    plus optional next-sentence/classification loss when the model emits
+    ``seq_logits``; plain classification loss for fine-tuning batches."""
+
+    name = "bert"
+    batch_keys = ("input_ids", "attention_mask", "token_type_ids", "labels", "next_sentence_label")
+
+    def loss_fn(self, outputs, batch):
+        if "loss" in outputs and outputs["loss"] is not None:
+            return outputs["loss"]
+        logits = outputs["logits"]
+        labels = batch["labels"]
+        if logits.ndim == 3:  # MLM: [B, S, V] vs token labels
+            loss = cross_entropy_loss(logits, labels)
+        else:  # sequence classification: [B, num_labels]
+            loss = cross_entropy_loss(logits, labels)
+        nsl = batch.get("next_sentence_label")
+        if nsl is not None and "seq_logits" in outputs:
+            loss = loss + cross_entropy_loss(outputs["seq_logits"], nsl)
+        return loss
+
+
+class T5TrainStep(AbstractTrainStep):
+    """Seq2seq step (reference ``T5TrainStep`` ~:700): encoder/decoder batch keys,
+    decoder-token cross-entropy with pad masking (the model applies it when given
+    ``labels``)."""
+
+    name = "t5"
+    batch_keys = (
+        "input_ids", "attention_mask", "decoder_input_ids", "decoder_attention_mask", "labels",
+    )
+
+    def loss_fn(self, outputs, batch):
+        if "loss" in outputs and outputs["loss"] is not None:
+            return outputs["loss"]
+        return cross_entropy_loss(outputs["logits"], batch["labels"])
+
+
+class RegressionTrainStep(AbstractTrainStep):
+    """MSE step for the test fixtures (no reference analog; used by examples)."""
+
+    name = "regression"
+    batch_keys = ("x", "y")
+
+    def loss_fn(self, outputs, batch):
+        if "loss" in outputs and outputs["loss"] is not None:
+            return outputs["loss"]
+        return mse_loss(outputs["prediction"], batch["y"])
+
+
+TRAIN_STEPS = {cls.name: cls for cls in (GPTTrainStep, BertTrainStep, T5TrainStep, RegressionTrainStep)}
+
+
+def get_train_step(name: str) -> AbstractTrainStep:
+    """Factory mirroring megatron's model-type dispatch (megatron_lm.py model_type
+    switch in ``MegatronEngine``)."""
+    if name not in TRAIN_STEPS:
+        raise ValueError(f"Unknown train step {name!r}; available: {sorted(TRAIN_STEPS)}")
+    return TRAIN_STEPS[name]()

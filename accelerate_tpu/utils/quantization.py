@@ -1,0 +1,175 @@
+"""Weight-only quantization — the bitsandbytes-parity layer.
+
+Reference parity: ``src/accelerate/utils/bnb.py:44-194`` (``load_and_quantize_model``
+driving 8/4-bit bitsandbytes conversion + offload integration) and
+``BnbQuantizationConfig`` (``utils/dataclasses.py:2653-2807``). The parity target
+is the API; the implementation is TPU-native by necessity — there are no CUDA
+bnb kernels here:
+
+- **int8**: symmetric per-channel absmax quantization of 2-D+ weights. Storage is
+  ``int8`` + a bf16 scale per output channel (channel = last axis).
+- **int4**: same scheme packed two nibbles per byte (``int4 ∈ [-8, 7]``).
+- **compute**: weights are dequantized at forward entry by a hook
+  (``DequantizeHook``) and the scale-multiply fuses into the consuming matmul
+  under jit — XLA's analog of bnb's fused dequant epilogue. Memory savings hold
+  at rest (params pytree stays quantized); transient bf16 copies exist only
+  inside a forward, mirroring bnb's activation-time dequant.
+
+Skip rules mirror bnb defaults: 1-D leaves (norms, biases) and configured
+``skip_modules`` (e.g. the lm head, reference ``bnb.py:124-136``) stay in full
+precision.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+QUANT_KEY = "_quantized"  # marker key inside a quantized-leaf dict
+
+
+@dataclass
+class QuantizationConfig:
+    """Mirrors ``BnbQuantizationConfig`` fields that make sense on TPU
+    (reference ``utils/dataclasses.py:2653-2807``)."""
+
+    load_in_8bit: bool = False
+    load_in_4bit: bool = False
+    compute_dtype: str = "bfloat16"  # bnb_4bit_compute_dtype analog
+    skip_modules: list = field(default_factory=list)  # llm_int8_skip_modules analog
+    keep_in_fp32_modules: list = field(default_factory=list)
+
+    def __post_init__(self):
+        if self.load_in_8bit and self.load_in_4bit:
+            raise ValueError("load_in_8bit and load_in_4bit can't both be set")
+        if not (self.load_in_8bit or self.load_in_4bit):
+            raise ValueError("Set load_in_8bit or load_in_4bit")
+
+    @property
+    def bits(self) -> int:
+        return 8 if self.load_in_8bit else 4
+
+    @property
+    def target_dtype(self):
+        return jnp.dtype(self.compute_dtype)
+
+
+def quantize_leaf(w, bits: int) -> dict:
+    """Symmetric absmax per-channel quantization; channel = last axis."""
+    w = jnp.asarray(w)
+    qmax = 127.0 if bits == 8 else 7.0
+    absmax = jnp.max(jnp.abs(w), axis=tuple(range(w.ndim - 1)), keepdims=True)
+    scale = (absmax / qmax).astype(jnp.float32)
+    scale = jnp.where(scale == 0, 1.0, scale)
+    q = jnp.clip(jnp.round(w / scale), -qmax - 1, qmax).astype(jnp.int8)
+    if bits == 4:
+        # Pack nibble pairs over the flattened array (shape-agnostic; odd sizes
+        # get one pad nibble).
+        flat = q.reshape(-1)
+        if flat.size % 2:
+            flat = jnp.concatenate([flat, jnp.zeros((1,), jnp.int8)])
+        lo = flat[0::2] & 0x0F
+        hi = (flat[1::2] & 0x0F) << 4
+        q = (lo | hi).astype(jnp.int8)
+    return {QUANT_KEY: True, "bits": bits, "data": q, "scale": scale, "shape": tuple(w.shape)}
+
+
+def dequantize_leaf(leaf: dict, dtype=jnp.bfloat16):
+    q, scale, bits = leaf["data"], leaf["scale"], leaf["bits"]
+    shape = tuple(leaf["shape"])
+    if bits == 4:
+        lo = (q & 0x0F).astype(jnp.int8)
+        lo = jnp.where(lo > 7, lo - 16, lo)  # sign-extend nibble
+        hi = (q >> 4) & 0x0F
+        hi = jnp.where(hi > 7, hi - 16, hi).astype(jnp.int8)
+        size = int(np.prod(shape))
+        full = jnp.stack([lo, hi], axis=1).reshape(-1)[:size].reshape(shape)
+        return (full * scale).astype(dtype)
+    return (q.astype(jnp.float32) * scale).astype(dtype)
+
+
+def is_quantized_leaf(x) -> bool:
+    return isinstance(x, dict) and x.get(QUANT_KEY) is True
+
+
+def _should_quantize(name: str, leaf, config: QuantizationConfig) -> bool:
+    arr = jnp.asarray(leaf) if not hasattr(leaf, "ndim") else leaf
+    if arr.ndim < 2:
+        return False  # norms/biases stay full precision (bnb skips nn.LayerNorm etc.)
+    skip = list(config.skip_modules) + list(config.keep_in_fp32_modules)
+    return not any(s and s in name for s in skip)
+
+
+def quantize_tree(params, config: QuantizationConfig):
+    """Quantize eligible leaves of a param pytree (quantized leaves become marker
+    dicts, which remain valid pytree nodes)."""
+    from .modeling import named_parameters
+
+    flat = {}
+    for name, leaf in named_parameters(params).items():
+        if _should_quantize(name, leaf, config):
+            flat[name] = quantize_leaf(leaf, config.bits)
+        else:
+            flat[name] = leaf
+    return _unflatten_with_quant(flat, params)
+
+
+def _unflatten_with_quant(flat: dict, template):
+    """Like ``unflatten_names`` but quantized leaves expand the tree structure
+    (a leaf becomes a dict node), so rebuild nested dicts directly."""
+    out = {}
+    for name, value in flat.items():
+        parts = name.split(".")
+        node = out
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = value
+    return out
+
+
+def dequantize_tree(params, dtype=jnp.bfloat16):
+    """Rebuild a full-precision tree (inverse of ``quantize_tree``)."""
+    if is_quantized_leaf(params):
+        return dequantize_leaf(params, dtype)
+    if isinstance(params, dict):
+        return {k: dequantize_tree(v, dtype) for k, v in params.items()}
+    return params
+
+
+def quantized_nbytes(params) -> int:
+    return sum(np.asarray(leaf).nbytes for leaf in jax.tree_util.tree_leaves(params))
+
+
+def load_and_quantize_model(
+    model,
+    weights_location: str | None = None,
+    quantization_config: QuantizationConfig | None = None,
+    device_map=None,
+    no_split_module_classes=None,
+    offload_folder: str | None = None,
+):
+    """bnb-parity entry point (reference ``load_and_quantize_model`` bnb.py:44-194):
+    optionally load checkpoint weights, quantize the param tree in place, and hook
+    ``model.apply`` so forwards see dequantized weights in ``compute_dtype``."""
+    if quantization_config is None:
+        raise ValueError("quantization_config is required")
+    if weights_location is not None:
+        from .modeling import load_checkpoint_in_model
+
+        model.params = load_checkpoint_in_model(
+            model.params, weights_location, device_map=device_map,
+            offload_folder=offload_folder,
+        )
+    if model.params is None:
+        raise ValueError("Model has no params; init or load weights first")
+    model.params = quantize_tree(model.params, quantization_config)
+
+    from ..hooks import DequantizeHook, add_hook_to_module
+
+    add_hook_to_module(model, DequantizeHook(quantization_config.target_dtype))
+    model.is_quantized = True
+    return model

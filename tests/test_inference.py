@@ -1,0 +1,94 @@
+"""Pipeline-parallel inference tests (reference ``test_pippy.py`` external-deps
+script + ``inference.py`` unit behavior)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from accelerate_tpu.inference import generate_device_map, prepare_pippy
+from accelerate_tpu.models import Llama, LlamaConfig
+
+
+def _tiny_model(num_layers=4):
+    cfg = LlamaConfig.tiny(num_hidden_layers=num_layers)
+    model = Llama(cfg)
+    model.init_params(jax.random.key(0))
+    return model, cfg
+
+
+def test_generate_device_map_even():
+    assert generate_device_map(8, 4) == [(0, 2), (2, 4), (4, 6), (6, 8)]
+
+
+def test_generate_device_map_uneven():
+    # 7 layers over 3 stages: extras go to the earliest stages.
+    assert generate_device_map(7, 3) == [(0, 3), (3, 5), (5, 7)]
+
+
+def test_generate_device_map_errors():
+    with pytest.raises(ValueError):
+        generate_device_map(2, 4)
+    with pytest.raises(ValueError):
+        generate_device_map(4, 0)
+
+
+def test_pippy_matches_unpipelined():
+    model, cfg = _tiny_model()
+    ids = np.random.default_rng(0).integers(0, cfg.vocab_size, (4, 8)).astype(np.int32)
+    want = model.apply(model.params, input_ids=ids)["logits"]
+    piped = prepare_pippy(model, split_points=2, num_chunks=2)
+    got = piped(input_ids=ids)["logits"]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+
+def test_pippy_auto_split_uses_devices():
+    model, cfg = _tiny_model(num_layers=8)
+    piped = prepare_pippy(model)
+    assert len(piped.stage_layers) == min(len(jax.local_devices()), 8)
+    # Stage layer slices cover all layers exactly once.
+    total = sum(b - a for a, b in piped.stage_ranges)
+    assert total == 8
+
+
+def test_pippy_explicit_split_points():
+    model, cfg = _tiny_model(num_layers=4)
+    piped = prepare_pippy(model, split_points=[1, 3])
+    assert piped.stage_ranges == [(0, 1), (1, 3), (3, 4)]
+    ids = np.random.default_rng(1).integers(0, cfg.vocab_size, (2, 8)).astype(np.int32)
+    want = model.apply(model.params, input_ids=ids)["logits"]
+    got = piped(input_ids=ids)["logits"]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+
+def test_pippy_loss_microbatching():
+    model, cfg = _tiny_model()
+    ids = np.random.default_rng(2).integers(0, cfg.vocab_size, (4, 8)).astype(np.int32)
+    want = float(model.apply(model.params, input_ids=ids, labels=ids)["loss"])
+    piped = prepare_pippy(model, split_points=2, num_chunks=2)
+    got = float(piped(input_ids=ids, labels=ids)["loss"])
+    assert abs(got - want) < 1e-3, (got, want)
+
+
+def test_pippy_batch_divisibility_error():
+    model, cfg = _tiny_model()
+    piped = prepare_pippy(model, split_points=2, num_chunks=4)
+    ids = np.zeros((6, 8), np.int32)
+    with pytest.raises(ValueError, match="divisible"):
+        piped(input_ids=ids)
+
+
+def test_pippy_train_mode_rejected():
+    model, _ = _tiny_model()
+    piped = prepare_pippy(model, split_points=2)
+    with pytest.raises(RuntimeError):
+        piped.train()
+    assert piped.eval() is piped
+
+
+def test_pippy_gather_output():
+    model, cfg = _tiny_model()
+    piped = prepare_pippy(model, split_points=2, gather_output=True)
+    ids = np.zeros((2, 8), np.int32)
+    out = piped(input_ids=ids)["logits"]
+    assert out.sharding.device_set == {piped.devices[0]}

@@ -1,0 +1,100 @@
+"""Quantization tests (reference ``tests/test_quantization.py`` exercises bnb
+8/4-bit load + skip modules; same behavioral checks against the TPU-native
+int8/int4 implementation)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from accelerate_tpu.models import Llama, LlamaConfig
+from accelerate_tpu.utils.quantization import (
+    QuantizationConfig,
+    dequantize_leaf,
+    dequantize_tree,
+    is_quantized_leaf,
+    load_and_quantize_model,
+    quantize_leaf,
+    quantize_tree,
+    quantized_nbytes,
+)
+
+
+def test_config_validation():
+    with pytest.raises(ValueError):
+        QuantizationConfig(load_in_8bit=True, load_in_4bit=True)
+    with pytest.raises(ValueError):
+        QuantizationConfig()
+    assert QuantizationConfig(load_in_8bit=True).bits == 8
+    assert QuantizationConfig(load_in_4bit=True).bits == 4
+
+
+def test_int8_roundtrip_error_bound():
+    rng = np.random.default_rng(0)
+    w = rng.normal(size=(64, 32)).astype(np.float32)
+    q = quantize_leaf(w, 8)
+    assert q["data"].dtype == jnp.int8
+    back = np.asarray(dequantize_leaf(q, jnp.float32))
+    # absmax int8: max error ~ absmax/127 per channel
+    max_err = np.abs(w).max(axis=0) / 127
+    assert (np.abs(back - w) <= max_err[None, :] + 1e-6).all()
+
+
+def test_int4_roundtrip_and_packing():
+    rng = np.random.default_rng(1)
+    w = rng.normal(size=(33, 16)).astype(np.float32)  # odd leading dim
+    q = quantize_leaf(w, 4)
+    assert q["data"].size == (w.size + 1) // 2  # two nibbles per byte
+    back = np.asarray(dequantize_leaf(q, jnp.float32))
+    assert back.shape == w.shape
+    max_err = np.abs(w).max(axis=0) / 7
+    assert (np.abs(back - w) <= max_err[None, :] + 1e-6).all()
+
+
+def test_quantize_tree_skips_1d_and_named():
+    params = {
+        "attn": {"wq": jnp.ones((8, 8)), "norm": jnp.ones((8,))},
+        "lm_head": {"w": jnp.ones((8, 4))},
+    }
+    cfg = QuantizationConfig(load_in_8bit=True, skip_modules=["lm_head"])
+    qt = quantize_tree(params, cfg)
+    assert is_quantized_leaf(qt["attn"]["wq"])
+    assert not is_quantized_leaf(qt["attn"]["norm"])  # 1-D stays
+    assert not is_quantized_leaf(qt["lm_head"]["w"])  # skipped by name
+
+
+def test_tree_roundtrip_structure():
+    params = {"a": {"w": jnp.arange(32.0).reshape(4, 8)}, "b": jnp.ones((3,))}
+    cfg = QuantizationConfig(load_in_8bit=True)
+    qt = quantize_tree(params, cfg)
+    back = dequantize_tree(qt, jnp.float32)
+    assert back["b"].shape == (3,)
+    np.testing.assert_allclose(np.asarray(back["a"]["w"]), np.arange(32.0).reshape(4, 8), atol=0.15)
+
+
+def test_load_and_quantize_model_memory_and_forward():
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    model = Llama(cfg)
+    model.init_params(jax.random.key(0))
+    ids = np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 8)).astype(np.int32)
+    want = np.asarray(model.apply(model.params, input_ids=ids)["logits"], np.float32)
+    fp32_bytes = quantized_nbytes(model.params)
+
+    qconfig = QuantizationConfig(load_in_8bit=True)
+    model = load_and_quantize_model(model, quantization_config=qconfig)
+    assert model.is_quantized
+    q_bytes = quantized_nbytes(model.params)
+    assert q_bytes < fp32_bytes * 0.45  # ~4x smaller (embeddings dominate)
+
+    got = np.asarray(model.apply(model.params, input_ids=ids)["logits"], np.float32)
+    # int8 + bf16 compute: loose tolerance, but logits must correlate strongly.
+    corr = np.corrcoef(got.ravel(), want.ravel())[0, 1]
+    assert corr > 0.99, corr
+
+
+def test_quantized_checkpoint_requires_config_error():
+    model = Llama(LlamaConfig.tiny())
+    model.init_params(jax.random.key(0))
+    with pytest.raises(ValueError):
+        load_and_quantize_model(model, quantization_config=None)

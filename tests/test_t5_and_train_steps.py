@@ -1,0 +1,132 @@
+"""T5 model + per-arch train-step library tests (reference megatron_lm per-arch
+steps + transformers-model examples; SURVEY.md §2.4 Megatron row)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.models import T5Config, T5ForConditionalGeneration
+from accelerate_tpu.train_steps import (
+    BertTrainStep,
+    GPTTrainStep,
+    T5TrainStep,
+    get_train_step,
+)
+
+
+def _t5():
+    cfg = T5Config.tiny()
+    model = T5ForConditionalGeneration(cfg)
+    model.init_params(jax.random.key(0))
+    return model, cfg
+
+
+def _batch(cfg, B=2, S=10, T=6, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "input_ids": rng.integers(1, cfg.vocab_size, (B, S)).astype(np.int32),
+        "labels": rng.integers(1, cfg.vocab_size, (B, T)).astype(np.int32),
+    }
+
+
+def test_t5_forward_shapes():
+    model, cfg = _t5()
+    b = _batch(cfg)
+    out = model.apply(model.params, **b)
+    assert out["logits"].shape == (2, 6, cfg.vocab_size)
+    assert np.isfinite(float(out["loss"]))
+    assert out["encoder_last_hidden_state"].shape == (2, 10, cfg.d_model)
+
+
+def test_t5_pad_masking_changes_nothing_when_no_pad():
+    """Padded encoder tokens must not affect unpadded positions' logits."""
+    model, cfg = _t5()
+    b = _batch(cfg, S=8)
+    out_full = model.apply(model.params, **b)["logits"]
+    # Append pad tokens + explicit mask: logits for the same decoder positions
+    # must be unchanged.
+    ids_padded = np.concatenate([b["input_ids"], np.zeros((2, 4), np.int32)], axis=1)
+    out_padded = model.apply(
+        model.params, input_ids=ids_padded, labels=b["labels"]
+    )["logits"]
+    np.testing.assert_allclose(np.asarray(out_full), np.asarray(out_padded), rtol=2e-4, atol=2e-4)
+
+
+def test_t5_causal_decoder():
+    """Future decoder tokens must not leak into earlier positions."""
+    model, cfg = _t5()
+    b = _batch(cfg)
+    dec = np.asarray(model._shift_right(jnp.asarray(b["labels"])))
+    out1 = model.apply(model.params, input_ids=b["input_ids"], decoder_input_ids=dec)["logits"]
+    dec2 = dec.copy()
+    dec2[:, -1] = (dec2[:, -1] + 1) % cfg.vocab_size  # perturb last token
+    out2 = model.apply(model.params, input_ids=b["input_ids"], decoder_input_ids=dec2)["logits"]
+    np.testing.assert_allclose(
+        np.asarray(out1[:, :-1]), np.asarray(out2[:, :-1]), rtol=2e-4, atol=2e-4
+    )
+    assert not np.allclose(np.asarray(out1[:, -1]), np.asarray(out2[:, -1]))
+
+
+def test_t5_trains():
+    model, cfg = _t5()
+    acc = Accelerator()
+    pmodel, popt = acc.prepare(model, optax.adamw(1e-3))
+    step = acc.build_train_step(pmodel, popt)
+    b = _batch(cfg)
+    losses = [float(step(b)) for _ in range(8)]
+    assert losses[-1] < losses[0], losses
+
+
+def test_t5_jit_forward():
+    model, cfg = _t5()
+    b = _batch(cfg)
+    fn = jax.jit(lambda p, ids, lab: model.apply(p, input_ids=ids, labels=lab)["loss"])
+    loss = fn(model.params, b["input_ids"], b["labels"])
+    assert np.isfinite(float(loss))
+
+
+def test_gpt_train_step_shift_and_mask():
+    step = GPTTrainStep()
+    V = 11
+    logits = jnp.zeros((1, 4, V)).at[0, :, 3].set(10.0)  # always predicts 3
+    batch = {
+        "input_ids": jnp.asarray([[3, 3, 3, 3]]),
+        "labels": jnp.asarray([[3, 3, 3, 3]]),
+        "attention_mask": jnp.asarray([[1, 1, 1, 1]]),
+    }
+    loss = float(step.loss_fn({"logits": logits}, batch))
+    assert loss < 0.01  # perfect prediction
+    # Masked-out positions are ignored: same loss with a mask hole.
+    batch2 = dict(batch, attention_mask=jnp.asarray([[1, 1, 0, 1]]))
+    loss2 = float(step.loss_fn({"logits": logits}, batch2))
+    assert loss2 < 0.01
+
+
+def test_bert_train_step_classification_and_nsp():
+    step = BertTrainStep()
+    logits = jnp.asarray([[10.0, 0.0], [0.0, 10.0]])
+    batch = {"labels": jnp.asarray([0, 1])}
+    assert float(step.loss_fn({"logits": logits}, batch)) < 0.01
+    batch_nsp = {"labels": jnp.asarray([0, 1]), "next_sentence_label": jnp.asarray([1, 0])}
+    outputs = {"logits": logits, "seq_logits": jnp.asarray([[0.0, 10.0], [10.0, 0.0]])}
+    assert float(step.loss_fn(outputs, batch_nsp)) < 0.02
+
+
+def test_train_step_factory_and_model_loss_passthrough():
+    assert isinstance(get_train_step("t5"), T5TrainStep)
+    with pytest.raises(ValueError):
+        get_train_step("mamba")
+    # Model-computed loss wins.
+    out = {"loss": jnp.asarray(1.5), "logits": jnp.zeros((1, 2))}
+    assert float(get_train_step("gpt").loss_fn(out, {})) == 1.5
+
+
+def test_get_batch_projection():
+    step = GPTTrainStep()
+    raw = {"input_ids": np.ones((1, 4)), "extra_junk": 1}
+    batch = step.get_batch(raw)
+    assert set(batch) == {"input_ids", "labels"}
