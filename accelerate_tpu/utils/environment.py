@@ -38,6 +38,33 @@ def parse_choice_from_env(key: str, default: str = "no") -> str:
     return os.environ.get(key, str(default))
 
 
+def pin_cpu_platform(n_devices: int = 8) -> None:
+    """Force the CPU backend with ``n_devices`` virtual devices.
+
+    Single audited home for the axon workaround (the TPU plugin overrides
+    JAX_PLATFORMS at import time and can hang backend init when the tunnel is
+    absent, so we pin via jax.config — which wins — in addition to the env
+    contract). Must run before the first jax backend touch in the process;
+    callers that may run after backend init should verify
+    ``len(jax.devices()) == n_devices`` afterward and fall back to a clean
+    subprocess. Used by tests/conftest.py, __graft_entry__.py, and bench.py.
+    """
+    import re
+
+    opt = f"--xla_force_host_platform_device_count={n_devices}"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" in flags:
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+", opt, flags)
+    else:
+        flags = (flags + " " + opt).strip()
+    os.environ["XLA_FLAGS"] = flags
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
 def get_int_from_env(env_keys, default: int) -> int:
     """Return the first positive int found among env_keys."""
     for key in env_keys:

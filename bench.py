@@ -35,6 +35,38 @@ def peak_flops_per_chip() -> float:
     return 197e12
 
 
+def resolve_backend() -> str:
+    """Return the usable backend name, falling back to CPU when the TPU/axon
+    backend is unavailable (tunnel down, plugin error). Must never raise or
+    hang: the driver requires one JSON line from this script regardless.
+
+    Backend discovery is probed in a SUBPROCESS with a timeout because the axon
+    plugin's failure modes include hanging inside C++ backend init (see
+    MULTICHIP_r01.json rc=124) — an in-process try/except cannot catch a hang.
+    """
+    import subprocess
+
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; print(jax.default_backend())"],
+            capture_output=True,
+            text=True,
+            timeout=180,
+        )
+        backend = probe.stdout.strip().splitlines()[-1] if probe.returncode == 0 else ""
+    except subprocess.TimeoutExpired:
+        backend = ""
+
+    if backend not in ("tpu", "gpu"):
+        # TPU probe failed or hung: pin CPU before this process's first
+        # backend touch (jax.config wins over the plugin's env override).
+        from accelerate_tpu.utils.environment import pin_cpu_platform
+
+        pin_cpu_platform(1)
+        backend = "cpu"
+    return backend
+
+
 def main():
     import jax
     import optax
@@ -42,7 +74,7 @@ def main():
     from accelerate_tpu import Accelerator
     from accelerate_tpu.models import Llama, LlamaConfig
 
-    on_tpu = jax.default_backend() == "tpu"
+    on_tpu = resolve_backend() == "tpu"
     # ~340M-param model that fits one v5e chip with Adam state; smaller on CPU.
     if on_tpu:
         cfg = LlamaConfig(
@@ -107,4 +139,18 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as exc:  # emit a parseable JSON line no matter what
+        print(
+            json.dumps(
+                {
+                    "metric": "llama340m_train_mfu_per_chip",
+                    "value": 0.0,
+                    "unit": "fraction_of_peak_bf16",
+                    "vs_baseline": 0.0,
+                    "detail": {"error": f"{type(exc).__name__}: {exc}"[:500]},
+                }
+            )
+        )
+        sys.exit(0)

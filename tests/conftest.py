@@ -8,19 +8,13 @@ devices exercise every sharding/collective path with zero hardware (SURVEY.md §
 import os
 import sys
 
-# Must run before any jax backend initialization. The axon TPU plugin overrides the
-# JAX_PLATFORMS env var at import time, so we pin the platform via jax.config (which
-# wins) in addition to the env contract.
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax  # noqa: E402
+# Must run before any jax backend initialization — see pin_cpu_platform's
+# docstring for the axon workaround this encodes.
+from accelerate_tpu.utils.environment import pin_cpu_platform  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+pin_cpu_platform(8)
 
 import pytest  # noqa: E402
 
