@@ -66,6 +66,10 @@ def __getattr__(name):
         from .inference import prepare_pippy
 
         return prepare_pippy
+    if name in ("generate", "sample_logits"):
+        from . import generation
+
+        return getattr(generation, name)
     if name in ("GPTTrainStep", "BertTrainStep", "T5TrainStep", "get_train_step"):
         from . import train_steps
 

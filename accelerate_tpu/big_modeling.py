@@ -302,6 +302,11 @@ class StreamedScanModel:
         # jit caches are keyed on the function object — build each wrapper ONCE so
         # repeated inference calls reuse the compiled programs.
         self._block_fn = jax.jit(lambda layer, x, ctx: model.block(layer, x, ctx))
+        self._block_cache_fn = jax.jit(
+            lambda layer, ck, cv, x, ctx: model.block(
+                layer, x, ctx, cache_layer={"k": ck, "v": cv}
+            )
+        )
         self._embed_fn = jax.jit(lambda p, ids, pos, am: model.embed(p, ids, pos, am))
         self._head_fn = jax.jit(
             lambda p, x, lab, am: model.head(p, x, labels=lab, attention_mask=am)
@@ -344,8 +349,33 @@ class StreamedScanModel:
         out.pop("layers", None)
         return jax.device_put(out, self.execution_device)
 
-    def __call__(self, input_ids=None, labels=None, attention_mask=None, positions=None, **kw):
+    def init_cache(self, batch_size: int, max_len: int, dtype=jnp.bfloat16):
+        """Decode cache for streamed generation. Per-layer K/V kept as a LIST of
+        (B, K, n_kv, D) arrays (not stacked over L): each token's forward
+        updates one layer's slice at a time while that layer's weights stream
+        in, so a stacked array would force a full-cache copy per layer."""
+        if not hasattr(self.model, "init_cache"):
+            raise TypeError(f"{type(self.model).__name__} does not support KV caching")
+        # eval_shape: get the stacked layout WITHOUT materializing it — the
+        # stacked cache can be tens of GB for the offloaded models this class
+        # exists for, so allocate per-layer buffers directly on the chip.
+        spec = jax.eval_shape(
+            lambda: self.model.init_cache(batch_size, max_len, dtype=dtype)
+        )
+        k_shape, v_shape = spec["k"].shape[1:], spec["v"].shape[1:]
+        with jax.default_device(self.execution_device):
+            return {
+                "k": [jnp.zeros(k_shape, dtype) for _ in range(self.num_layers)],
+                "v": [jnp.zeros(v_shape, dtype) for _ in range(self.num_layers)],
+                "pos": jnp.zeros((), jnp.int32),
+                "kv_mask": jnp.zeros((batch_size, max_len), jnp.int32),
+            }
+
+    def __call__(self, input_ids=None, labels=None, attention_mask=None, positions=None,
+                 cache=None, **kw):
         nonlayer = self._resident_nonlayer_params()
+        if cache is not None:
+            return self._call_cached(nonlayer, input_ids, labels, attention_mask, cache)
         x, ctx = self._embed_fn(nonlayer, input_ids, positions, attention_mask)
         # Double-buffered streaming: prefetch layer i+1 while layer i computes.
         next_layer = jax.device_put(self._layer_host_slice(0), self.execution_device)
@@ -357,6 +387,40 @@ class StreamedScanModel:
                 )
             x = self._block_fn(layer, x, ctx)
         return self._head_fn(nonlayer, x, labels, attention_mask)
+
+    def _call_cached(self, nonlayer, input_ids, labels, attention_mask, cache):
+        """Incremental forward through the per-layer KV cache, weights streamed."""
+        B, S = input_ids.shape
+        pos = cache["pos"]
+        q_positions = jnp.broadcast_to(
+            pos + jnp.arange(S, dtype=jnp.int32)[None], (B, S)
+        )
+        chunk_mask = (
+            jnp.asarray(attention_mask, jnp.int32)
+            if attention_mask is not None
+            else jnp.ones((B, S), jnp.int32)
+        )
+        kv_mask = jax.lax.dynamic_update_slice(cache["kv_mask"], chunk_mask, (0, pos))
+        x, ctx = self._embed_fn(nonlayer, input_ids, q_positions, attention_mask)
+        ctx = dict(ctx)
+        ctx["positions"] = q_positions
+        ctx["kv_mask"] = kv_mask
+        ctx["cache_pos"] = pos
+
+        new_k, new_v = [], []
+        next_layer = jax.device_put(self._layer_host_slice(0), self.execution_device)
+        for i in range(self.num_layers):
+            layer = next_layer
+            if i + 1 < self.num_layers:
+                next_layer = jax.device_put(
+                    self._layer_host_slice(i + 1), self.execution_device
+                )
+            x, updated = self._block_cache_fn(layer, cache["k"][i], cache["v"][i], x, ctx)
+            new_k.append(updated["k"])
+            new_v.append(updated["v"])
+        out = self._head_fn(nonlayer, x, labels, attention_mask)
+        out["cache"] = {"k": new_k, "v": new_v, "pos": pos + S, "kv_mask": kv_mask}
+        return out
 
     def apply(self, params, *args, **kwargs):
         if params is not None and params is not self.model.params:

@@ -1,0 +1,207 @@
+"""Autoregressive generation over the KV-cache decode path.
+
+Reference counterpart: the reference has no generate() of its own — its
+big-model-inference story is transformers' ``model.generate`` driven through
+dispatched/offloaded models (``benchmarks/big_model_inference/
+big_model_inference.py``, BASELINE.md big-model tables measure s/token).
+Here generation is part of the framework, built TPU-first:
+
+- **One compiled program per shape**: prefill is one jit; the decode loop is a
+  single ``lax.scan`` over steps with a static-shape cache, so the entire
+  generation runs as two XLA programs — no per-token Python dispatch.
+- **Static shapes everywhere**: the cache is pre-allocated to
+  ``prompt + max_new_tokens``; finished rows keep stepping but emit
+  ``pad_token_id`` (the standard masked-finish idiom), preserving SPMD-friendly
+  control flow (no data-dependent early exit inside jit).
+- **Ragged batches are left-aligned internally**: right-padded prompts are
+  rolled so every row's last real token sits at index S-1 — all rows then share
+  one global decode position (SPMD-uniform), and because RoPE attention depends
+  only on position *differences* within a row, the per-row constant offset the
+  roll introduces cancels exactly (leading pads are masked via kv_mask).
+- **Offloaded models stream instead**: for ``StreamedScanModel`` (layer weights
+  on host/disk) each token's forward streams layer slices just-in-time — the
+  per-token Python loop is the point there, since HBM never holds the model.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_logits(logits, rng, temperature: float = 1.0, top_k: int | None = None,
+                  top_p: float | None = None):
+    """Sample token ids from (B, V) logits. temperature<=0 means greedy."""
+    if temperature is None or temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits.astype(jnp.float32) / temperature
+    if top_k is not None and top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None and 0.0 < top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # Smallest logit value still inside the nucleus, per row.
+        inside = cum - probs < top_p
+        cutoff = jnp.min(jnp.where(inside, sorted_logits, jnp.inf), axis=-1, keepdims=True)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+def left_align(input_ids, attention_mask):
+    """Roll each right-padded row so its last real token lands at index S-1.
+
+    Decoder-only generation with ragged batches requires left padding: with
+    right padding each row's next token would need a per-row write offset and a
+    per-row RoPE position. After the roll, one global offset serves every row,
+    and the constant per-row position shift cancels in RoPE dot products.
+    """
+    S = input_ids.shape[1]
+    shifts = S - jnp.sum(attention_mask, axis=-1).astype(jnp.int32)  # pad count per row
+    roll = jax.vmap(lambda row, s: jnp.roll(row, s, axis=0))
+    return roll(input_ids, shifts), roll(attention_mask, shifts)
+
+
+def _unwrap(model):
+    """(module, params) from a Module, PreparedModel, or raw (module, params)."""
+    handle = getattr(model, "handle", None)
+    if handle is not None:  # PreparedModel
+        return handle.module, handle.params
+    return model, getattr(model, "params", None)
+
+
+def generate(
+    model,
+    input_ids,
+    *,
+    max_new_tokens: int,
+    params=None,
+    attention_mask=None,
+    temperature: float = 0.0,
+    top_k: int | None = None,
+    top_p: float | None = None,
+    rng=None,
+    eos_token_id: int | None = None,
+    pad_token_id: int = 0,
+    cache_dtype=jnp.bfloat16,
+    include_prompt: bool = True,
+):
+    """Generate ``max_new_tokens`` continuations for a batch of prompts.
+
+    ``model`` may be an ``accelerate_tpu.Module`` (with ``init_cache``), a
+    ``PreparedModel`` from ``Accelerator.prepare``, or a ``StreamedScanModel``
+    from offloaded ``dispatch_model``. Prompts are right-padded; pass
+    ``attention_mask`` (1 = real) for ragged batches.
+
+    Returns int32 ids of shape (B, prompt_len + max_new_tokens) when
+    ``include_prompt`` else (B, max_new_tokens).
+    """
+    from .big_modeling import StreamedScanModel
+
+    input_ids = jnp.asarray(input_ids, jnp.int32)
+    B, S = input_ids.shape
+    if attention_mask is not None:
+        attention_mask = jnp.asarray(attention_mask, jnp.int32)
+    if rng is None:
+        rng = jax.random.key(0)
+    eos = -1 if eos_token_id is None else eos_token_id
+
+    if isinstance(model, StreamedScanModel):
+        new_tokens = _generate_streamed(
+            model, input_ids, attention_mask, max_new_tokens,
+            temperature, top_k, top_p, rng, eos, pad_token_id, cache_dtype,
+        )
+    else:
+        module, mparams = _unwrap(model)
+        if params is None:
+            params = mparams
+        if params is None:
+            raise ValueError("Model has no params; pass params= or init the model first.")
+        fn = _compiled_generate(module, max_new_tokens, temperature, top_k, top_p,
+                                eos, pad_token_id, cache_dtype)
+        mask_arg = (
+            attention_mask if attention_mask is not None else jnp.ones((B, S), jnp.int32)
+        )
+        new_tokens = fn(params, input_ids, mask_arg, rng)
+    if include_prompt:
+        return jnp.concatenate([input_ids, new_tokens], axis=1)
+    return new_tokens
+
+
+def _compiled_generate(module, max_new_tokens, temperature, top_k, top_p,
+                       eos, pad_token_id, cache_dtype):
+    """Prefill + scan-decode as one jitted function, cached per module so
+    repeated calls with the same shapes reuse the compiled program."""
+    cache_store = module.__dict__.setdefault("_generate_fns", {})
+    key = (max_new_tokens, temperature, top_k, top_p, eos, pad_token_id, str(cache_dtype))
+    if key in cache_store:
+        return cache_store[key]
+
+    def run(params, input_ids, attention_mask, rng):
+        B, S = input_ids.shape
+        total = S + max_new_tokens
+        cache = module.init_cache(B, total, dtype=cache_dtype)
+
+        input_ids, attention_mask = left_align(input_ids, attention_mask)
+        out = module.apply(params, input_ids=input_ids, attention_mask=attention_mask,
+                           cache=cache)
+        last_logits = out["logits"][:, -1]
+        rng0, rng_loop = jax.random.split(rng)
+        tok = sample_logits(last_logits, rng0, temperature, top_k, top_p)
+        finished = tok == eos
+        tok = jnp.where(finished, pad_token_id, tok)
+
+        def step(carry, _):
+            cache, tok, finished, rng = carry
+            rng, sub = jax.random.split(rng)
+            out = module.apply(params, input_ids=tok[:, None], cache=cache)
+            nxt = sample_logits(out["logits"][:, -1], sub, temperature, top_k, top_p)
+            newly_finished = finished | (nxt == eos)
+            nxt = jnp.where(finished, pad_token_id, jnp.where(nxt == eos, pad_token_id, nxt))
+            return (out["cache"], nxt, newly_finished, rng), nxt
+
+        (cache, _, _, _), rest = jax.lax.scan(
+            step, (out["cache"], tok, finished, rng_loop), None, length=max_new_tokens - 1
+        )
+        return jnp.concatenate([tok[:, None], rest.T], axis=1)
+
+    fn = jax.jit(run)
+    cache_store[key] = fn
+    return fn
+
+
+def _generate_streamed(model, input_ids, attention_mask, max_new_tokens,
+                       temperature, top_k, top_p, rng, eos, pad_token_id, cache_dtype):
+    """Per-token Python loop for offloaded models: every forward streams layer
+    weights host→HBM just-in-time (the model never fully resides on chip)."""
+    B, S = input_ids.shape
+    total = S + max_new_tokens
+    cache = model.init_cache(B, total, dtype=cache_dtype)
+    mask = attention_mask if attention_mask is not None else jnp.ones((B, S), jnp.int32)
+
+    input_ids, mask = left_align(input_ids, mask)
+    out = model(input_ids=input_ids, attention_mask=mask, cache=cache)
+    last_logits = out["logits"][:, -1]
+    rng, sub = jax.random.split(rng)
+    tok = sample_logits(last_logits, sub, temperature, top_k, top_p)
+    finished = tok == eos
+    tok = jnp.where(finished, pad_token_id, tok)
+    cache = out["cache"]
+
+    tokens = [tok]
+    for _ in range(max_new_tokens - 1):
+        rng, sub = jax.random.split(rng)
+        out = model(input_ids=tok[:, None], cache=cache)
+        cache = out["cache"]
+        nxt = sample_logits(out["logits"][:, -1], sub, temperature, top_k, top_p)
+        newly = finished | (nxt == eos)
+        nxt = jnp.where(finished | (nxt == eos), pad_token_id, nxt)
+        finished = newly
+        tokens.append(nxt)
+        tok = nxt
+    return jnp.stack(tokens, axis=1)

@@ -189,8 +189,17 @@ class Llama(Module):
         cos, sin = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
         return x, {"cos": cos, "sin": sin, "attention_mask": attention_mask}
 
-    def block(self, layer, x, ctx):
-        """One decoder layer on the residual stream (runs under scan or streamed)."""
+    def block(self, layer, x, ctx, cache_layer=None):
+        """One decoder layer on the residual stream (runs under scan or streamed).
+
+        With ``cache_layer`` (``{"k","v"}`` of shape (B, K, n_kv, D) plus
+        ``ctx["cache_pos"]``) the layer writes this chunk's K/V into the cache at
+        the write offset and attends against the whole cache — the incremental
+        decoding path (reference counterpart: transformers' KV cache driven by
+        the big_model_inference benchmark,
+        ``benchmarks/big_model_inference/big_model_inference.py``). Returns
+        ``(x, new_cache_layer)`` in that mode.
+        """
         cfg = self.config
         nh, nkv, hd = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
         B, S, _ = x.shape
@@ -201,18 +210,36 @@ class Llama(Module):
         v = (h @ layer["attn"]["wv"]).reshape(B, S, nkv, hd)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        if nkv != nh:
-            rep = nh // nkv
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
-        attn_out = _attention(
-            q, k, v, causal=True, mask=ctx["attention_mask"], impl=cfg.attention_impl
-        ).reshape(B, S, nh * hd)
-        x = x + attn_out @ layer["attn"]["wo"]
+        new_cache = None
+        if cache_layer is not None:
+            from ..ops.attention import cached_attention
+
+            pos = ctx["cache_pos"]
+            k_cache = jax.lax.dynamic_update_slice(
+                cache_layer["k"], k.astype(cache_layer["k"].dtype), (0, pos, 0, 0)
+            )
+            v_cache = jax.lax.dynamic_update_slice(
+                cache_layer["v"], v.astype(cache_layer["v"].dtype), (0, pos, 0, 0)
+            )
+            attn_out = cached_attention(
+                q, k_cache, v_cache,
+                q_positions=ctx["positions"],
+                kv_mask=ctx.get("kv_mask"),
+            )
+            new_cache = {"k": k_cache, "v": v_cache}
+        else:
+            if nkv != nh:
+                rep = nh // nkv
+                k = jnp.repeat(k, rep, axis=2)
+                v = jnp.repeat(v, rep, axis=2)
+            attn_out = _attention(
+                q, k, v, causal=True, mask=ctx["attention_mask"], impl=cfg.attention_impl
+            )
+        x = x + attn_out.reshape(B, S, nh * hd) @ layer["attn"]["wo"]
         h2 = rms_norm(x, layer["post_attn_norm"]["weight"], cfg.rms_norm_eps)
         gated = jax.nn.silu(h2 @ layer["mlp"]["w_gate"]) * (h2 @ layer["mlp"]["w_up"])
         x = x + gated @ layer["mlp"]["w_down"]
-        return x
+        return x if new_cache is None else (x, new_cache)
 
     def head(self, params, x, labels=None, attention_mask=None):
         """Final norm + LM head (+ shifted-label loss)."""
@@ -234,6 +261,21 @@ class Llama(Module):
             out["loss"] = cross_entropy_loss(logits, shifted)
         return out
 
+    # ------------------------------------------------------------------ cache
+    def init_cache(self, batch_size: int, max_len: int, dtype=jnp.bfloat16):
+        """Pre-allocated decode cache: static shapes so every decode step hits
+        the same compiled program. K/V stacked over layers to ride the same
+        ``lax.scan`` as training. ``kv_mask`` tracks which slots hold real
+        tokens (padding-aware); ``pos`` is the write offset."""
+        cfg = self.config
+        shape = (cfg.num_hidden_layers, batch_size, max_len, cfg.num_key_value_heads, cfg.head_dim)
+        return {
+            "k": jnp.zeros(shape, dtype),
+            "v": jnp.zeros(shape, dtype),
+            "pos": jnp.zeros((), jnp.int32),
+            "kv_mask": jnp.zeros((batch_size, max_len), jnp.int32),
+        }
+
     def apply(
         self,
         params,
@@ -241,11 +283,14 @@ class Llama(Module):
         labels=None,
         attention_mask=None,
         positions=None,
+        cache=None,
         train: bool = False,
         rngs=None,
         **kwargs,
     ):
         cfg = self.config
+        if cache is not None:
+            return self._apply_cached(params, input_ids, attention_mask, cache, labels=labels)
         x, ctx = self.embed(params, input_ids, positions, attention_mask)
 
         body = lambda x, layer: self.block(layer, x, ctx)
@@ -258,6 +303,35 @@ class Llama(Module):
 
         x, _ = jax.lax.scan(scan_step, x, params["layers"])
         return self.head(params, x, labels=labels, attention_mask=attention_mask)
+
+    def _apply_cached(self, params, input_ids, attention_mask, cache, labels=None):
+        """Prefill/decode forward through the KV cache. The chunk is written at
+        ``cache['pos']``; the output carries the advanced cache."""
+        B, S = input_ids.shape
+        pos = cache["pos"]
+        positions = pos + jnp.arange(S, dtype=jnp.int32)[None]
+        positions = jnp.broadcast_to(positions, (B, S))
+        chunk_mask = (
+            attention_mask.astype(jnp.int32)
+            if attention_mask is not None
+            else jnp.ones((B, S), jnp.int32)
+        )
+        kv_mask = jax.lax.dynamic_update_slice(cache["kv_mask"], chunk_mask, (0, pos))
+
+        x, ctx = self.embed(params, input_ids, positions, attention_mask)
+        ctx["positions"] = positions
+        ctx["kv_mask"] = kv_mask
+        ctx["cache_pos"] = pos
+
+        def scan_step(x, inp):
+            layer, ck, cv = inp
+            x, new = self.block(layer, x, ctx, cache_layer={"k": ck, "v": cv})
+            return x, (new["k"], new["v"])
+
+        x, (nk, nv) = jax.lax.scan(scan_step, x, (params["layers"], cache["k"], cache["v"]))
+        out = self.head(params, x, labels=labels, attention_mask=attention_mask)
+        out["cache"] = {"k": nk, "v": nv, "pos": pos + S, "kv_mask": kv_mask}
+        return out
 
     # -------------------------------------------------------------- estimation
     def num_params(self) -> int:

@@ -83,6 +83,36 @@ def flash_attention(q, k, v, *, causal=True, mask=None):
     return jnp.swapaxes(out, 1, 2)
 
 
+def cached_attention(q, k_cache, v_cache, *, q_positions, kv_mask=None):
+    """Attention of a query chunk against a pre-allocated KV cache (decode path).
+
+    q: (B, S, H, D); k_cache/v_cache: (B, K, Hkv, D) with H = G·Hkv (GQA).
+    q_positions: (S,) or (B, S) global positions of the queries.
+    kv_mask: (B, K) validity of cache slots (1 = real token). Slots beyond the
+    write offset are excluded by the causal comparison alone.
+
+    TPU shape notes: queries are grouped (B,S,Hkv,G,D) so the GQA repeat never
+    materializes — the einsum contracts each KV head against its G query heads
+    directly. For S=1 decode this is a bandwidth-bound GEMV over the cache,
+    which is the best any kernel can do; no flash kernel needed.
+    """
+    B, S, H, D = q.shape
+    K, Hkv = k_cache.shape[1], k_cache.shape[2]
+    G = H // Hkv
+    scale = 1.0 / np.sqrt(D)
+    qg = q.reshape(B, S, Hkv, G, D)
+    scores = jnp.einsum("bshgd,bkhd->bhgsk", qg, k_cache).astype(jnp.float32) * scale
+    if q_positions.ndim == 1:
+        q_positions = jnp.broadcast_to(q_positions[None], (B, S))
+    causal = q_positions[:, None, None, :, None] >= jnp.arange(K)[None, None, None, None, :]
+    bias = jnp.where(causal, 0.0, -1e30)
+    if kv_mask is not None:
+        bias = bias + jnp.where(kv_mask[:, None, None, None, :].astype(bool), 0.0, -1e30)
+    probs = jax.nn.softmax(scores + bias, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhgsk,bkhd->bshgd", probs, v_cache)
+    return out.reshape(B, S, H, D)
+
+
 def attention(q, k, v, *, causal=True, mask=None, impl: str = "auto", mesh=None):
     """Unified entry used by the model zoo. ``impl``: auto|dense|flash|ring."""
     if impl == "auto":
@@ -106,4 +136,4 @@ def _flash_shapes_ok(q, k) -> bool:
     # Mosaic flash wants seq multiples of the block sizes (min 128) and head_dim
     # aligned to lanes; fall back for tiny/test shapes.
     B, S, H, D = q.shape
-    return S >= 128 and S % 128 == 0 and D % 128 == 0 or (D in (64, 96, 128, 256) and S % 128 == 0 and S >= 128)
+    return (S >= 128 and S % 128 == 0) and (D % 128 == 0 or D in (64, 96, 256))

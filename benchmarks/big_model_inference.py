@@ -1,0 +1,133 @@
+"""Big-model-inference benchmark: load-time + s/token for dispatched models.
+
+Counterpart of the reference's ``benchmarks/big_model_inference/
+big_model_inference.py`` (load a checkpoint with a device_map — possibly
+CPU/disk-offloaded — and measure model load time and generation latency;
+published numbers in BASELINE.md's big-model table).
+
+Scenarios measured, each printed as one JSON line:
+  1. ``on_chip``      — checkpoint → load_checkpoint_and_dispatch(device_map
+     'auto') with everything HBM-resident; fused scan-decode generation.
+  2. ``cpu_offload``  — layers forced to host RAM, streamed per token
+     (StreamedScanModel double-buffered DMA) — the OPT-30B-style config.
+  3. ``disk_offload`` — layers memmapped from disk (GPT-NeoX-fp32-style).
+
+Usage: python benchmarks/big_model_inference.py [tiny|medium|1b|3b] [--tokens N]
+Default size: 1b on TPU, tiny elsewhere.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+SIZES = {
+    # name -> (hidden, inter, layers, heads, kv_heads, vocab)
+    "tiny": (64, 128, 2, 4, 2, 256),
+    "medium": (512, 1408, 8, 8, 4, 8192),
+    "1b": (2048, 5632, 22, 16, 4, 32000),
+    "3b": (3072, 8192, 26, 24, 8, 32000),
+}
+
+
+def build(size: str):
+    from accelerate_tpu.models import Llama, LlamaConfig
+
+    h, inter, L, nh, nkv, vocab = SIZES[size]
+    cfg = LlamaConfig(
+        vocab_size=vocab, hidden_size=h, intermediate_size=inter,
+        num_hidden_layers=L, num_attention_heads=nh, num_key_value_heads=nkv,
+        max_position_embeddings=2048,
+    )
+    return Llama(cfg)
+
+
+def run_scenario(name, size, checkpoint, device_map, offload_dir, prompt_len, n_tokens):
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu import load_checkpoint_and_dispatch
+    from accelerate_tpu.big_modeling import init_empty_weights
+    from accelerate_tpu.generation import generate
+
+    with init_empty_weights():
+        model = build(size)
+        model.init_params(jax.random.key(0))
+
+    t0 = time.perf_counter()
+    model = load_checkpoint_and_dispatch(
+        model, checkpoint, device_map=device_map, offload_folder=offload_dir
+    )
+    load_time = time.perf_counter() - t0
+
+    ids = np.random.default_rng(0).integers(
+        0, build(size).config.vocab_size, (1, prompt_len)
+    ).astype(np.int32)
+
+    # Warmup (compile) with a 2-token generation, then timed run.
+    generate(model, ids, max_new_tokens=2, cache_dtype=jnp.bfloat16).block_until_ready()
+    t0 = time.perf_counter()
+    out = generate(model, ids, max_new_tokens=n_tokens, cache_dtype=jnp.bfloat16)
+    out.block_until_ready()
+    gen_time = time.perf_counter() - t0
+
+    n_params = build(size).num_params()
+    print(json.dumps({
+        "scenario": name,
+        "model": f"llama-{size}",
+        "params": n_params,
+        "load_time_s": round(load_time, 3),
+        "s_per_token": round(gen_time / n_tokens, 4),
+        "tokens_per_s": round(n_tokens / gen_time, 2),
+        "backend": jax.default_backend(),
+    }))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("size", nargs="?", default=None, choices=list(SIZES))
+    parser.add_argument("--tokens", type=int, default=32)
+    parser.add_argument("--prompt-len", type=int, default=64)
+    parser.add_argument("--scenarios", default="on_chip,cpu_offload,disk_offload")
+    args = parser.parse_args()
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from bench import resolve_backend
+
+    backend = resolve_backend()
+    size = args.size or ("1b" if backend == "tpu" else "tiny")
+
+    import jax
+
+    from accelerate_tpu.checkpointing import export_full_weights
+
+    # Materialize a real checkpoint once so load time is measured honestly.
+    model = build(size)
+    model.init_params(jax.random.key(0))
+    tmp = tempfile.mkdtemp(prefix="bmi_ckpt_")
+    export_full_weights(model.params, tmp, max_shard_size="1GB")
+    del model
+
+    scenarios = {
+        "on_chip": ("auto", None),
+        "cpu_offload": ({"layers": "cpu", "embed": "tpu:0", "final_norm": "tpu:0",
+                         "lm_head": "tpu:0"}, None),
+        "disk_offload": ({"layers": "disk", "embed": "tpu:0", "final_norm": "tpu:0",
+                          "lm_head": "tpu:0"}, tempfile.mkdtemp(prefix="bmi_disk_")),
+    }
+    for name in args.scenarios.split(","):
+        device_map, offload_dir = scenarios[name]
+        run_scenario(name, size, tmp, device_map, offload_dir,
+                     args.prompt_len, args.tokens)
+
+
+if __name__ == "__main__":
+    main()
