@@ -60,14 +60,19 @@ class PipelinedModel:
     returns; reference wraps the pipeline driver into ``model.forward``
     inference.py:99-121)."""
 
-    def __init__(self, model, num_stages: int, devices, num_chunks: int, gather_output: bool):
+    def __init__(
+        self, model, num_stages: int, devices, num_chunks: int, gather_output: bool,
+        stage_ranges: list[tuple[int, int]] | None = None,
+    ):
         self.model = model
         self.num_chunks = num_chunks
         self.gather_output = gather_output
         self.devices = list(devices)[:num_stages]
+        if num_stages > len(self.devices):
+            raise ValueError(f"{num_stages} stages > {len(self.devices)} local devices")
         cfg = model.config
         num_layers = getattr(cfg, "num_hidden_layers", None) or getattr(cfg, "num_layers", None)
-        self.stage_ranges = generate_device_map(num_layers, num_stages)
+        self.stage_ranges = stage_ranges or generate_device_map(num_layers, num_stages)
         params = model.params
         if params is None:
             raise ValueError("Model has no params; call init_params / load weights first")
@@ -214,16 +219,11 @@ def prepare_pippy(
             raise ValueError(f"split points {split_points} out of range (0, {num_layers})")
         num_stages = len(bounds) + 1
         model_ranges = [0] + bounds + [num_layers]
-        wrapper = PipelinedModel(model, num_stages, devices, num_chunks or num_stages, gather_output)
-        wrapper.stage_ranges = [(model_ranges[i], model_ranges[i + 1]) for i in range(num_stages)]
-        params = model.params
-        wrapper.stage_layers = [
-            jax.device_put(_slice_stacked(params["layers"], a, b), wrapper.devices[s])
-            for s, (a, b) in enumerate(wrapper.stage_ranges)
-        ]
-        return wrapper
+        stage_ranges = [(model_ranges[i], model_ranges[i + 1]) for i in range(num_stages)]
+        return PipelinedModel(
+            model, num_stages, devices, num_chunks or num_stages, gather_output,
+            stage_ranges=stage_ranges,
+        )
     else:
         raise ValueError(f"Unsupported split_points: {split_points!r}")
-    if num_stages > len(devices):
-        raise ValueError(f"{num_stages} stages > {len(devices)} local devices")
     return PipelinedModel(model, num_stages, devices, num_chunks or num_stages, gather_output)

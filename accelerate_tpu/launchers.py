@@ -22,7 +22,6 @@ import traceback
 from .utils.constants import (
     ENV_COORDINATOR,
     ENV_CPU,
-    ENV_MESH_SHAPE,
     ENV_NUM_PROCESSES,
     ENV_PROCESS_ID,
 )
@@ -45,32 +44,69 @@ def notebook_launcher(
     processes; JAX needs one). When ``num_processes > 1`` on a CPU-only host we
     delegate to :func:`debug_launcher` semantics to simulate hosts.
     """
-    import jax
-
-    in_colab = "google.colab" in sys.modules
-    in_kaggle = "KAGGLE_KERNEL_RUN_TYPE" in os.environ
-    if (in_colab or in_kaggle) and os.environ.get("JAX_PLATFORMS", "") == "":
-        # Interactive TPU runtimes are already initialized; nothing to patch.
-        pass
     if mixed_precision not in ("no", "bf16", "fp16"):
         raise ValueError(f"Unknown mixed_precision mode: {mixed_precision}")
     os.environ.setdefault("ACCELERATE_MIXED_PRECISION", mixed_precision)
 
-    platform = jax.default_backend()
-    if platform in ("tpu", "gpu") or num_processes in (None, 0, 1):
+    if num_processes in (None, 0, 1):
         # One process drives all local devices — the JAX-native notebook path.
+        return function(*args)
+
+    # num_processes > 1: only a CPU host simulates multiple processes. Decide
+    # the platform WITHOUT initializing the XLA backend where we can — once a
+    # backend exists, debug_launcher loses its fork path (closures stop
+    # working, see _jax_backend_initialized).
+    env_platforms = os.environ.get("JAX_PLATFORMS", os.environ.get("JAX_PLATFORM_NAME", ""))
+    if env_platforms.split(",")[0].strip().lower() == "cpu" or os.environ.get(ENV_CPU):
+        return debug_launcher(function, args=args, num_processes=num_processes)
+
+    import jax
+
+    platform = jax.default_backend()
+    if platform in ("tpu", "gpu"):
         return function(*args)
     return debug_launcher(function, args=args, num_processes=num_processes)
 
 
-def _debug_worker(rank: int, num_processes: int, port: int, fn_path: str):
-    import pickle
-
+def _set_debug_env(rank: int, num_processes: int, port: int):
     os.environ[ENV_COORDINATOR] = f"127.0.0.1:{port}"
     os.environ[ENV_NUM_PROCESSES] = str(num_processes)
     os.environ[ENV_PROCESS_ID] = str(rank)
     os.environ[ENV_CPU] = "1"
     os.environ["JAX_PLATFORMS"] = "cpu"
+
+
+def _debug_worker_inline(rank: int, num_processes: int, port: int, function, args):
+    # fork start method: function/args are inherited by memory, never pickled,
+    # so lambdas and closures defined in notebooks/tests work. The parent may
+    # have constructed state singletons before forking — drop that inherited
+    # identity so this child reads its own env contract.
+    _set_debug_env(rank, num_processes, port)
+    from .state import AcceleratorState, GradientState
+
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+    function(*args)
+
+
+def _jax_backend_initialized() -> bool:
+    """True once any XLA backend exists in this process — after which forked
+    children inherit live XLA threads and ``jax.distributed.initialize`` refuses
+    to run, so fork is no longer safe."""
+    try:
+        from jax._src import xla_bridge
+
+        if hasattr(xla_bridge, "backends_are_initialized"):
+            return xla_bridge.backends_are_initialized()
+        return bool(getattr(xla_bridge, "_backends", None))
+    except Exception:
+        return "jax" in sys.modules
+
+
+def _debug_worker_pickled(rank: int, num_processes: int, port: int, fn_path: str):
+    import pickle
+
+    _set_debug_env(rank, num_processes, port)
     with open(fn_path, "rb") as f:
         function, args = pickle.load(f)
     function(*args)
@@ -80,9 +116,15 @@ def debug_launcher(function, args=(), num_processes: int = 2):
     """Fork ``num_processes`` CPU "hosts" on localhost and run ``function`` in each
     (reference ``debug_launcher`` :269-302, fake MASTER_ADDR=127.0.0.1 :295).
 
-    Uses fork-based multiprocessing so closures defined in tests/notebooks work
-    without being importable; each child becomes one JAX process in a
-    ``jax.distributed`` job rendezvousing on a random localhost port.
+    Uses fork-based multiprocessing where it is safe so closures defined in
+    tests/notebooks work without being importable (the reference uses
+    start_method='fork' for the same reason). Fork stops being safe the moment
+    this process initializes an XLA backend — forked children would inherit live
+    XLA threads and ``jax.distributed.initialize`` raises — so after any JAX
+    compute in the parent, and on fork-less platforms, we fall back to
+    spawn + pickle, which requires a picklable top-level function. Each child
+    becomes one JAX process in a ``jax.distributed`` job rendezvousing on a
+    random localhost port.
     """
     import multiprocessing
     import pickle
@@ -92,14 +134,39 @@ def debug_launcher(function, args=(), num_processes: int = 2):
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
 
-    ctx = multiprocessing.get_context("spawn")
-    with tempfile.NamedTemporaryFile(suffix=".pkl", delete=False) as f:
-        fn_path = f.name
-        pickle.dump((function, args), f)
+    use_fork = (
+        "fork" in multiprocessing.get_all_start_methods() and not _jax_backend_initialized()
+    )
+    fn_path = None
+    if use_fork:
+        ctx = multiprocessing.get_context("fork")
+    else:
+        ctx = multiprocessing.get_context("spawn")
+        with tempfile.NamedTemporaryFile(suffix=".pkl", delete=False) as f:
+            fn_path = f.name
+            try:
+                pickle.dump((function, args), f)
+            except (pickle.PicklingError, AttributeError, TypeError) as e:
+                raise RuntimeError(
+                    "debug_launcher must spawn fresh interpreters here (the JAX "
+                    "backend is already initialized in this process, so fork is "
+                    "unsafe), which requires a picklable top-level function. "
+                    "Either pass a module-level function, or call debug_launcher "
+                    "before any JAX computation so the fork path can run your "
+                    "closure."
+                ) from e
     procs = []
     try:
         for rank in range(num_processes):
-            p = ctx.Process(target=_debug_worker, args=(rank, num_processes, port, fn_path))
+            if use_fork:
+                p = ctx.Process(
+                    target=_debug_worker_inline,
+                    args=(rank, num_processes, port, function, args),
+                )
+            else:
+                p = ctx.Process(
+                    target=_debug_worker_pickled, args=(rank, num_processes, port, fn_path)
+                )
             p.start()
             procs.append(p)
         failed = []
@@ -113,7 +180,8 @@ def debug_launcher(function, args=(), num_processes: int = 2):
         for p in procs:
             if p.is_alive():
                 p.terminate()
-        try:
-            os.unlink(fn_path)
-        except OSError:
-            traceback.print_exc()
+        if fn_path is not None:
+            try:
+                os.unlink(fn_path)
+            except OSError:
+                traceback.print_exc()

@@ -79,7 +79,10 @@ class BertTrainStep(AbstractTrainStep):
             return outputs["loss"]
         logits = outputs["logits"]
         labels = batch["labels"]
-        if logits.ndim == 3:  # MLM: [B, S, V] vs token labels
+        if logits.ndim == 3:  # MLM: [B, S, V] vs token labels; mask padding
+            mask = batch.get("attention_mask")
+            if mask is not None:
+                labels = jnp.where(mask.astype(bool), labels, -100)
             loss = cross_entropy_loss(logits, labels)
         else:  # sequence classification: [B, num_labels]
             loss = cross_entropy_loss(logits, labels)

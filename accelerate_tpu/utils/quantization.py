@@ -29,7 +29,38 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-QUANT_KEY = "_quantized"  # marker key inside a quantized-leaf dict
+QUANT_KEY = "_quantized"  # marker key inside a legacy quantized-leaf dict
+
+
+@jax.tree_util.register_pytree_node_class
+class QuantizedTensor:
+    """A quantized weight leaf: int8 (or nibble-packed int4) data + per-channel
+    scales. Registered as a pytree node with ``bits``/logical ``shape`` as static
+    aux data, so quantized param trees flow through jit tracing, ``device_put``
+    tree_maps, and checkpoint flattening without scalar Python leaves polluting
+    the tree."""
+
+    def __init__(self, data, scale, bits: int, shape: tuple):
+        self.data = data
+        self.scale = scale
+        self.bits = int(bits)
+        self.shape = tuple(shape)
+
+    def tree_flatten(self):
+        return (self.data, self.scale), (self.bits, self.shape)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        data, scale = children
+        bits, shape = aux
+        return cls(data, scale, bits, shape)
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.data.nbytes + self.scale.nbytes)
+
+    def __repr__(self):
+        return f"QuantizedTensor(bits={self.bits}, shape={self.shape})"
 
 
 @dataclass
@@ -58,11 +89,14 @@ class QuantizationConfig:
         return jnp.dtype(self.compute_dtype)
 
 
-def quantize_leaf(w, bits: int) -> dict:
-    """Symmetric absmax per-channel quantization; channel = last axis."""
+def quantize_leaf(w, bits: int) -> QuantizedTensor:
+    """Symmetric absmax per-channel quantization; channel = last axis. Stacked
+    layers (ndim >= 3, leading axis = layer) keep per-layer scales — bnb
+    quantizes per matrix, so one outlier layer must not degrade the stack."""
     w = jnp.asarray(w)
     qmax = 127.0 if bits == 8 else 7.0
-    absmax = jnp.max(jnp.abs(w), axis=tuple(range(w.ndim - 1)), keepdims=True)
+    reduce_axes = tuple(range(1, w.ndim - 1)) if w.ndim >= 3 else tuple(range(w.ndim - 1))
+    absmax = jnp.max(jnp.abs(w), axis=reduce_axes, keepdims=True)
     scale = (absmax / qmax).astype(jnp.float32)
     scale = jnp.where(scale == 0, 1.0, scale)
     q = jnp.clip(jnp.round(w / scale), -qmax - 1, qmax).astype(jnp.int8)
@@ -75,12 +109,14 @@ def quantize_leaf(w, bits: int) -> dict:
         lo = flat[0::2] & 0x0F
         hi = (flat[1::2] & 0x0F) << 4
         q = (lo | hi).astype(jnp.int8)
-    return {QUANT_KEY: True, "bits": bits, "data": q, "scale": scale, "shape": tuple(w.shape)}
+    return QuantizedTensor(q, scale, bits, tuple(w.shape))
 
 
-def dequantize_leaf(leaf: dict, dtype=jnp.bfloat16):
-    q, scale, bits = leaf["data"], leaf["scale"], leaf["bits"]
-    shape = tuple(leaf["shape"])
+def dequantize_leaf(leaf, dtype=jnp.bfloat16):
+    if isinstance(leaf, QuantizedTensor):
+        q, scale, bits, shape = leaf.data, leaf.scale, leaf.bits, leaf.shape
+    else:  # legacy marker-dict form
+        q, scale, bits, shape = leaf["data"], leaf["scale"], leaf["bits"], tuple(leaf["shape"])
     if bits == 4:
         lo = (q & 0x0F).astype(jnp.int8)
         lo = jnp.where(lo > 7, lo - 16, lo)  # sign-extend nibble
@@ -93,6 +129,8 @@ def dequantize_leaf(leaf: dict, dtype=jnp.bfloat16):
 
 
 def is_quantized_leaf(x) -> bool:
+    if isinstance(x, QuantizedTensor):
+        return True
     return isinstance(x, dict) and x.get(QUANT_KEY) is True
 
 
@@ -105,8 +143,8 @@ def _should_quantize(name: str, leaf, config: QuantizationConfig) -> bool:
 
 
 def quantize_tree(params, config: QuantizationConfig):
-    """Quantize eligible leaves of a param pytree (quantized leaves become marker
-    dicts, which remain valid pytree nodes)."""
+    """Quantize eligible leaves of a param pytree (quantized leaves become
+    :class:`QuantizedTensor` pytree nodes)."""
     from .modeling import named_parameters
 
     flat = {}
@@ -119,8 +157,8 @@ def quantize_tree(params, config: QuantizationConfig):
 
 
 def _unflatten_with_quant(flat: dict, template):
-    """Like ``unflatten_names`` but quantized leaves expand the tree structure
-    (a leaf becomes a dict node), so rebuild nested dicts directly."""
+    """Like ``unflatten_names`` but rebuilds nested dicts directly; quantized
+    leaves are :class:`QuantizedTensor` values placed as-is."""
     out = {}
     for name, value in flat.items():
         parts = name.split(".")

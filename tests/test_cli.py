@@ -216,3 +216,37 @@ def test_parallelism_config_dp_zero_means_infer():
 
     sizes = ParallelismConfig(dp_size=0, tp_size=2).resolved_sizes(8)
     assert sizes["dp"] == 4 and sizes["tp"] == 2
+
+
+def test_debug_launcher_runs_closures(tmp_path):
+    """Regression for the fork-vs-spawn bug: closures (the documented use case,
+    reference debug_launcher start_method='fork') must survive the launch. Runs
+    in a fresh interpreter because fork is only offered before the parent
+    initializes an XLA backend — which this pytest process already has."""
+    import multiprocessing
+
+    if "fork" not in multiprocessing.get_all_start_methods():
+        pytest.skip("platform has no fork start method")
+    out = tmp_path / "ranks"
+    out.mkdir()
+    script = f"""
+import os
+from accelerate_tpu.launchers import debug_launcher
+
+def main():
+    marker = {str(out)!r}
+
+    def write_rank():  # a true closure — unpicklable, needs the fork path
+        rank = os.environ["ACCELERATE_PROCESS_ID"]
+        with open(os.path.join(marker, rank), "w") as f:
+            f.write("ok")
+
+    debug_launcher(write_rank, num_processes=2)
+
+main()
+"""
+    env = {k: v for k, v in os.environ.items() if not k.startswith("ACCELERATE_")}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    subprocess.run([sys.executable, "-c", script], env=env, check=True, timeout=180)
+    assert sorted(os.listdir(out)) == ["0", "1"]

@@ -34,7 +34,7 @@ def test_int8_roundtrip_error_bound():
     rng = np.random.default_rng(0)
     w = rng.normal(size=(64, 32)).astype(np.float32)
     q = quantize_leaf(w, 8)
-    assert q["data"].dtype == jnp.int8
+    assert q.data.dtype == jnp.int8
     back = np.asarray(dequantize_leaf(q, jnp.float32))
     # absmax int8: max error ~ absmax/127 per channel
     max_err = np.abs(w).max(axis=0) / 127
@@ -45,7 +45,7 @@ def test_int4_roundtrip_and_packing():
     rng = np.random.default_rng(1)
     w = rng.normal(size=(33, 16)).astype(np.float32)  # odd leading dim
     q = quantize_leaf(w, 4)
-    assert q["data"].size == (w.size + 1) // 2  # two nibbles per byte
+    assert q.data.size == (w.size + 1) // 2  # two nibbles per byte
     back = np.asarray(dequantize_leaf(q, jnp.float32))
     assert back.shape == w.shape
     max_err = np.abs(w).max(axis=0) / 7
@@ -91,6 +91,31 @@ def test_load_and_quantize_model_memory_and_forward():
     # int8 + bf16 compute: loose tolerance, but logits must correlate strongly.
     corr = np.corrcoef(got.ravel(), want.ravel())[0, 1]
     assert corr > 0.99, corr
+
+
+def test_stacked_layers_get_per_layer_scales():
+    rng = np.random.default_rng(2)
+    w = rng.normal(size=(4, 16, 8)).astype(np.float32)
+    w[2] *= 100.0  # one outlier layer must not degrade the others
+    q = quantize_leaf(w, 8)
+    assert q.scale.shape == (4, 1, 8)
+    back = np.asarray(dequantize_leaf(q, jnp.float32))
+    for layer in (0, 1, 3):
+        max_err = np.abs(w[layer]).max(axis=0) / 127
+        assert (np.abs(back[layer] - w[layer]) <= max_err[None, :] + 1e-6).all()
+
+
+def test_quantized_tree_is_valid_pytree():
+    params = {"a": {"w": jnp.arange(32.0).reshape(4, 8)}, "b": jnp.ones((3,))}
+    qt = quantize_tree(params, QuantizationConfig(load_in_8bit=True))
+    # tree_map over a quantized tree sees only array leaves (no Python scalars)
+    leaves = jax.tree_util.tree_leaves(qt)
+    assert all(hasattr(leaf, "dtype") for leaf in leaves), leaves
+    moved = jax.tree_util.tree_map(jax.device_put, qt)
+    assert is_quantized_leaf(moved["a"]["w"])
+    # ...and flows through jit tracing
+    out = jax.jit(lambda t: dequantize_tree(t, jnp.float32)["a"]["w"].sum())(qt)
+    np.testing.assert_allclose(float(out), np.arange(32.0).sum(), rtol=0.05)
 
 
 def test_quantized_checkpoint_requires_config_error():
