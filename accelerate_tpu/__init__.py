@@ -66,6 +66,10 @@ def __getattr__(name):
         from .inference import prepare_pippy
 
         return prepare_pippy
+    if name == "LocalSGD":
+        from .local_sgd import LocalSGD
+
+        return LocalSGD
     if name in ("generate", "sample_logits"):
         from . import generation
 

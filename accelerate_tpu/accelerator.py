@@ -631,13 +631,46 @@ class Accelerator:
         nothing dynamic to toggle here."""
         yield
 
+    def _optimizer_for_parameters(self, parameters):
+        """Resolve which prepared optimizer owns ``parameters`` (a PreparedModel,
+        its params pytree, or None). With one optimizer None is unambiguous; with
+        several it is an error — the reference clips exactly the tensors you pass
+        (``accelerator.py:2630``), so silently picking one would clip the wrong
+        model."""
+        if parameters is None:
+            if len(self._optimizers) > 1:
+                raise ValueError(
+                    "Multiple optimizers are prepared; pass the model (or its "
+                    "params) whose gradients should be clipped."
+                )
+            return self._optimizers[-1] if self._optimizers else None
+        handle = getattr(parameters, "handle", None)  # PreparedModel
+        for opt in self._optimizers:
+            if opt.handle is handle and handle is not None:
+                return opt
+            if opt.handle is not None and opt.handle.params is parameters:
+                return opt
+        # Match by pytree identity of any leaf (covers params trees that were
+        # rebuilt but share buffers) before giving up.
+        param_ids = {id(l) for l in jax.tree_util.tree_leaves(parameters)}
+        for opt in self._optimizers:
+            if opt.handle is None:
+                continue
+            opt_ids = {id(l) for l in jax.tree_util.tree_leaves(opt.handle.params)}
+            if param_ids & opt_ids:
+                return opt
+        raise ValueError(
+            "clip_grad_norm_ received parameters that do not belong to any "
+            "prepared optimizer; pass a model returned by prepare()."
+        )
+
     def clip_grad_norm_(self, parameters=None, max_norm: float = 1.0, norm_type: int = 2):
         """Register clipping for the pending update and return the pre-clip global
         norm of the currently-banked grads (reference :2630-2690; the XLA branch
         there hand-rolls all_reduce — GSPMD already made our grads global)."""
         if norm_type != 2:
             raise NotImplementedError("only the L2 global norm is supported on TPU")
-        opt = self._optimizers[-1] if self._optimizers else None
+        opt = self._optimizer_for_parameters(parameters)
         if opt is None or opt.grads is None:
             return jnp.float32(0.0)
         opt._pending_clip_norm = float(max_norm)
@@ -646,7 +679,7 @@ class Accelerator:
         return _global_norm(opt.grads)
 
     def clip_grad_value_(self, parameters, clip_value: float):
-        opt = self._optimizers[-1] if self._optimizers else None
+        opt = self._optimizer_for_parameters(parameters)
         if opt is None or opt.grads is None:
             return
         opt._accum_grads = jax.tree_util.tree_map(

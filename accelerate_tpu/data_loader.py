@@ -332,6 +332,7 @@ class DataLoaderShard(DataLoaderStateMixin):
         self._drop_last = _drop_last
         self.iteration = 0
         self._num_batches_fetched = 0
+        self._resume_batches = 0
         try:
             self.state = AcceleratorState()
         except Exception:
@@ -372,6 +373,10 @@ class DataLoaderShard(DataLoaderStateMixin):
 
     def set_epoch(self, epoch: int):
         if self.iteration != epoch:
+            # A restored mid-epoch position belongs to epoch `iteration`;
+            # switching to a different epoch invalidates it (otherwise the
+            # pending skip silently truncates the wrong epoch).
+            self._resume_batches = 0
             self.iteration = epoch
         if hasattr(self.base_loader, "set_epoch"):
             self.base_loader.set_epoch(epoch)
@@ -415,6 +420,12 @@ class DataLoaderShard(DataLoaderStateMixin):
             synchronize_rng_states(self.rng_types, self.synchronized_generator)
         self.set_epoch(self.iteration)
         iterator = iter(self.base_loader)
+        # One-shot mid-epoch resume (load_state_dict): skip to the saved
+        # position this epoch only; position counter starts there.
+        resume = self._resume_batches
+        self._resume_batches = 0
+        self._num_batches_fetched = resume
+        effective_skip = self.skip_batches + resume
         skipped = 0
         # Prefetch-one-ahead so the flag flips *on* the final batch, not after it
         # (reference :563-587) — grad accumulation must sync on the last batch.
@@ -430,7 +441,7 @@ class DataLoaderShard(DataLoaderStateMixin):
                 if not have_current:
                     break
             if have_current:
-                if skipped < self.skip_batches:
+                if skipped < effective_skip:
                     skipped += 1
                 else:
                     is_last = nxt is None
@@ -458,17 +469,27 @@ class DataLoaderShard(DataLoaderStateMixin):
             current = nxt
             have_current = True
         self.iteration += 1
+        # Natural exhaustion: the epoch is over, position resets (torchdata
+        # StatefulDataLoader semantics — a checkpoint taken *between* epochs
+        # resumes at the top of the next epoch, not mid-stream).
+        self._num_batches_fetched = 0
         self.end()
 
     # -------------------------------------------------- resume (stateful) API
     def state_dict(self):
-        """Minimal resume state: batches fetched this epoch + epoch counter —
-        feed to ``skip_first_batches`` (reference StatefulDataLoader passthrough
-        :444-497)."""
-        return {"num_batches_fetched": self._num_batches_fetched, "iteration": self.iteration}
+        """Position within the current epoch + epoch counter (reference
+        StatefulDataLoader passthrough ``data_loader.py:444-497``). Restoring
+        replays the same epoch's sampler order (seedable samplers re-derive it
+        from (seed, epoch)) and skips to the saved position. A just-restored,
+        not-yet-iterated loader reports its pending position so load→save
+        round-trips are idempotent (torchdata StatefulDataLoader semantics)."""
+        return {
+            "num_batches_fetched": max(self._num_batches_fetched, self._resume_batches),
+            "iteration": self.iteration,
+        }
 
     def load_state_dict(self, sd):
-        self.skip_batches = sd.get("num_batches_fetched", 0)
+        self._resume_batches = sd.get("num_batches_fetched", 0)
         self.iteration = sd.get("iteration", 0)
 
 
@@ -488,6 +509,8 @@ class DataLoaderDispatcher(DataLoaderStateMixin):
         self._drop_last = _drop_last
         self.gradient_state = GradientState()
         self.iteration = 0
+        self._num_batches_fetched = 0
+        self._resume_batches = 0
         try:
             self.state = AcceleratorState()
         except Exception:
@@ -514,6 +537,8 @@ class DataLoaderDispatcher(DataLoaderStateMixin):
         return max(len(self.base_loader) - self.skip_batches, 0)
 
     def set_epoch(self, epoch):
+        if self.iteration != epoch:
+            self._resume_batches = 0  # see DataLoaderShard.set_epoch
         self.iteration = epoch
         if hasattr(self.base_loader, "set_epoch"):
             self.base_loader.set_epoch(epoch)
@@ -544,25 +569,43 @@ class DataLoaderDispatcher(DataLoaderStateMixin):
         self.begin()
         iterator = iter(self.base_loader)
         state = self.state
+        resume = self._resume_batches
+        self._resume_batches = 0
+        self._num_batches_fetched = resume
+        effective_skip = self.skip_batches + resume
         skipped = 0
         prev = None
         have_prev = False
         while True:
             batch = self._fetch_and_scatter(iterator)
             if batch is None:
-                if have_prev:
+                if have_prev and skipped >= effective_skip:
                     self.end_of_dataloader = True
+                    self._num_batches_fetched += 1
                     yield self._emit(prev)
                 break
             if have_prev:
-                if skipped < self.skip_batches:
+                if skipped < effective_skip:
                     skipped += 1
                 else:
+                    self._num_batches_fetched += 1
                     yield self._emit(prev)
             prev = batch
             have_prev = True
         self.iteration += 1
+        self._num_batches_fetched = 0
         self.end()
+
+    # -------------------------------------------------- resume (stateful) API
+    def state_dict(self):
+        return {
+            "num_batches_fetched": max(self._num_batches_fetched, self._resume_batches),
+            "iteration": self.iteration,
+        }
+
+    def load_state_dict(self, sd):
+        self._resume_batches = sd.get("num_batches_fetched", 0)
+        self.iteration = sd.get("iteration", 0)
 
     def _emit(self, global_np_batch):
         """Each process slices its rows, then the global array is assembled."""
@@ -630,6 +673,12 @@ def skip_first_batches(dataloader, num_batches: int = 0):
 
         new_loader = copy.copy(dataloader)
         new_loader.skip_batches = dataloader.skip_batches + num_batches
+        # Explicit skip wins: don't compound with a pending stateful-resume
+        # position (load_state + skip_first_batches would otherwise double-skip
+        # this epoch, and the leftover pending position would silently truncate
+        # the source loader's next epoch).
+        new_loader._resume_batches = 0
+        dataloader._resume_batches = 0
         return new_loader
     return SkipDataLoader(dataloader, skip_batches=num_batches)
 

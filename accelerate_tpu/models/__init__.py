@@ -1,3 +1,4 @@
 from .bert import BertConfig, BertForSequenceClassification
 from .llama import Llama, LlamaConfig
 from .t5 import T5Config, T5ForConditionalGeneration
+from .vision import ConvNetConfig, ConvNetForImageClassification

@@ -1,0 +1,110 @@
+"""Small convolutional image classifier for the CV examples.
+
+Workload parity with the reference's ``examples/cv_example.py`` /
+``complete_cv_example.py`` (timm resnet50 fine-tuned on a pet-image folder,
+BASELINE.json configs[1]). The reference leans on a torch CNN zoo; here the CV
+example ships a compact TPU-first convnet instead: NHWC layout (XLA's native
+conv layout on TPU), ``lax.conv_general_dilated`` so the convs tile onto the
+MXU, fp32 GroupNorm (batch-size independent — works under any dp sharding),
+bf16-friendly matmul head, global average pooling.
+
+Returns ``loss`` when ``labels`` are present (HF convention the Accelerator
+relies on — see ``modules.default_loss_extractor``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..modules import ModelOutput, Module
+from ..ops.losses import cross_entropy_loss
+
+
+@dataclass
+class ConvNetConfig:
+    num_classes: int = 10
+    in_channels: int = 3
+    widths: tuple = (32, 64, 128)
+    norm_groups: int = 8
+    compute_dtype: str = "float32"
+
+    @classmethod
+    def tiny(cls, **kw):
+        defaults = dict(widths=(16, 32), norm_groups=4)
+        defaults.update(kw)
+        return cls(**defaults)
+
+
+def _group_norm(x, scale, bias, groups, eps=1e-5):
+    # fp32 statistics regardless of compute dtype (norms stay fp32 on TPU).
+    orig_dtype = x.dtype
+    n, h, w, c = x.shape
+    xg = x.astype(jnp.float32).reshape(n, h, w, groups, c // groups)
+    mean = xg.mean(axis=(1, 2, 4), keepdims=True)
+    var = xg.var(axis=(1, 2, 4), keepdims=True)
+    xg = (xg - mean) * jax.lax.rsqrt(var + eps)
+    x = xg.reshape(n, h, w, c) * scale + bias
+    return x.astype(orig_dtype)
+
+
+def _conv(x, kernel, stride):
+    return jax.lax.conv_general_dilated(
+        x,
+        kernel.astype(x.dtype),
+        window_strides=(stride, stride),
+        padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+class ConvNetForImageClassification(Module):
+    """Stacked conv → GroupNorm → relu stages (stride-2 downsample each), global
+    average pool, linear head."""
+
+    def __init__(self, config: ConvNetConfig):
+        self.config = config
+        self.params = None
+
+    def init(self, rng, *example_inputs, **kwargs):
+        cfg = self.config
+        keys = jax.random.split(rng, len(cfg.widths) + 1)
+        params = {"stages": [], "head": {}}
+        c_in = cfg.in_channels
+        for i, c_out in enumerate(cfg.widths):
+            fan_in = 3 * 3 * c_in
+            params["stages"].append(
+                {
+                    "kernel": jax.random.normal(keys[i], (3, 3, c_in, c_out), jnp.float32)
+                    * np.sqrt(2.0 / fan_in),
+                    "gn_scale": jnp.ones((c_out,), jnp.float32),
+                    "gn_bias": jnp.zeros((c_out,), jnp.float32),
+                }
+            )
+            c_in = c_out
+        params["head"] = {
+            "kernel": jax.random.normal(keys[-1], (c_in, cfg.num_classes), jnp.float32)
+            * np.sqrt(1.0 / c_in),
+            "bias": jnp.zeros((cfg.num_classes,), jnp.float32),
+        }
+        return params
+
+    def apply(self, params, pixel_values=None, labels=None, train: bool = False, rngs=None, **kwargs):
+        cfg = self.config
+        x = pixel_values.astype(jnp.dtype(cfg.compute_dtype))
+        for stage in params["stages"]:
+            x = _conv(x, stage["kernel"], stride=2)
+            x = _group_norm(x, stage["gn_scale"], stage["gn_bias"], cfg.norm_groups)
+            x = jax.nn.relu(x)
+        x = x.mean(axis=(1, 2))  # global average pool → (N, C)
+        logits = (
+            x.astype(jnp.float32) @ params["head"]["kernel"] + params["head"]["bias"]
+        )
+        out = ModelOutput(logits=logits)
+        if labels is not None:
+            out["loss"] = cross_entropy_loss(logits, labels)
+        return out

@@ -25,13 +25,15 @@ class AcceleratedScheduler:
         self.schedule = schedule
         self.optimizers = optimizers if isinstance(optimizers, (list, tuple)) else [optimizers]
         self.step_with_optimizer = step_with_optimizer
+        # API-parity no-op: the reference uses split_batches to decide between
+        # advancing 1 vs num_processes; here every step is a global step (see
+        # step() below) so the flag has no effect.
         self.split_batches = split_batches
         self.step_count = 0
         self._last_lr = float(np.asarray(schedule(0)))
-        from .state import AcceleratorState, GradientState
+        from .state import GradientState
 
         self.gradient_state = GradientState()
-        self.accelerator_state = AcceleratorState() if AcceleratorState._shared_state else None
 
     def step(self, *args, **kwargs):
         if not self.step_with_optimizer:
@@ -43,16 +45,16 @@ class AcceleratedScheduler:
         # Skip if any optimizer skipped (fp16 overflow; reference :73-81).
         if any(opt.step_was_skipped for opt in self.optimizers):
             return
-        if self.split_batches:
-            increment = 1
-        else:
-            # One global step consumes data-parallel-degree process-batches; a
-            # schedule authored in per-process steps advances that much (reference
-            # multiplies by num_processes for the same reason).
-            increment = (
-                self.accelerator_state.global_batch_divisor if self.accelerator_state is not None else 1
-            )
-        self._advance(increment)
+        # The reference advances by num_processes when batches aren't split
+        # (scheduler.py:60-81) because each torch process's loader shard yields
+        # num_processes× fewer batches than the single-process count schedules
+        # are authored against. Here the prepared loader yields *global*
+        # batches — every optimizer step is one global step on every process —
+        # so one schedule tick per step is already the same lr-vs-samples curve.
+        # (Scaling by the device-level dp×fsdp degree would exhaust the schedule
+        # mesh-size× early: a 192-step schedule would hit its floor at step 24
+        # on an 8-device mesh.)
+        self._advance(1)
 
     def _advance(self, increment: int):
         self.step_count += increment

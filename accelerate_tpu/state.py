@@ -268,7 +268,19 @@ class PartialState:
         if self.num_processes == 1:
             yield inputs
             return
-        length = len(inputs)
+        if isinstance(inputs, dict):
+            # Split each value's rows, not the dict's keys (reference :447-455).
+            lengths = {k: len(v) for k, v in inputs.items()}
+            if not lengths:
+                yield inputs
+                return
+            if len(set(lengths.values())) > 1:
+                raise ValueError(
+                    f"All dict values must share a length to split; got {lengths}"
+                )
+            length = next(iter(lengths.values()))
+        else:
+            length = len(inputs)
         split_sizes = [length // self.num_processes] * self.num_processes
         for i in range(length % self.num_processes):
             split_sizes[i] += 1

@@ -68,6 +68,7 @@ class RNGType(str, BaseEnum):
     JAX = "jax"
     NUMPY = "numpy"
     PYTHON = "python"
+    TORCH = "torch"
     GENERATOR = "generator"
 
 

@@ -33,6 +33,12 @@ def set_seed(seed: int, device_specific: bool = False, deterministic: bool = Fal
         seed += jax.process_index()
     random.seed(seed)
     np.random.seed(seed % (2**32))
+    try:
+        import torch
+
+        torch.manual_seed(seed)
+    except ImportError:
+        pass
     return jax.random.key(seed)
 
 
@@ -49,6 +55,15 @@ def synchronize_rng_state(rng_type: RNGType | str | None = None, generator=None)
         state = [np.random.get_state()]
         broadcast_object_list(state, from_process=0)
         np.random.set_state(state[0])
+    elif rng_type == RNGType.TORCH:
+        try:
+            import torch
+
+            state = [torch.get_rng_state().numpy()]
+            broadcast_object_list(state, from_process=0)
+            torch.set_rng_state(torch.from_numpy(state[0]))
+        except ImportError:
+            pass
     elif rng_type == RNGType.JAX:
         # JAX keys are pure data: nothing process-local to synchronize. Kept for
         # API parity; generators below cover the stateful host streams.

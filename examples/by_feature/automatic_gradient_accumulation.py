@@ -1,0 +1,93 @@
+"""Feature: memory-aware accumulation (reference ``by_feature/automatic_gradient_accumulation.py``).
+
+Combines ``find_executable_batch_size`` with gradient accumulation: keep the
+*observed* (global effective) batch size constant by raising
+``gradient_accumulation_steps`` whenever the per-step batch size is halved on
+OOM.
+
+Run:
+    python examples/by_feature/automatic_gradient_accumulation.py
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.test_utils import RegressionDataset, RegressionModel
+from accelerate_tpu.utils.memory import find_executable_batch_size
+
+
+def get_dataloader(batch_size):
+    import torch.utils.data as tud
+
+    def collate(items):
+        return {k: np.stack([it[k] for it in items]) for k in items[0]}
+
+    return tud.DataLoader(
+        RegressionDataset(length=128), batch_size=batch_size, shuffle=True,
+        drop_last=True, collate_fn=collate,
+    )
+
+
+def training_function(args):
+    import jax
+
+    observed_batch_sizes = []
+
+    @find_executable_batch_size(starting_batch_size=args.observed_batch_size)
+    def inner_training_loop(batch_size):
+        observed_batch_sizes.append(batch_size)
+        # Keep the effective batch constant: fewer rows per step → more
+        # accumulation steps (reference does exactly this arithmetic).
+        accumulation = args.observed_batch_size // batch_size
+        if args.simulate_oom_above and batch_size > args.simulate_oom_above:
+            raise RuntimeError("RESOURCE_EXHAUSTED: Out of memory (simulated)")
+        from accelerate_tpu.state import AcceleratorState, GradientState
+
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        accelerator = Accelerator(gradient_accumulation_steps=accumulation)
+        accelerator.free_memory()
+        model = RegressionModel()
+        model.init_params(jax.random.key(0))
+        train_dl = get_dataloader(min(batch_size, 32))
+        pmodel, optimizer, dl = accelerator.prepare(model, optax.sgd(0.2), train_dl)
+        pmodel.train()
+        for epoch in range(args.num_epochs):
+            dl.set_epoch(epoch)
+            for batch in dl:
+                with accelerator.accumulate(pmodel):
+                    outputs = pmodel(**batch)
+                    accelerator.backward(outputs["loss"])
+                    optimizer.step()
+                    optimizer.zero_grad()
+        sd = accelerator.get_state_dict(pmodel)
+        return accelerator, sd, accumulation
+
+    accelerator, params, accumulation = inner_training_loop()
+    a, b = float(params["a"]), float(params["b"])
+    accelerator.print(
+        f"batch sizes tried {observed_batch_sizes}; final accumulation {accumulation}; "
+        f"learned a={a:.3f} b={b:.3f}"
+    )
+    assert abs(a - 2.0) < 0.3 and abs(b - 3.0) < 0.3, (a, b)
+    accelerator.end_training()
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--observed_batch_size", type=int, default=128)
+    parser.add_argument("--simulate_oom_above", type=int, default=32)
+    parser.add_argument("--num_epochs", type=int, default=10)
+    training_function(parser.parse_args())
+
+
+if __name__ == "__main__":
+    main()

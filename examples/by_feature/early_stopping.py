@@ -1,0 +1,93 @@
+"""Feature: cross-process early stopping (reference ``by_feature/early_stopping.py``).
+
+Any process may call ``accelerator.set_trigger()`` (e.g. when its local loss
+dips under a threshold); ``accelerator.check_trigger()`` reduces the flag across
+processes so ALL ranks break together — no rank ever hangs in a collective the
+others left.
+
+Run:
+    python examples/by_feature/early_stopping.py
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.test_utils import RegressionDataset, RegressionModel
+
+
+def get_dataloader(batch_size):
+    import torch.utils.data as tud
+
+    def collate(items):
+        return {k: np.stack([it[k] for it in items]) for k in items[0]}
+
+    return tud.DataLoader(
+        RegressionDataset(length=128), batch_size=batch_size, shuffle=True,
+        drop_last=True, collate_fn=collate,
+    )
+
+
+class EarlyStoppingCallback:
+    def __init__(self, threshold, patience=2):
+        self.threshold = threshold
+        self.patience = patience
+        self.count = 0
+
+    def check_early_stopping(self, loss):
+        self.count = self.count + 1 if loss < self.threshold else 0
+        return self.count >= self.patience
+
+
+def training_function(args):
+    accelerator = Accelerator()
+    import jax
+
+    model = RegressionModel()
+    model.init_params(jax.random.key(0))
+    train_dl = get_dataloader(args.batch_size)
+    model, optimizer, train_dl = accelerator.prepare(model, optax.sgd(0.2), train_dl)
+    callback = EarlyStoppingCallback(threshold=args.loss_threshold)
+
+    stopped_at = None
+    step = 0
+    for epoch in range(args.num_epochs):
+        model.train()
+        train_dl.set_epoch(epoch)
+        for batch in train_dl:
+            with accelerator.accumulate(model):
+                outputs = model(**batch)
+                accelerator.backward(outputs["loss"])
+                if callback.check_early_stopping(float(outputs["loss"])):
+                    accelerator.set_trigger()
+                optimizer.step()
+                optimizer.zero_grad()
+            step += 1
+            if accelerator.check_trigger():
+                stopped_at = step
+                break
+        if stopped_at is not None:
+            break
+
+    accelerator.print(f"early-stopped at step {stopped_at} of {args.num_epochs * len(train_dl)}")
+    assert stopped_at is not None, "never triggered — loss_threshold too low?"
+    accelerator.end_training()
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch_size", type=int, default=16)
+    parser.add_argument("--num_epochs", type=int, default=20)
+    parser.add_argument("--loss_threshold", type=float, default=0.05)
+    training_function(parser.parse_args())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,75 @@
+"""Feature: gradient accumulation (reference ``by_feature/gradient_accumulation.py``).
+
+``Accelerator(gradient_accumulation_steps=N)`` + ``with accelerator.accumulate(model)``
+— grads are banked device-side each microbatch; the optimizer applies them every
+N-th step (sync_gradients flips on the boundary and on the final batch).
+
+Run:
+    python examples/by_feature/gradient_accumulation.py --gradient_accumulation_steps 4
+    accelerate-tpu launch examples/by_feature/gradient_accumulation.py
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.test_utils import RegressionDataset, RegressionModel
+
+
+def get_dataloader(batch_size):
+    import torch.utils.data as tud
+
+    def collate(items):
+        return {k: np.stack([it[k] for it in items]) for k in items[0]}
+
+    return tud.DataLoader(
+        RegressionDataset(length=128), batch_size=batch_size, shuffle=True,
+        drop_last=True, collate_fn=collate,
+    )
+
+
+def training_function(args):
+    accelerator = Accelerator(gradient_accumulation_steps=args.gradient_accumulation_steps)
+    import jax
+
+    model = RegressionModel()
+    model.init_params(jax.random.key(0))
+    train_dl = get_dataloader(args.batch_size)
+    model, optimizer, train_dl = accelerator.prepare(model, optax.sgd(0.2), train_dl)
+
+    for epoch in range(args.num_epochs):
+        model.train()
+        train_dl.set_epoch(epoch)
+        for batch in train_dl:
+            # accumulate() keeps banking grads; the optimizer only applies them
+            # when accelerator.sync_gradients is True (every N-th microbatch).
+            with accelerator.accumulate(model):
+                outputs = model(**batch)
+                accelerator.backward(outputs["loss"])
+                optimizer.step()
+                optimizer.zero_grad()
+
+    params = accelerator.get_state_dict(model)
+    a, b = float(params["a"]), float(params["b"])
+    accelerator.print(f"learned a={a:.3f} b={b:.3f} (target 2, 3)")
+    assert abs(a - 2.0) < 0.2 and abs(b - 3.0) < 0.2, (a, b)
+    accelerator.end_training()
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--gradient_accumulation_steps", type=int, default=4)
+    parser.add_argument("--batch_size", type=int, default=8)
+    parser.add_argument("--num_epochs", type=int, default=12)
+    training_function(parser.parse_args())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,83 @@
+"""Feature: automatic OOM-aware batch-size finder (reference ``by_feature/memory.py``).
+
+``find_executable_batch_size`` decorates the inner training function; on a
+RESOURCE_EXHAUSTED/OOM error it clears compiled caches and retries with the
+batch size halved. Everything inside must re-derive from ``batch_size``.
+
+Run:
+    python examples/by_feature/memory.py --starting_batch_size 256
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.test_utils import RegressionDataset, RegressionModel
+from accelerate_tpu.utils.memory import find_executable_batch_size
+
+
+def get_dataloader(batch_size):
+    import torch.utils.data as tud
+
+    def collate(items):
+        return {k: np.stack([it[k] for it in items]) for k in items[0]}
+
+    return tud.DataLoader(
+        RegressionDataset(length=128), batch_size=batch_size, shuffle=True,
+        drop_last=True, collate_fn=collate,
+    )
+
+
+def training_function(args):
+    accelerator = Accelerator()
+    import jax
+
+    observed = []
+
+    @find_executable_batch_size(starting_batch_size=args.starting_batch_size)
+    def inner_training_loop(batch_size):
+        observed.append(batch_size)
+        accelerator.free_memory()
+        # Simulate OOM at over-large sizes so the halving path is exercised even
+        # on hosts with plenty of memory (the reference relies on real CUDA OOM).
+        if args.simulate_oom_above and batch_size > args.simulate_oom_above:
+            raise RuntimeError("RESOURCE_EXHAUSTED: Out of memory (simulated)")
+        model = RegressionModel()
+        model.init_params(jax.random.key(0))
+        train_dl = get_dataloader(min(batch_size, 64))
+        pmodel, optimizer, dl = accelerator.prepare(model, optax.sgd(0.2), train_dl)
+        pmodel.train()
+        for epoch in range(args.num_epochs):
+            dl.set_epoch(epoch)
+            for batch in dl:
+                with accelerator.accumulate(pmodel):
+                    outputs = pmodel(**batch)
+                    accelerator.backward(outputs["loss"])
+                    optimizer.step()
+                    optimizer.zero_grad()
+        return accelerator.get_state_dict(pmodel)
+
+    params = inner_training_loop()
+    a, b = float(params["a"]), float(params["b"])
+    accelerator.print(f"tried batch sizes {observed}; learned a={a:.3f} b={b:.3f}")
+    assert abs(a - 2.0) < 0.3 and abs(b - 3.0) < 0.3, (a, b)
+    accelerator.end_training()
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--starting_batch_size", type=int, default=256)
+    parser.add_argument("--simulate_oom_above", type=int, default=64)
+    parser.add_argument("--num_epochs", type=int, default=10)
+    training_function(parser.parse_args())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,76 @@
+"""Feature: profiling (reference ``by_feature/profiler.py``).
+
+``accelerator.profile(ProfileKwargs(output_trace_dir=...))`` wraps the training
+loop in a ``jax.profiler`` trace — the XLA-native analog of torch.profiler; the
+resulting trace opens in TensorBoard or Perfetto.
+
+Run:
+    python examples/by_feature/profiler.py --trace_dir /tmp/profile_example
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.test_utils import RegressionDataset, RegressionModel
+from accelerate_tpu.utils.dataclasses import ProfileKwargs
+
+
+def get_dataloader(batch_size):
+    import torch.utils.data as tud
+
+    def collate(items):
+        return {k: np.stack([it[k] for it in items]) for k in items[0]}
+
+    return tud.DataLoader(
+        RegressionDataset(length=64), batch_size=batch_size, shuffle=True,
+        drop_last=True, collate_fn=collate,
+    )
+
+
+def training_function(args):
+    accelerator = Accelerator()
+    import jax
+
+    model = RegressionModel()
+    model.init_params(jax.random.key(0))
+    train_dl = get_dataloader(args.batch_size)
+    model, optimizer, train_dl = accelerator.prepare(model, optax.sgd(0.2), train_dl)
+
+    profile_kwargs = ProfileKwargs(output_trace_dir=args.trace_dir)
+    with accelerator.profile(profile_kwargs):
+        model.train()
+        for batch in train_dl:
+            with accelerator.accumulate(model):
+                outputs = model(**batch)
+                accelerator.backward(outputs["loss"])
+                optimizer.step()
+                optimizer.zero_grad()
+
+    if accelerator.is_main_process:
+        traces = []
+        for root, _dirs, files in os.walk(args.trace_dir):
+            traces += [f for f in files if f.endswith((".trace.json.gz", ".pb", ".xplane.pb"))]
+        accelerator.print(f"profiler wrote {len(traces)} trace file(s) under {args.trace_dir}")
+        assert traces, "no trace files produced"
+    accelerator.end_training()
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch_size", type=int, default=16)
+    parser.add_argument("--trace_dir", default="/tmp/accelerate_tpu_profile_example")
+    args = parser.parse_args()
+    os.makedirs(args.trace_dir, exist_ok=True)
+    training_function(args)
+
+
+if __name__ == "__main__":
+    main()

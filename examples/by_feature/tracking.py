@@ -1,0 +1,84 @@
+"""Feature: experiment tracking (reference ``by_feature/tracking.py``).
+
+``Accelerator(log_with=...)`` + ``init_trackers`` / ``log`` / ``end_training``.
+``log_with="all"`` resolves every tracker whose package is importable; the JSON
+tracker always works (writes ``logs/<project>/metrics.jsonl``).
+
+Run:
+    python examples/by_feature/tracking.py --project_dir /tmp/tracking_example
+"""
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.test_utils import RegressionDataset, RegressionModel
+
+
+def get_dataloader(batch_size):
+    import torch.utils.data as tud
+
+    def collate(items):
+        return {k: np.stack([it[k] for it in items]) for k in items[0]}
+
+    return tud.DataLoader(
+        RegressionDataset(length=128), batch_size=batch_size, shuffle=True,
+        drop_last=True, collate_fn=collate,
+    )
+
+
+def training_function(args):
+    accelerator = Accelerator(log_with="all", project_dir=args.project_dir)
+    accelerator.init_trackers("tracking_example", config={"lr": 0.2, "batch_size": args.batch_size})
+    import jax
+
+    model = RegressionModel()
+    model.init_params(jax.random.key(0))
+    train_dl = get_dataloader(args.batch_size)
+    model, optimizer, train_dl = accelerator.prepare(model, optax.sgd(0.2), train_dl)
+
+    overall_step = 0
+    for epoch in range(args.num_epochs):
+        model.train()
+        train_dl.set_epoch(epoch)
+        total_loss = 0.0
+        for batch in train_dl:
+            with accelerator.accumulate(model):
+                outputs = model(**batch)
+                total_loss += float(outputs["loss"])
+                accelerator.backward(outputs["loss"])
+                optimizer.step()
+                optimizer.zero_grad()
+            overall_step += 1
+        accelerator.log(
+            {"train_loss": total_loss / len(train_dl), "epoch": epoch}, step=overall_step
+        )
+    accelerator.end_training()
+
+    metrics_file = os.path.join(args.project_dir, "tracking_example", "metrics.jsonl")
+    if accelerator.is_main_process and os.path.isfile(metrics_file):
+        rows = [json.loads(line) for line in open(metrics_file)]
+        accelerator.print(f"JSON tracker recorded {len(rows)} rows; last: {rows[-1]}")
+        assert len(rows) >= args.num_epochs
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch_size", type=int, default=16)
+    parser.add_argument("--num_epochs", type=int, default=4)
+    parser.add_argument("--project_dir", default="/tmp/accelerate_tpu_tracking_example")
+    args = parser.parse_args()
+    os.makedirs(args.project_dir, exist_ok=True)
+    training_function(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,166 @@
+"""Complete NLP example — the flagship loop plus every production feature.
+
+Mirrors the reference's ``examples/complete_nlp_example.py``: argparse surface
+(``--with_tracking``, ``--checkpointing_steps`` int-or-"epoch",
+``--resume_from_checkpoint``, ``--output_dir``), ``ProjectConfiguration``,
+``save_state``/``load_state`` with mid-epoch resume via ``skip_first_batches``,
+tracker logging of loss/accuracy, and the canonical prepared-objects loop.
+Synthetic key-match data stands in for GLUE/MRPC (see ``nlp_example.py``).
+
+Run:
+    python examples/complete_nlp_example.py --with_tracking --checkpointing_steps epoch
+    accelerate-tpu launch examples/complete_nlp_example.py --checkpointing_steps 50
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.models import BertConfig, BertForSequenceClassification
+from accelerate_tpu.utils import set_seed
+from accelerate_tpu.utils.dataclasses import ProjectConfiguration
+
+from nlp_example import SEQ_LEN, get_dataloaders
+
+
+def training_function(config, args):
+    project_config = ProjectConfiguration(
+        project_dir=args.output_dir, logging_dir=os.path.join(args.output_dir, "logs")
+    )
+    accelerator = Accelerator(
+        mixed_precision=args.mixed_precision,
+        log_with="all" if args.with_tracking else None,
+        project_config=project_config,
+    )
+    if args.with_tracking:
+        accelerator.init_trackers("complete_nlp_example", config)
+
+    lr, num_epochs, batch_size = config["lr"], config["num_epochs"], config["batch_size"]
+    set_seed(config["seed"])
+
+    import jax
+
+    model_cfg = BertConfig.tiny(
+        vocab_size=config["vocab_size"], max_position_embeddings=SEQ_LEN, hidden_dropout_prob=0.0
+    )
+    model = BertForSequenceClassification(model_cfg)
+    model.init_params(jax.random.key(config["seed"]))
+
+    train_dl, eval_dl = get_dataloaders(accelerator, batch_size, config["vocab_size"])
+    # Loaders first: the schedule horizon is authored in global optimizer steps
+    # = len(prepared loader) (raw length over-counts by num_processes).
+    train_dl, eval_dl = accelerator.prepare(train_dl, eval_dl)
+    schedule = optax.linear_schedule(lr, 0.1 * lr, num_epochs * len(train_dl))
+    optimizer = optax.inject_hyperparams(optax.adamw)(learning_rate=lr)
+
+    model, optimizer, scheduler = accelerator.prepare(model, optimizer, schedule)
+
+    # ---------------------------------------------------------------- resume
+    starting_epoch = 0
+    resume_step = None
+    if args.resume_from_checkpoint:
+        ckpt_path = args.resume_from_checkpoint
+        if ckpt_path in (True, "latest", ""):
+            dirs = [
+                os.path.join(args.output_dir, d) for d in os.listdir(args.output_dir)
+                if d.startswith(("epoch_", "step_"))
+            ]
+            ckpt_path = max(dirs, key=os.path.getmtime)  # most recently written
+        accelerator.print(f"Resumed from checkpoint: {ckpt_path}")
+        # load_state restores model/optimizer/scheduler/RNG AND the dataloader
+        # position: the loaders are stateful, so the next iteration over
+        # train_dl automatically resumes mid-epoch — no manual skip needed.
+        accelerator.load_state(ckpt_path)
+        training_difference = os.path.splitext(os.path.basename(ckpt_path))[0]
+        if "epoch" in training_difference:
+            starting_epoch = int(training_difference.replace("epoch_", "")) + 1
+        else:
+            resume_step = int(training_difference.replace("step_", ""))
+            starting_epoch = resume_step // len(train_dl)
+            resume_step -= starting_epoch * len(train_dl)
+
+    overall_step = starting_epoch * len(train_dl)
+    accuracy = 0.0
+    for epoch in range(starting_epoch, num_epochs):
+        model.train()
+        train_dl.set_epoch(epoch)
+        total_loss = 0.0
+        if args.resume_from_checkpoint and epoch == starting_epoch and resume_step is not None:
+            overall_step += resume_step  # the stateful loader skips these itself
+        for batch in train_dl:
+            with accelerator.accumulate(model):
+                outputs = model(**batch)
+                loss = outputs["loss"]
+                total_loss += float(loss)
+                accelerator.backward(loss)
+                optimizer.step()
+                scheduler.step()
+                optimizer.zero_grad()
+            overall_step += 1
+
+            if isinstance(args.checkpointing_steps, int) and overall_step % args.checkpointing_steps == 0:
+                output_dir = os.path.join(args.output_dir, f"step_{overall_step}")
+                accelerator.save_state(output_dir)
+
+        model.eval()
+        correct = total = 0
+        for batch in eval_dl:
+            labels = batch.pop("labels")
+            outputs = model(**batch)
+            preds = np.argmax(np.asarray(outputs["logits"]), axis=-1)
+            preds, refs = accelerator.gather_for_metrics((preds, labels))
+            correct += int((np.asarray(preds) == np.asarray(refs)).sum())
+            total += len(np.asarray(refs))
+        accuracy = correct / total
+        accelerator.print(f"epoch {epoch}: accuracy {accuracy:.3f}")
+        if args.with_tracking:
+            accelerator.log(
+                {
+                    "accuracy": accuracy,
+                    "train_loss": total_loss / max(len(train_dl), 1),
+                    "epoch": epoch,
+                },
+                step=overall_step,
+            )
+        if args.checkpointing_steps == "epoch":
+            output_dir = os.path.join(args.output_dir, f"epoch_{epoch}")
+            accelerator.save_state(output_dir)
+
+    accelerator.end_training()
+    return accuracy
+
+
+def main():
+    parser = argparse.ArgumentParser(description="accelerate-tpu complete nlp example")
+    parser.add_argument("--mixed_precision", default="no", choices=["no", "bf16", "fp16"])
+    parser.add_argument("--num_epochs", type=int, default=5)
+    parser.add_argument("--batch_size", type=int, default=32)
+    parser.add_argument("--output_dir", default=".accelerate_example_output")
+    parser.add_argument(
+        "--checkpointing_steps", default=None,
+        help='Save state every N steps (int) or "epoch".',
+    )
+    parser.add_argument(
+        "--resume_from_checkpoint", default=None, nargs="?", const="latest",
+        help='Checkpoint folder to resume from, or "latest".',
+    )
+    parser.add_argument("--with_tracking", action="store_true")
+    args = parser.parse_args()
+    if args.checkpointing_steps is not None and args.checkpointing_steps != "epoch":
+        args.checkpointing_steps = int(args.checkpointing_steps)
+    os.makedirs(args.output_dir, exist_ok=True)
+    config = {"lr": 1e-3, "num_epochs": args.num_epochs, "seed": 42,
+              "batch_size": args.batch_size, "vocab_size": 128}
+    training_function(config, args)
+
+
+if __name__ == "__main__":
+    main()

@@ -2,15 +2,20 @@
 
 Mirrors the reference's ``examples/nlp_example.py`` (bert-base on GLUE/MRPC)
 structure: ``get_dataloaders`` → ``training_function`` → argparse ``main``, with
-the familiar loop::
+the canonical loop over prepared objects::
 
-    outputs = model(**batch); accelerator.backward(outputs["loss"])
-    optimizer.step(); scheduler.step(); optimizer.zero_grad()
+    model, optimizer, train_dl, eval_dl, scheduler = accelerator.prepare(...)
+    for batch in train_dl:
+        outputs = model(**batch); accelerator.backward(outputs.loss)
+        optimizer.step(); scheduler.step(); optimizer.zero_grad()
 
 Data is synthetic (this environment has no network): token-pair sequences whose
-binary label is "do segment A and segment B start with the same token" — a task
-a 2-layer attention model learns to >95% accuracy in a few epochs, playing the
-role MRPC plays in the reference.
+binary label is "do segment A and segment B start with the same key token" — a
+task a 2-layer attention model learns to >90% accuracy in a few epochs, playing
+the role MRPC plays in the reference. The loaders are real
+``torch.utils.data.DataLoader`` objects and go through ``prepare`` so the full
+data layer (BatchSamplerShard → DataLoaderShard → global sharded arrays) is
+exercised, exactly as the reference example exercises its sharded samplers.
 
 Run (any of):
     python examples/nlp_example.py
@@ -30,51 +35,67 @@ import optax
 
 from accelerate_tpu import Accelerator
 from accelerate_tpu.models import BertConfig, BertForSequenceClassification
+from accelerate_tpu.utils import set_seed
 
-SEQ_LEN = 32
+SEQ_LEN = 16
 SEG = SEQ_LEN // 2
-
-
 NUM_KEYS = 8  # key symbols live in token ids [5, 5+NUM_KEYS)
 
 
-def make_split(rng, size, vocab_size):
-    ids = rng.integers(5 + NUM_KEYS, vocab_size, (size, SEQ_LEN)).astype(np.int32)
-    labels = rng.integers(0, 2, (size,)).astype(np.int32)
-    # Each segment opens with a key symbol; the label is whether the two keys
-    # match (positives share it, negatives are forced to differ).
-    key_a = rng.integers(0, NUM_KEYS, size)
-    ids[:, 0] = 5 + key_a
-    ids[:, SEG] = 5 + np.where(
-        labels == 1, key_a, (key_a + 1 + rng.integers(0, NUM_KEYS - 1, size)) % NUM_KEYS
+class KeyMatchDataset:
+    """Map-style synthetic dataset (torch Dataset protocol)."""
+
+    def __init__(self, size, vocab_size, seed):
+        rng = np.random.default_rng(seed)
+        ids = rng.integers(5 + NUM_KEYS, vocab_size, (size, SEQ_LEN)).astype(np.int32)
+        labels = rng.integers(0, 2, (size,)).astype(np.int32)
+        # Each segment opens with a key symbol; the label is whether the two
+        # keys match (positives share it, negatives are forced to differ).
+        key_a = rng.integers(0, NUM_KEYS, size)
+        ids[:, 0] = 5 + key_a
+        ids[:, SEG] = 5 + np.where(
+            labels == 1, key_a, (key_a + 1 + rng.integers(0, NUM_KEYS - 1, size)) % NUM_KEYS
+        )
+        self.ids = ids
+        self.labels = labels
+        self.token_type = np.concatenate(
+            [np.zeros((size, SEG), np.int32), np.ones((size, SEG), np.int32)], axis=1
+        )
+
+    def __len__(self):
+        return len(self.labels)
+
+    def __getitem__(self, i):
+        return {
+            "input_ids": self.ids[i],
+            "token_type_ids": self.token_type[i],
+            "labels": self.labels[i],
+        }
+
+
+def get_dataloaders(accelerator, batch_size, vocab_size, train_size=2048, eval_size=512):
+    """Build torch DataLoaders; ``prepare`` shards them across processes (the
+    reference builds tokenized MRPC loaders the same way)."""
+    import torch.utils.data as tud
+
+    def collate(items):
+        return {k: np.stack([it[k] for it in items]) for k in items[0]}
+
+    train_ds = KeyMatchDataset(train_size, vocab_size, seed=42)
+    eval_ds = KeyMatchDataset(eval_size, vocab_size, seed=7)
+    train_dl = tud.DataLoader(
+        train_ds, batch_size=batch_size, shuffle=True, drop_last=True, collate_fn=collate
     )
-    token_type = np.concatenate(
-        [np.zeros((size, SEG), np.int32), np.ones((size, SEG), np.int32)], axis=1
+    eval_dl = tud.DataLoader(
+        eval_ds, batch_size=batch_size, shuffle=False, drop_last=True, collate_fn=collate
     )
-    return {"input_ids": ids, "token_type_ids": token_type, "labels": labels}
-
-
-def get_dataloaders(accelerator, batch_size, vocab_size):
-    """Build per-process dataloaders (reference builds tokenized MRPC loaders and
-    lets ``prepare`` shard them; synthetic arrays play that role here)."""
-    rng = np.random.default_rng(42)
-    train, test = make_split(rng, 2048, vocab_size), make_split(rng, 512, vocab_size)
-
-    def batches(split, bs, seed):
-        order_rng = np.random.default_rng(seed)
-        idx = order_rng.permutation(len(split["labels"]))
-        for start in range(0, len(idx) - bs + 1, bs):
-            take = idx[start : start + bs]
-            yield {k: v[take] for k, v in split.items()}
-
-    train_loader = lambda epoch: batches(train, batch_size, seed=epoch)  # noqa: E731
-    eval_loader = lambda: batches(test, batch_size, seed=0)  # noqa: E731
-    return train_loader, eval_loader
+    return train_dl, eval_dl
 
 
 def training_function(config, args):
     accelerator = Accelerator(mixed_precision=args.mixed_precision)
     lr, num_epochs, batch_size = config["lr"], config["num_epochs"], config["batch_size"]
+    set_seed(config["seed"])  # python/numpy/torch (shuffle order) + returns a JAX key
 
     model_cfg = BertConfig.tiny(
         vocab_size=config["vocab_size"], max_position_embeddings=SEQ_LEN, hidden_dropout_prob=0.0
@@ -84,19 +105,23 @@ def training_function(config, args):
 
     model.init_params(jax.random.key(config["seed"]))
 
-    steps_per_epoch = 2048 // batch_size
-    schedule = optax.linear_schedule(lr, 0.1 * lr, num_epochs * steps_per_epoch)
+    train_dl, eval_dl = get_dataloaders(accelerator, batch_size, config["vocab_size"])
+    # Prepare the loaders first: the schedule horizon must be authored in
+    # *global* optimizer steps, which is the prepared loader's length (the raw
+    # loader's length over-counts by num_processes under multi-process launch).
+    train_dl, eval_dl = accelerator.prepare(train_dl, eval_dl)
+    schedule = optax.linear_schedule(lr, 0.1 * lr, num_epochs * len(train_dl))
     # Constant lr inside the transform; AcceleratedScheduler writes the schedule
     # value through each real optimizer step (scheduler.py docstring).
     optimizer = optax.inject_hyperparams(optax.adamw)(learning_rate=lr)
 
-    train_loader, eval_loader = get_dataloaders(accelerator, batch_size, config["vocab_size"])
     model, optimizer, scheduler = accelerator.prepare(model, optimizer, schedule)
 
+    accuracy = 0.0
     for epoch in range(num_epochs):
         model.train()
-        for batch in train_loader(epoch):
-            batch = accelerator.prepare_batch(batch) if hasattr(accelerator, "prepare_batch") else batch
+        train_dl.set_epoch(epoch)
+        for batch in train_dl:
             with accelerator.accumulate(model):
                 outputs = model(**batch)
                 accelerator.backward(outputs["loss"])
@@ -106,25 +131,27 @@ def training_function(config, args):
 
         model.eval()
         correct = total = 0
-        for batch in eval_loader():
+        for batch in eval_dl:
             labels = batch.pop("labels")
             outputs = model(**batch)
             preds = np.argmax(np.asarray(outputs["logits"]), axis=-1)
             preds, refs = accelerator.gather_for_metrics((preds, labels))
             correct += int((np.asarray(preds) == np.asarray(refs)).sum())
             total += len(np.asarray(refs))
-        accelerator.print(f"epoch {epoch}: accuracy {correct / total:.3f}")
-    return correct / total
+        accuracy = correct / total
+        accelerator.print(f"epoch {epoch}: accuracy {accuracy:.3f}")
+    accelerator.end_training()
+    return accuracy
 
 
 def main():
     parser = argparse.ArgumentParser(description="accelerate-tpu nlp example")
     parser.add_argument("--mixed_precision", default="no", choices=["no", "bf16", "fp16"])
-    parser.add_argument("--num_epochs", type=int, default=3)
+    parser.add_argument("--num_epochs", type=int, default=5)
     parser.add_argument("--batch_size", type=int, default=32)
     args = parser.parse_args()
-    config = {"lr": 3e-3, "num_epochs": args.num_epochs, "seed": 42,
-              "batch_size": args.batch_size, "vocab_size": 512}
+    config = {"lr": 1e-3, "num_epochs": args.num_epochs, "seed": 42,
+              "batch_size": args.batch_size, "vocab_size": 128}
     acc = training_function(config, args)
     assert acc > 0.8, f"model failed to learn (accuracy {acc:.3f})"
 

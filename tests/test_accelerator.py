@@ -138,8 +138,9 @@ def test_scheduler_steps_with_optimizer():
             popt.step()
             psched.step()
             popt.zero_grad()
-    # Two microbatches = one real step; scheduler advanced once (by dp degree 8).
-    assert psched.step_count == 8
+    # Two microbatches = one real step; scheduler ticks exactly once (the
+    # prepared loader yields global batches, so no num_processes scaling).
+    assert psched.step_count == 1
     assert popt.learning_rate is not None
 
 
@@ -179,3 +180,31 @@ def test_set_trigger_roundtrip():
     accelerator.set_trigger()
     assert accelerator.check_trigger()
     assert not accelerator.check_trigger()
+
+
+def test_clip_grad_norm_targets_the_right_model():
+    """With two prepared models, clip_grad_norm_ must clip the one whose
+    parameters are passed — and refuse the ambiguous no-argument form
+    (round-1 weakness: it silently clipped self._optimizers[-1])."""
+    accelerator, model_a, tx, dl = make_setup()
+    model_b = RegressionModel()
+    model_b.init_params(jax.random.key(1))
+    pa, oa = accelerator.prepare(model_a, optax.sgd(0.5))
+    pb, ob = accelerator.prepare(model_b, optax.sgd(0.5))
+    batch = regression_batches(RegressionDataset(length=16), batch_size=16)[0]
+    with accelerator.accumulate(pa, pb):
+        out_a = pa(**batch)
+        accelerator.backward(out_a.loss)
+        out_b = pb(**batch)
+        accelerator.backward(out_b.loss)
+        with pytest.raises(ValueError, match="Multiple optimizers"):
+            accelerator.clip_grad_norm_(max_norm=1.0)
+        norm_a = accelerator.clip_grad_norm_(pa, max_norm=1e-8)
+        assert float(norm_a) > 0
+        oa.step(); ob.step(); oa.zero_grad(); ob.zero_grad()
+    sd_a = accelerator.get_state_dict(pa)
+    sd_b = accelerator.get_state_dict(pb)
+    assert abs(float(sd_a["a"])) < 1e-6          # clipped to nothing
+    assert abs(float(sd_b["a"])) > 1e-4          # stepped normally
+    with pytest.raises(ValueError, match="do not belong"):
+        accelerator.clip_grad_norm_({"z": jnp.zeros(3)}, max_norm=1.0)

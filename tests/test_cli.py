@@ -250,3 +250,19 @@ main()
     env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
     subprocess.run([sys.executable, "-c", script], env=env, check=True, timeout=180)
     assert sorted(os.listdir(out)) == ["0", "1"]
+
+
+def test_distributed_parity_script_two_processes():
+    """The bundled `accelerate test` assert script must pass on a real
+    2-process CPU rendezvous (reference runs test_script.py the same way)."""
+    env = {k: v for k, v in os.environ.items() if not k.startswith("ACCELERATE_")}
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "accelerate_tpu.commands.launch", "--cpu",
+            "--num_processes", "2", "-m", "accelerate_tpu.test_utils.test_script",
+        ],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO_ROOT,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:] + proc.stdout[-2000:]
+    assert "All distributed asserts passed." in proc.stdout

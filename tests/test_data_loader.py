@@ -215,3 +215,56 @@ def test_dataloader_shard_end_flags():
     assert flags == [False, False, True]
     # after iteration the loader deregisters
     assert gs.active_dataloader is None
+
+
+def test_dispatcher_skip_overrun_yields_nothing():
+    """A resume position at/past the end must not re-emit the final batch
+    (code-review finding: the end-of-stream branch skipped the skip check)."""
+    from accelerate_tpu.data_loader import DataLoaderDispatcher
+
+    batches = [{"x": np.arange(4) + 4 * i} for i in range(3)]
+    d = DataLoaderDispatcher(batches, put_on_device=False)
+    d.load_state_dict({"num_batches_fetched": 3, "iteration": 0})
+    assert [b for b in d] == []
+    # And a fresh epoch afterwards is full-length again.
+    assert len([b for b in d]) == 3
+
+
+def test_skip_first_batches_does_not_compound_with_stateful_resume():
+    """load_state + skip_first_batches must skip exactly once, and the source
+    loader's next epoch must start at the top (code-review finding)."""
+    from accelerate_tpu.data_loader import DataLoaderShard, skip_first_batches
+
+    batches = [{"x": np.arange(4) + 4 * i} for i in range(8)]
+    dl = DataLoaderShard(batches, put_on_device=False)
+    dl.load_state_dict({"num_batches_fetched": 3, "iteration": 0})
+    active = skip_first_batches(dl, 3)
+    got = [int(np.asarray(b["x"])[0]) for b in active]
+    assert got == [12, 16, 20, 24, 28], got  # batches 3..7, not 6..7
+    nxt = [int(np.asarray(b["x"])[0]) for b in dl]
+    assert nxt == [0, 4, 8, 12, 16, 20, 24, 28], nxt  # full epoch, no leak
+
+
+def test_set_epoch_invalidates_restored_position():
+    """A restored mid-epoch position belongs to its own epoch; set_epoch to a
+    different epoch must clear it (code-review finding: an end-of-epoch
+    checkpoint would otherwise wipe out the whole next epoch)."""
+    from accelerate_tpu.data_loader import DataLoaderShard
+
+    batches = [{"x": np.arange(4) + 4 * i} for i in range(3)]
+    dl = DataLoaderShard(batches, put_on_device=False)
+    dl.load_state_dict({"num_batches_fetched": 3, "iteration": 0})
+    dl.set_epoch(1)
+    assert len(list(dl)) == 3  # full epoch
+
+
+def test_state_dict_idempotent_after_load():
+    """load_state_dict → state_dict must round-trip the position even before
+    any iteration (torchdata StatefulDataLoader semantics)."""
+    from accelerate_tpu.data_loader import DataLoaderDispatcher, DataLoaderShard
+
+    for cls in (DataLoaderShard, DataLoaderDispatcher):
+        batches = [{"x": np.arange(4)} for _ in range(4)]
+        dl = cls(batches, put_on_device=False)
+        dl.load_state_dict({"num_batches_fetched": 2, "iteration": 0})
+        assert dl.state_dict()["num_batches_fetched"] == 2, cls.__name__

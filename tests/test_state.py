@@ -156,3 +156,11 @@ def test_split_between_processes_padding_helper():
     assert out == [3, 3]
     out = _pad_with_last(np.array([[1, 2]]), 1, fallback=np.array([[0, 0], [9, 9]]))
     assert out.shape == (2, 2) and np.all(out[1] == [1, 2])
+
+
+def test_split_between_processes_empty_dict():
+    from accelerate_tpu.state import PartialState
+
+    state = PartialState()
+    with state.split_between_processes({}) as shard:
+        assert shard == {}
