@@ -13,6 +13,7 @@ from .estimate import estimate_command_parser
 from .launch import launch_command_parser
 from .merge import merge_command_parser
 from .test import test_command_parser
+from .tpu import tpu_command_parser
 
 
 def main() -> None:
@@ -27,6 +28,7 @@ def main() -> None:
     estimate_command_parser(subparsers=subparsers)
     merge_command_parser(subparsers=subparsers)
     test_command_parser(subparsers=subparsers)
+    tpu_command_parser(subparsers=subparsers)
 
     args = parser.parse_args()
     if not hasattr(args, "func"):

@@ -1,0 +1,154 @@
+"""``accelerate-tpu tpu-config`` — fan a command out to every pod host.
+
+Reference parity: ``src/accelerate/commands/tpu.py:29-152`` (gcloud tpu-vm ssh
+--worker=all). TPU-first extension: pods not managed through gcloud (bare-metal
+SSH lists, k8s jump hosts) are covered by ``--pod_hosts host1,host2,...`` which
+fans the same command over plain ``ssh``. ``--debug`` prints the exact
+command(s) without executing — the testable dry-run mode.
+
+Config-file defaults come from the ``accelerate-tpu config`` yaml: keys
+``tpu_name``, ``tpu_zone``, ``pod_hosts``, ``commands``, ``command_file`` are
+read from the file's extra fields.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+
+from .config_args import default_config_file, load_config_from_file
+
+_description = "Run commands on a TPU pod (every worker at once)."
+
+
+def tpu_command_parser(subparsers=None):
+    if subparsers is not None:
+        parser = subparsers.add_parser("tpu-config", description=_description)
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu tpu-config", description=_description)
+    config_args = parser.add_argument_group(
+        "Config Arguments", "Arguments that can be configured through `accelerate-tpu config`."
+    )
+    config_args.add_argument("--config_file", type=str, default=None, help="Config yaml to read defaults from.")
+    config_args.add_argument("--tpu_name", default=None, help="Name of the (gcloud) TPU to use.")
+    config_args.add_argument("--tpu_zone", default=None, help="GCE zone of the TPU.")
+    config_args.add_argument(
+        "--pod_hosts", default=None,
+        help="Comma-separated SSH targets; fan out over plain ssh instead of gcloud.",
+    )
+    pod_args = parser.add_argument_group("TPU Arguments", "Options run inside the pod.")
+    pod_args.add_argument(
+        "--use_alpha", action="store_true", help="Use `gcloud alpha` instead of `gcloud`."
+    )
+    pod_args.add_argument(
+        "--command_file", default=None, help="File with commands to run on each worker (one per line)."
+    )
+    pod_args.add_argument(
+        "--command", action="append", nargs="+", help="A command to run; repeatable."
+    )
+    pod_args.add_argument(
+        "--install_accelerate", action="store_true",
+        help="Prepend a pip install of this framework on each worker.",
+    )
+    pod_args.add_argument(
+        "--accelerate_version", default="latest",
+        help='Version to install ("latest", "dev", or a pin like "==0.1.0").',
+    )
+    pod_args.add_argument(
+        "--debug", action="store_true", help="Print the command instead of running it."
+    )
+    if subparsers is not None:
+        parser.set_defaults(func=tpu_command_launcher)
+    return parser
+
+
+def _flatten_commands(command_arg) -> list[str]:
+    """argparse `append`+`nargs='+'` yields [[...], ...]; join each group."""
+    commands = []
+    for group in command_arg or []:
+        commands.append(" ".join(group) if isinstance(group, (list, tuple)) else str(group))
+    return commands
+
+
+def tpu_command_launcher(args):
+    defaults = None
+    if args.config_file is not None or os.path.isfile(default_config_file):
+        defaults = load_config_from_file(args.config_file)
+    if defaults is not None:
+        extra = defaults.extra or {}
+        if not args.command_file and not args.command and extra.get("command_file"):
+            args.command_file = extra["command_file"]
+        if not args.command and extra.get("commands"):
+            args.command = [[c] if isinstance(c, str) else c for c in extra["commands"]]
+        if not args.tpu_name:
+            args.tpu_name = extra.get("tpu_name")
+        if not args.tpu_zone:
+            args.tpu_zone = extra.get("tpu_zone")
+        if not args.pod_hosts and extra.get("pod_hosts"):
+            hosts = extra["pod_hosts"]
+            args.pod_hosts = ",".join(hosts) if isinstance(hosts, (list, tuple)) else hosts
+
+    commands = _flatten_commands(args.command)
+    if args.command_file:
+        with open(args.command_file) as f:
+            commands = [line.strip() for line in f if line.strip()] + commands
+    if args.install_accelerate:
+        if args.accelerate_version == "dev":
+            install = "pip install git+https://github.com/accelerate-tpu/accelerate-tpu"
+        elif args.accelerate_version == "latest":
+            install = "pip install -U accelerate-tpu"
+        else:
+            version = args.accelerate_version.strip()
+            if version and version[0] not in "=<>!~":
+                version = f"=={version}"  # bare "0.1.0" → "==0.1.0"
+            install = f"pip install accelerate-tpu{version}"
+        commands = [install] + commands
+    if not commands:
+        raise ValueError(
+            "No commands given: pass --command, --command_file, or configure "
+            "`commands` via `accelerate-tpu config`."
+        )
+    joined = "; ".join(commands)
+
+    if args.pod_hosts:
+        hosts = [h.strip() for h in str(args.pod_hosts).split(",") if h.strip()]
+        cmds = [["ssh", host, joined] for host in hosts]
+        label = f"{len(hosts)} pod hosts"
+    else:
+        if not args.tpu_name or not args.tpu_zone:
+            raise ValueError(
+                "tpu-config needs --tpu_name and --tpu_zone (or --pod_hosts / "
+                "config-file defaults)."
+            )
+        gcloud = ["gcloud", "alpha"] if args.use_alpha else ["gcloud"]
+        cmds = [
+            gcloud
+            + [
+                "compute", "tpus", "tpu-vm", "ssh", args.tpu_name,
+                "--zone", args.tpu_zone,
+                "--command", joined,
+                "--worker", "all",
+            ]
+        ]
+        label = f"TPU {args.tpu_name}"
+
+    if args.debug:
+        for cmd in cmds:
+            print(f"Running {' '.join(cmd)}")
+        return
+    procs = [subprocess.Popen(cmd) for cmd in cmds]  # all workers in parallel
+    failures = [p.wait() for p in procs]
+    if any(failures):
+        raise RuntimeError(f"tpu-config: {sum(1 for f in failures if f)} host command(s) failed")
+    print(f"Successfully ran commands on {label}.")
+
+
+def main():
+    parser = tpu_command_parser()
+    args = parser.parse_args()
+    tpu_command_launcher(args)
+
+
+if __name__ == "__main__":
+    main()

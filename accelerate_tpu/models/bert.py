@@ -116,14 +116,19 @@ class BertForSequenceClassification(Module):
         return params
 
     def sharding_rules(self):
+        # Leading layer-stack dim sharded on pp (stage placement; trivial when
+        # pp=1) — same scheme as the Llama rules.
         return [
             (r"embeddings/word", P("tp", "fsdp")),
-            (r"attn/w[qkv]", P(None, "fsdp", "tp")),
-            (r"attn/b[qkv]", P(None, "tp")),
-            (r"attn/wo", P(None, "tp", "fsdp")),
-            (r"mlp/w_in", P(None, "fsdp", "tp")),
-            (r"mlp/b_in", P(None, "tp")),
-            (r"mlp/w_out", P(None, "tp", "fsdp")),
+            (r"attn/w[qkv]", P("pp", "fsdp", "tp")),
+            (r"attn/b[qkv]", P("pp", "tp")),
+            (r"attn/wo", P("pp", "tp", "fsdp")),
+            (r"attn/bo", P("pp")),
+            (r"mlp/w_in", P("pp", "fsdp", "tp")),
+            (r"mlp/b_in", P("pp", "tp")),
+            (r"mlp/w_out", P("pp", "tp", "fsdp")),
+            (r"mlp/b_out", P("pp")),
+            (r"layers/.*norm", P("pp")),
             (r"norm|pooler|classifier", P()),
         ]
 

@@ -162,13 +162,21 @@ class Llama(Module):
 
     # --------------------------------------------------------------- sharding
     def sharding_rules(self):
-        """Megatron-style tp + complementary fsdp. Leading scan dim unsharded."""
+        """Megatron-style tp + complementary fsdp + pipeline stages.
+
+        The leading scan (layer-stack) dim is sharded on ``pp``: each pipeline
+        stage owns a contiguous block of layers (GSPMD inserts the stage-to-stage
+        transfers as the scan crosses shard boundaries). With ``pp=1`` the axis
+        is trivial and the spec degenerates to unsharded — one rule set serves
+        every mesh. Per-layer norm scales ride the same ``pp`` placement.
+        """
         return [
             (r"embed/weight", P("tp", "fsdp")),
-            (r"attn/w[qkv]", P(None, "fsdp", "tp")),
-            (r"attn/wo", P(None, "tp", "fsdp")),
-            (r"mlp/w_(gate|up)", P(None, "fsdp", "tp")),
-            (r"mlp/w_down", P(None, "tp", "fsdp")),
+            (r"attn/w[qkv]", P("pp", "fsdp", "tp")),
+            (r"attn/wo", P("pp", "tp", "fsdp")),
+            (r"mlp/w_(gate|up)", P("pp", "fsdp", "tp")),
+            (r"mlp/w_down", P("pp", "tp", "fsdp")),
+            (r"layers/.*norm", P("pp")),
             (r"norm", P()),
             (r"lm_head/weight", P("fsdp", "tp")),
         ]

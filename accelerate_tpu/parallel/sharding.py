@@ -67,6 +67,29 @@ def _spec_fits(shape, spec: P, mesh: Mesh) -> bool:
     return True
 
 
+def _relax_spec(shape, spec: P, mesh: Mesh) -> P:
+    """Drop only the mesh axes that don't divide their dim, keeping the rest.
+
+    A rule asking ``P('pp','fsdp','tp')`` for a 3-layer stack on pp=2 keeps the
+    fsdp/tp placement instead of losing the whole rule to the auto plan (which
+    would silently drop tensor parallelism for that leaf). Per dim, axes are
+    kept greedily left-to-right while their combined size still divides.
+    """
+    relaxed = []
+    for dim, axes in zip(shape, tuple(spec) + (None,) * (len(shape) - len(spec))):
+        if axes is None:
+            relaxed.append(None)
+            continue
+        kept, prod = [], 1
+        for ax in axes if isinstance(axes, tuple) else (axes,):
+            size = mesh.shape.get(ax, 1)
+            if dim % (prod * size) == 0:
+                kept.append(ax)
+                prod *= size
+        relaxed.append(tuple(kept) if len(kept) > 1 else (kept[0] if kept else None))
+    return P(*relaxed)
+
+
 def batch_spec(mesh: Mesh, extra_dims: int = 0) -> P:
     """Leading-dim batch sharding over the combined data axes."""
     return P(("dp", "fsdp"), *([None] * extra_dims))
@@ -107,6 +130,14 @@ def plan_param_shardings(
             if pat.search(name):
                 if _spec_fits(shape, spec, mesh):
                     return NamedSharding(mesh, spec)
+                relaxed = _relax_spec(shape, spec, mesh)
+                if any(ax is not None for ax in relaxed):
+                    logger.warning(
+                        "sharding rule %s -> %s does not divide param %s%s; "
+                        "relaxed to %s (non-dividing axes dropped)",
+                        pat.pattern, spec, name, shape, relaxed,
+                    )
+                    return NamedSharding(mesh, relaxed)
                 logger.warning(
                     "sharding rule %s -> %s does not divide param %s%s; using auto plan",
                     pat.pattern,

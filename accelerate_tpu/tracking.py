@@ -18,6 +18,7 @@ from functools import wraps
 from typing import Any
 
 from .logging import get_logger
+from .utils.imports import _is_package_available
 from .state import PartialState
 
 logger = get_logger(__name__)
@@ -187,12 +188,7 @@ class WandBTracker(GeneralTracker):
 
     @classmethod
     def is_available(cls) -> bool:
-        try:
-            import wandb  # noqa
-
-            return True
-        except ImportError:
-            return False
+        return _is_package_available("wandb")
 
     @property
     def tracker(self):
@@ -235,12 +231,7 @@ class MLflowTracker(GeneralTracker):
 
     @classmethod
     def is_available(cls) -> bool:
-        try:
-            import mlflow  # noqa
-
-            return True
-        except ImportError:
-            return False
+        return _is_package_available("mlflow")
 
     @property
     def tracker(self):
@@ -267,7 +258,192 @@ class MLflowTracker(GeneralTracker):
         mlflow.end_run()
 
 
-LOGGER_TYPE_TO_CLASS = {"json": JSONTracker, "tensorboard": TensorBoardTracker, "wandb": WandBTracker, "mlflow": MLflowTracker}
+@_register
+class CometMLTracker(GeneralTracker):
+    """Reference :414-506 — Experiment lifecycle, log_metrics/log_parameters."""
+
+    name = "comet_ml"
+    requires_logging_directory = False
+
+    @on_main_process
+    def __init__(self, run_name: str, **kwargs):
+        super().__init__()
+        import comet_ml
+
+        self.run_name = run_name
+        self.writer = comet_ml.Experiment(project_name=run_name, **kwargs)
+
+    @classmethod
+    def is_available(cls) -> bool:
+        return _is_package_available("comet_ml")
+
+    @property
+    def tracker(self):
+        return self.writer
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        self.writer.log_parameters(values)
+
+    @on_main_process
+    def log(self, values: dict, step: int | None = None, **kwargs):
+        if step is not None:
+            self.writer.set_step(step)
+        for k, v in values.items():
+            if isinstance(v, (int, float)) or hasattr(v, "__float__"):
+                self.writer.log_metric(k, float(v), step=step, **kwargs)
+            elif isinstance(v, str):
+                self.writer.log_other(k, v)
+            elif isinstance(v, dict):
+                self.writer.log_metrics(v, step=step, **kwargs)
+
+    @on_main_process
+    def finish(self):
+        self.writer.end()
+
+
+@_register
+class AimTracker(GeneralTracker):
+    """Reference :508-609 — aim.Run with hparams + track()."""
+
+    name = "aim"
+    requires_logging_directory = True
+
+    @on_main_process
+    def __init__(self, run_name: str, logging_dir: str | None = ".", **kwargs):
+        super().__init__()
+        import aim
+
+        self.run_name = run_name
+        self.writer = aim.Run(repo=logging_dir, **kwargs)
+        self.writer.name = run_name
+
+    @classmethod
+    def is_available(cls) -> bool:
+        return _is_package_available("aim")
+
+    @property
+    def tracker(self):
+        return self.writer
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        self.writer["hparams"] = values
+
+    @on_main_process
+    def log(self, values: dict, step: int | None = None, **kwargs):
+        for k, v in values.items():
+            self.writer.track(v, name=k, step=step, **kwargs)
+
+    @on_main_process
+    def finish(self):
+        self.writer.close()
+
+
+@_register
+class ClearMLTracker(GeneralTracker):
+    """Reference :818-974 — Task.init + report_scalar with title/series split."""
+
+    name = "clearml"
+    requires_logging_directory = False
+
+    @on_main_process
+    def __init__(self, run_name: str | None = None, **kwargs):
+        super().__init__()
+        from clearml import Task
+
+        kwargs.setdefault("project_name", os.environ.get("CLEARML_PROJECT", run_name))
+        kwargs.setdefault("task_name", os.environ.get("CLEARML_TASK", run_name))
+        self.task = Task.init(**kwargs)
+
+    @classmethod
+    def is_available(cls) -> bool:
+        return _is_package_available("clearml")
+
+    @property
+    def tracker(self):
+        return self.task
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        return self.task.connect_configuration(values)
+
+    @on_main_process
+    def log(self, values: dict, step: int | None = None, **kwargs):
+        clearml_logger = self.task.get_logger()
+        for k, v in values.items():
+            if not isinstance(v, (int, float)) and not hasattr(v, "__float__"):
+                continue
+            # Split only the known split prefixes (reference :969-973):
+            # "train_loss" → title "loss", series "train"; everything else
+            # keeps its full name as the title under the default "train" series.
+            title, series = k, "train"
+            for prefix in ("eval", "test", "train"):
+                if k.startswith(prefix + "_"):
+                    title, series = k[len(prefix) + 1 :], prefix
+                    break
+            if step is None:
+                clearml_logger.report_single_value(name=k, value=float(v), **kwargs)
+            else:
+                clearml_logger.report_scalar(
+                    title=title, series=series, value=float(v), iteration=step, **kwargs
+                )
+
+    @on_main_process
+    def finish(self):
+        if self.task:
+            self.task.close()
+
+
+@_register
+class DVCLiveTracker(GeneralTracker):
+    """Reference :976-1088 — dvclive.Live log_params/log_metric/next_step."""
+
+    name = "dvclive"
+    requires_logging_directory = False
+
+    @on_main_process
+    def __init__(self, run_name: str | None = None, live=None, **kwargs):
+        super().__init__()
+        from dvclive import Live
+
+        self.live = live if live is not None else Live(**kwargs)
+
+    @classmethod
+    def is_available(cls) -> bool:
+        return _is_package_available("dvclive")
+
+    @property
+    def tracker(self):
+        return self.live
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        self.live.log_params(values)
+
+    @on_main_process
+    def log(self, values: dict, step: int | None = None, **kwargs):
+        if step is not None:
+            self.live.step = step
+        for k, v in values.items():
+            if isinstance(v, (int, float)) or hasattr(v, "__float__"):
+                self.live.log_metric(k, float(v), **kwargs)
+
+    @on_main_process
+    def finish(self):
+        self.live.end()
+
+
+LOGGER_TYPE_TO_CLASS = {
+    "json": JSONTracker,
+    "tensorboard": TensorBoardTracker,
+    "wandb": WandBTracker,
+    "mlflow": MLflowTracker,
+    "comet_ml": CometMLTracker,
+    "aim": AimTracker,
+    "clearml": ClearMLTracker,
+    "dvclive": DVCLiveTracker,
+}
 
 
 def filter_trackers(log_with, logging_dir: str | None = None):

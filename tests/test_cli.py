@@ -266,3 +266,93 @@ def test_distributed_parity_script_two_processes():
     )
     assert proc.returncode == 0, proc.stderr[-3000:] + proc.stdout[-2000:]
     assert "All distributed asserts passed." in proc.stdout
+
+
+# --------------------------------------------------------------- tpu-config
+@pytest.fixture(autouse=True)
+def _no_user_default_config(monkeypatch, tmp_path):
+    """Keep tpu-config tests hermetic: never read a real user-level default
+    config (its pod_hosts/commands extras would change which branch runs)."""
+    import accelerate_tpu.commands.tpu as tpu_mod
+
+    monkeypatch.setattr(tpu_mod, "default_config_file", str(tmp_path / "no_default.yaml"))
+
+
+def _tpu_args(argv):
+    from accelerate_tpu.commands.tpu import tpu_command_parser
+
+    return tpu_command_parser().parse_args(argv)
+
+
+def test_tpu_config_gcloud_debug(capsys):
+    from accelerate_tpu.commands.tpu import tpu_command_launcher
+
+    args = _tpu_args([
+        "--tpu_name", "my-pod", "--tpu_zone", "us-central2-b",
+        "--command", "echo", "hello", "--command", "uptime", "--debug",
+    ])
+    tpu_command_launcher(args)
+    out = capsys.readouterr().out
+    assert "gcloud compute tpus tpu-vm ssh my-pod" in out
+    assert "--zone us-central2-b" in out
+    assert "echo hello; uptime" in out
+    assert "--worker all" in out
+
+
+def test_tpu_config_pod_hosts_debug(capsys):
+    from accelerate_tpu.commands.tpu import tpu_command_launcher
+
+    args = _tpu_args(["--pod_hosts", "host1,host2", "--command", "hostname", "--debug"])
+    tpu_command_launcher(args)
+    out = capsys.readouterr().out
+    assert "ssh host1 hostname" in out and "ssh host2 hostname" in out
+
+
+def test_tpu_config_install_and_command_file(tmp_path, capsys):
+    from accelerate_tpu.commands.tpu import tpu_command_launcher
+
+    cmd_file = tmp_path / "cmds.txt"
+    cmd_file.write_text("echo one\necho two\n")
+    args = _tpu_args([
+        "--tpu_name", "p", "--tpu_zone", "z", "--command_file", str(cmd_file),
+        "--install_accelerate", "--accelerate_version", "==0.1.0", "--debug",
+    ])
+    tpu_command_launcher(args)
+    out = capsys.readouterr().out
+    assert "pip install accelerate-tpu==0.1.0; echo one; echo two" in out
+
+
+def test_tpu_config_defaults_from_config_file(tmp_path, capsys):
+    import yaml
+
+    from accelerate_tpu.commands.tpu import tpu_command_launcher
+
+    cfg = tmp_path / "cfg.yaml"
+    yaml.safe_dump(
+        {"compute_environment": "TPU", "tpu_name": "cfg-pod", "tpu_zone": "eu-west4-a",
+         "commands": ["echo from-config"]},
+        open(cfg, "w"),
+    )
+    args = _tpu_args(["--config_file", str(cfg), "--debug"])
+    tpu_command_launcher(args)
+    out = capsys.readouterr().out
+    assert "cfg-pod" in out and "--zone eu-west4-a" in out and "echo from-config" in out
+
+
+def test_tpu_config_requires_commands():
+    from accelerate_tpu.commands.tpu import tpu_command_launcher
+
+    args = _tpu_args(["--tpu_name", "p", "--tpu_zone", "z", "--debug"])
+    with pytest.raises(ValueError, match="No commands given"):
+        tpu_command_launcher(args)
+
+
+def test_tpu_config_bare_version_gets_pinned(capsys):
+    from accelerate_tpu.commands.tpu import tpu_command_launcher
+
+    args = _tpu_args([
+        "--tpu_name", "p", "--tpu_zone", "z", "--command", "true",
+        "--install_accelerate", "--accelerate_version", "0.1.0", "--debug",
+    ])
+    tpu_command_launcher(args)
+    assert "pip install accelerate-tpu==0.1.0" in capsys.readouterr().out

@@ -1,0 +1,132 @@
+"""Tracker tests (reference ``tests/test_tracking.py`` — lifecycle per tracker,
+custom-tracker integration, filter semantics). The JSON and TensorBoard
+trackers run for real; service-backed trackers (wandb/comet/aim/clearml/
+dvclive/mlflow) are exercised through availability gating — their packages are
+deliberately absent in this environment."""
+
+import json
+import os
+
+import pytest
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.tracking import (
+    LOGGER_TYPE_TO_CLASS,
+    GeneralTracker,
+    JSONTracker,
+    TensorBoardTracker,
+    filter_trackers,
+)
+
+
+def test_eight_tracker_classes_registered():
+    assert sorted(LOGGER_TYPE_TO_CLASS) == [
+        "aim", "clearml", "comet_ml", "dvclive", "json", "mlflow", "tensorboard", "wandb",
+    ]
+
+
+def test_json_tracker_lifecycle(tmp_path):
+    t = JSONTracker("run1", str(tmp_path))
+    t.store_init_configuration({"lr": 0.1, "note": "hello"})
+    t.log({"loss": 1.5}, step=0)
+    t.log({"loss": 0.5, "acc": 0.9}, step=1)
+    t.finish()
+    cfg = json.load(open(tmp_path / "run1" / "config.json"))
+    assert cfg["lr"] == 0.1
+    rows = [json.loads(l) for l in open(tmp_path / "run1" / "metrics.jsonl")]
+    assert rows[0]["loss"] == 1.5 and rows[0]["_step"] == 0
+    assert rows[1]["acc"] == 0.9
+
+
+def test_tensorboard_tracker_lifecycle(tmp_path):
+    t = TensorBoardTracker("tbrun", str(tmp_path))
+    t.store_init_configuration({"lr": 0.1, "layers": 2})
+    t.log({"loss": 1.0, "msg": "text", "group": {"a": 1.0, "b": 2.0}}, step=0)
+    t.finish()
+    files = []
+    for root, _d, fs in os.walk(tmp_path / "tbrun"):
+        files += fs
+    assert any("tfevents" in f for f in files), files
+
+
+def test_filter_trackers_unknown_raises(tmp_path):
+    with pytest.raises(ValueError, match="Unknown tracker"):
+        filter_trackers("not_a_tracker", str(tmp_path))
+
+
+def test_filter_trackers_unavailable_skipped(tmp_path, caplog):
+    # wandb et al. are not installed here: requesting them warns and skips.
+    assert filter_trackers(["wandb", "comet_ml", "aim", "clearml", "dvclive"], str(tmp_path)) == []
+
+
+def test_filter_trackers_all_resolves_available(tmp_path):
+    names = filter_trackers("all", str(tmp_path))
+    assert "json" in names and "tensorboard" in names
+    assert "wandb" not in names  # not installed
+
+
+def test_filter_trackers_requires_dir():
+    with pytest.raises(ValueError, match="requires a logging_dir"):
+        filter_trackers("json", None)
+
+
+def test_filter_trackers_dedup_and_passthrough(tmp_path):
+    class MyTracker(GeneralTracker):
+        name = "custom"
+        requires_logging_directory = False
+
+        @property
+        def tracker(self):
+            return None
+
+    mine = MyTracker()
+    out = filter_trackers(["json", "json", mine], str(tmp_path))
+    assert out == ["json", mine]
+
+
+def test_accelerator_tracking_end_to_end(tmp_path):
+    logged = []
+
+    class RecordingTracker(GeneralTracker):
+        name = "recording"
+        requires_logging_directory = False
+
+        @property
+        def tracker(self):
+            return logged
+
+        def store_init_configuration(self, values):
+            logged.append(("config", values))
+
+        def log(self, values, step=None, **kwargs):
+            logged.append(("log", values, step))
+
+        def finish(self):
+            logged.append(("finish",))
+
+    accelerator = Accelerator(log_with=["json", RecordingTracker()], project_dir=str(tmp_path))
+    accelerator.init_trackers("proj", config={"lr": 1.0})
+    accelerator.log({"loss": 2.0}, step=3)
+    tracker = accelerator.get_tracker("recording")
+    assert tracker.tracker is logged
+    accelerator.end_training()
+
+    assert ("config", {"lr": 1.0}) in logged
+    assert ("log", {"loss": 2.0}, 3) in logged
+    assert ("finish",) in logged
+    rows = [json.loads(l) for l in open(tmp_path / "proj" / "metrics.jsonl")]
+    assert rows[0]["loss"] == 2.0
+
+
+def test_get_tracker_missing_raises(tmp_path):
+    accelerator = Accelerator(log_with="json", project_dir=str(tmp_path))
+    accelerator.init_trackers("proj")
+    with pytest.raises(ValueError, match="not found"):
+        accelerator.get_tracker("wandb")
+
+
+@pytest.mark.parametrize("name", ["wandb", "mlflow", "comet_ml", "aim", "clearml", "dvclive"])
+def test_optional_trackers_report_unavailable(name):
+    cls = LOGGER_TYPE_TO_CLASS[name]
+    assert cls.is_available() is False
+    assert cls.name == name
