@@ -21,11 +21,37 @@ real↔pad pairs are masked inside the kernel).
 from __future__ import annotations
 
 import functools
+import os
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
+
+# Dense/flash crossover by device kind: below this sequence length the S²
+# einsum rides the MXU faster than the block-streamed kernel. Measured with
+# benchmarks/attention_crossover.py (B=4, H=8, D=128, bf16, causal): on
+# v5 lite dense wins through S=2048 (2.22ms vs 2.50ms) and flash wins at
+# S=4096 (9.2ms vs 15.7ms — and dense's fp32 score matrix OOMs by S=8192).
+# Override with ACCELERATE_FLASH_MIN_SEQ.
+_FLASH_CROSSOVER = {"TPU v5 lite": 4096, "TPU v5e": 4096}
+_DEFAULT_FLASH_MIN_SEQ = 2048
+
+
+@functools.lru_cache(maxsize=1)
+def _device_flash_min_seq() -> int:
+    try:
+        kind = jax.devices()[0].device_kind
+    except Exception:
+        return _DEFAULT_FLASH_MIN_SEQ
+    return _FLASH_CROSSOVER.get(kind, _DEFAULT_FLASH_MIN_SEQ)
+
+
+def _flash_min_seq() -> int:
+    env = os.environ.get("ACCELERATE_FLASH_MIN_SEQ")  # read per call: overridable
+    if env:
+        return int(env)
+    return _device_flash_min_seq()
 
 
 def repeat_kv(k, v, n_rep: int):
@@ -116,10 +142,11 @@ def cached_attention(q, k_cache, v_cache, *, q_positions, kv_mask=None):
 def attention(q, k, v, *, causal=True, mask=None, impl: str = "auto", mesh=None):
     """Unified entry used by the model zoo. ``impl``: auto|dense|flash|ring."""
     if impl == "auto":
-        # Measured on v5e: the Mosaic flash kernel beats dense einsum attention
-        # from ~2k sequence length; below that the S² matmul rides the MXU faster
-        # than the block-streamed kernel (and remat of dense attention is cheap).
-        impl = "flash" if _flash_available() and _flash_shapes_ok(q, k) and q.shape[1] >= 2048 else "dense"
+        impl = (
+            "flash"
+            if _flash_available() and _flash_shapes_ok(q, k) and q.shape[1] >= _flash_min_seq()
+            else "dense"
+        )
     if impl == "flash":
         if not _flash_available():
             impl = "dense"

@@ -11,14 +11,30 @@ a Megatron passthrough flag). Here it is first-class and TPU-shaped:
   the attention compute of the resident chunk (the RingAttention recipe);
 - softmax is streamed: each visiting KV chunk updates running (max, sum, acc)
   statistics exactly like flash attention's inner loop, so no device ever holds a
-  full S×S score matrix — numerics match dense attention to fp32 tolerance.
+  full S×S score matrix — numerics match dense attention to fp32 tolerance;
+- the backward pass is an explicit second ring (``jax.custom_vjp``): gradients
+  for each KV chunk accumulate into buffers that rotate *with* the chunk, so
+  after ``sp`` hops every ``dk``/``dv`` shard arrives back on its home device.
+  O(S/sp) memory in both passes — no per-hop residual stacking from loop AD.
+
+Per-block compute is pluggable (``ACCELERATE_RING_BLOCK`` or the ``block_impl``
+argument):
+
+- ``"dense"`` (default) — einsum score block + fp32 streaming merge; runs on any
+  backend.
+- ``"flash"`` — the Mosaic flash kernel shipped inside JAX processes each
+  visiting KV block in VMEM (``_flash_attention(save_residuals=True)`` for the
+  forward, ``_flash_attention_bwd_dq``/``_bwd_dkv`` with the globally-merged
+  softmax statistics for the backward). TPU-only; block shapes must satisfy the
+  kernel's 128-lane alignment.
 
 Causality is enforced with *global* positions (chunk offsets), so the result is
-bit-for-bit the same function as dense causal attention on the unsharded sequence.
+the same function as dense causal attention on the unsharded sequence.
 """
 
 from __future__ import annotations
 
+import os
 from functools import partial
 
 import numpy as np
@@ -30,30 +46,101 @@ from jax.sharding import PartitionSpec as P
 _NEG_INF = -1e30
 
 
-def _chunk_scores(q, k, bias):
-    """q (b,s,h,d) k (b,skv,h,d) → fp32 scores (b,h,s,skv) + bias."""
-    scale = 1.0 / np.sqrt(q.shape[-1])
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
-    return scores + bias
-
-
-def _streaming_merge(m, l, acc, scores, v):
-    """Flash-style running softmax update with one incoming score block."""
+# --------------------------------------------------------------------- blocks
+def _dense_block_fwd(q, k_cur, v_cur, mask_cur, pos_q, pos_k, m, l, acc, causal):
+    """One visiting KV block, dense: fp32 scores + flash-style streaming merge."""
+    b, s_loc, h, d = q.shape
+    scale = 1.0 / np.sqrt(d)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_cur).astype(jnp.float32) * scale
+    bias = jnp.zeros((b, 1, s_loc, pos_k.shape[0]), jnp.float32)
+    if causal:
+        visible = pos_q[:, None] >= pos_k[None, :]
+        bias = jnp.where(visible[None, None], bias, _NEG_INF)
+    if mask_cur is not None:
+        bias = bias + jnp.where(mask_cur[:, None, None, :].astype(bool), 0.0, _NEG_INF)
+    scores = scores + bias
     valid = scores > _NEG_INF / 2
     m_j = jnp.max(scores, axis=-1)  # (b,h,s)
     m_new = jnp.maximum(m, m_j)
-    # Guard: rows with no valid key this block contribute nothing.
     p = jnp.exp(scores - m_new[..., None]) * valid
     l_j = jnp.sum(p, axis=-1)
     alpha = jnp.exp(m - m_new)
-    o_j = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v).astype(jnp.float32)
+    o_j = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v_cur.dtype), v_cur).astype(jnp.float32)
     l_new = l * alpha + l_j
     acc_new = acc * jnp.swapaxes(alpha, 1, 2)[..., None] + o_j
     return m_new, l_new, acc_new
 
 
-def _ring_attention_local(q, k, v, mask, q_offset_chunks, axis_name: str, causal: bool):
-    """Body run per-device under shard_map. q/k/v: (b, s_loc, h, d) local chunks."""
+def _flash_block_sizes(b, h, s_loc, d):
+    from jax.experimental.pallas.ops.tpu import flash_attention as fa
+
+    # Signature: (batch_size, num_heads, q_seq_len, kv_len, d_model).
+    return fa.BlockSizes.get_default(b, h, s_loc, s_loc, d)
+
+
+def _segment_ids(mask_cur, b, s_loc):
+    """kv-side padding as segment ids; q side stays in the 'real' segment so
+    padded *keys* are masked for every query, matching the dense bias."""
+    from jax.experimental.pallas.ops.tpu import flash_attention as fa
+
+    kv_seg = jnp.where(mask_cur.astype(bool), 2, 1).astype(jnp.int32)
+    q_seg = jnp.full((b, s_loc), 2, jnp.int32)
+    return fa.SegmentIds(q=q_seg, kv=kv_seg)
+
+
+def _flash_block_fwd(q, k_cur, v_cur, mask_cur, chunk_rel, m, l, acc):
+    """One visiting KV block through the Mosaic kernel.
+
+    ``chunk_rel``: traced scalar — 0 diagonal block (causal inside), 1 fully
+    visible, 2 fully masked (skip). The kernel returns a *normalized* block
+    output plus its (l_j, m_j) stats; merging into the running (m, l, acc) uses
+    o_j · l_j as the unnormalized accumulator contribution.
+    """
+    from jax.experimental.pallas.ops.tpu import flash_attention as fa
+
+    b, s_loc, h, d = q.shape
+    scale = 1.0 / np.sqrt(d)
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k_cur, 1, 2)
+    vt = jnp.swapaxes(v_cur, 1, 2)
+    seg = None if mask_cur is None else _segment_ids(mask_cur, b, s_loc)
+    bs = _flash_block_sizes(b, h, s_loc, d)
+
+    def run(causal_block):
+        o_j, l_j, m_j = fa._flash_attention(
+            qt, kt, vt, None, seg, True, causal_block, scale, bs, False
+        )
+        return jnp.swapaxes(o_j, 1, 2), l_j, m_j
+
+    def diag(_):
+        return run(True)
+
+    def full(_):
+        return run(False)
+
+    def skip(_):
+        return (
+            jnp.zeros((b, s_loc, h, d), qt.dtype),
+            jnp.zeros((b, h, s_loc), jnp.float32),
+            jnp.full((b, h, s_loc), _NEG_INF, jnp.float32),
+        )
+
+    o_j, l_j, m_j = jax.lax.switch(chunk_rel, [diag, full, skip], None)
+    m_j = jnp.where(l_j > 0, m_j, _NEG_INF)  # rows with no valid key
+    m_new = jnp.maximum(m, m_j)
+    alpha = jnp.exp(m - m_new)
+    beta = jnp.exp(jnp.where(m_j > _NEG_INF / 2, m_j - m_new, _NEG_INF))
+    l_new = l * alpha + l_j * beta
+    acc_new = (
+        acc * jnp.swapaxes(alpha, 1, 2)[..., None]
+        + o_j.astype(jnp.float32) * jnp.swapaxes(l_j * beta, 1, 2)[..., None]
+    )
+    return m_new, l_new, acc_new
+
+
+# ------------------------------------------------------------------- forward
+def _ring_fwd_local(q, k, v, mask, axis_name, causal, block_impl):
+    """Per-device forward ring. Returns (out, lse) with lse = m + log l."""
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, s_loc, h, d = q.shape
@@ -62,20 +149,21 @@ def _ring_attention_local(q, k, v, mask, q_offset_chunks, axis_name: str, causal
     m = jnp.full((b, h, s_loc), _NEG_INF, jnp.float32)
     l = jnp.zeros((b, h, s_loc), jnp.float32)
     acc = jnp.zeros((b, s_loc, h, d), jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
 
     def body(step, carry):
         m, l, acc, k_cur, v_cur, mask_cur, kv_idx = carry
-        pos_k = kv_idx * s_loc + jnp.arange(s_loc)
-        bias = jnp.zeros((b, 1, s_loc, s_loc), jnp.float32)
-        if causal:
-            visible = pos_q[:, None] >= pos_k[None, :]
-            bias = jnp.where(visible[None, None], bias, _NEG_INF)
-        if mask_cur is not None:
-            bias = bias + jnp.where(mask_cur[:, None, None, :].astype(bool), 0.0, _NEG_INF)
-        scores = _chunk_scores(q, k_cur, bias)
-        m, l, acc = _streaming_merge(m, l, acc, scores, v_cur)
+        if block_impl == "flash":
+            # 0 = diagonal (causal inside block), 1 = fully visible, 2 = skip.
+            if causal:
+                chunk_rel = jnp.where(kv_idx == idx, 0, jnp.where(kv_idx < idx, 1, 2))
+            else:
+                chunk_rel = jnp.ones((), jnp.int32)
+            m, l, acc = _flash_block_fwd(q, k_cur, v_cur, mask_cur, chunk_rel, m, l, acc)
+        else:
+            pos_k = kv_idx * s_loc + jnp.arange(s_loc)
+            m, l, acc = _dense_block_fwd(q, k_cur, v_cur, mask_cur, pos_q, pos_k, m, l, acc, causal)
         # Rotate KV (and its metadata) to the next ring neighbor — a pure ICI hop.
-        perm = [(i, (i + 1) % n) for i in range(n)]
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
         mask_nxt = jax.lax.ppermute(mask_cur, axis_name, perm) if mask_cur is not None else None
@@ -85,13 +173,180 @@ def _ring_attention_local(q, k, v, mask, q_offset_chunks, axis_name: str, causal
     carry = (m, l, acc, k, v, mask, idx)
     carry = jax.lax.fori_loop(0, n, body, carry)
     m, l, acc = carry[0], carry[1], carry[2]
-    l_safe = jnp.swapaxes(jnp.where(l > 0, l, 1.0), 1, 2)[..., None]
-    return (acc / l_safe).astype(q.dtype)
+    l_safe = jnp.where(l > 0, l, 1.0)
+    out = (acc / jnp.swapaxes(l_safe, 1, 2)[..., None]).astype(q.dtype)
+    lse = jnp.where(l > 0, m + jnp.log(l_safe), jnp.inf)  # exp(s - inf) = 0
+    return out, lse
 
 
-def ring_attention(q, k, v, *, causal=True, mask=None, mesh=None, axis_name: str = "sp"):
+# ------------------------------------------------------------------ backward
+def _dense_block_bwd(q, k_cur, v_cur, mask_cur, pos_q, pos_k, lse, dout, delta, causal):
+    """Gradients of one visiting block, probabilities rebuilt from global lse."""
+    d = q.shape[-1]
+    scale = 1.0 / np.sqrt(d)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_cur).astype(jnp.float32) * scale
+    bias = jnp.zeros_like(scores[:, :1])
+    if causal:
+        visible = pos_q[:, None] >= pos_k[None, :]
+        bias = jnp.where(visible[None, None], bias, _NEG_INF)
+    if mask_cur is not None:
+        bias = bias + jnp.where(mask_cur[:, None, None, :].astype(bool), 0.0, _NEG_INF)
+    scores = scores + bias
+    p = jnp.exp(scores - lse[..., None])  # globally-normalized probabilities
+    dout32 = dout.astype(jnp.float32)
+    dv = jnp.einsum("bhqk,bqhd->bkhd", p, dout32)
+    dp = jnp.einsum("bqhd,bkhd->bhqk", dout32, v_cur.astype(jnp.float32))
+    ds = p * (dp - delta[..., None])
+    dq = jnp.einsum("bhqk,bkhd->bqhd", ds, k_cur.astype(jnp.float32)) * scale
+    dk = jnp.einsum("bhqk,bqhd->bkhd", ds, q.astype(jnp.float32)) * scale
+    return dq, dk, dv
+
+
+def _flash_block_bwd(q, k_cur, v_cur, mask_cur, chunk_rel, l_g, m_g, dout, delta):
+    """Block gradients via the Mosaic bwd kernels with globally-merged stats.
+
+    Passing the global (l, m) makes the kernels rebuild the globally-normalized
+    probabilities for this block, which is exactly the ring decomposition of the
+    full-softmax backward.
+    """
+    from jax.experimental.pallas.ops.tpu import flash_attention as fa
+
+    b, s_loc, h, d = q.shape
+    scale = 1.0 / np.sqrt(d)
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k_cur, 1, 2)
+    vt = jnp.swapaxes(v_cur, 1, 2)
+    dot = jnp.swapaxes(dout, 1, 2)
+    seg = None if mask_cur is None else _segment_ids(mask_cur, b, s_loc)
+    bs = _flash_block_sizes(b, h, s_loc, d)
+
+    def run(causal_block):
+        dq_t = fa._flash_attention_bwd_dq(
+            qt, kt, vt, None, seg, l_g, m_g, dot, delta,
+            block_q_major=bs.block_q_dq, block_k_major=bs.block_k_major_dq,
+            block_k=bs.block_k_dq, sm_scale=scale, causal=causal_block,
+            mask_value=fa.DEFAULT_MASK_VALUE, debug=False,
+        )[0]
+        dk_t, dv_t = fa._flash_attention_bwd_dkv(
+            qt, kt, vt, None, seg, l_g, m_g, dot, delta,
+            block_q_major=bs.block_q_major_dkv, block_q=bs.block_q_dkv,
+            block_k_major=bs.block_k_major_dkv, block_k=bs.block_k_dkv,
+            sm_scale=scale, causal=causal_block,
+            mask_value=fa.DEFAULT_MASK_VALUE, debug=False,
+        )
+        return dq_t, dk_t, dv_t
+
+    def diag(_):
+        return run(True)
+
+    def full(_):
+        return run(False)
+
+    def skip(_):
+        return (jnp.zeros_like(qt), jnp.zeros_like(kt), jnp.zeros_like(vt))
+
+    dq_t, dk_t, dv_t = jax.lax.switch(chunk_rel, [diag, full, skip], None)
+    return (
+        jnp.swapaxes(dq_t, 1, 2).astype(jnp.float32),
+        jnp.swapaxes(dk_t, 1, 2).astype(jnp.float32),
+        jnp.swapaxes(dv_t, 1, 2).astype(jnp.float32),
+    )
+
+
+def _ring_bwd_local(q, k, v, mask, out, lse, dout, axis_name, causal, block_impl):
+    """Per-device backward ring. dk/dv accumulators rotate with their KV chunk,
+    so each chunk's gradient arrives home after ``n`` hops."""
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, s_loc, h, d = q.shape
+    pos_q = idx * s_loc + jnp.arange(s_loc)
+    delta = jnp.sum(out.astype(jnp.float32) * dout.astype(jnp.float32), axis=-1)  # (b,s,h)
+    delta = jnp.swapaxes(delta, 1, 2)  # (b,h,s)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    dq = jnp.zeros((b, s_loc, h, d), jnp.float32)
+    dk0 = jnp.zeros((b, s_loc, h, d), jnp.float32)
+    dv0 = jnp.zeros((b, s_loc, h, d), jnp.float32)
+
+    def body(step, carry):
+        dq, dk_cur, dv_cur, k_cur, v_cur, mask_cur, kv_idx = carry
+        if block_impl == "flash":
+            if causal:
+                chunk_rel = jnp.where(kv_idx == idx, 0, jnp.where(kv_idx < idx, 1, 2))
+            else:
+                chunk_rel = jnp.ones((), jnp.int32)
+            dq_j, dk_j, dv_j = _flash_block_bwd(
+                q, k_cur, v_cur, mask_cur, chunk_rel, _lse_to_l(lse), _lse_to_m(lse),
+                dout, delta,
+            )
+        else:
+            pos_k = kv_idx * s_loc + jnp.arange(s_loc)
+            dq_j, dk_j, dv_j = _dense_block_bwd(
+                q, k_cur, v_cur, mask_cur, pos_q, pos_k, lse, dout, delta, causal
+            )
+        dq = dq + dq_j
+        dk_cur = dk_cur + dk_j
+        dv_cur = dv_cur + dv_j
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        dk_nxt = jax.lax.ppermute(dk_cur, axis_name, perm)
+        dv_nxt = jax.lax.ppermute(dv_cur, axis_name, perm)
+        mask_nxt = jax.lax.ppermute(mask_cur, axis_name, perm) if mask_cur is not None else None
+        kv_nxt = jax.lax.ppermute(kv_idx, axis_name, perm)
+        return dq, dk_nxt, dv_nxt, k_nxt, v_nxt, mask_nxt, kv_nxt
+
+    carry = (dq, dk0, dv0, k, v, mask, idx)
+    carry = jax.lax.fori_loop(0, n, body, carry)
+    dq, dk, dv = carry[0], carry[1], carry[2]
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+def _lse_to_m(lse):
+    """The Mosaic bwd kernels rebuild p = exp(s·scale − m)/l; feeding m = lse
+    and l = 1 yields the globally-normalized probabilities. Rows with no valid
+    key have lse = +inf; a large finite m keeps exp(s − m) = 0 without NaNs."""
+    return jnp.where(jnp.isfinite(lse), lse, 1e30)
+
+
+def _lse_to_l(lse):
+    return jnp.ones_like(lse)
+
+
+# --------------------------------------------------------------- custom VJP
+@partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+def _ring_cv(axis_name, causal, block_impl, q, k, v, mask):
+    out, _ = _ring_fwd_local(q, k, v, mask, axis_name, causal, block_impl)
+    return out
+
+
+def _ring_cv_fwd(axis_name, causal, block_impl, q, k, v, mask):
+    out, lse = _ring_fwd_local(q, k, v, mask, axis_name, causal, block_impl)
+    return out, (q, k, v, mask, out, lse)
+
+
+def _ring_cv_bwd(axis_name, causal, block_impl, res, dout):
+    q, k, v, mask, out, lse = res
+    dq, dk, dv = _ring_bwd_local(q, k, v, mask, out, lse, dout, axis_name, causal, block_impl)
+    dmask = None if mask is None else np.zeros(mask.shape, dtype=jax.dtypes.float0)
+    return dq, dk, dv, dmask
+
+
+_ring_cv.defvjp(_ring_cv_fwd, _ring_cv_bwd)
+
+
+# -------------------------------------------------------------------- entry
+def ring_attention(
+    q, k, v, *, causal=True, mask=None, mesh=None, axis_name: str = "sp", block_impl: str | None = None
+):
     """Sequence-parallel attention. q/k/v: (B, S, H, D) global arrays with S
-    sharded on ``axis_name``; heads may simultaneously be sharded on ``tp``."""
+    sharded on ``axis_name``; heads may simultaneously be sharded on ``tp``.
+
+    ``block_impl``: per-visiting-block compute — ``"dense"`` (any backend) or
+    ``"flash"`` (Mosaic kernel, TPU only). Defaults to ``$ACCELERATE_RING_BLOCK``
+    or ``"dense"``.
+    """
+    if block_impl is None:
+        block_impl = os.environ.get("ACCELERATE_RING_BLOCK", "dense")
     if mesh is None:
         from ..state import PartialState
 
@@ -111,8 +366,7 @@ def ring_attention(q, k, v, *, causal=True, mask=None, mesh=None, axis_name: str
 
     if mask is None:
         fn = shard_map(
-            partial(_ring_attention_local, mask=None, q_offset_chunks=None,
-                    axis_name=axis_name, causal=causal),
+            lambda q, k, v: _ring_cv(axis_name, causal, block_impl, q, k, v, None),
             mesh=mesh,
             in_specs=(qkv_spec, qkv_spec, qkv_spec),
             out_specs=qkv_spec,
@@ -120,9 +374,7 @@ def ring_attention(q, k, v, *, causal=True, mask=None, mesh=None, axis_name: str
         )
         return fn(q, k, v)
     fn = shard_map(
-        lambda q, k, v, mask: _ring_attention_local(
-            q, k, v, mask, None, axis_name=axis_name, causal=causal
-        ),
+        lambda q, k, v, mask: _ring_cv(axis_name, causal, block_impl, q, k, v, mask),
         mesh=mesh,
         in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
         out_specs=qkv_spec,
