@@ -742,17 +742,26 @@ class Accelerator:
             optimizer._accum_grads = jax.tree_util.tree_map(jnp.zeros_like, handle.params)
         count_box = [jnp.int32(0)]
 
+        def _step_args(batch, rng, clip_norm):
+            return (
+                handle.params, optimizer.opt_state, optimizer._accum_grads,
+                count_box[0], self._place_batch(batch), rng, jnp.float32(clip_norm),
+            )
+
         def step(batch, clip_norm: float = 0.0):
-            batch = self._place_batch(batch)
             handle.step_counter += 1
             rng = jax.random.fold_in(handle.rng, handle.step_counter)
             (handle.params, optimizer.opt_state, optimizer._accum_grads,
-             count_box[0], loss) = _step(
-                handle.params, optimizer.opt_state, optimizer._accum_grads,
-                count_box[0], batch, rng, jnp.float32(clip_norm),
-            )
+             count_box[0], loss) = _step(*_step_args(batch, rng, clip_norm))
             return loss
 
+        def lower(batch, clip_norm: float = 0.0):
+            """Lower (without running) the fused step for HLO inspection — used
+            by the collective-count tests to pin each plan's communication
+            pattern without multi-chip hardware."""
+            return _step.lower(*_step_args(batch, handle.rng, clip_norm))
+
+        step.lower = lower
         return step
 
     # ------------------------------------------------------------ collectives

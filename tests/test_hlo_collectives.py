@@ -1,0 +1,80 @@
+"""Pin each parallelism plan's communication pattern at the HLO level.
+
+Without multi-chip hardware, the strongest no-hardware proxy for "the sharding
+actually does what the plan says" is counting the collectives XLA emits for the
+compiled train step on the 8-device CPU mesh (VERDICT round-1 item 9):
+
+- dp       → gradient all-reduce, nothing else;
+- fsdp     → parameter all-gathers (+ grad reduction traffic);
+- tp       → row-parallel partial-sum all-reduces *on top of* dp's;
+- pp       → per-stage layer gathers as the scan crosses stage boundaries;
+- sp(ring) → the explicit ppermute KV rotation → collective-permute.
+"""
+
+import re
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+
+from accelerate_tpu import Accelerator, ParallelismConfig
+from accelerate_tpu.models import Llama, LlamaConfig
+from accelerate_tpu.state import AcceleratorState, GradientState
+
+_OPS = ("all-reduce", "all-gather", "reduce-scatter", "collective-permute", "all-to-all")
+
+
+def _collective_counts(parallelism, attention_impl="auto", seq=16):
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+    acc = Accelerator(parallelism_config=parallelism)
+    cfg = LlamaConfig.tiny(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_attention_heads=2, num_key_value_heads=2, num_hidden_layers=2,
+        attention_impl=attention_impl,
+    )
+    model = Llama(cfg)
+    model.init_params(jax.random.key(0))
+    pmodel, popt = acc.prepare(model, optax.sgd(0.1))
+    step = acc.build_train_step(pmodel, popt)
+    ids = np.random.default_rng(0).integers(0, 128, (8, seq)).astype(np.int32)
+    hlo = step.lower({"input_ids": ids, "labels": ids}).compile().as_text()
+    return {op: len(re.findall(rf"\b{op}", hlo)) for op in _OPS}
+
+
+@pytest.fixture(scope="module")
+def dp_counts():
+    return _collective_counts(ParallelismConfig())  # dp8
+
+
+def test_dp_plan_is_allreduce_only(dp_counts):
+    assert dp_counts["all-reduce"] > 0, dp_counts
+    assert dp_counts["all-gather"] == 0, dp_counts
+    assert dp_counts["collective-permute"] == 0, dp_counts
+
+
+def test_fsdp_plan_gathers_params():
+    c = _collective_counts(ParallelismConfig(fsdp_size=8))
+    # Sharded params must be gathered for compute; grad reduction shows up as
+    # reduce-scatter or its all-reduce/all-to-all decomposition on this backend.
+    assert c["all-gather"] > 0, c
+    assert c["reduce-scatter"] + c["all-to-all"] + c["all-reduce"] > 0, c
+
+
+def test_tp_plan_adds_partial_sum_allreduces(dp_counts):
+    c = _collective_counts(ParallelismConfig(tp_size=2))
+    # Megatron col→row pairs emit forward partial-sum all-reduces in addition
+    # to the gradient all-reduce — strictly more than the pure-dp plan.
+    assert c["all-reduce"] > dp_counts["all-reduce"], (c, dp_counts)
+
+
+def test_pp_plan_moves_stage_params():
+    c = _collective_counts(ParallelismConfig(pp_size=2))
+    assert c["all-gather"] > 0, c
+
+
+def test_ring_plan_emits_collective_permute():
+    c = _collective_counts(ParallelismConfig(sp_size=4, dp_size=2), attention_impl="ring", seq=32)
+    assert c["collective-permute"] > 0, c
