@@ -77,6 +77,7 @@ class ClusterConfig:
     tp_size: int = 1
     pp_size: int = 1
     sp_size: int = 1
+    ep_size: int = 1
     # Host-side virtual device count for CPU simulation (xla_force_host_platform_device_count)
     cpu_virtual_devices: int = 0
     downcast_bf16: bool = False
@@ -103,8 +104,10 @@ class ClusterConfig:
 
     def mesh_shape_env(self) -> str:
         """Serialize mesh axes for ACCELERATE_MESH_SHAPE (`axis:size,...`)."""
+        from ..utils.constants import MESH_AXIS_ORDER
+
         axes = []
-        for name in ("pp", "dp", "fsdp", "sp", "tp"):
+        for name in MESH_AXIS_ORDER:
             size = getattr(self, f"{name}_size")
             axes.append(f"{name}:{size}")
         return ",".join(axes)

@@ -67,6 +67,7 @@ def launch_command_parser(subparsers=None) -> argparse.ArgumentParser:
         ("tp", "tensor-parallel size"),
         ("pp", "pipeline-parallel size"),
         ("sp", "sequence-parallel size"),
+        ("ep", "expert-parallel size"),
     ):
         parser.add_argument(f"--{axis}_size", type=int, default=None, help=helptext)
     parser.add_argument("-m", "--module", action="store_true", help="Run script as a python module")
@@ -97,6 +98,7 @@ def _merge_config(args) -> ClusterConfig:
         ("tp_size", "tp_size"),
         ("pp_size", "pp_size"),
         ("sp_size", "sp_size"),
+        ("ep_size", "ep_size"),
     ]:
         val = getattr(args, flag, None)
         if val is not None:

@@ -1,4 +1,5 @@
 from .bert import BertConfig, BertForSequenceClassification
 from .llama import Llama, LlamaConfig
+from .moe import MoELlama, MoELlamaConfig
 from .t5 import T5Config, T5ForConditionalGeneration
 from .vision import ConvNetConfig, ConvNetForImageClassification

@@ -52,6 +52,7 @@ class LlamaConfig:
     remat: bool = False
     remat_policy: str = "nothing_saveable"  # any jax.checkpoint_policies name
     attention_impl: str = "auto"  # 'auto' | 'dense' | 'flash' | 'ring'
+    matmul_precision: str = "default"  # 'default' | 'int8' (QAT w/ STE bwd, ops/int8.py)
 
     @property
     def head_dim(self) -> int:
@@ -213,9 +214,9 @@ class Llama(Module):
         B, S, _ = x.shape
         cos, sin = ctx["cos"], ctx["sin"]
         h = rms_norm(x, layer["input_norm"]["weight"], cfg.rms_norm_eps)
-        q = (h @ layer["attn"]["wq"]).reshape(B, S, nh, hd)
-        k = (h @ layer["attn"]["wk"]).reshape(B, S, nkv, hd)
-        v = (h @ layer["attn"]["wv"]).reshape(B, S, nkv, hd)
+        q = self._mm(h, layer["attn"]["wq"]).reshape(B, S, nh, hd)
+        k = self._mm(h, layer["attn"]["wk"]).reshape(B, S, nkv, hd)
+        v = self._mm(h, layer["attn"]["wv"]).reshape(B, S, nkv, hd)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
         new_cache = None
@@ -243,11 +244,24 @@ class Llama(Module):
             attn_out = _attention(
                 q, k, v, causal=True, mask=ctx["attention_mask"], impl=cfg.attention_impl
             )
-        x = x + attn_out.reshape(B, S, nh * hd) @ layer["attn"]["wo"]
+        x = x + self._mm(attn_out.reshape(B, S, nh * hd), layer["attn"]["wo"])
         h2 = rms_norm(x, layer["post_attn_norm"]["weight"], cfg.rms_norm_eps)
-        gated = jax.nn.silu(h2 @ layer["mlp"]["w_gate"]) * (h2 @ layer["mlp"]["w_up"])
-        x = x + gated @ layer["mlp"]["w_down"]
+        x = x + self.mlp(layer, h2, ctx)
         return x if new_cache is None else (x, new_cache)
+
+    def mlp(self, layer, h2, ctx=None):
+        """SwiGLU FFN on the normed residual. The MoE variant overrides this and
+        sows its router aux loss into ``ctx`` (per-call dict, so no state leaks
+        across traces)."""
+        gated = jax.nn.silu(self._mm(h2, layer["mlp"]["w_gate"])) * self._mm(h2, layer["mlp"]["w_up"])
+        return self._mm(gated, layer["mlp"]["w_down"])
+
+    def _mm(self, a, b):
+        """Block matmul through the precision dispatcher (ops/int8.py). The
+        embedding and LM head stay exact — the usual QAT skip list."""
+        from ..ops.int8 import matmul
+
+        return matmul(a, b, precision=self.config.matmul_precision)
 
     def head(self, params, x, labels=None, attention_mask=None):
         """Final norm + LM head (+ shifted-label loss)."""

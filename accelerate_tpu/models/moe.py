@@ -1,0 +1,172 @@
+"""Mixture-of-experts Llama — the expert-parallel flagship.
+
+Same decoder skeleton as ``Llama`` (scan over stacked layers, GQA attention,
+RoPE) with the dense SwiGLU FFN replaced by a routed expert FFN
+(``ops/moe.py``). Expert weights carry a leading ``E`` dim sharded on the mesh
+``ep`` axis: expert compute stays on the owning shard and the combine einsum
+becomes one all-reduce over ``ep`` per layer (row-parallel-style) — the
+TPU-native analog of DeepSpeed-MoE's expert parallelism
+(reference exposes only passthrough flags for that backend; SURVEY.md §2.4
+lists EP as note-only).
+
+The router's load-balancing auxiliary loss is accumulated across the layer scan
+and added to the LM loss with ``router_aux_coef``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..ops.moe import moe_ffn
+from .llama import Llama, LlamaConfig
+
+
+@dataclass
+class MoELlamaConfig(LlamaConfig):
+    num_experts: int = 8
+    moe_top_k: int = 2
+    capacity_factor: float = 1.25
+    router_aux_coef: float = 0.01
+
+    @classmethod
+    def tiny(cls, **kw):
+        defaults = dict(
+            vocab_size=256,
+            hidden_size=64,
+            intermediate_size=128,
+            num_hidden_layers=2,
+            num_attention_heads=4,
+            num_key_value_heads=2,
+            max_position_embeddings=128,
+            num_experts=4,
+            moe_top_k=2,
+        )
+        defaults.update(kw)
+        return cls(**defaults)
+
+
+class MoELlama(Llama):
+    def __init__(self, config: MoELlamaConfig):
+        super().__init__(config)
+
+    # ------------------------------------------------------------------- init
+    def init(self, rng, *example_inputs, **kwargs):
+        cfg = self.config
+        params = super().init(rng, *example_inputs, **kwargs)
+        h, inter, L, E = cfg.hidden_size, cfg.intermediate_size, cfg.num_hidden_layers, cfg.num_experts
+        keys = jax.random.split(jax.random.fold_in(rng, 17), 4)
+
+        def dense(key, shape, scale_dim):
+            return (jax.random.normal(key, shape, jnp.float32) / np.sqrt(scale_dim)).astype(jnp.float32)
+
+        params["layers"]["mlp"] = {
+            "router": dense(keys[0], (L, h, E), h),
+            "w_gate": dense(keys[1], (L, E, h, inter), h),
+            "w_up": dense(keys[2], (L, E, h, inter), h),
+            "w_down": dense(keys[3], (L, E, inter, h), inter),
+        }
+        return params
+
+    # --------------------------------------------------------------- sharding
+    def sharding_rules(self):
+        """Llama rules + expert weights: layer stack on ``pp``, experts on
+        ``ep``, then the Megatron col/row split on fsdp/tp."""
+        rules = [
+            (r"mlp/router", P("pp", "fsdp", None)),
+            (r"mlp/w_(gate|up)", P("pp", "ep", "fsdp", "tp")),
+            (r"mlp/w_down", P("pp", "ep", "tp", "fsdp")),
+        ]
+        base = [r for r in super().sharding_rules() if "mlp" not in r[0]]
+        return rules + base
+
+    # ---------------------------------------------------------------- forward
+    def mlp(self, layer, h2, ctx=None):
+        cfg = self.config
+        out, aux = moe_ffn(
+            h2,
+            layer["mlp"]["router"],
+            layer["mlp"]["w_gate"],
+            layer["mlp"]["w_up"],
+            layer["mlp"]["w_down"],
+            k=cfg.moe_top_k,
+            capacity_factor=cfg.capacity_factor,
+        )
+        if ctx is not None:
+            ctx["moe_aux"] = aux  # sown per call; read back by apply()'s scan body
+        return out
+
+    def apply(
+        self,
+        params,
+        input_ids=None,
+        labels=None,
+        attention_mask=None,
+        positions=None,
+        cache=None,
+        train: bool = False,
+        rngs=None,
+        **kwargs,
+    ):
+        cfg = self.config
+        if cache is not None:
+            return super().apply(
+                params, input_ids=input_ids, labels=labels, attention_mask=attention_mask,
+                positions=positions, cache=cache, train=train, rngs=rngs, **kwargs,
+            )
+        x, ctx = self.embed(params, input_ids, positions, attention_mask)
+
+        def body(x, layer):
+            x = self.block(layer, x, ctx)
+            # The aux tracer sown into ctx must become a real output *inside*
+            # any checkpoint boundary, or it would leak across the remat trace.
+            return x, ctx.pop("moe_aux")
+
+        if cfg.remat:
+            policy = getattr(jax.checkpoint_policies, cfg.remat_policy)
+            body = jax.checkpoint(body, policy=policy)
+
+        x, aux_per_layer = jax.lax.scan(body, x, params["layers"])
+        out = self.head(params, x, labels=labels, attention_mask=attention_mask)
+        aux = jnp.mean(aux_per_layer)
+        out["aux_loss"] = aux
+        if "loss" in out:
+            out["loss"] = out["loss"] + cfg.router_aux_coef * aux
+        return out
+
+    # -------------------------------------------------------------- estimation
+    def num_params(self) -> int:
+        cfg = self.config
+        h, inter, L, E = cfg.hidden_size, cfg.intermediate_size, cfg.num_hidden_layers, cfg.num_experts
+        attn = (
+            h * (cfg.num_attention_heads + 2 * cfg.num_key_value_heads) * cfg.head_dim
+            + cfg.num_attention_heads * cfg.head_dim * h
+        )
+        moe = h * E + E * 3 * h * inter
+        norms = 2 * h
+        total = L * (attn + moe + norms) + cfg.vocab_size * h + h
+        if not cfg.tie_word_embeddings:
+            total += h * cfg.vocab_size
+        return total
+
+    def flops_per_token(self) -> float:
+        """Per-token compute touches only the router + top-k active experts,
+        not all E — 6·(active params) + attention."""
+        cfg = self.config
+        h, inter, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_hidden_layers
+        attn = (
+            h * (cfg.num_attention_heads + 2 * cfg.num_key_value_heads) * cfg.head_dim
+            + cfg.num_attention_heads * cfg.head_dim * h
+        )
+        active_moe = h * cfg.num_experts + cfg.moe_top_k * 3 * h * inter
+        norms = 2 * h
+        active = L * (attn + active_moe + norms) + cfg.vocab_size * h + h
+        if not cfg.tie_word_embeddings:
+            active += h * cfg.vocab_size
+        attn_extra = 12 * L * h * cfg.max_position_embeddings
+        return 6 * active + attn_extra

@@ -1,0 +1,90 @@
+"""Int8 quantized matmul for training — the TPU analog of fp8 recipes.
+
+TPUs have no fp8 MXU path; the equivalent low-precision speed lever is int8
+(v5e: 394 int8 TOPS vs 197 bf16 TFLOPS — exactly 2×). This module provides a
+drop-in matmul that:
+
+- dynamically quantizes both operands per-row/per-column (absmax symmetric,
+  the AQT recipe) so the contraction runs int8×int8 → int32 on the MXU;
+- rescales the int32 accumulator back to the activation dtype;
+- backpropagates with a straight-through estimator (gradients flow as if the
+  matmul were exact, computed in bf16/fp32) — the standard quantization-aware
+  training treatment, so the optimizer state and gradient path stay full
+  precision.
+
+Reference context: the reference's fp8 support wires TransformerEngine /
+torchao recipes (``utils/transformer_engine.py``, ``utils/ao.py``); there the
+recipe swaps Linear modules. Here it swaps the matmul primitive inside the
+model's forward (``LlamaConfig(matmul_precision="int8")``), which is the
+functional-JAX shape of the same feature (SURVEY.md §2.6).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def _absmax_scale(t, axis):
+    """Symmetric per-vector scale: max|t| along `axis` mapped to 127."""
+    amax = jnp.max(jnp.abs(t.astype(jnp.float32)), axis=axis, keepdims=True)
+    return jnp.where(amax > 0, amax / 127.0, 1.0)
+
+
+def quantize_rowwise(t, axis):
+    """Quantize to int8 with a per-vector scale along ``axis``."""
+    scale = _absmax_scale(t, axis)
+    q = jnp.clip(jnp.round(t.astype(jnp.float32) / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+@jax.custom_vjp
+def int8_matmul(x, w):
+    """x @ w with both operands dynamically quantized to int8.
+
+    x: (..., K); w: (K, N). Forward runs int8×int8→int32 on the MXU with
+    per-row (x) / per-column (w) rescale; backward is straight-through in the
+    original precision.
+    """
+    return _int8_matmul_fwd_value(x, w)
+
+
+def _int8_matmul_fwd_value(x, w):
+    qx, sx = quantize_rowwise(x, axis=-1)  # per-row of x
+    qw, sw = quantize_rowwise(w, axis=0)  # per-column of w
+    acc = jax.lax.dot_general(
+        qx, qw,
+        dimension_numbers=(((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    out = acc.astype(jnp.float32) * sx * sw.reshape((1,) * (acc.ndim - 1) + (-1,))
+    return out.astype(x.dtype)
+
+
+def _int8_matmul_fwd(x, w):
+    return _int8_matmul_fwd_value(x, w), (x, w)
+
+
+def _int8_matmul_bwd(res, g):
+    x, w = res
+    g32 = g.astype(jnp.float32)
+    dx = (g32 @ w.astype(jnp.float32).T).astype(x.dtype)
+    dw = jnp.tensordot(
+        x.astype(jnp.float32), g32, axes=(tuple(range(x.ndim - 1)), tuple(range(g.ndim - 1)))
+    ).astype(w.dtype)
+    return dx, dw
+
+
+int8_matmul.defvjp(_int8_matmul_fwd, _int8_matmul_bwd)
+
+
+def matmul(x, w, precision: str = "default"):
+    """Model-zoo matmul dispatch: ``default`` → ``x @ w``; ``int8`` → the
+    quantized MXU path with straight-through backward."""
+    if precision == "int8":
+        return int8_matmul(x, w)
+    if precision != "default":
+        raise ValueError(f"matmul precision must be 'default' or 'int8', got {precision!r}")
+    return x @ w

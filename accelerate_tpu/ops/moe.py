@@ -1,0 +1,125 @@
+"""Mixture-of-experts routing — top-k gating + einsum dispatch/combine.
+
+TPU-first design (the GShard/Switch recipe rather than a torch-style gather
+loop): routing produces dense one-hot dispatch/combine tensors and the expert
+FFN runs as *batched einsums* over a leading expert dim. Under GSPMD, sharding
+that expert dim on the mesh ``ep`` axis partitions the expert FFNs the way
+row-parallel TP partitions a matmul: dispatch einsums are device-local (each
+ep shard holds its batch rows), expert compute touches only the local experts,
+and the combine einsum contracts the sharded expert dim — one all-reduce over
+``ep`` per layer, inserted by XLA. No hand-written collectives, and the
+einsums stay MXU-shaped. (A token all-to-all materializes instead when ``ep``
+is folded into the data axes — the DeepSpeed-MoE topology; with a dedicated
+axis the all-reduce form is what's communication-minimal.)
+
+Reference context: the reference has no MoE implementation of its own (only
+DeepSpeed-MoE passthrough flags, ``utils/dataclasses.py``); this is a native
+capability of the framework (SURVEY.md §2.4 lists EP as a note-only strategy
+for the reference).
+
+Shapes (per group = batch row): x (B, S, h); router (h, E); k choices per
+token; capacity C per expert per group.
+
+- ``dispatch`` (B, S, E, C) one-hot: token (b, s) occupies slot c of expert e.
+- ``combine``  (B, S, E, C) = dispatch · gate: weights for the return trip.
+- expert inputs  = einsum('bsec,bsh->ebch', dispatch, x)
+- expert outputs = SwiGLU with weights (E, h, i) via 'ebch,ehi->ebci'
+- token outputs  = einsum('ebch,bsec->bsh', expert_out, combine)
+
+The auxiliary load-balancing loss is the Switch formulation:
+``E · Σ_e  f_e · p̄_e`` (token fraction × mean router prob).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def router_capacity(tokens_per_group: int, num_experts: int, k: int, capacity_factor: float) -> int:
+    """Slots per expert per group; multiples of 8 keep the lanes happy."""
+    cap = int(np.ceil(tokens_per_group * k * capacity_factor / num_experts))
+    return max(8, int(np.ceil(cap / 8)) * 8)
+
+
+def top_k_routing(router_logits, k: int, capacity: int):
+    """Build dispatch/combine tensors from router logits.
+
+    router_logits: (B, S, E). Returns (dispatch (B,S,E,C) float, combine
+    (B,S,E,C) float, aux_loss scalar). Tokens beyond an expert's capacity are
+    dropped (their combine weights are zero → they ride the residual stream
+    only, the standard Switch behavior).
+    """
+    B, S, E = router_logits.shape
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)  # (B,S,E)
+    gate_vals, expert_idx = jax.lax.top_k(probs, k)  # (B,S,k)
+    gate_vals = gate_vals / jnp.sum(gate_vals, axis=-1, keepdims=True)
+
+    # One-hot per choice, flattened so earlier tokens (and higher-priority
+    # choices) claim capacity first: (B, S·k, E).
+    onehot = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)  # (B,S,k,E)
+    flat = onehot.reshape(B, S * k, E)
+    # Position of each claim within its expert's slots (count of prior claims).
+    pos = jnp.cumsum(flat, axis=1) - flat  # (B, S·k, E)
+    keep = flat * (pos < capacity)
+    slot = jnp.einsum(
+        "bte,btec->btec",
+        keep,
+        jax.nn.one_hot(pos.astype(jnp.int32), capacity, dtype=jnp.float32),
+    )
+    slot = slot.reshape(B, S, k, E, capacity)
+
+    dispatch = jnp.max(slot, axis=2)  # (B,S,E,C) — a token occupies ≤1 slot per expert
+    combine = jnp.einsum("bske,bskec->bsec", onehot * gate_vals[..., None], slot)
+
+    # Switch aux loss: fraction of tokens routed to e (top-1 assignment) times
+    # mean router probability of e, scaled by E (≈1 at perfect balance).
+    top1 = jax.nn.one_hot(expert_idx[..., 0], E, dtype=jnp.float32)
+    frac_tokens = jnp.mean(top1, axis=(0, 1))
+    mean_probs = jnp.mean(probs, axis=(0, 1))
+    aux_loss = E * jnp.sum(frac_tokens * mean_probs)
+    return dispatch, combine, aux_loss
+
+
+def moe_ffn(x, router_w, w_gate, w_up, w_down, *, k: int, capacity_factor: float = 1.25):
+    """Full MoE SwiGLU layer: route → dispatch → expert FFN → combine.
+
+    x: (B, S, h); router_w: (h, E); w_gate/w_up: (E, h, i); w_down: (E, i, h).
+    Returns (output (B, S, h), aux_loss scalar). Sharding the leading E dim of
+    the expert weights on ``ep`` keeps expert compute local; the final combine
+    contracts the sharded expert dim into an all-reduce over ``ep``.
+    """
+    B, S, h = x.shape
+    E = router_w.shape[-1]
+    capacity = router_capacity(S, E, k, capacity_factor)
+    router_logits = (x @ router_w.astype(x.dtype)).astype(jnp.float32)
+    dispatch, combine, aux = top_k_routing(router_logits, k, capacity)
+
+    expert_in = jnp.einsum("bsec,bsh->ebch", dispatch.astype(x.dtype), x)
+    expert_in = _constrain_expert_layout(expert_in)
+    gated = jax.nn.silu(jnp.einsum("ebch,ehi->ebci", expert_in, w_gate.astype(x.dtype)))
+    up = jnp.einsum("ebch,ehi->ebci", expert_in, w_up.astype(x.dtype))
+    expert_out = jnp.einsum("ebci,eih->ebch", gated * up, w_down.astype(x.dtype))
+    expert_out = _constrain_expert_layout(expert_out)
+    out = jnp.einsum("ebch,bsec->bsh", expert_out, combine.astype(x.dtype))
+    return out, aux
+
+
+def _constrain_expert_layout(t):
+    """Pin (E, B, C, ...) intermediates to expert-major sharding: E on ``ep``,
+    B on the data axes — guarantees the partitioner keeps expert compute on
+    the expert's own shard instead of gathering expert weights to the tokens."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..state import PartialState
+
+    try:
+        mesh = PartialState().mesh
+    except Exception:
+        return t
+    if mesh is None or mesh.shape.get("ep", 1) == 1:
+        return t
+    spec = P("ep", ("dp", "fsdp"), *([None] * (t.ndim - 2)))
+    return jax.lax.with_sharding_constraint(t, NamedSharding(mesh, spec))

@@ -12,6 +12,8 @@ strategy is an **axis of one** ``jax.sharding.Mesh``:
 - ``pp``   — pipeline parallelism (layer groups staged across devices)
 - ``sp``   — sequence/context parallelism (activations sharded along sequence;
              the reference has no native implementation — SURVEY.md §2.4)
+- ``ep``   — expert parallelism (MoE expert weights sharded expert-wise; token
+             dispatch rides all-to-all over this axis)
 
 Axis order puts ``tp`` innermost so tensor-parallel collectives ride the
 fastest-varying ICI neighbors, then ``sp``, then ``fsdp``/``dp``, with ``pp``
@@ -45,11 +47,12 @@ class ParallelismConfig:
     tp_size: int = 1
     pp_size: int = 1
     sp_size: int = 1
+    ep_size: int = 1
 
     def __post_init__(self):
         if self.dp_size == 0:
             self.dp_size = -1  # config-file convention: 0 also means "infer"
-        for name in ("fsdp_size", "tp_size", "pp_size", "sp_size"):
+        for name in ("fsdp_size", "tp_size", "pp_size", "sp_size", "ep_size"):
             if getattr(self, name) < 1:
                 raise ValueError(f"{name} must be >= 1, got {getattr(self, name)}")
 
@@ -62,7 +65,7 @@ class ParallelismConfig:
             for part in spec.split(","):
                 axis, _, size = part.partition(":")
                 axis = axis.strip()
-                if axis not in ("dp", "fsdp", "tp", "pp", "sp"):
+                if axis not in ("dp", "fsdp", "tp", "pp", "sp", "ep"):
                     raise ValueError(f"Unknown mesh axis {axis!r} in {ENV_MESH_SHAPE}")
                 size = int(size)
                 if axis == "dp" and size == 0:
@@ -72,21 +75,21 @@ class ParallelismConfig:
 
     def resolved_sizes(self, num_devices: int) -> dict[str, int]:
         """Resolve ``dp_size=-1`` against the device count and validate divisibility."""
-        model_degree = self.fsdp_size * self.tp_size * self.pp_size * self.sp_size
+        model_degree = self.fsdp_size * self.tp_size * self.pp_size * self.sp_size * self.ep_size
         dp = self.dp_size
         if dp == -1:
             if num_devices % model_degree != 0:
                 raise ValueError(
-                    f"{num_devices} devices not divisible by fsdp*tp*pp*sp={model_degree}"
+                    f"{num_devices} devices not divisible by fsdp*tp*pp*sp*ep={model_degree}"
                 )
             dp = num_devices // model_degree
         total = dp * model_degree
         if total != num_devices:
             raise ValueError(
-                f"Mesh {dict(pp=self.pp_size, dp=dp, fsdp=self.fsdp_size, sp=self.sp_size, tp=self.tp_size)} "
+                f"Mesh {dict(pp=self.pp_size, dp=dp, fsdp=self.fsdp_size, ep=self.ep_size, sp=self.sp_size, tp=self.tp_size)} "
                 f"needs {total} devices but {num_devices} are available."
             )
-        return {"pp": self.pp_size, "dp": dp, "fsdp": self.fsdp_size, "sp": self.sp_size, "tp": self.tp_size}
+        return {"pp": self.pp_size, "dp": dp, "fsdp": self.fsdp_size, "ep": self.ep_size, "sp": self.sp_size, "tp": self.tp_size}
 
     def build_mesh(self, devices=None) -> Mesh:
         """Build the ``jax.sharding.Mesh``.
@@ -114,6 +117,7 @@ class ParallelismConfig:
             and self.tp_size == 1
             and self.pp_size == 1
             and self.sp_size == 1
+            and self.ep_size == 1
             and self.dp_size in (-1, 1)
         )
 

@@ -38,7 +38,7 @@ ENV_CPU = "ACCELERATE_USE_CPU"
 ENV_DEBUG_MODE = "ACCELERATE_DEBUG_MODE"
 ENV_MESH_SHAPE = "ACCELERATE_MESH_SHAPE"
 
-MESH_AXIS_ORDER = ("pp", "dp", "fsdp", "sp", "tp")
+MESH_AXIS_ORDER = ("pp", "dp", "fsdp", "ep", "sp", "tp")
 BATCH_SHARDING_AXES = ("dp", "fsdp")
 
 # Default config location, mirroring the reference's

@@ -84,6 +84,20 @@ def test_prepare_launch_env_contract():
     assert any("accelerate_tpu" in os.listdir(p) for p in env["PYTHONPATH"].split(os.pathsep) if os.path.isdir(p))
 
 
+def test_ep_size_flag_reaches_mesh_env():
+    """--ep_size must survive the flag→ClusterConfig merge and land in the
+    serialized mesh (regression: the merge list once dropped it silently)."""
+    from accelerate_tpu.commands.launch import _merge_config, launch_command_parser
+
+    args = launch_command_parser().parse_args(
+        ["--cpu", "--ep_size", "2", "--tp_size", "2", "script.py"]
+    )
+    cfg = _merge_config(args)
+    assert cfg.ep_size == 2
+    env = prepare_launch_env(cfg)
+    assert "ep:2" in env["ACCELERATE_MESH_SHAPE"]
+
+
 def test_prepare_launch_env_cpu_virtual_devices():
     cfg = ClusterConfig(use_cpu=True, cpu_virtual_devices=8)
     env = prepare_launch_env(cfg)

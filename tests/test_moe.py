@@ -1,0 +1,135 @@
+"""Mixture-of-experts: routing numerics, capacity drops, aux loss, and
+expert-parallel training on the ep mesh axis."""
+
+import re
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from accelerate_tpu import Accelerator, ParallelismConfig
+from accelerate_tpu.models import MoELlama, MoELlamaConfig
+from accelerate_tpu.ops.moe import moe_ffn, router_capacity, top_k_routing
+from accelerate_tpu.state import AcceleratorState, GradientState
+
+
+def _moe_weights(seed=0, h=16, E=4, inter=32):
+    rng = np.random.default_rng(seed)
+    mk = lambda *shape, s=0.1: jnp.asarray(rng.normal(size=shape).astype(np.float32)) * s
+    return mk(h, E), mk(E, h, inter), mk(E, h, inter), mk(E, inter, h)
+
+
+def test_moe_ffn_matches_manual_expert_loop():
+    """With ample capacity (no drops) the einsum dispatch must equal a plain
+    per-token top-k expert evaluation."""
+    B, S, h, E, k = 2, 8, 16, 4, 2
+    rw, wg, wu, wd = _moe_weights(h=h, E=E)
+    x = jnp.asarray(np.random.default_rng(1).normal(size=(B, S, h)).astype(np.float32))
+    out, _ = moe_ffn(x, rw, wg, wu, wd, k=k, capacity_factor=4.0)
+
+    probs = jax.nn.softmax(x @ rw, -1)
+    gv, gi = jax.lax.top_k(probs, k)
+    gv = gv / gv.sum(-1, keepdims=True)
+    ref = np.zeros((B, S, h), np.float32)
+    for b in range(B):
+        for s in range(S):
+            for j in range(k):
+                e = int(gi[b, s, j])
+                t = x[b, s]
+                y = (jax.nn.silu(t @ wg[e]) * (t @ wu[e])) @ wd[e]
+                ref[b, s] += float(gv[b, s, j]) * np.asarray(y)
+    assert np.allclose(np.asarray(out), ref, atol=1e-5), np.abs(np.asarray(out) - ref).max()
+
+
+def test_capacity_drops_overflow_tokens():
+    """When every token picks the same expert, only `capacity` tokens may
+    occupy slots; the rest must carry zero combine weight (residual-only)."""
+    B, S, E, k, C = 1, 32, 4, 1, 8
+    logits = jnp.zeros((B, S, E)).at[..., 2].set(10.0)  # everyone wants expert 2
+    dispatch, combine, _ = top_k_routing(logits, k, C)
+    assert float(dispatch.sum()) == C  # exactly C slots filled
+    assert float(combine[0, C:, 2].sum()) == 0.0  # overflow tokens dropped
+    assert float(combine[0, :C, 2].sum()) > 0.0
+
+
+def test_aux_loss_is_one_at_perfect_balance():
+    """Uniform routing (round-robin argmax) gives aux ≈ 1 by construction."""
+    B, S, E = 1, 64, 4
+    logits = jnp.asarray(
+        np.eye(E, dtype=np.float32)[np.arange(S) % E][None] * 5.0
+    )  # (1, S, E): token s → expert s % E
+    _, _, aux = top_k_routing(logits, 1, capacity=S)
+    assert 0.9 < float(aux) < 1.1, float(aux)
+
+
+def test_router_capacity_rounding():
+    assert router_capacity(128, 8, 2, 1.0) == 32
+    assert router_capacity(8, 8, 1, 1.0) == 8  # floor
+    assert router_capacity(100, 8, 2, 1.25) % 8 == 0
+
+
+def _train_moe(parallelism, steps=6):
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+    accelerator = Accelerator(parallelism_config=parallelism)
+    cfg = MoELlamaConfig.tiny()
+    model = MoELlama(cfg)
+    model.init_params(jax.random.key(0))
+    pmodel, popt = accelerator.prepare(model, optax.adam(1e-2))
+    step = accelerator.build_train_step(pmodel, popt)
+    ids = np.random.default_rng(0).integers(0, cfg.vocab_size, (8, 16)).astype(np.int32)
+    losses = [float(step({"input_ids": ids, "labels": ids})) for _ in range(steps)]
+    return losses, pmodel, step, ids
+
+
+def test_moe_trains_with_expert_parallelism():
+    losses, pmodel, _, _ = _train_moe(ParallelismConfig(ep_size=2, tp_size=2))
+    assert all(np.isfinite(l) for l in losses), losses
+    assert losses[-1] < losses[0], losses
+    wg = pmodel.params["layers"]["mlp"]["w_gate"]
+    assert "ep" in jax.tree_util.tree_leaves(tuple(wg.sharding.spec)), wg.sharding
+
+
+def test_moe_ep_matches_dp_numerics():
+    """Expert parallelism is a layout choice: losses must match pure dp."""
+    losses_dp, _, _, _ = _train_moe(ParallelismConfig())
+    losses_ep, _, _, _ = _train_moe(ParallelismConfig(ep_size=4, dp_size=2))
+    np.testing.assert_allclose(losses_ep, losses_dp, rtol=2e-3)
+
+
+def test_moe_ep_plan_reduces_over_experts():
+    """The combine contraction over the sharded expert dim must show up as
+    ep-axis communication in the compiled HLO."""
+    _, _, step, ids = _train_moe(ParallelismConfig(ep_size=4, dp_size=2), steps=1)
+    hlo = step.lower({"input_ids": ids, "labels": ids}).compile().as_text()
+    n_reduce = len(re.findall(r"\ball-reduce", hlo))
+    # dp-only grad sync on this tiny model is ~20 all-reduces; the per-layer
+    # expert combines (fwd+bwd, 2 layers) push it well past that.
+    assert n_reduce > 25, n_reduce
+
+
+def test_moe_aux_loss_in_output():
+    AcceleratorState._reset_state(reset_partial_state=True)
+    cfg = MoELlamaConfig.tiny()
+    model = MoELlama(cfg)
+    params = model.init_params(jax.random.key(0))
+    ids = np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 16)).astype(np.int32)
+    out = model.apply(params, input_ids=ids, labels=ids)
+    assert "aux_loss" in out and np.isfinite(float(out["aux_loss"]))
+    assert float(out["aux_loss"]) >= 1.0 - 1e-3  # Switch aux lower bound at balance
+
+
+def test_moe_generation_with_cache():
+    """The cached decode path runs through the MoE FFN unchanged."""
+    AcceleratorState._reset_state(reset_partial_state=True)
+    cfg = MoELlamaConfig.tiny()
+    model = MoELlama(cfg)
+    params = model.init_params(jax.random.key(0))
+    ids = np.random.default_rng(0).integers(0, cfg.vocab_size, (1, 8)).astype(np.int32)
+    cache = model.init_cache(1, 16, dtype=jnp.float32)
+    out = model.apply(params, input_ids=ids, cache=cache)
+    assert out["cache"]["pos"] == 8
+    assert np.isfinite(np.asarray(out.logits)).all()
