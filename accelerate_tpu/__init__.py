@@ -66,10 +66,10 @@ def __getattr__(name):
         from .inference import prepare_pippy
 
         return prepare_pippy
-    if name == "LocalSGD":
-        from .local_sgd import LocalSGD
+    if name in ("LocalSGD", "LocalSGDTrainer"):
+        from . import local_sgd
 
-        return LocalSGD
+        return getattr(local_sgd, name)
     if name in ("generate", "sample_logits"):
         from . import generation
 

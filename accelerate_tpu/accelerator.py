@@ -133,6 +133,22 @@ class PreparedModel:
     def load_state_dict(self, params):
         self.handle.params = apply_shardings(params, self.handle.param_shardings)
 
+    # ------------------------------------------------------------------ loss
+    def training_loss_fn(self, extract=None):
+        """The canonical ``loss_of(params, batch, rng)`` used by every compiled
+        training path (fused step, LocalSGDTrainer) — one definition so the
+        forward contract (train flag, rng collections, loss extraction) cannot
+        diverge between them. ``extract`` overrides the model's loss extractor."""
+        module = self.handle.module
+        cast = self._cast
+        extract = extract or self.loss_fn
+
+        def loss_of(params, batch, rng):
+            outputs = module.apply(cast(params), train=True, rngs={"dropout": rng}, **batch)
+            return extract(outputs, batch)
+
+        return loss_of
+
     # ---------------------------------------------------------------- compile
     def _cast(self, params):
         dtype = self.handle.compute_dtype
@@ -699,15 +715,9 @@ class Accelerator:
 
         handle = model.handle
         optimizer._ensure_initialized()
-        module = handle.module
-        extract = loss_fn or model.loss_fn
         accum = self.gradient_accumulation_steps
         tx = optimizer.tx
-        cast = model._cast
-
-        def loss_of(params, batch, rng):
-            outputs = module.apply(cast(params), train=True, rngs={"dropout": rng}, **batch)
-            return extract(outputs, batch)
+        loss_of = model.training_loss_fn(loss_fn)
 
         @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
         def _step(params, opt_state, accum_grads, count, batch, rng, clip_norm):

@@ -4,19 +4,158 @@ Reference parity: ``src/accelerate/local_sgd.py:36-106``. There, DDP gradient
 allreduce is suppressed (``no_sync``) for ``local_sgd_steps`` steps and then the
 *parameters* are averaged (``_sync_and_avg_model_params`` :100-106).
 
-TPU-native inversion: under GSPMD the per-step gradient reduce rides the compiled
-train step and is effectively free on ICI, so the *divergence* LocalSGD exists to
-repair cannot arise — a parameter is one global array and every update to it is
-already collective. This context manager therefore keeps the reference's API and
-cadence (``step()`` counting, sync on boundaries and on exit) while the "averaging"
-degenerates to a barrier plus re-assertion of canonical shardings. True Local SGD
-over a slow DCN axis would require per-slice parameter copies (a deliberate
-departure from the single-global-array model) and is not implemented.
+Two layers here:
+
+- ``LocalSGDTrainer`` — the real thing, TPU-shaped. Parameters and optimizer
+  state carry a leading replica dim ``R = dp_size`` sharded on ``dp``; the
+  per-step update is ``jax.vmap`` over that dim, so between sync boundaries
+  every step is embarrassingly parallel — *zero* cross-device traffic, exactly
+  the property LocalSGD exists for (sync over slow DCN only every N steps).
+  The boundary average is a mean over the replica dim inside the same compiled
+  step (``lax.cond`` on the step counter). Optimizer state stays per-replica,
+  matching the reference (only params are averaged).
+
+- ``LocalSGD`` — the reference-shaped context manager for the imperative path.
+  Under GSPMD the imperative path's parameters are single global arrays whose
+  every update is already collective, so its "averaging" degenerates to a
+  barrier + re-assertion of canonical shardings; use ``LocalSGDTrainer`` when
+  you actually want desynchronized local steps.
 """
 
 from __future__ import annotations
 
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
 from .accelerator import Accelerator, PreparedModel
+
+
+class LocalSGDTrainer:
+    """Per-replica training with periodic parameter averaging.
+
+    Usage::
+
+        trainer = LocalSGDTrainer(accelerator, pmodel, optax.sgd(0.1), sync_every=8)
+        for batch in loader:
+            loss = trainer.step(batch)     # local update; averages every 8 steps
+        params = trainer.final_params()    # replica-averaged pytree
+
+    Requires a pure-dp mesh (LocalSGD is a data-parallel technique; fsdp/tp/pp/
+    sp/ep axes must be trivial). The global batch is split replica-major: rows
+    ``[r·B/R, (r+1)·B/R)`` feed replica ``r``.
+    """
+
+    def __init__(self, accelerator: Accelerator, model: PreparedModel, tx, sync_every: int):
+        if not isinstance(model, PreparedModel):
+            raise ValueError("LocalSGDTrainer requires a model from accelerator.prepare().")
+        from .optimizer import AcceleratedOptimizer
+
+        self._prepared_optimizer = None
+        if isinstance(tx, AcceleratedOptimizer):
+            # Reuse the prepared optimizer's transform; its state is superseded
+            # by the trainer's per-replica state and re-synced in final_params().
+            self._prepared_optimizer = tx
+            tx = tx.tx
+        if sync_every < 1:
+            raise ValueError(f"sync_every must be >= 1, got {sync_every}")
+        mesh = accelerator.mesh
+        for ax in ("fsdp", "tp", "pp", "sp", "ep"):
+            if mesh.shape.get(ax, 1) != 1:
+                raise ValueError(
+                    f"LocalSGDTrainer needs a pure-dp mesh; axis {ax!r} has size "
+                    f"{mesh.shape[ax]}. Use the fused train step for sharded models."
+                )
+        self.accelerator = accelerator
+        self.model = model
+        self.sync_every = sync_every
+        self.mesh = mesh
+        self.R = R = mesh.shape.get("dp", 1)
+        handle = model.handle
+
+        rep_shard = NamedSharding(mesh, P("dp"))
+        stack = lambda p: jax.device_put(jnp.broadcast_to(p[None], (R,) + p.shape), rep_shard)
+        self._params_rep = jax.tree_util.tree_map(stack, handle.params)
+        self._opt_rep = jax.vmap(tx.init)(self._params_rep)
+        self._count = jnp.zeros((), jnp.int32)
+
+        loss_of = model.training_loss_fn()
+
+        import optax
+
+        @partial(jax.jit, donate_argnums=(0, 1, 2))
+        def _step(params_rep, opt_rep, count, batch, rng):
+            def one(params, opt, local_batch, r):
+                loss, grads = jax.value_and_grad(loss_of)(
+                    params, local_batch, jax.random.fold_in(rng, r)
+                )
+                updates, opt = tx.update(grads, opt, params)
+                return optax.apply_updates(params, updates), opt, loss
+
+            batch_rep = jax.tree_util.tree_map(
+                lambda x: x.reshape((R, x.shape[0] // R) + x.shape[1:]), batch
+            )
+            params_rep, opt_rep, losses = jax.vmap(one)(
+                params_rep, opt_rep, batch_rep, jnp.arange(R)
+            )
+            count = count + 1
+            params_rep = jax.lax.cond(
+                (count % sync_every) == 0,
+                lambda p: jax.tree_util.tree_map(
+                    lambda t: jnp.broadcast_to(t.mean(axis=0)[None], t.shape).astype(t.dtype), p
+                ),
+                lambda p: p,
+                params_rep,
+            )
+            return params_rep, opt_rep, count, losses.mean()
+
+        self._compiled = _step
+
+    def step(self, batch) -> jax.Array:
+        """One local step per replica (params averaged on sync boundaries).
+        Returns the replica-mean loss."""
+        handle = self.model.handle
+        for leaf in jax.tree_util.tree_leaves(batch):
+            if leaf.ndim >= 1 and leaf.shape[0] % self.R != 0:
+                raise ValueError(
+                    f"LocalSGDTrainer needs batch rows divisible by the replica "
+                    f"count {self.R}; got {leaf.shape[0]}. Pad the final batch or "
+                    f"use drop_last."
+                )
+        batch = self.accelerator._place_batch(batch)
+        handle.step_counter += 1
+        rng = jax.random.fold_in(handle.rng, handle.step_counter)
+        self._params_rep, self._opt_rep, self._count, loss = self._compiled(
+            self._params_rep, self._opt_rep, self._count, batch, rng
+        )
+        return loss
+
+    def replica_params(self):
+        """The (R, ...)-stacked per-replica parameters (diagnostics/tests)."""
+        return self._params_rep
+
+    def final_params(self):
+        """Replica-averaged parameters, written back to the prepared model. If
+        the trainer was built from a prepared ``AcceleratedOptimizer``, its
+        state is replaced by the replica-average too, so a later
+        ``optimizer.step()`` continues from the trainer's trajectory instead of
+        stale pre-trainer moments."""
+        replica_mean = jax.jit(
+            lambda p: jax.tree_util.tree_map(
+                lambda t: t.mean(axis=0).astype(t.dtype), p  # keep int counts int
+            )
+        )
+        mean = replica_mean(self._params_rep)
+        handle = self.model.handle
+        from .parallel.sharding import apply_shardings
+
+        handle.params = apply_shardings(mean, handle.param_shardings)
+        if self._prepared_optimizer is not None:
+            self._prepared_optimizer.opt_state = replica_mean(self._opt_rep)
+            self._prepared_optimizer._accum_grads = None
+        return handle.params
 
 
 class LocalSGD:

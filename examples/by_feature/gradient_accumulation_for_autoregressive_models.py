@@ -1,0 +1,91 @@
+"""Feature: gradient accumulation for autoregressive models (reference
+``by_feature/gradient_accumulation_for_autoregressive_models.py``).
+
+Plain per-microbatch mean-loss accumulation is *wrong* for causal LMs when
+microbatches contain different numbers of real (non-padding) tokens: the mean
+of means over-weights short microbatches. The fix — like the reference's — is
+to weight each microbatch by its token count relative to the whole
+accumulation window.
+
+The weighting must live INSIDE the traced loss (a custom loss extractor passed
+to ``build_train_step``): gradients are produced by the compiled forward, so
+scaling the loss value afterwards would never reach them. The per-window token
+total rides the batch dict (the model's ``apply`` ignores unknown keys).
+
+Run:
+    python examples/by_feature/gradient_accumulation_for_autoregressive_models.py
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.models import Llama, LlamaConfig
+
+
+def make_batches(cfg, n_batches, batch_size, rng):
+    """Variable-length causal-LM microbatches, padded to seq 32."""
+    batches = []
+    for _ in range(n_batches):
+        lens = rng.integers(8, 32, batch_size)
+        ids = np.zeros((batch_size, 32), np.int32)
+        mask = np.zeros((batch_size, 32), np.int32)
+        for i, L in enumerate(lens):
+            ids[i, :L] = rng.integers(1, cfg.vocab_size, L)
+            mask[i, :L] = 1
+        batches.append({"input_ids": ids, "labels": ids, "attention_mask": mask})
+    return batches
+
+
+def training_function(args):
+    import jax
+    import jax.numpy as jnp
+
+    accum = args.gradient_accumulation_steps
+    accelerator = Accelerator(gradient_accumulation_steps=accum)
+    cfg = LlamaConfig.tiny()
+    model = Llama(cfg)
+    model.init_params(jax.random.key(0))
+    pmodel, optimizer = accelerator.prepare(model, optax.adam(1e-2))
+
+    def token_weighted_loss(outputs, batch):
+        # outputs.loss is the microbatch's per-token mean; re-weight it so the
+        # window's accumulated gradient equals the token-level mean over ALL
+        # window tokens: mean · n_micro · accum / n_window (backward divides by
+        # accum). This runs inside the compiled step, so it scales the grads.
+        n_micro = jnp.sum(batch["attention_mask"][:, 1:])
+        return outputs["loss"] * n_micro * accum / batch["window_tokens"]
+
+    step = accelerator.build_train_step(pmodel, optimizer, loss_fn=token_weighted_loss)
+
+    rng = np.random.default_rng(0)
+    window = make_batches(cfg, accum, args.batch_size, rng)  # fixed data, epochs over it
+    window_tokens = np.float32(sum(b["attention_mask"][:, 1:].sum() for b in window))
+    losses = []
+    for _ in range(args.num_windows):
+        for b in window:
+            loss = step({**b, "window_tokens": window_tokens})
+            losses.append(float(loss))
+
+    accelerator.print(f"first window loss {losses[0]:.3f} → last {losses[-1]:.3f}")
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
+    accelerator.end_training()
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--gradient_accumulation_steps", type=int, default=2)
+    parser.add_argument("--batch_size", type=int, default=4)
+    parser.add_argument("--num_windows", type=int, default=8)
+    training_function(parser.parse_args())
+
+
+if __name__ == "__main__":
+    main()

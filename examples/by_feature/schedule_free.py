@@ -1,0 +1,76 @@
+"""Feature: schedule-free optimization (reference ``by_feature/schedule_free.py``).
+
+The reference wraps ``schedulefree.AdamWScheduleFree`` and flips it between
+train/eval modes. The optax-native equivalent is ``optax.contrib.schedule_free``:
+prepare() takes the wrapped transform like any other, and evaluation uses
+``schedule_free_eval_params`` to read the averaged iterate.
+
+Run:
+    python examples/by_feature/schedule_free.py
+    accelerate-tpu launch examples/by_feature/schedule_free.py
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.test_utils import RegressionDataset, RegressionModel
+
+
+def get_dataloader(batch_size):
+    import torch.utils.data as tud
+
+    def collate(items):
+        return {k: np.stack([it[k] for it in items]) for k in items[0]}
+
+    return tud.DataLoader(
+        RegressionDataset(length=128), batch_size=batch_size, shuffle=True,
+        drop_last=True, collate_fn=collate,
+    )
+
+
+def training_function(args):
+    import jax
+
+    accelerator = Accelerator()
+    model = RegressionModel()
+    model.init_params(jax.random.key(0))
+    train_dl = get_dataloader(args.batch_size)
+    tx = optax.contrib.schedule_free_sgd(learning_rate=0.3, b1=0.9)
+    model, optimizer, train_dl = accelerator.prepare(model, tx, train_dl)
+
+    for epoch in range(args.num_epochs):
+        model.train()
+        train_dl.set_epoch(epoch)
+        for batch in train_dl:
+            outputs = model(**batch)
+            accelerator.backward(outputs["loss"])
+            optimizer.step()
+            optimizer.zero_grad()
+
+    # Evaluation reads the schedule-free *averaged* iterate, the analog of the
+    # reference's optimizer.eval() mode flip.
+    raw = accelerator.get_state_dict(model)
+    eval_params = optax.contrib.schedule_free_eval_params(optimizer.opt_state, raw)
+    a, b = float(eval_params["a"]), float(eval_params["b"])
+    accelerator.print(f"learned a={a:.3f} b={b:.3f} (target 2, 3)")
+    assert abs(a - 2.0) < 0.3 and abs(b - 3.0) < 0.3, (a, b)
+    accelerator.end_training()
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch_size", type=int, default=8)
+    parser.add_argument("--num_epochs", type=int, default=12)
+    training_function(parser.parse_args())
+
+
+if __name__ == "__main__":
+    main()

@@ -94,6 +94,10 @@ def test_complete_cv_example_step_checkpointing(tmp_path):
         ("by_feature/multi_process_metrics.py", []),
         ("by_feature/local_sgd.py", []),
         ("by_feature/automatic_gradient_accumulation.py", []),
+        ("by_feature/schedule_free.py", ["--num_epochs", 8]),
+        ("by_feature/gradient_accumulation_for_autoregressive_models.py", ["--num_windows", 4]),
+        ("by_feature/megatron_style_gpt_pretraining.py", ["--tp", 2, "--pp", 2, "--num_steps", 6]),
+        ("by_feature/fsdp_with_peak_mem_tracking.py", ["--num_epochs", 4]),
     ],
 )
 def test_by_feature_examples(script, args, tmp_path):
