@@ -132,6 +132,67 @@ class BertForSequenceClassification(Module):
             (r"norm|pooler|classifier", P()),
         ]
 
+    # ---------------------------------------------------------------- forward
+    # Decomposed into embed/block/head (the stage protocol) so the same code
+    # serves training (scan with dropout rng in the carry), pipelined inference
+    # (``prepare_pippy``), and the layer-streamed offload runtime.
+    def embed(self, params, input_ids, positions=None, attention_mask=None, token_type_ids=None):
+        cfg = self.config
+        B, S = input_ids.shape
+        emb = params["embeddings"]
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        x = (
+            jnp.take(emb["word"], input_ids, axis=0)
+            + emb["position"][None, :S]
+            + jnp.take(emb["token_type"], token_type_ids, axis=0)
+        ).astype(emb["word"].dtype)
+        x = layer_norm(x, emb["norm"]["scale"], emb["norm"]["bias"], cfg.layer_norm_eps)
+        if attention_mask is None:
+            attention_mask = jnp.ones((B, S), jnp.int32)
+        bias = jnp.where(attention_mask[:, None, None, :].astype(bool), 0.0, -1e30).astype(jnp.float32)
+        return x, {"attention_mask": attention_mask, "bias": bias}
+
+    def _dropout(self, x, rng, train_rate):
+        if train_rate == 0.0 or rng is None:
+            return x
+        keep = jax.random.bernoulli(rng, 1.0 - train_rate, x.shape)
+        return jnp.where(keep, x / (1.0 - train_rate), 0.0).astype(x.dtype)
+
+    def block(self, layer, x, ctx, rng=None, drop_rate=0.0):
+        """One encoder layer. Without ``rng`` (pipelined/streamed inference)
+        dropout is off; the training scan passes a per-layer rng."""
+        cfg = self.config
+        nh, hd = cfg.num_attention_heads, cfg.head_dim
+        B, S, _ = x.shape
+        bias = ctx["bias"]
+        if rng is not None:
+            r1, r2 = jax.random.split(rng)
+        else:
+            r1 = r2 = None
+        a = layer["attn"]
+        q = (x @ a["wq"] + a["bq"]).reshape(B, S, nh, hd)
+        k = (x @ a["wk"] + a["bk"]).reshape(B, S, nh, hd)
+        v = (x @ a["wv"] + a["bv"]).reshape(B, S, nh, hd)
+        scale = 1.0 / np.sqrt(hd)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale + bias
+        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, S, nh * hd)
+        attn = self._dropout(attn @ a["wo"] + a["bo"], r1, drop_rate)
+        x = layer_norm(x + attn, layer["attn_norm"]["scale"], layer["attn_norm"]["bias"], cfg.layer_norm_eps)
+        m = layer["mlp"]
+        hdn = jax.nn.gelu(x @ m["w_in"] + m["b_in"], approximate=False)
+        hdn = self._dropout(hdn @ m["w_out"] + m["b_out"], r2, drop_rate)
+        return layer_norm(x + hdn, layer["mlp_norm"]["scale"], layer["mlp_norm"]["bias"], cfg.layer_norm_eps)
+
+    def head(self, params, x, labels=None, attention_mask=None):
+        pooled = jnp.tanh(x[:, 0] @ params["pooler"]["w"] + params["pooler"]["b"])
+        logits = (pooled @ params["classifier"]["w"] + params["classifier"]["b"]).astype(jnp.float32)
+        out = ModelOutput(logits=logits)
+        if labels is not None:
+            out["loss"] = cross_entropy_loss(logits, labels)
+        return out
+
     def apply(
         self,
         params,
@@ -144,62 +205,21 @@ class BertForSequenceClassification(Module):
         **kwargs,
     ):
         cfg = self.config
-        B, S = input_ids.shape
-        emb = params["embeddings"]
-        compute_dtype = emb["word"].dtype
-        if token_type_ids is None:
-            token_type_ids = jnp.zeros_like(input_ids)
-        x = (
-            jnp.take(emb["word"], input_ids, axis=0)
-            + emb["position"][None, :S]
-            + jnp.take(emb["token_type"], token_type_ids, axis=0)
-        ).astype(compute_dtype)
-        x = layer_norm(x, emb["norm"]["scale"], emb["norm"]["bias"], cfg.layer_norm_eps)
-
-        if attention_mask is None:
-            attention_mask = jnp.ones((B, S), jnp.int32)
-        bias = jnp.where(attention_mask[:, None, None, :].astype(bool), 0.0, -1e30).astype(jnp.float32)
-
-        nh, hd = cfg.num_attention_heads, cfg.head_dim
+        x, ctx = self.embed(params, input_ids, None, attention_mask, token_type_ids)
         dropout_rng = (rngs or {}).get("dropout") if train else None
         drop_rate = cfg.hidden_dropout_prob if train else 0.0
 
-        def maybe_dropout(x, rng):
-            if drop_rate == 0.0 or rng is None:
-                return x
-            keep = jax.random.bernoulli(rng, 1.0 - drop_rate, x.shape)
-            return jnp.where(keep, x / (1.0 - drop_rate), 0.0).astype(x.dtype)
-
-        def block(carry, layer):
+        def scan_body(carry, layer):
             x, rng = carry
             if rng is not None:
-                rng, r1, r2 = jax.random.split(rng, 3)
+                rng, r = jax.random.split(rng)
             else:
-                r1 = r2 = None
-            a = layer["attn"]
-            q = (x @ a["wq"] + a["bq"]).reshape(B, S, nh, hd)
-            k = (x @ a["wk"] + a["bk"]).reshape(B, S, nh, hd)
-            v = (x @ a["wv"] + a["bv"]).reshape(B, S, nh, hd)
-            scale = 1.0 / np.sqrt(hd)
-            scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale + bias
-            probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
-            attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, S, nh * hd)
-            attn = maybe_dropout(attn @ a["wo"] + a["bo"], r1)
-            x = layer_norm(x + attn, layer["attn_norm"]["scale"], layer["attn_norm"]["bias"], cfg.layer_norm_eps)
-            m = layer["mlp"]
-            hdn = jax.nn.gelu(x @ m["w_in"] + m["b_in"], approximate=False)
-            hdn = maybe_dropout(hdn @ m["w_out"] + m["b_out"], r2)
-            x = layer_norm(x + hdn, layer["mlp_norm"]["scale"], layer["mlp_norm"]["bias"], cfg.layer_norm_eps)
+                r = None
+            x = self.block(layer, x, ctx, rng=r, drop_rate=drop_rate)
             return (x, rng), None
 
-        body = block
+        body = scan_body
         if cfg.remat:
-            body = jax.checkpoint(block)
+            body = jax.checkpoint(scan_body)
         (x, _), _ = jax.lax.scan(body, (x, dropout_rng), params["layers"])
-
-        pooled = jnp.tanh(x[:, 0] @ params["pooler"]["w"] + params["pooler"]["b"])
-        logits = (pooled @ params["classifier"]["w"] + params["classifier"]["b"]).astype(jnp.float32)
-        out = ModelOutput(logits=logits)
-        if labels is not None:
-            out["loss"] = cross_entropy_loss(logits, labels)
-        return out
+        return self.head(params, x, labels=labels, attention_mask=attention_mask)

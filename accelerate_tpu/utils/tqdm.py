@@ -1,0 +1,23 @@
+"""Main-process-only progress bars (reference ``utils/tqdm.py``).
+
+``tqdm(iterable, main_process_only=True)`` renders the bar only on the main
+process so an N-process launch doesn't print N interleaved bars. Pass
+``main_process_only=False`` to get a bar on every process.
+"""
+
+from __future__ import annotations
+
+
+def tqdm(*args, main_process_only: bool = True, **kwargs):
+    """Drop-in ``tqdm.auto.tqdm`` that is a no-op bar on non-main processes."""
+    try:
+        from tqdm.auto import tqdm as _tqdm
+    except ImportError as e:  # pragma: no cover - tqdm ships with the image
+        raise ImportError("tqdm is required for accelerate_tpu.utils.tqdm") from e
+
+    if main_process_only:
+        from ..state import PartialState
+
+        if not PartialState().is_main_process:
+            kwargs["disable"] = True
+    return _tqdm(*args, **kwargs)

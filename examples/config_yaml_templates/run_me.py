@@ -1,0 +1,11 @@
+"""Verify a config template: print the topology the launch produced."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+from accelerate_tpu import Accelerator
+
+acc = Accelerator()
+acc.print(f"processes={acc.num_processes} mesh={dict(acc.mesh.shape)} "
+          f"mixed_precision={acc.mixed_precision}")

@@ -1,0 +1,35 @@
+"""Pipeline-parallel BERT inference (reference ``examples/inference/pippy/bert.py``).
+
+Run (8-device CPU simulation):
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python examples/inference/pippy/bert.py
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))))
+
+from accelerate_tpu import prepare_pippy
+from accelerate_tpu.models import BertConfig, BertForSequenceClassification
+
+
+def main():
+    import jax
+
+    cfg = BertConfig.tiny(num_hidden_layers=4)
+    model = BertForSequenceClassification(cfg)
+    model.init_params(jax.random.key(0))
+
+    ids = np.random.default_rng(0).integers(0, cfg.vocab_size, (4, 16)).astype(np.int32)
+    piped = prepare_pippy(model, split_points=2, num_chunks=2)
+    out = piped(input_ids=ids)
+    logits = np.asarray(out.logits)
+    print(f"stages={len(piped.stage_ranges)} logits={logits.shape}")
+    assert logits.shape[0] == 4 and np.isfinite(logits).all()
+
+
+if __name__ == "__main__":
+    main()

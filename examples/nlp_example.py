@@ -35,7 +35,7 @@ import optax
 
 from accelerate_tpu import Accelerator
 from accelerate_tpu.models import BertConfig, BertForSequenceClassification
-from accelerate_tpu.utils import set_seed
+from accelerate_tpu.utils import set_seed, tqdm
 
 SEQ_LEN = 16
 SEG = SEQ_LEN // 2
@@ -121,7 +121,8 @@ def training_function(config, args):
     for epoch in range(num_epochs):
         model.train()
         train_dl.set_epoch(epoch)
-        for batch in train_dl:
+        # main-process-only progress bar (no N-way interleaving under launch)
+        for batch in tqdm(train_dl, main_process_only=True, desc=f"epoch {epoch}"):
             with accelerator.accumulate(model):
                 outputs = model(**batch)
                 accelerator.backward(outputs["loss"])

@@ -370,3 +370,20 @@ def test_tpu_config_bare_version_gets_pinned(capsys):
     ])
     tpu_command_launcher(args)
     assert "pip install accelerate-tpu==0.1.0" in capsys.readouterr().out
+
+
+def test_tqdm_main_process_only():
+    """utils.tqdm disables the bar on non-main processes (reference utils/tqdm.py)."""
+    from unittest import mock
+
+    from accelerate_tpu.utils import tqdm as acc_tqdm
+
+    bar = acc_tqdm(range(3), main_process_only=True)
+    assert not bar.disable  # single process == main
+    list(bar)
+
+    with mock.patch("accelerate_tpu.state.PartialState.is_main_process",
+                    new_callable=mock.PropertyMock, return_value=False):
+        bar = acc_tqdm(range(3), main_process_only=True)
+        assert bar.disable
+        bar.close()

@@ -111,6 +111,18 @@ def test_by_feature_examples(script, args, tmp_path):
     run_example(script, *args, *extra)
 
 
+@pytest.mark.parametrize(
+    "script",
+    [
+        "inference/pippy/llama.py",
+        "inference/pippy/bert.py",
+        "inference/distributed/distributed_inference.py",
+    ],
+)
+def test_inference_examples(script):
+    run_example(script)
+
+
 def test_launch_cli_runs_flagship(tmp_path):
     """`accelerate-tpu launch --cpu` end-to-end on the flagship example
     (reference runs its examples through the launcher in test_examples.py)."""
