@@ -60,6 +60,13 @@ def launch_command_parser(subparsers=None) -> argparse.ArgumentParser:
     # Precision / debug
     parser.add_argument("--mixed_precision", choices=["no", "bf16", "fp16"], default=None)
     parser.add_argument("--debug", action="store_true", default=None, help="Enable collective shape checks")
+    parser.add_argument(
+        "--max_restarts", type=int, default=None,
+        help="Relaunch the whole process gang up to N times after a failure "
+             "(full-gang restart is the TPU elastic model: collectives cannot "
+             "survive a lost participant, so recovery = restart + resume from "
+             "the latest checkpoint via save_state/load_state).",
+    )
     # Mesh axes (reference buries these in plugin args; first-class here)
     for axis, helptext in (
         ("dp", "data-parallel size (0 = absorb remaining devices)"),
@@ -99,6 +106,7 @@ def _merge_config(args) -> ClusterConfig:
         ("pp_size", "pp_size"),
         ("sp_size", "sp_size"),
         ("ep_size", "ep_size"),
+        ("max_restarts", "max_restarts"),
     ]:
         val = getattr(args, flag, None)
         if val is not None:
@@ -155,14 +163,40 @@ def _script_cmd(args) -> list:
 def simple_launcher(args, cfg: ClusterConfig) -> int:
     """Single process on this host (reference ``launch.py:778-788``)."""
     rank = cfg.machine_rank if cfg.num_machines > 1 else None
-    env = prepare_launch_env(cfg, process_id=rank)
-    proc = subprocess.run(_script_cmd(args), env=env)
+    for attempt in range(cfg.max_restarts + 1):
+        env = prepare_launch_env(cfg, process_id=rank)
+        proc = subprocess.run(_script_cmd(args), env=env)
+        if proc.returncode == 0:
+            return 0
+        if attempt < cfg.max_restarts:
+            print(
+                f"Process failed (rc={proc.returncode}); restart "
+                f"{attempt + 1}/{cfg.max_restarts} (resume from the latest "
+                "checkpoint is the script's responsibility via load_state)."
+            )
     return proc.returncode
 
 
 def multi_process_launcher(args, cfg: ClusterConfig) -> int:
     """Spawn N local processes rendezvousing on localhost — the CPU-sim multi-host
-    path (reference's multi-CPU gloo path, ``launchers.py:269-302``)."""
+    path (reference's multi-CPU gloo path, ``launchers.py:269-302``). On failure
+    with ``max_restarts`` > 0, the WHOLE gang is relaunched: collectives cannot
+    survive a lost participant, so TPU-elastic = full-gang restart + checkpoint
+    resume (the torchrun-restart analog the reference delegates to)."""
+    rc = 1
+    for attempt in range(cfg.max_restarts + 1):
+        rc = _run_gang_once(args, cfg)
+        if rc == 0:
+            return 0
+        if attempt < cfg.max_restarts:
+            print(
+                f"Gang failed (rc={rc}); restarting all ranks "
+                f"{attempt + 1}/{cfg.max_restarts}."
+            )
+    return rc
+
+
+def _run_gang_once(args, cfg: ClusterConfig) -> int:
     import time
 
     nproc = cfg.num_processes
@@ -193,6 +227,16 @@ def multi_process_launcher(args, cfg: ClusterConfig) -> int:
 
 def launch_command(args) -> None:
     cfg = _merge_config(args)
+    if cfg.max_restarts < 0:
+        raise ValueError(f"--max_restarts must be >= 0, got {cfg.max_restarts}")
+    if cfg.max_restarts > 0 and cfg.num_machines > 1:
+        raise ValueError(
+            "--max_restarts only applies to single-machine jobs: on a pod, a "
+            "per-host restart cannot re-rendezvous with live ranks from the "
+            "old incarnation. Restart the WHOLE pod launch (e.g. via "
+            "`accelerate-tpu tpu-config` or your scheduler) and resume with "
+            "load_state."
+        )
     if cfg.num_machines <= 1 and cfg.num_processes > 1:
         if not cfg.main_process_ip:
             cfg.main_process_ip = "127.0.0.1"

@@ -1,4 +1,5 @@
 from .bert import BertConfig, BertForSequenceClassification
+from .gpt2 import GPT2, GPT2Config
 from .llama import Llama, LlamaConfig
 from .moe import MoELlama, MoELlamaConfig
 from .t5 import T5Config, T5ForConditionalGeneration

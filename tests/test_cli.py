@@ -387,3 +387,75 @@ def test_tqdm_main_process_only():
         bar = acc_tqdm(range(3), main_process_only=True)
         assert bar.disable
         bar.close()
+
+
+def test_max_restarts_relaunches_gang(tmp_path):
+    """--max_restarts relaunches the whole gang; a script that fails once then
+    succeeds (via a marker file) must end with rc=0 after one restart."""
+    script = tmp_path / "flaky.py"
+    marker = tmp_path / "attempted"
+    script.write_text(
+        "import os, sys\n"
+        f"marker = {str(marker)!r}\n"
+        "if not os.path.exists(marker):\n"
+        "    open(marker, 'w').write('x')\n"
+        "    sys.exit(1)  # first attempt dies\n"
+        "from accelerate_tpu import Accelerator\n"
+        "acc = Accelerator()\n"
+        "print('RECOVERED_OK')\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-m", "accelerate_tpu.commands.launch", "--cpu",
+         "--max_restarts", "1", str(script)],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=300,
+        env={**os.environ, "PYTHONPATH": REPO_ROOT, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "RECOVERED_OK" in proc.stdout
+    assert "restart 1/1" in proc.stdout
+
+
+def test_max_restarts_relaunches_multi_process_gang(tmp_path):
+    """The multi-process (gang) path must also recover: all ranks die on the
+    first incarnation, the gang is relaunched, and rendezvous works again."""
+    script = tmp_path / "flaky_gang.py"
+    marker = tmp_path / "gang_attempted"
+    script.write_text(
+        "import os, sys\n"
+        f"marker = {str(marker)!r}\n"
+        "from accelerate_tpu import Accelerator\n"
+        "acc = Accelerator()\n"
+        "if not os.path.exists(marker):\n"
+        "    if acc.is_main_process:\n"
+        "        open(marker, 'w').write('x')\n"
+        "    acc.wait_for_everyone()\n"
+        "    sys.exit(3)\n"
+        "print(f'GANG_RECOVERED rank={acc.process_index}')\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-m", "accelerate_tpu.commands.launch", "--cpu",
+         "--num_processes", "2", "--max_restarts", "1", str(script)],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=600,
+        env={**os.environ, "PYTHONPATH": REPO_ROOT, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:] + proc.stdout[-1000:]
+    assert "restarting all ranks 1/1" in proc.stdout
+    assert proc.stdout.count("GANG_RECOVERED") == 2
+
+
+def test_max_restarts_rejected_on_multi_machine():
+    from accelerate_tpu.commands.launch import launch_command
+
+    args = launch_command_parser().parse_args(
+        ["--num_machines", "2", "--machine_rank", "0", "--max_restarts", "1", "x.py"]
+    )
+    with pytest.raises(ValueError, match="single-machine"):
+        launch_command(args)
+
+
+def test_max_restarts_negative_rejected():
+    from accelerate_tpu.commands.launch import launch_command
+
+    args = launch_command_parser().parse_args(["--cpu", "--max_restarts", "-1", "x.py"])
+    with pytest.raises(ValueError, match=">= 0"):
+        launch_command(args)

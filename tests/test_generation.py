@@ -150,3 +150,18 @@ def test_streamed_generation_matches_onchip(tmp_path, model_and_params):
     assert isinstance(dispatched, StreamedScanModel)
     got = generate(dispatched, ids, max_new_tokens=4, cache_dtype=jnp.float32)
     np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
+
+
+def test_gpt2_generate_with_cache():
+    """GPT-2 implements the same decode-cache protocol as Llama."""
+    from accelerate_tpu.generation import generate
+    from accelerate_tpu.models import GPT2, GPT2Config
+
+    cfg = GPT2Config.tiny()
+    model = GPT2(cfg)
+    model.init_params(jax.random.key(0))
+    prompt = np.random.default_rng(0).integers(1, cfg.vocab_size, (2, 8)).astype(np.int32)
+    out = generate(model, prompt, max_new_tokens=6, temperature=0.0)
+    out = np.asarray(out)
+    assert out.shape == (2, 14)
+    np.testing.assert_array_equal(out[:, :8], prompt)

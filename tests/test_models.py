@@ -146,3 +146,40 @@ def test_int8_matmul_op_numerics():
     dx, dw = jax.vjp(int8_matmul, x, w)[1](g)
     np.testing.assert_allclose(np.asarray(dx), np.asarray(g @ w.T), rtol=2e-5)
     np.testing.assert_allclose(np.asarray(dw), np.asarray(x.T @ g), rtol=2e-5)
+
+
+def test_gpt2_forward_train_and_pipeline():
+    """GPT-2 family: forward shapes, tied-head loss, sharded tp training, and
+    the stage protocol (pipelined inference)."""
+    import optax
+
+    from accelerate_tpu import Accelerator, ParallelismConfig
+    from accelerate_tpu.models import GPT2, GPT2Config
+    from accelerate_tpu.state import AcceleratorState, GradientState
+
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+    accelerator = Accelerator(parallelism_config=ParallelismConfig(tp_size=2))
+    cfg = GPT2Config.tiny()
+    model = GPT2(cfg)
+    model.init_params(jax.random.key(0))
+    ids = np.random.default_rng(0).integers(0, cfg.vocab_size, (4, 16)).astype(np.int32)
+
+    out = model.apply(model.params, input_ids=ids, labels=ids)
+    assert out.logits.shape == (4, 16, cfg.vocab_size)
+    assert np.isfinite(float(out["loss"]))
+
+    pmodel, popt = accelerator.prepare(model, optax.adam(1e-2))
+    step = accelerator.build_train_step(pmodel, popt)
+    losses = [float(step({"input_ids": ids, "labels": ids})) for _ in range(8)]
+    assert losses[-1] < losses[0], losses
+    wqkv = pmodel.params["layers"]["attn"]["w_qkv"]
+    assert "tp" in jax.tree_util.tree_leaves(tuple(wqkv.sharding.spec)), wqkv.sharding
+
+    from accelerate_tpu import prepare_pippy
+
+    model2 = GPT2(GPT2Config.tiny(num_hidden_layers=4))
+    model2.init_params(jax.random.key(1))
+    piped = prepare_pippy(model2, split_points=2, num_chunks=2)
+    out = piped(input_ids=ids)
+    assert np.isfinite(np.asarray(out.logits)).all()
