@@ -62,10 +62,13 @@ from .parallel.sharding import (
 from .scheduler import AcceleratedScheduler
 from .state import AcceleratorState, DistributedType, GradientState, PartialState
 from .utils.dataclasses import (
+    AutocastKwargs,
     DataLoaderConfiguration,
     FullyShardedDataParallelPlugin,
     GradientAccumulationPlugin,
     JaxShardingKwargs,
+    KwargsHandler,
+    ProfileKwargs,
     MegatronStylePlugin,
     PipelineParallelPlugin,
     ProjectConfiguration,
@@ -235,9 +238,25 @@ class Accelerator:
         if project_dir is not None and self.project_configuration.project_dir is None:
             self.project_configuration.set_directories(project_dir)
         self.sharding_kwargs = JaxShardingKwargs()
+        self.autocast_handler = None
+        self.profile_handler = None
+        seen_handler_classes = set()
         for handler in kwargs_handlers or []:
+            assert isinstance(handler, KwargsHandler), (
+                f"Unsupported kwargs handler passed: {handler}, must be one that "
+                "inherits `accelerate_tpu.utils.KwargsHandler`."
+            )
+            if type(handler) in seen_handler_classes:
+                raise ValueError(
+                    f"You can only pass one {type(handler).__name__} in `kwargs_handlers`."
+                )
+            seen_handler_classes.add(type(handler))
             if isinstance(handler, JaxShardingKwargs):
                 self.sharding_kwargs = handler
+            elif isinstance(handler, AutocastKwargs):
+                self.autocast_handler = handler
+            elif isinstance(handler, ProfileKwargs):
+                self.profile_handler = handler
 
         if parallelism_config is None:
             parallelism_config = self._resolve_parallelism(
@@ -251,6 +270,12 @@ class Accelerator:
         if gradient_accumulation_plugin is None:
             steps = int(os.environ.get("ACCELERATE_GRADIENT_ACCUMULATION_STEPS", gradient_accumulation_steps))
             gradient_accumulation_plugin = GradientAccumulationPlugin(num_steps=steps)
+        elif gradient_accumulation_steps > 1:
+            raise ValueError(
+                "You can only pass one of `gradient_accumulation_steps` and "
+                "`gradient_accumulation_plugin`. Please only pass in the created "
+                "`GradientAccumulationPlugin` object."
+            )
         self.gradient_state = GradientState(gradient_accumulation_plugin)
 
         self.device_placement = device_placement
@@ -517,7 +542,12 @@ class Accelerator:
         shardings = plan_param_shardings(params, self.mesh, rules=rules, min_shard_size=min_shard)
         params = apply_shardings(params, shardings)
         rng = jax.random.key(int(os.environ.get("ACCELERATE_SEED", 0)) + 7919)
-        handle = TrainHandle(module, params, shardings, self.mesh, self.state.compute_dtype, rng)
+        # AutocastKwargs(enabled=False) pins fp32 compute regardless of the
+        # mixed-precision setting (reference autocast ctx with enabled=False).
+        compute_dtype = self.state.compute_dtype
+        if self.autocast_handler is not None and not self.autocast_handler.enabled:
+            compute_dtype = jnp.float32
+        handle = TrainHandle(module, params, shardings, self.mesh, compute_dtype, rng)
         prepared = PreparedModel(handle, self, loss_fn=self._loss_fn)
         prepared.train(not evaluation_mode)
         self._models.append(prepared)
@@ -643,9 +673,17 @@ class Accelerator:
 
     @contextlib.contextmanager
     def autocast(self, autocast_handler=None):
-        """Parity context (:3770): dtype policy is applied inside compiled calls;
-        nothing dynamic to toggle here."""
-        yield
+        """Parity context (:3770). The dtype policy is baked into compiled calls
+        when a model is prepared, so this context cannot retroactively retune an
+        already-compiled model; a handler passed here (or via ``kwargs_handlers``)
+        governs models prepared inside the context."""
+        prev = self.autocast_handler
+        if autocast_handler is not None:
+            self.autocast_handler = autocast_handler
+        try:
+            yield
+        finally:
+            self.autocast_handler = prev
 
     def _optimizer_for_parameters(self, parameters):
         """Resolve which prepared optimizer owns ``parameters`` (a PreparedModel,
@@ -908,9 +946,7 @@ class Accelerator:
     def profile(self, profile_handler=None):
         """``jax.profiler`` trace context (reference ``profile`` :3797-3856 builds
         torch.profiler; output opens in TensorBoard/perfetto)."""
-        from .utils.dataclasses import ProfileKwargs
-
-        handler = profile_handler or ProfileKwargs()
+        handler = profile_handler or self.profile_handler or ProfileKwargs()
         trace_dir = handler.output_trace_dir
         if trace_dir is None:
             yield None

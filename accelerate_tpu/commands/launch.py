@@ -165,6 +165,9 @@ def simple_launcher(args, cfg: ClusterConfig) -> int:
     rank = cfg.machine_rank if cfg.num_machines > 1 else None
     for attempt in range(cfg.max_restarts + 1):
         env = prepare_launch_env(cfg, process_id=rank)
+        # Scripts key resume-vs-fresh decisions off this (torchrun exposes
+        # TORCHELASTIC_RESTART_COUNT the same way).
+        env["ACCELERATE_RESTART_ATTEMPT"] = str(attempt)
         proc = subprocess.run(_script_cmd(args), env=env)
         if proc.returncode == 0:
             return 0
@@ -185,7 +188,7 @@ def multi_process_launcher(args, cfg: ClusterConfig) -> int:
     resume (the torchrun-restart analog the reference delegates to)."""
     rc = 1
     for attempt in range(cfg.max_restarts + 1):
-        rc = _run_gang_once(args, cfg)
+        rc = _run_gang_once(args, cfg, attempt)
         if rc == 0:
             return 0
         if attempt < cfg.max_restarts:
@@ -196,13 +199,14 @@ def multi_process_launcher(args, cfg: ClusterConfig) -> int:
     return rc
 
 
-def _run_gang_once(args, cfg: ClusterConfig) -> int:
+def _run_gang_once(args, cfg: ClusterConfig, attempt: int = 0) -> int:
     import time
 
     nproc = cfg.num_processes
     procs = []
     for rank in range(nproc):
         env = prepare_launch_env(cfg, process_id=rank)
+        env["ACCELERATE_RESTART_ATTEMPT"] = str(attempt)
         procs.append(subprocess.Popen(_script_cmd(args), env=env))
     # Poll rather than wait sequentially: if one rank dies before the JAX
     # rendezvous completes, the others would block in initialize() forever —

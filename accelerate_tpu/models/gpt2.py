@@ -75,7 +75,10 @@ class GPT2(Module):
         keys = jax.random.split(rng, 8)
 
         def dense(key, shape, scale_dim=None):
-            scale = 1.0 / np.sqrt(scale_dim if scale_dim is not None else shape[0])
+            # Stacked-layer weights are (L, fan_in, fan_out): the fan-in is the
+            # second-to-last dim, not the layer count.
+            fan_in = scale_dim if scale_dim is not None else (shape[-2] if len(shape) >= 3 else shape[0])
+            scale = 1.0 / np.sqrt(fan_in)
             return (jax.random.normal(key, shape, jnp.float32) * scale).astype(jnp.float32)
 
         return {
@@ -126,6 +129,13 @@ class GPT2(Module):
     # ---------------------------------------------------------------- forward
     def embed(self, params, input_ids, positions=None, attention_mask=None):
         B, S = input_ids.shape
+        if S > self.config.max_position_embeddings:
+            # Learned positions have a hard table limit; jnp.take would silently
+            # clamp out-of-range rows to the last position otherwise.
+            raise ValueError(
+                f"sequence length {S} exceeds max_position_embeddings "
+                f"{self.config.max_position_embeddings}"
+            )
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (B, S))
         x = jnp.take(params["embed"]["wte"], input_ids, axis=0) + jnp.take(
@@ -186,13 +196,24 @@ class GPT2(Module):
                 [labels[:, 1:], jnp.full((B, 1), -100, labels.dtype)], axis=1
             )
             if attention_mask is not None:
-                shifted = jnp.where(attention_mask.astype(bool), shifted, -100)
+                # Validity of the *target* (token t+1), so the last real position
+                # of a right-padded row doesn't train toward the pad token.
+                target_valid = jnp.concatenate(
+                    [attention_mask[:, 1:], jnp.zeros((B, 1), attention_mask.dtype)], axis=1
+                )
+                shifted = jnp.where(target_valid.astype(bool), shifted, -100)
             out["loss"] = cross_entropy_loss(logits, shifted)
         return out
 
     def init_cache(self, batch_size: int, max_len: int, dtype=jnp.bfloat16):
         """Pre-allocated decode cache (same layout/contract as Llama's)."""
         cfg = self.config
+        if max_len > cfg.max_position_embeddings:
+            raise ValueError(
+                f"cache length {max_len} exceeds max_position_embeddings "
+                f"{cfg.max_position_embeddings}: learned positions cannot extend "
+                "past the table (decode steps would silently reuse the last row)"
+            )
         shape = (cfg.num_hidden_layers, batch_size, max_len, cfg.num_attention_heads, cfg.head_dim)
         return {
             "k": jnp.zeros(shape, dtype),

@@ -135,7 +135,10 @@ class Llama(Module):
         keys = jax.random.split(rng, 10)
 
         def dense(key, shape, scale_dim=None):
-            scale = 1.0 / np.sqrt(scale_dim if scale_dim is not None else shape[0])
+            # Stacked-layer weights are (L, fan_in, fan_out): the fan-in is the
+            # second-to-last dim, not the layer count.
+            fan_in = scale_dim if scale_dim is not None else (shape[-2] if len(shape) >= 3 else shape[0])
+            scale = 1.0 / np.sqrt(fan_in)
             return (jax.random.normal(key, shape, jnp.float32) * scale).astype(jnp.float32)
 
         params = {
@@ -279,7 +282,12 @@ class Llama(Module):
                 [labels[:, 1:], jnp.full((B, 1), -100, labels.dtype)], axis=1
             )
             if attention_mask is not None:
-                shifted = jnp.where(attention_mask.astype(bool), shifted, -100)
+                # Validity of the *target* (token t+1), so the last real position
+                # of a right-padded row doesn't train toward the pad token.
+                target_valid = jnp.concatenate(
+                    [attention_mask[:, 1:], jnp.zeros((B, 1), attention_mask.dtype)], axis=1
+                )
+                shifted = jnp.where(target_valid.astype(bool), shifted, -100)
             out["loss"] = cross_entropy_loss(logits, shifted)
         return out
 

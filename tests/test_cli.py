@@ -393,13 +393,10 @@ def test_max_restarts_relaunches_gang(tmp_path):
     """--max_restarts relaunches the whole gang; a script that fails once then
     succeeds (via a marker file) must end with rc=0 after one restart."""
     script = tmp_path / "flaky.py"
-    marker = tmp_path / "attempted"
     script.write_text(
         "import os, sys\n"
-        f"marker = {str(marker)!r}\n"
-        "if not os.path.exists(marker):\n"
-        "    open(marker, 'w').write('x')\n"
-        "    sys.exit(1)  # first attempt dies\n"
+        "if os.environ['ACCELERATE_RESTART_ATTEMPT'] == '0':\n"
+        "    sys.exit(1)  # first incarnation dies\n"
         "from accelerate_tpu import Accelerator\n"
         "acc = Accelerator()\n"
         "print('RECOVERED_OK')\n"
@@ -419,17 +416,14 @@ def test_max_restarts_relaunches_multi_process_gang(tmp_path):
     """The multi-process (gang) path must also recover: all ranks die on the
     first incarnation, the gang is relaunched, and rendezvous works again."""
     script = tmp_path / "flaky_gang.py"
-    marker = tmp_path / "gang_attempted"
     script.write_text(
         "import os, sys\n"
-        f"marker = {str(marker)!r}\n"
+        "attempt = os.environ['ACCELERATE_RESTART_ATTEMPT']\n"
         "from accelerate_tpu import Accelerator\n"
         "acc = Accelerator()\n"
-        "if not os.path.exists(marker):\n"
-        "    if acc.is_main_process:\n"
-        "        open(marker, 'w').write('x')\n"
+        "if attempt == '0':\n"
         "    acc.wait_for_everyone()\n"
-        "    sys.exit(3)\n"
+        "    sys.exit(3)  # every rank of incarnation 0 dies after rendezvous\n"
         "print(f'GANG_RECOVERED rank={acc.process_index}')\n"
     )
     proc = subprocess.run(

@@ -1,0 +1,241 @@
+"""Hook-engine tests.
+
+Reference model: ``tests/test_hooks.py`` (459 LoC) — hook protocol, attach/detach,
+SequentialHook composition, AlignDevicesHook weight loading/offload,
+LayerwiseCastingHook dtype policy. Our hooks intercept ``module.apply`` over
+(params, args, kwargs) instead of mutating ``nn.Module.forward`` (hooks.py docstring).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from accelerate_tpu.hooks import (
+    AlignDevicesHook,
+    CpuOffload,
+    DequantizeHook,
+    LayerwiseCastingHook,
+    ModelHook,
+    SequentialHook,
+    UserCpuOffloadHook,
+    add_hook_to_module,
+    remove_hook_from_module,
+)
+from accelerate_tpu.test_utils import RegressionModel
+
+
+def make_model():
+    model = RegressionModel(a=2.0, b=3.0)
+    model.params = model.init(jax.random.key(0))
+    return model
+
+
+X = np.arange(4.0, dtype=np.float32)
+
+
+def test_default_hook_is_identity():
+    model = make_model()
+    baseline = np.asarray(model.apply(model.params, x=X)["prediction"])
+    add_hook_to_module(model, ModelHook())
+    hooked = np.asarray(model.apply(model.params, x=X)["prediction"])
+    np.testing.assert_allclose(hooked, baseline)
+
+
+def test_remove_hook_restores_original_apply():
+    model = make_model()
+    original = model.apply
+
+    class Doubler(ModelHook):
+        def post_forward(self, module, output):
+            output["prediction"] = output["prediction"] * 2
+            return output
+
+    add_hook_to_module(model, Doubler())
+    assert model.apply is not original
+    doubled = np.asarray(model.apply(model.params, x=X)["prediction"])
+    np.testing.assert_allclose(doubled, (2.0 * X + 3.0) * 2)
+
+    remove_hook_from_module(model)
+    assert model._at_hook is None
+    restored = np.asarray(model.apply(model.params, x=X)["prediction"])
+    np.testing.assert_allclose(restored, 2.0 * X + 3.0)
+
+
+def test_pre_forward_can_rewrite_params_and_inputs():
+    model = make_model()
+
+    class ZeroSlope(ModelHook):
+        def pre_forward(self, module, params, args, kwargs):
+            params = dict(params, a=jnp.zeros_like(params["a"]))
+            kwargs = dict(kwargs, x=kwargs["x"] + 1.0)
+            return params, args, kwargs
+
+    add_hook_to_module(model, ZeroSlope())
+    out = np.asarray(model.apply(model.params, x=X)["prediction"])
+    np.testing.assert_allclose(out, np.full_like(X, 3.0))  # a=0 ⇒ constant b
+
+
+def test_append_composes_in_order():
+    """append=True wraps the old hook in a SequentialHook, old first (reference
+    ``add_hook_to_module(append=True)`` :130-186)."""
+    model = make_model()
+    trace = []
+
+    class Tagger(ModelHook):
+        def __init__(self, tag):
+            self.tag = tag
+
+        def pre_forward(self, module, params, args, kwargs):
+            trace.append(f"pre:{self.tag}")
+            return params, args, kwargs
+
+        def post_forward(self, module, output):
+            trace.append(f"post:{self.tag}")
+            return output
+
+    add_hook_to_module(model, Tagger("first"))
+    add_hook_to_module(model, Tagger("second"), append=True)
+    assert isinstance(model._at_hook, SequentialHook)
+    model.apply(model.params, x=X)
+    assert trace == ["pre:first", "pre:second", "post:first", "post:second"]
+
+    # Removing strips the whole stack in one go.
+    remove_hook_from_module(model)
+    trace.clear()
+    model.apply(model.params, x=X)
+    assert trace == []
+
+
+def test_add_hook_without_append_replaces():
+    model = make_model()
+
+    class AddOne(ModelHook):
+        def post_forward(self, module, output):
+            output["prediction"] = output["prediction"] + 1
+            return output
+
+    add_hook_to_module(model, AddOne())
+    add_hook_to_module(model, ModelHook())  # replace, not compose
+    out = np.asarray(model.apply(model.params, x=X)["prediction"])
+    np.testing.assert_allclose(out, 2.0 * X + 3.0)  # AddOne is gone
+
+
+def test_sequential_hook_init_and_detach_run_all():
+    seen = []
+
+    class Recorder(ModelHook):
+        def __init__(self, tag):
+            self.tag = tag
+
+        def init_hook(self, module):
+            seen.append(f"init:{self.tag}")
+            return module
+
+        def detach_hook(self, module):
+            seen.append(f"detach:{self.tag}")
+            return module
+
+    model = make_model()
+    add_hook_to_module(model, SequentialHook(Recorder("a"), Recorder("b")))
+    remove_hook_from_module(model)
+    assert seen == ["init:a", "init:b", "detach:a", "detach:b"]
+
+
+def test_align_devices_hook_places_on_device():
+    model = make_model()
+    device = jax.local_devices()[0]
+    add_hook_to_module(model, AlignDevicesHook(execution_device=device))
+    out = model.apply(model.params, x=X)["prediction"]
+    assert isinstance(out, jax.Array)
+    assert out.devices() == {device}
+
+
+def test_align_devices_hook_loads_missing_weights_from_map():
+    """Abstract (ShapeDtypeStruct) leaves are filled from the weights_map by name —
+    the offloaded-weights path (reference AlignDevicesHook pre_forward :328-371)."""
+    model = make_model()
+    abstract = jax.tree_util.tree_map(
+        lambda p: jax.ShapeDtypeStruct(np.shape(p), p.dtype), model.params
+    )
+    weights_map = {"a": np.float32(5.0), "b": np.float32(-1.0)}
+    add_hook_to_module(model, AlignDevicesHook(weights_map=weights_map))
+    out = np.asarray(model.apply(abstract, x=X)["prediction"])
+    np.testing.assert_allclose(out, 5.0 * X - 1.0)
+
+
+def test_align_devices_hook_io_same_device_roundtrip():
+    model = make_model()
+    device = jax.local_devices()[1] if len(jax.local_devices()) > 1 else jax.local_devices()[0]
+    x_dev = jax.device_put(jnp.asarray(X), jax.local_devices()[0])
+    add_hook_to_module(model, AlignDevicesHook(execution_device=device, io_same_device=True))
+    out = model.apply(model.params, x=x_dev)["prediction"]
+    assert out.sharding == x_dev.sharding
+
+
+def test_cpu_offload_hook_and_user_handle():
+    model = make_model()
+    hook = CpuOffload(execution_device=jax.local_devices()[0])
+    add_hook_to_module(model, hook)
+    handle = UserCpuOffloadHook(model, hook)
+    out = model.apply(model.params, x=X)["prediction"]
+    assert isinstance(out, jax.Array)
+    handle.offload()
+    assert isinstance(model.params["a"], np.ndarray)  # back on host
+    # Still works after offload: pre_forward re-places per call.
+    out2 = np.asarray(model.apply(model.params, x=X)["prediction"])
+    np.testing.assert_allclose(out2, np.asarray(out))
+    handle.remove()
+    assert model._at_hook is None
+
+
+def test_cpu_offload_prev_module_eviction():
+    """prev_module_hook chains evict the previous model when the next runs
+    (reference CpuOffload :689-714, the SD UNet/VAE pattern)."""
+    first, second = make_model(), make_model()
+    first.params = jax.device_put(first.params, jax.local_devices()[0])
+    hook1 = CpuOffload(execution_device=jax.local_devices()[0])
+    add_hook_to_module(first, hook1)
+    handle1 = UserCpuOffloadHook(first, hook1)
+    hook2 = CpuOffload(execution_device=jax.local_devices()[0], prev_module_hook=handle1)
+    add_hook_to_module(second, hook2)
+
+    assert isinstance(first.params["a"], jax.Array)
+    second.apply(second.params, x=X)
+    assert isinstance(first.params["a"], np.ndarray)  # evicted by hook2.pre_forward
+
+
+def test_layerwise_casting_hook_storage_and_compute():
+    model = make_model()
+    add_hook_to_module(
+        model, LayerwiseCastingHook(storage_dtype=jnp.bfloat16, compute_dtype=jnp.float32)
+    )
+    # init_hook downcast the stored params to bf16...
+    assert model.params["a"].dtype == jnp.bfloat16
+    # ...but compute sees float32.
+    out = model.apply(model.params, x=X)["prediction"]
+    assert out.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(out), 2.0 * X + 3.0, atol=0.05)
+
+
+def test_dequantize_hook_matches_dense():
+    from accelerate_tpu.utils.quantization import QuantizationConfig, quantize_tree
+
+    class Linear:
+        def apply(self, params, x):
+            return x @ params["w"]
+
+    model = Linear()
+    rng = np.random.default_rng(0)
+    model.params = {"w": jnp.asarray(rng.normal(size=(4, 4)).astype(np.float32))}
+    x = jnp.asarray(rng.normal(size=(2, 4)).astype(np.float32))
+    dense = np.asarray(model.apply(model.params, x))
+    qparams = quantize_tree(model.params, QuantizationConfig(load_in_8bit=True))
+    add_hook_to_module(model, DequantizeHook(compute_dtype=jnp.float32))
+    out = np.asarray(model.apply(qparams, x))
+    np.testing.assert_allclose(out, dense, atol=0.1)
+
+
+def test_no_grad_flag_present_for_parity():
+    assert ModelHook.no_grad is False

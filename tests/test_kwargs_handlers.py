@@ -1,0 +1,172 @@
+"""Kwargs-handler / plugin dataclass tests.
+
+Reference model: ``tests/test_kwargs_handlers.py`` (206 LoC) — to_kwargs diffing,
+plugin validation, handler plumbing into the Accelerator.
+"""
+
+import pytest
+
+from accelerate_tpu import Accelerator, GradientAccumulationPlugin
+from accelerate_tpu.utils.dataclasses import (
+    AutocastKwargs,
+    DataLoaderConfiguration,
+    FullyShardedDataParallelPlugin,
+    JaxShardingKwargs,
+    KwargsHandler,
+    PipelineParallelPlugin,
+    PrecisionType,
+    ProfileKwargs,
+    ProjectConfiguration,
+    RNGType,
+    SequenceParallelPlugin,
+    TensorParallelPlugin,
+)
+
+
+def test_to_kwargs_diffs_defaults():
+    """Only non-default fields survive (reference ``KwargsHandler.to_kwargs``
+    :64-78 — the contract every handler relies on)."""
+    from dataclasses import dataclass
+
+    @dataclass
+    class MockHandler(KwargsHandler):
+        a: int = 0
+        b: float = 1.5
+        c: str = "x"
+
+    assert MockHandler().to_kwargs() == {}
+    assert MockHandler(a=2, c="x").to_kwargs() == {"a": 2}
+    assert MockHandler(a=2, b=-1.0).to_kwargs() == {"a": 2, "b": -1.0}
+
+
+def test_grad_accum_plugin_defaults_and_diff():
+    plugin = GradientAccumulationPlugin(num_steps=4)
+    kw = plugin.to_kwargs()
+    assert kw == {"num_steps": 4}
+    assert plugin.sync_with_dataloader is True
+    # None coerces back to True (reference __post_init__).
+    assert GradientAccumulationPlugin(sync_with_dataloader=None).sync_with_dataloader is True
+
+
+def test_grad_accum_plugin_reaches_gradient_state():
+    accelerator = Accelerator(
+        gradient_accumulation_plugin=GradientAccumulationPlugin(num_steps=3)
+    )
+    assert accelerator.gradient_state.num_steps == 3
+    assert accelerator.gradient_accumulation_steps == 3
+
+
+def test_grad_accum_plugin_conflicts_with_int_arg():
+    with pytest.raises(ValueError):
+        Accelerator(
+            gradient_accumulation_steps=2,
+            gradient_accumulation_plugin=GradientAccumulationPlugin(num_steps=4),
+        )
+
+
+def test_precision_type_contains_and_list():
+    assert "bf16" in PrecisionType
+    assert "fp64" not in PrecisionType
+    assert set(PrecisionType.list()) == {"no", "bf16", "fp16", "fp8"}
+    assert str(PrecisionType.BF16) == "bf16"
+
+
+def test_rng_type_enum():
+    assert "generator" in RNGType
+    assert "cuda" not in RNGType
+
+
+def test_fsdp_plugin_validation():
+    plugin = FullyShardedDataParallelPlugin(fsdp_size=4, cpu_offload=True)
+    assert plugin.to_kwargs() == {"fsdp_size": 4, "cpu_offload": True}
+    with pytest.raises(ValueError):
+        FullyShardedDataParallelPlugin(state_dict_type="BOGUS")
+
+
+def test_tp_plugin_validation():
+    assert TensorParallelPlugin(tp_size=2).tp_size == 2
+    with pytest.raises(ValueError):
+        TensorParallelPlugin(tp_size=0)
+
+
+def test_pp_and_sp_plugin_defaults():
+    assert PipelineParallelPlugin().schedule == "gpipe"
+    assert SequenceParallelPlugin().ring_attention is True
+
+
+def test_autocast_kwargs_parity_slot():
+    assert AutocastKwargs(enabled=False).to_kwargs() == {"enabled": False}
+
+
+def test_jax_sharding_kwargs():
+    kw = JaxShardingKwargs(donate_params=False, remat_policy="full")
+    assert kw.to_kwargs() == {"donate_params": False, "remat_policy": "full"}
+
+
+def test_profile_kwargs_builds_profiler():
+    import jax.profiler
+
+    assert ProfileKwargs().build() is jax.profiler
+
+
+def test_project_configuration_directories():
+    cfg = ProjectConfiguration(project_dir="/tmp/proj")
+    assert cfg.logging_dir == "/tmp/proj"  # defaults to project_dir
+    cfg2 = ProjectConfiguration(project_dir="/tmp/a", logging_dir="/tmp/logs")
+    assert cfg2.logging_dir == "/tmp/logs"
+    cfg2.set_directories("/tmp/b")
+    assert cfg2.project_dir == "/tmp/b"
+
+
+def test_dataloader_configuration_defaults():
+    cfg = DataLoaderConfiguration()
+    assert cfg.split_batches is False
+    assert cfg.even_batches is True
+    assert DataLoaderConfiguration(split_batches=True).to_kwargs() == {"split_batches": True}
+
+
+def test_accelerator_accepts_kwargs_handlers():
+    accelerator = Accelerator(kwargs_handlers=[AutocastKwargs(enabled=True)])
+    assert accelerator.autocast_handler is not None
+
+
+def test_autocast_disabled_pins_fp32_compute():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from accelerate_tpu.test_utils import RegressionModel
+
+    accelerator = Accelerator(
+        mixed_precision="bf16", kwargs_handlers=[AutocastKwargs(enabled=False)]
+    )
+    model = RegressionModel()
+    model.init_params(jax.random.key(0))
+    pmodel, _ = accelerator.prepare(model, optax.sgd(0.1))
+    assert pmodel.handle.compute_dtype == jnp.float32  # bf16 overridden
+
+
+def test_autocast_context_governs_models_prepared_inside():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from accelerate_tpu.test_utils import RegressionModel
+
+    accelerator = Accelerator(mixed_precision="bf16")
+    with accelerator.autocast(AutocastKwargs(enabled=False)):
+        model = RegressionModel()
+        model.init_params(jax.random.key(0))
+        pmodel, _ = accelerator.prepare(model, optax.sgd(0.1))
+    assert pmodel.handle.compute_dtype == jnp.float32
+    assert accelerator.autocast_handler is None  # restored on exit
+
+
+def test_accelerator_rejects_non_handler():
+    with pytest.raises(AssertionError):
+        Accelerator(kwargs_handlers=["not-a-handler"])
+
+
+def test_duplicate_handler_rejected():
+    with pytest.raises(ValueError):
+        Accelerator(kwargs_handlers=[AutocastKwargs(), AutocastKwargs()])

@@ -183,3 +183,53 @@ def test_gpt2_forward_train_and_pipeline():
     piped = prepare_pippy(model2, split_points=2, num_chunks=2)
     out = piped(input_ids=ids)
     assert np.isfinite(np.asarray(out.logits)).all()
+
+
+def test_stacked_init_uses_fan_in_not_layer_count():
+    """Stacked (L, fan_in, fan_out) weights must be scaled by 1/sqrt(fan_in);
+    scaling by the layer count L gives ~sqrt(h/L)x-too-large weights and blows up
+    activations at depth (code-review finding, round 2)."""
+    from accelerate_tpu.models import GPT2, GPT2Config, Llama, LlamaConfig
+
+    g = GPT2(GPT2Config.tiny(hidden_size=64, num_hidden_layers=2))
+    gp = g.init(jax.random.key(0))
+    std = float(np.std(np.asarray(gp["layers"]["attn"]["w_qkv"])))
+    assert abs(std - 1.0 / np.sqrt(64)) < 0.02, std
+
+    l = Llama(LlamaConfig.tiny(hidden_size=64, num_hidden_layers=2))
+    lp = l.init(jax.random.key(0))
+    std = float(np.std(np.asarray(lp["layers"]["attn"]["wq"])))
+    assert abs(std - 1.0 / np.sqrt(64)) < 0.02, std
+
+
+def test_gpt2_rejects_positions_past_table():
+    """Learned-position models must hard-error instead of silently clamping to
+    the last wpe row (jnp.take clip mode)."""
+    from accelerate_tpu.models import GPT2, GPT2Config
+
+    model = GPT2(GPT2Config.tiny(max_position_embeddings=16))
+    model.init_params(jax.random.key(0))
+    ids = np.zeros((1, 32), np.int32)
+    with pytest.raises(ValueError, match="max_position_embeddings"):
+        model.apply(model.params, input_ids=ids)
+    with pytest.raises(ValueError, match="max_position_embeddings"):
+        model.init_cache(batch_size=1, max_len=32)
+
+
+def test_shifted_label_mask_excludes_pad_targets():
+    """Right-padded rows: the last real position's target is padding and must be
+    ignored, not trained toward the pad token (code-review finding, round 2).
+    Loss over [t0..t2, PAD, PAD] must equal loss over the unpadded row."""
+    from accelerate_tpu.models import Llama
+
+    cfg = LlamaConfig.tiny(max_position_embeddings=16)
+    model = Llama(cfg)
+    model.init_params(jax.random.key(0))
+    full = np.array([[5, 6, 7, 8]], np.int32)
+    padded = np.array([[5, 6, 7, 8, 0, 0]], np.int32)
+    mask = np.array([[1, 1, 1, 1, 0, 0]], np.int32)
+    loss_full = float(model.apply(model.params, input_ids=full, labels=full)["loss"])
+    loss_padded = float(
+        model.apply(model.params, input_ids=padded, labels=padded, attention_mask=mask)["loss"]
+    )
+    np.testing.assert_allclose(loss_padded, loss_full, rtol=1e-5)

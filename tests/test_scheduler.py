@@ -1,0 +1,141 @@
+"""AcceleratedScheduler tests.
+
+Reference model: ``tests/test_scheduler.py`` — lambda-scheduler stepping under
+accumulation/split_batches, plus our write-through into optax inject_hyperparams.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import optax
+
+from accelerate_tpu import Accelerator, GradientAccumulationPlugin
+from accelerate_tpu.scheduler import AcceleratedScheduler
+from accelerate_tpu.state import GradientState
+from accelerate_tpu.test_utils import RegressionDataset, RegressionModel, regression_batches
+
+
+class FakeOptimizer:
+    step_was_skipped = False
+
+    def __init__(self):
+        self.lr_history = []
+
+    def set_learning_rate(self, lr):
+        self.lr_history.append(lr)
+
+
+def make_sched(schedule=None, **kwargs):
+    GradientState()  # ensure singleton exists
+    return AcceleratedScheduler(
+        schedule or (lambda step: 0.1 * (0.5 ** (step // 10))),
+        FakeOptimizer(),
+        **kwargs,
+    )
+
+
+def test_rejects_non_callable():
+    with pytest.raises(TypeError):
+        AcceleratedScheduler("not-a-schedule", FakeOptimizer())
+
+
+def test_steps_only_on_sync_boundaries():
+    sched = make_sched()
+    state = GradientState()
+    state._set_sync_gradients(False)
+    sched.step()
+    assert sched.step_count == 0  # accumulating: no tick (reference :63-69)
+    state._set_sync_gradients(True)
+    sched.step()
+    assert sched.step_count == 1
+
+
+def test_skips_when_optimizer_skipped():
+    """fp16 overflow skip must hold the schedule too (reference :73-81)."""
+    sched = make_sched()
+    GradientState()._set_sync_gradients(True)
+    sched.optimizers[0].step_was_skipped = True
+    sched.step()
+    assert sched.step_count == 0
+    sched.optimizers[0].step_was_skipped = False
+    sched.step()
+    assert sched.step_count == 1
+
+
+def test_step_without_optimizer_gating():
+    sched = make_sched(step_with_optimizer=False)
+    GradientState()._set_sync_gradients(False)
+    for _ in range(5):
+        sched.step()
+    assert sched.step_count == 5  # ungated
+
+
+def test_lr_curve_and_write_through():
+    sched = make_sched(schedule=optax.linear_schedule(1.0, 0.0, 10))
+    GradientState()._set_sync_gradients(True)
+    assert sched.get_last_lr() == [1.0]
+    for _ in range(5):
+        sched.step()
+    assert abs(sched.get_last_lr()[0] - 0.5) < 1e-6
+    assert sched.optimizers[0].lr_history[-1] == sched.get_last_lr()[0]
+
+
+def test_state_dict_roundtrip():
+    sched = make_sched()
+    GradientState()._set_sync_gradients(True)
+    for _ in range(7):
+        sched.step()
+    blob = sched.state_dict()
+    fresh = make_sched()
+    fresh.load_state_dict(blob)
+    assert fresh.step_count == 7
+    assert fresh.get_last_lr() == sched.get_last_lr()
+    assert fresh.optimizers[0].lr_history[-1] == sched.get_last_lr()[0]
+
+
+def test_inject_hyperparams_write_through_end_to_end():
+    """A prepared inject_hyperparams optimizer sees the scheduled lr on device
+    (scheduler.py write-through into optax hyperparams state)."""
+    accelerator = Accelerator()
+    model = RegressionModel()
+    model.init_params(jax.random.key(0))
+    tx = optax.inject_hyperparams(optax.sgd)(learning_rate=1.0)
+    ds = RegressionDataset(length=32)
+    dl = regression_batches(ds, batch_size=8)
+    schedule = optax.linear_schedule(1.0, 0.0, 8)
+    pmodel, popt, pdl, psched = accelerator.prepare(model, tx, dl, schedule)
+
+    for batch in pdl:
+        out = pmodel(**batch)
+        accelerator.backward(out.loss)
+        popt.step()
+        psched.step()
+        popt.zero_grad()
+    assert psched.step_count == len(pdl)
+    assert popt.learning_rate is not None
+    assert abs(popt.learning_rate - psched.get_last_lr()[0]) < 1e-6
+
+
+def test_accumulation_schedules_once_per_update():
+    """With num_steps=2, the schedule ticks every 2 microbatches — the lr-vs-
+    samples curve matches the unaccumulated run (reference scheduler contract)."""
+    accelerator = Accelerator(
+        gradient_accumulation_plugin=GradientAccumulationPlugin(
+            num_steps=2, sync_with_dataloader=False
+        )
+    )
+    model = RegressionModel()
+    model.init_params(jax.random.key(0))
+    dl = regression_batches(RegressionDataset(length=64), batch_size=8)
+    pmodel, popt, pdl, psched = accelerator.prepare(
+        model, optax.sgd(0.05), dl, optax.constant_schedule(0.05)
+    )
+    for batch in pdl:
+        with accelerator.accumulate(pmodel):
+            out = pmodel(**batch)
+            accelerator.backward(out.loss)
+            popt.step()
+            psched.step()
+            popt.zero_grad()
+    assert psched.step_count == len(pdl) // 2
