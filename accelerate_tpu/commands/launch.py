@@ -114,9 +114,14 @@ def _merge_config(args) -> ClusterConfig:
     return cfg
 
 
-def prepare_launch_env(cfg: ClusterConfig, process_id: int | None = None) -> dict:
-    """Build the ACCELERATE_* env contract (reference ``utils/launch.py:100-352``)."""
+def prepare_launch_env(cfg: ClusterConfig, process_id: int | None = None, attempt: int = 0) -> dict:
+    """Build the ACCELERATE_* env contract (reference ``utils/launch.py:100-352``).
+
+    ``attempt`` is the gang incarnation (0 = first launch); scripts key
+    resume-vs-fresh decisions off it the way torchrun scripts use
+    TORCHELASTIC_RESTART_COUNT."""
     env = dict(os.environ)
+    env["ACCELERATE_RESTART_ATTEMPT"] = str(attempt)
     # Make sure workers can import accelerate_tpu even without a pip install.
     import accelerate_tpu
 
@@ -164,10 +169,7 @@ def simple_launcher(args, cfg: ClusterConfig) -> int:
     """Single process on this host (reference ``launch.py:778-788``)."""
     rank = cfg.machine_rank if cfg.num_machines > 1 else None
     for attempt in range(cfg.max_restarts + 1):
-        env = prepare_launch_env(cfg, process_id=rank)
-        # Scripts key resume-vs-fresh decisions off this (torchrun exposes
-        # TORCHELASTIC_RESTART_COUNT the same way).
-        env["ACCELERATE_RESTART_ATTEMPT"] = str(attempt)
+        env = prepare_launch_env(cfg, process_id=rank, attempt=attempt)
         proc = subprocess.run(_script_cmd(args), env=env)
         if proc.returncode == 0:
             return 0
@@ -205,8 +207,7 @@ def _run_gang_once(args, cfg: ClusterConfig, attempt: int = 0) -> int:
     nproc = cfg.num_processes
     procs = []
     for rank in range(nproc):
-        env = prepare_launch_env(cfg, process_id=rank)
-        env["ACCELERATE_RESTART_ATTEMPT"] = str(attempt)
+        env = prepare_launch_env(cfg, process_id=rank, attempt=attempt)
         procs.append(subprocess.Popen(_script_cmd(args), env=env))
     # Poll rather than wait sequentially: if one rank dies before the JAX
     # rendezvous completes, the others would block in initialize() forever —

@@ -282,12 +282,13 @@ class Llama(Module):
                 [labels[:, 1:], jnp.full((B, 1), -100, labels.dtype)], axis=1
             )
             if attention_mask is not None:
-                # Validity of the *target* (token t+1), so the last real position
-                # of a right-padded row doesn't train toward the pad token.
+                # A position trains only if it is itself real (left-padding
+                # guard) AND its target token t+1 is real (right-padding guard).
                 target_valid = jnp.concatenate(
                     [attention_mask[:, 1:], jnp.zeros((B, 1), attention_mask.dtype)], axis=1
                 )
-                shifted = jnp.where(target_valid.astype(bool), shifted, -100)
+                valid = target_valid.astype(bool) & attention_mask.astype(bool)
+                shifted = jnp.where(valid, shifted, -100)
             out["loss"] = cross_entropy_loss(logits, shifted)
         return out
 

@@ -1,0 +1,61 @@
+"""Launch a training script on every host of a remote TPU pod from your laptop.
+
+Reference analog: ``examples/multigpu_remote_launcher.py`` (runhouse fan-out of a
+torch multi-GPU launch). TPU-native shape: a pod slice already has N hosts wired
+together over ICI, so "remote launch" = fan ONE launcher command to every pod
+worker (``gcloud ... ssh --worker=all`` or an SSH host list) with the right
+per-host rank; JAX's coordinator does the rendezvous and XLA compiles the
+cross-host collectives. This reuses the ``accelerate-tpu tpu-config`` machinery
+(``commands/tpu.py``) rather than a third-party scheduler.
+
+Dry-run (prints the per-host commands, no gcloud/ssh needed)::
+
+    python examples/multihost_remote_launcher.py --tpu_name my-pod \
+        --tpu_zone us-central2-b --num_hosts 4 --debug
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from accelerate_tpu.commands.tpu import tpu_command_launcher, tpu_command_parser
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--tpu_name", required=True, help="gcloud TPU pod name")
+    parser.add_argument("--tpu_zone", required=True, help="GCE zone of the pod")
+    parser.add_argument("--num_hosts", type=int, default=4, help="Hosts in the slice")
+    parser.add_argument(
+        "--script", default="examples/complete_nlp_example.py", help="Training script to run"
+    )
+    parser.add_argument("--mixed_precision", default="bf16", choices=["no", "bf16", "fp16"])
+    parser.add_argument("--debug", action="store_true", help="Print commands instead of running")
+    args = parser.parse_args()
+
+    # One launcher process per host. gcloud's --worker=all runs the same command
+    # on every worker; the per-host machine_rank comes from the TPU runtime's
+    # TPU_WORKER_ID on the host itself, so the command can be identical.
+    launch = (
+        "python -m accelerate_tpu.commands.launch "
+        f"--num_machines {args.num_hosts} "
+        '--machine_rank "${TPU_WORKER_ID:-0}" '
+        f"--mixed_precision {args.mixed_precision} "
+        f"{args.script}"
+    )
+
+    tpu_args = tpu_command_parser().parse_args(
+        [
+            "--tpu_name", args.tpu_name,
+            "--tpu_zone", args.tpu_zone,
+            "--command", launch,
+        ]
+        + (["--debug"] if args.debug else [])
+    )
+    tpu_command_launcher(tpu_args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,46 @@
+#!/bin/bash
+# SLURM submission for a multi-host TPU job (reference analog:
+# examples/slurm/submit_multinode.sh — GPU rdzv/c10d swapped for the JAX
+# coordinator contract: one process per TPU host, machine_rank = SLURM_PROCID).
+#
+# Each host runs ONE process that drives all its local TPU chips; JAX's
+# distributed runtime rendezvouses at the head node, and XLA compiles the
+# cross-host collectives onto ICI/DCN — there is no per-GPU process fan-out
+# to configure.
+
+#SBATCH --job-name=tpu-multihost
+#SBATCH -D .
+#SBATCH --output=O-%x.%j
+#SBATCH --error=E-%x.%j
+#SBATCH --nodes=4                   # TPU hosts in the slice
+#SBATCH --ntasks-per-node=1         # ONE process per host (it owns all local chips)
+#SBATCH --cpus-per-task=96
+#SBATCH --time=01:59:00
+
+######################
+### Set environment ##
+######################
+# source activate_environment.sh   # your venv/conda with accelerate_tpu installed
+export ACCELERATE_TPU_DIR="${ACCELERATE_TPU_DIR:-$PWD}"
+
+######################
+#### Set network #####
+######################
+head_node_ip=$(scontrol show hostnames "$SLURM_JOB_NODELIST" | head -n 1)
+
+export LAUNCHER="python -m accelerate_tpu.commands.launch \
+    --num_machines $SLURM_NNODES \
+    --machine_rank \$SLURM_PROCID \
+    --main_process_ip $head_node_ip \
+    --main_process_port 29500 \
+    --mixed_precision bf16 \
+    "
+export SCRIPT="${ACCELERATE_TPU_DIR}/examples/complete_nlp_example.py"
+export SCRIPT_ARGS=" \
+    --checkpointing_steps epoch \
+    --output_dir ${ACCELERATE_TPU_DIR}/examples/output \
+    "
+
+# srun starts one launcher per host; each reads its rank from SLURM_PROCID and
+# joins the JAX coordinator on the head node.
+srun bash -c "$LAUNCHER $SCRIPT $SCRIPT_ARGS"

@@ -1,0 +1,21 @@
+#!/bin/bash
+# SLURM submission for a single-host TPU job (reference analog:
+# examples/slurm/submit_multigpu.sh). One process drives every chip attached to
+# the host; data parallelism across the local chips comes from the device mesh,
+# not from process count.
+
+#SBATCH --job-name=tpu-singlehost
+#SBATCH -D .
+#SBATCH --output=O-%x.%j
+#SBATCH --error=E-%x.%j
+#SBATCH --nodes=1
+#SBATCH --ntasks-per-node=1
+#SBATCH --cpus-per-task=96
+#SBATCH --time=01:59:00
+
+export ACCELERATE_TPU_DIR="${ACCELERATE_TPU_DIR:-$PWD}"
+
+export LAUNCHER="python -m accelerate_tpu.commands.launch --mixed_precision bf16"
+export SCRIPT="${ACCELERATE_TPU_DIR}/examples/nlp_example.py"
+
+srun bash -c "$LAUNCHER $SCRIPT"

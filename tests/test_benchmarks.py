@@ -1,0 +1,27 @@
+"""Keep the benchmark scripts runnable (reference ``tests/test_examples.py``
+runs its benchmark-adjacent scripts the same way)."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_fsdp2_memory_benchmark_scales_and_matches():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "benchmarks", "fsdp2_memory.py")],
+        capture_output=True,
+        text=True,
+        timeout=420,
+        env={**os.environ, "BENCH_FSDP_SIZES": "1,8", "BENCH_FSDP_DEVICES": "8"},
+        cwd=REPO_ROOT,
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    record = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert record["value"] == 0.125  # exact 1/8 per-device param bytes
+    assert record["detail"]["memory_scales_as_1_over_n"] is True
+    assert record["detail"]["loss_parity_across_shardings"] is True
+    sharded = record["detail"]["rows"][-1]
+    assert sharded["collectives"]["all-gather"] > 0  # reshard-on-use is real

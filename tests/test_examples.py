@@ -138,3 +138,15 @@ def test_launch_cli_runs_flagship(tmp_path):
         capture_output=True, text=True, timeout=900, env=env, cwd=REPO,
     )
     assert proc.returncode == 0, proc.stderr[-3000:]
+
+
+def test_multihost_remote_launcher_dry_run():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, "multihost_remote_launcher.py"),
+         "--tpu_name", "pod", "--tpu_zone", "us-central2-b", "--num_hosts", "2", "--debug"],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+        env={**os.environ, "PYTHONPATH": REPO},
+    )
+    assert proc.returncode == 0, proc.stderr[-1500:]
+    assert "--worker all" in proc.stdout
+    assert "--num_machines 2" in proc.stdout

@@ -233,3 +233,25 @@ def test_shifted_label_mask_excludes_pad_targets():
         model.apply(model.params, input_ids=padded, labels=padded, attention_mask=mask)["loss"]
     )
     np.testing.assert_allclose(loss_padded, loss_full, rtol=1e-5)
+
+
+def test_shifted_label_mask_excludes_left_pad_positions():
+    """Left-padded rows: pad positions have a valid-looking next token but must
+    not train (their logits come from pad context). Loss must match unpadded."""
+    from accelerate_tpu.models import Llama
+
+    cfg = LlamaConfig.tiny(max_position_embeddings=16)
+    model = Llama(cfg)
+    model.init_params(jax.random.key(0))
+    full = np.array([[5, 6, 7, 8]], np.int32)
+    left = np.array([[0, 0, 5, 6, 7, 8]], np.int32)
+    lmask = np.array([[0, 0, 1, 1, 1, 1]], np.int32)
+    loss_left = float(
+        model.apply(model.params, input_ids=left, labels=left, attention_mask=lmask)["loss"]
+    )
+    # Count of training targets must be 3 either way; a leaked pad position
+    # would add a 4th target (the pad->5 transition) and move the loss. RoPE
+    # depends only on position differences and pads are attention-masked, so
+    # the match is exact.
+    loss_full = float(model.apply(model.params, input_ids=full, labels=full)["loss"])
+    np.testing.assert_allclose(loss_left, loss_full, rtol=1e-6)
