@@ -13,6 +13,7 @@ from .state import AcceleratorState, DistributedType, GradientState, PartialStat
 from .parallel.mesh import ParallelismConfig
 from .utils.dataclasses import (
     AutocastKwargs,
+    Fp8RecipeKwargs,
     DataLoaderConfiguration,
     FullyShardedDataParallelPlugin,
     GradientAccumulationPlugin,

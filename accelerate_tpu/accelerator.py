@@ -64,6 +64,7 @@ from .state import AcceleratorState, DistributedType, GradientState, PartialStat
 from .utils.dataclasses import (
     AutocastKwargs,
     DataLoaderConfiguration,
+    Fp8RecipeKwargs,
     FullyShardedDataParallelPlugin,
     GradientAccumulationPlugin,
     JaxShardingKwargs,
@@ -240,6 +241,7 @@ class Accelerator:
         self.sharding_kwargs = JaxShardingKwargs()
         self.autocast_handler = None
         self.profile_handler = None
+        self.fp8_recipe_handler = None
         seen_handler_classes = set()
         for handler in kwargs_handlers or []:
             assert isinstance(handler, KwargsHandler), (
@@ -257,6 +259,8 @@ class Accelerator:
                 self.autocast_handler = handler
             elif isinstance(handler, ProfileKwargs):
                 self.profile_handler = handler
+            elif isinstance(handler, Fp8RecipeKwargs):
+                self.fp8_recipe_handler = handler
 
         if parallelism_config is None:
             parallelism_config = self._resolve_parallelism(
@@ -313,14 +317,9 @@ class Accelerator:
             )
         cfg = ParallelismConfig.from_env()
         if fsdp_plugin is not None:
+            # -1 = full-shard over all remaining devices; ParallelismConfig
+            # resolves it against the device count at mesh-build time.
             cfg.fsdp_size = fsdp_plugin.fsdp_size if fsdp_plugin.fsdp_size > 0 else -1
-            if cfg.fsdp_size == -1:
-                cfg.fsdp_size, cfg.dp_size = 1, cfg.dp_size  # resolved against devices below
-                import jax as _jax
-
-                denom = cfg.tp_size * cfg.pp_size * cfg.sp_size
-                cfg.fsdp_size = max(_jax.device_count() // denom, 1)
-                cfg.dp_size = 1
         if tp_plugin is not None:
             cfg.tp_size = tp_plugin.tp_size
         if pp_plugin is not None:
@@ -376,6 +375,16 @@ class Accelerator:
     @gradient_accumulation_steps.setter
     def gradient_accumulation_steps(self, value):
         self.gradient_state.plugin_kwargs.update({"num_steps": value})
+
+    @property
+    def fp8_backend(self):
+        """Which low-precision backend serves ``mixed_precision='fp8'`` (reference
+        ``fp8_backend`` property :3939-3952): "INT8" (QAT matmuls) or "BF16"
+        (cast-only fallback); None when fp8 isn't requested."""
+        if self.state.mixed_precision != "fp8":
+            return None
+        recipe = self.fp8_recipe_handler or Fp8RecipeKwargs()
+        return recipe.backend.upper()
 
     @property
     def sync_gradients(self):
@@ -538,6 +547,17 @@ class Accelerator:
         rules = None
         if isinstance(module, Module):
             rules = module.sharding_rules()
+        # fp8 mixed precision: swap eligible model matmuls to the int8 QAT path
+        # (reference routes fp8 through TE/AO module conversion at prepare time,
+        # accelerator.py:1802-1830 there; see Fp8RecipeKwargs for the TPU story).
+        if self.state.mixed_precision == "fp8" and self.fp8_backend == "INT8":
+            model_cfg = getattr(module, "config", None)
+            if model_cfg is not None and getattr(model_cfg, "matmul_precision", None) == "default":
+                import dataclasses as _dc
+
+                # Give the module its own config copy: a config shared with other
+                # models (or serialized later) must not silently turn int8.
+                module.config = _dc.replace(model_cfg, matmul_precision="int8")
         min_shard = self.fsdp_plugin.min_shard_size if self.fsdp_plugin is not None else 2**14
         shardings = plan_param_shardings(params, self.mesh, rules=rules, min_shard_size=min_shard)
         params = apply_shardings(params, shardings)

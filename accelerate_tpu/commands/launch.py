@@ -58,7 +58,7 @@ def launch_command_parser(subparsers=None) -> argparse.ArgumentParser:
         help="Expose N virtual XLA host devices per process (CPU simulation)",
     )
     # Precision / debug
-    parser.add_argument("--mixed_precision", choices=["no", "bf16", "fp16"], default=None)
+    parser.add_argument("--mixed_precision", choices=["no", "bf16", "fp16", "fp8"], default=None)
     parser.add_argument("--debug", action="store_true", default=None, help="Enable collective shape checks")
     parser.add_argument(
         "--max_restarts", type=int, default=None,

@@ -52,8 +52,12 @@ class ParallelismConfig:
     def __post_init__(self):
         if self.dp_size == 0:
             self.dp_size = -1  # config-file convention: 0 also means "infer"
+        if self.fsdp_size in (0, -1):
+            # FSDP-plugin convention: full-shard over every device left after the
+            # model axes (reference FULL_SHARD has no explicit degree either).
+            self.fsdp_size = -1
         for name in ("fsdp_size", "tp_size", "pp_size", "sp_size", "ep_size"):
-            if getattr(self, name) < 1:
+            if getattr(self, name) < 1 and not (name == "fsdp_size" and self.fsdp_size == -1):
                 raise ValueError(f"{name} must be >= 1, got {getattr(self, name)}")
 
     @classmethod
@@ -68,15 +72,26 @@ class ParallelismConfig:
                 if axis not in ("dp", "fsdp", "tp", "pp", "sp", "ep"):
                     raise ValueError(f"Unknown mesh axis {axis!r} in {ENV_MESH_SHAPE}")
                 size = int(size)
-                if axis == "dp" and size == 0:
+                if axis in ("dp", "fsdp") and size == 0:
                     size = -1  # config files use 0 for "absorb remaining devices"
                 kwargs[f"{axis}_size"] = size
         return cls(**kwargs)
 
     def resolved_sizes(self, num_devices: int) -> dict[str, int]:
-        """Resolve ``dp_size=-1`` against the device count and validate divisibility."""
-        model_degree = self.fsdp_size * self.tp_size * self.pp_size * self.sp_size * self.ep_size
-        dp = self.dp_size
+        """Resolve ``dp_size=-1`` / ``fsdp_size=-1`` against the device count and
+        validate divisibility. When both are -1, fsdp absorbs the remainder
+        (full-shard preference, matching the FSDP plugin's FULL_SHARD intent)."""
+        dp, fsdp = self.dp_size, self.fsdp_size
+        other = self.tp_size * self.pp_size * self.sp_size * self.ep_size
+        if fsdp == -1:
+            if dp == -1:
+                dp = 1
+            if num_devices % (dp * other) != 0:
+                raise ValueError(
+                    f"{num_devices} devices not divisible by dp*tp*pp*sp*ep={dp * other}"
+                )
+            fsdp = max(num_devices // (dp * other), 1)
+        model_degree = fsdp * other
         if dp == -1:
             if num_devices % model_degree != 0:
                 raise ValueError(
@@ -86,10 +101,10 @@ class ParallelismConfig:
         total = dp * model_degree
         if total != num_devices:
             raise ValueError(
-                f"Mesh {dict(pp=self.pp_size, dp=dp, fsdp=self.fsdp_size, ep=self.ep_size, sp=self.sp_size, tp=self.tp_size)} "
+                f"Mesh {dict(pp=self.pp_size, dp=dp, fsdp=fsdp, ep=self.ep_size, sp=self.sp_size, tp=self.tp_size)} "
                 f"needs {total} devices but {num_devices} are available."
             )
-        return {"pp": self.pp_size, "dp": dp, "fsdp": self.fsdp_size, "ep": self.ep_size, "sp": self.sp_size, "tp": self.tp_size}
+        return {"pp": self.pp_size, "dp": dp, "fsdp": fsdp, "ep": self.ep_size, "sp": self.sp_size, "tp": self.tp_size}
 
     def build_mesh(self, devices=None) -> Mesh:
         """Build the ``jax.sharding.Mesh``.

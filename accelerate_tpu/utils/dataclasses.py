@@ -177,6 +177,26 @@ class MegatronStylePlugin(KwargsHandler):
 
 
 @dataclass
+class Fp8RecipeKwargs(KwargsHandler):
+    """Low-precision matmul recipe — the TPU answer to the reference's fp8
+    recipe handlers (``TERecipeKwargs``/``AORecipeKwargs``/``MSAMPRecipeKwargs``,
+    reference ``dataclasses.py:298-407``). TPUs through v5p have no fp8 ALUs;
+    the hardware's low-precision lever is the int8 MXU path (2× bf16 TOPS), so
+    ``mixed_precision="fp8"`` maps onto dynamically-quantized int8 matmuls with
+    straight-through-estimator backward (``ops/int8.py``) — quantization-aware
+    training rather than TransformerEngine's delayed-scaling fp8.
+
+    ``backend="int8"`` swaps eligible model matmuls to the QAT path;
+    ``backend="bf16"`` keeps plain bf16 compute (the documented fallback)."""
+
+    backend: str = "int8"  # 'int8' (QAT matmuls) | 'bf16' (cast-only fallback)
+
+    def __post_init__(self):
+        if self.backend not in ("int8", "bf16"):
+            raise ValueError(f"fp8 recipe backend must be int8|bf16, got {self.backend!r}")
+
+
+@dataclass
 class ProfileKwargs(KwargsHandler):
     """Reference ``dataclasses.py:438-552`` builds torch.profiler; here it drives
     ``jax.profiler`` (perfetto/tensorboard trace)."""

@@ -119,7 +119,9 @@ def main():
     ok_memory, ok_numerics = True, True
     for row in rows:
         n = row["fsdp_size"]
-        expected = base["param_bytes_dev0"] / n
+        # Scale from whatever the first measured size was (it need not be 1):
+        # total bytes are invariant, so dev0 bytes scale as base_n/n.
+        expected = base["param_bytes_dev0"] * base["fsdp_size"] / n
         ratio = row["param_bytes_dev0"] / expected
         # Actual shard bytes may exceed the ideal 1/N by padding on
         # non-divisible dims; 15% covers the benchmark shapes.
@@ -131,7 +133,7 @@ def main():
               f"{ratio:>8.3f} {row['collectives']['all-gather']:>10} "
               f"{row['final_loss']:>11.5f}")
 
-    shard_frac = rows[-1]["param_bytes_dev0"] / base["param_bytes_dev0"]
+    shard_frac = rows[-1]["param_bytes_dev0"] / (base["param_bytes_dev0"] * base["fsdp_size"])
     print(json.dumps({
         "metric": "fsdp_full_shard_dev0_param_fraction",
         "value": round(shard_frac, 4),

@@ -31,9 +31,22 @@ def main():
     parser.add_argument(
         "--script", default="examples/complete_nlp_example.py", help="Training script to run"
     )
-    parser.add_argument("--mixed_precision", default="bf16", choices=["no", "bf16", "fp16"])
+    parser.add_argument("--mixed_precision", default="bf16", choices=["no", "bf16", "fp16", "fp8"])
+    parser.add_argument(
+        "--main_process_ip",
+        default=None,
+        help="Coordinator address every host can reach — worker 0's internal IP "
+        "or hostname. Required for real launches (without it each host would "
+        "rendezvous with its own localhost and hang).",
+    )
+    parser.add_argument("--main_process_port", type=int, default=29500)
     parser.add_argument("--debug", action="store_true", help="Print commands instead of running")
     args = parser.parse_args()
+
+    if args.main_process_ip is None and not args.debug:
+        parser.error("--main_process_ip is required for a real launch (worker 0's internal IP)")
+    # gcloud pods name workers predictably; a dry run shows the placeholder.
+    coordinator_ip = args.main_process_ip or f"{args.tpu_name}-worker-0"
 
     # One launcher process per host. gcloud's --worker=all runs the same command
     # on every worker; the per-host machine_rank comes from the TPU runtime's
@@ -42,6 +55,8 @@ def main():
         "python -m accelerate_tpu.commands.launch "
         f"--num_machines {args.num_hosts} "
         '--machine_rank "${TPU_WORKER_ID:-0}" '
+        f"--main_process_ip {coordinator_ip} "
+        f"--main_process_port {args.main_process_port} "
         f"--mixed_precision {args.mixed_precision} "
         f"{args.script}"
     )

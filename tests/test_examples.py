@@ -150,3 +150,15 @@ def test_multihost_remote_launcher_dry_run():
     assert proc.returncode == 0, proc.stderr[-1500:]
     assert "--worker all" in proc.stdout
     assert "--num_machines 2" in proc.stdout
+    assert "--main_process_ip pod-worker-0" in proc.stdout  # debug placeholder
+
+
+def test_multihost_remote_launcher_requires_coordinator_for_real_runs():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, "multihost_remote_launcher.py"),
+         "--tpu_name", "pod", "--tpu_zone", "z", "--num_hosts", "2"],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+        env={**os.environ, "PYTHONPATH": REPO},
+    )
+    assert proc.returncode != 0
+    assert "main_process_ip" in proc.stderr

@@ -164,3 +164,28 @@ def test_split_between_processes_empty_dict():
     state = PartialState()
     with state.split_between_processes({}) as shard:
         assert shard == {}
+
+
+def test_fsdp_minus_one_absorbs_remaining_devices():
+    """fsdp_size=-1 (or 0) = full-shard over everything left after the model
+    axes — resolvable from config files/env, not just the FSDP plugin path."""
+    from accelerate_tpu.parallel.mesh import ParallelismConfig
+
+    sizes = ParallelismConfig(fsdp_size=-1).resolved_sizes(8)
+    assert sizes["fsdp"] == 8 and sizes["dp"] == 1
+    sizes = ParallelismConfig(fsdp_size=0, tp_size=2).resolved_sizes(8)
+    assert sizes["fsdp"] == 4 and sizes["tp"] == 2
+    sizes = ParallelismConfig(dp_size=2, fsdp_size=-1).resolved_sizes(8)
+    assert sizes["dp"] == 2 and sizes["fsdp"] == 4
+
+
+def test_fsdp_minus_one_from_env(monkeypatch):
+    from accelerate_tpu.parallel.mesh import ParallelismConfig
+    from accelerate_tpu.utils.constants import ENV_MESH_SHAPE
+
+    monkeypatch.setenv(ENV_MESH_SHAPE, "dp:1,fsdp:-1,tp:2")
+    cfg = ParallelismConfig.from_env()
+    assert cfg.fsdp_size == -1
+    assert cfg.resolved_sizes(8)["fsdp"] == 4
+    monkeypatch.setenv(ENV_MESH_SHAPE, "fsdp:0,tp:1")
+    assert ParallelismConfig.from_env().resolved_sizes(8)["fsdp"] == 8
