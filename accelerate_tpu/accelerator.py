@@ -267,6 +267,7 @@ class Accelerator:
                 fsdp_plugin, tp_plugin, pp_plugin, sp_plugin, megatron_plugin
             )
         self.fsdp_plugin = fsdp_plugin
+        self.sp_plugin = sp_plugin
         self.state = AcceleratorState(
             mixed_precision=mixed_precision, cpu=cpu, parallelism_config=parallelism_config
         )
@@ -550,14 +551,24 @@ class Accelerator:
         # fp8 mixed precision: swap eligible model matmuls to the int8 QAT path
         # (reference routes fp8 through TE/AO module conversion at prepare time,
         # accelerator.py:1802-1830 there; see Fp8RecipeKwargs for the TPU story).
-        if self.state.mixed_precision == "fp8" and self.fp8_backend == "INT8":
-            model_cfg = getattr(module, "config", None)
-            if model_cfg is not None and getattr(model_cfg, "matmul_precision", None) == "default":
-                import dataclasses as _dc
+        # Config-driven compute routing. replace() (not mutation) gives the
+        # module its own config copy: a config shared with other models (or
+        # serialized later) must not silently change precision or attention.
+        import dataclasses as _dc
 
-                # Give the module its own config copy: a config shared with other
-                # models (or serialized later) must not silently turn int8.
-                module.config = _dc.replace(model_cfg, matmul_precision="int8")
+        model_cfg = getattr(module, "config", None)
+        if self.fp8_backend == "INT8":
+            if model_cfg is not None and getattr(model_cfg, "matmul_precision", None) == "default":
+                model_cfg = _dc.replace(model_cfg, matmul_precision="int8")
+        # Sequence parallelism: with an sp axis in the mesh, route the model's
+        # attention through the sequence-parallel op — ppermute ring (default)
+        # or Ulysses all-to-all (SequenceParallelPlugin(ring_attention=False)).
+        if self.mesh.shape.get("sp", 1) > 1:
+            if model_cfg is not None and getattr(model_cfg, "attention_impl", None) == "auto":
+                ring = self.sp_plugin.ring_attention if self.sp_plugin is not None else True
+                model_cfg = _dc.replace(model_cfg, attention_impl="ring" if ring else "ulysses")
+        if model_cfg is not None and model_cfg is not getattr(module, "config", None):
+            module.config = model_cfg
         min_shard = self.fsdp_plugin.min_shard_size if self.fsdp_plugin is not None else 2**14
         shardings = plan_param_shardings(params, self.mesh, rules=rules, min_shard_size=min_shard)
         params = apply_shardings(params, shardings)

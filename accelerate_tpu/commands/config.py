@@ -69,7 +69,7 @@ def get_user_input() -> ClusterConfig:
     pp = _ask("  pipeline-parallel (pp) size", 1, int)
     sp = _ask("  sequence-parallel (sp) size", 1, int)
     mixed_precision = _ask(
-        "Do you wish to use mixed precision? (no/bf16/fp16)", "bf16", str, ["no", "bf16", "fp16"]
+        "Do you wish to use mixed precision? (no/bf16/fp16/fp8)", "bf16", str, ["no", "bf16", "fp16", "fp8"]
     )
     return ClusterConfig(
         compute_environment=compute_env,

@@ -67,7 +67,7 @@ class ClusterConfig:
     num_processes: int = 1  # processes per launch on this machine (CPU sim) or total hosts
     main_process_ip: str | None = None
     main_process_port: int | None = None
-    mixed_precision: str = "no"  # no | bf16 | fp16
+    mixed_precision: str = "no"  # no | bf16 | fp16 | fp8
     use_cpu: bool = False
     debug: bool = False
     # Mesh axis sizes; 0/1 = unused axis. The launcher exports these as

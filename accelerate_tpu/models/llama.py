@@ -51,7 +51,7 @@ class LlamaConfig:
     tie_word_embeddings: bool = False
     remat: bool = False
     remat_policy: str = "nothing_saveable"  # any jax.checkpoint_policies name
-    attention_impl: str = "auto"  # 'auto' | 'dense' | 'flash' | 'ring'
+    attention_impl: str = "auto"  # 'auto' | 'dense' | 'flash' | 'ring' | 'ulysses'
     matmul_precision: str = "default"  # 'default' | 'int8' (QAT w/ STE bwd, ops/int8.py)
 
     @property

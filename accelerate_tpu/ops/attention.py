@@ -156,6 +156,10 @@ def attention(q, k, v, *, causal=True, mask=None, impl: str = "auto", mesh=None)
         from ..parallel.ring import ring_attention
 
         return ring_attention(q, k, v, causal=causal, mask=mask, mesh=mesh)
+    if impl == "ulysses":
+        from ..parallel.ulysses import ulysses_attention
+
+        return ulysses_attention(q, k, v, causal=causal, mask=mask, mesh=mesh)
     return dense_attention(q, k, v, causal=causal, mask=mask)
 
 

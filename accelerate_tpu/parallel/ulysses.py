@@ -1,0 +1,93 @@
+"""Ulysses-style all-to-all sequence parallelism over the mesh ``sp`` axis.
+
+The second canonical long-context strategy next to ring attention
+(``parallel/ring.py``): instead of rotating KV blocks around a ring, two
+``all_to_all`` collectives re-shard the activations for the attention op —
+
+- inbound: (B, S/sp, H, D) sequence-sharded → (B, S, H/sp, D) head-sharded.
+  Every device then holds the FULL sequence for its slice of heads, so
+  attention is computed exactly (any mask/causal structure, no streaming
+  softmax) by the ordinary dense/flash kernel;
+- outbound: the mirror all_to_all restores sequence sharding for the
+  position-wise rest of the layer (MLP/norms run on S/sp rows).
+
+Trade-off vs ring (the DeepSpeed-Ulysses analysis): all-to-all moves
+O(B·S·H·D/sp) per device regardless of sp and needs ``H % sp == 0``, but
+attention itself stays a single fused kernel over the full sequence — better
+at moderate sp and plentiful heads; ring wins when sp exceeds the head count
+or at extreme S where even one full-sequence score row is too big. The
+reference has NO native implementation of either (SURVEY.md §2.4: SP exists
+only as a Megatron passthrough flag).
+
+Selection: ``SequenceParallelPlugin(ring_attention=False)`` or
+``attention_impl="ulysses"`` on a model config.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+def ulysses_attention(q, k, v, *, causal=True, mask=None, mesh=None, axis_name: str = "sp"):
+    """Sequence-parallel exact attention via head↔sequence all-to-all.
+
+    q/k/v: (B, S, H, D) global arrays with S sharded on ``axis_name``; heads may
+    simultaneously be sharded on ``tp``. Requires the per-device head count to
+    divide by the ``sp`` degree."""
+    from ..ops.attention import dense_attention
+
+    if mesh is None:
+        from ..state import PartialState
+
+        mesh = PartialState().mesh
+    sp = mesh.shape.get(axis_name, 1)
+    if sp == 1:
+        return dense_attention(q, k, v, causal=causal, mask=mask)
+
+    tp = mesh.shape.get("tp", 1)
+    B, S, H, D = q.shape
+    if (H // tp if H % tp == 0 else H) % sp != 0:
+        raise ValueError(
+            f"Ulysses needs heads divisible by sp: {H} heads / tp={tp} across sp={sp}. "
+            "Use ring attention (SequenceParallelPlugin(ring_attention=True)) instead."
+        )
+
+    n_batch = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+    batch_axes = ("dp", "fsdp") if B % n_batch == 0 else None
+    head_axis = "tp" if H % tp == 0 and tp > 1 else None
+    qkv_spec = P(batch_axes, axis_name, head_axis, None)
+    mask_spec = P(batch_axes, axis_name)
+
+    def local(q, k, v, mask):
+        # Inbound: scatter heads (axis 2), gather sequence (axis 1).
+        q, k, v = (
+            lax.all_to_all(t, axis_name, split_axis=2, concat_axis=1, tiled=True)
+            for t in (q, k, v)
+        )
+        if mask is not None:
+            mask = lax.all_gather(mask, axis_name, axis=1, tiled=True)
+        out = dense_attention(q, k, v, causal=causal, mask=mask)
+        # Outbound: scatter sequence back, gather heads.
+        return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+    from jax import shard_map
+
+    if mask is None:
+        fn = shard_map(
+            lambda q, k, v: local(q, k, v, None),
+            mesh=mesh,
+            in_specs=(qkv_spec, qkv_spec, qkv_spec),
+            out_specs=qkv_spec,
+            check_vma=False,
+        )
+        return fn(q, k, v)
+    fn = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
+        out_specs=qkv_spec,
+        check_vma=False,
+    )
+    return fn(q, k, v, mask)

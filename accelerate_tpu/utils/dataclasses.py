@@ -158,11 +158,16 @@ class PipelineParallelPlugin(KwargsHandler):
 
 @dataclass
 class SequenceParallelPlugin(KwargsHandler):
-    """Sequence/context parallelism over the ``sp`` axis — ring attention. The
-    reference has NO native implementation (SURVEY.md §2.4): this exceeds parity."""
+    """Sequence/context parallelism over the ``sp`` axis. The reference has NO
+    native implementation (SURVEY.md §2.4): this exceeds parity.
+
+    ``ring_attention=True`` → ppermute ring with streaming softmax
+    (``parallel/ring.py``; scales past the head count, O(S/sp) memory);
+    ``False`` → Ulysses-style head↔sequence all-to-all (``parallel/ulysses.py``;
+    exact single-kernel attention, needs heads divisible by sp)."""
 
     sp_size: int = 1
-    ring_attention: bool = True  # ppermute ring; False = all-gather KV
+    ring_attention: bool = True
 
 
 @dataclass
