@@ -1,0 +1,121 @@
+"""Environment utilities, RNG sync, import probes, and multi-process logging.
+
+Reference models: ``tests/test_utils.py`` (patch_environment/clear_environment),
+``tests/test_logging.py``, ``tests/test_imports.py``.
+"""
+
+import logging
+import os
+
+import numpy as np
+import pytest
+
+from accelerate_tpu.logging import get_logger
+from accelerate_tpu.utils.environment import (
+    clear_environment,
+    get_int_from_env,
+    parse_choice_from_env,
+    parse_flag_from_env,
+    patch_environment,
+    purge_accelerate_environment,
+    str_to_bool,
+)
+from accelerate_tpu.utils.random import set_seed, synchronize_rng_states
+
+
+def test_str_to_bool():
+    for truthy in ("yes", "TRUE", "1", "t", "y", "on"):
+        assert str_to_bool(truthy) == 1, truthy
+    for falsy in ("no", "False", "0", "f", "n", "off"):
+        assert str_to_bool(falsy) == 0, falsy
+    with pytest.raises(ValueError):
+        str_to_bool("maybe")
+
+
+def test_parse_flag_and_choice_and_int(monkeypatch):
+    monkeypatch.setenv("AT_TEST_FLAG", "true")
+    assert parse_flag_from_env("AT_TEST_FLAG") is True
+    assert parse_flag_from_env("AT_TEST_MISSING", default=True) is True
+    monkeypatch.setenv("AT_TEST_CHOICE", "bf16")
+    assert parse_choice_from_env("AT_TEST_CHOICE") == "bf16"
+    monkeypatch.setenv("AT_TEST_INT", "7")
+    assert get_int_from_env(["AT_TEST_NOPE", "AT_TEST_INT"], 3) == 7
+    assert get_int_from_env(["AT_TEST_NOPE"], 3) == 3
+
+
+def test_patch_environment_restores():
+    """Reference ``patch_environment`` (utils/environment.py:326): values set
+    inside, restored after — including previously-present keys."""
+    os.environ["AT_KEEP"] = "orig"
+    with patch_environment(AT_KEEP="patched", AT_NEW="fresh"):
+        assert os.environ["AT_KEEP"] == "patched"
+        assert os.environ["AT_NEW"] == "fresh"
+    assert os.environ["AT_KEEP"] == "orig"
+    assert "AT_NEW" not in os.environ
+    del os.environ["AT_KEEP"]
+
+
+def test_clear_environment_restores():
+    os.environ["AT_CLEARME"] = "x"
+    with clear_environment():
+        assert "AT_CLEARME" not in os.environ
+        os.environ["AT_INSIDE"] = "y"
+    assert os.environ["AT_CLEARME"] == "x"
+    assert "AT_INSIDE" not in os.environ
+    del os.environ["AT_CLEARME"]
+
+
+def test_purge_accelerate_environment():
+    os.environ["ACCELERATE_AT_TEST_PURGE"] = "1"
+
+    @purge_accelerate_environment
+    def inner():
+        return "ACCELERATE_AT_TEST_PURGE" in os.environ
+
+    assert inner() is False
+    assert os.environ.pop("ACCELERATE_AT_TEST_PURGE") == "1"
+
+
+def test_set_seed_reproducible():
+    set_seed(123)
+    a = np.random.random(4)
+    set_seed(123)
+    b = np.random.random(4)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_synchronize_rng_states_single_process():
+    set_seed(7)
+    synchronize_rng_states(["numpy", "python"])  # no-op at world=1, must not raise
+
+
+def test_import_probes_match_reality():
+    from accelerate_tpu.utils import imports
+
+    assert imports.is_jax_available()
+    assert imports.is_optax_available()
+    assert imports.is_torch_available()
+    assert imports.is_safetensors_available()
+    assert isinstance(imports.is_tpu_available(check_device=False), bool)
+
+
+def test_get_logger_warns_once_per_process(caplog):
+    logger = get_logger("at_test_logger")
+    with caplog.at_level(logging.INFO, logger="at_test_logger"):
+        logger.info("hello", main_process_only=True)
+    assert any("hello" in r.message for r in caplog.records)
+
+
+def test_get_logger_respects_level():
+    logger = get_logger("at_test_logger_lvl", log_level="ERROR")
+    assert logger.logger.level == logging.ERROR
+    assert not logger.isEnabledFor(logging.INFO)
+    assert logger.isEnabledFor(logging.ERROR)
+
+
+def test_logger_in_order_kwarg(caplog):
+    """in_order=True serializes by rank; at world=1 it must simply log."""
+    logger = get_logger("at_test_logger_order")
+    with caplog.at_level(logging.INFO, logger="at_test_logger_order"):
+        logger.info("ordered", in_order=True)
+    assert any("ordered" in r.message for r in caplog.records)
