@@ -1,0 +1,228 @@
+"""HF/torch checkpoint conversion into the TPU-native model zoo.
+
+The reference never defines model architectures — users bring transformers
+``nn.Module``s and their checkpoints. For a reference user switching here, the
+weights are the moat: this module maps HuggingFace state dicts (torch tensors,
+numpy arrays, or safetensors files) onto the zoo's stacked-layer param pytrees
+so existing Llama/GPT-2 checkpoints run on the TPU engine unchanged.
+
+Layout differences handled:
+- torch ``nn.Linear`` stores (out, in); zoo matmuls are ``x @ W`` with
+  (in, out) → transpose. GPT-2's ``Conv1D`` already stores (in, out) → direct.
+- per-layer tensors are stacked into one leading-``L``-dim array (the scan
+  layout; one XLA program per block instead of L inlined copies).
+- RoPE: HF-Llama's rotate_half and the zoo's split-halves convention are the
+  same math — verified by the logits-parity tests (tests/test_convert.py).
+
+Entry points::
+
+    model, params = from_hf(hf_model)            # a transformers PreTrainedModel
+    params = llama_params_from_hf(sd, config)    # raw state dict → pytree
+    cfg = llama_config_from_hf(hf_config)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .gpt2 import GPT2, GPT2Config
+from .llama import Llama, LlamaConfig
+
+
+def _to_numpy(t) -> np.ndarray:
+    if hasattr(t, "detach"):  # torch tensor
+        return t.detach().cpu().float().numpy()
+    return np.asarray(t)
+
+
+def _normalize_keys(state_dict) -> dict:
+    """Strip the wrapper prefix transformers adds (``model.`` for Llama,
+    ``transformer.`` for GPT-2) so bare-backbone and LMHead checkpoints both map."""
+    out = {}
+    for k, v in state_dict.items():
+        for prefix in ("model.", "transformer."):
+            if k.startswith(prefix):
+                k = k[len(prefix):]
+                break
+        out[k] = v
+    return out
+
+
+def _stack(sd, pattern: str, num_layers: int, transpose: bool = False) -> jnp.ndarray:
+    mats = []
+    for i in range(num_layers):
+        m = _to_numpy(sd[pattern.format(i=i)])
+        mats.append(m.T if transpose else m)
+    return jnp.asarray(np.stack(mats))
+
+
+def _getter(hf_config):
+    """Uniform field access for transformers config objects and plain dicts."""
+    if isinstance(hf_config, dict):
+        return lambda k, d=None: hf_config.get(k, d)
+    return lambda k, d=None: getattr(hf_config, k, d)
+
+
+def _get_converter(model_type):
+    if model_type not in _CONVERTERS:
+        raise ValueError(
+            f"No converter for model_type={model_type!r}; supported: {sorted(_CONVERTERS)}"
+        )
+    return _CONVERTERS[model_type]
+
+
+# --------------------------------------------------------------------- llama
+def llama_config_from_hf(hf_config) -> LlamaConfig:
+    """Map a ``transformers.LlamaConfig`` (attributes or dict) onto the zoo config.
+
+    Raises on config features the zoo model does not implement (rope scaling,
+    attention/mlp biases, decoupled head_dim) — silently dropping them would
+    convert cleanly and then generate garbage at depth/length."""
+    get = _getter(hf_config)
+    if get("rope_scaling"):
+        raise ValueError(
+            f"rope_scaling={get('rope_scaling')!r} is not supported by the zoo Llama "
+            "(plain RoPE only); converting would silently mis-position long contexts."
+        )
+    if get("attention_bias") or get("mlp_bias"):
+        raise ValueError("attention_bias/mlp_bias checkpoints are not supported (zoo Llama is bias-free)")
+    explicit_hd = get("head_dim")
+    if explicit_hd and explicit_hd != get("hidden_size") // get("num_attention_heads"):
+        raise ValueError(
+            f"decoupled head_dim={explicit_hd} != hidden/heads is not supported by the zoo Llama"
+        )
+    return LlamaConfig(
+        vocab_size=get("vocab_size"),
+        hidden_size=get("hidden_size"),
+        intermediate_size=get("intermediate_size"),
+        num_hidden_layers=get("num_hidden_layers"),
+        num_attention_heads=get("num_attention_heads"),
+        num_key_value_heads=get("num_key_value_heads") or get("num_attention_heads"),
+        max_position_embeddings=get("max_position_embeddings", 2048),
+        rms_norm_eps=get("rms_norm_eps", 1e-5),
+        rope_theta=get("rope_theta", 10000.0),
+        tie_word_embeddings=bool(get("tie_word_embeddings", False)),
+    )
+
+
+def llama_params_from_hf(state_dict, config: LlamaConfig, dtype=jnp.float32) -> dict:
+    sd = _normalize_keys(state_dict)
+    L = config.num_hidden_layers
+    params = {
+        "embed": {"weight": jnp.asarray(_to_numpy(sd["embed_tokens.weight"]))},
+        "layers": {
+            "attn": {
+                "wq": _stack(sd, "layers.{i}.self_attn.q_proj.weight", L, transpose=True),
+                "wk": _stack(sd, "layers.{i}.self_attn.k_proj.weight", L, transpose=True),
+                "wv": _stack(sd, "layers.{i}.self_attn.v_proj.weight", L, transpose=True),
+                "wo": _stack(sd, "layers.{i}.self_attn.o_proj.weight", L, transpose=True),
+            },
+            "mlp": {
+                "w_gate": _stack(sd, "layers.{i}.mlp.gate_proj.weight", L, transpose=True),
+                "w_up": _stack(sd, "layers.{i}.mlp.up_proj.weight", L, transpose=True),
+                "w_down": _stack(sd, "layers.{i}.mlp.down_proj.weight", L, transpose=True),
+            },
+            "input_norm": {"weight": _stack(sd, "layers.{i}.input_layernorm.weight", L)},
+            "post_attn_norm": {
+                "weight": _stack(sd, "layers.{i}.post_attention_layernorm.weight", L)
+            },
+        },
+        "final_norm": {"weight": jnp.asarray(_to_numpy(sd["norm.weight"]))},
+    }
+    if not config.tie_word_embeddings:
+        head = sd.get("lm_head.weight")
+        if head is None:  # backbone-only checkpoint: fall back to tying
+            head = sd["embed_tokens.weight"]
+        params["lm_head"] = {"weight": jnp.asarray(_to_numpy(head).T)}
+    return jax.tree_util.tree_map(lambda x: x.astype(dtype), params) if dtype else params
+
+
+# ---------------------------------------------------------------------- gpt2
+def gpt2_config_from_hf(hf_config) -> GPT2Config:
+    get = _getter(hf_config)
+    n_embd = get("n_embd") or get("hidden_size")
+    return GPT2Config(
+        vocab_size=get("vocab_size"),
+        hidden_size=n_embd,
+        intermediate_size=get("n_inner") or 4 * n_embd,
+        num_hidden_layers=get("n_layer") or get("num_hidden_layers"),
+        num_attention_heads=get("n_head") or get("num_attention_heads"),
+        max_position_embeddings=get("n_positions") or get("max_position_embeddings", 1024),
+        layer_norm_eps=get("layer_norm_epsilon", 1e-5),
+    )
+
+
+def gpt2_params_from_hf(state_dict, config: GPT2Config, dtype=jnp.float32) -> dict:
+    sd = _normalize_keys(state_dict)
+    L = config.num_hidden_layers
+
+    def ln(i_pattern):
+        return {
+            "scale": _stack(sd, f"h.{{i}}.{i_pattern}.weight", L),
+            "bias": _stack(sd, f"h.{{i}}.{i_pattern}.bias", L),
+        }
+
+    params = {
+        "embed": {
+            "wte": jnp.asarray(_to_numpy(sd["wte.weight"])),
+            "wpe": jnp.asarray(_to_numpy(sd["wpe.weight"])),
+        },
+        "layers": {
+            # transformers GPT-2 uses Conv1D: weights already (in, out).
+            "attn": {
+                "w_qkv": _stack(sd, "h.{i}.attn.c_attn.weight", L),
+                "b_qkv": _stack(sd, "h.{i}.attn.c_attn.bias", L),
+                "wo": _stack(sd, "h.{i}.attn.c_proj.weight", L),
+                "bo": _stack(sd, "h.{i}.attn.c_proj.bias", L),
+            },
+            "mlp": {
+                "w_in": _stack(sd, "h.{i}.mlp.c_fc.weight", L),
+                "b_in": _stack(sd, "h.{i}.mlp.c_fc.bias", L),
+                "w_out": _stack(sd, "h.{i}.mlp.c_proj.weight", L),
+                "b_out": _stack(sd, "h.{i}.mlp.c_proj.bias", L),
+            },
+            "ln_1": ln("ln_1"),
+            "ln_2": ln("ln_2"),
+        },
+        "ln_f": {
+            "scale": jnp.asarray(_to_numpy(sd["ln_f.weight"])),
+            "bias": jnp.asarray(_to_numpy(sd["ln_f.bias"])),
+        },
+    }
+    return jax.tree_util.tree_map(lambda x: x.astype(dtype), params) if dtype else params
+
+
+# ----------------------------------------------------------------- dispatcher
+_CONVERTERS = {
+    "llama": (Llama, llama_config_from_hf, llama_params_from_hf),
+    "gpt2": (GPT2, gpt2_config_from_hf, gpt2_params_from_hf),
+}
+
+
+def from_hf(hf_model, dtype=jnp.float32):
+    """Convert a live ``transformers`` model: returns ``(zoo_model, params)``
+    with ``model.params`` already set, ready for ``Accelerator.prepare``."""
+    cls, config_fn, params_fn = _get_converter(getattr(hf_model.config, "model_type", None))
+    config = config_fn(hf_model.config)
+    model = cls(config)
+    model.params = params_fn(hf_model.state_dict(), config, dtype=dtype)
+    return model, model.params
+
+
+def from_hf_checkpoint(model_type: str, checkpoint: str, hf_config, dtype=jnp.float32):
+    """Convert from safetensors file(s) on disk without instantiating torch
+    (uses ``utils/modeling.load_state_dict``; ``checkpoint`` is a file or a
+    directory with an index)."""
+    from ..utils.modeling import _resolve_checkpoint_files, load_state_dict
+
+    cls, config_fn, params_fn = _get_converter(model_type)
+    sd = {}
+    for f in _resolve_checkpoint_files(checkpoint):
+        sd.update(load_state_dict(f))
+    config = config_fn(hf_config)
+    model = cls(config)
+    model.params = params_fn(sd, config, dtype=dtype)
+    return model, model.params

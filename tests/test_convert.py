@@ -1,0 +1,181 @@
+"""HF→zoo checkpoint conversion: exact logits parity against transformers.
+
+The strongest possible correctness test for the model zoo — the converted
+weights must produce (near-)identical logits to the original torch model, which
+simultaneously pins our RoPE, GQA-repeat, rms-norm, attention-scale, and
+gelu conventions to HF's.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+def _logits_close(ours, theirs, atol):
+    ours = np.asarray(ours, np.float32)
+    theirs = theirs.detach().float().numpy()
+    np.testing.assert_allclose(ours, theirs, atol=atol, rtol=1e-3)
+
+
+@pytest.fixture(scope="module")
+def hf_llama():
+    cfg = transformers.LlamaConfig(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=64,
+        rms_norm_eps=1e-5,
+        rope_theta=10000.0,
+        tie_word_embeddings=False,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    return transformers.LlamaForCausalLM(cfg).eval()
+
+
+@pytest.fixture(scope="module")
+def hf_gpt2():
+    cfg = transformers.GPT2Config(
+        vocab_size=128,
+        n_embd=64,
+        n_layer=2,
+        n_head=4,
+        n_positions=64,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(1)
+    return transformers.GPT2LMHeadModel(cfg).eval()
+
+
+def test_llama_logits_match_hf(hf_llama):
+    from accelerate_tpu.models.convert import from_hf
+
+    model, params = from_hf(hf_llama)
+    ids = np.random.default_rng(0).integers(0, 128, (2, 16)).astype(np.int32)
+    ours = model.apply(params, input_ids=ids)["logits"]
+    with torch.no_grad():
+        theirs = hf_llama(torch.tensor(ids, dtype=torch.long)).logits
+    _logits_close(ours, theirs, atol=2e-4)
+
+
+def test_llama_gqa_conversion_is_exact(hf_llama):
+    """The fixture uses num_key_value_heads < num_attention_heads, so logit
+    parity already proves our consecutive KV-repeat matches HF repeat_kv."""
+    assert hf_llama.config.num_key_value_heads < hf_llama.config.num_attention_heads
+
+
+def test_llama_masked_logits_match_hf(hf_llama):
+    from accelerate_tpu.models.convert import from_hf
+
+    model, params = from_hf(hf_llama)
+    ids = np.random.default_rng(1).integers(0, 128, (2, 12)).astype(np.int32)
+    mask = np.ones((2, 12), np.int32)
+    mask[0, 8:] = 0
+    ours = model.apply(params, input_ids=ids, attention_mask=mask)["logits"]
+    with torch.no_grad():
+        theirs = hf_llama(
+            torch.tensor(ids, dtype=torch.long), attention_mask=torch.tensor(mask)
+        ).logits
+    _logits_close(np.asarray(ours)[0, :8], theirs[0, :8], atol=2e-4)
+    _logits_close(np.asarray(ours)[1], theirs[1], atol=2e-4)
+
+
+def test_gpt2_logits_match_hf(hf_gpt2):
+    from accelerate_tpu.models.convert import from_hf
+
+    model, params = from_hf(hf_gpt2)
+    ids = np.random.default_rng(2).integers(0, 128, (2, 16)).astype(np.int32)
+    ours = model.apply(params, input_ids=ids)["logits"]
+    with torch.no_grad():
+        theirs = hf_gpt2(torch.tensor(ids, dtype=torch.long)).logits
+    _logits_close(ours, theirs, atol=2e-4)
+
+
+def test_converted_model_generates(hf_llama):
+    """Converted weights drive the whole decode stack: greedy generate() must
+    match HF greedy generation token-for-token."""
+    from accelerate_tpu.generation import generate
+    from accelerate_tpu.models.convert import from_hf
+
+    model, params = from_hf(hf_llama)
+    prompt = np.random.default_rng(3).integers(0, 128, (1, 8)).astype(np.int32)
+    import jax.numpy as jnp
+
+    ours = generate(
+        model, prompt, max_new_tokens=8, temperature=0.0, cache_dtype=jnp.float32
+    )
+    with torch.no_grad():
+        theirs = hf_llama.generate(
+            torch.tensor(prompt, dtype=torch.long),
+            max_new_tokens=8,
+            eos_token_id=None,  # disable early stop so lengths always match
+            do_sample=False,
+            use_cache=True,
+            pad_token_id=0,
+        )
+    np.testing.assert_array_equal(np.asarray(ours)[0], theirs[0].numpy())
+
+
+def test_converted_model_trains(hf_gpt2):
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.models.convert import from_hf
+
+    acc = Accelerator()
+    model, params = from_hf(hf_gpt2)
+    pmodel, popt = acc.prepare(model, optax.adam(1e-3))
+    ids = np.random.default_rng(4).integers(0, 128, (8, 16)).astype(np.int32)
+    step = acc.build_train_step(pmodel, popt)
+    losses = [float(step({"input_ids": ids, "labels": ids})) for _ in range(5)]
+    assert losses[-1] < losses[0]
+
+
+def test_from_hf_rejects_unknown_arch():
+    from accelerate_tpu.models.convert import from_hf
+
+    class FakeModel:
+        class config:
+            model_type = "mamba"
+
+    with pytest.raises(ValueError, match="No converter"):
+        from_hf(FakeModel())
+
+
+def test_from_hf_checkpoint_safetensors(tmp_path, hf_llama):
+    """Disk path: HF-style safetensors shards load without torch in the loop."""
+    import safetensors.numpy
+
+    from accelerate_tpu.models.convert import from_hf_checkpoint
+
+    sd = {k: v.detach().float().numpy() for k, v in hf_llama.state_dict().items()}
+    path = tmp_path / "model.safetensors"
+    safetensors.numpy.save_file(sd, str(path))
+    model, params = from_hf_checkpoint("llama", str(path), hf_llama.config)
+    ids = np.random.default_rng(5).integers(0, 128, (1, 8)).astype(np.int32)
+    ours = model.apply(params, input_ids=ids)["logits"]
+    with torch.no_grad():
+        theirs = hf_llama(torch.tensor(ids, dtype=torch.long)).logits
+    _logits_close(ours, theirs, atol=2e-4)
+
+
+def test_unsupported_llama_features_raise():
+    from accelerate_tpu.models.convert import llama_config_from_hf
+
+    base = dict(
+        vocab_size=128, hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2,
+    )
+    with pytest.raises(ValueError, match="rope_scaling"):
+        llama_config_from_hf({**base, "rope_scaling": {"rope_type": "llama3", "factor": 8.0}})
+    with pytest.raises(ValueError, match="bias"):
+        llama_config_from_hf({**base, "attention_bias": True})
+    with pytest.raises(ValueError, match="head_dim"):
+        llama_config_from_hf({**base, "head_dim": 32})

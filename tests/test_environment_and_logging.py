@@ -43,37 +43,35 @@ def test_parse_flag_and_choice_and_int(monkeypatch):
     assert get_int_from_env(["AT_TEST_NOPE"], 3) == 3
 
 
-def test_patch_environment_restores():
+def test_patch_environment_restores(monkeypatch):
     """Reference ``patch_environment`` (utils/environment.py:326): values set
     inside, restored after — including previously-present keys."""
-    os.environ["AT_KEEP"] = "orig"
+    monkeypatch.setenv("AT_KEEP", "orig")
     with patch_environment(AT_KEEP="patched", AT_NEW="fresh"):
         assert os.environ["AT_KEEP"] == "patched"
         assert os.environ["AT_NEW"] == "fresh"
     assert os.environ["AT_KEEP"] == "orig"
     assert "AT_NEW" not in os.environ
-    del os.environ["AT_KEEP"]
 
 
-def test_clear_environment_restores():
-    os.environ["AT_CLEARME"] = "x"
+def test_clear_environment_restores(monkeypatch):
+    monkeypatch.setenv("AT_CLEARME", "x")
     with clear_environment():
         assert "AT_CLEARME" not in os.environ
         os.environ["AT_INSIDE"] = "y"
     assert os.environ["AT_CLEARME"] == "x"
     assert "AT_INSIDE" not in os.environ
-    del os.environ["AT_CLEARME"]
 
 
-def test_purge_accelerate_environment():
-    os.environ["ACCELERATE_AT_TEST_PURGE"] = "1"
+def test_purge_accelerate_environment(monkeypatch):
+    monkeypatch.setenv("ACCELERATE_AT_TEST_PURGE", "1")
 
     @purge_accelerate_environment
     def inner():
         return "ACCELERATE_AT_TEST_PURGE" in os.environ
 
     assert inner() is False
-    assert os.environ.pop("ACCELERATE_AT_TEST_PURGE") == "1"
+    assert os.environ["ACCELERATE_AT_TEST_PURGE"] == "1"
 
 
 def test_set_seed_reproducible():
@@ -99,11 +97,22 @@ def test_import_probes_match_reality():
     assert isinstance(imports.is_tpu_available(check_device=False), bool)
 
 
-def test_get_logger_warns_once_per_process(caplog):
+def test_get_logger_emits_on_main_process(caplog):
     logger = get_logger("at_test_logger")
     with caplog.at_level(logging.INFO, logger="at_test_logger"):
         logger.info("hello", main_process_only=True)
     assert any("hello" in r.message for r in caplog.records)
+
+
+def test_warning_once_deduplicates(caplog):
+    logger = get_logger("at_test_logger_once")
+    with caplog.at_level(logging.WARNING, logger="at_test_logger_once"):
+        logger.warning_once("dup")
+        logger.warning_once("dup")
+        logger.warning_once("other")
+    dups = [r for r in caplog.records if r.message == "dup"]
+    assert len(dups) == 1
+    assert any(r.message == "other" for r in caplog.records)
 
 
 def test_get_logger_respects_level():
