@@ -28,14 +28,18 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .bert import BertConfig, BertForSequenceClassification
 from .gpt2 import GPT2, GPT2Config
 from .llama import Llama, LlamaConfig
 
 
-def _to_numpy(t) -> np.ndarray:
-    if hasattr(t, "detach"):  # torch tensor
-        return t.detach().cpu().float().numpy()
-    return np.asarray(t)
+def _to_numpy(t, dtype=None) -> np.ndarray:
+    if hasattr(t, "detach"):  # torch tensor (may be bf16: go through float32)
+        arr = t.detach().cpu().float().numpy()
+    else:
+        arr = np.asarray(t)
+    # Cast per-tensor so a large checkpoint never stages fully in fp32.
+    return arr.astype(dtype) if dtype is not None else arr
 
 
 def _normalize_keys(state_dict) -> dict:
@@ -43,7 +47,7 @@ def _normalize_keys(state_dict) -> dict:
     ``transformer.`` for GPT-2) so bare-backbone and LMHead checkpoints both map."""
     out = {}
     for k, v in state_dict.items():
-        for prefix in ("model.", "transformer."):
+        for prefix in ("model.", "transformer.", "bert."):
             if k.startswith(prefix):
                 k = k[len(prefix):]
                 break
@@ -51,10 +55,10 @@ def _normalize_keys(state_dict) -> dict:
     return out
 
 
-def _stack(sd, pattern: str, num_layers: int, transpose: bool = False) -> jnp.ndarray:
+def _stack(sd, pattern: str, num_layers: int, transpose: bool = False, dtype=None) -> jnp.ndarray:
     mats = []
     for i in range(num_layers):
-        m = _to_numpy(sd[pattern.format(i=i)])
+        m = _to_numpy(sd[pattern.format(i=i)], dtype)
         mats.append(m.T if transpose else m)
     return jnp.asarray(np.stack(mats))
 
@@ -112,37 +116,45 @@ def llama_params_from_hf(state_dict, config: LlamaConfig, dtype=jnp.float32) -> 
     sd = _normalize_keys(state_dict)
     L = config.num_hidden_layers
     params = {
-        "embed": {"weight": jnp.asarray(_to_numpy(sd["embed_tokens.weight"]))},
+        "embed": {"weight": jnp.asarray(_to_numpy(sd["embed_tokens.weight"], dtype))},
         "layers": {
             "attn": {
-                "wq": _stack(sd, "layers.{i}.self_attn.q_proj.weight", L, transpose=True),
-                "wk": _stack(sd, "layers.{i}.self_attn.k_proj.weight", L, transpose=True),
-                "wv": _stack(sd, "layers.{i}.self_attn.v_proj.weight", L, transpose=True),
-                "wo": _stack(sd, "layers.{i}.self_attn.o_proj.weight", L, transpose=True),
+                "wq": _stack(sd, "layers.{i}.self_attn.q_proj.weight", L, transpose=True, dtype=dtype),
+                "wk": _stack(sd, "layers.{i}.self_attn.k_proj.weight", L, transpose=True, dtype=dtype),
+                "wv": _stack(sd, "layers.{i}.self_attn.v_proj.weight", L, transpose=True, dtype=dtype),
+                "wo": _stack(sd, "layers.{i}.self_attn.o_proj.weight", L, transpose=True, dtype=dtype),
             },
             "mlp": {
-                "w_gate": _stack(sd, "layers.{i}.mlp.gate_proj.weight", L, transpose=True),
-                "w_up": _stack(sd, "layers.{i}.mlp.up_proj.weight", L, transpose=True),
-                "w_down": _stack(sd, "layers.{i}.mlp.down_proj.weight", L, transpose=True),
+                "w_gate": _stack(sd, "layers.{i}.mlp.gate_proj.weight", L, transpose=True, dtype=dtype),
+                "w_up": _stack(sd, "layers.{i}.mlp.up_proj.weight", L, transpose=True, dtype=dtype),
+                "w_down": _stack(sd, "layers.{i}.mlp.down_proj.weight", L, transpose=True, dtype=dtype),
             },
-            "input_norm": {"weight": _stack(sd, "layers.{i}.input_layernorm.weight", L)},
+            "input_norm": {"weight": _stack(sd, "layers.{i}.input_layernorm.weight", L, dtype=dtype)},
             "post_attn_norm": {
-                "weight": _stack(sd, "layers.{i}.post_attention_layernorm.weight", L)
+                "weight": _stack(sd, "layers.{i}.post_attention_layernorm.weight", L, dtype=dtype)
             },
         },
-        "final_norm": {"weight": jnp.asarray(_to_numpy(sd["norm.weight"]))},
+        "final_norm": {"weight": jnp.asarray(_to_numpy(sd["norm.weight"], dtype))},
     }
     if not config.tie_word_embeddings:
         head = sd.get("lm_head.weight")
         if head is None:  # backbone-only checkpoint: fall back to tying
             head = sd["embed_tokens.weight"]
-        params["lm_head"] = {"weight": jnp.asarray(_to_numpy(head).T)}
-    return jax.tree_util.tree_map(lambda x: x.astype(dtype), params) if dtype else params
+        params["lm_head"] = {"weight": jnp.asarray(_to_numpy(head, dtype).T)}
+    return params
 
 
 # ---------------------------------------------------------------------- gpt2
 def gpt2_config_from_hf(hf_config) -> GPT2Config:
     get = _getter(hf_config)
+    act = get("activation_function", "gelu_new")
+    if act not in ("gelu_new", "gelu_pytorch_tanh"):
+        raise ValueError(f"activation_function={act!r} is not supported (zoo GPT-2 uses tanh-gelu)")
+    if get("scale_attn_by_inverse_layer_idx") or get("reorder_and_upcast_attn"):
+        raise ValueError(
+            "scale_attn_by_inverse_layer_idx / reorder_and_upcast_attn checkpoints "
+            "are not supported (zoo GPT-2 uses uniform 1/sqrt(head_dim) scaling)"
+        )
     n_embd = get("n_embd") or get("hidden_size")
     return GPT2Config(
         vocab_size=get("vocab_size"),
@@ -161,44 +173,133 @@ def gpt2_params_from_hf(state_dict, config: GPT2Config, dtype=jnp.float32) -> di
 
     def ln(i_pattern):
         return {
-            "scale": _stack(sd, f"h.{{i}}.{i_pattern}.weight", L),
-            "bias": _stack(sd, f"h.{{i}}.{i_pattern}.bias", L),
+            "scale": _stack(sd, f"h.{{i}}.{i_pattern}.weight", L, dtype=dtype),
+            "bias": _stack(sd, f"h.{{i}}.{i_pattern}.bias", L, dtype=dtype),
         }
 
     params = {
         "embed": {
-            "wte": jnp.asarray(_to_numpy(sd["wte.weight"])),
-            "wpe": jnp.asarray(_to_numpy(sd["wpe.weight"])),
+            "wte": jnp.asarray(_to_numpy(sd["wte.weight"], dtype)),
+            "wpe": jnp.asarray(_to_numpy(sd["wpe.weight"], dtype)),
         },
         "layers": {
             # transformers GPT-2 uses Conv1D: weights already (in, out).
             "attn": {
-                "w_qkv": _stack(sd, "h.{i}.attn.c_attn.weight", L),
-                "b_qkv": _stack(sd, "h.{i}.attn.c_attn.bias", L),
-                "wo": _stack(sd, "h.{i}.attn.c_proj.weight", L),
-                "bo": _stack(sd, "h.{i}.attn.c_proj.bias", L),
+                "w_qkv": _stack(sd, "h.{i}.attn.c_attn.weight", L, dtype=dtype),
+                "b_qkv": _stack(sd, "h.{i}.attn.c_attn.bias", L, dtype=dtype),
+                "wo": _stack(sd, "h.{i}.attn.c_proj.weight", L, dtype=dtype),
+                "bo": _stack(sd, "h.{i}.attn.c_proj.bias", L, dtype=dtype),
             },
             "mlp": {
-                "w_in": _stack(sd, "h.{i}.mlp.c_fc.weight", L),
-                "b_in": _stack(sd, "h.{i}.mlp.c_fc.bias", L),
-                "w_out": _stack(sd, "h.{i}.mlp.c_proj.weight", L),
-                "b_out": _stack(sd, "h.{i}.mlp.c_proj.bias", L),
+                "w_in": _stack(sd, "h.{i}.mlp.c_fc.weight", L, dtype=dtype),
+                "b_in": _stack(sd, "h.{i}.mlp.c_fc.bias", L, dtype=dtype),
+                "w_out": _stack(sd, "h.{i}.mlp.c_proj.weight", L, dtype=dtype),
+                "b_out": _stack(sd, "h.{i}.mlp.c_proj.bias", L, dtype=dtype),
             },
             "ln_1": ln("ln_1"),
             "ln_2": ln("ln_2"),
         },
         "ln_f": {
-            "scale": jnp.asarray(_to_numpy(sd["ln_f.weight"])),
-            "bias": jnp.asarray(_to_numpy(sd["ln_f.bias"])),
+            "scale": jnp.asarray(_to_numpy(sd["ln_f.weight"], dtype)),
+            "bias": jnp.asarray(_to_numpy(sd["ln_f.bias"], dtype)),
         },
     }
-    return jax.tree_util.tree_map(lambda x: x.astype(dtype), params) if dtype else params
+    return params
+
+
+# ---------------------------------------------------------------------- bert
+def bert_config_from_hf(hf_config) -> BertConfig:
+    get = _getter(hf_config)
+    act = get("hidden_act", "gelu")
+    if act not in ("gelu", "gelu_python"):
+        raise ValueError(f"hidden_act={act!r} is not supported (zoo BERT uses exact gelu)")
+    pos_type = get("position_embedding_type", "absolute")
+    if pos_type != "absolute":
+        raise ValueError(
+            f"position_embedding_type={pos_type!r} is not supported (zoo BERT uses "
+            "absolute learned positions; relative distance_embedding weights would be dropped)"
+        )
+    return BertConfig(
+        vocab_size=get("vocab_size"),
+        hidden_size=get("hidden_size"),
+        intermediate_size=get("intermediate_size"),
+        num_hidden_layers=get("num_hidden_layers"),
+        num_attention_heads=get("num_attention_heads"),
+        max_position_embeddings=get("max_position_embeddings", 512),
+        type_vocab_size=get("type_vocab_size", 2),
+        layer_norm_eps=get("layer_norm_eps", 1e-12),
+        num_labels=get("num_labels", 2) or 2,
+        hidden_dropout_prob=get("hidden_dropout_prob", 0.1),
+    )
+
+
+def bert_params_from_hf(state_dict, config: BertConfig, dtype=jnp.float32) -> dict:
+    """BertForSequenceClassification layout; a backbone-only checkpoint gets a
+    fresh pooler/classifier (the standard fine-tuning setup)."""
+    sd = _normalize_keys(state_dict)
+    L = config.num_hidden_layers
+    h = config.hidden_size
+
+    def ln_pair(pattern):
+        return {
+            "scale": _stack(sd, f"{pattern}.weight", L, dtype=dtype),
+            "bias": _stack(sd, f"{pattern}.bias", L, dtype=dtype),
+        }
+
+    def head_linear(key_w, key_b, out_dim, transpose=True):
+        if key_w in sd:
+            w = _to_numpy(sd[key_w], dtype)
+            return {
+                "w": jnp.asarray(w.T if transpose else w),
+                "b": jnp.asarray(_to_numpy(sd[key_b], dtype)),
+            }
+        rng = np.random.default_rng(0)
+        return {
+            "w": jnp.asarray(rng.normal(scale=0.02, size=(h, out_dim)).astype(dtype or np.float32)),
+            "b": jnp.zeros((out_dim,), dtype or jnp.float32),
+        }
+
+    params = {
+        "embeddings": {
+            "word": jnp.asarray(_to_numpy(sd["embeddings.word_embeddings.weight"], dtype)),
+            "position": jnp.asarray(_to_numpy(sd["embeddings.position_embeddings.weight"], dtype)),
+            "token_type": jnp.asarray(_to_numpy(sd["embeddings.token_type_embeddings.weight"], dtype)),
+            "norm": {
+                "scale": jnp.asarray(_to_numpy(sd["embeddings.LayerNorm.weight"], dtype)),
+                "bias": jnp.asarray(_to_numpy(sd["embeddings.LayerNorm.bias"], dtype)),
+            },
+        },
+        "layers": {
+            "attn": {
+                "wq": _stack(sd, "encoder.layer.{i}.attention.self.query.weight", L, transpose=True, dtype=dtype),
+                "bq": _stack(sd, "encoder.layer.{i}.attention.self.query.bias", L, dtype=dtype),
+                "wk": _stack(sd, "encoder.layer.{i}.attention.self.key.weight", L, transpose=True, dtype=dtype),
+                "bk": _stack(sd, "encoder.layer.{i}.attention.self.key.bias", L, dtype=dtype),
+                "wv": _stack(sd, "encoder.layer.{i}.attention.self.value.weight", L, transpose=True, dtype=dtype),
+                "bv": _stack(sd, "encoder.layer.{i}.attention.self.value.bias", L, dtype=dtype),
+                "wo": _stack(sd, "encoder.layer.{i}.attention.output.dense.weight", L, transpose=True, dtype=dtype),
+                "bo": _stack(sd, "encoder.layer.{i}.attention.output.dense.bias", L, dtype=dtype),
+            },
+            "attn_norm": ln_pair("encoder.layer.{i}.attention.output.LayerNorm"),
+            "mlp": {
+                "w_in": _stack(sd, "encoder.layer.{i}.intermediate.dense.weight", L, transpose=True, dtype=dtype),
+                "b_in": _stack(sd, "encoder.layer.{i}.intermediate.dense.bias", L, dtype=dtype),
+                "w_out": _stack(sd, "encoder.layer.{i}.output.dense.weight", L, transpose=True, dtype=dtype),
+                "b_out": _stack(sd, "encoder.layer.{i}.output.dense.bias", L, dtype=dtype),
+            },
+            "mlp_norm": ln_pair("encoder.layer.{i}.output.LayerNorm"),
+        },
+        "pooler": head_linear("pooler.dense.weight", "pooler.dense.bias", h),
+        "classifier": head_linear("classifier.weight", "classifier.bias", config.num_labels),
+    }
+    return params
 
 
 # ----------------------------------------------------------------- dispatcher
 _CONVERTERS = {
     "llama": (Llama, llama_config_from_hf, llama_params_from_hf),
     "gpt2": (GPT2, gpt2_config_from_hf, gpt2_params_from_hf),
+    "bert": (BertForSequenceClassification, bert_config_from_hf, bert_params_from_hf),
 }
 
 

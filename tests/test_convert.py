@@ -179,3 +179,101 @@ def test_unsupported_llama_features_raise():
         llama_config_from_hf({**base, "attention_bias": True})
     with pytest.raises(ValueError, match="head_dim"):
         llama_config_from_hf({**base, "head_dim": 32})
+
+
+@pytest.fixture(scope="module")
+def hf_bert():
+    cfg = transformers.BertConfig(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        max_position_embeddings=64,
+        num_labels=3,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(2)
+    return transformers.BertForSequenceClassification(cfg).eval()
+
+
+def test_bert_logits_match_hf(hf_bert):
+    from accelerate_tpu.models.convert import from_hf
+
+    model, params = from_hf(hf_bert)
+    rng = np.random.default_rng(6)
+    ids = rng.integers(0, 128, (2, 16)).astype(np.int32)
+    types = rng.integers(0, 2, (2, 16)).astype(np.int32)
+    mask = np.ones((2, 16), np.int32)
+    mask[1, 10:] = 0
+    ours = model.apply(
+        params, input_ids=ids, attention_mask=mask, token_type_ids=types
+    )["logits"]
+    with torch.no_grad():
+        theirs = hf_bert(
+            torch.tensor(ids, dtype=torch.long),
+            attention_mask=torch.tensor(mask),
+            token_type_ids=torch.tensor(types, dtype=torch.long),
+        ).logits
+    _logits_close(ours, theirs, atol=2e-4)
+
+
+def test_bert_backbone_checkpoint_gets_fresh_head(hf_bert):
+    """A bare BertModel checkpoint (no classifier) converts with a freshly
+    initialized pooler/classifier — the standard fine-tuning entry."""
+    from accelerate_tpu.models.convert import bert_config_from_hf, bert_params_from_hf
+
+    sd = {k: v for k, v in hf_bert.state_dict().items() if not k.startswith("classifier")}
+    cfg = bert_config_from_hf(hf_bert.config)
+    params = bert_params_from_hf(sd, cfg)
+    assert params["classifier"]["w"].shape == (64, 3)
+
+
+def test_unsupported_gpt2_and_bert_features_raise():
+    from accelerate_tpu.models.convert import bert_config_from_hf, gpt2_config_from_hf
+
+    with pytest.raises(ValueError, match="activation_function"):
+        gpt2_config_from_hf({"vocab_size": 128, "n_embd": 64, "n_layer": 2, "n_head": 4,
+                             "activation_function": "relu"})
+    with pytest.raises(ValueError, match="scale_attn"):
+        gpt2_config_from_hf({"vocab_size": 128, "n_embd": 64, "n_layer": 2, "n_head": 4,
+                             "scale_attn_by_inverse_layer_idx": True})
+    with pytest.raises(ValueError, match="position_embedding_type"):
+        bert_config_from_hf({"vocab_size": 128, "hidden_size": 64, "intermediate_size": 128,
+                             "num_hidden_layers": 2, "num_attention_heads": 4,
+                             "position_embedding_type": "relative_key"})
+
+
+def test_convert_dtype_is_applied_per_leaf():
+    """dtype lands on every leaf without an fp32 staging tree."""
+    import jax.numpy as jnp
+
+    from accelerate_tpu.models.convert import gpt2_config_from_hf, gpt2_params_from_hf
+
+    cfg_dict = {"vocab_size": 32, "n_embd": 16, "n_layer": 1, "n_head": 2, "n_positions": 16}
+    cfg = gpt2_config_from_hf(cfg_dict)
+    rng = np.random.default_rng(0)
+    sd = {
+        "wte.weight": rng.normal(size=(32, 16)).astype(np.float32),
+        "wpe.weight": rng.normal(size=(16, 16)).astype(np.float32),
+        "ln_f.weight": np.ones(16, np.float32),
+        "ln_f.bias": np.zeros(16, np.float32),
+    }
+    for i in range(1):
+        sd.update({
+            f"h.{i}.ln_1.weight": np.ones(16, np.float32),
+            f"h.{i}.ln_1.bias": np.zeros(16, np.float32),
+            f"h.{i}.ln_2.weight": np.ones(16, np.float32),
+            f"h.{i}.ln_2.bias": np.zeros(16, np.float32),
+            f"h.{i}.attn.c_attn.weight": rng.normal(size=(16, 48)).astype(np.float32),
+            f"h.{i}.attn.c_attn.bias": np.zeros(48, np.float32),
+            f"h.{i}.attn.c_proj.weight": rng.normal(size=(16, 16)).astype(np.float32),
+            f"h.{i}.attn.c_proj.bias": np.zeros(16, np.float32),
+            f"h.{i}.mlp.c_fc.weight": rng.normal(size=(16, 64)).astype(np.float32),
+            f"h.{i}.mlp.c_fc.bias": np.zeros(64, np.float32),
+            f"h.{i}.mlp.c_proj.weight": rng.normal(size=(64, 16)).astype(np.float32),
+            f"h.{i}.mlp.c_proj.bias": np.zeros(16, np.float32),
+        })
+    params = gpt2_params_from_hf(sd, cfg, dtype=jnp.bfloat16)
+    for leaf in jax.tree_util.tree_leaves(params):
+        assert leaf.dtype == jnp.bfloat16, leaf.dtype
