@@ -25,12 +25,12 @@ from __future__ import annotations
 
 import numpy as np
 
-import jax
 import jax.numpy as jnp
 
 from .bert import BertConfig, BertForSequenceClassification
 from .gpt2 import GPT2, GPT2Config
 from .llama import Llama, LlamaConfig
+from .t5 import T5Config, T5ForConditionalGeneration
 
 
 def _to_numpy(t, dtype=None) -> np.ndarray:
@@ -150,6 +150,11 @@ def gpt2_config_from_hf(hf_config) -> GPT2Config:
     act = get("activation_function", "gelu_new")
     if act not in ("gelu_new", "gelu_pytorch_tanh"):
         raise ValueError(f"activation_function={act!r} is not supported (zoo GPT-2 uses tanh-gelu)")
+    if get("scale_attn_weights") is False:
+        raise ValueError(
+            "scale_attn_weights=False checkpoints are not supported "
+            "(zoo GPT-2 always scales by 1/sqrt(head_dim))"
+        )
     if get("scale_attn_by_inverse_layer_idx") or get("reorder_and_upcast_attn"):
         raise ValueError(
             "scale_attn_by_inverse_layer_idx / reorder_and_upcast_attn checkpoints "
@@ -246,6 +251,8 @@ def bert_params_from_hf(state_dict, config: BertConfig, dtype=jnp.float32) -> di
             "bias": _stack(sd, f"{pattern}.bias", L, dtype=dtype),
         }
 
+    fresh_head_rng = np.random.default_rng(0)
+
     def head_linear(key_w, key_b, out_dim, transpose=True):
         if key_w in sd:
             w = _to_numpy(sd[key_w], dtype)
@@ -253,7 +260,7 @@ def bert_params_from_hf(state_dict, config: BertConfig, dtype=jnp.float32) -> di
                 "w": jnp.asarray(w.T if transpose else w),
                 "b": jnp.asarray(_to_numpy(sd[key_b], dtype)),
             }
-        rng = np.random.default_rng(0)
+        rng = fresh_head_rng  # one stream: fresh pooler/classifier stay independent
         return {
             "w": jnp.asarray(rng.normal(scale=0.02, size=(h, out_dim)).astype(dtype or np.float32)),
             "b": jnp.zeros((out_dim,), dtype or jnp.float32),
@@ -295,11 +302,94 @@ def bert_params_from_hf(state_dict, config: BertConfig, dtype=jnp.float32) -> di
     return params
 
 
+# ------------------------------------------------------------------------ t5
+def t5_config_from_hf(hf_config) -> T5Config:
+    get = _getter(hf_config)
+    ff_proj = get("feed_forward_proj", "relu")
+    if ff_proj != "relu":
+        raise ValueError(
+            f"feed_forward_proj={ff_proj!r} is not supported (zoo T5 implements the "
+            "original ReLU recipe; t5-v1.1 gated-gelu checkpoints have wi_0/wi_1 "
+            "weights the zoo model has no slot for)"
+        )
+    if not get("tie_word_embeddings", True):
+        raise ValueError("untied-lm-head T5 is not supported (zoo T5 ties the scaled head)")
+    return T5Config(
+        vocab_size=get("vocab_size"),
+        d_model=get("d_model"),
+        d_kv=get("d_kv"),
+        d_ff=get("d_ff"),
+        num_layers=get("num_layers"),
+        num_decoder_layers=get("num_decoder_layers") or get("num_layers"),
+        num_heads=get("num_heads"),
+        relative_attention_num_buckets=get("relative_attention_num_buckets", 32),
+        relative_attention_max_distance=get("relative_attention_max_distance", 128),
+        layer_norm_epsilon=get("layer_norm_epsilon", 1e-6),
+        pad_token_id=get("pad_token_id", 0),
+        decoder_start_token_id=get("decoder_start_token_id", 0),
+    )
+
+
+def t5_params_from_hf(state_dict, config: T5Config, dtype=jnp.float32) -> dict:
+    """HF T5 blocks are layer.0=self-attn, layer.1=cross-attn (decoder) or MLP
+    (encoder), layer.2=MLP (decoder); the relative bias lives only in block 0."""
+    sd = dict(state_dict)  # T5 keys carry no strippable prefix
+
+    def attn(side, L, li, name):
+        base = f"{side}.block.{{i}}.layer.{li}.{name}"
+        return {
+            "wq": _stack(sd, f"{base}.q.weight", L, transpose=True, dtype=dtype),
+            "wk": _stack(sd, f"{base}.k.weight", L, transpose=True, dtype=dtype),
+            "wv": _stack(sd, f"{base}.v.weight", L, transpose=True, dtype=dtype),
+            "wo": _stack(sd, f"{base}.o.weight", L, transpose=True, dtype=dtype),
+        }
+
+    def norm(side, L, li):
+        return {
+            "scale": _stack(sd, f"{side}.block.{{i}}.layer.{li}.layer_norm.weight", L, dtype=dtype)
+        }
+
+    def mlp(side, L, li):
+        base = f"{side}.block.{{i}}.layer.{li}.DenseReluDense"
+        return {
+            "wi": _stack(sd, f"{base}.wi.weight", L, transpose=True, dtype=dtype),
+            "wo": _stack(sd, f"{base}.wo.weight", L, transpose=True, dtype=dtype),
+        }
+
+    def side_params(side, L, cross):
+        layers = {
+            "self_attn": attn(side, L, 0, "SelfAttention"),
+            "self_norm": norm(side, L, 0),
+        }
+        if cross:
+            layers["cross_attn"] = attn(side, L, 1, "EncDecAttention")
+            layers["cross_norm"] = norm(side, L, 1)
+        mlp_idx = 2 if cross else 1
+        layers["mlp"] = mlp(side, L, mlp_idx)
+        layers["mlp_norm"] = norm(side, L, mlp_idx)
+        return {
+            "layers": layers,
+            "rel_bias": jnp.asarray(_to_numpy(
+                sd[f"{side}.block.0.layer.0.SelfAttention.relative_attention_bias.weight"], dtype
+            )),
+            "final_norm": {
+                "scale": jnp.asarray(_to_numpy(sd[f"{side}.final_layer_norm.weight"], dtype))
+            },
+        }
+
+    return {
+        "shared": jnp.asarray(_to_numpy(sd["shared.weight"], dtype)),
+        "encoder": side_params("encoder", config.num_layers, cross=False),
+        "decoder": side_params("decoder", config.num_decoder_layers, cross=True),
+    }
+
+
 # ----------------------------------------------------------------- dispatcher
 _CONVERTERS = {
     "llama": (Llama, llama_config_from_hf, llama_params_from_hf),
     "gpt2": (GPT2, gpt2_config_from_hf, gpt2_params_from_hf),
     "bert": (BertForSequenceClassification, bert_config_from_hf, bert_params_from_hf),
+    "t5": (T5ForConditionalGeneration, t5_config_from_hf, t5_params_from_hf),
 }
 
 

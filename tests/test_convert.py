@@ -277,3 +277,55 @@ def test_convert_dtype_is_applied_per_leaf():
     params = gpt2_params_from_hf(sd, cfg, dtype=jnp.bfloat16)
     for leaf in jax.tree_util.tree_leaves(params):
         assert leaf.dtype == jnp.bfloat16, leaf.dtype
+
+
+@pytest.fixture(scope="module")
+def hf_t5():
+    cfg = transformers.T5Config(
+        vocab_size=128,
+        d_model=32,
+        d_kv=8,
+        d_ff=64,
+        num_layers=2,
+        num_decoder_layers=2,
+        num_heads=4,
+        relative_attention_num_buckets=8,
+        relative_attention_max_distance=16,
+        feed_forward_proj="relu",
+        tie_word_embeddings=True,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(4)
+    return transformers.T5ForConditionalGeneration(cfg).eval()
+
+
+def test_t5_logits_match_hf(hf_t5):
+    from accelerate_tpu.models.convert import from_hf
+
+    model, params = from_hf(hf_t5)
+    rng = np.random.default_rng(7)
+    # ids from 1: token 0 is T5's pad id, which the zoo masks automatically
+    # when no attention_mask is given while HF attends it — not a weight issue.
+    ids = rng.integers(1, 128, (2, 12)).astype(np.int32)
+    dec = rng.integers(1, 128, (2, 6)).astype(np.int32)
+    mask = np.ones((2, 12), np.int32)
+    mask[1, 9:] = 0
+    ours = model.apply(
+        params, input_ids=ids, attention_mask=mask, decoder_input_ids=dec
+    )["logits"]
+    with torch.no_grad():
+        theirs = hf_t5(
+            input_ids=torch.tensor(ids, dtype=torch.long),
+            attention_mask=torch.tensor(mask),
+            decoder_input_ids=torch.tensor(dec, dtype=torch.long),
+        ).logits
+    _logits_close(ours, theirs, atol=3e-4)
+
+
+def test_t5_gated_checkpoint_rejected():
+    from accelerate_tpu.models.convert import t5_config_from_hf
+
+    with pytest.raises(ValueError, match="feed_forward_proj"):
+        t5_config_from_hf({"vocab_size": 128, "d_model": 32, "d_kv": 8, "d_ff": 64,
+                           "num_layers": 2, "num_heads": 4,
+                           "feed_forward_proj": "gated-gelu"})
