@@ -99,7 +99,10 @@ def generate(
     ``attention_mask`` (1 = real) for ragged batches.
 
     Returns int32 ids of shape (B, prompt_len + max_new_tokens) when
-    ``include_prompt`` else (B, max_new_tokens).
+    ``include_prompt`` else (B, max_new_tokens). Encoder-decoder models (those
+    with an ``encode`` method, e.g. T5) always return (B, max_new_tokens): the
+    prompt is the encoder input and the decoder stream starts fresh from
+    ``decoder_start_token_id``, so there is no prompt to include.
     """
     from .big_modeling import StreamedScanModel
 
@@ -110,6 +113,23 @@ def generate(
     if rng is None:
         rng = jax.random.key(0)
     eos = -1 if eos_token_id is None else eos_token_id
+
+    module_probe, _ = _unwrap(model) if not isinstance(model, StreamedScanModel) else (model, None)
+    if hasattr(module_probe, "encode"):
+        # Encoder-decoder (T5-style): the "prompt" is the encoder input; decoding
+        # starts fresh from decoder_start_token_id, so the return is always
+        # (B, max_new_tokens) — see the docstring.
+        module, mparams = _unwrap(model)
+        if params is None:
+            params = mparams
+        if params is None:
+            raise ValueError("Model has no params; pass params= or init the model first.")
+        fn = _compiled_generate_encdec(module, max_new_tokens, temperature, top_k,
+                                       top_p, eos, pad_token_id, cache_dtype)
+        if attention_mask is None:
+            # Same inference encode() does for mask=None: pad tokens are not real.
+            attention_mask = (input_ids != module.config.pad_token_id).astype(jnp.int32)
+        return fn(params, input_ids, attention_mask, rng)
 
     if isinstance(model, StreamedScanModel):
         new_tokens = _generate_streamed(
@@ -166,6 +186,49 @@ def _compiled_generate(module, max_new_tokens, temperature, top_k, top_p,
             return (out["cache"], nxt, newly_finished, rng), nxt
 
         (cache, _, _, _), rest = jax.lax.scan(
+            step, (out["cache"], tok, finished, rng_loop), None, length=max_new_tokens - 1
+        )
+        return jnp.concatenate([tok[:, None], rest.T], axis=1)
+
+    fn = jax.jit(run)
+    cache_store[key] = fn
+    return fn
+
+
+def _compiled_generate_encdec(module, max_new_tokens, temperature, top_k, top_p,
+                              eos, pad_token_id, cache_dtype):
+    """Encoder once + cross-KV precompute + scan-decode, one jitted program
+    (cached per module/shape like the decoder-only path)."""
+    cache_store = module.__dict__.setdefault("_generate_fns", {})
+    key = ("encdec", max_new_tokens, temperature, top_k, top_p, eos, pad_token_id,
+           str(cache_dtype))
+    if key in cache_store:
+        return cache_store[key]
+
+    def run(params, input_ids, attention_mask, rng):
+        B = input_ids.shape[0]
+        enc_out, enc_mask = module.encode(params, input_ids, attention_mask)
+        cross_kv = module.precompute_cross_kv(params, enc_out)
+        cache = module.init_cache(B, max_new_tokens, dtype=cache_dtype)
+
+        start = jnp.full((B, 1), module.config.decoder_start_token_id, jnp.int32)
+        out = module.decode(params, start, cache, enc_out, enc_mask, cross_kv=cross_kv)
+        rng0, rng_loop = jax.random.split(rng)
+        tok = sample_logits(out["logits"][:, -1], rng0, temperature, top_k, top_p)
+        finished = tok == eos
+        tok = jnp.where(finished, pad_token_id, tok)
+
+        def step(carry, _):
+            cache, tok, finished, rng = carry
+            rng, sub = jax.random.split(rng)
+            out = module.decode(params, tok[:, None], cache, enc_out, enc_mask,
+                                cross_kv=cross_kv)
+            nxt = sample_logits(out["logits"][:, -1], sub, temperature, top_k, top_p)
+            newly = finished | (nxt == eos)
+            nxt = jnp.where(finished | (nxt == eos), pad_token_id, nxt)
+            return (out["cache"], nxt, newly, rng), nxt
+
+        (_, _, _, _), rest = jax.lax.scan(
             step, (out["cache"], tok, finished, rng_loop), None, length=max_new_tokens - 1
         )
         return jnp.concatenate([tok[:, None], rest.T], axis=1)

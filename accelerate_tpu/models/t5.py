@@ -223,14 +223,9 @@ class T5ForConditionalGeneration(Module):
         emb = params["shared"]
         compute_dtype = emb.dtype
 
-        if attention_mask is None:
-            attention_mask = (input_ids != cfg.pad_token_id).astype(jnp.int32)
+        # Encoder (shared with the generation path — one implementation).
+        enc_out, attention_mask = self.encode(params, input_ids, attention_mask)
         enc_pad = jnp.where(attention_mask[:, None, None, :].astype(bool), 0.0, -1e30).astype(jnp.float32)
-
-        # Encoder.
-        x = jnp.take(emb, input_ids, axis=0).astype(compute_dtype)
-        enc_bias = self._rel_bias(params["encoder"]["rel_bias"], S, S, bidirectional=True) + enc_pad
-        enc_out = self._run_stack(params["encoder"], x, None, enc_bias, None, cross=False)
 
         # Decoder: causal self-attn bias + cross-attn encoder padding bias.
         causal = jnp.where(
@@ -252,3 +247,115 @@ class T5ForConditionalGeneration(Module):
             masked = jnp.where(labels == cfg.pad_token_id, -100, labels)
             out["loss"] = cross_entropy_loss(logits, masked)
         return out
+
+    # ------------------------------------------------------------- generation
+    # Cached incremental decoding (the seq2seq analog of Llama's decode cache;
+    # reference workload: the big_model_inference benchmark's T0pp s/token
+    # table, BASELINE.md). The encoder runs once; decoder self-attention K/V
+    # accumulate in a static-shape cache and cross-attention K/V are
+    # precomputed per layer from the encoder output.
+    def encode(self, params, input_ids, attention_mask=None):
+        """Run the encoder once. Returns (enc_out, attention_mask)."""
+        cfg = self.config
+        if attention_mask is None:
+            attention_mask = (input_ids != cfg.pad_token_id).astype(jnp.int32)
+        S = input_ids.shape[1]
+        emb = params["shared"]
+        enc_pad = jnp.where(
+            attention_mask[:, None, None, :].astype(bool), 0.0, -1e30
+        ).astype(jnp.float32)
+        x = jnp.take(emb, input_ids, axis=0).astype(emb.dtype)
+        enc_bias = self._rel_bias(params["encoder"]["rel_bias"], S, S, bidirectional=True) + enc_pad
+        enc_out = self._run_stack(params["encoder"], x, None, enc_bias, None, cross=False)
+        return enc_out, attention_mask
+
+    def init_cache(self, batch_size: int, max_len: int, dtype=jnp.bfloat16):
+        """Decoder self-attention K/V cache, stacked over layers."""
+        cfg = self.config
+        shape = (cfg.num_decoder_layers, batch_size, max_len, cfg.num_heads, cfg.d_kv)
+        return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype),
+                "pos": jnp.zeros((), jnp.int32)}
+
+    def precompute_cross_kv(self, params, enc_out):
+        """Per-layer cross-attention K/V from the encoder output (computed once
+        per generation): (L, B, S, nh, dkv) each."""
+        cfg = self.config
+        nh, dkv = cfg.num_heads, cfg.d_kv
+        B, S, _ = enc_out.shape
+        wk = params["decoder"]["layers"]["cross_attn"]["wk"]  # (L, h, inner)
+        wv = params["decoder"]["layers"]["cross_attn"]["wv"]
+        ck = jnp.einsum("bsh,lhi->lbsi", enc_out, wk).reshape(-1, B, S, nh, dkv)
+        cv = jnp.einsum("bsh,lhi->lbsi", enc_out, wv).reshape(-1, B, S, nh, dkv)
+        return ck, cv
+
+    def decode(self, params, decoder_input_ids, cache, enc_out, enc_attention_mask,
+               cross_kv=None):
+        """One cached decoder chunk (prefill or single decode step).
+
+        Returns ``ModelOutput(logits=..., cache=...)``; positions are implicit
+        (``cache['pos']`` + offset) — T5 decoding always starts at position 0
+        with ``decoder_start_token_id``, so there is no left-padding to handle.
+        """
+        cfg = self.config
+        B, Tc = decoder_input_ids.shape
+        T_max = cache["k"].shape[2]
+        nh, dkv = cfg.num_heads, cfg.d_kv
+        pos = cache["pos"]
+        emb = params["shared"]
+        y = jnp.take(emb, decoder_input_ids, axis=0).astype(emb.dtype)
+
+        if cross_kv is None:
+            cross_kv = self.precompute_cross_kv(params, enc_out)
+        enc_pad = jnp.where(
+            enc_attention_mask[:, None, None, :].astype(bool), 0.0, -1e30
+        ).astype(jnp.float32)
+
+        # Relative bias between this chunk's query positions and every cache
+        # slot; slots after the query are causally masked (never written yet).
+        q_pos = pos + jnp.arange(Tc)
+        k_pos = jnp.arange(T_max)
+        buckets = relative_position_bucket(
+            k_pos[None, :] - q_pos[:, None], False,
+            cfg.relative_attention_num_buckets, cfg.relative_attention_max_distance,
+        )
+        rel = jnp.take(params["decoder"]["rel_bias"], buckets, axis=0)  # (Tc,Tmax,nh)
+        self_bias = rel.transpose(2, 0, 1)[None].astype(jnp.float32)
+        causal = jnp.where(k_pos[None, :] <= q_pos[:, None], 0.0, -1e30)
+        self_bias = self_bias + causal[None, None].astype(jnp.float32)
+
+        def block(carry, inp):
+            h = carry
+            layer, k_cache, v_cache, ck, cv = inp
+            # Cached self-attention.
+            z = rms_norm(h, layer["self_norm"]["scale"], cfg.layer_norm_epsilon)
+            q = (z @ layer["self_attn"]["wq"]).reshape(B, Tc, nh, dkv)
+            k = (z @ layer["self_attn"]["wk"]).reshape(B, Tc, nh, dkv)
+            v = (z @ layer["self_attn"]["wv"]).reshape(B, Tc, nh, dkv)
+            k_cache = jax.lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype), (0, pos, 0, 0))
+            v_cache = jax.lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype), (0, pos, 0, 0))
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache.astype(q.dtype)).astype(jnp.float32)
+            probs = jax.nn.softmax(scores + self_bias, axis=-1).astype(h.dtype)
+            attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache.astype(h.dtype))
+            h = h + attn.reshape(B, Tc, nh * dkv) @ layer["self_attn"]["wo"]
+            # Cross-attention against precomputed encoder K/V.
+            z = rms_norm(h, layer["cross_norm"]["scale"], cfg.layer_norm_epsilon)
+            q = (z @ layer["cross_attn"]["wq"]).reshape(B, Tc, nh, dkv)
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q, ck.astype(q.dtype)).astype(jnp.float32)
+            probs = jax.nn.softmax(scores + enc_pad, axis=-1).astype(h.dtype)
+            attn = jnp.einsum("bhqk,bkhd->bqhd", probs, cv.astype(h.dtype))
+            h = h + attn.reshape(B, Tc, nh * dkv) @ layer["cross_attn"]["wo"]
+            # MLP.
+            z = rms_norm(h, layer["mlp_norm"]["scale"], cfg.layer_norm_epsilon)
+            h = h + jax.nn.relu(z @ layer["mlp"]["wi"]) @ layer["mlp"]["wo"]
+            return h, (k_cache, v_cache)
+
+        ck, cv = cross_kv
+        y, (nk, nv) = jax.lax.scan(
+            block, y, (params["decoder"]["layers"], cache["k"], cache["v"], ck, cv)
+        )
+        y = rms_norm(y, params["decoder"]["final_norm"]["scale"], cfg.layer_norm_epsilon)
+        logits = ((y * (cfg.d_model ** -0.5)) @ emb.T.astype(y.dtype)).astype(jnp.float32)
+        return ModelOutput(
+            logits=logits,
+            cache={"k": nk, "v": nv, "pos": pos + Tc},
+        )

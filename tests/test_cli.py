@@ -453,3 +453,25 @@ def test_max_restarts_negative_rejected():
     args = launch_command_parser().parse_args(["--cpu", "--max_restarts", "-1", "x.py"])
     with pytest.raises(ValueError, match=">= 0"):
         launch_command(args)
+
+
+def test_notebook_launcher_single_process_inline():
+    """num_processes<=1 runs the function in-process and returns its value
+    (reference notebook_launcher semantics for TPU/one-host)."""
+    from accelerate_tpu.launchers import notebook_launcher
+
+    seen = {}
+
+    def fn(a, b):
+        seen["sum"] = a + b
+        return a + b
+
+    assert notebook_launcher(fn, (2, 3), num_processes=1) == 5
+    assert seen["sum"] == 5
+
+
+def test_notebook_launcher_rejects_bad_precision():
+    from accelerate_tpu.launchers import notebook_launcher
+
+    with pytest.raises(ValueError, match="mixed_precision"):
+        notebook_launcher(lambda: None, num_processes=1, mixed_precision="fp64")

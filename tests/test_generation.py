@@ -165,3 +165,25 @@ def test_gpt2_generate_with_cache():
     out = np.asarray(out)
     assert out.shape == (2, 14)
     np.testing.assert_array_equal(out[:, :8], prompt)
+
+
+def test_encoder_decoder_generate_shapes_and_determinism():
+    """T5-style generation: encoder input in, fresh decoder stream out; greedy
+    runs are deterministic and finished rows emit pad."""
+    import jax.numpy as jnp
+
+    from accelerate_tpu.models import T5Config, T5ForConditionalGeneration
+
+    model = T5ForConditionalGeneration(T5Config.tiny())
+    model.init_params(jax.random.key(0))
+    ids = np.random.default_rng(0).integers(1, 256, (2, 12)).astype(np.int32)
+    out1 = np.asarray(generate(model, ids, max_new_tokens=5, temperature=0.0))
+    out2 = np.asarray(generate(model, ids, max_new_tokens=5, temperature=0.0))
+    assert out1.shape == (2, 5)  # decoder stream only; prompt is encoder-side
+    np.testing.assert_array_equal(out1, out2)
+    # Sampling with a fixed key is reproducible too.
+    s1 = np.asarray(generate(model, ids, max_new_tokens=5, temperature=0.8,
+                             rng=jax.random.key(1)))
+    s2 = np.asarray(generate(model, ids, max_new_tokens=5, temperature=0.8,
+                             rng=jax.random.key(1)))
+    np.testing.assert_array_equal(s1, s2)
