@@ -114,21 +114,22 @@ def generate(
         rng = jax.random.key(0)
     eos = -1 if eos_token_id is None else eos_token_id
 
-    module_probe, _ = _unwrap(model) if not isinstance(model, StreamedScanModel) else (model, None)
-    if hasattr(module_probe, "encode"):
+    if isinstance(model, StreamedScanModel):
+        module, mparams = model, None
+    else:
+        module, mparams = _unwrap(model)
+    if hasattr(module, "encode"):
         # Encoder-decoder (T5-style): the "prompt" is the encoder input; decoding
         # starts fresh from decoder_start_token_id, so the return is always
         # (B, max_new_tokens) — see the docstring.
-        module, mparams = _unwrap(model)
         if params is None:
             params = mparams
         if params is None:
             raise ValueError("Model has no params; pass params= or init the model first.")
         fn = _compiled_generate_encdec(module, max_new_tokens, temperature, top_k,
                                        top_p, eos, pad_token_id, cache_dtype)
-        if attention_mask is None:
-            # Same inference encode() does for mask=None: pad tokens are not real.
-            attention_mask = (input_ids != module.config.pad_token_id).astype(jnp.int32)
+        # None passes through jit as an empty pytree; encode() applies the
+        # model's own pad-mask default, keeping one implementation.
         return fn(params, input_ids, attention_mask, rng)
 
     if isinstance(model, StreamedScanModel):
@@ -153,6 +154,32 @@ def generate(
     return new_tokens
 
 
+def _scan_decode(first_out, step_apply, rng, max_new_tokens, temperature, top_k,
+                 top_p, eos, pad_token_id):
+    """Shared sample + finished-mask + lax.scan loop for both decode paths.
+
+    ``first_out`` is the prefill's ModelOutput; ``step_apply(tok, cache)`` runs
+    one cached decode step and returns the next ModelOutput."""
+    rng0, rng_loop = jax.random.split(rng)
+    tok = sample_logits(first_out["logits"][:, -1], rng0, temperature, top_k, top_p)
+    finished = tok == eos
+    tok = jnp.where(finished, pad_token_id, tok)
+
+    def step(carry, _):
+        cache, tok, finished, rng = carry
+        rng, sub = jax.random.split(rng)
+        out = step_apply(tok, cache)
+        nxt = sample_logits(out["logits"][:, -1], sub, temperature, top_k, top_p)
+        newly = finished | (nxt == eos)
+        nxt = jnp.where(newly, pad_token_id, nxt)
+        return (out["cache"], nxt, newly, rng), nxt
+
+    (_, _, _, _), rest = jax.lax.scan(
+        step, (first_out["cache"], tok, finished, rng_loop), None, length=max_new_tokens - 1
+    )
+    return jnp.concatenate([tok[:, None], rest.T], axis=1)
+
+
 def _compiled_generate(module, max_new_tokens, temperature, top_k, top_p,
                        eos, pad_token_id, cache_dtype):
     """Prefill + scan-decode as one jitted function, cached per module so
@@ -170,25 +197,9 @@ def _compiled_generate(module, max_new_tokens, temperature, top_k, top_p,
         input_ids, attention_mask = left_align(input_ids, attention_mask)
         out = module.apply(params, input_ids=input_ids, attention_mask=attention_mask,
                            cache=cache)
-        last_logits = out["logits"][:, -1]
-        rng0, rng_loop = jax.random.split(rng)
-        tok = sample_logits(last_logits, rng0, temperature, top_k, top_p)
-        finished = tok == eos
-        tok = jnp.where(finished, pad_token_id, tok)
-
-        def step(carry, _):
-            cache, tok, finished, rng = carry
-            rng, sub = jax.random.split(rng)
-            out = module.apply(params, input_ids=tok[:, None], cache=cache)
-            nxt = sample_logits(out["logits"][:, -1], sub, temperature, top_k, top_p)
-            newly_finished = finished | (nxt == eos)
-            nxt = jnp.where(finished, pad_token_id, jnp.where(nxt == eos, pad_token_id, nxt))
-            return (out["cache"], nxt, newly_finished, rng), nxt
-
-        (cache, _, _, _), rest = jax.lax.scan(
-            step, (out["cache"], tok, finished, rng_loop), None, length=max_new_tokens - 1
-        )
-        return jnp.concatenate([tok[:, None], rest.T], axis=1)
+        step_apply = lambda tok, cache: module.apply(params, input_ids=tok[:, None], cache=cache)
+        return _scan_decode(out, step_apply, rng, max_new_tokens, temperature,
+                            top_k, top_p, eos, pad_token_id)
 
     fn = jax.jit(run)
     cache_store[key] = fn
@@ -213,25 +224,11 @@ def _compiled_generate_encdec(module, max_new_tokens, temperature, top_k, top_p,
 
         start = jnp.full((B, 1), module.config.decoder_start_token_id, jnp.int32)
         out = module.decode(params, start, cache, enc_out, enc_mask, cross_kv=cross_kv)
-        rng0, rng_loop = jax.random.split(rng)
-        tok = sample_logits(out["logits"][:, -1], rng0, temperature, top_k, top_p)
-        finished = tok == eos
-        tok = jnp.where(finished, pad_token_id, tok)
-
-        def step(carry, _):
-            cache, tok, finished, rng = carry
-            rng, sub = jax.random.split(rng)
-            out = module.decode(params, tok[:, None], cache, enc_out, enc_mask,
-                                cross_kv=cross_kv)
-            nxt = sample_logits(out["logits"][:, -1], sub, temperature, top_k, top_p)
-            newly = finished | (nxt == eos)
-            nxt = jnp.where(finished | (nxt == eos), pad_token_id, nxt)
-            return (out["cache"], nxt, newly, rng), nxt
-
-        (_, _, _, _), rest = jax.lax.scan(
-            step, (out["cache"], tok, finished, rng_loop), None, length=max_new_tokens - 1
+        step_apply = lambda tok, cache: module.decode(
+            params, tok[:, None], cache, enc_out, enc_mask, cross_kv=cross_kv
         )
-        return jnp.concatenate([tok[:, None], rest.T], axis=1)
+        return _scan_decode(out, step_apply, rng, max_new_tokens, temperature,
+                            top_k, top_p, eos, pad_token_id)
 
     fn = jax.jit(run)
     cache_store[key] = fn

@@ -314,6 +314,11 @@ def t5_config_from_hf(hf_config) -> T5Config:
         )
     if not get("tie_word_embeddings", True):
         raise ValueError("untied-lm-head T5 is not supported (zoo T5 ties the scaled head)")
+    pad = get("pad_token_id", 0)
+    pad = 0 if pad is None else pad
+    start = get("decoder_start_token_id")
+    # transformers leaves this None and falls back to pad at generate time.
+    start = pad if start is None else start
     return T5Config(
         vocab_size=get("vocab_size"),
         d_model=get("d_model"),
@@ -325,10 +330,8 @@ def t5_config_from_hf(hf_config) -> T5Config:
         relative_attention_num_buckets=get("relative_attention_num_buckets", 32),
         relative_attention_max_distance=get("relative_attention_max_distance", 128),
         layer_norm_epsilon=get("layer_norm_epsilon", 1e-6),
-        pad_token_id=pad if (pad := get("pad_token_id", 0)) is not None else 0,
-        # transformers leaves this None and falls back to pad at generate time.
-        decoder_start_token_id=start if (start := get("decoder_start_token_id")) is not None
-        else (pad if pad is not None else 0),
+        pad_token_id=pad,
+        decoder_start_token_id=start,
     )
 
 

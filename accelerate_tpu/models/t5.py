@@ -218,7 +218,6 @@ class T5ForConditionalGeneration(Module):
             if labels is None:
                 raise ValueError("Need decoder_input_ids or labels")
             decoder_input_ids = self._shift_right(labels)
-        B, S = input_ids.shape
         T = decoder_input_ids.shape[1]
         emb = params["shared"]
         compute_dtype = emb.dtype

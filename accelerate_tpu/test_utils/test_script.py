@@ -307,6 +307,72 @@ def training_check(accelerator):
         print(f"training parity ok: a={ia:.5f} b={ib:.5f} (fused/pure-jax match)")
 
 
+def grad_sync_check(accelerator):
+    """Gradient-accumulation semantics on the real process topology (reference
+    ``test_sync.py`` 410 LoC): the sync flag toggles on exact boundaries, banked
+    grads agree across ranks (GSPMD reduces every microbatch), and k
+    accumulated microbatches equal one k-times-larger batch at tight ATOL."""
+    import jax
+    import numpy as np
+    import optax
+
+    from accelerate_tpu import Accelerator, GradientAccumulationPlugin
+    from accelerate_tpu.state import AcceleratorState, GradientState
+    from accelerate_tpu.test_utils.training import RegressionDataset, RegressionModel, regression_batches
+    from accelerate_tpu.utils.operations import gather_object
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    acc = Accelerator(
+        gradient_accumulation_plugin=GradientAccumulationPlugin(
+            num_steps=2, sync_with_dataloader=False
+        )
+    )
+    ds = RegressionDataset(length=32, seed=3)
+    model = RegressionModel()
+    model.init_params(jax.random.key(7))
+    pmodel, popt = acc.prepare(model, optax.sgd(0.1))
+
+    flags = []
+    for batch in regression_batches(ds, batch_size=8):
+        with acc.accumulate(pmodel):
+            flags.append(acc.sync_gradients)
+            out = pmodel(**batch)
+            acc.backward(out["loss"])
+            if acc.sync_gradients:
+                # Banked grads must be bitwise-identical across ranks: GSPMD
+                # already reduced them inside the compiled backward.
+                ga = float(np.asarray(popt.grads["a"]))
+                everyone = gather_object([round(ga, 10)])
+                assert all(v == everyone[0] for v in everyone), everyone
+            popt.step()
+            popt.zero_grad()
+    assert flags == [False, True, False, True], flags
+    accumulated = {k: float(v) for k, v in acc.get_state_dict(pmodel).items()}
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    acc2 = Accelerator()
+    model2 = RegressionModel()
+    model2.init_params(jax.random.key(7))
+    pmodel2, popt2 = acc2.prepare(model2, optax.sgd(0.1))
+    for batch in regression_batches(ds, batch_size=16):
+        out = pmodel2(**batch)
+        acc2.backward(out["loss"])
+        popt2.step()
+        popt2.zero_grad()
+    onebatch = {k: float(v) for k, v in acc2.get_state_dict(pmodel2).items()}
+    for k in accumulated:
+        assert abs(accumulated[k] - onebatch[k]) < 1e-5, (k, accumulated[k], onebatch[k])
+
+    # Restore a fresh default state for subsequent checks.
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    Accelerator()
+    if accelerator.is_main_process:
+        print("grad sync ok")
+
+
 def trigger_check(accelerator):
     """A flag set on the last rank must be seen by every rank (reference
     ``test_trigger`` :837-852)."""
@@ -331,6 +397,7 @@ def main():
     collectives_check(accelerator)
     split_between_processes_check(accelerator)
     training_check(accelerator)
+    grad_sync_check(accelerator)
     trigger_check(accelerator)
     accelerator.wait_for_everyone()
     if accelerator.is_main_process:
