@@ -1,0 +1,53 @@
+"""Distributed T5 inference (reference ``examples/inference/pippy/t5.py``).
+
+The reference pipelines T5 through torch.distributed.pipelining. Here the
+encoder-decoder runs as compiled sharded programs over the mesh instead of a
+pipeline schedule: the encoder is one jitted pass, cross-attention K/V are
+precomputed per layer, and the decoder scan-decodes with a static cache —
+GSPMD shards the batch and any tp-sharded weights across the local devices,
+which is the TPU-shaped equivalent of splitting the model across GPUs for
+inference throughput. (Stage-pipelined execution via ``prepare_pippy`` covers
+the decoder-only zoo; T5's two stacks ride the mesh instead.)
+
+Run (8-device CPU simulation):
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python examples/inference/pippy/t5.py
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))))
+
+from accelerate_tpu.generation import generate
+from accelerate_tpu.models import T5Config, T5ForConditionalGeneration
+
+
+def main():
+    import jax
+
+    cfg = T5Config.tiny(num_layers=4, num_decoder_layers=4)
+    model = T5ForConditionalGeneration(cfg)
+    model.init_params(jax.random.key(0))
+
+    ids = np.random.default_rng(0).integers(1, cfg.vocab_size, (8, 24)).astype(np.int32)
+    t0 = time.perf_counter()
+    out = np.asarray(generate(model, ids, max_new_tokens=12, temperature=0.0))
+    dt = time.perf_counter() - t0
+    print(f"devices={jax.device_count()} generated={out.shape} first call {dt * 1e3:.0f} ms")
+    assert out.shape == (8, 12)
+
+    # Sampled decode reuses the same compiled programs.
+    out2 = np.asarray(
+        generate(model, ids, max_new_tokens=12, temperature=0.8, top_p=0.9,
+                 rng=jax.random.key(1))
+    )
+    assert out2.shape == (8, 12)
+    print("greedy and sampled decodes ok")
+
+
+if __name__ == "__main__":
+    main()

@@ -116,6 +116,8 @@ def test_by_feature_examples(script, args, tmp_path):
     [
         "inference/pippy/llama.py",
         "inference/pippy/bert.py",
+        "inference/pippy/gpt2.py",
+        "inference/pippy/t5.py",
         "inference/distributed/distributed_inference.py",
     ],
 )
