@@ -11,7 +11,8 @@ def __getattr__(name):
     if name in ("from_hf", "from_hf_checkpoint", "llama_config_from_hf",
                 "llama_params_from_hf", "gpt2_config_from_hf", "gpt2_params_from_hf",
                 "bert_config_from_hf", "bert_params_from_hf",
-                "t5_config_from_hf", "t5_params_from_hf"):
+                "t5_config_from_hf", "t5_params_from_hf",
+                "mixtral_config_from_hf", "mixtral_params_from_hf"):
         from . import convert
 
         return getattr(convert, name)

@@ -112,8 +112,9 @@ def llama_config_from_hf(hf_config) -> LlamaConfig:
     )
 
 
-def llama_params_from_hf(state_dict, config: LlamaConfig, dtype=jnp.float32) -> dict:
-    sd = _normalize_keys(state_dict)
+def _llama_backbone_params(sd, config, dtype) -> dict:
+    """Embed + attention + norms + head — shared by the dense-Llama and
+    Mixtral converters (Mixtral swaps only the FFN)."""
     L = config.num_hidden_layers
     params = {
         "embed": {"weight": jnp.asarray(_to_numpy(sd["embed_tokens.weight"], dtype))},
@@ -123,11 +124,6 @@ def llama_params_from_hf(state_dict, config: LlamaConfig, dtype=jnp.float32) -> 
                 "wk": _stack(sd, "layers.{i}.self_attn.k_proj.weight", L, transpose=True, dtype=dtype),
                 "wv": _stack(sd, "layers.{i}.self_attn.v_proj.weight", L, transpose=True, dtype=dtype),
                 "wo": _stack(sd, "layers.{i}.self_attn.o_proj.weight", L, transpose=True, dtype=dtype),
-            },
-            "mlp": {
-                "w_gate": _stack(sd, "layers.{i}.mlp.gate_proj.weight", L, transpose=True, dtype=dtype),
-                "w_up": _stack(sd, "layers.{i}.mlp.up_proj.weight", L, transpose=True, dtype=dtype),
-                "w_down": _stack(sd, "layers.{i}.mlp.down_proj.weight", L, transpose=True, dtype=dtype),
             },
             "input_norm": {"weight": _stack(sd, "layers.{i}.input_layernorm.weight", L, dtype=dtype)},
             "post_attn_norm": {
@@ -141,6 +137,18 @@ def llama_params_from_hf(state_dict, config: LlamaConfig, dtype=jnp.float32) -> 
         if head is None:  # backbone-only checkpoint: fall back to tying
             head = sd["embed_tokens.weight"]
         params["lm_head"] = {"weight": jnp.asarray(_to_numpy(head, dtype).T)}
+    return params
+
+
+def llama_params_from_hf(state_dict, config: LlamaConfig, dtype=jnp.float32) -> dict:
+    sd = _normalize_keys(state_dict)
+    L = config.num_hidden_layers
+    params = _llama_backbone_params(sd, config, dtype)
+    params["layers"]["mlp"] = {
+        "w_gate": _stack(sd, "layers.{i}.mlp.gate_proj.weight", L, transpose=True, dtype=dtype),
+        "w_up": _stack(sd, "layers.{i}.mlp.up_proj.weight", L, transpose=True, dtype=dtype),
+        "w_down": _stack(sd, "layers.{i}.mlp.down_proj.weight", L, transpose=True, dtype=dtype),
+    }
     return params
 
 
@@ -302,6 +310,72 @@ def bert_params_from_hf(state_dict, config: BertConfig, dtype=jnp.float32) -> di
     return params
 
 
+# -------------------------------------------------------------------- mixtral
+def mixtral_config_from_hf(hf_config):
+    """Mixtral = Llama attention/norms + top-k sparse MoE FFN. Our renormalized
+    top-k gate is mathematically identical to Mixtral's softmax-over-top-k-
+    logits; ``capacity_factor = num_experts/top_k`` guarantees no token is ever
+    dropped, so converted inference is exact (tests/test_convert.py)."""
+    from .moe import MoELlamaConfig
+
+    get = _getter(hf_config)
+    if get("rope_scaling"):
+        raise ValueError("rope_scaling is not supported by the zoo MoE Llama")
+    window = get("sliding_window")
+    max_pos = get("max_position_embeddings", 2048)
+    if window is not None and window < max_pos:
+        raise ValueError(
+            f"sliding_window={window} is not supported (zoo MoE Llama is full-causal); "
+            "sequences past the window would silently diverge from HF. Convert only "
+            "checkpoints with sliding_window disabled or >= max_position_embeddings."
+        )
+    E = get("num_local_experts", 8)
+    k = get("num_experts_per_tok", 2)
+    return MoELlamaConfig(
+        vocab_size=get("vocab_size"),
+        hidden_size=get("hidden_size"),
+        intermediate_size=get("intermediate_size"),
+        num_hidden_layers=get("num_hidden_layers"),
+        num_attention_heads=get("num_attention_heads"),
+        num_key_value_heads=get("num_key_value_heads") or get("num_attention_heads"),
+        max_position_embeddings=get("max_position_embeddings", 2048),
+        rms_norm_eps=get("rms_norm_eps", 1e-5),
+        rope_theta=get("rope_theta", 10000.0),
+        tie_word_embeddings=bool(get("tie_word_embeddings", False)),
+        num_experts=E,
+        moe_top_k=k,
+        capacity_factor=float(E) / k,  # drop-free: exact Mixtral routing
+        router_aux_coef=coef if (coef := get("router_aux_loss_coef")) is not None else 0.001,
+    )
+
+
+def mixtral_params_from_hf(state_dict, config, dtype=jnp.float32) -> dict:
+    sd = _normalize_keys(state_dict)
+    L, E = config.num_hidden_layers, config.num_experts
+    params = _llama_backbone_params(sd, config, dtype)
+
+    def expert_stack(w_name, transpose=True):
+        mats = []
+        for i in range(L):
+            per_layer = []
+            for e in range(E):
+                m = _to_numpy(
+                    sd[f"layers.{i}.block_sparse_moe.experts.{e}.{w_name}.weight"], dtype
+                )
+                per_layer.append(m.T if transpose else m)
+            mats.append(np.stack(per_layer))
+        return jnp.asarray(np.stack(mats))  # (L, E, in, out)
+
+    params["layers"]["mlp"] = {
+        "router": _stack(sd, "layers.{i}.block_sparse_moe.gate.weight", L,
+                         transpose=True, dtype=dtype),
+        "w_gate": expert_stack("w1"),
+        "w_up": expert_stack("w3"),
+        "w_down": expert_stack("w2"),
+    }
+    return params
+
+
 # ------------------------------------------------------------------------ t5
 def t5_config_from_hf(hf_config) -> T5Config:
     get = _getter(hf_config)
@@ -396,6 +470,11 @@ _CONVERTERS = {
     "bert": (BertForSequenceClassification, bert_config_from_hf, bert_params_from_hf),
     "t5": (T5ForConditionalGeneration, t5_config_from_hf, t5_params_from_hf),
 }
+
+
+from .moe import MoELlama as _MoELlama  # noqa: E402 — registered below
+
+_CONVERTERS["mixtral"] = (_MoELlama, mixtral_config_from_hf, mixtral_params_from_hf)
 
 
 def from_hf(hf_model, dtype=jnp.float32):

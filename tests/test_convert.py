@@ -378,3 +378,76 @@ def test_t5_generate_matches_hf_greedy(hf_t5):
         ).numpy()
     # HF prepends the decoder start token; ours returns only generated tokens.
     np.testing.assert_array_equal(ours, theirs[:, 1:7])
+
+
+@pytest.fixture(scope="module")
+def hf_mixtral():
+    cfg = transformers.MixtralConfig(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=96,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        num_local_experts=4,
+        num_experts_per_tok=2,
+        max_position_embeddings=64,
+        sliding_window=None,  # zoo MoE is full-causal; windowed configs are rejected
+        tie_word_embeddings=False,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(5)
+    return transformers.MixtralForCausalLM(cfg).eval()
+
+
+def test_mixtral_logits_match_hf(hf_mixtral):
+    """Sparse-MoE parity: our renormalized top-k gate == Mixtral's
+    softmax-over-top-k, and drop-free capacity makes routing exact."""
+    from accelerate_tpu.models.convert import from_hf
+
+    model, params = from_hf(hf_mixtral)
+    assert model.config.num_experts == 4 and model.config.moe_top_k == 2
+    ids = np.random.default_rng(10).integers(0, 128, (2, 16)).astype(np.int32)
+    ours = model.apply(params, input_ids=ids)["logits"]
+    with torch.no_grad():
+        theirs = hf_mixtral(torch.tensor(ids, dtype=torch.long)).logits
+    _logits_close(ours, theirs, atol=5e-4)
+
+
+def test_mixtral_converted_model_trains(hf_mixtral):
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.models.convert import from_hf
+
+    acc = Accelerator()
+    model, params = from_hf(hf_mixtral)
+    pmodel, popt = acc.prepare(model, optax.adam(1e-3))
+    ids = np.random.default_rng(11).integers(0, 128, (8, 16)).astype(np.int32)
+    step = acc.build_train_step(pmodel, popt)
+    losses = [float(step({"input_ids": ids, "labels": ids})) for _ in range(5)]
+    assert losses[-1] < losses[0]
+
+
+def test_mixtral_sliding_window_rejected():
+    from accelerate_tpu.models.convert import mixtral_config_from_hf
+
+    with pytest.raises(ValueError, match="sliding_window"):
+        mixtral_config_from_hf({
+            "vocab_size": 128, "hidden_size": 64, "intermediate_size": 96,
+            "num_hidden_layers": 2, "num_attention_heads": 4,
+            "num_local_experts": 4, "num_experts_per_tok": 2,
+            "max_position_embeddings": 4096, "sliding_window": 1024,
+        })
+
+
+def test_mixtral_zero_aux_coef_preserved():
+    from accelerate_tpu.models.convert import mixtral_config_from_hf
+
+    cfg = mixtral_config_from_hf({
+        "vocab_size": 128, "hidden_size": 64, "intermediate_size": 96,
+        "num_hidden_layers": 2, "num_attention_heads": 4,
+        "num_local_experts": 4, "num_experts_per_tok": 2,
+        "router_aux_loss_coef": 0.0,
+    })
+    assert cfg.router_aux_coef == 0.0
