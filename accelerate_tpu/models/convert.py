@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from .bert import BertConfig, BertForSequenceClassification
 from .gpt2 import GPT2, GPT2Config
 from .llama import Llama, LlamaConfig
+from .moe import MoELlama, MoELlamaConfig
 from .t5 import T5Config, T5ForConditionalGeneration
 
 
@@ -82,15 +83,23 @@ def _get_converter(model_type):
 def llama_config_from_hf(hf_config) -> LlamaConfig:
     """Map a ``transformers.LlamaConfig`` (attributes or dict) onto the zoo config.
 
-    Raises on config features the zoo model does not implement (rope scaling,
-    attention/mlp biases, decoupled head_dim) — silently dropping them would
-    convert cleanly and then generate garbage at depth/length."""
+    Raises on config features the zoo model does not implement (unsupported
+    rope_type values, attention/mlp biases, decoupled head_dim) — silently
+    dropping them would convert cleanly and then generate garbage at
+    depth/length. linear and llama3 rope scaling are supported."""
     get = _getter(hf_config)
-    if get("rope_scaling"):
-        raise ValueError(
-            f"rope_scaling={get('rope_scaling')!r} is not supported by the zoo Llama "
-            "(plain RoPE only); converting would silently mis-position long contexts."
-        )
+    rope_scaling = get("rope_scaling")
+    if rope_scaling:
+        rope_scaling = dict(rope_scaling)
+        from .llama import SUPPORTED_ROPE_TYPES
+
+        rope_type = rope_scaling.get("rope_type", rope_scaling.get("type", "default"))
+        if rope_type not in SUPPORTED_ROPE_TYPES:
+            raise ValueError(
+                f"rope_type={rope_type!r} is not supported by the zoo Llama "
+                "(supported: linear, llama3); converting would silently "
+                "mis-position long contexts."
+            )
     if get("attention_bias") or get("mlp_bias"):
         raise ValueError("attention_bias/mlp_bias checkpoints are not supported (zoo Llama is bias-free)")
     explicit_hd = get("head_dim")
@@ -109,6 +118,7 @@ def llama_config_from_hf(hf_config) -> LlamaConfig:
         rms_norm_eps=get("rms_norm_eps", 1e-5),
         rope_theta=get("rope_theta", 10000.0),
         tie_word_embeddings=bool(get("tie_word_embeddings", False)),
+        rope_scaling=rope_scaling,
     )
 
 
@@ -316,11 +326,18 @@ def mixtral_config_from_hf(hf_config):
     top-k gate is mathematically identical to Mixtral's softmax-over-top-k-
     logits; ``capacity_factor = num_experts/top_k`` guarantees no token is ever
     dropped, so converted inference is exact (tests/test_convert.py)."""
-    from .moe import MoELlamaConfig
-
     get = _getter(hf_config)
-    if get("rope_scaling"):
-        raise ValueError("rope_scaling is not supported by the zoo MoE Llama")
+    from .llama import SUPPORTED_ROPE_TYPES
+
+    rope_scaling = get("rope_scaling")
+    if rope_scaling:
+        rope_scaling = dict(rope_scaling)
+        rope_type = rope_scaling.get("rope_type", rope_scaling.get("type", "default"))
+        if rope_type not in SUPPORTED_ROPE_TYPES:
+            raise ValueError(
+                f"rope_type={rope_type!r} is not supported by the zoo MoE Llama "
+                f"(supported: {SUPPORTED_ROPE_TYPES})"
+            )
     window = get("sliding_window")
     max_pos = get("max_position_embeddings", 2048)
     if window is not None and window < max_pos:
@@ -346,6 +363,7 @@ def mixtral_config_from_hf(hf_config):
         moe_top_k=k,
         capacity_factor=float(E) / k,  # drop-free: exact Mixtral routing
         router_aux_coef=coef if (coef := get("router_aux_loss_coef")) is not None else 0.001,
+        rope_scaling=rope_scaling,
     )
 
 
@@ -469,12 +487,11 @@ _CONVERTERS = {
     "gpt2": (GPT2, gpt2_config_from_hf, gpt2_params_from_hf),
     "bert": (BertForSequenceClassification, bert_config_from_hf, bert_params_from_hf),
     "t5": (T5ForConditionalGeneration, t5_config_from_hf, t5_params_from_hf),
+    "mixtral": (MoELlama, mixtral_config_from_hf, mixtral_params_from_hf),
 }
 
 
-from .moe import MoELlama as _MoELlama  # noqa: E402 — registered below
 
-_CONVERTERS["mixtral"] = (_MoELlama, mixtral_config_from_hf, mixtral_params_from_hf)
 
 
 def from_hf(hf_model, dtype=jnp.float32):

@@ -53,6 +53,10 @@ class LlamaConfig:
     remat_policy: str = "nothing_saveable"  # any jax.checkpoint_policies name
     attention_impl: str = "auto"  # 'auto' | 'dense' | 'flash' | 'ring' | 'ulysses'
     matmul_precision: str = "default"  # 'default' | 'int8' (QAT w/ STE bwd, ops/int8.py)
+    # RoPE scaling for long-context checkpoints: None, or a dict with
+    # rope_type 'linear' (positions/factor) or 'llama3' (frequency-banded
+    # scaling, the Llama-3.1 recipe). Matches the HF config field.
+    rope_scaling: dict | None = None
 
     @property
     def head_dim(self) -> int:
@@ -99,9 +103,41 @@ def rms_norm(x, weight, eps):
     return (x * weight).astype(dtype)
 
 
-def rope_tables(positions, head_dim, theta):
+SUPPORTED_ROPE_TYPES = ("default", "linear", "llama3")
+
+
+def _llama3_scale_inv_freq(inv_freq, scaling: dict):
+    """Llama-3.1 frequency-banded RoPE scaling (the public llama3 recipe, as in
+    transformers' Llama3RotaryEmbedding): low-frequency components are divided
+    by ``factor``, high-frequency kept, the band between smoothly interpolated."""
+    factor = scaling.get("factor", 8.0)
+    low = scaling.get("low_freq_factor", 1.0)
+    high = scaling.get("high_freq_factor", 4.0)
+    original_max = scaling.get("original_max_position_embeddings", 8192)
+
+    wavelen = 2.0 * np.pi / inv_freq
+    low_freq_wavelen = original_max / low
+    high_freq_wavelen = original_max / high
+    smooth = (original_max / wavelen - low) / (high - low)
+    smoothed = (1.0 - smooth) * inv_freq / factor + smooth * inv_freq
+    scaled = np.where(wavelen > low_freq_wavelen, inv_freq / factor, inv_freq)
+    is_medium = (wavelen >= high_freq_wavelen) & (wavelen <= low_freq_wavelen)
+    return np.where(is_medium, smoothed, scaled).astype(np.float32)
+
+
+def rope_tables(positions, head_dim, theta, scaling: dict | None = None):
     """cos/sin tables for rotary embeddings, fp32. positions: (B, S) int."""
     inv_freq = 1.0 / (theta ** (np.arange(0, head_dim, 2, dtype=np.float32) / head_dim))
+    if scaling:
+        rope_type = scaling.get("rope_type", scaling.get("type", "default"))
+        if rope_type == "linear":
+            inv_freq = inv_freq / float(scaling.get("factor", 1.0))
+        elif rope_type == "llama3":
+            inv_freq = _llama3_scale_inv_freq(inv_freq, scaling)
+        elif rope_type not in (None, "default"):
+            raise ValueError(
+                f"Unsupported rope_type {rope_type!r} (supported: {SUPPORTED_ROPE_TYPES})"
+            )
     angles = positions[..., None].astype(jnp.float32) * inv_freq  # (B,S,D/2)
     return jnp.cos(angles), jnp.sin(angles)
 
@@ -198,7 +234,7 @@ class Llama(Module):
         x = x.astype(params["embed"]["weight"].dtype)
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (B, S))
-        cos, sin = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
+        cos, sin = rope_tables(positions, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
         return x, {"cos": cos, "sin": sin, "attention_mask": attention_mask}
 
     def block(self, layer, x, ctx, cache_layer=None):

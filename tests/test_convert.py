@@ -173,8 +173,8 @@ def test_unsupported_llama_features_raise():
         vocab_size=128, hidden_size=64, intermediate_size=128, num_hidden_layers=2,
         num_attention_heads=4, num_key_value_heads=2,
     )
-    with pytest.raises(ValueError, match="rope_scaling"):
-        llama_config_from_hf({**base, "rope_scaling": {"rope_type": "llama3", "factor": 8.0}})
+    with pytest.raises(ValueError, match="rope_type"):
+        llama_config_from_hf({**base, "rope_scaling": {"rope_type": "yarn", "factor": 8.0}})
     with pytest.raises(ValueError, match="bias"):
         llama_config_from_hf({**base, "attention_bias": True})
     with pytest.raises(ValueError, match="head_dim"):
@@ -451,3 +451,92 @@ def test_mixtral_zero_aux_coef_preserved():
         "router_aux_loss_coef": 0.0,
     })
     assert cfg.router_aux_coef == 0.0
+
+
+def test_gpt2_generate_matches_hf_greedy(hf_gpt2):
+    import jax.numpy as jnp
+
+    from accelerate_tpu.generation import generate
+    from accelerate_tpu.models.convert import from_hf
+
+    model, params = from_hf(hf_gpt2)
+    prompt = np.random.default_rng(12).integers(0, 128, (1, 8)).astype(np.int32)
+    ours = generate(model, prompt, max_new_tokens=8, temperature=0.0,
+                    cache_dtype=jnp.float32)
+    with torch.no_grad():
+        theirs = hf_gpt2.generate(
+            torch.tensor(prompt, dtype=torch.long),
+            max_new_tokens=8, eos_token_id=None, do_sample=False, pad_token_id=0,
+        )
+    np.testing.assert_array_equal(np.asarray(ours)[0], theirs[0].numpy())
+
+
+def test_converted_model_shards_onto_mesh(hf_llama):
+    """Converted HF weights flow through the sharding planner: tp/fsdp specs
+    land on the stacked params and training still runs."""
+    import optax
+
+    from accelerate_tpu import Accelerator, ParallelismConfig
+    from accelerate_tpu.models.convert import from_hf
+
+    acc = Accelerator(parallelism_config=ParallelismConfig(tp_size=2, fsdp_size=2, dp_size=2))
+    model, params = from_hf(hf_llama)
+    pmodel, popt = acc.prepare(model, optax.sgd(1e-2))
+    wq = pmodel.params["layers"]["attn"]["wq"]
+    assert "tp" in jax.tree_util.tree_leaves(tuple(wq.sharding.spec)), wq.sharding
+    ids = np.random.default_rng(13).integers(0, 128, (4, 16)).astype(np.int32)
+    step = acc.build_train_step(pmodel, popt)
+    assert np.isfinite(float(step({"input_ids": ids, "labels": ids})))
+
+
+def test_llama3_rope_scaling_logits_match_hf():
+    """Llama-3.1 checkpoints (frequency-banded rope scaling) convert and match
+    HF logits exactly — the raise is only for genuinely unsupported rope types."""
+    from accelerate_tpu.models.convert import from_hf
+
+    cfg = transformers.LlamaConfig(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=128,
+        rope_theta=10000.0,
+        rope_scaling={"rope_type": "llama3", "factor": 8.0, "low_freq_factor": 1.0,
+                      "high_freq_factor": 4.0, "original_max_position_embeddings": 64},
+        attn_implementation="eager",
+    )
+    torch.manual_seed(6)
+    hf = transformers.LlamaForCausalLM(cfg).eval()
+    model, params = from_hf(hf)
+    assert model.config.rope_scaling["rope_type"] == "llama3"
+    ids = np.random.default_rng(14).integers(0, 128, (2, 48)).astype(np.int32)
+    ours = model.apply(params, input_ids=ids)["logits"]
+    with torch.no_grad():
+        theirs = hf(torch.tensor(ids, dtype=torch.long)).logits
+    _logits_close(ours, theirs, atol=3e-4)
+
+
+def test_linear_rope_scaling_logits_match_hf():
+    from accelerate_tpu.models.convert import from_hf
+
+    cfg = transformers.LlamaConfig(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=128,
+        rope_scaling={"rope_type": "linear", "factor": 2.0},
+        attn_implementation="eager",
+    )
+    torch.manual_seed(7)
+    hf = transformers.LlamaForCausalLM(cfg).eval()
+    model, params = from_hf(hf)
+    ids = np.random.default_rng(15).integers(0, 128, (2, 32)).astype(np.int32)
+    ours = model.apply(params, input_ids=ids)["logits"]
+    with torch.no_grad():
+        theirs = hf(torch.tensor(ids, dtype=torch.long)).logits
+    _logits_close(ours, theirs, atol=3e-4)
