@@ -100,8 +100,8 @@ def llama_config_from_hf(hf_config) -> LlamaConfig:
                 "(supported: linear, llama3); converting would silently "
                 "mis-position long contexts."
             )
-    if get("attention_bias") or get("mlp_bias"):
-        raise ValueError("attention_bias/mlp_bias checkpoints are not supported (zoo Llama is bias-free)")
+    if get("mlp_bias"):
+        raise ValueError("mlp_bias checkpoints are not supported (zoo Llama's FFN is bias-free)")
     explicit_hd = get("head_dim")
     if explicit_hd and explicit_hd != get("hidden_size") // get("num_attention_heads"):
         raise ValueError(
@@ -119,6 +119,7 @@ def llama_config_from_hf(hf_config) -> LlamaConfig:
         rope_theta=get("rope_theta", 10000.0),
         tie_word_embeddings=bool(get("tie_word_embeddings", False)),
         rope_scaling=rope_scaling,
+        attention_bias=bool(get("attention_bias", False)),
     )
 
 
@@ -154,12 +155,38 @@ def llama_params_from_hf(state_dict, config: LlamaConfig, dtype=jnp.float32) -> 
     sd = _normalize_keys(state_dict)
     L = config.num_hidden_layers
     params = _llama_backbone_params(sd, config, dtype)
+    if config.attention_bias:
+        params["layers"]["attn"].update({
+            "bq": _stack(sd, "layers.{i}.self_attn.q_proj.bias", L, dtype=dtype),
+            "bk": _stack(sd, "layers.{i}.self_attn.k_proj.bias", L, dtype=dtype),
+            "bv": _stack(sd, "layers.{i}.self_attn.v_proj.bias", L, dtype=dtype),
+        })
     params["layers"]["mlp"] = {
         "w_gate": _stack(sd, "layers.{i}.mlp.gate_proj.weight", L, transpose=True, dtype=dtype),
         "w_up": _stack(sd, "layers.{i}.mlp.up_proj.weight", L, transpose=True, dtype=dtype),
         "w_down": _stack(sd, "layers.{i}.mlp.down_proj.weight", L, transpose=True, dtype=dtype),
     }
     return params
+
+
+# --------------------------------------------------------------------- qwen2
+def qwen2_config_from_hf(hf_config) -> LlamaConfig:
+    """Qwen2 = the Llama recipe + QKV biases; map onto LlamaConfig with
+    ``attention_bias=True``."""
+    get = _getter(hf_config)
+    if get("use_sliding_window"):
+        raise ValueError(
+            "use_sliding_window=True is not supported (zoo Llama is full-causal)"
+        )
+    cfg = llama_config_from_hf(hf_config)
+    import dataclasses
+
+    return dataclasses.replace(cfg, attention_bias=True)
+
+
+# Qwen2's QKV-bias loading rides the generalized Llama converter (the config
+# forces attention_bias=True above).
+qwen2_params_from_hf = llama_params_from_hf
 
 
 # ---------------------------------------------------------------------- gpt2
@@ -488,6 +515,7 @@ _CONVERTERS = {
     "bert": (BertForSequenceClassification, bert_config_from_hf, bert_params_from_hf),
     "t5": (T5ForConditionalGeneration, t5_config_from_hf, t5_params_from_hf),
     "mixtral": (MoELlama, mixtral_config_from_hf, mixtral_params_from_hf),
+    "qwen2": (Llama, qwen2_config_from_hf, qwen2_params_from_hf),
 }
 
 

@@ -53,6 +53,8 @@ class LlamaConfig:
     remat_policy: str = "nothing_saveable"  # any jax.checkpoint_policies name
     attention_impl: str = "auto"  # 'auto' | 'dense' | 'flash' | 'ring' | 'ulysses'
     matmul_precision: str = "default"  # 'default' | 'int8' (QAT w/ STE bwd, ops/int8.py)
+    # QKV projection biases (the Qwen2 recipe; Llama proper is bias-free).
+    attention_bias: bool = False
     # RoPE scaling for long-context checkpoints: None, or a dict with
     # rope_type 'linear' (positions/factor) or 'llama3' (frequency-banded
     # scaling, the Llama-3.1 recipe). Matches the HF config field.
@@ -185,6 +187,15 @@ class Llama(Module):
                     "wk": dense(keys[2], (L, h, nkv * hd)),
                     "wv": dense(keys[3], (L, h, nkv * hd)),
                     "wo": dense(keys[4], (L, nh * hd, h)),
+                    **(
+                        {
+                            "bq": jnp.zeros((L, nh * hd), jnp.float32),
+                            "bk": jnp.zeros((L, nkv * hd), jnp.float32),
+                            "bv": jnp.zeros((L, nkv * hd), jnp.float32),
+                        }
+                        if cfg.attention_bias
+                        else {}
+                    ),
                 },
                 "mlp": {
                     "w_gate": dense(keys[5], (L, h, inter)),
@@ -213,6 +224,7 @@ class Llama(Module):
         return [
             (r"embed/weight", P("tp", "fsdp")),
             (r"attn/w[qkv]", P("pp", "fsdp", "tp")),
+            (r"attn/b[qkv]", P("pp", "tp")),
             (r"attn/wo", P("pp", "tp", "fsdp")),
             (r"mlp/w_(gate|up)", P("pp", "fsdp", "tp")),
             (r"mlp/w_down", P("pp", "tp", "fsdp")),
@@ -253,9 +265,15 @@ class Llama(Module):
         B, S, _ = x.shape
         cos, sin = ctx["cos"], ctx["sin"]
         h = rms_norm(x, layer["input_norm"]["weight"], cfg.rms_norm_eps)
-        q = self._mm(h, layer["attn"]["wq"]).reshape(B, S, nh, hd)
-        k = self._mm(h, layer["attn"]["wk"]).reshape(B, S, nkv, hd)
-        v = self._mm(h, layer["attn"]["wv"]).reshape(B, S, nkv, hd)
+        a = layer["attn"]
+        q = self._mm(h, a["wq"])
+        k = self._mm(h, a["wk"])
+        v = self._mm(h, a["wv"])
+        if "bq" in a:  # Qwen2-style QKV biases (static pytree structure)
+            q, k, v = q + a["bq"], k + a["bk"], v + a["bv"]
+        q = q.reshape(B, S, nh, hd)
+        k = k.reshape(B, S, nkv, hd)
+        v = v.reshape(B, S, nkv, hd)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
         new_cache = None
@@ -405,6 +423,8 @@ class Llama(Module):
         cfg = self.config
         h, inter, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_hidden_layers
         attn = h * (cfg.num_attention_heads + 2 * cfg.num_key_value_heads) * cfg.head_dim + cfg.num_attention_heads * cfg.head_dim * h
+        if cfg.attention_bias:
+            attn += (cfg.num_attention_heads + 2 * cfg.num_key_value_heads) * cfg.head_dim
         mlp = 3 * h * inter
         norms = 2 * h
         total = L * (attn + mlp + norms) + cfg.vocab_size * h + h

@@ -176,7 +176,9 @@ def test_unsupported_llama_features_raise():
     with pytest.raises(ValueError, match="rope_type"):
         llama_config_from_hf({**base, "rope_scaling": {"rope_type": "yarn", "factor": 8.0}})
     with pytest.raises(ValueError, match="bias"):
-        llama_config_from_hf({**base, "attention_bias": True})
+        llama_config_from_hf({**base, "mlp_bias": True})
+    # attention_bias is now supported (the Qwen2 recipe), not rejected.
+    assert llama_config_from_hf({**base, "attention_bias": True}).attention_bias is True
     with pytest.raises(ValueError, match="head_dim"):
         llama_config_from_hf({**base, "head_dim": 32})
 
@@ -540,3 +542,53 @@ def test_linear_rope_scaling_logits_match_hf():
     with torch.no_grad():
         theirs = hf(torch.tensor(ids, dtype=torch.long)).logits
     _logits_close(ours, theirs, atol=3e-4)
+
+
+def test_qwen2_logits_match_hf():
+    """Qwen2 = Llama + QKV biases; conversion pins the bias path too."""
+    from accelerate_tpu.models.convert import from_hf
+
+    cfg = transformers.Qwen2Config(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=64,
+        tie_word_embeddings=False,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(8)
+    hf = transformers.Qwen2ForCausalLM(cfg).eval()
+    model, params = from_hf(hf)
+    assert model.config.attention_bias is True
+    assert "bq" in params["layers"]["attn"]
+    ids = np.random.default_rng(16).integers(0, 128, (2, 16)).astype(np.int32)
+    ours = model.apply(params, input_ids=ids)["logits"]
+    with torch.no_grad():
+        theirs = hf(torch.tensor(ids, dtype=torch.long)).logits
+    _logits_close(ours, theirs, atol=3e-4)
+
+
+def test_qwen2_generate_matches_hf_greedy():
+    import jax.numpy as jnp
+
+    from accelerate_tpu.generation import generate
+    from accelerate_tpu.models.convert import from_hf
+
+    cfg = transformers.Qwen2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=64,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(9)
+    hf = transformers.Qwen2ForCausalLM(cfg).eval()
+    model, params = from_hf(hf)
+    prompt = np.random.default_rng(17).integers(0, 128, (1, 6)).astype(np.int32)
+    ours = generate(model, prompt, max_new_tokens=6, temperature=0.0,
+                    cache_dtype=jnp.float32)
+    with torch.no_grad():
+        theirs = hf.generate(torch.tensor(prompt, dtype=torch.long), max_new_tokens=6,
+                             eos_token_id=None, do_sample=False, pad_token_id=0)
+    np.testing.assert_array_equal(np.asarray(ours)[0], theirs[0].numpy())
