@@ -120,6 +120,7 @@ def llama_config_from_hf(hf_config) -> LlamaConfig:
         tie_word_embeddings=bool(get("tie_word_embeddings", False)),
         rope_scaling=rope_scaling,
         attention_bias=bool(get("attention_bias", False)),
+        sliding_window=get("sliding_window"),
     )
 
 
@@ -174,14 +175,26 @@ def qwen2_config_from_hf(hf_config) -> LlamaConfig:
     """Qwen2 = the Llama recipe + QKV biases; map onto LlamaConfig with
     ``attention_bias=True``."""
     get = _getter(hf_config)
-    if get("use_sliding_window"):
-        raise ValueError(
-            "use_sliding_window=True is not supported (zoo Llama is full-causal)"
-        )
     cfg = llama_config_from_hf(hf_config)
     import dataclasses
 
-    return dataclasses.replace(cfg, attention_bias=True)
+    # Qwen2 applies its window only to layers >= max_window_layers; the zoo's
+    # scan shares one mask across layers, so only the uniform cases map.
+    window = None
+    if get("use_sliding_window"):
+        L = get("num_hidden_layers")
+        mwl = get("max_window_layers", 0) or 0
+        if mwl >= L:
+            window = None  # no layer windowed
+        elif mwl == 0:
+            window = get("sliding_window")  # every layer windowed
+        else:
+            raise ValueError(
+                f"max_window_layers={mwl} mixes windowed and full-attention layers; "
+                "the zoo applies one attention mask to every layer — converting "
+                "would silently diverge from HF."
+            )
+    return dataclasses.replace(cfg, attention_bias=True, sliding_window=window)
 
 
 # Qwen2's QKV-bias loading rides the generalized Llama converter (the config
@@ -365,14 +378,6 @@ def mixtral_config_from_hf(hf_config):
                 f"rope_type={rope_type!r} is not supported by the zoo MoE Llama "
                 f"(supported: {SUPPORTED_ROPE_TYPES})"
             )
-    window = get("sliding_window")
-    max_pos = get("max_position_embeddings", 2048)
-    if window is not None and window < max_pos:
-        raise ValueError(
-            f"sliding_window={window} is not supported (zoo MoE Llama is full-causal); "
-            "sequences past the window would silently diverge from HF. Convert only "
-            "checkpoints with sliding_window disabled or >= max_position_embeddings."
-        )
     E = get("num_local_experts", 8)
     k = get("num_experts_per_tok", 2)
     return MoELlamaConfig(
@@ -391,6 +396,7 @@ def mixtral_config_from_hf(hf_config):
         capacity_factor=float(E) / k,  # drop-free: exact Mixtral routing
         router_aux_coef=coef if (coef := get("router_aux_loss_coef")) is not None else 0.001,
         rope_scaling=rope_scaling,
+        sliding_window=get("sliding_window"),
     )
 
 
@@ -516,6 +522,9 @@ _CONVERTERS = {
     "t5": (T5ForConditionalGeneration, t5_config_from_hf, t5_params_from_hf),
     "mixtral": (MoELlama, mixtral_config_from_hf, mixtral_params_from_hf),
     "qwen2": (Llama, qwen2_config_from_hf, qwen2_params_from_hf),
+    # Mistral is the Llama recipe + sliding-window attention; the generalized
+    # Llama converter handles both (sliding_window flows from the config).
+    "mistral": (Llama, llama_config_from_hf, llama_params_from_hf),
 }
 
 

@@ -55,6 +55,9 @@ class LlamaConfig:
     matmul_precision: str = "default"  # 'default' | 'int8' (QAT w/ STE bwd, ops/int8.py)
     # QKV projection biases (the Qwen2 recipe; Llama proper is bias-free).
     attention_bias: bool = False
+    # Sliding-window attention (the Mistral recipe): each query attends only
+    # the previous `sliding_window` positions. None = full causal.
+    sliding_window: int | None = None
     # RoPE scaling for long-context checkpoints: None, or a dict with
     # rope_type 'linear' (positions/factor) or 'llama3' (frequency-banded
     # scaling, the Llama-3.1 recipe). Matches the HF config field.
@@ -291,6 +294,7 @@ class Llama(Module):
                 q, k_cache, v_cache,
                 q_positions=ctx["positions"],
                 kv_mask=ctx.get("kv_mask"),
+                window=cfg.sliding_window,
             )
             new_cache = {"k": k_cache, "v": v_cache}
         else:
@@ -299,7 +303,8 @@ class Llama(Module):
                 k = jnp.repeat(k, rep, axis=2)
                 v = jnp.repeat(v, rep, axis=2)
             attn_out = _attention(
-                q, k, v, causal=True, mask=ctx["attention_mask"], impl=cfg.attention_impl
+                q, k, v, causal=True, mask=ctx["attention_mask"],
+                impl=cfg.attention_impl, window=cfg.sliding_window,
             )
         x = x + self._mm(attn_out.reshape(B, S, nh * hd), layer["attn"]["wo"])
         h2 = rms_norm(x, layer["post_attn_norm"]["weight"], cfg.rms_norm_eps)

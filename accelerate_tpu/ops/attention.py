@@ -60,18 +60,25 @@ def repeat_kv(k, v, n_rep: int):
     return jnp.repeat(k, n_rep, axis=2), jnp.repeat(v, n_rep, axis=2)
 
 
-def dense_attention(q, k, v, *, causal=True, mask=None, positions_q=None, positions_kv=None):
-    """q: (B,S,H,D), k/v: (B,Skv,H,D); mask: (B,Skv) 1=real. fp32 softmax."""
+def dense_attention(q, k, v, *, causal=True, mask=None, positions_q=None, positions_kv=None,
+                    window=None):
+    """q: (B,S,H,D), k/v: (B,Skv,H,D); mask: (B,Skv) 1=real. fp32 softmax.
+
+    ``window``: sliding-window size (Mistral recipe) — a query attends keys
+    with ``0 <= q_pos - k_pos < window`` (plus itself); None = full causal."""
     scale = 1.0 / np.sqrt(q.shape[-1])
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
     bias = jnp.zeros_like(scores)
-    if causal:
+    if causal or window is not None:
         if positions_q is None:
             positions_q = jnp.arange(q.shape[1])
         if positions_kv is None:
             positions_kv = jnp.arange(k.shape[1])
-        causal_mask = positions_q[:, None] >= positions_kv[None, :]
-        bias = jnp.where(causal_mask[None, None], bias, -1e30)
+        delta = positions_q[:, None] - positions_kv[None, :]
+        keep = delta >= 0 if causal else jnp.ones_like(delta, bool)
+        if window is not None:
+            keep = keep & (delta < window)
+        bias = jnp.where(keep[None, None], bias, -1e30)
     if mask is not None:
         bias = bias + jnp.where(mask[:, None, None, :].astype(bool), 0.0, -1e30)
     probs = jax.nn.softmax(scores + bias, axis=-1).astype(q.dtype)
@@ -109,7 +116,7 @@ def flash_attention(q, k, v, *, causal=True, mask=None):
     return jnp.swapaxes(out, 1, 2)
 
 
-def cached_attention(q, k_cache, v_cache, *, q_positions, kv_mask=None):
+def cached_attention(q, k_cache, v_cache, *, q_positions, kv_mask=None, window=None):
     """Attention of a query chunk against a pre-allocated KV cache (decode path).
 
     q: (B, S, H, D); k_cache/v_cache: (B, K, Hkv, D) with H = G·Hkv (GQA).
@@ -130,8 +137,11 @@ def cached_attention(q, k_cache, v_cache, *, q_positions, kv_mask=None):
     scores = jnp.einsum("bshgd,bkhd->bhgsk", qg, k_cache).astype(jnp.float32) * scale
     if q_positions.ndim == 1:
         q_positions = jnp.broadcast_to(q_positions[None], (B, S))
-    causal = q_positions[:, None, None, :, None] >= jnp.arange(K)[None, None, None, None, :]
-    bias = jnp.where(causal, 0.0, -1e30)
+    delta = q_positions[:, None, None, :, None] - jnp.arange(K)[None, None, None, None, :]
+    keep = delta >= 0
+    if window is not None:  # sliding-window decode: only the last `window` slots
+        keep = keep & (delta < window)
+    bias = jnp.where(keep, 0.0, -1e30)
     if kv_mask is not None:
         bias = bias + jnp.where(kv_mask[:, None, None, None, :].astype(bool), 0.0, -1e30)
     probs = jax.nn.softmax(scores + bias, axis=-1).astype(q.dtype)
@@ -139,8 +149,17 @@ def cached_attention(q, k_cache, v_cache, *, q_positions, kv_mask=None):
     return out.reshape(B, S, H, D)
 
 
-def attention(q, k, v, *, causal=True, mask=None, impl: str = "auto", mesh=None):
-    """Unified entry used by the model zoo. ``impl``: auto|dense|flash|ring."""
+def attention(q, k, v, *, causal=True, mask=None, impl: str = "auto", mesh=None, window=None):
+    """Unified entry used by the model zoo. ``impl``: auto|dense|flash|ring|ulysses.
+    ``window`` (sliding-window attention) is dense-only: the flash kernel and
+    the sequence-parallel paths fall back to dense when it is set."""
+    if window is not None:
+        if impl not in ("auto", "dense"):
+            raise ValueError(
+                f"sliding-window attention is dense-only; impl={impl!r} cannot "
+                "apply a window (drop the window or use impl='dense'/'auto')."
+            )
+        return dense_attention(q, k, v, causal=causal, mask=mask, window=window)
     if impl == "auto":
         impl = (
             "flash"

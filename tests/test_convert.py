@@ -431,16 +431,16 @@ def test_mixtral_converted_model_trains(hf_mixtral):
     assert losses[-1] < losses[0]
 
 
-def test_mixtral_sliding_window_rejected():
+def test_mixtral_sliding_window_carried():
     from accelerate_tpu.models.convert import mixtral_config_from_hf
 
-    with pytest.raises(ValueError, match="sliding_window"):
-        mixtral_config_from_hf({
-            "vocab_size": 128, "hidden_size": 64, "intermediate_size": 96,
-            "num_hidden_layers": 2, "num_attention_heads": 4,
-            "num_local_experts": 4, "num_experts_per_tok": 2,
-            "max_position_embeddings": 4096, "sliding_window": 1024,
-        })
+    cfg = mixtral_config_from_hf({
+        "vocab_size": 128, "hidden_size": 64, "intermediate_size": 96,
+        "num_hidden_layers": 2, "num_attention_heads": 4,
+        "num_local_experts": 4, "num_experts_per_tok": 2,
+        "max_position_embeddings": 4096, "sliding_window": 1024,
+    })
+    assert cfg.sliding_window == 1024
 
 
 def test_mixtral_zero_aux_coef_preserved():
@@ -592,3 +592,78 @@ def test_qwen2_generate_matches_hf_greedy():
         theirs = hf.generate(torch.tensor(prompt, dtype=torch.long), max_new_tokens=6,
                              eos_token_id=None, do_sample=False, pad_token_id=0)
     np.testing.assert_array_equal(np.asarray(ours)[0], theirs[0].numpy())
+
+
+def test_mistral_sliding_window_logits_match_hf():
+    """Sliding-window attention parity: a window smaller than the sequence
+    forces the windowed mask path to actually matter."""
+    from accelerate_tpu.models.convert import from_hf
+
+    cfg = transformers.MistralConfig(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=64,
+        sliding_window=8,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(10)
+    hf = transformers.MistralForCausalLM(cfg).eval()
+    model, params = from_hf(hf)
+    assert model.config.sliding_window == 8
+    ids = np.random.default_rng(18).integers(0, 128, (2, 24)).astype(np.int32)
+    ours = model.apply(params, input_ids=ids)["logits"]
+    with torch.no_grad():
+        theirs = hf(torch.tensor(ids, dtype=torch.long)).logits
+    _logits_close(ours, theirs, atol=3e-4)
+
+
+def test_mistral_windowed_generate_matches_hf():
+    import jax.numpy as jnp
+
+    from accelerate_tpu.generation import generate
+    from accelerate_tpu.models.convert import from_hf
+
+    cfg = transformers.MistralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=64,
+        sliding_window=6, attn_implementation="eager",
+    )
+    torch.manual_seed(11)
+    hf = transformers.MistralForCausalLM(cfg).eval()
+    model, params = from_hf(hf)
+    prompt = np.random.default_rng(19).integers(0, 128, (1, 10)).astype(np.int32)
+    ours = generate(model, prompt, max_new_tokens=8, temperature=0.0,
+                    cache_dtype=jnp.float32)
+    with torch.no_grad():
+        theirs = hf.generate(torch.tensor(prompt, dtype=torch.long), max_new_tokens=8,
+                             eos_token_id=None, do_sample=False, pad_token_id=0)
+    np.testing.assert_array_equal(np.asarray(ours)[0], theirs[0].numpy())
+
+
+def test_qwen2_mixed_window_layers_rejected():
+    from accelerate_tpu.models.convert import qwen2_config_from_hf
+
+    base = dict(vocab_size=128, hidden_size=64, intermediate_size=128,
+                num_hidden_layers=8, num_attention_heads=4, num_key_value_heads=2)
+    with pytest.raises(ValueError, match="max_window_layers"):
+        qwen2_config_from_hf({**base, "use_sliding_window": True,
+                              "sliding_window": 16, "max_window_layers": 4})
+    # Uniform cases map cleanly: no layer windowed / all layers windowed.
+    cfg = qwen2_config_from_hf({**base, "use_sliding_window": True,
+                                "sliding_window": 16, "max_window_layers": 8})
+    assert cfg.sliding_window is None
+    cfg = qwen2_config_from_hf({**base, "use_sliding_window": True,
+                                "sliding_window": 16, "max_window_layers": 0})
+    assert cfg.sliding_window == 16
+
+
+def test_window_with_explicit_kernel_impl_raises():
+    from accelerate_tpu.ops.attention import attention
+
+    q = np.zeros((1, 8, 2, 4), np.float32)
+    with pytest.raises(ValueError, match="dense-only"):
+        attention(q, q, q, impl="flash", window=4)
