@@ -328,13 +328,17 @@ def grad_sync_check(accelerator):
             num_steps=2, sync_with_dataloader=False
         )
     )
-    ds = RegressionDataset(length=32, seed=3)
+    # Batches must split over the mesh's combined data axes (multi-process
+    # runs multiply the degree by the per-process device count).
+    n_data = acc.mesh.shape["dp"] * acc.mesh.shape["fsdp"]
+    bs = max(8, n_data)
+    ds = RegressionDataset(length=4 * bs, seed=3)
     model = RegressionModel()
     model.init_params(jax.random.key(7))
     pmodel, popt = acc.prepare(model, optax.sgd(0.1))
 
     flags = []
-    for batch in regression_batches(ds, batch_size=8):
+    for batch in regression_batches(ds, batch_size=bs):
         with acc.accumulate(pmodel):
             flags.append(acc.sync_gradients)
             out = pmodel(**batch)
@@ -356,7 +360,7 @@ def grad_sync_check(accelerator):
     model2 = RegressionModel()
     model2.init_params(jax.random.key(7))
     pmodel2, popt2 = acc2.prepare(model2, optax.sgd(0.1))
-    for batch in regression_batches(ds, batch_size=16):
+    for batch in regression_batches(ds, batch_size=2 * bs):
         out = pmodel2(**batch)
         acc2.backward(out["loss"])
         popt2.step()
