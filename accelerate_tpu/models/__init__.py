@@ -13,7 +13,8 @@ def __getattr__(name):
                 "bert_config_from_hf", "bert_params_from_hf",
                 "t5_config_from_hf", "t5_params_from_hf",
                 "mixtral_config_from_hf", "mixtral_params_from_hf",
-                "qwen2_config_from_hf", "qwen2_params_from_hf"):
+                "qwen2_config_from_hf", "qwen2_params_from_hf",
+                "gemma_config_from_hf", "gemma_params_from_hf"):
         from . import convert
 
         return getattr(convert, name)

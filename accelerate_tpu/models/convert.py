@@ -102,12 +102,8 @@ def llama_config_from_hf(hf_config) -> LlamaConfig:
             )
     if get("mlp_bias"):
         raise ValueError("mlp_bias checkpoints are not supported (zoo Llama's FFN is bias-free)")
-    explicit_hd = get("head_dim")
-    if explicit_hd and explicit_hd != get("hidden_size") // get("num_attention_heads"):
-        raise ValueError(
-            f"decoupled head_dim={explicit_hd} != hidden/heads is not supported by the zoo Llama"
-        )
     return LlamaConfig(
+        head_dim=get("head_dim"),
         vocab_size=get("vocab_size"),
         hidden_size=get("hidden_size"),
         intermediate_size=get("intermediate_size"),
@@ -167,6 +163,41 @@ def llama_params_from_hf(state_dict, config: LlamaConfig, dtype=jnp.float32) -> 
         "w_up": _stack(sd, "layers.{i}.mlp.up_proj.weight", L, transpose=True, dtype=dtype),
         "w_down": _stack(sd, "layers.{i}.mlp.down_proj.weight", L, transpose=True, dtype=dtype),
     }
+    return params
+
+
+# --------------------------------------------------------------------- gemma
+def gemma_config_from_hf(hf_config) -> LlamaConfig:
+    """Gemma = the Llama skeleton with GeGLU FFN, sqrt(hidden)-scaled
+    embeddings, decoupled head_dim, and (1 + weight) RMSNorm — the norm offset
+    is baked into the stored weights at conversion (rms_norm is linear in its
+    scale), so only the first three need config knobs."""
+    get = _getter(hf_config)
+    # GemmaMLP reads hidden_activation (defaulting to tanh-gelu) and ignores
+    # hidden_act; mirror that precedence and only accept the activation the
+    # zoo reproduces exactly.
+    act = get("hidden_activation") or "gelu_pytorch_tanh"
+    if act != "gelu_pytorch_tanh":
+        raise ValueError(
+            f"hidden_activation={act!r} is not supported for Gemma (tanh-gelu only)"
+        )
+    cfg = llama_config_from_hf(hf_config)
+    import dataclasses
+
+    return dataclasses.replace(
+        cfg,
+        hidden_act="gelu_tanh",
+        embedding_multiplier=float(get("hidden_size")) ** 0.5,
+        tie_word_embeddings=True,  # Gemma always ties
+    )
+
+
+def gemma_params_from_hf(state_dict, config: LlamaConfig, dtype=jnp.float32) -> dict:
+    params = llama_params_from_hf(state_dict, config, dtype=dtype)
+    # Gemma's RMSNorm computes x * (1 + weight): fold the offset in once.
+    for tree in (params["layers"]["input_norm"], params["layers"]["post_attn_norm"],
+                 params["final_norm"]):
+        tree["weight"] = tree["weight"] + 1.0
     return params
 
 
@@ -525,6 +556,7 @@ _CONVERTERS = {
     # Mistral is the Llama recipe + sliding-window attention; the generalized
     # Llama converter handles both (sliding_window flows from the config).
     "mistral": (Llama, llama_config_from_hf, llama_params_from_hf),
+    "gemma": (Llama, gemma_config_from_hf, gemma_params_from_hf),
 }
 
 

@@ -62,10 +62,21 @@ class LlamaConfig:
     # rope_type 'linear' (positions/factor) or 'llama3' (frequency-banded
     # scaling, the Llama-3.1 recipe). Matches the HF config field.
     rope_scaling: dict | None = None
+    # Per-head width; None = hidden/heads. Gemma decouples it (e.g. 2048/8
+    # hidden/heads with 256-wide heads).
+    head_dim: int | None = None
+    # FFN activation: 'silu' (SwiGLU, the Llama recipe) or 'gelu_tanh'
+    # (GeGLU, the Gemma recipe).
+    hidden_act: str = "silu"
+    # Embedding-lookup scale (Gemma multiplies by sqrt(hidden)); the tied LM
+    # head is NOT scaled, so this cannot be baked into the table.
+    embedding_multiplier: float = 1.0
 
-    @property
-    def head_dim(self) -> int:
-        return self.hidden_size // self.num_attention_heads
+    def __post_init__(self):
+        if self.head_dim is None:
+            self.head_dim = self.hidden_size // self.num_attention_heads
+        if self.hidden_act not in ("silu", "gelu_tanh"):
+            raise ValueError(f"hidden_act must be silu|gelu_tanh, got {self.hidden_act!r}")
 
     @classmethod
     def tiny(cls, **kw):
@@ -247,6 +258,9 @@ class Llama(Module):
         B, S = input_ids.shape
         x = jnp.take(params["embed"]["weight"], input_ids, axis=0)
         x = x.astype(params["embed"]["weight"].dtype)
+        if cfg.embedding_multiplier != 1.0:
+            # Gemma scales the lookup only — the tied head stays unscaled.
+            x = x * jnp.asarray(cfg.embedding_multiplier, x.dtype)
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (B, S))
         cos, sin = rope_tables(positions, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
@@ -315,7 +329,12 @@ class Llama(Module):
         """SwiGLU FFN on the normed residual. The MoE variant overrides this and
         sows its router aux loss into ``ctx`` (per-call dict, so no state leaks
         across traces)."""
-        gated = jax.nn.silu(self._mm(h2, layer["mlp"]["w_gate"])) * self._mm(h2, layer["mlp"]["w_up"])
+        act = (
+            jax.nn.silu
+            if self.config.hidden_act == "silu"
+            else lambda x: jax.nn.gelu(x, approximate=True)
+        )
+        gated = act(self._mm(h2, layer["mlp"]["w_gate"])) * self._mm(h2, layer["mlp"]["w_up"])
         return self._mm(gated, layer["mlp"]["w_down"])
 
     def _mm(self, a, b):

@@ -179,8 +179,8 @@ def test_unsupported_llama_features_raise():
         llama_config_from_hf({**base, "mlp_bias": True})
     # attention_bias is now supported (the Qwen2 recipe), not rejected.
     assert llama_config_from_hf({**base, "attention_bias": True}).attention_bias is True
-    with pytest.raises(ValueError, match="head_dim"):
-        llama_config_from_hf({**base, "head_dim": 32})
+    # Decoupled head_dim is now a supported field (the Gemma recipe).
+    assert llama_config_from_hf({**base, "head_dim": 32}).head_dim == 32
 
 
 @pytest.fixture(scope="module")
@@ -667,3 +667,66 @@ def test_window_with_explicit_kernel_impl_raises():
     q = np.zeros((1, 8, 2, 4), np.float32)
     with pytest.raises(ValueError, match="dense-only"):
         attention(q, q, q, impl="flash", window=4)
+
+
+def test_gemma_logits_match_hf():
+    """Gemma: decoupled head_dim, GeGLU, scaled embeddings, +1 norm offset."""
+    from accelerate_tpu.models.convert import from_hf
+
+    cfg = transformers.GemmaConfig(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        head_dim=32,  # decoupled: != 64/4
+        max_position_embeddings=64,
+        hidden_act="gelu_pytorch_tanh",
+        attn_implementation="eager",
+    )
+    torch.manual_seed(12)
+    hf = transformers.GemmaForCausalLM(cfg).eval()
+    model, params = from_hf(hf)
+    assert model.config.head_dim == 32
+    assert model.config.hidden_act == "gelu_tanh"
+    ids = np.random.default_rng(20).integers(0, 128, (2, 16)).astype(np.int32)
+    ours = model.apply(params, input_ids=ids)["logits"]
+    with torch.no_grad():
+        theirs = hf(torch.tensor(ids, dtype=torch.long)).logits
+    _logits_close(ours, theirs, atol=5e-4)
+
+
+def test_gemma_generate_matches_hf_greedy():
+    import jax.numpy as jnp
+
+    from accelerate_tpu.generation import generate
+    from accelerate_tpu.models.convert import from_hf
+
+    cfg = transformers.GemmaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=32,
+        max_position_embeddings=64, hidden_act="gelu_pytorch_tanh",
+        attn_implementation="eager",
+    )
+    torch.manual_seed(13)
+    hf = transformers.GemmaForCausalLM(cfg).eval()
+    model, params = from_hf(hf)
+    prompt = np.random.default_rng(21).integers(0, 128, (1, 6)).astype(np.int32)
+    ours = generate(model, prompt, max_new_tokens=6, temperature=0.0,
+                    cache_dtype=jnp.float32)
+    with torch.no_grad():
+        theirs = hf.generate(torch.tensor(prompt, dtype=torch.long), max_new_tokens=6,
+                             eos_token_id=None, do_sample=False, pad_token_id=0)
+    np.testing.assert_array_equal(np.asarray(ours)[0], theirs[0].numpy())
+
+
+def test_gemma_exact_gelu_rejected():
+    from accelerate_tpu.models.convert import gemma_config_from_hf
+
+    with pytest.raises(ValueError, match="hidden_activation"):
+        gemma_config_from_hf({
+            "vocab_size": 128, "hidden_size": 64, "intermediate_size": 128,
+            "num_hidden_layers": 2, "num_attention_heads": 4,
+            "hidden_activation": "gelu",
+        })
