@@ -955,9 +955,17 @@ class Accelerator:
         self._custom_objects.extend(objects)
 
     def save_state(self, output_dir: str | None = None, **save_model_func_kwargs):
+        """``blocking=False`` queues the array writes in the background and
+        returns immediately (training continues while HBM drains to disk);
+        join with ``finish_pending_saves()`` or let ``load_state`` join."""
         from .checkpointing import save_accelerator_state
 
         return save_accelerator_state(self, output_dir, **save_model_func_kwargs)
+
+    def finish_pending_saves(self):
+        from .checkpointing import finish_pending_saves
+
+        finish_pending_saves()
 
     def load_state(self, input_dir: str | None = None, **load_model_func_kwargs):
         from .checkpointing import load_accelerator_state

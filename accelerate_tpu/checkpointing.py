@@ -46,10 +46,33 @@ from .utils.constants import (
 logger = get_logger(__name__)
 
 
+_PENDING_SAVES: list = []
+
+
 def _checkpointer():
     import orbax.checkpoint as ocp
 
     return ocp.StandardCheckpointer()
+
+
+def _queue_save(path, tree):
+    """One checkpointer per item: orbax serializes saves on a single instance
+    (each .save joins the previous), so overlapping the model AND optimizer
+    writes with training requires separate instances, all joined by
+    :func:`finish_pending_saves`."""
+    ck = _checkpointer()
+    ck.save(path, tree)
+    _PENDING_SAVES.append(ck)
+
+
+def finish_pending_saves():
+    """Block until every queued (non-blocking) checkpoint write has committed.
+
+    Called automatically by ``load_accelerator_state`` and by the rotation
+    logic, so a resume can never read — nor rotation delete — a half-written
+    folder from this process."""
+    while _PENDING_SAVES:
+        _PENDING_SAVES.pop().wait_until_finished()
 
 
 def _flatten_params(params, prefix=""):
@@ -63,8 +86,16 @@ def _flatten_params(params, prefix=""):
     return flat
 
 
-def save_accelerator_state(accelerator, output_dir: str | None = None, safe_serialization: bool = True):
-    """Save everything (reference ``save_accelerator_state`` :61 + driver :3260)."""
+def save_accelerator_state(accelerator, output_dir: str | None = None, safe_serialization: bool = True,
+                           blocking: bool = True):
+    """Save everything (reference ``save_accelerator_state`` :61 + driver :3260).
+
+    ``blocking=False`` queues the sharded array writes on orbax's background
+    thread and returns as soon as the host-side state is down — training
+    continues while HBM drains to disk (orbax snapshots the arrays at call
+    time, so subsequent optimizer steps don't corrupt the checkpoint). Join
+    explicitly with :func:`finish_pending_saves`; ``load_accelerator_state``
+    joins automatically."""
     project = accelerator.project_configuration
     if output_dir is None:
         if project.automatic_checkpoint_naming:
@@ -82,7 +113,10 @@ def save_accelerator_state(accelerator, output_dir: str | None = None, safe_seri
             and len(folders) + 1 > project.total_limit
             and accelerator.is_main_process
         ):
-            # Rotation: drop oldest (reference :3301-3323).
+            # Rotation: drop oldest (reference :3301-3323). Join queued saves
+            # first — rmtree under an in-flight write destroys the checkpoint
+            # and poisons the writer with a deferred ENOENT.
+            finish_pending_saves()
             folders.sort(key=lambda f: int(f.rsplit("_", 1)[-1]))
             for stale in folders[: len(folders) + 1 - project.total_limit]:
                 shutil.rmtree(os.path.join(output_dir, stale), ignore_errors=True)
@@ -94,22 +128,22 @@ def save_accelerator_state(accelerator, output_dir: str | None = None, safe_seri
         os.makedirs(output_dir, exist_ok=True)
     accelerator.wait_for_everyone()
 
-    ckptr = _checkpointer()
     # Sharded model params, one dir per model.
     for i, model in enumerate(accelerator._models):
         suffix = "" if i == 0 else f"_{i}"
-        ckptr.save(os.path.join(output_dir, f"{MODEL_NAME}{suffix}"), model.handle.params)
+        _queue_save(os.path.join(output_dir, f"{MODEL_NAME}{suffix}"), model.handle.params)
     # Sharded optimizer state.
     for i, opt in enumerate(accelerator._optimizers):
         suffix = "" if i == 0 else f"_{i}"
         if opt.opt_state is not None:
-            ckptr.save(os.path.join(output_dir, f"{OPTIMIZER_NAME}{suffix}"), opt.opt_state)
+            _queue_save(os.path.join(output_dir, f"{OPTIMIZER_NAME}{suffix}"), opt.opt_state)
         _host_pickle(
             os.path.join(output_dir, f"{OPTIMIZER_NAME}{suffix}.meta.pkl"),
             {"step_count": opt._step_count, "scale": opt.scaler.scale if opt.scaler else None},
             accelerator,
         )
-    ckptr.wait_until_finished()
+    if blocking:
+        finish_pending_saves()
     # Schedulers / samplers / dataloaders / custom objects: host-side pickles.
     for i, sched in enumerate(accelerator._schedulers):
         suffix = "" if i == 0 else f"_{i}"
@@ -144,6 +178,7 @@ def _host_pickle(path, obj, accelerator, all_processes: bool = False):
 
 def load_accelerator_state(accelerator, input_dir: str | None = None, **kwargs):
     """Reference ``load_accelerator_state`` :179 + driver :3426."""
+    finish_pending_saves()  # never resume from a checkpoint still being written
     project = accelerator.project_configuration
     if input_dir is None:
         if not project.automatic_checkpoint_naming:

@@ -80,7 +80,7 @@ def _get_converter(model_type):
 
 
 # --------------------------------------------------------------------- llama
-def llama_config_from_hf(hf_config) -> LlamaConfig:
+def llama_config_from_hf(hf_config, check_act: bool = True) -> LlamaConfig:
     """Map a ``transformers.LlamaConfig`` (attributes or dict) onto the zoo config.
 
     Raises on config features the zoo model does not implement (unsupported
@@ -102,6 +102,13 @@ def llama_config_from_hf(hf_config) -> LlamaConfig:
             )
     if get("mlp_bias"):
         raise ValueError("mlp_bias checkpoints are not supported (zoo Llama's FFN is bias-free)")
+    if check_act:
+        act = get("hidden_act") or "silu"
+        if act != "silu":
+            raise ValueError(
+                f"hidden_act={act!r} is not supported for llama-type checkpoints "
+                "(the zoo converts SwiGLU here; Gemma's GeGLU has its own converter)"
+            )
     return LlamaConfig(
         head_dim=get("head_dim"),
         vocab_size=get("vocab_size"),
@@ -181,7 +188,7 @@ def gemma_config_from_hf(hf_config) -> LlamaConfig:
         raise ValueError(
             f"hidden_activation={act!r} is not supported for Gemma (tanh-gelu only)"
         )
-    cfg = llama_config_from_hf(hf_config)
+    cfg = llama_config_from_hf(hf_config, check_act=False)  # Gemma validated above
     import dataclasses
 
     return dataclasses.replace(

@@ -177,3 +177,50 @@ def test_skip_first_batches_resume_via_state_dict():
     resumed = accelerator.skip_first_batches(pdl, sd["num_batches_fetched"])
     remaining = list(resumed)
     assert len(remaining) == 2
+
+
+def test_nonblocking_save_roundtrip(tmp_path):
+    """blocking=False returns before the array writes commit; a later
+    finish_pending_saves (or load_state) joins them and the checkpoint is
+    complete and loadable with the values from save time."""
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.checkpointing import finish_pending_saves
+    from accelerate_tpu.test_utils import RegressionDataset, RegressionModel, regression_batches
+
+    accelerator = Accelerator()
+    model = RegressionModel()
+    model.init_params(jax.random.key(0))
+    dl = regression_batches(RegressionDataset(length=32), batch_size=8)
+    pmodel, popt, pdl = accelerator.prepare(model, optax.sgd(0.1), dl)
+    for batch in pdl:
+        out = pmodel(**batch)
+        accelerator.backward(out.loss)
+        popt.step()
+        popt.zero_grad()
+    saved_a = float(accelerator.get_state_dict(pmodel)["a"])
+
+    out_dir = str(tmp_path / "ckpt")
+    accelerator.save_state(out_dir, blocking=False)
+    # Keep training AFTER the queued save: the checkpoint must hold the
+    # save-time values, not these later updates.
+    for batch in pdl:
+        out = pmodel(**batch)
+        accelerator.backward(out.loss)
+        popt.step()
+        popt.zero_grad()
+    finish_pending_saves()
+
+    from accelerate_tpu.state import AcceleratorState, GradientState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    acc2 = Accelerator()
+    model2 = RegressionModel()
+    model2.init_params(jax.random.key(1))
+    pmodel2, popt2, _ = acc2.prepare(model2, optax.sgd(0.1), dl)
+    acc2.load_state(out_dir)
+    np.testing.assert_allclose(
+        float(acc2.get_state_dict(pmodel2)["a"]), saved_a, rtol=1e-6
+    )
