@@ -290,6 +290,11 @@ class Accelerator:
         self.rng_types = rng_types or ["generator"]
 
         self.scaler = GradScalerState() if self.state.mixed_precision == "fp16" else None
+        # FSDP plugin cpu_offload: optimizer state parks in host RAM (the
+        # ZeRO-Offload trade — HBM for step latency). Applies to the imperative
+        # optimizer path; the fused build_train_step keeps state device-resident
+        # by design (donated buffers, zero host round-trips).
+        self._offload_opt_state = bool(fsdp_plugin.cpu_offload) if fsdp_plugin is not None else False
         self.step = 0
         self.flag_tensor = None
         self._models: list[PreparedModel] = []
@@ -471,7 +476,9 @@ class Accelerator:
                 prepared = self.prepare_model(obj)
                 prepared_model = prepared
             elif kind == "optimizer":
-                prepared = AcceleratedOptimizer(obj, scaler=self.scaler)
+                prepared = AcceleratedOptimizer(
+                    obj, scaler=self.scaler, host_offload=self._offload_opt_state
+                )
                 prepared_opts.append(prepared)
                 self._optimizers.append(prepared)
             elif kind == "dataloader":
@@ -613,7 +620,9 @@ class Accelerator:
         return prepared
 
     def prepare_optimizer(self, optimizer, device_placement=None):
-        prepared = AcceleratedOptimizer(optimizer, scaler=self.scaler)
+        prepared = AcceleratedOptimizer(
+            optimizer, scaler=self.scaler, host_offload=self._offload_opt_state
+        )
         if self._models:
             prepared.handle = self._models[-1].handle
         self._optimizers.append(prepared)

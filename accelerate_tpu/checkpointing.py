@@ -72,7 +72,9 @@ def finish_pending_saves():
     logic, so a resume can never read — nor rotation delete — a half-written
     folder from this process."""
     while _PENDING_SAVES:
-        _PENDING_SAVES.pop().wait_until_finished()
+        ck = _PENDING_SAVES.pop()
+        ck.wait_until_finished()
+        ck.close()  # release the background writer thread/resources
 
 
 def _flatten_params(params, prefix=""):
@@ -108,18 +110,17 @@ def save_accelerator_state(accelerator, output_dir: str | None = None, safe_seri
             f for f in (os.listdir(output_dir) if os.path.isdir(output_dir) else [])
             if f.startswith(f"{CHECKPOINT_DIR_PREFIX}_")
         ]
-        if (
-            project.total_limit is not None
-            and len(folders) + 1 > project.total_limit
-            and accelerator.is_main_process
-        ):
-            # Rotation: drop oldest (reference :3301-3323). Join queued saves
-            # first — rmtree under an in-flight write destroys the checkpoint
-            # and poisons the writer with a deferred ENOENT.
+        if project.total_limit is not None and len(folders) + 1 > project.total_limit:
+            # Rotation: drop oldest (reference :3301-3323). EVERY process joins
+            # its own queued writers and all rendezvous before rank 0 deletes —
+            # rmtree under any host's in-flight write destroys the checkpoint
+            # and poisons that writer with a deferred ENOENT.
             finish_pending_saves()
-            folders.sort(key=lambda f: int(f.rsplit("_", 1)[-1]))
-            for stale in folders[: len(folders) + 1 - project.total_limit]:
-                shutil.rmtree(os.path.join(output_dir, stale), ignore_errors=True)
+            accelerator.wait_for_everyone()
+            if accelerator.is_main_process:
+                folders.sort(key=lambda f: int(f.rsplit("_", 1)[-1]))
+                for stale in folders[: len(folders) + 1 - project.total_limit]:
+                    shutil.rmtree(os.path.join(output_dir, stale), ignore_errors=True)
         output_dir = os.path.join(output_dir, f"{CHECKPOINT_DIR_PREFIX}_{project.iteration}")
         if os.path.isdir(output_dir):
             raise ValueError(f"Checkpoint directory {output_dir} already exists.")

@@ -75,7 +75,8 @@ class GradScalerState:
 class AcceleratedOptimizer:
     """Wraps ``optax.GradientTransformation``. Constructed by ``Accelerator.prepare``."""
 
-    def __init__(self, tx, handle=None, scaler: GradScalerState | None = None):
+    def __init__(self, tx, handle=None, scaler: GradScalerState | None = None,
+                 host_offload: bool = False):
         import optax
 
         if not isinstance(tx, optax.GradientTransformation):
@@ -83,6 +84,10 @@ class AcceleratedOptimizer:
         self.tx = tx
         self.handle = handle  # TrainHandle: .params, .param_shardings, .mesh
         self.scaler = scaler
+        # ZeRO-Offload analog (FullyShardedDataParallelPlugin.cpu_offload):
+        # optimizer state parks in host RAM between steps and rides through the
+        # device only transiently inside step() — HBM holds params + grads only.
+        self.host_offload = host_offload
         self.gradient_state = GradientState()
         self.accelerator_state = AcceleratorState()
         self.opt_state = None
@@ -116,6 +121,8 @@ class AcceleratedOptimizer:
             )
             self.opt_state = jax.jit(self.tx.init, out_shardings=opt_shardings)(params)
             self.opt_shardings = opt_shardings
+            if self.host_offload:
+                self.opt_state = self._to_host(self.opt_state)
 
     def _build_update_fn(self):
         import optax
@@ -176,11 +183,15 @@ class AcceleratedOptimizer:
             self._update_fn = self._build_update_fn()
         inv_scale = 1.0 / self.scaler.scale if self.scaler is not None else 1.0
         clip = self._pending_clip_norm if self._pending_clip_norm is not None else -1.0
+        if self.host_offload:
+            # Host → mesh with the proper shardings; jit refuses to mix a
+            # single-device host tree with mesh-sharded params implicitly.
+            self.opt_state = jax.device_put(self.opt_state, self.opt_shardings)
         new_params, new_opt, gnorm, finite = self._update_fn(
             self.handle.params, self.opt_state, self._accum_grads, jnp.float32(clip), jnp.float32(inv_scale)
         )
         self.handle.params = new_params
-        self.opt_state = new_opt
+        self.opt_state = self._to_host(new_opt) if self.host_offload else new_opt
         self._accum_grads = None
         self._pending_clip_norm = None
         self.handle.last_grad_norm = gnorm
@@ -192,6 +203,26 @@ class AcceleratedOptimizer:
             self._step_was_skipped = False
         if not self._step_was_skipped:
             self._step_count += 1
+
+    def _to_host(self, tree):
+        """Move the optimizer state to host memory (async device→host DMA); the
+        next step's device_put brings it back with its mesh shardings.
+
+        Preferred mechanism: keep the NamedSharding and switch the memory kind
+        to pinned_host — each host keeps only its own shards (works on
+        multi-host meshes, preserves the ZeRO-style partitioning). Backends
+        without memory kinds (the CPU test platform) fall back to a
+        single-local-device gather."""
+
+        def move(x):
+            if not isinstance(x, jax.Array):
+                return x
+            try:
+                return jax.device_put(x, x.sharding.with_memory_kind("pinned_host"))
+            except Exception:
+                return jax.device_put(x, jax.local_devices(backend="cpu")[0])
+
+        return jax.tree_util.tree_map(move, tree)
 
     @property
     def step_was_skipped(self) -> bool:
