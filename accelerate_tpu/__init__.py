@@ -75,6 +75,10 @@ def __getattr__(name):
         from . import generation
 
         return getattr(generation, name)
+    if name in ("from_hf", "from_hf_checkpoint"):
+        from .models import convert
+
+        return getattr(convert, name)
     if name in ("GPTTrainStep", "BertTrainStep", "T5TrainStep", "get_train_step"):
         from . import train_steps
 

@@ -91,6 +91,8 @@ class AcceleratedOptimizer:
         self.gradient_state = GradientState()
         self.accelerator_state = AcceleratorState()
         self.opt_state = None
+        self.opt_shardings = None
+        self._host_mode = None  # 'pinned' | 'gather', probed on first offload
         self._accum_grads = None
         self._pending_clip_norm = None
         self._step_was_skipped = False
@@ -98,29 +100,36 @@ class AcceleratedOptimizer:
         self._step_count = 0  # optimizer steps actually applied
 
     # ------------------------------------------------------------------ setup
+    def _plan_opt_shardings(self):
+        """Opt-state leaves that mirror a param shape inherit that param's
+        sharding (ZeRO-style sharded optimizer state under fsdp); scalars and
+        the rest replicate. This is the GSPMD answer to DeepSpeed's partitioned
+        optimizer (SURVEY.md §2.4 ZeRO row)."""
+        params = self.handle.params
+        shape_to_sharding = {}
+        for p, s in zip(
+            jax.tree_util.tree_leaves(params),
+            jax.tree_util.tree_leaves(self.handle.param_shardings),
+        ):
+            shape_to_sharding.setdefault(np.shape(p), s)
+
+        opt_shapes = jax.eval_shape(self.tx.init, params)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        replicated = NamedSharding(self.handle.mesh, P())
+        return jax.tree_util.tree_map(
+            lambda l: shape_to_sharding.get(tuple(l.shape), replicated), opt_shapes
+        )
+
     def _ensure_initialized(self):
+        if self.opt_shardings is None and self.handle is not None:
+            # Also covers opt_state arriving via load_state_dict: the sharding
+            # plan is derivable from the params regardless of who set the state.
+            self.opt_shardings = self._plan_opt_shardings()
         if self.opt_state is None:
-            params = self.handle.params
-            # Opt-state leaves that mirror a param shape inherit that param's
-            # sharding (ZeRO-style sharded optimizer state under fsdp); scalars and
-            # the rest replicate. This is the GSPMD answer to DeepSpeed's
-            # partitioned optimizer (SURVEY.md §2.4 ZeRO row).
-            shape_to_sharding = {}
-            for p, s in zip(
-                jax.tree_util.tree_leaves(params),
-                jax.tree_util.tree_leaves(self.handle.param_shardings),
-            ):
-                shape_to_sharding.setdefault(np.shape(p), s)
-
-            opt_shapes = jax.eval_shape(self.tx.init, params)
-            from jax.sharding import NamedSharding, PartitionSpec as P
-
-            replicated = NamedSharding(self.handle.mesh, P())
-            opt_shardings = jax.tree_util.tree_map(
-                lambda l: shape_to_sharding.get(tuple(l.shape), replicated), opt_shapes
+            self.opt_state = jax.jit(self.tx.init, out_shardings=self.opt_shardings)(
+                self.handle.params
             )
-            self.opt_state = jax.jit(self.tx.init, out_shardings=opt_shardings)(params)
-            self.opt_shardings = opt_shardings
             if self.host_offload:
                 self.opt_state = self._to_host(self.opt_state)
 
@@ -214,15 +223,31 @@ class AcceleratedOptimizer:
         without memory kinds (the CPU test platform) fall back to a
         single-local-device gather."""
 
-        def move(x):
-            if not isinstance(x, jax.Array):
-                return x
-            try:
-                return jax.device_put(x, x.sharding.with_memory_kind("pinned_host"))
-            except Exception:
-                return jax.device_put(x, jax.local_devices(backend="cpu")[0])
+        if self._host_mode is None:
+            # Probe memory-kind support ONCE (not per leaf per step, and so a
+            # later transient pinned-host failure surfaces instead of silently
+            # degrading to a gather that cannot work on multi-host meshes).
+            probe = next(
+                (x for x in jax.tree_util.tree_leaves(tree) if isinstance(x, jax.Array)), None
+            )
+            self._host_mode = "gather"
+            if probe is not None:
+                try:
+                    jax.device_put(probe, probe.sharding.with_memory_kind("pinned_host"))
+                    self._host_mode = "pinned"
+                except Exception:
+                    pass
 
-        return jax.tree_util.tree_map(move, tree)
+        if self._host_mode == "pinned":
+            return jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, x.sharding.with_memory_kind("pinned_host"))
+                if isinstance(x, jax.Array) else x,
+                tree,
+            )
+        cpu = jax.local_devices(backend="cpu")[0]
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, cpu) if isinstance(x, jax.Array) else x, tree
+        )
 
     @property
     def step_was_skipped(self) -> bool:

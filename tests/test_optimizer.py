@@ -225,3 +225,39 @@ def test_host_offloaded_optimizer_state_trains():
     params = accelerator.get_state_dict(pmodel)
     assert abs(float(params["a"]) - 2.0) < 0.3
     assert abs(float(params["b"]) - 3.0) < 0.3
+
+
+def test_offloaded_resume_via_load_state_dict():
+    """load_state_dict before any step must still step under host offload
+    (opt_shardings are derivable regardless of who populated the state)."""
+    from accelerate_tpu.utils.dataclasses import FullyShardedDataParallelPlugin
+
+    accelerator = Accelerator(
+        fsdp_plugin=FullyShardedDataParallelPlugin(fsdp_size=8, min_shard_size=0,
+                                                   cpu_offload=True)
+    )
+    model = RegressionModel()
+    model.init_params(jax.random.key(0))
+    pmodel, popt = accelerator.prepare(model, optax.adam(0.1))
+    batch = {"x": np.ones(8, np.float32), "y": np.ones(8, np.float32)}
+    out = pmodel(**batch)
+    accelerator.backward(out["loss"])
+    popt.step()
+    blob = popt.state_dict()
+
+    from accelerate_tpu.state import AcceleratorState, GradientState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    acc2 = Accelerator(
+        fsdp_plugin=FullyShardedDataParallelPlugin(fsdp_size=8, min_shard_size=0,
+                                                   cpu_offload=True)
+    )
+    model2 = RegressionModel()
+    model2.init_params(jax.random.key(0))
+    pmodel2, popt2 = acc2.prepare(model2, optax.adam(0.1))
+    popt2.load_state_dict(blob)  # state set externally, before any step
+    out = pmodel2(**batch)
+    acc2.backward(out["loss"])
+    popt2.step()  # must not raise
+    assert popt2._step_count == 2
