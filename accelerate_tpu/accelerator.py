@@ -86,13 +86,17 @@ class TrainHandle:
     *current* sharded params so ``optimizer.step()`` visibly updates what
     ``model(...)`` uses next — the stateful shim over the functional core."""
 
-    def __init__(self, module: Module, params, param_shardings, mesh, compute_dtype, rng):
+    def __init__(self, module: Module, params, param_shardings, mesh, compute_dtype, rng,
+                 pipeline_spec=None):
         self.module = module
         self.params = params
         self.param_shardings = param_shardings
         self.mesh = mesh
         self.compute_dtype = compute_dtype
         self.rng = rng
+        # GPipe schedule over the pp axis (parallel/pipeline.py); None = the
+        # GSPMD layer-dim sharding fallback (or no pp axis at all).
+        self.pipeline_spec = pipeline_spec
         self.step_counter = 0
         self.last_grad_norm = None
         self.pending = None  # (loss jax.Array, grads pytree) from last train forward
@@ -146,9 +150,10 @@ class PreparedModel:
         module = self.handle.module
         cast = self._cast
         extract = extract or self.loss_fn
+        pipe = {"pipeline": self.handle.pipeline_spec} if self.handle.pipeline_spec is not None else {}
 
         def loss_of(params, batch, rng):
-            outputs = module.apply(cast(params), train=True, rngs={"dropout": rng}, **batch)
+            outputs = module.apply(cast(params), train=True, rngs={"dropout": rng}, **pipe, **batch)
             return extract(outputs, batch)
 
         return loss_of
@@ -166,13 +171,17 @@ class PreparedModel:
         module = self.handle.module
         loss_fn = self.loss_fn
         cast = self._cast
+        # Training forwards route through the GPipe schedule when one resolved;
+        # eval keeps the GSPMD path (eval batch sizes need not divide the
+        # microbatch grid, and eval throughput is not pipeline-bound).
+        pipe = {"pipeline": self.handle.pipeline_spec} if self.handle.pipeline_spec is not None else {}
 
         def fwd(params, args, kwargs, rng):
             return module.apply(cast(params), *args, train=False, rngs=None, **kwargs)
 
         def loss_and_out(params, args, kwargs, rng, loss_scale):
             outputs = module.apply(
-                cast(params), *args, train=True, rngs={"dropout": rng}, **kwargs
+                cast(params), *args, train=True, rngs={"dropout": rng}, **pipe, **kwargs
             )
             loss = loss_fn(outputs, kwargs if kwargs else args)
             return loss * loss_scale, outputs
@@ -268,6 +277,7 @@ class Accelerator:
             )
         self.fsdp_plugin = fsdp_plugin
         self.sp_plugin = sp_plugin
+        self.pp_plugin = pp_plugin
         self.state = AcceleratorState(
             mixed_precision=mixed_precision, cpu=cpu, parallelism_config=parallelism_config
         )
@@ -585,7 +595,25 @@ class Accelerator:
         compute_dtype = self.state.compute_dtype
         if self.autocast_handler is not None and not self.autocast_handler.enabled:
             compute_dtype = jnp.float32
-        handle = TrainHandle(module, params, shardings, self.mesh, compute_dtype, rng)
+        # Pipeline-parallel training: with a pp axis and a stage-protocol model,
+        # swap the GSPMD layer-dim sharding (which all-gathers stage weights)
+        # for the GPipe schedule with stationary weights + ppermuted activations.
+        from .parallel.pipeline import resolve_pipeline_spec
+
+        mbs = self.pp_plugin.num_microbatches if self.pp_plugin is not None else 0
+        if mbs <= 0:
+            env_mbs = os.environ.get("ACCELERATE_PP_MICROBATCHES", "").strip()
+            try:
+                mbs = int(env_mbs) if env_mbs else 0
+            except ValueError:
+                raise ValueError(
+                    f"ACCELERATE_PP_MICROBATCHES={env_mbs!r} is not an integer"
+                ) from None
+        pipeline_spec = resolve_pipeline_spec(module, params, self.mesh, mbs)
+        handle = TrainHandle(
+            module, params, shardings, self.mesh, compute_dtype, rng,
+            pipeline_spec=pipeline_spec,
+        )
         prepared = PreparedModel(handle, self, loss_fn=self._loss_fn)
         prepared.train(not evaluation_mode)
         self._models.append(prepared)

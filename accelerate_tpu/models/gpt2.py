@@ -64,6 +64,11 @@ class GPT2Config:
 
 
 class GPT2(Module):
+    # embed/block/head stage protocol — GPipe-eligible (parallel/pipeline.py).
+    # (No scan_aux_keys: the GPT-2 block sows nothing; models that do must also
+    # collect aux in their scan path as Llama does.)
+    pipeline_capable = True
+
     def __init__(self, config: GPT2Config):
         self.config = config
         self.params = None
@@ -259,6 +264,7 @@ class GPT2(Module):
         cache=None,
         train: bool = False,
         rngs=None,
+        pipeline=None,
         **kwargs,
     ):
         cfg = self.config
@@ -266,15 +272,18 @@ class GPT2(Module):
             return self._apply_cached(params, input_ids, attention_mask, cache, labels=labels)
         x, ctx = self.embed(params, input_ids, positions, attention_mask)
 
-        body = lambda x, layer: self.block(layer, x, ctx)
-        if cfg.remat:
-            policy = getattr(jax.checkpoint_policies, cfg.remat_policy)
-            body = jax.checkpoint(body, policy=policy)
+        if pipeline is not None:
+            x, _aux = pipeline.run(self, params["layers"], x, ctx)
+        else:
+            body = lambda x, layer: self.block(layer, x, ctx)
+            if cfg.remat:
+                policy = getattr(jax.checkpoint_policies, cfg.remat_policy)
+                body = jax.checkpoint(body, policy=policy)
 
-        def scan_step(x, layer):
-            return body(x, layer), None
+            def scan_step(x, layer):
+                return body(x, layer), None
 
-        x, _ = jax.lax.scan(scan_step, x, params["layers"])
+            x, _ = jax.lax.scan(scan_step, x, params["layers"])
         return self.head(params, x, labels=labels, attention_mask=attention_mask)
 
     # -------------------------------------------------------------- estimation

@@ -174,6 +174,13 @@ from ..ops.attention import attention as _attention
 
 
 class Llama(Module):
+    # Stage protocol (embed/block/head with a context-dict block) — eligible
+    # for the GPipe training schedule (parallel/pipeline.py) when pp > 1.
+    pipeline_capable = True
+    # Context keys a block sows per layer that must surface as scan outputs
+    # (MoE router aux loss); empty for the dense model.
+    scan_aux_keys: tuple = ()
+
     def __init__(self, config: LlamaConfig):
         self.config = config
         self.params = None
@@ -395,23 +402,42 @@ class Llama(Module):
         cache=None,
         train: bool = False,
         rngs=None,
+        pipeline=None,
         **kwargs,
     ):
         cfg = self.config
         if cache is not None:
             return self._apply_cached(params, input_ids, attention_mask, cache, labels=labels)
         x, ctx = self.embed(params, input_ids, positions, attention_mask)
+        aux_keys = tuple(self.scan_aux_keys)
 
-        body = lambda x, layer: self.block(layer, x, ctx)
-        if cfg.remat:
-            policy = getattr(jax.checkpoint_policies, cfg.remat_policy)
-            body = jax.checkpoint(body, policy=policy)
+        if pipeline is not None:
+            # GPipe schedule over the pp mesh axis: stationary stage weights,
+            # ppermuted activations (parallel/pipeline.py).
+            x, aux = pipeline.run(self, params["layers"], x, ctx)
+        else:
 
-        def scan_step(x, layer):
-            return body(x, layer), None
+            def scan_step(x, layer):
+                ctx_call = dict(ctx) if aux_keys else ctx
+                x = self.block(layer, x, ctx_call)
+                # Sown aux must become a real scan output *inside* any
+                # checkpoint boundary, or it would leak across the remat trace.
+                return x, tuple(ctx_call.pop(k) for k in aux_keys)
 
-        x, _ = jax.lax.scan(scan_step, x, params["layers"])
-        return self.head(params, x, labels=labels, attention_mask=attention_mask)
+            if cfg.remat:
+                policy = getattr(jax.checkpoint_policies, cfg.remat_policy)
+                scan_step = jax.checkpoint(scan_step, policy=policy)
+
+            x, aux_stack = jax.lax.scan(scan_step, x, params["layers"])
+            aux = {k: jnp.mean(a) for k, a in zip(aux_keys, aux_stack)}
+        out = self.head(params, x, labels=labels, attention_mask=attention_mask)
+        return self.finalize_aux(out, aux)
+
+    def finalize_aux(self, out, aux: dict):
+        """Fold per-layer scan aux (``scan_aux_keys``) into the output; the
+        dense model has none. MoE adds the router loss here so the dense and
+        pipelined forwards share one seam."""
+        return out
 
     def _apply_cached(self, params, input_ids, attention_mask, cache, labels=None):
         """Prefill/decode forward through the KV cache. The chunk is written at

@@ -101,42 +101,17 @@ class MoELlama(Llama):
             ctx["moe_aux"] = aux  # sown per call; read back by apply()'s scan body
         return out
 
-    def apply(
-        self,
-        params,
-        input_ids=None,
-        labels=None,
-        attention_mask=None,
-        positions=None,
-        cache=None,
-        train: bool = False,
-        rngs=None,
-        **kwargs,
-    ):
-        cfg = self.config
-        if cache is not None:
-            return super().apply(
-                params, input_ids=input_ids, labels=labels, attention_mask=attention_mask,
-                positions=positions, cache=cache, train=train, rngs=rngs, **kwargs,
-            )
-        x, ctx = self.embed(params, input_ids, positions, attention_mask)
+    # The base ``Llama.apply`` drives the scan (and the pipelined schedule)
+    # generically: declaring the sown key routes the router aux loss out of
+    # every forward path — plain scan, remat, and GPipe pipeline alike.
+    scan_aux_keys = ("moe_aux",)
 
-        def body(x, layer):
-            x = self.block(layer, x, ctx)
-            # The aux tracer sown into ctx must become a real output *inside*
-            # any checkpoint boundary, or it would leak across the remat trace.
-            return x, ctx.pop("moe_aux")
-
-        if cfg.remat:
-            policy = getattr(jax.checkpoint_policies, cfg.remat_policy)
-            body = jax.checkpoint(body, policy=policy)
-
-        x, aux_per_layer = jax.lax.scan(body, x, params["layers"])
-        out = self.head(params, x, labels=labels, attention_mask=attention_mask)
-        aux = jnp.mean(aux_per_layer)
-        out["aux_loss"] = aux
-        if "loss" in out:
-            out["loss"] = out["loss"] + cfg.router_aux_coef * aux
+    def finalize_aux(self, out, aux: dict):
+        a = aux.get("moe_aux")
+        if a is not None:
+            out["aux_loss"] = a
+            if "loss" in out:
+                out["loss"] = out["loss"] + self.config.router_aux_coef * a
         return out
 
     # -------------------------------------------------------------- estimation

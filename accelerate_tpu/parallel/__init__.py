@@ -1,1 +1,2 @@
 from .mesh import ParallelismConfig, batch_sharding_size, default_mesh, mesh_axis_size
+from .pipeline import PipelineSpec, resolve_pipeline_spec

@@ -1,0 +1,252 @@
+"""GPipe pipeline-parallel *training* schedule over the mesh ``pp`` axis.
+
+This replaces the round-2 "pp = shard the layer-stack dim under GSPMD" design,
+whose HLO all-gathered each stage's weights to the data every step (the traffic
+pattern of FSDP, growing with model size). Here stage weights are **stationary**
+— each pp rank keeps its own contiguous block of layers — and the *activations*
+move stage-to-stage through ``lax.ppermute``, microbatch by microbatch, exactly
+the communication shape of a real pipeline.
+
+Reference parity: the reference's training-side PP is Megatron's ``pp_degree``
+passthrough (``src/accelerate/utils/dataclasses.py:2110-2111``) and its native
+scheduler is the GPipe-style pippy wrapper for inference
+(``src/accelerate/inference.py:73-96``). This module is the TPU-native training
+scheduler those defer to elsewhere.
+
+Design (validated numerically against the plain ``lax.scan`` forward):
+
+- ``jax.shard_map`` manual over **only** the ``pp`` axis (``axis_names={'pp'}``)
+  — tp/fsdp/dp/sp stay *auto*, so GSPMD keeps partitioning the per-stage matmuls
+  (Megatron tp all-reduces, fsdp weight gathers) inside each stage unchanged.
+- The global batch is split into ``M`` microbatches **per data shard** (a
+  layout-only reshape/transpose — see ``microbatch``), so microbatch indexing
+  never crosses the (dp, fsdp) batch sharding and costs zero communication.
+- A ``lax.scan`` over ``M + P - 1`` ticks runs the classic GPipe wavefront:
+  stage 0 feeds a fresh microbatch each tick, every stage applies its layer
+  block, the result ppermutes to the next stage, the last stage banks finished
+  microbatches into an output buffer.
+- **Backward is autodiff**: ppermute's transpose is the reverse-ring ppermute
+  and the tick-scan reverses, yielding the GPipe backward wavefront (all
+  forwards, then all backwards) with no hand-written schedule. Per-microbatch
+  gradient contributions accumulate into each stage's stationary weights.
+- Read-only per-microbatch context (rotary tables, attention mask) is *not*
+  ppermuted: it is replicated over pp, and stage ``s`` at tick ``t`` indexes
+  microbatch ``t - s`` locally — only the residual stream (+ tiny aux scalars)
+  rides the ring.
+
+Bubble fraction is ``(P-1)/(M+P-1)`` — pick ``num_microbatches >= 4*pp`` for
+utilization; correctness holds for any ``M >= 1``. One semantic note: ops that
+group over the whole batch see per-microbatch groups instead — for MoE with a
+finite capacity factor, expert-capacity competition (token dropping) happens
+within each microbatch, the standard behavior of pipelined MoE stacks
+(GShard/Megatron); drop-free capacity is exactly batch-separable. Memory is GPipe-shaped: the
+tick-scan saves one boundary activation per tick per stage, with intermediate
+layer activations governed by the model's own ``remat`` flag exactly as in the
+non-pipelined path.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _data_axes_size(mesh: Mesh) -> int:
+    return mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+
+
+def microbatch(x, mesh: Mesh, num_microbatches: int):
+    """(B, ...) -> (M, B//M, ...) with each microbatch drawing an equal
+    contiguous chunk from every (dp, fsdp) batch shard.
+
+    The naive ``reshape(M, B//M, ...)`` would put the data sharding on the
+    microbatch dim, so indexing microbatches inside the pipeline would
+    all-gather the batch across data shards every tick. This permuted split is
+    layout-only (per-shard reshape + transpose), pinned by a sharding
+    constraint; ``unmicrobatch`` inverts it so batch order round-trips exactly.
+    """
+    dpf = _data_axes_size(mesh)
+    M = num_microbatches
+    B = x.shape[0]
+    mb = B // (dpf * M)
+    x = x.reshape(dpf, M, mb, *x.shape[1:])
+    x = jnp.swapaxes(x, 0, 1)
+    x = x.reshape(M, dpf * mb, *x.shape[3:])
+    return lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P(None, ("dp", "fsdp"), *([None] * (x.ndim - 2))))
+    )
+
+
+def unmicrobatch(xs, mesh: Mesh):
+    """Inverse of ``microbatch``: (M, B//M, ...) -> (B, ...) in original order."""
+    dpf = _data_axes_size(mesh)
+    M, Bm = xs.shape[0], xs.shape[1]
+    mb = Bm // dpf
+    x = xs.reshape(M, dpf, mb, *xs.shape[2:])
+    x = jnp.swapaxes(x, 0, 1)
+    x = x.reshape(M * Bm, *xs.shape[2:])
+    return lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P(("dp", "fsdp"), *([None] * (x.ndim - 1))))
+    )
+
+
+@dataclass
+class PipelineSpec:
+    """Everything the model forward needs to route its layer stack through the
+    pipeline: the mesh (for the pp axis + batch layout) and the microbatch
+    count. Built by the Accelerator from ``PipelineParallelPlugin`` and passed
+    into ``module.apply(..., pipeline=spec)`` for pipeline-capable models."""
+
+    mesh: Mesh
+    num_microbatches: int
+
+    def run(self, module, stage_layers, x, ctx):
+        """Drive ``module.block`` over the pipelined layer stack.
+
+        ``stage_layers`` is the stacked-layer param subtree (leading dim ``L``
+        sharded on ``pp``); ``x`` is the (B, S, H) residual stream; ``ctx`` the
+        model's read-only per-batch context dict (leaves with a leading batch
+        dim are microbatched; ``None`` leaves pass through).
+
+        Returns ``(x_out, aux)`` where ``aux`` maps each of the module's
+        ``scan_aux_keys`` to its scalar mean over layers and microbatches
+        (empty dict for dense models).
+        """
+        mesh = self.mesh
+        M = self.num_microbatches
+        n_stages = mesh.shape["pp"]
+        dpf = _data_axes_size(mesh)
+        B = x.shape[0]
+        if B % (dpf * M) != 0:
+            raise ValueError(
+                f"Pipeline needs batch {B} divisible by data-parallel degree x "
+                f"num_microbatches = {dpf}*{M}; adjust the batch size or "
+                f"PipelineParallelPlugin(num_microbatches=...)."
+            )
+        aux_keys = tuple(getattr(module, "scan_aux_keys", ()) or ())
+        cfg = getattr(module, "config", None)
+        remat = bool(getattr(cfg, "remat", False))
+        remat_policy = getattr(cfg, "remat_policy", "nothing_saveable")
+
+        # Context entries without a leading batch dim (or None) replicate
+        # across microbatches instead of being split.
+        ctx_whole = {k for k, v in ctx.items() if v is None or jnp.ndim(v) == 0 or v.shape[0] != B}
+        # The residual stream crosses the shard_map boundary in f32: the
+        # transpose of a pp-replicated input is a psum of its cotangent, and a
+        # bf16 all-reduce trips XLA CPU's promotion pass on the virtual test
+        # mesh. Compute inside stays in the model's dtype.
+        compute_dtype = x.dtype
+        xs = microbatch(x, mesh, M).astype(jnp.float32)
+        ctx_mb = {k: (v if k in ctx_whole else microbatch(v, mesh, M)) for k, v in ctx.items()}
+
+        def per_stage(stage_layers, xs, ctx_mb):
+            xs = xs.astype(compute_dtype)
+            stage = lax.axis_index("pp")
+
+            def stage_fn(x, ctx_local):
+                def block_body(carry, layer):
+                    x, aux_acc = carry
+                    ctx_call = dict(ctx_local)
+                    x = module.block(layer, x, ctx_call)
+                    aux = tuple(ctx_call.pop(k) for k in aux_keys)
+                    aux_acc = tuple(a + v for a, v in zip(aux_acc, aux))
+                    return (x, aux_acc), None
+
+                if remat:
+                    policy = getattr(jax.checkpoint_policies, remat_policy)
+                    block_body = jax.checkpoint(block_body, policy=policy)
+                zero_aux = tuple(jnp.zeros((), jnp.float32) for _ in aux_keys)
+                (x, aux), _ = lax.scan(block_body, (x, zero_aux), stage_layers)
+                return x, aux
+
+            def tick(carry, t):
+                state, aux_state, outputs, aux_out = carry
+                # Stage s processes microbatch (t - s); clip keeps the gather
+                # in-bounds during drain ticks (results there are discarded).
+                m_in = jnp.clip(t, 0, M - 1)
+                m_here = jnp.clip(t - stage, 0, M - 1)
+                inp = lax.dynamic_index_in_dim(xs, m_in, keepdims=False)
+                ctx_local = {
+                    k: (v if k in ctx_whole else lax.dynamic_index_in_dim(v, m_here, keepdims=False))
+                    for k, v in ctx_mb.items()
+                }
+                x_in = jnp.where(stage == 0, inp, state)
+                aux_in = tuple(jnp.where(stage == 0, jnp.zeros((), jnp.float32), a) for a in aux_state)
+                y, aux_y = stage_fn(x_in, ctx_local)
+                aux_y = tuple(a + b for a, b in zip(aux_in, aux_y))
+                # Last stage banks the finished microbatch.
+                out_idx = jnp.clip(t - (n_stages - 1), 0, M - 1)
+                write = (stage == n_stages - 1) & (t >= n_stages - 1)
+                cur = lax.dynamic_index_in_dim(outputs, out_idx, keepdims=False)
+                outputs = lax.dynamic_update_index_in_dim(
+                    outputs, jnp.where(write, y, cur), out_idx, 0
+                )
+                aux_out = tuple(
+                    lax.dynamic_update_index_in_dim(
+                        ao, jnp.where(write, ay, lax.dynamic_index_in_dim(ao, out_idx, keepdims=False)), out_idx, 0
+                    )
+                    for ao, ay in zip(aux_out, aux_y)
+                )
+                perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+                state = lax.ppermute(y, "pp", perm)
+                aux_state = tuple(lax.ppermute(a, "pp", perm) for a in aux_y)
+                return (state, aux_state, outputs, aux_out), None
+
+            outputs = jnp.zeros_like(xs)
+            aux_out = tuple(jnp.zeros((M,), jnp.float32) for _ in aux_keys)
+            state = jnp.zeros_like(xs[0])
+            aux_state = tuple(jnp.zeros((), jnp.float32) for _ in aux_keys)
+            (state, aux_state, outputs, aux_out), _ = lax.scan(
+                tick, (state, aux_state, outputs, aux_out), jnp.arange(M + n_stages - 1)
+            )
+            # Finished microbatches live only on the last stage (zeros
+            # elsewhere): psum over pp broadcast-sums them everywhere so the
+            # result re-enters the GSPMD world replicated over pp, matching
+            # the non-pipelined activation layout. The sum runs in f32: exact
+            # (one non-zero contribution per element) and it sidesteps XLA
+            # CPU's bf16 all-reduce promotion crash on the virtual test mesh.
+            out_dtype = outputs.dtype
+            outputs = lax.psum(outputs.astype(jnp.float32), "pp").astype(out_dtype)
+            aux_out = tuple(lax.psum(a, "pp") for a in aux_out)
+            return outputs, aux_out
+
+        out, aux_out = jax.shard_map(
+            per_stage,
+            mesh=mesh,
+            in_specs=(P("pp"), P(), P()),
+            out_specs=(P(), P()),
+            axis_names={"pp"},
+            check_vma=False,
+        )(stage_layers, xs, ctx_mb)
+        x_out = unmicrobatch(out, mesh)
+        n_layers = jax.tree_util.tree_leaves(stage_layers)[0].shape[0]
+        aux = {k: jnp.mean(a) / n_layers for k, a in zip(aux_keys, aux_out)}
+        return x_out, aux
+
+
+def resolve_pipeline_spec(module, params, mesh: Mesh, num_microbatches: int = 0):
+    """Decide whether the pipelined schedule applies, returning a
+    ``PipelineSpec`` or ``None`` (falls back to the GSPMD layer-dim sharding).
+
+    Engages when the mesh has pp > 1, the module advertises
+    ``pipeline_capable`` (the embed/block/head stage protocol with a
+    context-dict block signature), and the layer count splits evenly across
+    stages — the same divisibility the sharding planner requires before it
+    places the layer stack on ``pp``.
+    """
+    pp = mesh.shape.get("pp", 1)
+    if pp <= 1 or not getattr(module, "pipeline_capable", False):
+        return None
+    layers = params.get("layers") if isinstance(params, dict) else None
+    if not layers:
+        return None
+    n_layers = jax.tree_util.tree_leaves(layers)[0].shape[0]
+    if n_layers % pp != 0:
+        return None
+    if num_microbatches <= 0:
+        num_microbatches = pp  # default: one microbatch in flight per stage
+    return PipelineSpec(mesh=mesh, num_microbatches=num_microbatches)

@@ -152,8 +152,8 @@ class PipelineParallelPlugin(KwargsHandler):
     Megatron ``pp_degree`` dataclasses.py:2110 and inference pippy inference.py:124)."""
 
     pp_size: int = 1
-    num_microbatches: int = 1
-    schedule: str = "gpipe"  # or '1f1b' (scan-based)
+    num_microbatches: int = 0  # 0 = auto (defaults to pp_size microbatches)
+    schedule: str = "gpipe"  # autodiff'd GPipe wavefront (parallel/pipeline.py)
 
 
 @dataclass

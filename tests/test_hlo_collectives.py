@@ -7,7 +7,8 @@ compiled train step on the 8-device CPU mesh (VERDICT round-1 item 9):
 - dp       → gradient all-reduce, nothing else;
 - fsdp     → parameter all-gathers (+ grad reduction traffic);
 - tp       → row-parallel partial-sum all-reduces *on top of* dp's;
-- pp       → per-stage layer gathers as the scan crosses stage boundaries;
+- pp       → GPipe: activations collective-permute stage-to-stage, stage
+             weights stationary (NO parameter all-gather);
 - sp(ring) → the explicit ppermute KV rotation → collective-permute.
 """
 
@@ -70,9 +71,13 @@ def test_tp_plan_adds_partial_sum_allreduces(dp_counts):
     assert c["all-reduce"] > dp_counts["all-reduce"], (c, dp_counts)
 
 
-def test_pp_plan_moves_stage_params():
+def test_pp_plan_pipelines_activations():
+    """The GPipe schedule (parallel/pipeline.py) keeps stage weights stationary
+    and moves microbatched activations by collective-permute — the round-2
+    design's per-step stage-param all-gather must be gone (VERDICT r2 #1)."""
     c = _collective_counts(ParallelismConfig(pp_size=2))
-    assert c["all-gather"] > 0, c
+    assert c["collective-permute"] > 0, c
+    assert c["all-gather"] == 0, c
 
 
 def test_ring_plan_emits_collective_permute():

@@ -1,6 +1,8 @@
-"""Pipeline-parallel training: the pp mesh axis shards the layer-stack dim
-(stage placement via GSPMD; VERDICT round-1 gap #8). Verifies pp>1 training
-compiles, runs, and matches pp=1 numerics exactly."""
+"""Pipeline-parallel training: a true GPipe schedule over the pp mesh axis —
+stationary stage weights, microbatched activations moving via ppermute
+(``parallel/pipeline.py``; VERDICT r2 #1). Verifies pp>1 training compiles,
+runs, matches pp=1 numerics exactly, and that the microbatch plumbing
+round-trips."""
 
 import numpy as np
 import pytest
@@ -11,17 +13,27 @@ import optax
 from accelerate_tpu import Accelerator, ParallelismConfig
 from accelerate_tpu.models import Llama, LlamaConfig
 from accelerate_tpu.state import AcceleratorState, GradientState
+from accelerate_tpu.utils.dataclasses import PipelineParallelPlugin
 
 
-def _run_training(parallelism, steps=4, lr=0.1):
-    AcceleratorState._reset_state(reset_partial_state=True)
-    GradientState._reset_state()
-    accelerator = Accelerator(parallelism_config=parallelism)
-    cfg = LlamaConfig.tiny(
+def _tiny_cfg(model_cls=Llama, **kw):
+    defaults = dict(
         vocab_size=128, hidden_size=64, intermediate_size=128,
         num_attention_heads=2, num_key_value_heads=2, num_hidden_layers=4,
     )
-    model = Llama(cfg)
+    defaults.update(kw)
+    if model_cls is Llama:
+        return LlamaConfig.tiny(**defaults)
+    from accelerate_tpu.models.moe import MoELlamaConfig
+
+    return MoELlamaConfig.tiny(**defaults)
+
+
+def _run_training(parallelism, steps=4, lr=0.1, model_cls=Llama, cfg_kw=None, plugin=None):
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+    accelerator = Accelerator(parallelism_config=parallelism, pp_plugin=plugin)
+    model = model_cls(_tiny_cfg(model_cls, **(cfg_kw or {})))
     model.init_params(jax.random.key(0))
     pmodel, popt = accelerator.prepare(model, optax.sgd(lr))
     ids = np.random.default_rng(0).integers(0, 128, (8, 16)).astype(np.int32)
@@ -85,3 +97,118 @@ def test_pp_indivisible_layers_relaxes_keeping_tp():
     ids = np.random.default_rng(0).integers(0, 128, (8, 16)).astype(np.int32)
     step = accelerator.build_train_step(pmodel, popt)
     assert np.isfinite(float(step({"input_ids": ids, "labels": ids})))
+
+
+def test_pipeline_spec_engages_for_pp():
+    """pp>1 + stage-protocol model + divisible layers → GPipe schedule active."""
+    _, _, pmodel = _run_training(ParallelismConfig(pp_size=2), steps=1)
+    spec = pmodel.handle.pipeline_spec
+    assert spec is not None
+    assert spec.num_microbatches == 2  # auto default: one in flight per stage
+
+
+def test_pipeline_explicit_microbatches_matches_pp1():
+    """More microbatches than stages (the utilization regime) keeps numerics."""
+    _, params_ref, _ = _run_training(ParallelismConfig(), steps=1)
+    _, params_pp, pmodel = _run_training(
+        ParallelismConfig(pp_size=4), steps=1,  # pp4 x dp2, 1 layer per stage
+        plugin=PipelineParallelPlugin(pp_size=4, num_microbatches=4),
+    )
+    assert pmodel.handle.pipeline_spec.num_microbatches == 4
+    for (pa, la), (pb, lb) in zip(
+        jax.tree_util.tree_leaves_with_path(params_ref),
+        jax.tree_util.tree_leaves_with_path(params_pp),
+    ):
+        np.testing.assert_allclose(la, lb, atol=2e-4, err_msg=str(pa))
+
+
+def test_pipeline_with_remat_matches():
+    """jax.checkpoint inside the stage body must not change the math."""
+    losses_plain, _, _ = _run_training(ParallelismConfig(pp_size=2), steps=2, lr=0.01)
+    losses_remat, _, _ = _run_training(
+        ParallelismConfig(pp_size=2), steps=2, lr=0.01, cfg_kw={"remat": True}
+    )
+    np.testing.assert_allclose(losses_plain, losses_remat, rtol=1e-5)
+
+
+def test_pipeline_moe_aux_loss_flows():
+    """MoE under the pipeline: router aux rides the ring as a scalar and the
+    pipelined loss (LM + aux) matches the non-pipelined forward.
+
+    Routing group semantics: under pipelining, capacity competition and the
+    load-balance statistics (f_e * P_e) are computed per microbatch — the
+    standard behavior of pipelined MoE stacks (GShard/Megatron). So the exact
+    LM-loss comparison uses drop-free capacity (E/k) with aux coefficient 0
+    (the batch-separable part), and the aux path is asserted separately."""
+    from accelerate_tpu.models.moe import MoELlama
+
+    moe_kw = {
+        "num_experts": 4, "moe_top_k": 2, "capacity_factor": 2.0,
+        "router_aux_coef": 0.0,
+    }
+    losses_ref, _, _ = _run_training(
+        ParallelismConfig(), steps=1, model_cls=MoELlama, cfg_kw=moe_kw,
+    )
+    losses_pp, _, pmodel = _run_training(
+        ParallelismConfig(pp_size=2), steps=1, model_cls=MoELlama, cfg_kw=moe_kw,
+    )
+    assert pmodel.handle.pipeline_spec is not None
+    np.testing.assert_allclose(losses_pp[0], losses_ref[0], rtol=1e-5)
+    # Aux loss flows out of the pipelined forward (per-microbatch groups).
+    ids = np.random.default_rng(0).integers(0, 128, (8, 16)).astype(np.int32)
+    fwd = jax.jit(
+        lambda p, i: pmodel.module.apply(
+            p, input_ids=i, labels=i, pipeline=pmodel.handle.pipeline_spec, train=True
+        )["aux_loss"]
+    )
+    aux = float(fwd(pmodel.params, ids))
+    assert np.isfinite(aux) and aux > 0.0, aux
+
+
+def test_pipeline_bf16_composes():
+    """Mixed-precision pp (the dryrun composition): bf16 activations must not
+    trip XLA CPU's all-reduce promotion — the boundary rides f32 (pipeline.py)."""
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+    accelerator = Accelerator(
+        mixed_precision="bf16",
+        parallelism_config=ParallelismConfig(pp_size=2, fsdp_size=2, tp_size=2),
+    )
+    model = Llama(_tiny_cfg(num_attention_heads=2, num_key_value_heads=2))
+    model.init_params(jax.random.key(0))
+    pmodel, popt = accelerator.prepare(model, optax.sgd(0.1))
+    assert pmodel.handle.pipeline_spec is not None
+    ids = np.random.default_rng(0).integers(0, 128, (8, 16)).astype(np.int32)
+    step = accelerator.build_train_step(pmodel, popt)
+    assert np.isfinite(float(step({"input_ids": ids, "labels": ids})))
+
+
+def test_pipeline_batch_divisibility_error():
+    """Batch not divisible by data_degree x microbatches → actionable error."""
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+    accelerator = Accelerator(
+        parallelism_config=ParallelismConfig(pp_size=2),  # pp2 x dp4
+        pp_plugin=PipelineParallelPlugin(pp_size=2, num_microbatches=3),
+    )
+    model = Llama(_tiny_cfg())
+    model.init_params(jax.random.key(0))
+    pmodel, popt = accelerator.prepare(model, optax.sgd(0.1))
+    ids = np.random.default_rng(0).integers(0, 128, (8, 16)).astype(np.int32)  # 8 % (2*3) != 0
+    step = accelerator.build_train_step(pmodel, popt)
+    with pytest.raises(ValueError, match="num_microbatches"):
+        step({"input_ids": ids, "labels": ids})
+
+
+def test_microbatch_roundtrip():
+    """microbatch/unmicrobatch preserve batch order for any rank layout."""
+    from accelerate_tpu.parallel.pipeline import microbatch, unmicrobatch
+    from accelerate_tpu.parallel.mesh import ParallelismConfig as PC
+
+    mesh = PC(dp_size=2, fsdp_size=2, pp_size=2).build_mesh()
+    x = np.arange(16 * 3, dtype=np.float32).reshape(16, 3)
+    with mesh:
+        xs = microbatch(jax.numpy.asarray(x), mesh, 2)
+        back = unmicrobatch(xs, mesh)
+    assert xs.shape == (2, 8, 3)
+    np.testing.assert_array_equal(np.asarray(back), x)
