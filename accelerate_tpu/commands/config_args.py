@@ -78,6 +78,7 @@ class ClusterConfig:
     pp_size: int = 1
     sp_size: int = 1
     ep_size: int = 1
+    dcn_size: int = 0  # multi-slice count (0 → auto-detect slices)
     max_restarts: int = 0  # full-gang relaunch attempts after failure
     # Host-side virtual device count for CPU simulation (xla_force_host_platform_device_count)
     cpu_virtual_devices: int = 0

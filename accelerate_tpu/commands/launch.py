@@ -75,6 +75,7 @@ def launch_command_parser(subparsers=None) -> argparse.ArgumentParser:
         ("pp", "pipeline-parallel size"),
         ("sp", "sequence-parallel size"),
         ("ep", "expert-parallel size"),
+        ("dcn", "multi-slice count (0 = auto-detect slices)"),
     ):
         parser.add_argument(f"--{axis}_size", type=int, default=None, help=helptext)
     parser.add_argument("-m", "--module", action="store_true", help="Run script as a python module")
@@ -106,6 +107,7 @@ def _merge_config(args) -> ClusterConfig:
         ("pp_size", "pp_size"),
         ("sp_size", "sp_size"),
         ("ep_size", "ep_size"),
+        ("dcn_size", "dcn_size"),
         ("max_restarts", "max_restarts"),
     ]:
         val = getattr(args, flag, None)

@@ -43,9 +43,20 @@ class LocalSGDTrainer:
             loss = trainer.step(batch)     # local update; averages every 8 steps
         params = trainer.final_params()    # replica-averaged pytree
 
-    Requires a pure-dp mesh (LocalSGD is a data-parallel technique; fsdp/tp/pp/
-    sp/ep axes must be trivial). The global batch is split replica-major: rows
-    ``[r·B/R, (r+1)·B/R)`` feed replica ``r``.
+    Replica placement:
+
+    - **pure-dp mesh** — one replica per dp rank (the round-2 behavior);
+      fsdp/tp/pp/sp/ep must be trivial.
+    - **multi-slice mesh** (``dcn > 1``) — one replica per *slice*: the replica
+      dim rides ``dcn`` and each replica's step runs GSPMD-sharded over its
+      slice's ICI axes (dp/fsdp/tp allowed; pp's shard_map schedule and the
+      ep/sp paths' explicit dcn-batch constraints do not compose with the
+      replica vmap and are rejected). This is the canonical DCN strategy:
+      zero cross-slice traffic between sync boundaries, one parameter average
+      over the slow network every ``sync_every`` steps.
+
+    The global batch is split replica-major: rows ``[r·B/R, (r+1)·B/R)`` feed
+    replica ``r``.
     """
 
     def __init__(self, accelerator: Accelerator, model: PreparedModel, tx, sync_every: int):
@@ -62,26 +73,49 @@ class LocalSGDTrainer:
         if sync_every < 1:
             raise ValueError(f"sync_every must be >= 1, got {sync_every}")
         mesh = accelerator.mesh
-        for ax in ("fsdp", "tp", "pp", "sp", "ep"):
-            if mesh.shape.get(ax, 1) != 1:
-                raise ValueError(
-                    f"LocalSGDTrainer needs a pure-dp mesh; axis {ax!r} has size "
-                    f"{mesh.shape[ax]}. Use the fused train step for sharded models."
-                )
+        if mesh.shape.get("dcn", 1) > 1:
+            self.replica_axis = "dcn"
+            for ax in ("pp", "ep", "sp"):
+                # pp's shard_map schedule, and the ep/sp paths' explicit
+                # sharding constraints naming 'dcn' as a batch axis, cannot
+                # appear under vmap(spmd_axis_name='dcn') — reject up front.
+                if mesh.shape.get(ax, 1) != 1:
+                    raise ValueError(
+                        f"LocalSGDTrainer over dcn: axis {ax!r} does not compose "
+                        "with the per-slice replica vmap; use fsdp/tp inside "
+                        "each slice (or the fused train step for this plan)."
+                    )
+        else:
+            self.replica_axis = "dp"
+            for ax in ("fsdp", "tp", "pp", "sp", "ep"):
+                if mesh.shape.get(ax, 1) != 1:
+                    raise ValueError(
+                        f"LocalSGDTrainer needs a pure-dp mesh (or a dcn axis for "
+                        f"per-slice replicas); axis {ax!r} has size "
+                        f"{mesh.shape[ax]}. Use the fused train step for sharded models."
+                    )
         self.accelerator = accelerator
         self.model = model
         self.sync_every = sync_every
         self.mesh = mesh
-        self.R = R = mesh.shape.get("dp", 1)
+        self.R = R = mesh.shape.get(self.replica_axis, 1)
+        replica_axis = self.replica_axis
         handle = model.handle
 
-        rep_shard = NamedSharding(mesh, P("dp"))
-        stack = lambda p: jax.device_put(jnp.broadcast_to(p[None], (R,) + p.shape), rep_shard)
-        self._params_rep = jax.tree_util.tree_map(stack, handle.params)
+        # Per-replica stacking keeps each leaf's intra-replica sharding (fsdp/tp
+        # dims stay sharded inside the slice) and adds the replica axis on dim 0.
+        def stack(p, s):
+            spec = P(replica_axis, *tuple(s.spec))
+            return jax.device_put(
+                jnp.broadcast_to(p[None], (R,) + p.shape), NamedSharding(mesh, spec)
+            )
+
+        self._params_rep = jax.tree_util.tree_map(stack, handle.params, handle.param_shardings)
         self._opt_rep = jax.vmap(tx.init)(self._params_rep)
         self._count = jnp.zeros((), jnp.int32)
 
         loss_of = model.training_loss_fn()
+        inner_batch_axes = ("dp", "fsdp") if replica_axis == "dcn" else None
 
         import optax
 
@@ -94,10 +128,17 @@ class LocalSGDTrainer:
                 updates, opt = tx.update(grads, opt, params)
                 return optax.apply_updates(params, updates), opt, loss
 
-            batch_rep = jax.tree_util.tree_map(
-                lambda x: x.reshape((R, x.shape[0] // R) + x.shape[1:]), batch
-            )
-            params_rep, opt_rep, losses = jax.vmap(one)(
+            def split(x):
+                x = x.reshape((R, x.shape[0] // R) + x.shape[1:])
+                return jax.lax.with_sharding_constraint(
+                    x,
+                    NamedSharding(
+                        mesh, P(replica_axis, inner_batch_axes, *([None] * (x.ndim - 2)))
+                    ),
+                )
+
+            batch_rep = jax.tree_util.tree_map(split, batch)
+            params_rep, opt_rep, losses = jax.vmap(one, spmd_axis_name=replica_axis)(
                 params_rep, opt_rep, batch_rep, jnp.arange(R)
             )
             count = count + 1

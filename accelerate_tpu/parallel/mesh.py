@@ -15,9 +15,17 @@ strategy is an **axis of one** ``jax.sharding.Mesh``:
 - ``ep``   — expert parallelism (MoE expert weights sharded expert-wise; token
              dispatch rides all-to-all over this axis)
 
+- ``dcn``  — the slice axis of a multi-slice deployment: pure data replication
+             across slices over data-center network (gradient all-reduce only,
+             or no per-step traffic at all under ``LocalSGDTrainer``).
+
 Axis order puts ``tp`` innermost so tensor-parallel collectives ride the
-fastest-varying ICI neighbors, then ``sp``, then ``fsdp``/``dp``, with ``pp``
-outermost (suited to DCN between slices on multi-slice deployments).
+fastest-varying ICI neighbors, then ``sp``, then ``fsdp``/``dp``, then ``pp``,
+with ``dcn`` outermost: on real multi-slice hardware the mesh is built
+hybrid (``mesh_utils.create_hybrid_device_mesh``) so every non-dcn axis maps
+onto intra-slice ICI and only the dcn axis crosses the slow network — the
+TPU-native analog of the reference's torchrun-over-nodes NCCL topology
+(``src/accelerate/utils/launch.py:203-352``).
 """
 
 from __future__ import annotations
@@ -48,6 +56,9 @@ class ParallelismConfig:
     pp_size: int = 1
     sp_size: int = 1
     ep_size: int = 1
+    # Slice count of a multi-slice deployment (0 = auto-detect from the
+    # MEGASCALE_NUM_SLICES runtime env / device slice_index; 1 = single slice).
+    dcn_size: int = 0
 
     def __post_init__(self):
         if self.dp_size == 0:
@@ -56,6 +67,19 @@ class ParallelismConfig:
             # FSDP-plugin convention: full-shard over every device left after the
             # model axes (reference FULL_SHARD has no explicit degree either).
             self.fsdp_size = -1
+        if self.dcn_size == 0:
+            # Cheap env-only resolution here; device-introspection (which would
+            # force backend init) waits until build_mesh has devices in hand.
+            env = os.environ.get("MEGASCALE_NUM_SLICES", "").strip()
+            if env:
+                try:
+                    self.dcn_size = max(int(env), 1)
+                except ValueError:
+                    raise ValueError(
+                        f"MEGASCALE_NUM_SLICES={env!r} is not an integer"
+                    ) from None
+        if self.dcn_size < 0:
+            raise ValueError(f"dcn_size must be >= 1 (or 0 = auto), got {self.dcn_size}")
         for name in ("fsdp_size", "tp_size", "pp_size", "sp_size", "ep_size"):
             if getattr(self, name) < 1 and not (name == "fsdp_size" and self.fsdp_size == -1):
                 raise ValueError(f"{name} must be >= 1, got {getattr(self, name)}")
@@ -69,7 +93,7 @@ class ParallelismConfig:
             for part in spec.split(","):
                 axis, _, size = part.partition(":")
                 axis = axis.strip()
-                if axis not in ("dp", "fsdp", "tp", "pp", "sp", "ep"):
+                if axis not in ("dp", "fsdp", "tp", "pp", "sp", "ep", "dcn"):
                     raise ValueError(f"Unknown mesh axis {axis!r} in {ENV_MESH_SHAPE}")
                 size = int(size)
                 if axis in ("dp", "fsdp") and size == 0:
@@ -77,51 +101,82 @@ class ParallelismConfig:
                 kwargs[f"{axis}_size"] = size
         return cls(**kwargs)
 
-    def resolved_sizes(self, num_devices: int) -> dict[str, int]:
+    def resolved_sizes(self, num_devices: int, dcn: int | None = None) -> dict[str, int]:
         """Resolve ``dp_size=-1`` / ``fsdp_size=-1`` against the device count and
         validate divisibility. When both are -1, fsdp absorbs the remainder
-        (full-shard preference, matching the FSDP plugin's FULL_SHARD intent)."""
+        (full-shard preference, matching the FSDP plugin's FULL_SHARD intent).
+        ``dcn_size=0`` (auto, no env hint) resolves to 1 here; ``build_mesh``
+        passes the device-detected slice count instead."""
+        if dcn is None:
+            dcn = self.dcn_size or 1
         dp, fsdp = self.dp_size, self.fsdp_size
-        other = self.tp_size * self.pp_size * self.sp_size * self.ep_size
+        other = dcn * self.tp_size * self.pp_size * self.sp_size * self.ep_size
         if fsdp == -1:
             if dp == -1:
                 dp = 1
             if num_devices % (dp * other) != 0:
                 raise ValueError(
-                    f"{num_devices} devices not divisible by dp*tp*pp*sp*ep={dp * other}"
+                    f"{num_devices} devices not divisible by dcn*dp*tp*pp*sp*ep={dp * other}"
                 )
             fsdp = max(num_devices // (dp * other), 1)
         model_degree = fsdp * other
         if dp == -1:
             if num_devices % model_degree != 0:
                 raise ValueError(
-                    f"{num_devices} devices not divisible by fsdp*tp*pp*sp*ep={model_degree}"
+                    f"{num_devices} devices not divisible by dcn*fsdp*tp*pp*sp*ep={model_degree}"
                 )
             dp = num_devices // model_degree
         total = dp * model_degree
         if total != num_devices:
             raise ValueError(
-                f"Mesh {dict(pp=self.pp_size, dp=dp, fsdp=fsdp, ep=self.ep_size, sp=self.sp_size, tp=self.tp_size)} "
+                f"Mesh {dict(dcn=dcn, pp=self.pp_size, dp=dp, fsdp=fsdp, ep=self.ep_size, sp=self.sp_size, tp=self.tp_size)} "
                 f"needs {total} devices but {num_devices} are available."
             )
-        return {"pp": self.pp_size, "dp": dp, "fsdp": fsdp, "ep": self.ep_size, "sp": self.sp_size, "tp": self.tp_size}
+        return {
+            "dcn": dcn, "pp": self.pp_size, "dp": dp, "fsdp": fsdp,
+            "ep": self.ep_size, "sp": self.sp_size, "tp": self.tp_size,
+        }
 
     def build_mesh(self, devices=None) -> Mesh:
         """Build the ``jax.sharding.Mesh``.
 
-        Uses ``mesh_utils.create_device_mesh`` when possible so the logical axes map
-        onto the physical ICI torus with nearest-neighbor adjacency for the inner
-        axes; falls back to a plain reshape on virtual/CPU device sets.
+        Single-slice: ``mesh_utils.create_device_mesh`` maps the logical axes
+        onto the physical ICI torus with nearest-neighbor adjacency for the
+        inner axes. Multi-slice (``dcn_size > 1``): a **hybrid** mesh — every
+        non-dcn axis is laid out inside one slice's ICI and the dcn axis
+        enumerates slices over DCN (``mesh_utils.create_hybrid_device_mesh``).
+        Falls back to a plain reshape on virtual/CPU device sets, where
+        contiguous blocks of ``len(devices)/dcn`` devices stand in for slices.
         """
         if devices is None:
             devices = jax.devices()
-        sizes = self.resolved_sizes(len(devices))
+        dcn = self.dcn_size or detect_num_slices(devices)
+        sizes = self.resolved_sizes(len(devices), dcn=dcn)
         shape = tuple(sizes[a] for a in MESH_AXIS_ORDER)
         try:
             from jax.experimental import mesh_utils
 
-            dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+            if dcn > 1:
+                per_slice = (1,) + shape[1:]
+                dcn_shape = (dcn,) + (1,) * (len(shape) - 1)
+                dev_array = mesh_utils.create_hybrid_device_mesh(
+                    per_slice, dcn_shape, devices=devices
+                )
+            else:
+                dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
         except Exception:
+            if dcn > 1 and len({getattr(d, "slice_index", 0) for d in devices}) > 1:
+                # Real multi-slice hardware: a plain reshape could scatter a
+                # slice-local axis across DCN — the one property the dcn axis
+                # exists to guarantee. Fail loudly rather than degrade.
+                raise
+            if dcn > 1:
+                import logging
+
+                logging.getLogger(__name__).info(
+                    "hybrid mesh construction unavailable; using contiguous "
+                    "device blocks as virtual slices (CPU/test topology)"
+                )
             dev_array = np.asarray(devices).reshape(shape)
         return Mesh(dev_array, MESH_AXIS_ORDER)
 
@@ -133,8 +188,23 @@ class ParallelismConfig:
             and self.pp_size == 1
             and self.sp_size == 1
             and self.ep_size == 1
+            and self.dcn_size in (0, 1)
             and self.dp_size in (-1, 1)
         )
+
+
+def detect_num_slices(devices=None) -> int:
+    """Slice count of the current device set, from the devices' ``slice_index``
+    attribute (present on real multi-slice TPU backends; virtual/CPU device
+    sets lack it → 1). The ``MEGASCALE_NUM_SLICES`` env hint is consumed
+    earlier, in ``ParallelismConfig.__post_init__``."""
+    try:
+        if devices is None:
+            devices = jax.devices()
+        slice_ids = {getattr(d, "slice_index", 0) for d in devices}
+        return max(len(slice_ids), 1)
+    except Exception:
+        return 1
 
 
 def default_mesh(devices=None) -> Mesh:
@@ -147,5 +217,9 @@ def mesh_axis_size(mesh: Mesh, axis: str) -> int:
 
 
 def batch_sharding_size(mesh: Mesh) -> int:
-    """Number of ways the global batch is split (dp × fsdp)."""
-    return mesh_axis_size(mesh, "dp") * mesh_axis_size(mesh, "fsdp")
+    """Number of ways the global batch is split (dcn × dp × fsdp)."""
+    return (
+        mesh_axis_size(mesh, "dcn")
+        * mesh_axis_size(mesh, "dp")
+        * mesh_axis_size(mesh, "fsdp")
+    )

@@ -56,7 +56,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
 def _data_axes_size(mesh: Mesh) -> int:
-    return mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+    return (
+        mesh.shape.get("dcn", 1)
+        * mesh.shape.get("dp", 1)
+        * mesh.shape.get("fsdp", 1)
+    )
 
 
 def microbatch(x, mesh: Mesh, num_microbatches: int):
@@ -77,7 +81,7 @@ def microbatch(x, mesh: Mesh, num_microbatches: int):
     x = jnp.swapaxes(x, 0, 1)
     x = x.reshape(M, dpf * mb, *x.shape[3:])
     return lax.with_sharding_constraint(
-        x, NamedSharding(mesh, P(None, ("dp", "fsdp"), *([None] * (x.ndim - 2))))
+        x, NamedSharding(mesh, P(None, ("dcn", "dp", "fsdp"), *([None] * (x.ndim - 2))))
     )
 
 
@@ -90,7 +94,7 @@ def unmicrobatch(xs, mesh: Mesh):
     x = jnp.swapaxes(x, 0, 1)
     x = x.reshape(M * Bm, *xs.shape[2:])
     return lax.with_sharding_constraint(
-        x, NamedSharding(mesh, P(("dp", "fsdp"), *([None] * (x.ndim - 1))))
+        x, NamedSharding(mesh, P(("dcn", "dp", "fsdp"), *([None] * (x.ndim - 1))))
     )
 
 

@@ -91,8 +91,10 @@ def _relax_spec(shape, spec: P, mesh: Mesh) -> P:
 
 
 def batch_spec(mesh: Mesh, extra_dims: int = 0) -> P:
-    """Leading-dim batch sharding over the combined data axes."""
-    return P(("dp", "fsdp"), *([None] * extra_dims))
+    """Leading-dim batch sharding over the combined data axes (dcn, dp, fsdp)."""
+    from ..utils.constants import BATCH_SHARDING_AXES
+
+    return P(BATCH_SHARDING_AXES, *([None] * extra_dims))
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:
@@ -176,7 +178,7 @@ def make_global_batch(batch, mesh: Mesh, spec_fn=None):
     ``jax.Array``, no host ever materializes it.
     """
     multi_host = jax.process_count() > 1
-    n_data = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+    n_data = mesh.shape.get("dcn", 1) * mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
 
     def _one(x):
         x = np.asarray(x)
@@ -200,7 +202,7 @@ def make_global_batch(batch, mesh: Mesh, spec_fn=None):
 
 def local_batch_size_for(global_batch_size: int, mesh: Mesh) -> int:
     """How many samples this *process* should feed per step."""
-    n_data = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+    n_data = mesh.shape.get("dcn", 1) * mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
     if global_batch_size % n_data != 0:
         raise ValueError(
             f"global batch size {global_batch_size} not divisible by data-parallel degree {n_data}"

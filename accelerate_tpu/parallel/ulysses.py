@@ -54,8 +54,8 @@ def ulysses_attention(q, k, v, *, causal=True, mask=None, mesh=None, axis_name: 
             "Use ring attention (SequenceParallelPlugin(ring_attention=True)) instead."
         )
 
-    n_batch = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
-    batch_axes = ("dp", "fsdp") if B % n_batch == 0 else None
+    n_batch = mesh.shape.get("dcn", 1) * mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+    batch_axes = ("dcn", "dp", "fsdp") if B % n_batch == 0 else None
     head_axis = "tp" if H % tp == 0 and tp > 1 else None
     qkv_spec = P(batch_axes, axis_name, head_axis, None)
     mask_spec = P(batch_axes, axis_name)

@@ -438,8 +438,10 @@ class AcceleratorState:
         # Build everything in locals first: mesh-shape validation errors must not
         # leave a half-initialized AcceleratorState singleton behind.
         partial = PartialState(cpu=cpu, **kwargs)
-        sizes = parallelism_config.resolved_sizes(jax.device_count())
         mesh = parallelism_config.build_mesh()
+        # Read sizes off the built mesh: it is the source of truth once slice
+        # auto-detection (dcn) has resolved against the real device set.
+        sizes = dict(mesh.shape)
 
         self._partial = partial
         # Share the dict contents: expose PartialState attrs through this object.

@@ -38,8 +38,12 @@ ENV_CPU = "ACCELERATE_USE_CPU"
 ENV_DEBUG_MODE = "ACCELERATE_DEBUG_MODE"
 ENV_MESH_SHAPE = "ACCELERATE_MESH_SHAPE"
 
-MESH_AXIS_ORDER = ("pp", "dp", "fsdp", "ep", "sp", "tp")
-BATCH_SHARDING_AXES = ("dp", "fsdp")
+# ``dcn`` is the slice axis of a multi-slice pod: replicas connected by
+# data-center network rather than ICI. It is outermost so only the axes meant
+# to cross slices (data parallelism / LocalSGD replicas) ever ride DCN; all
+# model axes (pp/tp/sp/ep, and fsdp by default) stay inside a slice's ICI.
+MESH_AXIS_ORDER = ("dcn", "pp", "dp", "fsdp", "ep", "sp", "tp")
+BATCH_SHARDING_AXES = ("dcn", "dp", "fsdp")
 
 # Default config location, mirroring the reference's
 # ~/.cache/huggingface/accelerate/default_config.yaml
