@@ -133,3 +133,50 @@ def test_moe_generation_with_cache():
     out = model.apply(params, input_ids=ids, cache=cache)
     assert out["cache"]["pos"] == 8
     assert np.isfinite(np.asarray(out.logits)).all()
+
+
+def test_sorted_and_einsum_dispatch_agree():
+    """The O(S·k) sort+ragged_dot path and the ep-shardable einsum path are
+    two implementations of one routing semantics — outputs and aux must match
+    in both the droppy and drop-free regimes (VERDICT r2 #4)."""
+    from accelerate_tpu.ops.moe import moe_ffn_einsum, moe_ffn_sorted
+
+    rng = np.random.default_rng(0)
+    B, S, h, i, E, k = 2, 16, 8, 16, 4, 2
+    x = jnp.asarray(rng.standard_normal((B, S, h)), jnp.float32)
+    router = jnp.asarray(rng.standard_normal((h, E)), jnp.float32)
+    wg = jnp.asarray(rng.standard_normal((E, h, i)) * 0.1, jnp.float32)
+    wu = jnp.asarray(rng.standard_normal((E, h, i)) * 0.1, jnp.float32)
+    wd = jnp.asarray(rng.standard_normal((E, i, h)) * 0.1, jnp.float32)
+    for cf in (1.0, float(E) / k):  # droppy and drop-free
+        out_s, aux_s = moe_ffn_sorted(x, router, wg, wu, wd, k=k, capacity_factor=cf)
+        out_e, aux_e = moe_ffn_einsum(x, router, wg, wu, wd, k=k, capacity_factor=cf)
+        np.testing.assert_allclose(np.asarray(out_s), np.asarray(out_e), atol=1e-5)
+        np.testing.assert_allclose(float(aux_s), float(aux_e), rtol=1e-6)
+
+
+def test_sorted_dispatch_memory_is_subquadratic():
+    """At S=2048/E=8 with Mixtral's drop-free capacity, the einsum path's
+    dispatch tensor is (B,S,E,C≈S) ≈ 34M elements; the sorted path must
+    compile with every HLO buffer well under that (O(S·k) routing state)."""
+    import re
+
+    from accelerate_tpu.ops.moe import moe_ffn_sorted
+
+    B, S, h, i, E, k = 1, 2048, 64, 128, 8, 2
+    cf = float(E) / k  # drop-free
+    x = jax.ShapeDtypeStruct((B, S, h), jnp.float32)
+    router = jax.ShapeDtypeStruct((h, E), jnp.float32)
+    wg = jax.ShapeDtypeStruct((E, h, i), jnp.float32)
+    wu = jax.ShapeDtypeStruct((E, h, i), jnp.float32)
+    wd = jax.ShapeDtypeStruct((E, i, h), jnp.float32)
+    fn = lambda *a: moe_ffn_sorted(*a, k=k, capacity_factor=cf)[0]
+    hlo = jax.jit(fn).lower(x, router, wg, wu, wd).compile().as_text()
+    biggest = 0
+    for shape in re.findall(r"\w+\[([0-9,]+)\]", hlo):
+        n = int(np.prod([int(d) for d in shape.split(",")]))
+        biggest = max(biggest, n)
+    dense_dispatch_elems = B * S * E * 2048  # (B,S,E,C≈S) the old path allocates
+    assert biggest < dense_dispatch_elems // 4, (
+        f"largest HLO buffer {biggest} elements — dispatch no longer O(S·k)?"
+    )
