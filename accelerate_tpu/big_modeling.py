@@ -375,7 +375,9 @@ class StreamedScanModel:
                  cache=None, **kw):
         nonlayer = self._resident_nonlayer_params()
         if cache is not None:
-            return self._call_cached(nonlayer, input_ids, labels, attention_mask, cache)
+            return self._call_cached(
+                nonlayer, input_ids, labels, attention_mask, cache, positions=positions
+            )
         x, ctx = self._embed_fn(nonlayer, input_ids, positions, attention_mask)
         # Double-buffered streaming: prefetch layer i+1 while layer i computes.
         next_layer = jax.device_put(self._layer_host_slice(0), self.execution_device)
@@ -388,20 +390,24 @@ class StreamedScanModel:
             x = self._block_fn(layer, x, ctx)
         return self._head_fn(nonlayer, x, labels, attention_mask)
 
-    def _call_cached(self, nonlayer, input_ids, labels, attention_mask, cache):
-        """Incremental forward through the per-layer KV cache, weights streamed."""
+    def _call_cached(self, nonlayer, input_ids, labels, attention_mask, cache,
+                     positions=None):
+        """Incremental forward through the per-layer KV cache, weights streamed.
+        ``positions`` = token positions for the embedding (mask-derived for
+        ragged batches); slot indices always drive the causal mask."""
         B, S = input_ids.shape
         pos = cache["pos"]
         q_positions = jnp.broadcast_to(
             pos + jnp.arange(S, dtype=jnp.int32)[None], (B, S)
         )
+        embed_positions = q_positions if positions is None else positions
         chunk_mask = (
             jnp.asarray(attention_mask, jnp.int32)
             if attention_mask is not None
             else jnp.ones((B, S), jnp.int32)
         )
         kv_mask = jax.lax.dynamic_update_slice(cache["kv_mask"], chunk_mask, (0, pos))
-        x, ctx = self._embed_fn(nonlayer, input_ids, q_positions, attention_mask)
+        x, ctx = self._embed_fn(nonlayer, input_ids, embed_positions, attention_mask)
         ctx = dict(ctx)
         ctx["positions"] = q_positions
         ctx["kv_mask"] = kv_mask

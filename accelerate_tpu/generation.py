@@ -15,9 +15,10 @@ Here generation is part of the framework, built TPU-first:
   control flow (no data-dependent early exit inside jit).
 - **Ragged batches are left-aligned internally**: right-padded prompts are
   rolled so every row's last real token sits at index S-1 — all rows then share
-  one global decode position (SPMD-uniform), and because RoPE attention depends
-  only on position *differences* within a row, the per-row constant offset the
-  roll introduces cancels exactly (leading pads are masked via kv_mask).
+  one global cache write offset (SPMD-uniform). Embedding positions are derived
+  from the attention mask (``mask_positions``), NOT the cache slot index, so
+  absolute-position models (GPT-2's learned wpe) are exact on ragged batches;
+  causal masking still runs on slot indices (leading pads masked via kv_mask).
 - **Offloaded models stream instead**: for ``StreamedScanModel`` (layer weights
   on host/disk) each token's forward streams layer slices just-in-time — the
   per-token Python loop is the point there, since HBM never holds the model.
@@ -65,6 +66,16 @@ def left_align(input_ids, attention_mask):
     shifts = S - jnp.sum(attention_mask, axis=-1).astype(jnp.int32)  # pad count per row
     roll = jax.vmap(lambda row, s: jnp.roll(row, s, axis=0))
     return roll(input_ids, shifts), roll(attention_mask, shifts)
+
+
+def mask_positions(attention_mask):
+    """Token positions from the attention mask: position = count of real
+    tokens before it (cumsum - 1, clipped). Real positions are what
+    absolute-position models (GPT-2's learned ``wpe``) must see for ragged
+    batches — the cache slot index counts pads (VERDICT r2 #6); for RoPE the
+    per-row difference is a constant that cancels, so one code path serves
+    both families."""
+    return jnp.clip(jnp.cumsum(attention_mask.astype(jnp.int32), axis=-1) - 1, 0)
 
 
 def _unwrap(model):
@@ -155,27 +166,32 @@ def generate(
 
 
 def _scan_decode(first_out, step_apply, rng, max_new_tokens, temperature, top_k,
-                 top_p, eos, pad_token_id):
+                 top_p, eos, pad_token_id, positions0=None):
     """Shared sample + finished-mask + lax.scan loop for both decode paths.
 
-    ``first_out`` is the prefill's ModelOutput; ``step_apply(tok, cache)`` runs
-    one cached decode step and returns the next ModelOutput."""
+    ``first_out`` is the prefill's ModelOutput; ``step_apply(tok, cache, pos)``
+    runs one cached decode step (``pos`` (B,) = each row's next token
+    position, threaded through the carry; encoder-decoder ignores it)."""
+    B = first_out["logits"].shape[0]
+    if positions0 is None:
+        positions0 = jnp.zeros((B,), jnp.int32)
     rng0, rng_loop = jax.random.split(rng)
     tok = sample_logits(first_out["logits"][:, -1], rng0, temperature, top_k, top_p)
     finished = tok == eos
     tok = jnp.where(finished, pad_token_id, tok)
 
     def step(carry, _):
-        cache, tok, finished, rng = carry
+        cache, tok, pos, finished, rng = carry
         rng, sub = jax.random.split(rng)
-        out = step_apply(tok, cache)
+        out = step_apply(tok, cache, pos)
         nxt = sample_logits(out["logits"][:, -1], sub, temperature, top_k, top_p)
         newly = finished | (nxt == eos)
         nxt = jnp.where(newly, pad_token_id, nxt)
-        return (out["cache"], nxt, newly, rng), nxt
+        return (out["cache"], nxt, pos + 1, newly, rng), nxt
 
-    (_, _, _, _), rest = jax.lax.scan(
-        step, (first_out["cache"], tok, finished, rng_loop), None, length=max_new_tokens - 1
+    (_, _, _, _, _), rest = jax.lax.scan(
+        step, (first_out["cache"], tok, positions0, finished, rng_loop), None,
+        length=max_new_tokens - 1,
     )
     return jnp.concatenate([tok[:, None], rest.T], axis=1)
 
@@ -195,11 +211,16 @@ def _compiled_generate(module, max_new_tokens, temperature, top_k, top_p,
         cache = module.init_cache(B, total, dtype=cache_dtype)
 
         input_ids, attention_mask = left_align(input_ids, attention_mask)
+        # Token positions from the mask (not cache slots): exact for GPT-2's
+        # learned wpe on ragged batches; a no-op difference under RoPE.
+        real_len = jnp.sum(attention_mask, axis=-1).astype(jnp.int32)
         out = module.apply(params, input_ids=input_ids, attention_mask=attention_mask,
-                           cache=cache)
-        step_apply = lambda tok, cache: module.apply(params, input_ids=tok[:, None], cache=cache)
+                           cache=cache, positions=mask_positions(attention_mask))
+        step_apply = lambda tok, cache, pos: module.apply(
+            params, input_ids=tok[:, None], cache=cache, positions=pos[:, None]
+        )
         return _scan_decode(out, step_apply, rng, max_new_tokens, temperature,
-                            top_k, top_p, eos, pad_token_id)
+                            top_k, top_p, eos, pad_token_id, positions0=real_len)
 
     fn = jax.jit(run)
     cache_store[key] = fn
@@ -224,7 +245,7 @@ def _compiled_generate_encdec(module, max_new_tokens, temperature, top_k, top_p,
 
         start = jnp.full((B, 1), module.config.decoder_start_token_id, jnp.int32)
         out = module.decode(params, start, cache, enc_out, enc_mask, cross_kv=cross_kv)
-        step_apply = lambda tok, cache: module.decode(
+        step_apply = lambda tok, cache, pos: module.decode(
             params, tok[:, None], cache, enc_out, enc_mask, cross_kv=cross_kv
         )
         return _scan_decode(out, step_apply, rng, max_new_tokens, temperature,
@@ -245,7 +266,9 @@ def _generate_streamed(model, input_ids, attention_mask, max_new_tokens,
     mask = attention_mask if attention_mask is not None else jnp.ones((B, S), jnp.int32)
 
     input_ids, mask = left_align(input_ids, mask)
-    out = model(input_ids=input_ids, attention_mask=mask, cache=cache)
+    next_pos = jnp.sum(mask, axis=-1).astype(jnp.int32)
+    out = model(input_ids=input_ids, attention_mask=mask, cache=cache,
+                positions=mask_positions(mask))
     last_logits = out["logits"][:, -1]
     rng, sub = jax.random.split(rng)
     tok = sample_logits(last_logits, sub, temperature, top_k, top_p)
@@ -256,7 +279,8 @@ def _generate_streamed(model, input_ids, attention_mask, max_new_tokens,
     tokens = [tok]
     for _ in range(max_new_tokens - 1):
         rng, sub = jax.random.split(rng)
-        out = model(input_ids=tok[:, None], cache=cache)
+        out = model(input_ids=tok[:, None], cache=cache, positions=next_pos[:, None])
+        next_pos = next_pos + 1
         cache = out["cache"]
         nxt = sample_logits(out["logits"][:, -1], sub, temperature, top_k, top_p)
         newly = finished | (nxt == eos)

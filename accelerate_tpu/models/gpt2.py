@@ -228,19 +228,25 @@ class GPT2(Module):
             "kv_mask": jnp.zeros((batch_size, max_len), jnp.int32),
         }
 
-    def _apply_cached(self, params, input_ids, attention_mask, cache, labels=None):
+    def _apply_cached(self, params, input_ids, attention_mask, cache, labels=None,
+                      positions=None):
+        """``positions`` (optional) are the *token* positions for the learned
+        ``wpe`` lookup — essential for ragged batches, where the cache slot
+        index ≠ the token's real position (VERDICT r2 #6). Causal masking
+        always uses slot indices."""
         B, S = input_ids.shape
         pos = cache["pos"]
-        positions = pos + jnp.arange(S, dtype=jnp.int32)[None]
-        positions = jnp.broadcast_to(positions, (B, S))
+        slot_positions = pos + jnp.arange(S, dtype=jnp.int32)[None]
+        slot_positions = jnp.broadcast_to(slot_positions, (B, S))
+        wpe_positions = slot_positions if positions is None else positions
         chunk_mask = (
             attention_mask.astype(jnp.int32)
             if attention_mask is not None
             else jnp.ones((B, S), jnp.int32)
         )
         kv_mask = jax.lax.dynamic_update_slice(cache["kv_mask"], chunk_mask, (0, pos))
-        x, ctx = self.embed(params, input_ids, positions, attention_mask)
-        ctx["positions"] = positions
+        x, ctx = self.embed(params, input_ids, wpe_positions, attention_mask)
+        ctx["positions"] = slot_positions
         ctx["kv_mask"] = kv_mask
         ctx["cache_pos"] = pos
 
@@ -269,7 +275,9 @@ class GPT2(Module):
     ):
         cfg = self.config
         if cache is not None:
-            return self._apply_cached(params, input_ids, attention_mask, cache, labels=labels)
+            return self._apply_cached(
+                params, input_ids, attention_mask, cache, labels=labels, positions=positions
+            )
         x, ctx = self.embed(params, input_ids, positions, attention_mask)
 
         if pipeline is not None:
