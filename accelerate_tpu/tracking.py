@@ -75,8 +75,34 @@ class GeneralTracker:
     def log_images(self, values: dict, step: int | None = None, **kwargs):
         raise NotImplementedError(f"{self.name} does not support image logging")
 
+    def log_table(self, table_name: str, columns: list | None = None,
+                  data: list | None = None, dataframe=None, step: int | None = None,
+                  **kwargs):
+        """Log tabular data (reference wandb ``log_table`` :370-395). Either
+        ``columns``+``data`` (list of rows) or a ``dataframe``."""
+        raise NotImplementedError(f"{self.name} does not support table logging")
+
     def finish(self):
         pass
+
+
+def _table_rows(columns, data, dataframe):
+    """Normalize (columns, data, dataframe) to (columns, list-of-rows)."""
+    if dataframe is not None:
+        return list(dataframe.columns), dataframe.values.tolist()
+    if data is None:
+        raise ValueError("log_table needs either data or dataframe")
+    return columns, data
+
+
+def _markdown_table(columns, rows) -> str:
+    cols = columns
+    if not cols:
+        cols = [f"c{i}" for i in range(len(rows[0]))] if rows else []
+    lines = ["| " + " | ".join(str(c) for c in cols) + " |",
+             "| " + " | ".join("---" for _ in cols) + " |"]
+    lines += ["| " + " | ".join(str(v) for v in row) + " |" for row in rows]
+    return "\n".join(lines)
 
 
 @_register
@@ -111,6 +137,47 @@ class JSONTracker(GeneralTracker):
         record.update({k: (float(v) if hasattr(v, "__float__") else v) for k, v in values.items()})
         with open(self.path, "a") as f:
             f.write(json.dumps(record, default=str) + "\n")
+
+    @on_main_process
+    def log_images(self, values: dict, step: int | None = None, **kwargs):
+        """File-path fallback (no image backend required): each array lands in
+        ``<dir>/images/`` as ``.npy`` (plus ``.png`` when PIL is importable)
+        and ``images.jsonl`` records the paths per step."""
+        import numpy as _np
+
+        img_dir = os.path.join(self.dir, "images")
+        os.makedirs(img_dir, exist_ok=True)
+        index = {"_step": step}
+        for key, imgs in values.items():
+            if hasattr(imgs, "ndim") and getattr(imgs, "ndim", 0) <= 3:
+                imgs = [imgs]
+            paths = []
+            for i, img in enumerate(imgs):
+                arr = _np.asarray(img)
+                base = os.path.join(img_dir, f"{key.replace('/', '_')}_{step}_{i}")
+                _np.save(base + ".npy", arr)
+                paths.append(base + ".npy")
+                try:
+                    from PIL import Image  # optional
+
+                    u8 = arr if arr.dtype == _np.uint8 else (
+                        _np.clip(arr, 0, 1) * 255).astype(_np.uint8)
+                    Image.fromarray(u8).save(base + ".png")
+                    paths.append(base + ".png")
+                except Exception:
+                    pass
+            index[key] = paths
+        with open(os.path.join(self.dir, "images.jsonl"), "a") as f:
+            f.write(json.dumps(index) + "\n")
+
+    @on_main_process
+    def log_table(self, table_name: str, columns: list | None = None,
+                  data: list | None = None, dataframe=None, step: int | None = None,
+                  **kwargs):
+        columns, rows = _table_rows(columns, data, dataframe)
+        with open(os.path.join(self.dir, "tables.jsonl"), "a") as f:
+            f.write(json.dumps({"_step": step, "name": table_name,
+                                "columns": columns, "rows": rows}, default=str) + "\n")
 
 
 @_register
@@ -168,6 +235,29 @@ class TensorBoardTracker(GeneralTracker):
         self.writer.flush()
 
     @on_main_process
+    def log_images(self, values: dict, step: int | None = None, **kwargs):
+        """Reference TensorBoard ``log_images`` (:285): NHWC arrays per key."""
+        import numpy as _np
+
+        for key, imgs in values.items():
+            arr = _np.asarray(imgs)
+            if arr.ndim == 3:  # single HWC image
+                self.writer.add_image(key, arr, global_step=step, dataformats="HWC")
+            else:  # batch NHWC
+                self.writer.add_images(key, arr, global_step=step, dataformats="NHWC")
+        self.writer.flush()
+
+    @on_main_process
+    def log_table(self, table_name: str, columns: list | None = None,
+                  data: list | None = None, dataframe=None, step: int | None = None,
+                  **kwargs):
+        """Rendered as a markdown table via add_text (TensorBoard has no native
+        table artifact)."""
+        columns, rows = _table_rows(columns, data, dataframe)
+        self.writer.add_text(table_name, _markdown_table(columns, rows), global_step=step)
+        self.writer.flush()
+
+    @on_main_process
     def finish(self):
         self.writer.close()
 
@@ -209,6 +299,16 @@ class WandBTracker(GeneralTracker):
         import wandb
 
         self.run.log({k: [wandb.Image(img) for img in v] for k, v in values.items()}, step=step)
+
+    @on_main_process
+    def log_table(self, table_name: str, columns: list | None = None,
+                  data: list | None = None, dataframe=None, step: int | None = None,
+                  **kwargs):
+        """Reference wandb ``log_table`` (:370-395)."""
+        import wandb
+
+        table = wandb.Table(columns=columns, data=data, dataframe=dataframe)
+        self.run.log({table_name: table}, step=step)
 
     @on_main_process
     def finish(self):
