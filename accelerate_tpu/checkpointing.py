@@ -68,13 +68,25 @@ def _queue_save(path, tree):
 def finish_pending_saves():
     """Block until every queued (non-blocking) checkpoint write has committed.
 
-    Called automatically by ``load_accelerator_state`` and by the rotation
-    logic, so a resume can never read — nor rotation delete — a half-written
-    folder from this process."""
+    Called automatically by ``load_accelerator_state`` and by every
+    automatic-naming save, so a resume can never read — nor rotation delete —
+    a half-written folder from this process."""
     while _PENDING_SAVES:
         ck = _PENDING_SAVES.pop()
         ck.wait_until_finished()
         ck.close()  # release the background writer thread/resources
+
+
+def _reap_pending(max_pending: int = 4):
+    """Bound the queue of unjoined background checkpointers: a long run calling
+    ``save_state(blocking=False)`` to explicit output dirs (no rotation, no
+    load) would otherwise accumulate writer threads indefinitely. Joining the
+    oldest is cheap once its write has committed — and if it hasn't, blocking
+    here is the backpressure we want."""
+    while len(_PENDING_SAVES) > max_pending:
+        ck = _PENDING_SAVES.pop(0)
+        ck.wait_until_finished()
+        ck.close()
 
 
 def _flatten_params(params, prefix=""):
@@ -105,19 +117,23 @@ def save_accelerator_state(accelerator, output_dir: str | None = None, safe_seri
         else:
             raise ValueError("output_dir required unless automatic_checkpoint_naming is set")
     output_dir = os.path.abspath(output_dir)
+    _reap_pending()  # bound the background-writer queue on every save path
     if project.automatic_checkpoint_naming:
-        folders = [
-            f for f in (os.listdir(output_dir) if os.path.isdir(output_dir) else [])
-            if f.startswith(f"{CHECKPOINT_DIR_PREFIX}_")
-        ]
-        if project.total_limit is not None and len(folders) + 1 > project.total_limit:
-            # Rotation: drop oldest (reference :3301-3323). EVERY process joins
-            # its own queued writers and all rendezvous before rank 0 deletes —
-            # rmtree under any host's in-flight write destroys the checkpoint
-            # and poisons that writer with a deferred ENOENT.
-            finish_pending_saves()
-            accelerator.wait_for_everyone()
-            if accelerator.is_main_process:
+        # EVERY process joins its own queued writers and all rendezvous
+        # BEFORE the rotation decision: the decision reads each process's own
+        # os.listdir, and a divergent listing (non-shared dir, racing rmtree)
+        # must never strand a subset of ranks in a conditional barrier. Also,
+        # rmtree under any host's in-flight write would destroy the checkpoint
+        # and poison that writer with a deferred ENOENT (reference rotation
+        # :3301-3323).
+        finish_pending_saves()
+        accelerator.wait_for_everyone()
+        if project.total_limit is not None and accelerator.is_main_process:
+            folders = [
+                f for f in (os.listdir(output_dir) if os.path.isdir(output_dir) else [])
+                if f.startswith(f"{CHECKPOINT_DIR_PREFIX}_")
+            ]
+            if len(folders) + 1 > project.total_limit:
                 folders.sort(key=lambda f: int(f.rsplit("_", 1)[-1]))
                 for stale in folders[: len(folders) + 1 - project.total_limit]:
                     shutil.rmtree(os.path.join(output_dir, stale), ignore_errors=True)
@@ -130,19 +146,28 @@ def save_accelerator_state(accelerator, output_dir: str | None = None, safe_seri
     accelerator.wait_for_everyone()
 
     # Sharded model params, one dir per model.
+    expected_items = []
     for i, model in enumerate(accelerator._models):
         suffix = "" if i == 0 else f"_{i}"
         _queue_save(os.path.join(output_dir, f"{MODEL_NAME}{suffix}"), model.handle.params)
+        expected_items.append(f"{MODEL_NAME}{suffix}")
     # Sharded optimizer state.
     for i, opt in enumerate(accelerator._optimizers):
         suffix = "" if i == 0 else f"_{i}"
         if opt.opt_state is not None:
             _queue_save(os.path.join(output_dir, f"{OPTIMIZER_NAME}{suffix}"), opt.opt_state)
+            expected_items.append(f"{OPTIMIZER_NAME}{suffix}")
         _host_pickle(
             os.path.join(output_dir, f"{OPTIMIZER_NAME}{suffix}.meta.pkl"),
             {"step_count": opt._step_count, "scale": opt.scaler.scale if opt.scaler else None},
             accelerator,
         )
+    # Manifest of queued orbax items: each commits atomically (tmp-dir rename),
+    # so on load "every listed dir exists and no tmp litter" == "all array
+    # writes from this save committed" — even for saves queued non-blocking.
+    _host_pickle_json(
+        os.path.join(output_dir, "manifest.json"), {"items": expected_items}, accelerator
+    )
     if blocking:
         finish_pending_saves()
     # Schedulers / samplers / dataloaders / custom objects: host-side pickles.
@@ -177,6 +202,43 @@ def _host_pickle(path, obj, accelerator, all_processes: bool = False):
             pickle.dump(obj, f)
 
 
+def _host_pickle_json(path, obj, accelerator):
+    if accelerator.is_main_process:
+        with open(path, "w") as f:
+            json.dump(obj, f)
+
+
+def _checkpoint_complete(path: str, accelerator) -> bool:
+    """Did this checkpoint folder's array writes commit?
+
+    Orbax commits each item atomically (tmp-suffixed dir renamed on commit), so
+    an interrupted non-blocking save leaves ``*.orbax-checkpoint-tmp*`` litter
+    and/or missing item dirs while the host-side pickles already exist. The
+    save-time ``manifest.json`` lists every queued item (model AND optimizer
+    state — a missing optimizer item would otherwise resume with silently
+    reinitialized moments); pre-manifest checkpoints fall back to checking the
+    model item dirs."""
+    try:
+        entries = os.listdir(path)
+    except OSError:
+        return False
+    if any(".orbax-checkpoint-tmp" in e for e in entries):
+        return False
+    manifest_path = os.path.join(path, "manifest.json")
+    if os.path.isfile(manifest_path):
+        try:
+            with open(manifest_path) as f:
+                items = json.load(f)["items"]
+        except (OSError, ValueError, KeyError):
+            return False
+        return all(os.path.isdir(os.path.join(path, item)) for item in items)
+    for i, _ in enumerate(accelerator._models):
+        suffix = "" if i == 0 else f"_{i}"
+        if not os.path.isdir(os.path.join(path, f"{MODEL_NAME}{suffix}")):
+            return False
+    return True
+
+
 def load_accelerator_state(accelerator, input_dir: str | None = None, **kwargs):
     """Reference ``load_accelerator_state`` :179 + driver :3426."""
     finish_pending_saves()  # never resume from a checkpoint still being written
@@ -189,7 +251,16 @@ def load_accelerator_state(accelerator, input_dir: str | None = None, **kwargs):
             (f for f in os.listdir(base) if f.startswith(f"{CHECKPOINT_DIR_PREFIX}_")),
             key=lambda f: int(f.rsplit("_", 1)[-1]),
         )
-        input_dir = os.path.join(base, folders[-1])
+        # Newest complete folder wins: a crash mid non-blocking save leaves the
+        # newest checkpoint_N partially written — fall back rather than fail.
+        for f in reversed(folders):
+            candidate = os.path.join(base, f)
+            if _checkpoint_complete(candidate, accelerator):
+                input_dir = candidate
+                break
+            logger.warning(f"Skipping incomplete checkpoint {candidate}")
+        if input_dir is None:
+            raise FileNotFoundError(f"No complete checkpoint found under {base}")
     input_dir = os.path.abspath(input_dir)
 
     ckptr = _checkpointer()

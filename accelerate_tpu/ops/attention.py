@@ -66,6 +66,11 @@ def dense_attention(q, k, v, *, causal=True, mask=None, positions_q=None, positi
 
     ``window``: sliding-window size (Mistral recipe) — a query attends keys
     with ``0 <= q_pos - k_pos < window`` (plus itself); None = full causal."""
+    if window is not None and not causal:
+        # Clipping only past keys while future keys stay fully visible matches
+        # no known model recipe; reject rather than compute silently-asymmetric
+        # semantics (advisor r2).
+        raise ValueError("window requires causal=True (bidirectional windows unsupported)")
     scale = 1.0 / np.sqrt(q.shape[-1])
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
     bias = jnp.zeros_like(scores)
@@ -109,7 +114,7 @@ def flash_attention(q, k, v, *, causal=True, mask=None):
     vt = jnp.swapaxes(v, 1, 2)
     segment_ids = None
     if mask is not None:
-        seg = mask.astype(jnp.int32) + 1  # real tokens: 2, padding: 1 — pads only see pads
+        # real tokens: segment 2, padding: segment 1 — pads only see pads
         seg = jnp.where(mask.astype(bool), 2, 1).astype(jnp.int32)
         segment_ids = SegmentIds(q=seg, kv=seg)
     out = _flash(qt, kt, vt, segment_ids=segment_ids, causal=causal, sm_scale=scale)

@@ -105,6 +105,17 @@ class JaxShardingKwargs(KwargsHandler):
     donate_params: bool = True  # donate param/opt buffers to the step (halves HBM)
     remat_policy: str | None = None  # None|'minimal'|'full'|'dots_saveable'...
     spmd_auto: bool = False  # let XLA auto-partition instead of explicit rules
+    # Gradient-compression comm hook (reference DistributedDataParallelKwargs
+    # comm_hook fp16/bf16 compressors :130-226): cast gradients to this dtype
+    # *before* the cross-device reduction (all-reduce / reduce-scatter runs on
+    # half the bytes), converting back after. None = full-precision reduce.
+    grad_reduce_dtype: str | None = None  # None | 'bf16' | 'fp16'
+
+    def __post_init__(self):
+        if self.grad_reduce_dtype not in (None, "bf16", "fp16"):
+            raise ValueError(
+                f"grad_reduce_dtype must be None|'bf16'|'fp16', got {self.grad_reduce_dtype!r}"
+            )
 
 
 @dataclass

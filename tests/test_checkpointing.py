@@ -224,3 +224,75 @@ def test_nonblocking_save_roundtrip(tmp_path):
     np.testing.assert_allclose(
         float(acc2.get_state_dict(pmodel2)["a"]), saved_a, rtol=1e-6
     )
+
+
+def test_partial_checkpoint_fallback(tmp_path):
+    """A crash mid non-blocking save leaves the newest checkpoint_N folder
+    incomplete (orbax tmp litter / missing model item); auto-resume must fall
+    back to the last complete folder instead of failing (advisor r2)."""
+    import shutil
+
+    cfg = ProjectConfiguration(
+        project_dir=str(tmp_path), automatic_checkpoint_naming=True
+    )
+    accelerator = Accelerator(project_config=cfg)
+    model = RegressionModel()
+    model.init_params(None)
+    pmodel, popt, pdl = accelerator.prepare(
+        model, optax.adam(0.1), regression_batches(RegressionDataset(length=32), 8)
+    )
+    _train_some(accelerator, pmodel, popt, pdl, steps=1)
+    accelerator.save_state()  # checkpoint_0 (complete)
+    good = accelerator.get_state_dict(pmodel)
+    _train_some(accelerator, pmodel, popt, pdl, steps=1)
+    accelerator.save_state()  # checkpoint_1 — then simulate the crash:
+    ckpt1 = tmp_path / "checkpoints" / "checkpoint_1"
+    shutil.rmtree(ckpt1 / "model")  # arrays never committed
+    (ckpt1 / "model.orbax-checkpoint-tmp-123").mkdir()
+
+    pmodel.handle.params = jax.tree_util.tree_map(lambda p: p * 0 + 7.0, pmodel.handle.params)
+    accelerator.load_state()  # must pick checkpoint_0
+    restored = accelerator.get_state_dict(pmodel)
+    for key in good:
+        assert np.allclose(good[key], restored[key]), key
+
+
+def test_dense_attention_rejects_bidirectional_window():
+    from accelerate_tpu.ops.attention import dense_attention
+
+    q = jnp.zeros((1, 4, 2, 8))
+    with pytest.raises(ValueError, match="causal"):
+        dense_attention(q, q, q, causal=False, window=2)
+
+
+def test_sp_rejects_sliding_window_models():
+    """Windowed checkpoints (Mistral recipe) under sp>1 must fail at prepare
+    with an actionable message, not at trace time (advisor r2)."""
+    from accelerate_tpu.models import Llama, LlamaConfig
+    from accelerate_tpu.state import AcceleratorState, GradientState
+
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+    accelerator = Accelerator(parallelism_config=ParallelismConfig(sp_size=2))
+    model = Llama(LlamaConfig.tiny(sliding_window=8))
+    model.init_params(jax.random.key(0))
+    with pytest.raises(ValueError, match="sliding-window"):
+        accelerator.prepare_model(model)
+
+
+def test_accum_steps_change_after_build_raises():
+    from accelerate_tpu.models import Llama, LlamaConfig
+    from accelerate_tpu.state import AcceleratorState, GradientState
+
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+    accelerator = Accelerator(gradient_accumulation_steps=2)
+    model = Llama(LlamaConfig.tiny())
+    model.init_params(jax.random.key(0))
+    pmodel, popt = accelerator.prepare(model, optax.sgd(0.1))
+    step = accelerator.build_train_step(pmodel, popt)
+    ids = np.zeros((4, 8), np.int32)
+    step({"input_ids": ids, "labels": ids})
+    accelerator.gradient_accumulation_steps = 4
+    with pytest.raises(RuntimeError, match="gradient_accumulation_steps"):
+        step({"input_ids": ids, "labels": ids})
