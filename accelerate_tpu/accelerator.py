@@ -622,6 +622,24 @@ class Accelerator:
         # attention through the sequence-parallel op — ppermute ring (default)
         # or Ulysses all-to-all (SequenceParallelPlugin(ring_attention=False)).
         if self.mesh.shape.get("sp", 1) > 1:
+            lw = getattr(model_cfg, "layer_windows", None) if model_cfg is not None else None
+            if lw is not None and any(w is not None for w in lw):
+                raise ValueError(
+                    "Sequence parallelism (sp>1) does not support per-layer "
+                    "windowed attention (layer_windows); train with sp=1 or use "
+                    "fsdp/tp for memory."
+                )
+            if model_cfg is not None and (
+                getattr(model_cfg, "attn_logit_softcap", None) is not None
+                or getattr(model_cfg, "query_pre_attn_scalar", None) is not None
+            ):
+                # Gemma-2 score shaping is dense-only; fail at prepare, not at
+                # trace time inside the first compiled step.
+                raise ValueError(
+                    "Sequence parallelism (sp>1) does not support attention "
+                    "softcapping / query_pre_attn_scalar (Gemma-2); train with "
+                    "sp=1 and use fsdp/tp for memory."
+                )
             if model_cfg is not None and getattr(model_cfg, "sliding_window", None):
                 # Fail here, not deep inside the first compiled step: the
                 # sequence-parallel attention paths reject window masks

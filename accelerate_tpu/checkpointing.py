@@ -133,9 +133,19 @@ def save_accelerator_state(accelerator, output_dir: str | None = None, safe_seri
                 f for f in (os.listdir(output_dir) if os.path.isdir(output_dir) else [])
                 if f.startswith(f"{CHECKPOINT_DIR_PREFIX}_")
             ]
-            if len(folders) + 1 > project.total_limit:
-                folders.sort(key=lambda f: int(f.rsplit("_", 1)[-1]))
-                for stale in folders[: len(folders) + 1 - project.total_limit]:
+            # Incomplete folders (crashed mid-save) are junk regardless of the
+            # limit — drop them first so rotation never counts them against
+            # (and deletes) the complete checkpoints the resume fallback needs.
+            complete = []
+            for f in folders:
+                if _checkpoint_complete(os.path.join(output_dir, f), accelerator):
+                    complete.append(f)
+                else:
+                    logger.warning(f"Rotating out incomplete checkpoint {f}")
+                    shutil.rmtree(os.path.join(output_dir, f), ignore_errors=True)
+            if len(complete) + 1 > project.total_limit:
+                complete.sort(key=lambda f: int(f.rsplit("_", 1)[-1]))
+                for stale in complete[: len(complete) + 1 - project.total_limit]:
                     shutil.rmtree(os.path.join(output_dir, stale), ignore_errors=True)
         output_dir = os.path.join(output_dir, f"{CHECKPOINT_DIR_PREFIX}_{project.iteration}")
         if os.path.isdir(output_dir):

@@ -208,6 +208,61 @@ def gemma_params_from_hf(state_dict, config: LlamaConfig, dtype=jnp.float32) -> 
     return params
 
 
+# -------------------------------------------------------------------- gemma2
+def gemma2_config_from_hf(hf_config) -> LlamaConfig:
+    """Gemma-2 = Gemma (GeGLU, scaled embeddings, decoupled heads, (1+w)
+    norms) + sandwich norms, tanh softcapping on attention scores and final
+    logits, query_pre_attn_scalar scaling, and alternating local/global
+    attention (``layer_types``) — all expressible on the zoo's LlamaConfig
+    (the per-layer windows drive the segmented layer scan; VERDICT r2 #5)."""
+    get = _getter(hf_config)
+    act = get("hidden_activation") or "gelu_pytorch_tanh"
+    if act != "gelu_pytorch_tanh":
+        raise ValueError(
+            f"hidden_activation={act!r} is not supported for Gemma-2 (tanh-gelu only)"
+        )
+    cfg = llama_config_from_hf(hf_config, check_act=False)
+    import dataclasses
+
+    L = get("num_hidden_layers")
+    window = get("sliding_window", 4096)
+    layer_types = get("layer_types")
+    if layer_types is None:  # HF default: odd-numbered (1-based) layers slide
+        layer_types = [
+            "sliding_attention" if (i + 1) % 2 else "full_attention" for i in range(L)
+        ]
+    layer_windows = tuple(
+        window if t == "sliding_attention" else None for t in layer_types
+    )
+    return dataclasses.replace(
+        cfg,
+        hidden_act="gelu_tanh",
+        embedding_multiplier=float(get("hidden_size")) ** 0.5,
+        tie_word_embeddings=True,
+        sliding_window=None,
+        layer_windows=layer_windows,
+        sandwich_norms=True,
+        attn_logit_softcap=get("attn_logit_softcapping", 50.0),
+        final_logit_softcap=get("final_logit_softcapping", 30.0),
+        query_pre_attn_scalar=float(get("query_pre_attn_scalar", 256)),
+    )
+
+
+def gemma2_params_from_hf(state_dict, config: LlamaConfig, dtype=jnp.float32) -> dict:
+    # Shared trees (incl. the (1+weight) fold on input/post-attn/final norms)
+    # come from the Gemma-1 converter; only the two sandwich norms are new.
+    params = gemma_params_from_hf(state_dict, config, dtype=dtype)
+    sd = _normalize_keys(state_dict)
+    L = config.num_hidden_layers
+    params["layers"]["pre_ffw_norm"] = {
+        "weight": _stack(sd, "layers.{i}.pre_feedforward_layernorm.weight", L, dtype=dtype) + 1.0
+    }
+    params["layers"]["post_ffw_norm"] = {
+        "weight": _stack(sd, "layers.{i}.post_feedforward_layernorm.weight", L, dtype=dtype) + 1.0
+    }
+    return params
+
+
 # --------------------------------------------------------------------- qwen2
 def qwen2_config_from_hf(hf_config) -> LlamaConfig:
     """Qwen2 = the Llama recipe + QKV biases; map onto LlamaConfig with
@@ -216,23 +271,24 @@ def qwen2_config_from_hf(hf_config) -> LlamaConfig:
     cfg = llama_config_from_hf(hf_config)
     import dataclasses
 
-    # Qwen2 applies its window only to layers >= max_window_layers; the zoo's
-    # scan shares one mask across layers, so only the uniform cases map.
-    window = None
+    # Qwen2 windows layer i iff use_sliding_window and i >= max_window_layers
+    # (HF Qwen2Config layer_types default). Uniform cases map onto
+    # sliding_window; mixed cases drive the segmented layer scan via
+    # layer_windows (two runs: full then windowed; VERDICT r2 #5).
+    window, layer_windows = None, None
     if get("use_sliding_window"):
         L = get("num_hidden_layers")
         mwl = get("max_window_layers", 0) or 0
-        if mwl >= L:
+        w = get("sliding_window")
+        if mwl >= L or w is None:
             window = None  # no layer windowed
         elif mwl == 0:
-            window = get("sliding_window")  # every layer windowed
+            window = w  # every layer windowed
         else:
-            raise ValueError(
-                f"max_window_layers={mwl} mixes windowed and full-attention layers; "
-                "the zoo applies one attention mask to every layer — converting "
-                "would silently diverge from HF."
-            )
-    return dataclasses.replace(cfg, attention_bias=True, sliding_window=window)
+            layer_windows = (None,) * mwl + (w,) * (L - mwl)
+    return dataclasses.replace(
+        cfg, attention_bias=True, sliding_window=window, layer_windows=layer_windows
+    )
 
 
 # Qwen2's QKV-bias loading rides the generalized Llama converter (the config
@@ -564,6 +620,7 @@ _CONVERTERS = {
     # Llama converter handles both (sliding_window flows from the config).
     "mistral": (Llama, llama_config_from_hf, llama_params_from_hf),
     "gemma": (Llama, gemma_config_from_hf, gemma_params_from_hf),
+    "gemma2": (Llama, gemma2_config_from_hf, gemma2_params_from_hf),
 }
 
 

@@ -60,19 +60,30 @@ def repeat_kv(k, v, n_rep: int):
     return jnp.repeat(k, n_rep, axis=2), jnp.repeat(v, n_rep, axis=2)
 
 
+def softcap_scores(scores, cap):
+    """Gemma-2 logit softcapping: ``tanh(scores / cap) * cap`` (bounds the
+    magnitude smoothly while keeping gradients; applied before masks)."""
+    return jnp.tanh(scores / cap) * cap
+
+
 def dense_attention(q, k, v, *, causal=True, mask=None, positions_q=None, positions_kv=None,
-                    window=None):
+                    window=None, softcap=None, scale=None):
     """q: (B,S,H,D), k/v: (B,Skv,H,D); mask: (B,Skv) 1=real. fp32 softmax.
 
     ``window``: sliding-window size (Mistral recipe) — a query attends keys
-    with ``0 <= q_pos - k_pos < window`` (plus itself); None = full causal."""
+    with ``0 <= q_pos - k_pos < window`` (plus itself); None = full causal.
+    ``softcap``: tanh cap on the scores (Gemma-2). ``scale``: query scaling
+    override (Gemma-2's query_pre_attn_scalar**-0.5); default 1/sqrt(D)."""
     if window is not None and not causal:
         # Clipping only past keys while future keys stay fully visible matches
         # no known model recipe; reject rather than compute silently-asymmetric
         # semantics (advisor r2).
         raise ValueError("window requires causal=True (bidirectional windows unsupported)")
-    scale = 1.0 / np.sqrt(q.shape[-1])
+    if scale is None:
+        scale = 1.0 / np.sqrt(q.shape[-1])
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if softcap is not None:
+        scores = softcap_scores(scores, softcap)
     bias = jnp.zeros_like(scores)
     if causal or window is not None:
         if positions_q is None:
@@ -121,7 +132,8 @@ def flash_attention(q, k, v, *, causal=True, mask=None):
     return jnp.swapaxes(out, 1, 2)
 
 
-def cached_attention(q, k_cache, v_cache, *, q_positions, kv_mask=None, window=None):
+def cached_attention(q, k_cache, v_cache, *, q_positions, kv_mask=None, window=None,
+                     softcap=None, scale=None):
     """Attention of a query chunk against a pre-allocated KV cache (decode path).
 
     q: (B, S, H, D); k_cache/v_cache: (B, K, Hkv, D) with H = G·Hkv (GQA).
@@ -137,9 +149,12 @@ def cached_attention(q, k_cache, v_cache, *, q_positions, kv_mask=None, window=N
     B, S, H, D = q.shape
     K, Hkv = k_cache.shape[1], k_cache.shape[2]
     G = H // Hkv
-    scale = 1.0 / np.sqrt(D)
+    if scale is None:
+        scale = 1.0 / np.sqrt(D)
     qg = q.reshape(B, S, Hkv, G, D)
     scores = jnp.einsum("bshgd,bkhd->bhgsk", qg, k_cache).astype(jnp.float32) * scale
+    if softcap is not None:
+        scores = softcap_scores(scores, softcap)
     if q_positions.ndim == 1:
         q_positions = jnp.broadcast_to(q_positions[None], (B, S))
     delta = q_positions[:, None, None, :, None] - jnp.arange(K)[None, None, None, None, :]
@@ -154,17 +169,20 @@ def cached_attention(q, k_cache, v_cache, *, q_positions, kv_mask=None, window=N
     return out.reshape(B, S, H, D)
 
 
-def attention(q, k, v, *, causal=True, mask=None, impl: str = "auto", mesh=None, window=None):
+def attention(q, k, v, *, causal=True, mask=None, impl: str = "auto", mesh=None, window=None,
+              softcap=None, scale=None):
     """Unified entry used by the model zoo. ``impl``: auto|dense|flash|ring|ulysses.
-    ``window`` (sliding-window attention) is dense-only: the flash kernel and
-    the sequence-parallel paths fall back to dense when it is set."""
-    if window is not None:
+    ``window`` (sliding-window attention) and ``softcap``/``scale`` (Gemma-2
+    score shaping) are dense-only: the flash kernel and the sequence-parallel
+    paths fall back to dense when they are set."""
+    if window is not None or softcap is not None or scale is not None:
         if impl not in ("auto", "dense"):
             raise ValueError(
-                f"sliding-window attention is dense-only; impl={impl!r} cannot "
-                "apply a window (drop the window or use impl='dense'/'auto')."
+                f"window/softcap/scale attention options are dense-only; "
+                f"impl={impl!r} cannot apply them (use impl='dense'/'auto')."
             )
-        return dense_attention(q, k, v, causal=causal, mask=mask, window=window)
+        return dense_attention(q, k, v, causal=causal, mask=mask, window=window,
+                               softcap=softcap, scale=scale)
     if impl == "auto":
         impl = (
             "flash"

@@ -245,6 +245,13 @@ def resolve_pipeline_spec(module, params, mesh: Mesh, num_microbatches: int = 0)
     pp = mesh.shape.get("pp", 1)
     if pp <= 1 or not getattr(module, "pipeline_capable", False):
         return None
+    cfg = getattr(module, "config", None)
+    ws = getattr(cfg, "layer_windows", None)
+    if ws is not None and len(set(ws)) > 1:
+        # Mixed attention regimes need per-layer static config inside the
+        # stage body; the pipeline's uniform stage scan can't express that —
+        # fall back to the GSPMD layer-dim sharding.
+        return None
     layers = params.get("layers") if isinstance(params, dict) else None
     if not layers:
         return None

@@ -644,21 +644,24 @@ def test_mistral_windowed_generate_matches_hf():
     np.testing.assert_array_equal(np.asarray(ours)[0], theirs[0].numpy())
 
 
-def test_qwen2_mixed_window_layers_rejected():
+def test_qwen2_window_layer_mapping():
     from accelerate_tpu.models.convert import qwen2_config_from_hf
 
     base = dict(vocab_size=128, hidden_size=64, intermediate_size=128,
                 num_hidden_layers=8, num_attention_heads=4, num_key_value_heads=2)
-    with pytest.raises(ValueError, match="max_window_layers"):
-        qwen2_config_from_hf({**base, "use_sliding_window": True,
-                              "sliding_window": 16, "max_window_layers": 4})
-    # Uniform cases map cleanly: no layer windowed / all layers windowed.
+    # Mixed case (the round-2 converter raised here): per-layer windows drive
+    # the segmented layer scan — layers < max_window_layers stay full.
+    cfg = qwen2_config_from_hf({**base, "use_sliding_window": True,
+                                "sliding_window": 16, "max_window_layers": 4})
+    assert cfg.sliding_window is None
+    assert cfg.layer_windows == (None,) * 4 + (16,) * 4
+    # Uniform cases map onto the plain sliding_window field.
     cfg = qwen2_config_from_hf({**base, "use_sliding_window": True,
                                 "sliding_window": 16, "max_window_layers": 8})
-    assert cfg.sliding_window is None
+    assert cfg.sliding_window is None and cfg.layer_windows is None
     cfg = qwen2_config_from_hf({**base, "use_sliding_window": True,
                                 "sliding_window": 16, "max_window_layers": 0})
-    assert cfg.sliding_window == 16
+    assert cfg.sliding_window == 16 and cfg.layer_windows is None
 
 
 def test_window_with_explicit_kernel_impl_raises():
@@ -763,3 +766,91 @@ def test_gpt2_ragged_generate_matches_hf(hf_gpt2):
         np.testing.assert_array_equal(
             np.asarray(ours[i]), theirs[0, len(row):].numpy(), err_msg=f"row {i}"
         )
+
+
+@pytest.fixture(scope="module")
+def hf_gemma2():
+    cfg = transformers.Gemma2Config(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=4,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        head_dim=16,
+        max_position_embeddings=64,
+        sliding_window=4,  # small so the local layers actually clip at S=16
+        query_pre_attn_scalar=32.0,  # != head_dim: exercises the scale override
+        attn_logit_softcapping=50.0,
+        final_logit_softcapping=30.0,
+        hidden_activation="gelu_pytorch_tanh",
+        attn_implementation="eager",  # eager path implements softcapping
+    )
+    torch.manual_seed(5)
+    return transformers.Gemma2ForCausalLM(cfg).eval()
+
+
+def test_gemma2_logits_match_hf(hf_gemma2):
+    """Gemma-2: alternating local/global windows (segmented scan), sandwich
+    norms, softcaps, query_pre_attn_scalar — exact logits vs transformers
+    (VERDICT r2 #5)."""
+    from accelerate_tpu.models.convert import from_hf
+
+    model, params = from_hf(hf_gemma2)
+    assert model.config.sandwich_norms
+    assert model.config.layer_windows == (4, None, 4, None)
+    assert model._attention_segments() == [(0, 4, (4, None))]  # folded pairs
+    ids = np.random.default_rng(6).integers(0, 128, (2, 16)).astype(np.int32)
+    ours = model.apply(params, input_ids=ids)["logits"]
+    with torch.no_grad():
+        theirs = hf_gemma2(torch.tensor(ids, dtype=torch.long)).logits
+    _logits_close(ours, theirs, atol=2e-4)
+
+
+def test_gemma2_generate_matches_hf_greedy(hf_gemma2):
+    """Cached decode through the segmented (mixed-window) cache path."""
+    import jax.numpy as jnp
+
+    from accelerate_tpu.generation import generate
+    from accelerate_tpu.models.convert import from_hf
+
+    model, params = from_hf(hf_gemma2)
+    prompt = np.random.default_rng(7).integers(0, 128, (1, 8)).astype(np.int32)
+    ours = generate(model, prompt, max_new_tokens=8, temperature=0.0,
+                    cache_dtype=jnp.float32)
+    with torch.no_grad():
+        theirs = hf_gemma2.generate(
+            torch.tensor(prompt, dtype=torch.long),
+            max_new_tokens=8, eos_token_id=None, do_sample=False, pad_token_id=0,
+        )
+    np.testing.assert_array_equal(np.asarray(ours)[0], theirs[0].numpy())
+
+
+def test_qwen2_mixed_window_logits_match_hf():
+    """Qwen2 max_window_layers mixing full and windowed layers — the round-2
+    converter raised here; the segmented scan now maps it (VERDICT r2 #5)."""
+    from accelerate_tpu.models.convert import from_hf
+
+    cfg = transformers.Qwen2Config(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=3,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=64,
+        use_sliding_window=True,
+        sliding_window=4,
+        max_window_layers=1,  # layer 0 full, layers 1-2 windowed
+        attn_implementation="eager",
+    )
+    torch.manual_seed(9)
+    hf = transformers.Qwen2ForCausalLM(cfg).eval()
+    model, params = from_hf(hf)
+    assert model.config.layer_windows == (None, 4, 4)
+    assert model._attention_segments() == [(0, 1, (None,)), (1, 2, (4,))]
+    ids = np.random.default_rng(8).integers(0, 128, (2, 16)).astype(np.int32)
+    ours = model.apply(params, input_ids=ids)["logits"]
+    with torch.no_grad():
+        theirs = hf(torch.tensor(ids, dtype=torch.long)).logits
+    _logits_close(ours, theirs, atol=2e-4)

@@ -255,3 +255,40 @@ def test_shifted_label_mask_excludes_left_pad_positions():
     # the match is exact.
     loss_full = float(model.apply(model.params, input_ids=full, labels=full)["loss"])
     np.testing.assert_allclose(loss_left, loss_full, rtol=1e-6)
+
+
+def test_segmented_scan_matches_per_layer_loop():
+    """Mixed per-layer windows (the segmented layer driver) must equal a
+    manual layer-by-layer forward with the same windows."""
+    import jax
+
+    from accelerate_tpu.models import Llama, LlamaConfig
+
+    windows = (None, 2, 2, None)
+    cfg = LlamaConfig.tiny(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_attention_heads=2, num_key_value_heads=2, num_hidden_layers=4,
+        layer_windows=windows,
+    )
+    model = Llama(cfg)
+    params = model.init_params(jax.random.key(0))
+    ids = np.random.default_rng(0).integers(0, 64, (2, 8)).astype(np.int32)
+    out = model.apply(params, input_ids=ids)["logits"]
+
+    x, ctx = model.embed(params, jnp.asarray(ids))
+    for i, w in enumerate(windows):
+        layer = jax.tree_util.tree_map(lambda t: t[i], params["layers"])
+        x = model.block(layer, x, dict(ctx), window=w)
+    ref = model.head(params, x)["logits"]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_uniform_layer_windows_normalize_to_sliding_window():
+    """Uniform layer_windows must fold into sliding_window so consumers that
+    read only the uniform field (the pp stage scan) see the truth."""
+    from accelerate_tpu.models import LlamaConfig
+
+    cfg = LlamaConfig.tiny(num_hidden_layers=4, layer_windows=(8, 8, 8, 8))
+    assert cfg.sliding_window == 8 and cfg.layer_windows is None
+    cfg = LlamaConfig.tiny(num_hidden_layers=2, layer_windows=(None, None))
+    assert cfg.sliding_window is None and cfg.layer_windows is None
