@@ -57,6 +57,26 @@ def _is_torch_loader(obj) -> bool:
         return False
 
 
+def _find_order_generator(loader):
+    """Find the torch.Generator that drives the loader's sample order, walking
+    the sampler/batch_sampler chain (a prepared torch loader nests the real
+    RandomSampler inside BatchSamplerShard → torch BatchSampler, and torch
+    sets the outer ``loader.sampler`` to a SequentialSampler)."""
+    seen, frontier = set(), [loader]
+    for _ in range(4):  # loader → shard → batch_sampler → sampler is depth 3
+        nxt = []
+        for obj in frontier:
+            if id(obj) in seen or obj is None:
+                continue
+            seen.add(id(obj))
+            gen = getattr(obj, "generator", None)
+            if gen is not None and hasattr(gen, "get_state"):
+                return gen
+            nxt.extend([getattr(obj, "sampler", None), getattr(obj, "batch_sampler", None)])
+        frontier = nxt
+    return None
+
+
 def _to_numpy(batch):
     """Convert torch tensors / lists in a fetched batch to numpy leaves."""
 
@@ -333,10 +353,51 @@ class DataLoaderShard(DataLoaderStateMixin):
         self.iteration = 0
         self._num_batches_fetched = 0
         self._resume_batches = 0
+        # True sampler-state resume (VERDICT r2 #7): the RNG snapshot that
+        # generated the current epoch's shuffle order, a snapshot pending
+        # restoration from load_state_dict, and a pending base-loader state
+        # for stateful bases (torchdata StatefulDataLoader protocol).
+        self._epoch_rng = None
+        self._pending_rng = None
+        self._pending_base_state = None
+        self._base_state_live = None
         try:
             self.state = AcceleratorState()
         except Exception:
             self.state = PartialState()
+
+    # ------------------------------------------------- sampler-state capture
+    def _capture_sampler_rng(self):
+        """Snapshot the RNG that will generate THIS epoch's sample order:
+        the torch sampler's dedicated generator when it has one, else the
+        torch global stream (RandomSampler's fallback source). Captured
+        *before* ``iter()`` consumes it, so restoring the snapshot and
+        re-iterating replays the interrupted epoch's exact order — no
+        seedable sampler required."""
+        try:
+            import torch
+        except ImportError:
+            return None
+        gen = _find_order_generator(self.base_loader)
+        if gen is not None and hasattr(gen, "get_state"):
+            return ("generator", gen.get_state().numpy().tobytes())
+        if _is_torch_loader(self.base_loader):
+            return ("torch_global", torch.random.get_rng_state().numpy().tobytes())
+        return None
+
+    def _restore_sampler_rng(self, snapshot):
+        if snapshot is None:
+            return
+        import torch
+
+        kind, raw = snapshot
+        state = torch.from_numpy(np.frombuffer(raw, dtype=np.uint8).copy())
+        if kind == "generator":
+            gen = _find_order_generator(self.base_loader)
+            if gen is not None:
+                gen.set_state(state)
+        else:
+            torch.random.set_rng_state(state)
 
     # -------------------------------------------------------------- delegation
     @property
@@ -374,9 +435,12 @@ class DataLoaderShard(DataLoaderStateMixin):
     def set_epoch(self, epoch: int):
         if self.iteration != epoch:
             # A restored mid-epoch position belongs to epoch `iteration`;
-            # switching to a different epoch invalidates it (otherwise the
-            # pending skip silently truncates the wrong epoch).
+            # switching to a different epoch invalidates ALL of it — the skip
+            # counter, the shuffle-RNG snapshot, and any pending base-loader
+            # state (otherwise they'd silently reposition the wrong epoch).
             self._resume_batches = 0
+            self._pending_rng = None
+            self._pending_base_state = None
             self.iteration = epoch
         if hasattr(self.base_loader, "set_epoch"):
             self.base_loader.set_epoch(epoch)
@@ -419,13 +483,41 @@ class DataLoaderShard(DataLoaderStateMixin):
         if self.rng_types is not None:
             synchronize_rng_states(self.rng_types, self.synchronized_generator)
         self.set_epoch(self.iteration)
-        iterator = iter(self.base_loader)
-        # One-shot mid-epoch resume (load_state_dict): skip to the saved
-        # position this epoch only; position counter starts there.
         resume = self._resume_batches
         self._resume_batches = 0
         self._num_batches_fetched = resume
+        if self._pending_base_state is not None:
+            # Stateful base (torchdata StatefulDataLoader protocol): the base
+            # restores its own sampler/iterator position — no skip replay.
+            self.base_loader.load_state_dict(self._pending_base_state)
+            self._pending_base_state = None
+            resume = 0
+        if self._pending_rng is not None:
+            # Replay the interrupted epoch's exact shuffle order by restoring
+            # the RNG snapshot taken before that epoch's iterator was built.
+            self._restore_sampler_rng(self._pending_rng)
+            self._pending_rng = None
+        self._epoch_rng = self._capture_sampler_rng()
         effective_skip = self.skip_batches + resume
+        base_is_stateful = hasattr(self.base_loader, "state_dict") and callable(
+            getattr(self.base_loader, "state_dict")
+        )
+        # Indexable bases skip by *indexing*, not by loading-and-discarding —
+        # O(1) positioning instead of the O(epoch) counter replay. Stateful
+        # bases are excluded: the index path bypasses their own iterator, so
+        # their reported state would go stale.
+        if (
+            effective_skip > 0
+            and not base_is_stateful
+            and hasattr(self.base_loader, "__getitem__")
+            and hasattr(self.base_loader, "__len__")
+            and not _is_torch_loader(self.base_loader)
+        ):
+            n = len(self.base_loader)
+            iterator = (self.base_loader[i] for i in range(min(effective_skip, n), n))
+            effective_skip = 0
+        else:
+            iterator = iter(self.base_loader)
         skipped = 0
         # Prefetch-one-ahead so the flag flips *on* the final batch, not after it
         # (reference :563-587) — grad accumulation must sync on the last batch.
@@ -434,6 +526,14 @@ class DataLoaderShard(DataLoaderStateMixin):
         batches_yielded = 0
         expected_local = None
         while True:
+            if base_is_stateful:
+                # Snapshot BEFORE the fetch: with the one-ahead prefetch, the
+                # state at any yield point must say "next fetch returns the
+                # buffered batch" — a post-fetch snapshot would drop it.
+                try:
+                    self._base_state_live = self.base_loader.state_dict()
+                except Exception:
+                    self._base_state_live = None
             try:
                 nxt = _to_numpy(next(iterator))
             except StopIteration:
@@ -473,24 +573,54 @@ class DataLoaderShard(DataLoaderStateMixin):
         # StatefulDataLoader semantics — a checkpoint taken *between* epochs
         # resumes at the top of the next epoch, not mid-stream).
         self._num_batches_fetched = 0
+        self._base_state_live = None
+        # A between-epoch checkpoint must NOT replay the finished epoch's
+        # shuffle into the next epoch — drop the consumed snapshot.
+        self._epoch_rng = None
         self.end()
 
     # -------------------------------------------------- resume (stateful) API
     def state_dict(self):
-        """Position within the current epoch + epoch counter (reference
-        StatefulDataLoader passthrough ``data_loader.py:444-497``). Restoring
-        replays the same epoch's sampler order (seedable samplers re-derive it
-        from (seed, epoch)) and skips to the saved position. A just-restored,
-        not-yet-iterated loader reports its pending position so load→save
-        round-trips are idempotent (torchdata StatefulDataLoader semantics)."""
-        return {
+        """Mid-epoch resume state (reference StatefulDataLoader passthrough
+        ``data_loader.py:444-497``). Three layers, best available wins at load:
+
+        - ``base_state``: the wrapped loader's own ``state_dict()`` when it is
+          stateful (torchdata StatefulDataLoader) — true pass-through, the
+          base repositions itself without any skip replay;
+        - ``sampler_rng``: the RNG snapshot that generated the current epoch's
+          shuffle order, so plain torch ``RandomSampler`` (no seedable
+          sampler) replays the interrupted order exactly on resume;
+        - position counters, replayed by skipping (indexable bases skip by
+          index, O(1)).
+
+        A just-restored, not-yet-iterated loader reports its pending state so
+        load→save round-trips are idempotent."""
+        sd = {
             "num_batches_fetched": max(self._num_batches_fetched, self._resume_batches),
             "iteration": self.iteration,
         }
+        # A pending (loaded, not yet consumed) snapshot is the authoritative
+        # resume state; the live epoch snapshot only applies mid-iteration.
+        rng = self._pending_rng if self._pending_rng is not None else self._epoch_rng
+        if rng is not None:
+            sd["sampler_rng"] = rng
+        if self._pending_base_state is not None:
+            sd["base_state"] = self._pending_base_state
+        elif getattr(self, "_base_state_live", None) is not None:
+            # The pre-fetch snapshot from the live iterator (accounts for the
+            # one-ahead prefetch buffer; see __iter__).
+            sd["base_state"] = self._base_state_live
+        return sd
 
     def load_state_dict(self, sd):
         self._resume_batches = sd.get("num_batches_fetched", 0)
         self.iteration = sd.get("iteration", 0)
+        self._pending_rng = sd.get("sampler_rng")
+        self._epoch_rng = None  # any live-epoch snapshot is now stale
+        self._base_state_live = None
+        base_state = sd.get("base_state")
+        if base_state is not None and hasattr(self.base_loader, "load_state_dict"):
+            self._pending_base_state = base_state
 
 
 class DataLoaderDispatcher(DataLoaderStateMixin):
@@ -673,12 +803,17 @@ def skip_first_batches(dataloader, num_batches: int = 0):
 
         new_loader = copy.copy(dataloader)
         new_loader.skip_batches = dataloader.skip_batches + num_batches
-        # Explicit skip wins: don't compound with a pending stateful-resume
-        # position (load_state + skip_first_batches would otherwise double-skip
-        # this epoch, and the leftover pending position would silently truncate
-        # the source loader's next epoch).
-        new_loader._resume_batches = 0
-        dataloader._resume_batches = 0
+        # Explicit skip wins: don't compound with ANY pending stateful-resume
+        # position — the counter, the shuffle-RNG snapshot, or a stateful
+        # base's saved position (load_state + skip_first_batches would
+        # otherwise double-skip this epoch, and the leftover pending state
+        # would silently truncate the source loader's next epoch).
+        for obj in (new_loader, dataloader):
+            obj._resume_batches = 0
+            if hasattr(obj, "_pending_rng"):
+                obj._pending_rng = None
+            if hasattr(obj, "_pending_base_state"):
+                obj._pending_base_state = None
         return new_loader
     return SkipDataLoader(dataloader, skip_batches=num_batches)
 
