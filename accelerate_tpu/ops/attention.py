@@ -29,13 +29,15 @@ import jax
 import jax.numpy as jnp
 
 # Dense/flash crossover by device kind: below this sequence length the S²
-# einsum rides the MXU faster than the block-streamed kernel. Measured with
-# benchmarks/attention_crossover.py (B=4, H=8, D=128, bf16, causal): on
-# v5 lite dense wins through S=2048 (2.22ms vs 2.50ms) and flash wins at
-# S=4096 (9.2ms vs 15.7ms — and dense's fp32 score matrix OOMs by S=8192).
-# Override with ACCELERATE_FLASH_MIN_SEQ.
-_FLASH_CROSSOVER = {"TPU v5 lite": 4096, "TPU v5e": 4096}
-_DEFAULT_FLASH_MIN_SEQ = 2048
+# einsum rides the MXU faster than the block-streamed kernel. Re-measured with
+# benchmarks/attention_crossover.py after tuning the kernel block sizes
+# (_flash_block_sizes — the library's 128-everywhere default was the round-2
+# bottleneck): on v5 lite flash at S<=1024 lands below the tunnel's host-RTT
+# measurement floor (dense doesn't), S=4096 is 1.2ms vs 15.3ms, and at the
+# 725M train step flash@1024 measures 57.1% MFU vs 50.1% dense. Override with
+# ACCELERATE_FLASH_MIN_SEQ.
+_FLASH_CROSSOVER = {"TPU v5 lite": 512, "TPU v5e": 512}
+_DEFAULT_FLASH_MIN_SEQ = 1024
 
 
 @functools.lru_cache(maxsize=1)
@@ -112,6 +114,28 @@ def _flash_available() -> bool:
         return False
 
 
+def _flash_block_sizes(q_len: int, kv_len: int):
+    """Tile sizes for the Mosaic flash kernel. The library default is 128
+    everywhere (its own TODO admits no heuristic was picked), which at long
+    sequence lengths costs >5x on the backward: measured fwd+bwd at
+    (B2,H11,S4096,D128) on v5e, 128-blocks take 75.4 ms/iter vs 14.0 ms with
+    512-blocks. Use the largest block <= 512 dividing the sequence lengths;
+    override with ACCELERATE_FLASH_BLOCK."""
+    from jax.experimental.pallas.ops.tpu.flash_attention import BlockSizes
+
+    want = int(os.environ.get("ACCELERATE_FLASH_BLOCK", 512))
+    bq = bk = 128
+    for b in sorted({want, 512, 256, 128}, reverse=True):
+        if b <= want and b % 128 == 0 and q_len % b == 0 and kv_len % b == 0:
+            bq = bk = b
+            break
+    return BlockSizes(
+        block_q=bq, block_k_major=bk, block_k=bk, block_b=1,
+        block_q_major_dkv=bq, block_k_major_dkv=bk, block_k_dkv=bk, block_q_dkv=bq,
+        block_k_major_dq=bk, block_k_dq=bk, block_q_dq=bq,
+    )
+
+
 def flash_attention(q, k, v, *, causal=True, mask=None):
     """Pallas TPU flash attention; layout (B,S,H,D) in, internally (B,H,S,D)."""
     from jax.experimental.pallas.ops.tpu.flash_attention import (
@@ -128,7 +152,10 @@ def flash_attention(q, k, v, *, causal=True, mask=None):
         # real tokens: segment 2, padding: segment 1 — pads only see pads
         seg = jnp.where(mask.astype(bool), 2, 1).astype(jnp.int32)
         segment_ids = SegmentIds(q=seg, kv=seg)
-    out = _flash(qt, kt, vt, segment_ids=segment_ids, causal=causal, sm_scale=scale)
+    out = _flash(
+        qt, kt, vt, segment_ids=segment_ids, causal=causal, sm_scale=scale,
+        block_sizes=_flash_block_sizes(q.shape[1], k.shape[1]),
+    )
     return jnp.swapaxes(out, 1, 2)
 
 
@@ -169,6 +196,21 @@ def cached_attention(q, k_cache, v_cache, *, q_positions, kv_mask=None, window=N
     return out.reshape(B, S, H, D)
 
 
+def resolve_auto_impl(seq_len: int, num_heads: int, head_dim: int,
+                      batch: int = 1) -> str:
+    """What ``impl='auto'`` resolves to for this shape — the single source of
+    the dispatch predicate, shared by ``attention()`` and introspection
+    (bench.py logs it as driver-visible evidence of the kernel in use)."""
+    shapes_ok = (seq_len >= 128 and seq_len % 128 == 0) and (
+        head_dim % 128 == 0 or head_dim in (64, 96, 256)
+    )
+    return (
+        "flash"
+        if _flash_available() and shapes_ok and seq_len >= _flash_min_seq()
+        else "dense"
+    )
+
+
 def attention(q, k, v, *, causal=True, mask=None, impl: str = "auto", mesh=None, window=None,
               softcap=None, scale=None):
     """Unified entry used by the model zoo. ``impl``: auto|dense|flash|ring|ulysses.
@@ -184,11 +226,7 @@ def attention(q, k, v, *, causal=True, mask=None, impl: str = "auto", mesh=None,
         return dense_attention(q, k, v, causal=causal, mask=mask, window=window,
                                softcap=softcap, scale=scale)
     if impl == "auto":
-        impl = (
-            "flash"
-            if _flash_available() and _flash_shapes_ok(q, k) and q.shape[1] >= _flash_min_seq()
-            else "dense"
-        )
+        impl = resolve_auto_impl(q.shape[1], q.shape[2], q.shape[3], batch=q.shape[0])
     if impl == "flash":
         if not _flash_available():
             impl = "dense"
@@ -210,3 +248,6 @@ def _flash_shapes_ok(q, k) -> bool:
     # aligned to lanes; fall back for tiny/test shapes.
     B, S, H, D = q.shape
     return (S >= 128 and S % 128 == 0) and (D % 128 == 0 or D in (64, 96, 256))
+
+
+# (kept for callers/tests; resolve_auto_impl is the dispatch source of truth)

@@ -72,10 +72,12 @@ def _dense_block_fwd(q, k_cur, v_cur, mask_cur, pos_q, pos_k, m, l, acc, causal)
 
 
 def _flash_block_sizes(b, h, s_loc, d):
-    from jax.experimental.pallas.ops.tpu import flash_attention as fa
+    """Tile sizes for the per-chunk Mosaic kernels — same tuned selection as
+    the single-device wrapper (ops/attention.py ``_flash_block_sizes``; the
+    library 128-default costs ~5x on the backward at long chunk lengths)."""
+    from ..ops.attention import _flash_block_sizes as _tuned
 
-    # Signature: (batch_size, num_heads, q_seq_len, kv_len, d_model).
-    return fa.BlockSizes.get_default(b, h, s_loc, s_loc, d)
+    return _tuned(s_loc, s_loc)
 
 
 def _segment_ids(mask_cur, b, s_loc):
