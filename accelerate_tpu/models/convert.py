@@ -97,8 +97,8 @@ def llama_config_from_hf(hf_config, check_act: bool = True) -> LlamaConfig:
         if rope_type not in SUPPORTED_ROPE_TYPES:
             raise ValueError(
                 f"rope_type={rope_type!r} is not supported by the zoo Llama "
-                "(supported: linear, llama3); converting would silently "
-                "mis-position long contexts."
+                f"(supported: {SUPPORTED_ROPE_TYPES}); converting would "
+                "silently mis-position long contexts."
             )
     if get("mlp_bias"):
         raise ValueError("mlp_bias checkpoints are not supported (zoo Llama's FFN is bias-free)")
@@ -524,15 +524,15 @@ def mixtral_params_from_hf(state_dict, config, dtype=jnp.float32) -> dict:
 # ------------------------------------------------------------------------ t5
 def t5_config_from_hf(hf_config) -> T5Config:
     get = _getter(hf_config)
+    # HF encodes the FFN recipe in feed_forward_proj: 'relu' (original T5) or
+    # 'gated-gelu' (t5-v1.1: wi_0 gate * wi_1, tanh-gelu, untied head).
     ff_proj = get("feed_forward_proj", "relu")
-    if ff_proj != "relu":
+    if ff_proj not in ("relu", "gated-gelu"):
         raise ValueError(
-            f"feed_forward_proj={ff_proj!r} is not supported (zoo T5 implements the "
-            "original ReLU recipe; t5-v1.1 gated-gelu checkpoints have wi_0/wi_1 "
-            "weights the zoo model has no slot for)"
+            f"feed_forward_proj={ff_proj!r} is not supported "
+            "(zoo T5 implements the original relu recipe and v1.1's gated-gelu)"
         )
-    if not get("tie_word_embeddings", True):
-        raise ValueError("untied-lm-head T5 is not supported (zoo T5 ties the scaled head)")
+    gated = ff_proj == "gated-gelu"
     pad = get("pad_token_id", 0)
     pad = 0 if pad is None else pad
     start = get("decoder_start_token_id")
@@ -551,6 +551,9 @@ def t5_config_from_hf(hf_config) -> T5Config:
         layer_norm_epsilon=get("layer_norm_epsilon", 1e-6),
         pad_token_id=pad,
         decoder_start_token_id=start,
+        gated_act=gated,
+        dense_act="gelu_tanh" if gated else "relu",
+        tie_word_embeddings=bool(get("tie_word_embeddings", True)),
     )
 
 
@@ -575,6 +578,12 @@ def t5_params_from_hf(state_dict, config: T5Config, dtype=jnp.float32) -> dict:
 
     def mlp(side, L, li):
         base = f"{side}.block.{{i}}.layer.{li}.DenseReluDense"
+        if config.gated_act:  # v1.1: wi_0 (gated) + wi_1
+            return {
+                "wi_0": _stack(sd, f"{base}.wi_0.weight", L, transpose=True, dtype=dtype),
+                "wi_1": _stack(sd, f"{base}.wi_1.weight", L, transpose=True, dtype=dtype),
+                "wo": _stack(sd, f"{base}.wo.weight", L, transpose=True, dtype=dtype),
+            }
         return {
             "wi": _stack(sd, f"{base}.wi.weight", L, transpose=True, dtype=dtype),
             "wo": _stack(sd, f"{base}.wo.weight", L, transpose=True, dtype=dtype),
@@ -601,11 +610,14 @@ def t5_params_from_hf(state_dict, config: T5Config, dtype=jnp.float32) -> dict:
             },
         }
 
-    return {
+    params = {
         "shared": jnp.asarray(_to_numpy(sd["shared.weight"], dtype)),
         "encoder": side_params("encoder", config.num_layers, cross=False),
         "decoder": side_params("decoder", config.num_decoder_layers, cross=True),
     }
+    if not config.tie_word_embeddings:  # v1.1 untied head: (V, d) -> (d, V)
+        params["lm_head"] = jnp.asarray(_to_numpy(sd["lm_head.weight"], dtype).T)
+    return params
 
 
 # ----------------------------------------------------------------- dispatcher

@@ -147,7 +147,7 @@ def rms_norm(x, weight, eps):
     return (x * weight).astype(dtype)
 
 
-SUPPORTED_ROPE_TYPES = ("default", "linear", "llama3")
+SUPPORTED_ROPE_TYPES = ("default", "linear", "llama3", "yarn", "dynamic")
 
 
 def _llama3_scale_inv_freq(inv_freq, scaling: dict):
@@ -169,21 +169,89 @@ def _llama3_scale_inv_freq(inv_freq, scaling: dict):
     return np.where(is_medium, smoothed, scaled).astype(np.float32)
 
 
-def rope_tables(positions, head_dim, theta, scaling: dict | None = None):
-    """cos/sin tables for rotary embeddings, fp32. positions: (B, S) int."""
-    inv_freq = 1.0 / (theta ** (np.arange(0, head_dim, 2, dtype=np.float32) / head_dim))
+def _yarn_inv_freq(head_dim, theta, scaling: dict):
+    """YaRN frequency blending (the public recipe, as in transformers'
+    ``_compute_yarn_parameters``): low-frequency components interpolate
+    (divide by ``factor``), high-frequency extrapolate (unchanged), with a
+    linear ramp between the correction dims derived from beta_fast/beta_slow.
+    Returns ``(inv_freq, attention_factor)`` — the factor scales cos/sin."""
+    import math
+
+    dim = head_dim
+    factor = float(scaling.get("factor", 1.0))
+    original_max = scaling.get("original_max_position_embeddings") or scaling.get(
+        "max_position_embeddings", 4096
+    )
+    beta_fast = scaling.get("beta_fast") or 32
+    beta_slow = scaling.get("beta_slow") or 1
+
+    attention_factor = scaling.get("attention_factor")
+    mscale, mscale_all = scaling.get("mscale"), scaling.get("mscale_all_dim")
+
+    def get_mscale(scale, m=1):
+        return 1.0 if scale <= 1 else 0.1 * m * math.log(scale) + 1.0
+
+    if attention_factor is None:
+        if mscale and mscale_all:
+            attention_factor = get_mscale(factor, mscale) / get_mscale(factor, mscale_all)
+        else:
+            attention_factor = get_mscale(factor)
+
+    def correction_dim(num_rot):
+        return (dim * math.log(original_max / (num_rot * 2 * math.pi))) / (2 * math.log(theta))
+
+    low, high = correction_dim(beta_fast), correction_dim(beta_slow)
+    if scaling.get("truncate", True):
+        low, high = math.floor(low), math.ceil(high)
+    low, high = max(low, 0), min(high, dim - 1)
+    if low == high:
+        high += 0.001
+
+    pos_freqs = theta ** (np.arange(0, dim, 2, dtype=np.float32) / dim)
+    extrapolation = 1.0 / pos_freqs
+    interpolation = 1.0 / (factor * pos_freqs)
+    ramp = np.clip((np.arange(dim // 2, dtype=np.float32) - low) / (high - low), 0, 1)
+    extrapolation_factor = 1.0 - ramp
+    inv_freq = interpolation * (1 - extrapolation_factor) + extrapolation * extrapolation_factor
+    return inv_freq.astype(np.float32), float(attention_factor)
+
+
+def rope_tables(positions, head_dim, theta, scaling: dict | None = None,
+                seq_len: int | None = None, max_position_embeddings: int | None = None):
+    """cos/sin tables for rotary embeddings, fp32. positions: (B, S) int.
+
+    ``seq_len``/``max_position_embeddings`` feed the ``dynamic`` (NTK-aware)
+    rope type, whose base stretches when the (static) forward length exceeds
+    the pretraining window; shorter forwards use the unmodified base — the
+    transformers semantic for a single forward pass. During cached decode the
+    chunk length is 1, so frequencies stay fixed (consistent with the cache)."""
+    attention_factor = 1.0
     if scaling:
         rope_type = scaling.get("rope_type", scaling.get("type", "default"))
+    else:
+        rope_type = "default"
+    if rope_type == "dynamic" and scaling:
+        max_pos = max_position_embeddings or scaling.get("max_position_embeddings", 2048)
+        eff = max(seq_len or max_pos, max_pos)
+        factor = float(scaling.get("factor", 1.0))
+        dim = head_dim
+        theta = theta * ((factor * eff / max_pos) - (factor - 1)) ** (dim / (dim - 2))
+    inv_freq = 1.0 / (theta ** (np.arange(0, head_dim, 2, dtype=np.float32) / head_dim))
+    if scaling:
         if rope_type == "linear":
             inv_freq = inv_freq / float(scaling.get("factor", 1.0))
         elif rope_type == "llama3":
             inv_freq = _llama3_scale_inv_freq(inv_freq, scaling)
-        elif rope_type not in (None, "default"):
+        elif rope_type == "yarn":
+            if "original_max_position_embeddings" not in scaling and max_position_embeddings:
+                scaling = {**scaling, "max_position_embeddings": max_position_embeddings}
+            inv_freq, attention_factor = _yarn_inv_freq(head_dim, theta, scaling)
+        elif rope_type not in (None, "default", "dynamic"):
             raise ValueError(
                 f"Unsupported rope_type {rope_type!r} (supported: {SUPPORTED_ROPE_TYPES})"
             )
     angles = positions[..., None].astype(jnp.float32) * inv_freq  # (B,S,D/2)
-    return jnp.cos(angles), jnp.sin(angles)
+    return jnp.cos(angles) * attention_factor, jnp.sin(angles) * attention_factor
 
 
 def apply_rope(x, cos, sin):
@@ -295,8 +363,14 @@ class Llama(Module):
     # the fused scan (training) and the layer-streamed offloaded-inference runtime
     # (``big_modeling.StreamedScanModel`` runs ``block`` once per layer with weights
     # DMA'd in just-in-time).
-    def embed(self, params, input_ids, positions=None, attention_mask=None):
-        """Token embedding + rotary tables. Returns (hidden, ctx)."""
+    def embed(self, params, input_ids, positions=None, attention_mask=None,
+              rope_seq_len=None):
+        """Token embedding + rotary tables. Returns (hidden, ctx).
+
+        ``rope_seq_len`` overrides the effective length fed to length-dependent
+        rope types (dynamic NTK): the cached decode path pins it to the cache
+        capacity so every chunk — prefill and single-token steps alike — is
+        rotated with ONE consistent set of frequencies."""
         cfg = self.config
         B, S = input_ids.shape
         x = jnp.take(params["embed"]["weight"], input_ids, axis=0)
@@ -306,7 +380,11 @@ class Llama(Module):
             x = x * jnp.asarray(cfg.embedding_multiplier, x.dtype)
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (B, S))
-        cos, sin = rope_tables(positions, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
+        cos, sin = rope_tables(
+            positions, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling,
+            seq_len=rope_seq_len if rope_seq_len is not None else S,
+            max_position_embeddings=cfg.max_position_embeddings,
+        )
         return x, {"cos": cos, "sin": sin, "attention_mask": attention_mask}
 
     _WINDOW_FROM_CONFIG = object()  # sentinel: use cfg.sliding_window
@@ -585,7 +663,14 @@ class Llama(Module):
         )
         kv_mask = jax.lax.dynamic_update_slice(cache["kv_mask"], chunk_mask, (0, pos))
 
-        x, ctx = self.embed(params, input_ids, rope_positions, attention_mask)
+        # Length-dependent rope (dynamic NTK) must see ONE length for the whole
+        # generation — the static cache capacity — or a decode chunk (S=1)
+        # would be rotated with the unstretched base while the prefilled keys
+        # used the stretched one (advisor r3 finding).
+        x, ctx = self.embed(
+            params, input_ids, rope_positions, attention_mask,
+            rope_seq_len=cache["k"].shape[2],
+        )
         ctx["positions"] = slot_positions
         ctx["kv_mask"] = kv_mask
         ctx["cache_pos"] = pos

@@ -44,6 +44,10 @@ class T5Config:
     pad_token_id: int = 0
     decoder_start_token_id: int = 0
     remat: bool = False
+    # T5-v1.1 recipe: gated FFN (wi_0 gate * wi_1) with tanh-gelu, untied head.
+    gated_act: bool = False
+    dense_act: str = "relu"  # 'relu' | 'gelu_tanh'
+    tie_word_embeddings: bool = True
 
     @classmethod
     def tiny(cls, **kw):
@@ -105,10 +109,18 @@ class T5ForConditionalGeneration(Module):
                 "wo": dense((L, inner, h), inner),
             },
             "self_norm": {"scale": jnp.ones((L, h), jnp.float32)},
-            "mlp": {
-                "wi": dense((L, h, ff), h),
-                "wo": dense((L, ff, h), ff),
-            },
+            "mlp": (
+                {
+                    "wi_0": dense((L, h, ff), h),
+                    "wi_1": dense((L, h, ff), h),
+                    "wo": dense((L, ff, h), ff),
+                }
+                if self.config.gated_act
+                else {
+                    "wi": dense((L, h, ff), h),
+                    "wo": dense((L, ff, h), ff),
+                }
+            ),
             "mlp_norm": {"scale": jnp.ones((L, h), jnp.float32)},
         }
         if cross:
@@ -141,7 +153,25 @@ class T5ForConditionalGeneration(Module):
                 "final_norm": {"scale": jnp.ones((cfg.d_model,), jnp.float32)},
             },
         }
+        if not cfg.tie_word_embeddings:
+            params["lm_head"] = jax.random.normal(
+                next(keys), (cfg.d_model, cfg.vocab_size), jnp.float32
+            ) * (cfg.d_model ** -0.5)
         return params
+
+    def _ffn(self, layer, y):
+        """Position-wise FFN: original-T5 ReLU or the v1.1 gated tanh-gelu
+        (``gelu(y @ wi_0) * (y @ wi_1)``), selected by config."""
+        cfg = self.config
+        act = (
+            jax.nn.relu
+            if cfg.dense_act == "relu"
+            else (lambda t: jax.nn.gelu(t, approximate=True))
+        )
+        m = layer["mlp"]
+        if cfg.gated_act:
+            return (act(y @ m["wi_0"]) * (y @ m["wi_1"])) @ m["wo"]
+        return act(y @ m["wi"]) @ m["wo"]
 
     def sharding_rules(self):
         return [
@@ -150,6 +180,7 @@ class T5ForConditionalGeneration(Module):
             (r"attn/wo", P(None, "tp", "fsdp")),
             (r"mlp/wi", P(None, "fsdp", "tp")),
             (r"mlp/wo", P(None, "tp", "fsdp")),
+            (r"lm_head", P("fsdp", "tp")),
             (r"norm|rel_bias", P()),
         ]
 
@@ -186,7 +217,7 @@ class T5ForConditionalGeneration(Module):
                 y = rms_norm(h, layer["cross_norm"]["scale"], cfg.layer_norm_epsilon)
                 h = h + self._attend(y, enc_out, layer["cross_attn"], cross_bias)
             y = rms_norm(h, layer["mlp_norm"]["scale"], cfg.layer_norm_epsilon)
-            h = h + jax.nn.relu(y @ layer["mlp"]["wi"]) @ layer["mlp"]["wo"]
+            h = h + self._ffn(layer, y)
             return h, None
 
         body = block
@@ -238,8 +269,12 @@ class T5ForConditionalGeneration(Module):
         y = jnp.take(emb, decoder_input_ids, axis=0).astype(compute_dtype)
         dec_out = self._run_stack(params["decoder"], y, enc_out, dec_bias, enc_pad, cross=True)
 
-        # Tied head with T5's 1/sqrt(d) rescale.
-        logits = (dec_out * (cfg.d_model ** -0.5)) @ emb.T.astype(compute_dtype)
+        # Tied head carries T5's 1/sqrt(d) rescale; the untied v1.1 head
+        # projects directly (HF applies the rescale only when tied).
+        if cfg.tie_word_embeddings:
+            logits = (dec_out * (cfg.d_model ** -0.5)) @ emb.T.astype(compute_dtype)
+        else:
+            logits = dec_out @ params["lm_head"].astype(compute_dtype)
         logits = logits.astype(jnp.float32)
         out = ModelOutput(logits=logits, encoder_last_hidden_state=enc_out)
         if labels is not None:
@@ -345,7 +380,7 @@ class T5ForConditionalGeneration(Module):
             h = h + attn.reshape(B, Tc, nh * dkv) @ layer["cross_attn"]["wo"]
             # MLP.
             z = rms_norm(h, layer["mlp_norm"]["scale"], cfg.layer_norm_epsilon)
-            h = h + jax.nn.relu(z @ layer["mlp"]["wi"]) @ layer["mlp"]["wo"]
+            h = h + self._ffn(layer, z)
             return h, (k_cache, v_cache)
 
         ck, cv = cross_kv
@@ -353,7 +388,10 @@ class T5ForConditionalGeneration(Module):
             block, y, (params["decoder"]["layers"], cache["k"], cache["v"], ck, cv)
         )
         y = rms_norm(y, params["decoder"]["final_norm"]["scale"], cfg.layer_norm_epsilon)
-        logits = ((y * (cfg.d_model ** -0.5)) @ emb.T.astype(y.dtype)).astype(jnp.float32)
+        if cfg.tie_word_embeddings:
+            logits = ((y * (cfg.d_model ** -0.5)) @ emb.T.astype(y.dtype)).astype(jnp.float32)
+        else:
+            logits = (y @ params["lm_head"].astype(y.dtype)).astype(jnp.float32)
         return ModelOutput(
             logits=logits,
             cache={"k": nk, "v": nv, "pos": pos + Tc},

@@ -187,3 +187,30 @@ def test_encoder_decoder_generate_shapes_and_determinism():
     s2 = np.asarray(generate(model, ids, max_new_tokens=5, temperature=0.8,
                              rng=jax.random.key(1)))
     np.testing.assert_array_equal(s1, s2)
+
+
+def test_dynamic_rope_cached_chunks_are_consistent():
+    """Dynamic-NTK rope past the pretraining window: a prefill+decode split
+    must produce the same logits as one cached prefill of the full sequence —
+    every chunk has to use the cache capacity (one frequency set), not its own
+    chunk length (advisor r3)."""
+    from accelerate_tpu.models import Llama, LlamaConfig
+
+    cfg = LlamaConfig.tiny(
+        max_position_embeddings=8,
+        rope_scaling={"rope_type": "dynamic", "factor": 2.0},
+    )
+    model = Llama(cfg)
+    params = model.init_params(jax.random.key(0))
+    ids = np.random.default_rng(0).integers(0, 256, (1, 13)).astype(np.int32)
+    total = 16  # cache capacity > max_position_embeddings -> stretch engages
+
+    cache = model.init_cache(1, total, dtype=jnp.float32)
+    full = model.apply(params, input_ids=ids, cache=cache)
+
+    cache2 = model.init_cache(1, total, dtype=jnp.float32)
+    part = model.apply(params, input_ids=ids[:, :12], cache=cache2)
+    step = model.apply(params, input_ids=ids[:, 12:], cache=part["cache"])
+    np.testing.assert_allclose(
+        np.asarray(step["logits"][0, -1]), np.asarray(full["logits"][0, -1]), atol=1e-4
+    )
