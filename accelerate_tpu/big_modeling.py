@@ -308,6 +308,18 @@ class StreamedScanModel:
             )
         )
         self._embed_fn = jax.jit(lambda p, ids, pos, am: model.embed(p, ids, pos, am))
+        # Cached decode must pin length-dependent rope (dynamic NTK) to the
+        # cache capacity — same consistency rule as Llama._apply_cached; only
+        # rope models expose the kwarg (GPT-2's learned positions don't).
+        import inspect as _inspect
+
+        if "rope_seq_len" in _inspect.signature(model.embed).parameters:
+            self._embed_cached_fn = jax.jit(
+                lambda p, ids, pos, am, rl: model.embed(p, ids, pos, am, rope_seq_len=rl),
+                static_argnums=4,
+            )
+        else:
+            self._embed_cached_fn = None
         self._head_fn = jax.jit(
             lambda p, x, lab, am: model.head(p, x, labels=lab, attention_mask=am)
         )
@@ -407,7 +419,13 @@ class StreamedScanModel:
             else jnp.ones((B, S), jnp.int32)
         )
         kv_mask = jax.lax.dynamic_update_slice(cache["kv_mask"], chunk_mask, (0, pos))
-        x, ctx = self._embed_fn(nonlayer, input_ids, embed_positions, attention_mask)
+        if self._embed_cached_fn is not None:
+            cache_capacity = cache["k"][0].shape[1]
+            x, ctx = self._embed_cached_fn(
+                nonlayer, input_ids, embed_positions, attention_mask, cache_capacity
+            )
+        else:
+            x, ctx = self._embed_fn(nonlayer, input_ids, embed_positions, attention_mask)
         ctx = dict(ctx)
         ctx["positions"] = q_positions
         ctx["kv_mask"] = kv_mask

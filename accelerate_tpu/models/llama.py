@@ -86,6 +86,11 @@ class LlamaConfig:
     # each sub-block's OUTPUT (before the residual add), with a separate
     # pre-feedforward norm — four norms per layer instead of two.
     sandwich_norms: bool = False
+    # Compute the training loss by vocab-chunked streaming logsumexp straight
+    # from hidden states (ops/losses.fused_cross_entropy_loss): the (B·S, V)
+    # fp32 logit tensor never materializes. Training-memory lever for large
+    # vocab x long context; outputs carry loss but NO logits when it engages.
+    fused_loss: bool = False
 
     def __post_init__(self):
         if self.head_dim is None:
@@ -490,34 +495,49 @@ class Llama(Module):
 
         return matmul(a, b, precision=self.config.matmul_precision)
 
+    @staticmethod
+    def _shift_labels(labels, attention_mask):
+        """Next-token targets: predict t+1 from t; final position untargeted.
+        A position trains only if it is itself real (left-padding guard) AND
+        its target token t+1 is real (right-padding guard)."""
+        B = labels.shape[0]
+        shifted = jnp.concatenate(
+            [labels[:, 1:], jnp.full((B, 1), -100, labels.dtype)], axis=1
+        )
+        if attention_mask is not None:
+            target_valid = jnp.concatenate(
+                [attention_mask[:, 1:], jnp.zeros((B, 1), attention_mask.dtype)], axis=1
+            )
+            valid = target_valid.astype(bool) & attention_mask.astype(bool)
+            shifted = jnp.where(valid, shifted, -100)
+        return shifted
+
     def head(self, params, x, labels=None, attention_mask=None):
         """Final norm + LM head (+ shifted-label loss)."""
         cfg = self.config
         x = rms_norm(x, params["final_norm"]["weight"], cfg.rms_norm_eps)
         if cfg.tie_word_embeddings:
-            logits = x @ params["embed"]["weight"].T.astype(x.dtype)
+            head_w = params["embed"]["weight"].T.astype(x.dtype)
         else:
-            logits = x @ params["lm_head"]["weight"]
+            head_w = params["lm_head"]["weight"]
+        if labels is not None and cfg.fused_loss:
+            # Streaming-logsumexp loss from hidden states: the full logit
+            # tensor never exists (see LlamaConfig.fused_loss).
+            from ..ops.losses import fused_cross_entropy_loss
+
+            loss = fused_cross_entropy_loss(
+                x, head_w, self._shift_labels(labels, attention_mask),
+                logit_cap=cfg.final_logit_softcap,
+            )
+            return ModelOutput(loss=loss)
+        logits = x @ head_w
         if cfg.final_logit_softcap is not None:
             from ..ops.attention import softcap_scores
 
             logits = softcap_scores(logits.astype(jnp.float32), cfg.final_logit_softcap)
         out = ModelOutput(logits=logits)
         if labels is not None:
-            B = labels.shape[0]
-            # Shift: predict token t+1 from position t; final position has no target.
-            shifted = jnp.concatenate(
-                [labels[:, 1:], jnp.full((B, 1), -100, labels.dtype)], axis=1
-            )
-            if attention_mask is not None:
-                # A position trains only if it is itself real (left-padding
-                # guard) AND its target token t+1 is real (right-padding guard).
-                target_valid = jnp.concatenate(
-                    [attention_mask[:, 1:], jnp.zeros((B, 1), attention_mask.dtype)], axis=1
-                )
-                valid = target_valid.astype(bool) & attention_mask.astype(bool)
-                shifted = jnp.where(valid, shifted, -100)
-            out["loss"] = cross_entropy_loss(logits, shifted)
+            out["loss"] = cross_entropy_loss(logits, self._shift_labels(labels, attention_mask))
         return out
 
     # ------------------------------------------------------------------ cache

@@ -40,5 +40,91 @@ def cross_entropy_loss(
     return jnp.sum(nll) / denom
 
 
+def fused_cross_entropy_loss(
+    hidden: jax.Array,
+    head_weight: jax.Array,
+    labels: jax.Array,
+    *,
+    ignore_index: int = -100,
+    z_loss: float = 0.0,
+    vocab_chunk: int = 8192,
+    logit_cap: float | None = None,
+):
+    """Cross-entropy straight from hidden states — full logits never exist.
+
+    The (B·S, V) fp32 logit tensor is the largest activation of an LM train
+    step (1 GB at B2·S4096·V32000, plus its gradient); this computes the same
+    loss by scanning the LM head's vocab dimension in chunks, carrying running
+    ``(max, sumexp, label_logit)`` streaming-logsumexp statistics — the flash
+    trick applied to the classifier. Each chunk's partial logits live only
+    transiently (the scan body is rematerialized in the backward), so peak
+    memory is O(B·S·vocab_chunk).
+
+    hidden: (B, S, h) — any float dtype, promoted to fp32 per chunk.
+    head_weight: (h, V). labels: (B, S) int with ``ignore_index`` holes.
+    ``logit_cap`` applies Gemma-2-style tanh softcapping per chunk.
+    Returns the mean NLL over non-ignored positions (+ optional z-loss).
+    """
+    B, S, h = hidden.shape
+    V = head_weight.shape[-1]
+    T = B * S
+    x = hidden.reshape(T, h)
+    labels = labels.reshape(T)
+    valid = labels != ignore_index
+    safe_labels = jnp.where(valid, labels, 0)
+
+    def update(carry, w_c, base, width):
+        """Fold one vocab slice into the running (max, sumexp, label_logit)."""
+        m, se, label_logit = carry
+        logits_c = (x @ w_c).astype(jnp.float32)  # (T, width)
+        if logit_cap is not None:
+            logits_c = jnp.tanh(logits_c / logit_cap) * logit_cap
+        m_c = jnp.max(logits_c, axis=-1)
+        m_new = jnp.maximum(m, m_c)
+        se = se * jnp.exp(m - m_new) + jnp.sum(jnp.exp(logits_c - m_new[:, None]), axis=-1)
+        hit = (safe_labels >= base) & (safe_labels < base + width)
+        local = jnp.take_along_axis(
+            logits_c, jnp.clip(safe_labels - base, 0, width - 1)[:, None], axis=-1
+        )[:, 0]
+        label_logit = jnp.where(hit, local, label_logit)
+        return m_new, se, label_logit
+
+    init = (
+        jnp.full((T,), -jnp.inf, jnp.float32),
+        jnp.zeros((T,), jnp.float32),
+        jnp.zeros((T,), jnp.float32),
+    )
+    # Full chunks ride a scan; a ragged tail (V % vocab_chunk) is folded by one
+    # extra call — never a padded copy of the whole head weight (at 128k-vocab
+    # bf16 heads that copy would cost ~1 GB per step).
+    n_full = V // vocab_chunk
+    carry = init
+    if n_full:
+        w_chunks = jnp.moveaxis(
+            head_weight[:, : n_full * vocab_chunk].reshape(h, n_full, vocab_chunk), 1, 0
+        )  # (n_full, h, chunk)
+
+        def body(carry, inp):
+            w_c, c_idx = inp
+            return update(carry, w_c, c_idx * vocab_chunk, vocab_chunk), None
+
+        body = jax.checkpoint(body)  # recompute chunk logits in the backward
+        carry, _ = jax.lax.scan(body, init, (w_chunks, jnp.arange(n_full)))
+    tail = V - n_full * vocab_chunk
+    if tail:
+        tail_fn = jax.checkpoint(
+            lambda c, w_t: update(c, w_t, n_full * vocab_chunk, tail)
+        )
+        carry = tail_fn(carry, head_weight[:, n_full * vocab_chunk :])
+    m, se, label_logit = carry
+    logz = m + jnp.log(se)
+    nll = logz - label_logit
+    if z_loss > 0.0:
+        nll = nll + z_loss * jnp.square(logz)
+    nll = jnp.where(valid, nll, 0.0)
+    denom = jnp.maximum(jnp.sum(valid), 1)
+    return jnp.sum(nll) / denom
+
+
 def mse_loss(pred: jax.Array, target: jax.Array):
     return jnp.mean(jnp.square(pred.astype(jnp.float32) - target.astype(jnp.float32)))
