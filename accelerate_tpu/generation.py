@@ -78,6 +78,183 @@ def mask_positions(attention_mask):
     return jnp.clip(jnp.cumsum(attention_mask.astype(jnp.int32), axis=-1) - 1, 0)
 
 
+def beam_search(
+    model,
+    input_ids,
+    *,
+    num_beams: int,
+    max_new_tokens: int,
+    params=None,
+    attention_mask=None,
+    length_penalty: float = 1.0,
+    eos_token_id: int | None = None,
+    pad_token_id: int = 0,
+    cache_dtype=jnp.float32,
+    include_prompt: bool = True,
+):
+    """Greedy beam search over the KV-cache decode path — one compiled program.
+
+    TPU-shaped like the sampling loop: beams live as a widened batch
+    (B·num_beams), every step is one cached forward + a top-k over K·V + a
+    gather that reorders the cache and token history along the beam dim, all
+    inside ``lax.scan`` (no per-step host round trips). Finished beams (EOS)
+    freeze their score and emit pad. Final selection applies HF's length
+    penalty ``score / len**penalty`` over finished-or-running beams.
+
+    Reference parity: the reference defers to transformers'
+    ``generate(num_beams=...)``; with ``eos_token_id=None`` this matches it
+    token-for-token (tests/test_convert.py::test_beam_search_matches_hf).
+    Finished hypotheses are banked by normalized score (transformers'
+    BeamHypotheses role) so a finished beam can never be evicted by running
+    beams and then lost; the length penalty divides by the FULL sequence
+    length (prompt + generated), matching transformers.
+    """
+    module, mparams = _unwrap(model)
+    if params is None:
+        params = mparams
+    if params is None:
+        raise ValueError("Model has no params; pass params= or init the model first.")
+    input_ids = jnp.asarray(input_ids, jnp.int32)
+    B, S = input_ids.shape
+    K = num_beams
+    eos = -1 if eos_token_id is None else eos_token_id
+    mask = (
+        jnp.asarray(attention_mask, jnp.int32)
+        if attention_mask is not None
+        else jnp.ones((B, S), jnp.int32)
+    )
+
+    def beam_select(tree, idx, width):
+        """Reorder every cache leaf's beam/batch dim by ``idx`` (the k/v
+        stacks carry it at axis 1 under the layer dim, host-side leaves at
+        axis 0); one helper serves both the prefill tiling (repeated index)
+        and the per-step parent gather."""
+        return jax.tree_util.tree_map(
+            lambda t: (
+                jnp.take(t, idx, axis=1)
+                if t.ndim >= 3 and t.shape[1] == width
+                else (jnp.take(t, idx, axis=0) if t.ndim >= 1 and t.shape[0] == width else t)
+            ),
+            tree,
+        )
+
+    cache_store = module.__dict__.setdefault("_generate_fns", {})
+    key = ("beam", K, max_new_tokens, length_penalty, eos, pad_token_id, str(cache_dtype))
+    if key not in cache_store:
+
+        def run(params, input_ids, mask):
+            B, S = input_ids.shape
+            total = S + max_new_tokens
+            input_ids, mask = left_align(input_ids, mask)
+            real_len = jnp.sum(mask, axis=-1).astype(jnp.int32)
+
+            # Prefill once per batch row, then tile the cache across beams.
+            cache = module.init_cache(B, total, dtype=cache_dtype)
+            out = module.apply(params, input_ids=input_ids, attention_mask=mask,
+                               cache=cache, positions=mask_positions(mask))
+            logp0 = jax.nn.log_softmax(out["logits"][:, -1].astype(jnp.float32))  # (B,V)
+            V = logp0.shape[-1]
+            scores0, tok0 = jax.lax.top_k(logp0, K)  # (B,K)
+            cache = beam_select(out["cache"], jnp.repeat(jnp.arange(B), K), B)
+
+            finished0 = (tok0 == eos).reshape(B, K)
+            # History records the raw token (an immediate eos included, as HF
+            # does); only the NEXT model input becomes pad for finished beams.
+            history = jnp.full((B, K, max_new_tokens), pad_token_id, jnp.int32)
+            history = history.at[:, :, 0].set(tok0)
+            tok = jnp.where(finished0, pad_token_id, tok0).reshape(B * K)
+            lengths = jnp.ones((B, K), jnp.int32)  # generated tokens incl. eos
+            pos = jnp.repeat(real_len, K)  # next-token position per beam
+            full_len = real_len[:, None].astype(jnp.float32)  # prompt part
+
+            def norm_scores(scores, lengths):
+                # transformers divides by the FULL hypothesis length.
+                return scores / ((full_len + lengths.astype(jnp.float32)) ** length_penalty)
+
+            bank_score = jnp.where(
+                finished0, norm_scores(scores0, lengths), -jnp.inf
+            ).max(axis=1)
+            bank_hist = jnp.take_along_axis(
+                history,
+                jnp.argmax(jnp.where(finished0, norm_scores(scores0, lengths), -jnp.inf),
+                           axis=1)[:, None, None],
+                axis=1,
+            )[:, 0]
+
+            def step(carry, _):
+                cache, tok, scores, finished, lengths, history, pos, bank_score, bank_hist = carry
+                out = module.apply(params, input_ids=tok[:, None], cache=cache,
+                                   positions=pos[:, None])
+                logp = jax.nn.log_softmax(out["logits"][:, -1].astype(jnp.float32))
+                logp = logp.reshape(B, K, V)
+                # Finished beams may only extend with pad at zero cost.
+                pad_only = jnp.full((V,), -jnp.inf).at[pad_token_id].set(0.0)
+                logp = jnp.where(finished.reshape(B, K)[..., None], pad_only[None, None], logp)
+                cand = scores[..., None] + logp  # (B,K,V)
+                new_scores, flat_idx = jax.lax.top_k(cand.reshape(B, K * V), K)
+                parent = flat_idx // V  # (B,K) beam each winner extends
+                token = (flat_idx % V).astype(jnp.int32)
+
+                gidx = (jnp.arange(B)[:, None] * K + parent).reshape(-1)
+                new_cache = beam_select(out["cache"], gidx, B * K)
+                finished = jnp.take_along_axis(finished.reshape(B, K), parent, axis=1)
+                lengths = jnp.take_along_axis(lengths, parent, axis=1)
+                history = jnp.take_along_axis(history, parent[..., None], axis=1)
+                pos = jnp.take_along_axis(pos.reshape(B, K), parent, axis=1).reshape(-1)
+
+                newly = finished | (token == eos)
+                # Unfinished beams append their token (including the eos
+                # itself) at index `lengths`; finished beams write nothing.
+                lengths = lengths + (~finished).astype(jnp.int32)
+                idx = jnp.minimum(lengths - 1, max_new_tokens - 1)
+                history = jnp.where(
+                    (~finished)[..., None]
+                    & (jnp.arange(max_new_tokens)[None, None] == idx[..., None]),
+                    token[..., None],
+                    history,
+                )
+                next_tok = jnp.where(newly, pad_token_id, token).reshape(B * K)
+                pos = pos + 1
+                # Bank beams that finished THIS step (transformers'
+                # BeamHypotheses role): a banked hypothesis can never be
+                # evicted from the running top-k and lost.
+                just = newly & ~finished
+                cand_norm = jnp.where(just, norm_scores(new_scores, lengths), -jnp.inf)
+                step_best = jnp.argmax(cand_norm, axis=1)
+                step_score = jnp.take_along_axis(cand_norm, step_best[:, None], axis=1)[:, 0]
+                step_hist = jnp.take_along_axis(
+                    history, step_best[:, None, None], axis=1
+                )[:, 0]
+                better = step_score > bank_score
+                bank_score = jnp.where(better, step_score, bank_score)
+                bank_hist = jnp.where(better[:, None], step_hist, bank_hist)
+                return (new_cache, next_tok, new_scores, newly, lengths, history, pos,
+                        bank_score, bank_hist), None
+
+            carry = (cache, tok, scores0, finished0, lengths, history, pos,
+                     bank_score, bank_hist)
+            (cache, tok, scores, finished, lengths, history, pos,
+             bank_score, bank_hist), _ = jax.lax.scan(
+                step, carry, None, length=max_new_tokens - 1
+            )
+            # Final selection: best banked (finished) hypothesis vs the best
+            # still-running beam, both under the full-length penalty.
+            running = jnp.where(finished, -jnp.inf, norm_scores(scores, lengths))
+            run_best = jnp.argmax(running, axis=1)
+            run_score = jnp.take_along_axis(running, run_best[:, None], axis=1)[:, 0]
+            run_hist = jnp.take_along_axis(history, run_best[:, None, None], axis=1)[:, 0]
+            # If nothing is running (all finished) run_score is -inf → bank wins;
+            # if nothing ever finished the bank is -inf → running wins.
+            pick_bank = bank_score >= run_score
+            return jnp.where(pick_bank[:, None], bank_hist, run_hist)
+
+        cache_store[key] = jax.jit(run)
+    new_tokens = cache_store[key](params, input_ids, mask)
+    if include_prompt:
+        return jnp.concatenate([input_ids, new_tokens], axis=1)
+    return new_tokens
+
+
 def _unwrap(model):
     """(module, params) from a Module, PreparedModel, or raw (module, params)."""
     handle = getattr(model, "handle", None)
@@ -101,6 +278,8 @@ def generate(
     pad_token_id: int = 0,
     cache_dtype=jnp.bfloat16,
     include_prompt: bool = True,
+    num_beams: int = 1,
+    length_penalty: float = 1.0,
 ):
     """Generate ``max_new_tokens`` continuations for a batch of prompts.
 
@@ -116,6 +295,19 @@ def generate(
     ``decoder_start_token_id``, so there is no prompt to include.
     """
     from .big_modeling import StreamedScanModel
+
+    if num_beams > 1:
+        if temperature and temperature > 0.0:
+            raise ValueError("beam search is greedy; use temperature<=0 (or num_beams=1)")
+        if isinstance(model, StreamedScanModel) or hasattr(_unwrap(model)[0], "encode"):
+            raise ValueError("beam search supports decoder-only cached models")
+        return beam_search(
+            model, input_ids, num_beams=num_beams, max_new_tokens=max_new_tokens,
+            params=params, attention_mask=attention_mask,
+            length_penalty=length_penalty, eos_token_id=eos_token_id,
+            pad_token_id=pad_token_id, cache_dtype=cache_dtype,
+            include_prompt=include_prompt,
+        )
 
     input_ids = jnp.asarray(input_ids, jnp.int32)
     B, S = input_ids.shape

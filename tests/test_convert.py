@@ -951,3 +951,50 @@ def test_t5_v11_gated_gelu_matches_hf():
             do_sample=False, eos_token_id=None, pad_token_id=0,
         )
     np.testing.assert_array_equal(np.asarray(ours_gen), theirs_gen[:, 1:].numpy())
+
+
+def test_beam_search_matches_hf(hf_llama):
+    """Beam search parity vs transformers: with EOS disabled, all beams run to
+    max length and the best-score beam must match token-for-token."""
+    import jax.numpy as jnp
+
+    from accelerate_tpu.generation import generate
+    from accelerate_tpu.models.convert import from_hf
+
+    model, params = from_hf(hf_llama)
+    prompt = np.random.default_rng(30).integers(0, 128, (2, 6)).astype(np.int32)
+    ours = generate(model, prompt, max_new_tokens=7, num_beams=3,
+                    cache_dtype=jnp.float32)
+    with torch.no_grad():
+        theirs = hf_llama.generate(
+            torch.tensor(prompt, dtype=torch.long),
+            max_new_tokens=7, num_beams=3, do_sample=False,
+            eos_token_id=None, early_stopping=True, pad_token_id=0,
+        )
+    np.testing.assert_array_equal(np.asarray(ours), theirs.numpy())
+
+
+def test_beam_search_beats_greedy_likelihood(hf_llama):
+    """Sanity: the beam-search sequence's total log-probability is >= greedy's
+    (on the same model/prompt) — the property beam search exists for."""
+    import jax.numpy as jnp
+
+    from accelerate_tpu.generation import generate
+    from accelerate_tpu.models.convert import from_hf
+
+    model, params = from_hf(hf_llama)
+    prompt = np.random.default_rng(31).integers(0, 128, (1, 5)).astype(np.int32)
+
+    def seq_logprob(full_ids):
+        out = model.apply(params, input_ids=full_ids)
+        logp = jax.nn.log_softmax(np.asarray(out["logits"], np.float32), axis=-1)
+        total = 0.0
+        for t in range(prompt.shape[1] - 1, full_ids.shape[1] - 1):
+            total += logp[0, t, full_ids[0, t + 1]]
+        return total
+
+    greedy = np.asarray(generate(model, prompt, max_new_tokens=6, temperature=0.0,
+                                 cache_dtype=jnp.float32))
+    beam = np.asarray(generate(model, prompt, max_new_tokens=6, num_beams=4,
+                               cache_dtype=jnp.float32))
+    assert seq_logprob(beam) >= seq_logprob(greedy) - 1e-4

@@ -214,3 +214,35 @@ def test_dynamic_rope_cached_chunks_are_consistent():
     np.testing.assert_allclose(
         np.asarray(step["logits"][0, -1]), np.asarray(full["logits"][0, -1]), atol=1e-4
     )
+
+
+def test_beam_search_eos_freezes_beams(model_and_params):
+    """Beams that emit EOS freeze: output carries the eos then pads, and the
+    chosen beam's score stops changing."""
+    from accelerate_tpu.generation import generate
+
+    model, params = model_and_params
+    ids = np.random.default_rng(40).integers(1, 256, (1, 5)).astype(np.int32)
+    free = generate(model, ids, max_new_tokens=5, num_beams=3,
+                    cache_dtype=jnp.float32, include_prompt=False)
+    first = int(np.asarray(free)[0, 0])
+    out = generate(model, ids, max_new_tokens=5, num_beams=3, eos_token_id=first,
+                   pad_token_id=0, cache_dtype=jnp.float32, include_prompt=False)
+    row = np.asarray(out)[0]
+    if first in row.tolist():
+        k = row.tolist().index(first)
+        assert all(t == 0 for t in row[k + 1:]), row
+
+
+def test_beam_search_rejects_sampling_and_encdec(model_and_params):
+    from accelerate_tpu.generation import generate
+    from accelerate_tpu.models.t5 import T5Config, T5ForConditionalGeneration
+
+    model, params = model_and_params
+    ids = np.zeros((1, 4), np.int32)
+    with pytest.raises(ValueError, match="greedy"):
+        generate(model, ids, max_new_tokens=2, num_beams=2, temperature=0.7)
+    t5 = T5ForConditionalGeneration(T5Config.tiny())
+    t5.init_params(jax.random.key(0))
+    with pytest.raises(ValueError, match="decoder-only"):
+        generate(t5, ids, max_new_tokens=2, num_beams=2)
