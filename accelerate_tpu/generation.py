@@ -97,17 +97,20 @@ def beam_search(
     TPU-shaped like the sampling loop: beams live as a widened batch
     (B·num_beams), every step is one cached forward + a top-k over K·V + a
     gather that reorders the cache and token history along the beam dim, all
-    inside ``lax.scan`` (no per-step host round trips). Finished beams (EOS)
-    freeze their score and emit pad. Final selection applies HF's length
-    penalty ``score / len**penalty`` over finished-or-running beams.
+    inside ``lax.scan`` (no per-step host round trips).
 
     Reference parity: the reference defers to transformers'
     ``generate(num_beams=...)``; with ``eos_token_id=None`` this matches it
     token-for-token (tests/test_convert.py::test_beam_search_matches_hf).
-    Finished hypotheses are banked by normalized score (transformers'
-    BeamHypotheses role) so a finished beam can never be evicted by running
-    beams and then lost; the length penalty divides by the FULL sequence
-    length (prompt + generated), matching transformers.
+    EOS handling mirrors transformers' draw-2K-keep-K-non-eos scheme: eos
+    candidates ranked within the top num_beams are banked by normalized
+    score (BeamHypotheses' role — lower-ranked eos candidates are skipped,
+    HF's is_beam_token_worse_than_top_num_beams), and the best K non-eos
+    candidates keep running;
+    final selection compares the bank against the best running beam. The
+    length penalty divides by the GENERATED length (eos included for
+    banked hypotheses; the prompt never enters the denominator) — matching
+    transformers' generated_len convention.
     """
     module, mparams = _unwrap(model)
     if params is None:
@@ -147,6 +150,7 @@ def beam_search(
             total = S + max_new_tokens
             input_ids, mask = left_align(input_ids, mask)
             real_len = jnp.sum(mask, axis=-1).astype(jnp.int32)
+            full_len = real_len[:, None].astype(jnp.float32)  # prompt part
 
             # Prefill once per batch row, then tile the cache across beams.
             cache = module.init_cache(B, total, dtype=cache_dtype)
@@ -154,97 +158,84 @@ def beam_search(
                                cache=cache, positions=mask_positions(mask))
             logp0 = jax.nn.log_softmax(out["logits"][:, -1].astype(jnp.float32))  # (B,V)
             V = logp0.shape[-1]
-            scores0, tok0 = jax.lax.top_k(logp0, K)  # (B,K)
-            cache = beam_select(out["cache"], jnp.repeat(jnp.arange(B), K), B)
 
-            finished0 = (tok0 == eos).reshape(B, K)
-            # History records the raw token (an immediate eos included, as HF
-            # does); only the NEXT model input becomes pad for finished beams.
+            bank_score = jnp.full((B,), -jnp.inf, jnp.float32)
+            bank_hist = jnp.full((B, max_new_tokens), pad_token_id, jnp.int32)
+            if eos >= 0:
+                # transformers draws the top 2K continuations, banks the eos
+                # ones (normalized by the length WITHOUT the eos — here just
+                # the prompt), and keeps the best K non-eos running. An eos
+                # outside the top 2K is never banked.
+                topk0, idx0 = jax.lax.top_k(logp0, min(K, V))
+                in2k = jnp.any((idx0 == eos) & jnp.isfinite(topk0), axis=1)
+                # transformers' denominator is the GENERATED length including
+                # the eos (generated_len = cur_len+1 - prompt_len) — here 1.
+                bank_score = jnp.where(in2k, logp0[:, eos], -jnp.inf)
+                bank_hist = bank_hist.at[:, 0].set(jnp.where(in2k, eos, pad_token_id))
+                logp0 = logp0.at[:, eos].set(-jnp.inf)
+            scores, tok0 = jax.lax.top_k(logp0, K)  # (B,K)
+            cache = beam_select(out["cache"], jnp.repeat(jnp.arange(B), K), B)
             history = jnp.full((B, K, max_new_tokens), pad_token_id, jnp.int32)
             history = history.at[:, :, 0].set(tok0)
-            tok = jnp.where(finished0, pad_token_id, tok0).reshape(B * K)
-            lengths = jnp.ones((B, K), jnp.int32)  # generated tokens incl. eos
+            tok = tok0.reshape(B * K)
             pos = jnp.repeat(real_len, K)  # next-token position per beam
-            full_len = real_len[:, None].astype(jnp.float32)  # prompt part
 
-            def norm_scores(scores, lengths):
-                # transformers divides by the FULL hypothesis length.
-                return scores / ((full_len + lengths.astype(jnp.float32)) ** length_penalty)
-
-            bank_score = jnp.where(
-                finished0, norm_scores(scores0, lengths), -jnp.inf
-            ).max(axis=1)
-            bank_hist = jnp.take_along_axis(
-                history,
-                jnp.argmax(jnp.where(finished0, norm_scores(scores0, lengths), -jnp.inf),
-                           axis=1)[:, None, None],
-                axis=1,
-            )[:, 0]
-
-            def step(carry, _):
-                cache, tok, scores, finished, lengths, history, pos, bank_score, bank_hist = carry
+            def step(carry, s):
+                cache, tok, scores, history, bank_score, bank_hist = carry
                 out = module.apply(params, input_ids=tok[:, None], cache=cache,
-                                   positions=pos[:, None])
+                                   positions=pos_of(s))
                 logp = jax.nn.log_softmax(out["logits"][:, -1].astype(jnp.float32))
-                logp = logp.reshape(B, K, V)
-                # Finished beams may only extend with pad at zero cost.
-                pad_only = jnp.full((V,), -jnp.inf).at[pad_token_id].set(0.0)
-                logp = jnp.where(finished.reshape(B, K)[..., None], pad_only[None, None], logp)
-                cand = scores[..., None] + logp  # (B,K,V)
+                cand = scores[..., None] + logp.reshape(B, K, V)  # (B,K,V)
+                if eos >= 0:
+                    # HF's scheme: among the top 2K candidates, eos ones are
+                    # banked (normalized by the length excluding the eos =
+                    # prompt + s generated) — an eos outside the top 2K never
+                    # is — and the best K non-eos keep running.
+                    # (banked only when ranked within the top K — HF skips
+                    # eos candidates 'worse than top num_beams')
+                    top2k, idx2k = jax.lax.top_k(cand.reshape(B, K * V), K)
+                    is_eos2k = (idx2k % V) == eos
+                    eos_scores = jnp.where(is_eos2k, top2k, -jnp.inf)  # (B,2K)
+                    b_sel = jnp.argmax(eos_scores, axis=1)
+                    b_raw = jnp.take_along_axis(eos_scores, b_sel[:, None], axis=1)[:, 0]
+                    b_parent = jnp.take_along_axis(idx2k // V, b_sel[:, None], axis=1)[:, 0]
+                    b_score = b_raw / ((s + 1.0) ** length_penalty)
+                    b_hist = jnp.take_along_axis(
+                        history, b_parent[:, None, None], axis=1
+                    )[:, 0]
+                    b_hist = jnp.where(jnp.arange(max_new_tokens)[None] == s, eos, b_hist)
+                    better = b_score > bank_score
+                    bank_score = jnp.where(better, b_score, bank_score)
+                    bank_hist = jnp.where(better[:, None], b_hist, bank_hist)
+                    cand = cand.at[:, :, eos].set(-jnp.inf)
                 new_scores, flat_idx = jax.lax.top_k(cand.reshape(B, K * V), K)
                 parent = flat_idx // V  # (B,K) beam each winner extends
                 token = (flat_idx % V).astype(jnp.int32)
 
                 gidx = (jnp.arange(B)[:, None] * K + parent).reshape(-1)
                 new_cache = beam_select(out["cache"], gidx, B * K)
-                finished = jnp.take_along_axis(finished.reshape(B, K), parent, axis=1)
-                lengths = jnp.take_along_axis(lengths, parent, axis=1)
                 history = jnp.take_along_axis(history, parent[..., None], axis=1)
-                pos = jnp.take_along_axis(pos.reshape(B, K), parent, axis=1).reshape(-1)
-
-                newly = finished | (token == eos)
-                # Unfinished beams append their token (including the eos
-                # itself) at index `lengths`; finished beams write nothing.
-                lengths = lengths + (~finished).astype(jnp.int32)
-                idx = jnp.minimum(lengths - 1, max_new_tokens - 1)
                 history = jnp.where(
-                    (~finished)[..., None]
-                    & (jnp.arange(max_new_tokens)[None, None] == idx[..., None]),
-                    token[..., None],
-                    history,
+                    jnp.arange(max_new_tokens)[None, None] == s, token[..., None], history
                 )
-                next_tok = jnp.where(newly, pad_token_id, token).reshape(B * K)
-                pos = pos + 1
-                # Bank beams that finished THIS step (transformers'
-                # BeamHypotheses role): a banked hypothesis can never be
-                # evicted from the running top-k and lost.
-                just = newly & ~finished
-                cand_norm = jnp.where(just, norm_scores(new_scores, lengths), -jnp.inf)
-                step_best = jnp.argmax(cand_norm, axis=1)
-                step_score = jnp.take_along_axis(cand_norm, step_best[:, None], axis=1)[:, 0]
-                step_hist = jnp.take_along_axis(
-                    history, step_best[:, None, None], axis=1
-                )[:, 0]
-                better = step_score > bank_score
-                bank_score = jnp.where(better, step_score, bank_score)
-                bank_hist = jnp.where(better[:, None], step_hist, bank_hist)
-                return (new_cache, next_tok, new_scores, newly, lengths, history, pos,
+                return (new_cache, token.reshape(B * K), new_scores, history,
                         bank_score, bank_hist), None
 
-            carry = (cache, tok, scores0, finished0, lengths, history, pos,
-                     bank_score, bank_hist)
-            (cache, tok, scores, finished, lengths, history, pos,
-             bank_score, bank_hist), _ = jax.lax.scan(
-                step, carry, None, length=max_new_tokens - 1
+            def pos_of(s):
+                # Every beam always extends by one real token per step.
+                return (jnp.repeat(real_len, K) + s)[:, None]
+
+            carry = (cache, tok, scores, history, bank_score, bank_hist)
+            (cache, tok, scores, history, bank_score, bank_hist), _ = jax.lax.scan(
+                step, carry, jnp.arange(1, max_new_tokens)
             )
             # Final selection: best banked (finished) hypothesis vs the best
-            # still-running beam, both under the full-length penalty.
-            running = jnp.where(finished, -jnp.inf, norm_scores(scores, lengths))
+            # running beam at max length (HF finalize adds running beams with
+            # the full generated length in the denominator).
+            running = scores / (float(max_new_tokens) ** length_penalty)
             run_best = jnp.argmax(running, axis=1)
             run_score = jnp.take_along_axis(running, run_best[:, None], axis=1)[:, 0]
             run_hist = jnp.take_along_axis(history, run_best[:, None, None], axis=1)[:, 0]
-            # If nothing is running (all finished) run_score is -inf → bank wins;
-            # if nothing ever finished the bank is -inf → running wins.
             pick_bank = bank_score >= run_score
             return jnp.where(pick_bank[:, None], bank_hist, run_hist)
 

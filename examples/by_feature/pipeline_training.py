@@ -1,0 +1,67 @@
+"""Feature: true pipeline-parallel training (GPipe schedule).
+
+The ``pp`` mesh axis runs a real pipeline (``parallel/pipeline.py``): each
+stage keeps its block of layers stationary and microbatched activations move
+stage-to-stage by ``ppermute`` — the communication shape of Megatron/GPipe,
+not the all-gather-weights pattern of layer-dim sharding. Raise
+``num_microbatches`` to amortize the ``(P-1)/(M+P-1)`` bubble.
+
+The reference exposes pipeline training only as a Megatron ``pp_degree``
+passthrough (``utils/dataclasses.py:2110``); here it is native.
+
+Run (8 virtual devices):
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python examples/by_feature/pipeline_training.py --pp 2 --microbatches 4
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+import jax
+import optax
+
+from accelerate_tpu import Accelerator, ParallelismConfig
+from accelerate_tpu.models import Llama, LlamaConfig
+from accelerate_tpu.utils.dataclasses import PipelineParallelPlugin
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--pp", type=int, default=2)
+    ap.add_argument("--microbatches", type=int, default=4)
+    ap.add_argument("--num_steps", type=int, default=8)
+    ap.add_argument("--layers", type=int, default=4)
+    args = ap.parse_args()
+
+    accelerator = Accelerator(
+        parallelism_config=ParallelismConfig(pp_size=args.pp),
+        pp_plugin=PipelineParallelPlugin(pp_size=args.pp, num_microbatches=args.microbatches),
+    )
+    cfg = LlamaConfig.tiny(num_hidden_layers=args.layers)
+    model = Llama(cfg)
+    model.init_params(jax.random.key(0))
+    pmodel, popt = accelerator.prepare(model, optax.adamw(1e-2))
+    assert pmodel.handle.pipeline_spec is not None, "pipeline schedule did not engage"
+    accelerator.print(
+        f"GPipe engaged: {args.pp} stages x {pmodel.handle.pipeline_spec.num_microbatches} "
+        f"microbatches (bubble {(args.pp - 1) / (args.pp - 1 + pmodel.handle.pipeline_spec.num_microbatches):.0%})"
+    )
+
+    data_degree = accelerator.mesh.shape["dp"] * accelerator.mesh.shape["fsdp"]
+    batch = data_degree * args.microbatches  # rows must cover data shards x microbatches
+    rng = np.random.default_rng(0)
+    step = accelerator.build_train_step(pmodel, popt)
+    for i in range(args.num_steps):
+        ids = rng.integers(0, cfg.vocab_size, (batch, 32)).astype(np.int32)
+        loss = step({"input_ids": ids, "labels": ids})
+        accelerator.print(f"step {i}: loss {float(loss):.4f}")
+    accelerator.print("pipeline training done")
+
+
+if __name__ == "__main__":
+    main()

