@@ -71,7 +71,7 @@ def __getattr__(name):
         from . import local_sgd
 
         return getattr(local_sgd, name)
-    if name in ("generate", "sample_logits"):
+    if name in ("generate", "sample_logits", "beam_search", "assisted_generate"):
         from . import generation
 
         return getattr(generation, name)

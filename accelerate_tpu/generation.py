@@ -246,6 +246,154 @@ def beam_search(
     return new_tokens
 
 
+def assisted_generate(
+    model,
+    draft_model,
+    input_ids,
+    *,
+    max_new_tokens: int,
+    num_draft_tokens: int = 5,
+    params=None,
+    draft_params=None,
+    eos_token_id: int | None = None,
+    pad_token_id: int = 0,
+    cache_dtype=jnp.float32,
+    include_prompt: bool = True,
+):
+    """Speculative (assisted) greedy decoding — transformers'
+    ``generate(assistant_model=...)``, TPU-shaped.
+
+    The draft model proposes ``num_draft_tokens`` greedily from its own KV
+    cache; the target scores the whole proposal in ONE cached forward and
+    accepts the longest matching prefix, emitting one extra corrected token —
+    so each target forward yields 1..γ+1 tokens while the output is **exactly
+    the target model's greedy decode** (the speculative guarantee, pinned by
+    tests). Both caches roll back to the accepted length by rewinding the
+    write offset and kv_mask; the whole accept/rollback loop is a
+    ``lax.while_loop`` inside one jit (no host round-trips).
+
+    Greedy only, batch size 1 (the transformers restriction as well).
+    """
+    module, mparams = _unwrap(model)
+    dmodule, dmparams = _unwrap(draft_model)
+    params = params if params is not None else mparams
+    draft_params = draft_params if draft_params is not None else dmparams
+    if params is None or draft_params is None:
+        raise ValueError("Both target and draft models need params.")
+    input_ids = jnp.asarray(input_ids, jnp.int32)
+    B, S = input_ids.shape
+    if B != 1:
+        raise ValueError("assisted generation supports batch_size=1 (as transformers)")
+    gamma = num_draft_tokens
+    eos = -1 if eos_token_id is None else eos_token_id
+
+    cache_store = module.__dict__.setdefault("_generate_fns", {})
+    key = ("assisted", id(dmodule), gamma, max_new_tokens, eos, pad_token_id, str(cache_dtype))
+    if key not in cache_store:
+
+        def rollback(cache, new_pos):
+            """Rewind a cache's write offset: slots >= new_pos become invalid
+            (kv_mask zeroed; stale k/v are masked by causality and later
+            overwritten)."""
+            total = cache["kv_mask"].shape[1]
+            return {
+                **cache,
+                "pos": new_pos,
+                "kv_mask": jnp.where(
+                    jnp.arange(total)[None] < new_pos, cache["kv_mask"], 0
+                ),
+            }
+
+        def run(params, draft_params, input_ids):
+            S = input_ids.shape[1]
+            total = S + max_new_tokens + gamma + 1  # headroom for the last chunk
+            t_cache = module.init_cache(1, total, dtype=cache_dtype)
+            d_cache = dmodule.init_cache(1, total + 1, dtype=cache_dtype)
+
+            t_out = module.apply(params, input_ids=input_ids, cache=t_cache)
+            d_out = dmodule.apply(draft_params, input_ids=input_ids, cache=d_cache)
+            first = jnp.argmax(t_out["logits"][0, -1]).astype(jnp.int32)
+
+            out_buf = jnp.full((max_new_tokens + gamma + 1,), pad_token_id, jnp.int32)
+            out_buf = out_buf.at[0].set(first)
+
+            def cond(carry):
+                n, finished, *_ = carry
+                return (n < max_new_tokens) & ~finished
+
+            def body(carry):
+                n, finished, last_tok, out_buf, t_cache, d_cache = carry
+
+                # Draft proposes gamma tokens greedily from its own cache.
+                def d_step(c, _):
+                    d_cache, tok = c
+                    o = dmodule.apply(draft_params, input_ids=tok[None, None], cache=d_cache)
+                    nxt = jnp.argmax(o["logits"][0, -1]).astype(jnp.int32)
+                    return (o["cache"], nxt), nxt
+
+                # One extra step so the draft cache also holds the LAST
+                # proposal's KV — otherwise a fully-accepted round leaves a
+                # permanent hole that silently degrades later acceptance.
+                (d_cache, _), draft_all = jax.lax.scan(
+                    d_step, (d_cache, last_tok), None, length=gamma + 1
+                )
+                draft = draft_all[:gamma]
+                # Target scores [last_tok, d0..d_{g-1}] in one chunk of g+1:
+                # t_choice[i] is the target's greedy pick after ...last,d0..d_{i-1},
+                # so t_choice[n_acc] is the correction at the first mismatch AND
+                # the bonus continuation when everything matched.
+                chunk = jnp.concatenate([last_tok[None], draft])[None]  # (1, g+1)
+                t_out = module.apply(params, input_ids=chunk, cache=t_cache)
+                t_choice = jnp.argmax(t_out["logits"][0], axis=-1).astype(jnp.int32)  # (g+1,)
+                match = t_choice[:gamma] == draft
+                n_acc = jnp.argmin(
+                    jnp.concatenate([match, jnp.zeros((1,), bool)])
+                ).astype(jnp.int32)  # accepted prefix length, 0..gamma
+                fix = t_choice[n_acc]
+                produced = n_acc + 1
+
+                slot = jnp.arange(gamma + 1)
+                block = jnp.where(
+                    slot < n_acc,
+                    jnp.concatenate([draft, jnp.zeros((1,), jnp.int32)]),
+                    jnp.where(slot == n_acc, fix, pad_token_id),
+                )
+                out_buf = jax.lax.dynamic_update_slice(out_buf, block, (n,))
+                hit_eos = (
+                    jnp.any((slot < produced) & (block == eos))
+                    if eos >= 0
+                    else jnp.asarray(False)
+                )
+                # Roll both caches back to the accepted frontier (last_tok +
+                # accepted draft tokens; the fix token's KV lands next round).
+                t_cache = rollback(t_out["cache"], t_out["cache"]["pos"] - gamma + n_acc)
+                d_cache = rollback(d_cache, d_cache["pos"] - gamma + n_acc)
+                return (n + produced, hit_eos, fix, out_buf, t_cache, d_cache)
+
+            carry = (jnp.int32(1), jnp.asarray(first == eos), first, out_buf,
+                     t_out["cache"], d_out["cache"])
+            n, finished, last, out_buf, *_ = jax.lax.while_loop(cond, body, carry)
+            out = out_buf[:max_new_tokens]
+            if eos >= 0:
+                # Pad strictly after the first eos.
+                after = jnp.cumsum(jnp.cumsum((out == eos).astype(jnp.int32)))
+                out = jnp.where(after > 1, pad_token_id, out)
+            out = jnp.where(jnp.arange(max_new_tokens) < n, out, pad_token_id)
+            return out[None]
+
+        cache_store[key] = jax.jit(run)
+        # Each assisted entry's closure pins its draft module + compiled
+        # executables; cap retention so sweeping draft models can't grow
+        # host memory without bound.
+        assisted_keys = [k for k in cache_store if k[0] == "assisted"]
+        for stale in assisted_keys[:-4]:
+            del cache_store[stale]
+    new_tokens = cache_store[key](params, draft_params, input_ids)
+    if include_prompt:
+        return jnp.concatenate([input_ids, new_tokens], axis=1)
+    return new_tokens
+
+
 def _unwrap(model):
     """(module, params) from a Module, PreparedModel, or raw (module, params)."""
     handle = getattr(model, "handle", None)
@@ -360,17 +508,17 @@ def _scan_decode(first_out, step_apply, rng, max_new_tokens, temperature, top_k,
         positions0 = jnp.zeros((B,), jnp.int32)
     rng0, rng_loop = jax.random.split(rng)
     tok = sample_logits(first_out["logits"][:, -1], rng0, temperature, top_k, top_p)
+    # HF convention (shared by the beam/assisted paths): the eos itself is
+    # emitted; only tokens AFTER it become pad.
     finished = tok == eos
-    tok = jnp.where(finished, pad_token_id, tok)
 
     def step(carry, _):
         cache, tok, pos, finished, rng = carry
         rng, sub = jax.random.split(rng)
-        out = step_apply(tok, cache, pos)
+        out = step_apply(jnp.where(finished, pad_token_id, tok), cache, pos)
         nxt = sample_logits(out["logits"][:, -1], sub, temperature, top_k, top_p)
-        newly = finished | (nxt == eos)
-        nxt = jnp.where(newly, pad_token_id, nxt)
-        return (out["cache"], nxt, pos + 1, newly, rng), nxt
+        nxt = jnp.where(finished, pad_token_id, nxt)
+        return (out["cache"], nxt, pos + 1, finished | (nxt == eos), rng), nxt
 
     (_, _, _, _, _), rest = jax.lax.scan(
         step, (first_out["cache"], tok, positions0, finished, rng_loop), None,
@@ -455,20 +603,21 @@ def _generate_streamed(model, input_ids, attention_mask, max_new_tokens,
     last_logits = out["logits"][:, -1]
     rng, sub = jax.random.split(rng)
     tok = sample_logits(last_logits, sub, temperature, top_k, top_p)
+    # HF convention (shared with the compiled paths): the eos itself is
+    # emitted; only tokens AFTER it become pad.
     finished = tok == eos
-    tok = jnp.where(finished, pad_token_id, tok)
     cache = out["cache"]
 
     tokens = [tok]
     for _ in range(max_new_tokens - 1):
         rng, sub = jax.random.split(rng)
-        out = model(input_ids=tok[:, None], cache=cache, positions=next_pos[:, None])
+        out = model(input_ids=jnp.where(finished, pad_token_id, tok)[:, None],
+                    cache=cache, positions=next_pos[:, None])
         next_pos = next_pos + 1
         cache = out["cache"]
         nxt = sample_logits(out["logits"][:, -1], sub, temperature, top_k, top_p)
-        newly = finished | (nxt == eos)
-        nxt = jnp.where(finished | (nxt == eos), pad_token_id, nxt)
-        finished = newly
+        nxt = jnp.where(finished, pad_token_id, nxt)
+        finished = finished | (nxt == eos)
         tokens.append(nxt)
         tok = nxt
     return jnp.stack(tokens, axis=1)

@@ -115,7 +115,10 @@ def test_generate_eos_fills_pad(model_and_params):
     first = int(free[0, 0])
     out = generate(model, ids, max_new_tokens=4, eos_token_id=first, pad_token_id=0,
                    cache_dtype=jnp.float32, include_prompt=False)
-    np.testing.assert_array_equal(np.asarray(out[0]), np.zeros(4, np.int32))
+    # HF convention: the eos itself is emitted, everything after is pad.
+    np.testing.assert_array_equal(
+        np.asarray(out[0]), np.array([first, 0, 0, 0], np.int32)
+    )
 
 
 def test_sampling_controls():
@@ -246,3 +249,61 @@ def test_beam_search_rejects_sampling_and_encdec(model_and_params):
     t5.init_params(jax.random.key(0))
     with pytest.raises(ValueError, match="decoder-only"):
         generate(t5, ids, max_new_tokens=2, num_beams=2)
+
+
+def test_assisted_generation_exactly_matches_greedy(model_and_params):
+    """The speculative guarantee: assisted decoding's output is EXACTLY the
+    target model's greedy decode, for any draft model — here both a weaker
+    independent draft (partial acceptance) and the target itself (full
+    acceptance fast path)."""
+    from accelerate_tpu.generation import assisted_generate, generate
+    from accelerate_tpu.models import Llama, LlamaConfig
+
+    model, params = model_and_params
+    ids = np.random.default_rng(50).integers(1, 256, (1, 6)).astype(np.int32)
+    ref = np.asarray(generate(model, ids, max_new_tokens=10, temperature=0.0,
+                              cache_dtype=jnp.float32, include_prompt=False))
+
+    draft = Llama(LlamaConfig.tiny(num_hidden_layers=1))
+    draft.init_params(jax.random.key(123))
+    for gamma in (1, 3, 5):
+        out = np.asarray(assisted_generate(
+            model, draft, ids, max_new_tokens=10, num_draft_tokens=gamma,
+            cache_dtype=jnp.float32, include_prompt=False,
+        ))
+        np.testing.assert_array_equal(out, ref, err_msg=f"gamma={gamma} (weak draft)")
+
+    # Target-as-draft: every proposal accepted, output still identical.
+    out = np.asarray(assisted_generate(
+        model, model, ids, max_new_tokens=10, num_draft_tokens=4,
+        cache_dtype=jnp.float32, include_prompt=False,
+    ))
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_assisted_generation_eos_stops(model_and_params):
+    from accelerate_tpu.generation import assisted_generate, generate
+
+    model, params = model_and_params
+    ids = np.random.default_rng(51).integers(1, 256, (1, 5)).astype(np.int32)
+    free = np.asarray(generate(model, ids, max_new_tokens=6, temperature=0.0,
+                               cache_dtype=jnp.float32, include_prompt=False))
+    eos_tok = int(free[0, 2])  # force a stop partway through
+    ref = np.asarray(generate(model, ids, max_new_tokens=6, temperature=0.0,
+                              eos_token_id=eos_tok, pad_token_id=0,
+                              cache_dtype=jnp.float32, include_prompt=False))
+    out = np.asarray(assisted_generate(
+        model, model, ids, max_new_tokens=6, num_draft_tokens=3,
+        eos_token_id=eos_tok, pad_token_id=0,
+        cache_dtype=jnp.float32, include_prompt=False,
+    ))
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_assisted_generation_rejects_batches(model_and_params):
+    from accelerate_tpu.generation import assisted_generate
+
+    model, params = model_and_params
+    with pytest.raises(ValueError, match="batch_size=1"):
+        assisted_generate(model, model, np.zeros((2, 4), np.int32),
+                          max_new_tokens=2)
