@@ -674,8 +674,12 @@ def test_window_with_explicit_kernel_impl_raises():
     from accelerate_tpu.ops.attention import attention
 
     q = np.zeros((1, 8, 2, 4), np.float32)
-    with pytest.raises(ValueError, match="dense-only"):
+    # Windowed attention routes through dense or the splash kernel — the plain
+    # flash/ring/ulysses impls cannot express it.
+    with pytest.raises(ValueError, match="dense or"):
         attention(q, q, q, impl="flash", window=4)
+    with pytest.raises(ValueError, match="TPU"):
+        attention(q, q, q, impl="splash", window=4)  # CPU test mesh has no TPU
 
 
 def test_gemma_logits_match_hf():

@@ -191,3 +191,30 @@ def test_flash_block_path_matches_dense_on_tpu():
     ):
         rel_err = float(jnp.abs(mine - refg).max()) / max(float(jnp.abs(refg).max()), 1e-6)
         assert rel_err < 2e-2, rel_err
+
+
+@pytest.mark.skipif(jax.default_backend() != "tpu", reason="Pallas splash kernel needs a TPU")
+def test_splash_matches_dense_windowed_softcapped():
+    """Splash kernel vs dense for the Mistral/Gemma-2 recipes (local window,
+    logit softcap, scale override, padding mask) — bf16-precision agreement
+    (the kernel accumulates at ~bf16 internally)."""
+    from accelerate_tpu.ops.attention import dense_attention, splash_attention
+
+    rng = np.random.default_rng(0)
+    B, S, H, D = 2, 1024, 4, 128
+    q = jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.float32)
+    mask = np.ones((B, S), np.int32)
+    mask[1, 900:] = 0
+    for kwargs in (
+        dict(window=256, softcap=None, scale=None),
+        dict(window=None, softcap=50.0, scale=None),
+        dict(window=256, softcap=50.0, scale=0.1),
+    ):
+        d = dense_attention(q, k, v, causal=True, mask=jnp.asarray(mask), **kwargs)
+        s = splash_attention(q, k, v, causal=True, mask=jnp.asarray(mask), **kwargs)
+        valid = mask.astype(bool)
+        np.testing.assert_allclose(
+            np.asarray(d)[valid], np.asarray(s)[valid], atol=3e-2, err_msg=str(kwargs)
+        )
