@@ -91,12 +91,15 @@ class LlamaConfig:
     # fp32 logit tensor never materializes. Training-memory lever for large
     # vocab x long context; outputs carry loss but NO logits when it engages.
     fused_loss: bool = False
+    fused_loss_chunk: int = 8192  # vocab tile per scan step
 
     def __post_init__(self):
         if self.head_dim is None:
             self.head_dim = self.hidden_size // self.num_attention_heads
         if self.hidden_act not in ("silu", "gelu_tanh"):
             raise ValueError(f"hidden_act must be silu|gelu_tanh, got {self.hidden_act!r}")
+        if self.fused_loss_chunk <= 0:
+            raise ValueError(f"fused_loss_chunk must be > 0, got {self.fused_loss_chunk}")
         if self.layer_windows is not None:
             self.layer_windows = tuple(self.layer_windows)
             if len(self.layer_windows) != self.num_hidden_layers:
@@ -528,6 +531,7 @@ class Llama(Module):
             loss = fused_cross_entropy_loss(
                 x, head_w, self._shift_labels(labels, attention_mask),
                 logit_cap=cfg.final_logit_softcap,
+                vocab_chunk=cfg.fused_loss_chunk,
             )
             return ModelOutput(loss=loss)
         logits = x @ head_w

@@ -87,3 +87,34 @@ def test_llama_fused_loss_flag_matches_dense_path():
     fused_out = model.apply(params, input_ids=ids, labels=ids, attention_mask=mask)
     np.testing.assert_allclose(float(fused_out["loss"]), float(dense_out["loss"]), rtol=1e-6)
     assert "logits" not in fused_out  # the whole point: no logits materialized
+
+
+def test_fused_loss_trains_under_sharding():
+    """The vocab-chunk scan must compose with tp/fsdp sharding of the LM head
+    (the head weight reshapes to (h, chunks, c) under GSPMD)."""
+    import optax
+
+    from accelerate_tpu import Accelerator, ParallelismConfig
+    from accelerate_tpu.models import Llama, LlamaConfig
+    from accelerate_tpu.state import AcceleratorState, GradientState
+
+    def run(fused):
+        AcceleratorState._reset_state(reset_partial_state=True)
+        GradientState._reset_state()
+        acc = Accelerator(parallelism_config=ParallelismConfig(tp_size=2, fsdp_size=2, dp_size=2))
+        cfg = LlamaConfig.tiny(
+            vocab_size=100,  # 3 full chunks + ragged tail under sharding
+            hidden_size=64, intermediate_size=128,
+            num_attention_heads=2, num_key_value_heads=2, num_hidden_layers=2,
+            fused_loss=fused, fused_loss_chunk=32,
+        )
+        model = Llama(cfg)
+        model.init_params(jax.random.key(0))
+        pmodel, popt = acc.prepare(model, optax.sgd(0.05))
+        step = acc.build_train_step(pmodel, popt)
+        ids = np.random.default_rng(0).integers(0, 100, (8, 16)).astype(np.int32)
+        return [float(step({"input_ids": ids, "labels": ids})) for _ in range(3)]
+
+    dense = run(False)
+    fused = run(True)
+    np.testing.assert_allclose(fused, dense, rtol=1e-5)
