@@ -150,7 +150,6 @@ def beam_search(
             total = S + max_new_tokens
             input_ids, mask = left_align(input_ids, mask)
             real_len = jnp.sum(mask, axis=-1).astype(jnp.int32)
-            full_len = real_len[:, None].astype(jnp.float32)  # prompt part
 
             # Prefill once per batch row, then tile the cache across beams.
             cache = module.init_cache(B, total, dtype=cache_dtype)
@@ -162,23 +161,22 @@ def beam_search(
             bank_score = jnp.full((B,), -jnp.inf, jnp.float32)
             bank_hist = jnp.full((B, max_new_tokens), pad_token_id, jnp.int32)
             if eos >= 0:
-                # transformers draws the top 2K continuations, banks the eos
-                # ones (normalized by the length WITHOUT the eos — here just
-                # the prompt), and keeps the best K non-eos running. An eos
-                # outside the top 2K is never banked.
+                # transformers banks an eos continuation only when it ranks
+                # within the top K ("is_beam_token_worse_than_top_num_beams"
+                # skip), normalized by the generated length WITHOUT the eos —
+                # here just the prompt — and keeps the best K non-eos running.
                 topk0, idx0 = jax.lax.top_k(logp0, min(K, V))
-                in2k = jnp.any((idx0 == eos) & jnp.isfinite(topk0), axis=1)
+                ink = jnp.any((idx0 == eos) & jnp.isfinite(topk0), axis=1)
                 # transformers' denominator is the GENERATED length including
                 # the eos (generated_len = cur_len+1 - prompt_len) — here 1.
-                bank_score = jnp.where(in2k, logp0[:, eos], -jnp.inf)
-                bank_hist = bank_hist.at[:, 0].set(jnp.where(in2k, eos, pad_token_id))
+                bank_score = jnp.where(ink, logp0[:, eos], -jnp.inf)
+                bank_hist = bank_hist.at[:, 0].set(jnp.where(ink, eos, pad_token_id))
                 logp0 = logp0.at[:, eos].set(-jnp.inf)
             scores, tok0 = jax.lax.top_k(logp0, K)  # (B,K)
             cache = beam_select(out["cache"], jnp.repeat(jnp.arange(B), K), B)
             history = jnp.full((B, K, max_new_tokens), pad_token_id, jnp.int32)
             history = history.at[:, :, 0].set(tok0)
             tok = tok0.reshape(B * K)
-            pos = jnp.repeat(real_len, K)  # next-token position per beam
 
             def step(carry, s):
                 cache, tok, scores, history, bank_score, bank_hist = carry
@@ -187,18 +185,17 @@ def beam_search(
                 logp = jax.nn.log_softmax(out["logits"][:, -1].astype(jnp.float32))
                 cand = scores[..., None] + logp.reshape(B, K, V)  # (B,K,V)
                 if eos >= 0:
-                    # HF's scheme: among the top 2K candidates, eos ones are
-                    # banked (normalized by the length excluding the eos =
-                    # prompt + s generated) — an eos outside the top 2K never
-                    # is — and the best K non-eos keep running.
-                    # (banked only when ranked within the top K — HF skips
-                    # eos candidates 'worse than top num_beams')
-                    top2k, idx2k = jax.lax.top_k(cand.reshape(B, K * V), K)
-                    is_eos2k = (idx2k % V) == eos
-                    eos_scores = jnp.where(is_eos2k, top2k, -jnp.inf)  # (B,2K)
+                    # HF's scheme: an eos candidate is banked only when it
+                    # ranks within the top K (HF skips eos candidates 'worse
+                    # than top num_beams'), normalized by the length excluding
+                    # the eos (= prompt + s generated); the best K non-eos
+                    # keep running.
+                    topk, idxk = jax.lax.top_k(cand.reshape(B, K * V), K)
+                    is_eosk = (idxk % V) == eos
+                    eos_scores = jnp.where(is_eosk, topk, -jnp.inf)  # (B,K)
                     b_sel = jnp.argmax(eos_scores, axis=1)
                     b_raw = jnp.take_along_axis(eos_scores, b_sel[:, None], axis=1)[:, 0]
-                    b_parent = jnp.take_along_axis(idx2k // V, b_sel[:, None], axis=1)[:, 0]
+                    b_parent = jnp.take_along_axis(idxk // V, b_sel[:, None], axis=1)[:, 0]
                     b_score = b_raw / ((s + 1.0) ** length_penalty)
                     b_hist = jnp.take_along_axis(
                         history, b_parent[:, None, None], axis=1
@@ -222,8 +219,9 @@ def beam_search(
                         bank_score, bank_hist), None
 
             def pos_of(s):
-                # Every beam always extends by one real token per step.
-                return (jnp.repeat(real_len, K) + s)[:, None]
+                # The token fed at scan step ``s`` is generation index s-1
+                # (tok0 at s=1), so its position is prompt_len + s - 1.
+                return (jnp.repeat(real_len, K) + s - 1)[:, None]
 
             carry = (cache, tok, scores, history, bank_score, bank_hist)
             (cache, tok, scores, history, bank_score, bank_hist), _ = jax.lax.scan(

@@ -978,6 +978,38 @@ def test_beam_search_matches_hf(hf_llama):
     np.testing.assert_array_equal(np.asarray(ours), theirs.numpy())
 
 
+def test_beam_search_gpt2_matches_hf():
+    """Beam search on GPT-2: learned absolute positions make the decode-step
+    position the hard case — the token fed at scan step s is generation index
+    s-1, so its wpe row is prompt_len + s - 1. An off-by-one here perturbs
+    every step's logits yet can hide under argmax margins on a lucky model,
+    so pin several independently-seeded tiny models (ADVICE r3 high)."""
+    import jax.numpy as jnp
+
+    from accelerate_tpu.generation import generate
+    from accelerate_tpu.models.convert import from_hf
+
+    for seed in (1, 2, 3, 4):
+        cfg = transformers.GPT2Config(
+            vocab_size=128, n_embd=64, n_layer=2, n_head=4, n_positions=64,
+            attn_implementation="eager",
+        )
+        torch.manual_seed(seed)
+        hf = transformers.GPT2LMHeadModel(cfg).eval()
+        model, params = from_hf(hf)
+        prompt = np.random.default_rng(40 + seed).integers(0, 128, (2, 6)).astype(np.int32)
+        ours = generate(model, prompt, max_new_tokens=7, num_beams=3,
+                        cache_dtype=jnp.float32)
+        with torch.no_grad():
+            theirs = hf.generate(
+                torch.tensor(prompt, dtype=torch.long),
+                max_new_tokens=7, num_beams=3, do_sample=False,
+                eos_token_id=None, early_stopping=True, pad_token_id=0,
+            )
+        np.testing.assert_array_equal(np.asarray(ours), theirs.numpy(),
+                                      err_msg=f"model seed {seed}")
+
+
 def test_beam_search_beats_greedy_likelihood(hf_llama):
     """Sanity: the beam-search sequence's total log-probability is >= greedy's
     (on the same model/prompt) — the property beam search exists for."""
