@@ -678,7 +678,8 @@ class Accelerator:
                 raise ValueError(
                     f"ACCELERATE_PP_MICROBATCHES={env_mbs!r} is not an integer"
                 ) from None
-        pipeline_spec = resolve_pipeline_spec(module, params, self.mesh, mbs)
+        schedule = self.pp_plugin.schedule if self.pp_plugin is not None else "gpipe"
+        pipeline_spec = resolve_pipeline_spec(module, params, self.mesh, mbs, schedule=schedule)
         handle = TrainHandle(
             module, params, shardings, self.mesh, compute_dtype, rng,
             pipeline_spec=pipeline_spec,

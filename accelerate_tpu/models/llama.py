@@ -603,19 +603,11 @@ class Llama(Module):
         """
         cfg = self.config
         ws = cfg.layer_windows
-        L = cfg.num_hidden_layers
         if ws is None:
-            return [(0, L, (cfg.sliding_window,))]
-        for p in (1, 2, 3, 4):
-            # A period must actually repeat (>= 2 folds) to beat plain runs.
-            if L % p == 0 and L // p >= 2 and all(ws[i] == ws[i % p] for i in range(L)):
-                return [(0, L, tuple(ws[:p]))]
-        runs, start = [], 0
-        for i in range(1, L + 1):
-            if i == L or ws[i] != ws[start]:
-                runs.append((start, i - start, (ws[start],)))
-                start = i
-        return runs
+            return [(0, cfg.num_hidden_layers, (cfg.sliding_window,))]
+        from ..parallel.pipeline import _window_segments
+
+        return _window_segments(ws)
 
     def _run_layers(self, stacked, x, ctx, aux_keys=()):
         """Run the stacked layers through per-regime scan segments; returns

