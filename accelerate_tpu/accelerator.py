@@ -180,6 +180,16 @@ class PreparedModel:
         module = self.handle.module
         cast = self._cast
         extract = extract or self.loss_fn
+        if self._uses_1f1b():
+            # training_loss_fn consumers (LocalSGDTrainer, custom loops) drive
+            # their own value_and_grad — they cannot honor the 1F1B schedule,
+            # and silently running GPipe would deliver O(M) activation
+            # liveness the user opted out of.
+            raise ValueError(
+                "schedule='1f1b' trains through build_train_step or the "
+                "imperative prepared-model forward only; use "
+                "PipelineParallelPlugin(schedule='gpipe') with this training path."
+            )
         pipe = {"pipeline": self.handle.pipeline_spec} if self.handle.pipeline_spec is not None else {}
 
         def loss_of(params, batch, rng):
@@ -208,30 +218,67 @@ class PreparedModel:
             return None
         return {"bf16": jnp.bfloat16, "fp16": jnp.float16}[name]
 
+    def _uses_1f1b(self):
+        spec = self.handle.pipeline_spec
+        return spec is not None and spec.schedule == "1f1b"
+
+    def _check_1f1b_loss_fn(self, extract):
+        if extract is not None and extract is not default_loss_extractor:
+            raise ValueError(
+                "schedule='1f1b' computes the loss on the last pipeline stage "
+                "via the model's own head (labels in the batch) — a custom "
+                "loss_fn cannot be honored. Drop set_loss_fn/loss_fn or use "
+                "PipelineParallelPlugin(schedule='gpipe')."
+            )
+
     def _build_calls(self):
         module = self.handle.module
         loss_fn = self.loss_fn
         cast = self._cast
-        # Training forwards route through the GPipe schedule when one resolved;
-        # eval keeps the GSPMD path (eval batch sizes need not divide the
-        # microbatch grid, and eval throughput is not pipeline-bound).
-        pipe = {"pipeline": self.handle.pipeline_spec} if self.handle.pipeline_spec is not None else {}
+        handle = self.handle
+        # Training forwards route through the pipeline schedule when one
+        # resolved; eval keeps the GSPMD path (eval batch sizes need not
+        # divide the microbatch grid, and eval throughput is not
+        # pipeline-bound).
+        pipe = {"pipeline": handle.pipeline_spec} if handle.pipeline_spec is not None else {}
 
         def fwd(params, args, kwargs, rng):
             return module.apply(cast(params), *args, train=False, rngs=None, **kwargs)
 
-        def loss_and_out(params, args, kwargs, rng, loss_scale):
-            outputs = module.apply(
-                cast(params), *args, train=True, rngs={"dropout": rng}, **pipe, **kwargs
-            )
-            loss = loss_fn(outputs, kwargs if kwargs else args)
-            return loss * loss_scale, outputs
+        if self._uses_1f1b():
+            self._check_1f1b_loss_fn(self.loss_fn)
+            spec = handle.pipeline_spec
 
-        def train_fwd(params, args, kwargs, rng, loss_scale):
-            (scaled_loss, outputs), grads = jax.value_and_grad(loss_and_out, has_aux=True)(
-                params, args, kwargs, rng, loss_scale
-            )
-            return scaled_loss / loss_scale, outputs, grads
+            def train_fwd(params, args, kwargs, rng, loss_scale):
+                # The 1F1B schedule produces loss AND grads in one pass; the
+                # outputs carry loss (and aux) but no logits — the same
+                # contract as fused_loss. Positional args follow the model
+                # apply() convention (input_ids, labels, attention_mask, ...).
+                batch = dict(zip(("input_ids", "labels", "attention_mask", "positions"), args))
+                batch.update(kwargs)
+                loss, grads, aux = spec.train_grads(
+                    module, params, batch,
+                    compute_dtype=handle.compute_dtype, loss_scale=loss_scale,
+                    param_shardings=handle.param_shardings,
+                )
+                outputs = ModelOutput(loss=loss)
+                if aux:
+                    outputs["aux_loss"] = sum(aux.values())
+                return loss, outputs, grads
+        else:
+
+            def loss_and_out(params, args, kwargs, rng, loss_scale):
+                outputs = module.apply(
+                    cast(params), *args, train=True, rngs={"dropout": rng}, **pipe, **kwargs
+                )
+                loss = loss_fn(outputs, kwargs if kwargs else args)
+                return loss * loss_scale, outputs
+
+            def train_fwd(params, args, kwargs, rng, loss_scale):
+                (scaled_loss, outputs), grads = jax.value_and_grad(loss_and_out, has_aux=True)(
+                    params, args, kwargs, rng, loss_scale
+                )
+                return scaled_loss / loss_scale, outputs, grads
 
         self._eval_call = jax.jit(fwd)
         self._train_call = jax.jit(train_fwd)
@@ -893,11 +940,25 @@ class Accelerator:
         optimizer._ensure_initialized()
         accum = self.gradient_accumulation_steps
         tx = optimizer.tx
-        loss_of = model.training_loss_fn(loss_fn)
+        spec = handle.pipeline_spec
+        if model._uses_1f1b():
+            model._check_1f1b_loss_fn(loss_fn if loss_fn is not None else model.loss_fn)
+
+            def value_and_grads(params, batch, rng):
+                loss, grads, _aux = spec.train_grads(
+                    handle.module, params, batch, compute_dtype=handle.compute_dtype,
+                    param_shardings=handle.param_shardings,
+                )
+                return loss, grads
+        else:
+            loss_of = model.training_loss_fn(loss_fn)
+
+            def value_and_grads(params, batch, rng):
+                return jax.value_and_grad(loss_of)(params, batch, rng)
 
         @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
         def _step(params, opt_state, accum_grads, count, batch, rng, clip_norm):
-            loss, grads = jax.value_and_grad(loss_of)(params, batch, rng)
+            loss, grads = value_and_grads(params, batch, rng)
             accum_grads = jax.tree_util.tree_map(
                 lambda a, g: a + g / accum, accum_grads, grads
             )

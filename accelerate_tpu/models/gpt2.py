@@ -190,25 +190,32 @@ class GPT2(Module):
         x = x + self._mm(mid, layer["mlp"]["w_out"]) + layer["mlp"]["b_out"]
         return x if new_cache is None else (x, new_cache)
 
+    @staticmethod
+    def _shift_labels(labels, attention_mask):
+        """Next-token targets with the padding guards — same contract as
+        ``Llama._shift_labels`` (the 1F1B pipeline reads this to renormalize
+        per-microbatch losses, so head and schedule share one definition)."""
+        B = labels.shape[0]
+        shifted = jnp.concatenate(
+            [labels[:, 1:], jnp.full((B, 1), -100, labels.dtype)], axis=1
+        )
+        if attention_mask is not None:
+            # A position trains only if it is itself real (left-padding
+            # guard) AND its target token t+1 is real (right-padding guard).
+            target_valid = jnp.concatenate(
+                [attention_mask[:, 1:], jnp.zeros((B, 1), attention_mask.dtype)], axis=1
+            )
+            valid = target_valid.astype(bool) & attention_mask.astype(bool)
+            shifted = jnp.where(valid, shifted, -100)
+        return shifted
+
     def head(self, params, x, labels=None, attention_mask=None):
         cfg = self.config
         x = _layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"], cfg.layer_norm_eps)
         logits = (x @ params["embed"]["wte"].T.astype(x.dtype)).astype(jnp.float32)
         out = ModelOutput(logits=logits)
         if labels is not None:
-            B = labels.shape[0]
-            shifted = jnp.concatenate(
-                [labels[:, 1:], jnp.full((B, 1), -100, labels.dtype)], axis=1
-            )
-            if attention_mask is not None:
-                # A position trains only if it is itself real (left-padding
-                # guard) AND its target token t+1 is real (right-padding guard).
-                target_valid = jnp.concatenate(
-                    [attention_mask[:, 1:], jnp.zeros((B, 1), attention_mask.dtype)], axis=1
-                )
-                valid = target_valid.astype(bool) & attention_mask.astype(bool)
-                shifted = jnp.where(valid, shifted, -100)
-            out["loss"] = cross_entropy_loss(logits, shifted)
+            out["loss"] = cross_entropy_loss(logits, self._shift_labels(labels, attention_mask))
         return out
 
     def init_cache(self, batch_size: int, max_len: int, dtype=jnp.bfloat16):

@@ -285,6 +285,12 @@ class Llama(Module):
     # (MoE router aux loss); empty for the dense model.
     scan_aux_keys: tuple = ()
 
+    def aux_loss_coefs(self) -> dict:
+        """How each ``scan_aux_keys`` entry enters the total loss (coefficient
+        per key). The 1F1B pipeline schedule reads this to seed the aux-loss
+        gradients inside the schedule — it must agree with ``finalize_aux``."""
+        return {}
+
     def __init__(self, config: LlamaConfig):
         self.config = config
         self.params = None

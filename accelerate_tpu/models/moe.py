@@ -106,6 +106,9 @@ class MoELlama(Llama):
     # every forward path — plain scan, remat, and GPipe pipeline alike.
     scan_aux_keys = ("moe_aux",)
 
+    def aux_loss_coefs(self) -> dict:
+        return {"moe_aux": self.config.router_aux_coef}
+
     def finalize_aux(self, out, aux: dict):
         a = aux.get("moe_aux")
         if a is not None:

@@ -29,14 +29,15 @@ def _tiny_cfg(model_cls=Llama, **kw):
     return MoELlamaConfig.tiny(**defaults)
 
 
-def _run_training(parallelism, steps=4, lr=0.1, model_cls=Llama, cfg_kw=None, plugin=None):
+def _run_training(parallelism, steps=4, lr=0.1, model_cls=Llama, cfg_kw=None, plugin=None,
+                  batch=8):
     AcceleratorState._reset_state(reset_partial_state=True)
     GradientState._reset_state()
     accelerator = Accelerator(parallelism_config=parallelism, pp_plugin=plugin)
     model = model_cls(_tiny_cfg(model_cls, **(cfg_kw or {})))
     model.init_params(jax.random.key(0))
     pmodel, popt = accelerator.prepare(model, optax.sgd(lr))
-    ids = np.random.default_rng(0).integers(0, 128, (8, 16)).astype(np.int32)
+    ids = np.random.default_rng(0).integers(0, 128, (batch, 16)).astype(np.int32)
     step = accelerator.build_train_step(pmodel, popt)
     losses = [float(step({"input_ids": ids, "labels": ids})) for _ in range(steps)]
     params = jax.tree_util.tree_map(np.asarray, accelerator.get_state_dict(pmodel))
@@ -290,6 +291,148 @@ def test_pipeline_batch_divisibility_error():
     step = accelerator.build_train_step(pmodel, popt)
     with pytest.raises(ValueError, match="num_microbatches"):
         step({"input_ids": ids, "labels": ids})
+
+
+def _p1f1b(mb=4):
+    return PipelineParallelPlugin(pp_size=2, num_microbatches=mb, schedule="1f1b")
+
+
+def test_1f1b_matches_pp1_numerics():
+    """The hand-written 1F1B schedule (loss on the last stage, in-schedule
+    embed/head backwards, explicit gradient accumulation) must reproduce the
+    non-pipelined step exactly: same loss, same params after one sgd step
+    (VERDICT r3 ask #1)."""
+    _, params_ref, _ = _run_training(ParallelismConfig(), steps=1, batch=16)
+    losses, params_1f, pmodel = _run_training(
+        ParallelismConfig(pp_size=2), steps=1, batch=16, plugin=_p1f1b(4)
+    )
+    assert pmodel.handle.pipeline_spec.schedule == "1f1b"
+    assert np.isfinite(losses[0])
+    for (pa, la), (pb, lb) in zip(
+        jax.tree_util.tree_leaves_with_path(params_ref),
+        jax.tree_util.tree_leaves_with_path(params_1f),
+    ):
+        np.testing.assert_allclose(la, lb, atol=2e-4, err_msg=str(pa))
+
+
+def test_1f1b_composes_with_tp_fsdp_bf16():
+    """Megatron-style composition: 1F1B over pp with tp+fsdp auto axes and
+    bf16 compute must track the GPipe trajectory (stage matmuls keep their
+    tp/fsdp partitioning; embed/head run sealed — see _seal_axes)."""
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+
+    def go(schedule):
+        AcceleratorState._reset_state(reset_partial_state=True)
+        GradientState._reset_state()
+        accelerator = Accelerator(
+            mixed_precision="bf16",
+            parallelism_config=ParallelismConfig(pp_size=2, fsdp_size=2, tp_size=2),
+            pp_plugin=PipelineParallelPlugin(pp_size=2, num_microbatches=4, schedule=schedule),
+        )
+        model = Llama(_tiny_cfg())
+        model.init_params(jax.random.key(0))
+        pmodel, popt = accelerator.prepare(model, optax.sgd(0.05))
+        ids = np.random.default_rng(0).integers(0, 128, (16, 16)).astype(np.int32)
+        step = accelerator.build_train_step(pmodel, popt)
+        return [float(step({"input_ids": ids, "labels": ids})) for _ in range(2)]
+
+    np.testing.assert_allclose(go("1f1b"), go("gpipe"), rtol=3e-2)
+
+
+def test_1f1b_mixed_window_gemma2():
+    """Gemma-2 recipe under 1F1B: the per-stage window dispatch and the
+    softcapped head both live inside the schedule."""
+    gemma2_kw = dict(
+        layer_windows=(4, None, 4, None), attn_logit_softcap=50.0,
+        final_logit_softcap=30.0, query_pre_attn_scalar=32.0,
+        sandwich_norms=True, hidden_act="gelu_tanh",
+    )
+    _, params_ref, _ = _run_training(ParallelismConfig(), steps=1, cfg_kw=gemma2_kw, batch=16)
+    _, params_1f, _ = _run_training(
+        ParallelismConfig(pp_size=2), steps=1, cfg_kw=gemma2_kw, batch=16, plugin=_p1f1b(4)
+    )
+    for (pa, la), (pb, lb) in zip(
+        jax.tree_util.tree_leaves_with_path(params_ref),
+        jax.tree_util.tree_leaves_with_path(params_1f),
+    ):
+        np.testing.assert_allclose(la, lb, atol=2e-4, err_msg=str(pa))
+
+
+def test_1f1b_moe_aux_grads_flow():
+    """MoE under 1F1B: the router aux loss contributes to both the loss value
+    and the gradients through aux_loss_coefs(). Drop-free capacity keeps the
+    LM part batch-separable for the exact comparison."""
+    from accelerate_tpu.models.moe import MoELlama
+
+    moe_kw = {
+        "num_experts": 4, "moe_top_k": 2, "capacity_factor": 2.0,
+        "router_aux_coef": 0.01,
+    }
+    losses_ref, _, _ = _run_training(
+        ParallelismConfig(), steps=1, model_cls=MoELlama, cfg_kw=moe_kw, batch=16,
+    )
+    losses_1f, _, pmodel = _run_training(
+        ParallelismConfig(pp_size=2), steps=1, model_cls=MoELlama, cfg_kw=moe_kw,
+        batch=16, plugin=_p1f1b(2),
+    )
+    assert pmodel.handle.pipeline_spec.schedule == "1f1b"
+    # Per-microbatch routing statistics differ slightly from full-batch.
+    np.testing.assert_allclose(losses_1f[0], losses_ref[0], rtol=1e-3)
+
+
+def test_1f1b_memory_below_gpipe():
+    """The point of 1F1B: boundary-activation liveness is O(pp), not O(M).
+    Compiled temp memory at pp2/M=8 must come in below GPipe's (generous
+    margin — the ratio grows with M)."""
+    import jax.numpy as jnp
+
+    def temp_bytes(schedule):
+        AcceleratorState._reset_state(reset_partial_state=True)
+        GradientState._reset_state()
+        accelerator = Accelerator(
+            parallelism_config=ParallelismConfig(pp_size=2),
+            pp_plugin=PipelineParallelPlugin(pp_size=2, num_microbatches=8, schedule=schedule),
+        )
+        model = Llama(LlamaConfig.tiny(
+            vocab_size=128, hidden_size=128, intermediate_size=256,
+            num_attention_heads=4, num_key_value_heads=4, num_hidden_layers=4,
+            max_position_embeddings=256, remat=True,
+        ))
+        model.init_params(jax.random.key(0))
+        pmodel, popt = accelerator.prepare(model, optax.sgd(0.05))
+        ids = jnp.zeros((32, 256), jnp.int32)
+        step = accelerator.build_train_step(pmodel, popt)
+        ma = step.lower({"input_ids": ids, "labels": ids}).compile().memory_analysis()
+        return None if ma is None else ma.temp_size_in_bytes
+
+    gpipe, f1b = temp_bytes("gpipe"), temp_bytes("1f1b")
+    if gpipe is None or f1b is None:
+        pytest.skip("memory_analysis unavailable on this backend")
+    assert f1b < 0.9 * gpipe, (f1b, gpipe)
+
+
+def test_1f1b_rejects_custom_loss_and_missing_labels():
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+    accelerator = Accelerator(
+        parallelism_config=ParallelismConfig(pp_size=2),
+        pp_plugin=_p1f1b(4),
+    )
+    model = Llama(_tiny_cfg())
+    model.init_params(jax.random.key(0))
+    pmodel, popt = accelerator.prepare(model, optax.sgd(0.05))
+    accelerator.set_loss_fn(lambda outputs, batch: outputs["loss"])
+    with pytest.raises(ValueError, match="1f1b"):
+        accelerator.build_train_step(pmodel, popt)
+    accelerator._loss_fn = None
+    from accelerate_tpu.modules import default_loss_extractor
+
+    pmodel.loss_fn = default_loss_extractor
+    step = accelerator.build_train_step(pmodel, popt)
+    ids = np.zeros((16, 16), np.int32)
+    with pytest.raises(ValueError, match="labels"):
+        step({"input_ids": ids})
 
 
 def test_microbatch_roundtrip():
