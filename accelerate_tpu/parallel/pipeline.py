@@ -429,7 +429,13 @@ def _pipeline_train_grads(spec, module, params, batch, compute_dtype=jnp.float32
     mean), the embedding is recomputed per microbatch on stage 0 so its
     backward stays in-schedule, and each stage's backward re-derives its
     block's VJP from the saved boundary input (activation recompute — the
-    same FLOPs the remat'd GPipe backward pays). Consequently NO (B, S, H)
+    same FLOPs the remat'd GPipe backward pays). The SPMD form computes the
+    head/embed on EVERY stage each tick, selecting the boundary stage's
+    result — per-rank head cost ~(1 + 2(P-1)/M)x the GPipe path's, which
+    already computes the full-batch head pp-replicated; a lax.cond on the
+    stage index would drop the waste but puts the (fsdp-sharded) head's
+    collectives inside a device-varying conditional, a deadlock-prone shape
+    we won't ship untested on real multichip. Consequently NO (B, S, H)
     tensor ever crosses the shard_map boundary: stage-layer gradients leave
     sharded on ``pp`` (matching the parameter sharding, zero collectives),
     and the only cross-stage reductions are the psums of the pp-replicated

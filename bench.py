@@ -76,8 +76,8 @@ def main():
 
     on_tpu = resolve_backend() == "tpu"
     mode = os.environ.get("BENCH_CONFIG", "large" if on_tpu else "tiny")
-    if mode not in ("large", "long", "340m", "tiny"):
-        raise ValueError(f"BENCH_CONFIG must be large|long|340m|tiny, got {mode!r}")
+    if mode not in ("large", "long", "340m", "tiny", "moe"):
+        raise ValueError(f"BENCH_CONFIG must be large|long|340m|tiny|moe, got {mode!r}")
     if mode == "large":
         # ~725M params — tuned on-chip (PERF.md): wider-and-shallower beats
         # deep at fixed params, adafactor's factored second moments free ~5G
@@ -116,6 +116,32 @@ def main():
             remat_policy="dots_with_no_batch_dims_saveable",
         )
         batch, seq, steps, warmup = 2, 4096, 20, 3
+    elif mode == "moe":
+        # MoE datapoint (VERDICT r3 ask #2): 8-expert, top-2, Mixtral-style
+        # sparsity at bench scale (946M total / ~330M active per token). The
+        # auto dispatch resolves to the einsum back-end at this shape — it
+        # measured 33.9% vs sorted ragged_dot's 25.5% on v5e (PERF.md; run
+        # with ACCELERATE_MOE_DISPATCH=sorted for the grouped-matmul path).
+        # MFU counts ACTIVE FLOPs only (router + k experts), the standard
+        # MoE accounting.
+        from accelerate_tpu.models import MoELlamaConfig
+
+        metric_name = "moe8e_train_mfu_per_chip"
+        cfg = MoELlamaConfig(
+            vocab_size=32000,
+            hidden_size=1024,
+            intermediate_size=2816,
+            num_hidden_layers=12,
+            num_attention_heads=8,
+            num_key_value_heads=8,
+            max_position_embeddings=1024,
+            num_experts=8,
+            moe_top_k=2,
+            capacity_factor=1.25,
+            remat=True,
+            remat_policy="dots_with_no_batch_dims_saveable",
+        )
+        batch, seq, steps, warmup = 8, 1024, 20, 3
     elif mode == "340m":
         metric_name = "llama340m_train_mfu_per_chip"
         cfg = LlamaConfig(
@@ -135,12 +161,17 @@ def main():
         batch, seq, steps, warmup = 8, 128, 5, 2
 
     accelerator = Accelerator(mixed_precision="bf16")
-    model = Llama(cfg)
+    if mode == "moe":
+        from accelerate_tpu.models import MoELlama
+
+        model = MoELlama(cfg)
+    else:
+        model = Llama(cfg)
     model.init_params(jax.random.key(0))
     # adafactor in the large config: factored second moments cost ~0 extra HBM
     # (vs Adam's 8 bytes/param), which is what lets the dots-saveable remat
     # policy fit — the standard TPU-pretraining optimizer choice (T5/PaLM).
-    tx = optax.adafactor(3e-4) if mode in ("large", "long") else optax.adamw(3e-4)
+    tx = optax.adafactor(3e-4) if mode in ("large", "long", "moe") else optax.adamw(3e-4)
     pmodel, popt = accelerator.prepare(model, tx)
     step = accelerator.build_train_step(pmodel, popt)
 
@@ -165,9 +196,14 @@ def main():
     steps_per_sec = steps / dt
     tokens_per_sec = steps_per_sec * batch * seq
     n_params = model.num_params()
-    # 6N per token fwd+bwd plus attention score/mix FLOPs.
     attn_flops = 12 * cfg.num_hidden_layers * cfg.hidden_size * seq
-    flops_per_token = 6 * n_params + attn_flops
+    if mode == "moe":
+        # Active-params accounting: router + top-k experts per token (the
+        # model's flops_per_token uses max_position_embeddings == seq here).
+        flops_per_token = model.flops_per_token()
+    else:
+        # 6N per token fwd+bwd plus attention score/mix FLOPs.
+        flops_per_token = 6 * n_params + attn_flops
     mfu = tokens_per_sec * flops_per_token / (peak_flops_per_chip() * jax.device_count())
 
     print(
@@ -186,6 +222,13 @@ def main():
                     "device": str(jax.devices()[0].device_kind),
                     "seq": seq,
                     "attention_impl": resolved_impl,
+                    **(
+                        # auto resolves to einsum at this shape (S<=2048,
+                        # cf<=2, no ep axis) — see ops/moe.py moe_ffn.
+                        {"moe_dispatch": os.environ.get("ACCELERATE_MOE_DISPATCH", "auto:einsum")}
+                        if mode == "moe"
+                        else {}
+                    ),
                 },
             }
         )
@@ -197,6 +240,7 @@ _FAIL_METRIC = {
     "long": "llama700m_long4k_train_mfu_per_chip",
     "340m": "llama340m_train_mfu_per_chip",
     "tiny": "llama_tiny_train_mfu_per_chip",
+    "moe": "moe8e_train_mfu_per_chip",
 }
 
 if __name__ == "__main__":
