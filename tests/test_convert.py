@@ -778,6 +778,44 @@ def test_gpt2_ragged_generate_matches_hf(hf_gpt2):
         )
 
 
+def test_gpt2_batched_assisted_matches_hf(hf_gpt2):
+    """Batched speculative decoding on GPT-2 vs transformers: each ragged
+    row must be token-identical to HF's greedy decode of that row alone
+    (assisted decoding's exactness guarantee, per row — learned absolute
+    positions make this the hard case)."""
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu.generation import assisted_generate
+    from accelerate_tpu.models import GPT2, GPT2Config
+    from accelerate_tpu.models.convert import from_hf
+
+    model, params = from_hf(hf_gpt2)
+    draft = GPT2(GPT2Config(vocab_size=128, hidden_size=32, num_hidden_layers=1,
+                            num_attention_heads=2, max_position_embeddings=64))
+    draft.init_params(jax.random.key(7))
+
+    rng = np.random.default_rng(22)
+    lens = [7, 4]
+    S = max(lens)
+    ids = np.zeros((2, S), np.int32)
+    mask = np.zeros((2, S), np.int32)
+    for i, n in enumerate(lens):
+        ids[i, :n] = rng.integers(1, 128, (n,))
+        mask[i, :n] = 1
+    ours = np.asarray(assisted_generate(
+        model, draft, ids, attention_mask=mask, max_new_tokens=6,
+        num_draft_tokens=3, cache_dtype=jnp.float32, include_prompt=False,
+    ))
+    for i, n in enumerate(lens):
+        with torch.no_grad():
+            theirs = hf_gpt2.generate(
+                torch.tensor(ids[i:i + 1, :n], dtype=torch.long), max_new_tokens=6,
+                eos_token_id=None, do_sample=False, pad_token_id=0,
+            )
+        np.testing.assert_array_equal(ours[i], theirs[0, n:].numpy(), err_msg=f"row {i}")
+
+
 @pytest.fixture(scope="module")
 def hf_gemma2():
     cfg = transformers.Gemma2Config(
@@ -1008,6 +1046,112 @@ def test_beam_search_gpt2_matches_hf():
             )
         np.testing.assert_array_equal(np.asarray(ours), theirs.numpy(),
                                       err_msg=f"model seed {seed}")
+
+
+def test_beam_num_return_sequences_matches_hf(hf_llama):
+    """num_return_sequences: the top-n hypotheses per row, HF-shaped
+    (B*n, T) and token-identical with EOS disabled (tie-free case)."""
+    import jax.numpy as jnp
+
+    from accelerate_tpu.generation import generate
+    from accelerate_tpu.models.convert import from_hf
+
+    model, params = from_hf(hf_llama)
+    prompt = np.random.default_rng(33).integers(0, 128, (2, 6)).astype(np.int32)
+    ours = generate(model, prompt, max_new_tokens=7, num_beams=4,
+                    num_return_sequences=3, cache_dtype=jnp.float32)
+    with torch.no_grad():
+        theirs = hf_llama.generate(
+            torch.tensor(prompt, dtype=torch.long),
+            max_new_tokens=7, num_beams=4, num_return_sequences=3,
+            do_sample=False, eos_token_id=None, early_stopping=True, pad_token_id=0,
+        )
+    assert np.asarray(ours).shape == (6, 13)
+    np.testing.assert_array_equal(np.asarray(ours), theirs.numpy())
+
+
+def test_beam_num_return_sequences_with_eos_matches_hf(hf_llama):
+    """With EOS active the bank is K-deep: multiple finished hypotheses per
+    row must come back in HF's order."""
+    import jax.numpy as jnp
+
+    from accelerate_tpu.generation import generate
+    from accelerate_tpu.models.convert import from_hf
+
+    model, params = from_hf(hf_llama)
+    for seed, eos_tok in ((0, 7), (1, 20), (2, 55)):
+        prompt = np.random.default_rng(seed).integers(0, 128, (1, 6)).astype(np.int32)
+        ours = np.asarray(generate(
+            model, prompt, max_new_tokens=8, num_beams=3, num_return_sequences=2,
+            eos_token_id=eos_tok, pad_token_id=0, cache_dtype=jnp.float32,
+            include_prompt=False,
+        ))
+        with torch.no_grad():
+            theirs = hf_llama.generate(
+                torch.tensor(prompt, dtype=torch.long), max_new_tokens=8,
+                num_beams=3, num_return_sequences=2, do_sample=False,
+                eos_token_id=eos_tok, pad_token_id=0,
+            )
+        t = theirs[:, 6:].numpy()
+        for r in range(2):
+            np.testing.assert_array_equal(
+                ours[r][: t.shape[1]], t[r],
+                err_msg=f"seed={seed} eos={eos_tok} return {r}",
+            )
+            assert all(x == 0 for x in ours[r][t.shape[1]:])
+
+
+def test_beam_sample_properties(hf_llama):
+    """Sampled beams (do_sample=True): shapes, determinism per rng, variety
+    across rngs, and warped-score monotonicity (cross-framework rng parity is
+    impossible, so pin the distributional contract instead)."""
+    import jax
+
+    import jax.numpy as jnp
+
+    from accelerate_tpu.generation import generate
+    from accelerate_tpu.models.convert import from_hf
+
+    model, params = from_hf(hf_llama)
+    prompt = np.random.default_rng(34).integers(0, 128, (2, 5)).astype(np.int32)
+
+    def sample(seed, **kw):
+        return np.asarray(generate(
+            model, prompt, max_new_tokens=6, num_beams=3, do_sample=True,
+            temperature=1.0, rng=jax.random.key(seed), cache_dtype=jnp.float32,
+            include_prompt=False, **kw,
+        ))
+
+    a, b = sample(0), sample(0)
+    np.testing.assert_array_equal(a, b)  # same rng -> same draw
+    c = sample(1)
+    assert not np.array_equal(a, c)  # different rng -> different beams (w.h.p.)
+    assert a.shape == (2, 6)
+    # num_return_sequences composes with sampling
+    d = sample(2, num_return_sequences=2)
+    assert d.shape == (4, 6)
+    # warpers apply PER BEAM (HF beam_sample): with top_k == num_beams every
+    # beam keeps its own k survivors, so all 3 returned beams stay live — a
+    # JOINT top-k could hand one dominant beam the whole budget and starve
+    # the others into -inf token-0 garbage chains (review r4)
+    e = sample(5, top_k=3, num_return_sequences=3)
+    assert e.shape == (6, 6)
+    np.testing.assert_array_equal(e, sample(5, top_k=3, num_return_sequences=3))
+    for row in e:
+        assert not np.array_equal(row, np.zeros_like(row)), e
+    # near-zero temperature: the first sampled token collapses to the argmax
+    # (the warped distribution is a point mass there); later steps follow the
+    # winning beam's chain, which legitimately differs from the greedy BEAM.
+    cold = np.asarray(generate(
+        model, prompt, max_new_tokens=6, num_beams=3, do_sample=True,
+        temperature=1e-4, rng=jax.random.key(3), cache_dtype=jnp.float32,
+        include_prompt=False,
+    ))
+    greedy_chain = np.asarray(generate(
+        model, prompt, max_new_tokens=1, temperature=0.0, cache_dtype=jnp.float32,
+        include_prompt=False,
+    ))
+    np.testing.assert_array_equal(cold[:, :1], greedy_chain)
 
 
 def test_beam_search_beats_greedy_likelihood(hf_llama):
