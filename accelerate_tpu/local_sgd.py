@@ -49,11 +49,12 @@ class LocalSGDTrainer:
       fsdp/tp/pp/sp/ep must be trivial.
     - **multi-slice mesh** (``dcn > 1``) — one replica per *slice*: the replica
       dim rides ``dcn`` and each replica's step runs GSPMD-sharded over its
-      slice's ICI axes (dp/fsdp/tp allowed; pp's shard_map schedule and the
-      ep/sp paths' explicit dcn-batch constraints do not compose with the
-      replica vmap and are rejected). This is the canonical DCN strategy:
-      zero cross-slice traffic between sync boundaries, one parameter average
-      over the slow network every ``sync_every`` steps.
+      slice's ICI axes — dp/fsdp/tp, and ep/sp too (their batch specs consult
+      ``data_batch_axes()``, which drops the claimed replica axis under the
+      vmap; only pp's manual shard_map schedule is rejected). This is the
+      canonical DCN strategy: zero cross-slice traffic between sync
+      boundaries, one parameter average over the slow network every
+      ``sync_every`` steps.
 
     The global batch is split replica-major: rows ``[r·B/R, (r+1)·B/R)`` feed
     replica ``r``.
@@ -75,16 +76,17 @@ class LocalSGDTrainer:
         mesh = accelerator.mesh
         if mesh.shape.get("dcn", 1) > 1:
             self.replica_axis = "dcn"
-            for ax in ("pp", "ep", "sp"):
-                # pp's shard_map schedule, and the ep/sp paths' explicit
-                # sharding constraints naming 'dcn' as a batch axis, cannot
-                # appear under vmap(spmd_axis_name='dcn') — reject up front.
-                if mesh.shape.get(ax, 1) != 1:
-                    raise ValueError(
-                        f"LocalSGDTrainer over dcn: axis {ax!r} does not compose "
-                        "with the per-slice replica vmap; use fsdp/tp inside "
-                        "each slice (or the fused train step for this plan)."
-                    )
+            # dp/fsdp/tp/ep/sp all run inside each slice: the ep/sp paths'
+            # batch specs consult data_batch_axes(), which drops the claimed
+            # 'dcn' axis under the replica vmap (VERDICT r3 ask #5). Only pp's
+            # manual shard_map schedule remains incompatible with
+            # vmap(spmd_axis_name='dcn').
+            if mesh.shape.get("pp", 1) != 1:
+                raise ValueError(
+                    "LocalSGDTrainer over dcn: the pipeline (pp) schedule does "
+                    "not compose with the per-slice replica vmap; use "
+                    "fsdp/tp/ep/sp inside each slice (or the fused train step)."
+                )
         else:
             self.replica_axis = "dp"
             for ax in ("fsdp", "tp", "pp", "sp", "ep"):
@@ -168,9 +170,15 @@ class LocalSGDTrainer:
         batch = self.accelerator._place_batch(batch)
         handle.step_counter += 1
         rng = jax.random.fold_in(handle.rng, handle.step_counter)
-        self._params_rep, self._opt_rep, self._count, loss = self._compiled(
-            self._params_rep, self._opt_rep, self._count, batch, rng
-        )
+        from .parallel.sharding import claim_mesh_axes
+
+        # Active during the (lazy) first-call trace: sharding constraints
+        # built inside model/op code must not name the replica axis the vmap
+        # already owns.
+        with claim_mesh_axes(self.replica_axis):
+            self._params_rep, self._opt_rep, self._count, loss = self._compiled(
+                self._params_rep, self._opt_rep, self._count, batch, rng
+            )
         return loss
 
     def replica_params(self):

@@ -143,8 +143,10 @@ class GPT2(Module):
             )
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (B, S))
-        x = jnp.take(params["embed"]["wte"], input_ids, axis=0) + jnp.take(
-            params["embed"]["wpe"], positions, axis=0
+        from ..parallel.sharding import embedding_lookup
+
+        x = embedding_lookup(params["embed"]["wte"], input_ids) + embedding_lookup(
+            params["embed"]["wpe"], positions
         )
         return x.astype(params["embed"]["wte"].dtype), {"attention_mask": attention_mask}
 

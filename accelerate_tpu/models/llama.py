@@ -387,7 +387,9 @@ class Llama(Module):
         rotated with ONE consistent set of frequencies."""
         cfg = self.config
         B, S = input_ids.shape
-        x = jnp.take(params["embed"]["weight"], input_ids, axis=0)
+        from ..parallel.sharding import embedding_lookup
+
+        x = embedding_lookup(params["embed"]["weight"], input_ids)
         x = x.astype(params["embed"]["weight"].dtype)
         if cfg.embedding_multiplier != 1.0:
             # Gemma scales the lookup only — the tied head stays unscaled.

@@ -217,6 +217,7 @@ def _constrain_expert_layout(t):
     the expert's own shard instead of gathering expert weights to the tokens."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    from ..parallel.sharding import data_batch_axes
     from ..state import PartialState
 
     try:
@@ -225,5 +226,6 @@ def _constrain_expert_layout(t):
         return t
     if mesh is None or mesh.shape.get("ep", 1) == 1:
         return t
-    spec = P("ep", ("dcn", "dp", "fsdp"), *([None] * (t.ndim - 2)))
+    axes = data_batch_axes()
+    spec = P("ep", axes if axes else None, *([None] * (t.ndim - 2)))
     return jax.lax.with_sharding_constraint(t, NamedSharding(mesh, spec))

@@ -358,8 +358,9 @@ def ring_attention(
 
         return dense_attention(q, k, v, causal=causal, mask=mask)
 
-    n_batch = mesh.shape.get("dcn", 1) * mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
-    batch_axes = ("dcn", "dp", "fsdp") if q.shape[0] % n_batch == 0 else None
+    from .sharding import batch_axes_for
+
+    batch_axes = batch_axes_for(q.shape[0], mesh)
     head_axis = "tp" if q.shape[2] % mesh.shape.get("tp", 1) == 0 else None
     qkv_spec = P(batch_axes, axis_name, head_axis, None)
     mask_spec = P(batch_axes, axis_name)

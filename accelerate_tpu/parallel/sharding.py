@@ -21,6 +21,8 @@ in/out shardings.
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import logging
 import re
 from typing import Any, Mapping
@@ -30,7 +32,96 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..utils.constants import BATCH_SHARDING_AXES
+
 logger = logging.getLogger(__name__)
+
+# Mesh axes temporarily claimed by an outer transform (LocalSGDTrainer's
+# replica vmap over 'dcn'): sharding constraints built inside its trace must
+# not name them — vmap(spmd_axis_name=...) already owns the axis for the
+# mapped dim, and a spec mentioning it again is a conflict.
+_claimed_axes: contextvars.ContextVar = contextvars.ContextVar(
+    "accelerate_tpu_claimed_axes", default=()
+)
+
+
+@contextlib.contextmanager
+def claim_mesh_axes(*axes):
+    """Mark mesh axes as owned by an enclosing transform for the duration of
+    a trace; ``data_batch_axes()`` consumers (MoE dispatch, ring/Ulysses
+    attention) drop them from their batch specs."""
+    token = _claimed_axes.set(tuple(axes))
+    try:
+        yield
+    finally:
+        _claimed_axes.reset(token)
+
+
+def data_batch_axes() -> tuple:
+    """The mesh axes the batch dim shards over, minus any axis claimed by an
+    enclosing transform — the single source for batch specs built inside
+    model/op code."""
+    claimed = _claimed_axes.get()
+    return tuple(a for a in BATCH_SHARDING_AXES if a not in claimed)
+
+
+def batch_axes_for(n_rows: int, mesh) -> tuple | None:
+    """Batch-dim spec axes for an ``n_rows`` batch on ``mesh``, or None when
+    the rows don't divide across them (shared by the ring/Ulysses shard_map
+    specs so the divisibility rule lives in one place)."""
+    axes = data_batch_axes()
+    n = int(np.prod([mesh.shape.get(a, 1) for a in axes])) if axes else 1
+    return axes if (axes and n_rows % n == 0) else None
+
+
+def embedding_lookup(weight, ids):
+    """``weight[ids]`` whose backward avoids scatter-add under a replica vmap.
+
+    The transpose of a gather is a scatter-add; under
+    ``vmap(spmd_axis_name=...)`` XLA's SPMD partitioner cannot reshard the
+    scatter updates efficiently and falls back to "involuntary full
+    rematerialization" (replicate-then-partition) of the gradient. When an
+    enclosing transform has claimed a mesh axis (LocalSGDTrainer), route the
+    backward through a one-hot matmul instead — MXU-friendly, partitions
+    cleanly, costs ~one extra LM-head-sized matmul per step on a path whose
+    whole point is saving slow-network traffic. Everywhere else this is a
+    plain ``jnp.take``.
+    """
+    import jax.numpy as jnp
+
+    if not _claimed_axes.get():
+        return jnp.take(weight, ids, axis=0)
+
+    vocab, w_dtype = weight.shape[0], weight.dtype
+    # Vocab-chunked like the fused loss: the full (tokens, vocab) one-hot is
+    # a logits-sized buffer (8 GB at 32k tokens x 128k vocab) — build it a
+    # chunk at a time inside a scan so peak extra memory is (tokens, chunk).
+    chunk = min(vocab, 8192)
+    n_chunks = -(-vocab // chunk)
+
+    @jax.custom_vjp
+    def lookup(w, i):
+        return jnp.take(w, i, axis=0)
+
+    def fwd(w, i):
+        return jnp.take(w, i, axis=0), i
+
+    def bwd(i, g):
+        g_flat = g.reshape(-1, g.shape[-1])
+        i_flat = i.reshape(-1)
+
+        def one_chunk(_, start):
+            oh = (i_flat[:, None] == (start + jnp.arange(chunk))[None]).astype(g_flat.dtype)
+            return None, oh.T @ g_flat  # (chunk, h)
+
+        _, parts = jax.lax.scan(
+            one_chunk, None, jnp.arange(n_chunks, dtype=jnp.int32) * chunk
+        )
+        dw = parts.reshape(n_chunks * chunk, -1)[:vocab]
+        return dw.astype(w_dtype), None
+
+    lookup.defvjp(fwd, bwd)
+    return lookup(weight, ids)
 
 
 def path_str(path) -> str:

@@ -25,6 +25,8 @@ Selection: ``SequenceParallelPlugin(ring_attention=False)`` or
 
 from __future__ import annotations
 
+import numpy as np
+
 import jax
 from jax import lax
 from jax.sharding import PartitionSpec as P
@@ -54,8 +56,9 @@ def ulysses_attention(q, k, v, *, causal=True, mask=None, mesh=None, axis_name: 
             "Use ring attention (SequenceParallelPlugin(ring_attention=True)) instead."
         )
 
-    n_batch = mesh.shape.get("dcn", 1) * mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
-    batch_axes = ("dcn", "dp", "fsdp") if B % n_batch == 0 else None
+    from .sharding import batch_axes_for
+
+    batch_axes = batch_axes_for(B, mesh)
     head_axis = "tp" if H % tp == 0 and tp > 1 else None
     qkv_spec = P(batch_axes, axis_name, head_axis, None)
     mask_spec = P(batch_axes, axis_name)

@@ -32,23 +32,29 @@ import optax
 from accelerate_tpu import Accelerator, ParallelismConfig
 from accelerate_tpu.models import Llama, LlamaConfig
 from accelerate_tpu.state import AcceleratorState, GradientState
+from accelerate_tpu.utils.dataclasses import PipelineParallelPlugin
 
 PLANS = {
-    "dp8": ParallelismConfig(),
-    "fsdp8": ParallelismConfig(fsdp_size=8),
-    "fsdp2_dp4": ParallelismConfig(fsdp_size=2, dp_size=4),
-    "tp2_dp4": ParallelismConfig(tp_size=2),
-    "pp2_dp4": ParallelismConfig(pp_size=2),
-    "pp2_fsdp2_tp2": ParallelismConfig(pp_size=2, fsdp_size=2, tp_size=2),
-    "dcn2_dp4": ParallelismConfig(dcn_size=2),
+    "dp8": (ParallelismConfig(), None),
+    "fsdp8": (ParallelismConfig(fsdp_size=8), None),
+    "fsdp2_dp4": (ParallelismConfig(fsdp_size=2, dp_size=4), None),
+    "tp2_dp4": (ParallelismConfig(tp_size=2), None),
+    "pp2_dp4": (ParallelismConfig(pp_size=2), None),
+    "pp2_dp4_1f1b": (
+        ParallelismConfig(pp_size=2),
+        PipelineParallelPlugin(pp_size=2, schedule="1f1b"),
+    ),
+    "pp2_fsdp2_tp2": (ParallelismConfig(pp_size=2, fsdp_size=2, tp_size=2), None),
+    "dcn2_dp4": (ParallelismConfig(dcn_size=2), None),
 }
 
 
-def time_plan(parallelism, steps: int, layers: int, hidden: int = 128, batch: int = 32,
+def time_plan(plan, steps: int, layers: int, hidden: int = 128, batch: int = 32,
               seq: int = 64):
+    parallelism, pp_plugin = plan
     AcceleratorState._reset_state(reset_partial_state=True)
     GradientState._reset_state()
-    acc = Accelerator(parallelism_config=parallelism)
+    acc = Accelerator(parallelism_config=parallelism, pp_plugin=pp_plugin)
     cfg = LlamaConfig.tiny(
         vocab_size=256, hidden_size=hidden, intermediate_size=2 * hidden,
         num_attention_heads=4, num_key_value_heads=4, num_hidden_layers=layers,
