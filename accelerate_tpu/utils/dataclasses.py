@@ -197,10 +197,16 @@ class Fp8RecipeKwargs(KwargsHandler):
     """Low-precision matmul recipe — the TPU answer to the reference's fp8
     recipe handlers (``TERecipeKwargs``/``AORecipeKwargs``/``MSAMPRecipeKwargs``,
     reference ``dataclasses.py:298-407``). TPUs through v5p have no fp8 ALUs;
-    the hardware's low-precision lever is the int8 MXU path (2× bf16 TOPS), so
     ``mixed_precision="fp8"`` maps onto dynamically-quantized int8 matmuls with
     straight-through-estimator backward (``ops/int8.py``) — quantization-aware
     training rather than TransformerEngine's delayed-scaling fp8.
+
+    This is a QAT-for-deployment knob, NOT a throughput lever: measured on
+    v5e, XLA's int8 ``dot_general`` lowering runs BELOW bf16 peak even with
+    pre-quantized operands (81 TOPS vs 104 TFLOP/s at bench shapes — the
+    nominal 2x int8 MXU path is never engaged), so int8 QAT trains slower
+    than bf16 at every swept shape while matching int8 inference numerics
+    (PERF.md, r4 sweep).
 
     ``backend="int8"`` swaps eligible model matmuls to the QAT path;
     ``backend="bf16"`` keeps plain bf16 compute (the documented fallback)."""
