@@ -332,6 +332,48 @@ class Accelerator:
         kwargs_handlers: list | None = None,
         dynamo_backend=None,  # parity slot: XLA always compiles
     ):
+        # Env contract extensions written by `accelerate-tpu config`'s guided
+        # wizard and exported by the launcher (reference cluster.py:57 flow):
+        # explicit constructor arguments always win over the env.
+        from .utils.environment import parse_flag_from_env
+
+        if project_config is None and project_dir is None:
+            env_pdir = os.environ.get("ACCELERATE_PROJECT_DIR")
+            if env_pdir:
+                project_config = ProjectConfiguration(
+                    project_dir=env_pdir,
+                    automatic_checkpoint_naming=parse_flag_from_env(
+                        "ACCELERATE_CHECKPOINT_AUTO_NAMING"
+                    ),
+                    total_limit=(
+                        int(os.environ["ACCELERATE_CHECKPOINT_TOTAL_LIMIT"])
+                        if os.environ.get("ACCELERATE_CHECKPOINT_TOTAL_LIMIT")
+                        else None
+                    ),
+                )
+        if fsdp_plugin is None and (
+            os.environ.get("ACCELERATE_FSDP_MIN_SHARD_SIZE")
+            or os.environ.get("ACCELERATE_FSDP_CPU_OFFLOAD")
+        ):
+            # Axis size comes from the mesh-shape env (the wizard writes both);
+            # only the per-feature options live in these variables. fsdp_size
+            # 1 stays 1 (disabled); 0/unset means full-shard (-1).
+            env_mesh_fsdp = ParallelismConfig.from_env().fsdp_size
+            fsdp_plugin = FullyShardedDataParallelPlugin(
+                fsdp_size=env_mesh_fsdp or -1,
+                min_shard_size=int(os.environ.get("ACCELERATE_FSDP_MIN_SHARD_SIZE", 2**14)),
+                cpu_offload=parse_flag_from_env("ACCELERATE_FSDP_CPU_OFFLOAD"),
+            )
+        if pp_plugin is None and os.environ.get("ACCELERATE_PP_SCHEDULE"):
+            # The pp axis size ALSO comes from the mesh-shape env; defaulting
+            # the plugin's pp_size would override (and silently disable) it.
+            pp_plugin = PipelineParallelPlugin(
+                pp_size=max(ParallelismConfig.from_env().pp_size, 1),
+                schedule=os.environ["ACCELERATE_PP_SCHEDULE"],
+            )
+        if log_with is None and os.environ.get("ACCELERATE_LOG_WITH"):
+            log_with = [t.strip() for t in os.environ["ACCELERATE_LOG_WITH"].split(",") if t.strip()]
+
         self.project_configuration = project_config or ProjectConfiguration(project_dir=project_dir)
         if project_dir is not None and self.project_configuration.project_dir is None:
             self.project_configuration.set_directories(project_dir)
@@ -371,7 +413,11 @@ class Accelerator:
         )
 
         if gradient_accumulation_plugin is None:
-            steps = int(os.environ.get("ACCELERATE_GRADIENT_ACCUMULATION_STEPS", gradient_accumulation_steps))
+            # The env is a default, not an override: an explicit constructor
+            # value (anything but the default 1) wins over the wizard's env.
+            steps = gradient_accumulation_steps
+            if steps == 1:
+                steps = int(os.environ.get("ACCELERATE_GRADIENT_ACCUMULATION_STEPS", 1))
             gradient_accumulation_plugin = GradientAccumulationPlugin(num_steps=steps)
         elif gradient_accumulation_steps > 1:
             raise ValueError(

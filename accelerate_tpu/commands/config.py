@@ -62,12 +62,49 @@ def get_user_input() -> ClusterConfig:
             8,
             int,
         )
+    dcn = 0
+    if compute_env == "TPU_POD":
+        if _yesno("Is this a MULTI-SLICE pod (slices connected over DCN)?", False):
+            dcn = _ask("How many slices? (0 = auto-detect)", 0, int)
     print("Mesh axis sizes (1 disables an axis; dp=0 lets dp absorb all remaining devices):")
     dp = _ask("  data-parallel (dp) size", 0, int)
     fsdp = _ask("  fully-sharded (fsdp/ZeRO) size", 1, int)
     tp = _ask("  tensor-parallel (tp) size", 1, int)
     pp = _ask("  pipeline-parallel (pp) size", 1, int)
     sp = _ask("  sequence-parallel (sp) size", 1, int)
+    ep = _ask("  expert-parallel (ep) size", 1, int)
+
+    # ---- per-feature sections (reference cluster.py's guided flow) ----
+    min_shard, cpu_offload = 0, False
+    if fsdp > 1 or fsdp in (0, -1):  # 0/-1 = full-shard over remaining devices
+        if _yesno("Do you want to configure FSDP options?", False):
+            min_shard = _ask(
+                "  minimum tensor size to shard (smaller stays replicated)", 2**14, int
+            )
+            cpu_offload = _yesno("  offload sharded optimizer state to host RAM?", False)
+    pp_schedule, pp_mbs = "", 0
+    if pp > 1:
+        pp_schedule = _ask(
+            "Pipeline schedule? (gpipe/1f1b — 1f1b caps activation memory at O(pp))",
+            "gpipe", str, ["gpipe", "1f1b"],
+        )
+        pp_mbs = _ask("Pipeline microbatches? (0 = one per stage; >=4x pp for utilization)", 0, int)
+    accum = _ask("How many gradient accumulation steps?", 1, int)
+    project_dir, ckpt_limit, ckpt_auto = None, 0, False
+    if _yesno("Do you want to configure checkpointing?", False):
+        project_dir = _ask("  project directory (checkpoints/logs root)", ".")
+        ckpt_auto = _yesno("  automatic checkpoint naming (checkpoints/checkpoint_<n>)?", True)
+        ckpt_limit = _ask("  how many checkpoints to keep? (0 = all)", 0, int)
+    log_with = ""
+    if _yesno("Do you want to configure experiment tracking?", False):
+        log_with = _ask(
+            "  trackers to log to (comma-separated: json,tensorboard,wandb,csv,aim,"
+            "mlflow,comet_ml,clearml,dvclive or 'all')", "json"
+        )
+        if log_with and not project_dir:
+            # File-backed trackers need a logging root; without one every
+            # launched process would fail at Accelerator() startup.
+            project_dir = _ask("  trackers need a logging root — project directory", ".")
     mixed_precision = _ask(
         "Do you wish to use mixed precision? (no/bf16/fp16/fp8)", "bf16", str, ["no", "bf16", "fp16", "fp8"]
     )
@@ -87,6 +124,17 @@ def get_user_input() -> ClusterConfig:
         tp_size=tp,
         pp_size=pp,
         sp_size=sp,
+        ep_size=ep,
+        dcn_size=dcn,
+        gradient_accumulation_steps=accum,
+        fsdp_min_shard_size=min_shard,
+        fsdp_cpu_offload=cpu_offload,
+        pp_schedule=pp_schedule,
+        pp_microbatches=pp_mbs,
+        project_dir=project_dir,
+        checkpoint_total_limit=ckpt_limit,
+        checkpoint_auto_naming=ckpt_auto,
+        log_with=log_with,
     )
 
 

@@ -83,6 +83,16 @@ class ClusterConfig:
     # Host-side virtual device count for CPU simulation (xla_force_host_platform_device_count)
     cpu_virtual_devices: int = 0
     downcast_bf16: bool = False
+    # Per-feature sections (the guided wizard; reference cluster.py:57 flow).
+    gradient_accumulation_steps: int = 1
+    fsdp_min_shard_size: int = 0  # 0 = plugin default (2**14)
+    fsdp_cpu_offload: bool = False
+    pp_schedule: str = ""  # '' = gpipe default; or 'gpipe'/'1f1b'
+    pp_microbatches: int = 0  # 0 = one per stage
+    project_dir: str | None = None  # checkpoints/logs root
+    checkpoint_total_limit: int = 0  # 0 = keep all
+    checkpoint_auto_naming: bool = False
+    log_with: str = ""  # comma-separated tracker names ('' = none)
     extra: dict = field(default_factory=dict)
 
     def to_dict(self) -> dict:

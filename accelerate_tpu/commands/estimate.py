@@ -3,8 +3,10 @@
 Reference parity: ``src/accelerate/commands/estimate.py:230-312`` loads a model on
 the meta device and prints per-dtype size tables via ``calculate_maximum_sizes``.
 Here the meta device is ``jax.eval_shape`` — shapes come from the model zoo's
-abstract init, so nothing touches HBM. Accepts either a zoo preset name
-(``llama-7b``) or a local HF-format ``config.json``.
+abstract init, so nothing touches HBM. Accepts a zoo preset name (``llama-7b``),
+a local HF-format ``config.json``, or any Hub model id with a supported
+architecture (``meta-llama/Llama-2-7b-hf`` — config fetched via AutoConfig,
+cache-first, never the weights).
 """
 
 from __future__ import annotations
@@ -40,57 +42,96 @@ PRESETS = {
 DTYPE_BYTES = {"float32": 4, "bf16": 2, "int8": 1, "int4": 0.5}
 
 
+def _model_from_hf_config(hf: dict):
+    """An (uninitialized) zoo model from an HF config dict, routed through the
+    converter registry — one mapping shared with ``from_hf`` for every
+    supported family (llama/mistral/qwen2/gemma/gemma-2/mixtral/gpt2/bert/t5).
+
+    Estimation needs SHAPES only, so converter numerics guards (unsupported
+    activation/rope recipes) fall back to a size-keys-only Llama mapping
+    instead of failing the estimate."""
+    from ..models.convert import _get_converter
+
+    model_type = hf.get("model_type")
+    if model_type is None:
+        arch = (hf.get("architectures") or [""])[0].lower()
+        for known in ("mixtral", "gemma2", "gemma", "qwen2", "mistral", "llama",
+                      "gpt2", "bert", "t5"):
+            if known in arch:
+                model_type = known
+                break
+    cls, config_fn, _params_fn = _get_converter(model_type)
+    try:
+        return cls(config_fn(hf))
+    except (ValueError, KeyError) as exc:
+        size_keys = ("vocab_size", "hidden_size", "intermediate_size",
+                     "num_hidden_layers", "num_attention_heads")
+        if not all(k in hf for k in size_keys):
+            raise
+        from ..models import Llama, LlamaConfig
+
+        return Llama(LlamaConfig(
+            **{k: hf[k] for k in size_keys},
+            num_key_value_heads=hf.get("num_key_value_heads", hf["num_attention_heads"]),
+            head_dim=hf.get("head_dim"),
+            tie_word_embeddings=hf.get("tie_word_embeddings", False),
+        ))
+
+
+def _hub_config(model_name: str) -> dict:
+    """config.json (ONLY — no weights) for a Hub model id, via transformers'
+    AutoConfig: cache-first so the offline/zero-egress path is instant, then
+    a live fetch (reference ``estimate.py:230-312`` accepts any Hub id)."""
+    try:
+        import transformers
+    except ImportError as exc:  # pragma: no cover - transformers is baked in
+        raise ValueError(
+            f"{model_name!r} looks like a Hub model id, which needs the "
+            "'transformers' package to resolve its config."
+        ) from exc
+    # ValueError also covers huggingface_hub's HFValidationError (a mistyped
+    # local path is not a valid repo id) — both get the actionable message.
+    try:
+        cfg = transformers.AutoConfig.from_pretrained(model_name, local_files_only=True)
+    except (OSError, ValueError):
+        try:
+            cfg = transformers.AutoConfig.from_pretrained(model_name)
+        except (OSError, ValueError) as exc:
+            raise ValueError(
+                f"Could not resolve {model_name!r}: not a local file, not a zoo "
+                f"preset ({sorted(PRESETS)}), not in the local HF cache, and the "
+                "Hub is unreachable. Download the model's config.json and pass "
+                "its path instead."
+            ) from exc
+    return cfg.to_dict()
+
+
 def create_empty_model(model_name: str):
-    """Abstract (shape-only) params for a preset or local config.json — the
-    ``jax.eval_shape`` analog of reference ``estimate.py:60-150`` meta-device load."""
+    """Abstract (shape-only) params for a preset, a local config.json, or a
+    Hub model id — the ``jax.eval_shape`` analog of reference
+    ``estimate.py:60-150`` meta-device load (config only, never weights)."""
     import jax
 
     if os.path.isfile(model_name):
         with open(model_name, encoding="utf-8") as f:
             hf = json.load(f)
-        arch = (hf.get("architectures") or [""])[0].lower()
-        if "llama" in arch or hf.get("model_type") == "llama":
-            family, kw = "llama", dict(
-                vocab_size=hf["vocab_size"], hidden_size=hf["hidden_size"],
-                intermediate_size=hf["intermediate_size"], num_hidden_layers=hf["num_hidden_layers"],
-                num_attention_heads=hf["num_attention_heads"],
-                num_key_value_heads=hf.get("num_key_value_heads", hf["num_attention_heads"]),
-            )
-        elif "t5" in arch or hf.get("model_type") == "t5":
-            family, kw = "t5", dict(
-                vocab_size=hf["vocab_size"], d_model=hf["d_model"], d_kv=hf["d_kv"],
-                d_ff=hf["d_ff"], num_layers=hf["num_layers"],
-                num_decoder_layers=hf.get("num_decoder_layers", hf["num_layers"]),
-                num_heads=hf["num_heads"],
-            )
-        elif "bert" in arch or hf.get("model_type") == "bert":
-            family, kw = "bert", dict(
-                vocab_size=hf["vocab_size"], hidden_size=hf["hidden_size"],
-                num_hidden_layers=hf["num_hidden_layers"],
-                num_attention_heads=hf["num_attention_heads"],
-                intermediate_size=hf["intermediate_size"],
-            )
-        else:
-            raise ValueError(f"Unsupported architecture in {model_name}: {arch or hf.get('model_type')}")
+        model = _model_from_hf_config(hf)
     elif model_name in PRESETS:
         family, kw = PRESETS[model_name]
+        if family == "llama":
+            from ..models import Llama, LlamaConfig
+
+            model = Llama(LlamaConfig(**kw))
+        elif family == "t5":
+            from ..models import T5Config, T5ForConditionalGeneration
+
+            model = T5ForConditionalGeneration(T5Config(**kw))
+        else:
+            from ..models import BertConfig, BertForSequenceClassification
+
+            model = BertForSequenceClassification(BertConfig(**kw))
     else:
-        raise ValueError(
-            f"Unknown model {model_name!r}. Pass a config.json path or one of {sorted(PRESETS)}"
-        )
-
-    if family == "llama":
-        from ..models import Llama, LlamaConfig
-
-        model = Llama(LlamaConfig(**kw))
-    elif family == "t5":
-        from ..models import T5Config, T5ForConditionalGeneration
-
-        model = T5ForConditionalGeneration(T5Config(**kw))
-    else:
-        from ..models import BertConfig, BertForSequenceClassification
-
-        model = BertForSequenceClassification(BertConfig(**kw))
+        model = _model_from_hf_config(_hub_config(model_name))
     return jax.eval_shape(lambda: model.init_params(jax.random.key(0)))
 
 
@@ -100,7 +141,11 @@ def estimate_command_parser(subparsers=None) -> argparse.ArgumentParser:
         parser = subparsers.add_parser("estimate-memory", description=description)
     else:
         parser = argparse.ArgumentParser("accelerate-tpu estimate-memory", description=description)
-    parser.add_argument("model_name", help="Zoo preset (e.g. llama-7b) or path to a config.json")
+    parser.add_argument(
+        "model_name",
+        help="Zoo preset (e.g. llama-7b), path to a config.json, or a Hub "
+             "model id (e.g. meta-llama/Llama-2-7b-hf; config only, no weights)",
+    )
     parser.add_argument(
         "--dtypes", nargs="+", default=list(DTYPE_BYTES), choices=list(DTYPE_BYTES),
         help="Dtypes to include in the table",

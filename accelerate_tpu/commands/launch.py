@@ -132,6 +132,26 @@ def prepare_launch_env(cfg: ClusterConfig, process_id: int | None = None, attemp
         env["PYTHONPATH"] = pkg_parent + os.pathsep + env.get("PYTHONPATH", "")
     env[ENV_MIXED_PRECISION] = cfg.mixed_precision
     env[ENV_MESH_SHAPE] = cfg.mesh_shape_env()
+    # Per-feature sections from the guided wizard; Accelerator() reads these
+    # when the corresponding constructor argument is not given.
+    if cfg.gradient_accumulation_steps and cfg.gradient_accumulation_steps > 1:
+        env["ACCELERATE_GRADIENT_ACCUMULATION_STEPS"] = str(cfg.gradient_accumulation_steps)
+    if cfg.fsdp_min_shard_size:
+        env["ACCELERATE_FSDP_MIN_SHARD_SIZE"] = str(cfg.fsdp_min_shard_size)
+    if cfg.fsdp_cpu_offload:
+        env["ACCELERATE_FSDP_CPU_OFFLOAD"] = "1"
+    if cfg.pp_schedule:
+        env["ACCELERATE_PP_SCHEDULE"] = cfg.pp_schedule
+    if cfg.pp_microbatches:
+        env["ACCELERATE_PP_MICROBATCHES"] = str(cfg.pp_microbatches)
+    if cfg.project_dir:
+        env["ACCELERATE_PROJECT_DIR"] = cfg.project_dir
+    if cfg.checkpoint_total_limit:
+        env["ACCELERATE_CHECKPOINT_TOTAL_LIMIT"] = str(cfg.checkpoint_total_limit)
+    if cfg.checkpoint_auto_naming:
+        env["ACCELERATE_CHECKPOINT_AUTO_NAMING"] = "1"
+    if cfg.log_with:
+        env["ACCELERATE_LOG_WITH"] = cfg.log_with
     # Plugins (e.g. the axon tunnel) may have pinned JAX_PLATFORMS in *this*
     # process's environ at jax-import time; children must re-discover their own
     # backend, so only forward the value we set deliberately.
