@@ -314,7 +314,7 @@ class Accelerator:
         device_placement: bool = True,
         split_batches: bool = False,
         mixed_precision: str | None = None,
-        gradient_accumulation_steps: int = 1,
+        gradient_accumulation_steps: int | None = None,  # None -> env, then 1
         cpu: bool = False,
         dataloader_config: DataLoaderConfiguration | None = None,
         fsdp_plugin: FullyShardedDataParallelPlugin | None = None,
@@ -413,13 +413,14 @@ class Accelerator:
         )
 
         if gradient_accumulation_plugin is None:
-            # The env is a default, not an override: an explicit constructor
-            # value (anything but the default 1) wins over the wizard's env.
+            # The env is a default, not an override: any explicit constructor
+            # value (including 1, via the None sentinel) wins over the
+            # wizard's env.
             steps = gradient_accumulation_steps
-            if steps == 1:
+            if steps is None:
                 steps = int(os.environ.get("ACCELERATE_GRADIENT_ACCUMULATION_STEPS", 1))
             gradient_accumulation_plugin = GradientAccumulationPlugin(num_steps=steps)
-        elif gradient_accumulation_steps > 1:
+        elif gradient_accumulation_steps is not None and gradient_accumulation_steps > 1:
             raise ValueError(
                 "You can only pass one of `gradient_accumulation_steps` and "
                 "`gradient_accumulation_plugin`. Please only pass in the created "

@@ -68,6 +68,10 @@ def _model_from_hf_config(hf: dict):
                      "num_hidden_layers", "num_attention_heads")
         if not all(k in hf for k in size_keys):
             raise
+        if "num_local_experts" in hf or "num_experts" in hf:
+            # A dense-Llama approximation would silently drop the expert
+            # FFNs (Mixtral-8x7B would read as ~13B) — fail loudly instead.
+            raise
         from ..models import Llama, LlamaConfig
 
         return Llama(LlamaConfig(
