@@ -69,9 +69,12 @@ def _model_from_hf_config(hf: dict):
         if not all(k in hf for k in size_keys):
             raise
         if "num_local_experts" in hf or "num_experts" in hf:
-            # A dense-Llama approximation would silently drop the expert
-            # FFNs (Mixtral-8x7B would read as ~13B) — fail loudly instead.
-            raise
+            raise ValueError(
+                "MoE config rejected by its converter and the dense-Llama "
+                "size fallback would silently drop the expert FFNs "
+                "(Mixtral-8x7B would read ~3.6x small) — fix the config "
+                f"feature the converter flagged: {exc}"
+            ) from exc
         from ..models import Llama, LlamaConfig
 
         return Llama(LlamaConfig(

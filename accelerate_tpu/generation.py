@@ -340,8 +340,8 @@ def assisted_generate(
     attention_mask=None,
     eos_token_id: int | None = None,
     pad_token_id: int = 0,
-    cache_dtype=jnp.float32,
-    include_prompt: bool = True,
+    cache_dtype=jnp.bfloat16,  # same default as generate(): the two entry
+    include_prompt: bool = True,  # points must produce identical tokens
 ):
     """Speculative (assisted) greedy decoding — transformers'
     ``generate(assistant_model=...)``, TPU-shaped.
@@ -685,6 +685,8 @@ def generate(
     length_penalty: float = 1.0,
     num_return_sequences: int = 1,
     do_sample: bool = False,
+    assistant_model=None,
+    num_draft_tokens: int = 5,
 ):
     """Generate ``max_new_tokens`` continuations for a batch of prompts.
 
@@ -701,6 +703,23 @@ def generate(
     """
     from .big_modeling import StreamedScanModel
 
+    if assistant_model is not None:
+        # transformers' generate(assistant_model=...) entry point: route to
+        # speculative decoding (greedy only, like HF's assisted path).
+        if num_beams > 1 or do_sample or (temperature and temperature > 0.0):
+            raise ValueError(
+                "assistant_model (speculative decoding) is greedy-only; drop "
+                "num_beams/do_sample/temperature or call assisted_generate directly."
+            )
+        if num_return_sequences != 1:
+            raise ValueError("assistant_model does not support num_return_sequences > 1")
+        return assisted_generate(
+            model, assistant_model, input_ids, max_new_tokens=max_new_tokens,
+            num_draft_tokens=num_draft_tokens, params=params,
+            attention_mask=attention_mask, eos_token_id=eos_token_id,
+            pad_token_id=pad_token_id, cache_dtype=cache_dtype,
+            include_prompt=include_prompt,
+        )
     if num_beams > 1:
         if temperature and temperature > 0.0 and not do_sample:
             raise ValueError(
@@ -720,8 +739,23 @@ def generate(
             temperature=temperature if (do_sample and temperature) else 1.0,
             top_k=top_k, top_p=top_p, rng=rng,
         )
+    if do_sample and not (temperature and temperature > 0.0):
+        temperature = 1.0  # HF do_sample semantics: sample at T=1 by default
     if num_return_sequences != 1:
-        raise ValueError("num_return_sequences > 1 requires num_beams > 1")
+        # HF semantics for sampling: n independent draws per prompt, returned
+        # as (B*n, T) with each prompt's draws adjacent. Implemented by
+        # row-expanding the batch; each expanded row samples its own stream.
+        if not (temperature and temperature > 0.0):
+            raise ValueError(
+                "num_return_sequences > 1 needs sampling (do_sample/temperature"
+                " > 0) or beam search (num_beams > 1) — greedy returns one "
+                "sequence."
+            )
+        n = num_return_sequences
+        input_ids = jnp.repeat(jnp.asarray(input_ids, jnp.int32), n, axis=0)
+        if attention_mask is not None:
+            attention_mask = jnp.repeat(jnp.asarray(attention_mask, jnp.int32), n, axis=0)
+        num_return_sequences = 1
 
     input_ids = jnp.asarray(input_ids, jnp.int32)
     B, S = input_ids.shape

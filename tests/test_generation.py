@@ -390,6 +390,45 @@ def test_assisted_batched_rejects_windowed(model_and_params):
                           max_new_tokens=2)
 
 
+def test_generate_assistant_model_entry_point(model_and_params):
+    """HF-parity surface: generate(assistant_model=...) routes to speculative
+    decoding and matches assisted_generate / plain greedy exactly."""
+    from accelerate_tpu.generation import assisted_generate
+
+    model, params = model_and_params
+    ids = np.random.default_rng(55).integers(1, 256, (1, 6)).astype(np.int32)
+    via_generate = np.asarray(generate(
+        model, ids, max_new_tokens=8, assistant_model=model, num_draft_tokens=3,
+        temperature=0.0, cache_dtype=jnp.float32, include_prompt=False,
+    ))
+    direct = np.asarray(assisted_generate(
+        model, model, ids, max_new_tokens=8, num_draft_tokens=3,
+        cache_dtype=jnp.float32, include_prompt=False,
+    ))
+    np.testing.assert_array_equal(via_generate, direct)
+    with pytest.raises(ValueError, match="greedy-only"):
+        generate(model, ids, max_new_tokens=2, assistant_model=model, temperature=0.7)
+
+
+def test_generate_sampling_num_return_sequences(model_and_params):
+    """HF semantics: sampling with num_return_sequences=n returns (B*n, T)
+    with n independent draws per prompt, adjacent per prompt."""
+    model, params = model_and_params
+    ids = np.random.default_rng(56).integers(1, 256, (2, 5)).astype(np.int32)
+    out = np.asarray(generate(
+        model, ids, max_new_tokens=6, temperature=1.0, num_return_sequences=3,
+        rng=jax.random.key(0), cache_dtype=jnp.float32, include_prompt=True,
+    ))
+    assert out.shape == (6, 11)
+    # prompts repeat per draw-group; draws within a group differ (w.h.p.)
+    for i in range(3):
+        np.testing.assert_array_equal(out[i, :5], ids[0])
+        np.testing.assert_array_equal(out[3 + i, :5], ids[1])
+    assert not np.array_equal(out[0, 5:], out[1, 5:])
+    with pytest.raises(ValueError, match="sampling"):
+        generate(model, ids, max_new_tokens=2, temperature=0.0, num_return_sequences=2)
+
+
 def test_assisted_cache_key_survives_draft_gc(model_and_params):
     """The compile cache keys on a monotone per-module uid, not id(): a new
     draft module reusing a GC'd module's id() must NOT hit the stale compiled
