@@ -706,6 +706,11 @@ def generate(
     if assistant_model is not None:
         # transformers' generate(assistant_model=...) entry point: route to
         # speculative decoding (greedy only, like HF's assisted path).
+        if isinstance(model, StreamedScanModel) or hasattr(_unwrap(model)[0], "encode"):
+            raise ValueError(
+                "assisted generation supports decoder-only cached models "
+                "(not StreamedScanModel or encoder-decoder)"
+            )
         if num_beams > 1 or do_sample or (temperature and temperature > 0.0):
             raise ValueError(
                 "assistant_model (speculative decoding) is greedy-only; drop "

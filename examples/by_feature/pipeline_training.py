@@ -1,17 +1,21 @@
-"""Feature: true pipeline-parallel training (GPipe schedule).
+"""Feature: true pipeline-parallel training (GPipe or 1F1B schedule).
 
 The ``pp`` mesh axis runs a real pipeline (``parallel/pipeline.py``): each
 stage keeps its block of layers stationary and microbatched activations move
 stage-to-stage by ``ppermute`` — the communication shape of Megatron/GPipe,
 not the all-gather-weights pattern of layer-dim sharding. Raise
-``num_microbatches`` to amortize the ``(P-1)/(M+P-1)`` bubble.
+``num_microbatches`` to amortize the ``(P-1)/(M+P-1)`` bubble;
+``--schedule 1f1b`` interleaves forwards and backwards so activation
+liveness is O(pp) instead of O(num_microbatches) (the memory schedule for
+deep pipelines — step time matches GPipe).
 
 The reference exposes pipeline training only as a Megatron ``pp_degree``
 passthrough (``utils/dataclasses.py:2110``); here it is native.
 
 Run (8 virtual devices):
     XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
-        python examples/by_feature/pipeline_training.py --pp 2 --microbatches 4
+        python examples/by_feature/pipeline_training.py --pp 2 --microbatches 4 \
+        --schedule 1f1b
 """
 
 import argparse
@@ -36,11 +40,14 @@ def main():
     ap.add_argument("--microbatches", type=int, default=4)
     ap.add_argument("--num_steps", type=int, default=8)
     ap.add_argument("--layers", type=int, default=4)
+    ap.add_argument("--schedule", choices=["gpipe", "1f1b"], default="gpipe")
     args = ap.parse_args()
 
     accelerator = Accelerator(
         parallelism_config=ParallelismConfig(pp_size=args.pp),
-        pp_plugin=PipelineParallelPlugin(pp_size=args.pp, num_microbatches=args.microbatches),
+        pp_plugin=PipelineParallelPlugin(
+            pp_size=args.pp, num_microbatches=args.microbatches, schedule=args.schedule
+        ),
     )
     cfg = LlamaConfig.tiny(num_hidden_layers=args.layers)
     model = Llama(cfg)
@@ -48,8 +55,9 @@ def main():
     pmodel, popt = accelerator.prepare(model, optax.adamw(1e-2))
     assert pmodel.handle.pipeline_spec is not None, "pipeline schedule did not engage"
     accelerator.print(
-        f"GPipe engaged: {args.pp} stages x {pmodel.handle.pipeline_spec.num_microbatches} "
-        f"microbatches (bubble {(args.pp - 1) / (args.pp - 1 + pmodel.handle.pipeline_spec.num_microbatches):.0%})"
+        f"{pmodel.handle.pipeline_spec.schedule} engaged: {args.pp} stages x "
+        f"{pmodel.handle.pipeline_spec.num_microbatches} microbatches "
+        f"(bubble {(args.pp - 1) / (args.pp - 1 + pmodel.handle.pipeline_spec.num_microbatches):.0%})"
     )
 
     data_degree = accelerator.mesh.shape["dp"] * accelerator.mesh.shape["fsdp"]

@@ -99,6 +99,8 @@ def test_complete_cv_example_step_checkpointing(tmp_path):
         ("by_feature/megatron_style_gpt_pretraining.py", ["--tp", 2, "--pp", 2, "--num_steps", 6]),
         ("by_feature/fsdp_with_peak_mem_tracking.py", ["--num_epochs", 4]),
         ("by_feature/pipeline_training.py", ["--pp", 2, "--microbatches", 4, "--num_steps", 4]),
+        ("by_feature/pipeline_training.py", ["--pp", 2, "--microbatches", 4, "--num_steps", 4,
+                                             "--schedule", "1f1b"]),
         ("by_feature/multi_slice_dcn.py", ["--slices", 2, "--tp", 2, "--num_steps", 4]),
     ],
 )
