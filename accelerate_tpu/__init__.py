@@ -75,6 +75,10 @@ def __getattr__(name):
         from . import generation
 
         return getattr(generation, name)
+    if name == "ContinuousBatcher":
+        from .serving import ContinuousBatcher
+
+        return ContinuousBatcher
     if name in ("from_hf", "from_hf_checkpoint"):
         from .models import convert
 

@@ -1,0 +1,327 @@
+"""Continuous batching — a slot-based serving engine over the KV-cache path.
+
+The reference serves through transformers' ``generate`` one batch at a time:
+a batch runs until its LAST row finishes, so short requests pay for long ones
+(head-of-line blocking). ``ContinuousBatcher`` keeps a fixed number of slots
+decoding together and refills a slot the moment its sequence finishes — the
+scheduling idea of vLLM/Orca, shaped for XLA's static-compilation model:
+
+- **One decode program plus one admit program per prompt-length bucket**:
+  the decode step covers all B slots at once, and an admit prefills one
+  slot's prompt while the others' state rides along untouched. No shape ever
+  depends on which requests are in flight, so nothing recompiles as traffic
+  changes.
+- **One global write offset, per-slot validity** — the same trick as batched
+  speculative decoding (``generation._assisted_generate_batched``): every
+  cache write lands at the global offset for ALL slots and rows that didn't
+  really produce a token simply mask the slot out of their ``kv_mask``.
+  Attention needs only slot-causality + validity, both hole-tolerant; rope
+  positions ride the separate per-row ``positions`` channel, so absolute- and
+  rotary-position models are exact.
+- The cost of that simplicity is cache capacity: slots consume global cache
+  columns even while other rows hole them out, so ``max_cache_len`` should be
+  sized to roughly the total tokens (prompt + generated) the engine will see
+  between full drains, not to a single sequence. The engine raises an
+  actionable error when capacity would overflow instead of corrupting state.
+
+Correctness contract (pinned by tests/test_serving.py): in greedy mode each
+request's output is EXACTLY ``generate(model, prompt, temperature=0)`` for
+that prompt alone, regardless of how requests interleave. In sampling mode
+each request draws from its own stream — ``fold_in(engine_rng, request_id)``
+folded again by step index — so a request's sampled tokens depend only on
+(engine rng, request id), not on traffic or slot assignment; they are
+reproducible but not bit-equal to a solo ``generate()`` (whose split chain
+differs).
+
+Sliding-window models are rejected: window masks measure cache-slot distance,
+which the holes would stretch (same restriction as batched assisted).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .generation import _unwrap, left_align, mask_positions
+
+
+@dataclass
+class _Request:
+    rid: int
+    prompt: np.ndarray  # (P,) real tokens, no padding
+
+
+class ContinuousBatcher:
+    """Slot-based continuous batching over a decoder-only cached model.
+
+    Usage::
+
+        engine = ContinuousBatcher(model, batch_slots=4, max_new_tokens=64,
+                                   max_cache_len=4096, eos_token_id=eos)
+        ids = [engine.submit(p) for p in prompts]       # any ragged lengths
+        outputs = engine.run()                           # {rid: np.ndarray}
+
+    ``run()`` drives admits + decode steps until every submitted request has
+    finished; ``submit`` may be called again afterwards (slots and the cache
+    are re-usable until ``max_cache_len`` is exhausted; ``reset()`` reclaims
+    everything).
+    """
+
+    def __init__(
+        self,
+        model,
+        *,
+        batch_slots: int,
+        max_new_tokens: int,
+        max_cache_len: int,
+        params=None,
+        temperature: float = 0.0,
+        top_k: int | None = None,
+        top_p: float | None = None,
+        rng=None,
+        eos_token_id: int | None = None,
+        pad_token_id: int = 0,
+        cache_dtype=jnp.bfloat16,
+        bucket_sizes: tuple = (16, 32, 64, 128, 256, 512, 1024),
+    ):
+        module, mparams = _unwrap(model)
+        self.module = module
+        self.params = params if params is not None else mparams
+        if self.params is None:
+            raise ValueError("Model has no params; pass params= or init the model first.")
+        cfg = getattr(module, "config", None)
+        ws = getattr(cfg, "layer_windows", None)
+        if getattr(cfg, "sliding_window", None) or (
+            ws is not None and any(w is not None for w in ws)
+        ):
+            raise ValueError(
+                "ContinuousBatcher does not support sliding-window attention "
+                "(window masks measure cache-slot distance; the slot scheme "
+                "leaves masked holes)."
+            )
+        if hasattr(module, "encode"):
+            raise ValueError("ContinuousBatcher supports decoder-only cached models.")
+        self.B = batch_slots
+        self.max_new = max_new_tokens
+        self.C = max_cache_len
+        self.temperature = temperature
+        self.top_k, self.top_p = top_k, top_p
+        self.eos = -1 if eos_token_id is None else eos_token_id
+        self.pad = pad_token_id
+        self.cache_dtype = cache_dtype
+        self.buckets = tuple(sorted(bucket_sizes))
+        self._rng = rng if rng is not None else jax.random.key(0)
+        self._queue: deque[_Request] = deque()
+        self._next_rid = 0
+        self._results: dict[int, np.ndarray] = {}
+        self._admit_fns: dict[int, object] = {}
+        self._decode_fn = None
+        self.reset()
+
+    # ------------------------------------------------------------- lifecycle
+    def reset(self):
+        """Fresh cache and slot state. Queued (not-yet-admitted) requests and
+        already-finished results survive; in-flight slots are wiped — the
+        capacity-error path re-queues them first, so catch + ``reset()`` +
+        ``run()`` retries everything."""
+        B = self.B
+        self._cache = self.module.init_cache(B, self.C, dtype=self.cache_dtype)
+        self._tok = jnp.full((B,), self.pad, jnp.int32)
+        self._pos = jnp.zeros((B,), jnp.int32)  # next rope position per slot
+        self._n_out = jnp.zeros((B,), jnp.int32)
+        self._active = jnp.zeros((B,), bool)
+        self._out_buf = jnp.full((B, self.max_new), self.pad, jnp.int32)
+        self._keys = jnp.broadcast_to(self._rng, (B,))
+        self._slot_req: list[_Request | None] = [None] * B
+
+    def submit(self, prompt_ids) -> int:
+        """Queue one prompt (1-D array of token ids). Returns a request id."""
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        if prompt.size > self.buckets[-1]:
+            raise ValueError(
+                f"prompt length {prompt.size} exceeds the largest bucket "
+                f"{self.buckets[-1]}; raise bucket_sizes."
+            )
+        rid = self._next_rid
+        self._next_rid += 1
+        self._queue.append(_Request(rid, prompt))
+        return rid
+
+    # ------------------------------------------------------------- sampling
+    def _sample_rows(self, logits, keys, step_idx):
+        """Per-row draw from per-request streams: row r's key folded by its
+        own step index — sampled tokens depend only on (engine rng, request
+        id, step), never on traffic or slot assignment."""
+        if not (self.temperature and self.temperature > 0.0):
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        from .generation import _warp_scores
+
+        warped = _warp_scores(logits, self.temperature, self.top_k, self.top_p)
+
+        def one(lg, k, n):
+            return jax.random.categorical(jax.random.fold_in(k, n), lg).astype(jnp.int32)
+
+        return jax.vmap(one)(warped, keys, step_idx)
+
+    # ------------------------------------------------------------- compiled
+    def _admit_fn(self, P: int):
+        """Compiled prefill of ONE slot's prompt (bucket length P): the whole
+        (B, P) chunk runs so shapes stay request-independent; rows other than
+        the target slot carry a zero attention mask, so their kv_mask stays
+        invalid for the written block automatically."""
+        if P in self._admit_fns:
+            return self._admit_fns[P]
+        module = self.module
+        pad = self.pad
+
+        def run(params, cache, state, slot, prompt_row, mask_row, rid, base_rng):
+            tok, pos, n_out, active, out_buf, keys = state
+            B = tok.shape[0]
+            # evict the slot's previous occupant: its KV must stop being
+            # attendable before the new prompt writes into the same row
+            cache = {**cache, "kv_mask": cache["kv_mask"].at[slot].set(0)}
+            ids = jnp.zeros((B, P), jnp.int32).at[slot].set(prompt_row)
+            mask = jnp.zeros((B, P), jnp.int32).at[slot].set(mask_row)
+            out = module.apply(params, input_ids=ids, attention_mask=mask,
+                               cache=cache, positions=mask_positions(mask))
+            real_len = jnp.sum(mask_row).astype(jnp.int32)
+            key = jax.random.fold_in(base_rng, rid)  # the request's own stream
+            keys = keys.at[slot].set(key)
+            first = self._sample_rows(
+                out["logits"][slot, -1][None], key[None], jnp.zeros((1,), jnp.int32)
+            )[0]
+            tok = tok.at[slot].set(first)
+            pos = pos.at[slot].set(real_len)
+            n_out = n_out.at[slot].set(1)
+            # even an immediate eos is emitted (HF convention); the slot stays
+            # active only if there is room and the first token wasn't eos
+            out_buf = out_buf.at[slot].set(jnp.full((self.max_new,), pad, jnp.int32))
+            out_buf = out_buf.at[slot, 0].set(first)
+            done0 = (first == self.eos) | (self.max_new <= 1)
+            active = active.at[slot].set(~done0)
+            return out["cache"], (tok, pos, n_out, active, out_buf, keys), done0
+
+        fn = jax.jit(run)
+        self._admit_fns[P] = fn
+        return fn
+
+    def _decode(self):
+        """Compiled one-token step for all B slots; inactive rows feed pads
+        and their freshly written cache column is invalidated."""
+        if self._decode_fn is not None:
+            return self._decode_fn
+        module = self.module
+        pad = self.pad
+
+        def run(params, cache, state):
+            tok, pos, n_out, active, out_buf, keys = state
+            B = tok.shape[0]
+            col = cache["pos"]  # global slot this step writes
+            feed = jnp.where(active, tok, pad)
+            out = module.apply(params, input_ids=feed[:, None], cache=cache,
+                               positions=pos[:, None])
+            nxt = self._sample_rows(out["logits"][:, -1], keys, n_out)
+            nxt = jnp.where(active, nxt, pad)
+            cache = out["cache"]
+            # hole out the column for rows that didn't really produce a token
+            cache = {
+                **cache,
+                "kv_mask": cache["kv_mask"].at[:, col].set(
+                    jnp.where(active, cache["kv_mask"][:, col], 0)
+                ),
+            }
+            emit_idx = jnp.clip(n_out, 0, self.max_new - 1)
+            cur = out_buf[jnp.arange(B), emit_idx]
+            out_buf = out_buf.at[jnp.arange(B), emit_idx].set(
+                jnp.where(active, nxt, cur)
+            )
+            n_out = n_out + active.astype(jnp.int32)
+            still = active & (nxt != self.eos) & (n_out < self.max_new)
+            return cache, (nxt, pos + 1, n_out, still, out_buf, keys)
+
+        self._decode_fn = jax.jit(run)
+        return self._decode_fn
+
+    # ----------------------------------------------------------------- loop
+    def _bucket(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        raise AssertionError  # guarded in submit()
+
+    def _collect(self, s: int, active_np):
+        req = self._slot_req[s]
+        if req is None or active_np[s]:
+            return
+        row = np.asarray(self._out_buf[s])
+        n = int(self._n_out[s])
+        row = row[:n].copy()
+        if self.eos >= 0 and (row == self.eos).any():
+            row = row[: int(np.argmax(row == self.eos)) + 1]
+        self._results[req.rid] = row
+        self._slot_req[s] = None
+
+    def _sync(self, state):
+        (self._tok, self._pos, self._n_out, self._active, self._out_buf,
+         self._keys) = state
+
+    def run(self) -> dict[int, np.ndarray]:
+        """Drive admits + decode until the queue drains and all slots finish.
+        Returns THIS wave's results only: {request_id: generated token ids
+        (eos included, no pads)} for every request finished during the call."""
+        state = (self._tok, self._pos, self._n_out, self._active, self._out_buf,
+                 self._keys)
+        while True:
+            self._sync(state)  # _collect reads the instance fields
+            active_np = np.asarray(state[3])
+            for s in range(self.B):
+                self._collect(s, active_np)
+            free = [s for s in range(self.B) if self._slot_req[s] is None]
+            while free and self._queue:
+                req = self._queue.popleft()
+                s = free.pop(0)
+                P = self._bucket(req.prompt.size)
+                if int(self._cache["pos"]) + P + self.max_new > self.C:
+                    # Recoverable: put the victim AND every in-flight request
+                    # back on the queue, so catch + reset() + run() retries
+                    # everything (finished results are already banked).
+                    self._queue.appendleft(req)
+                    for t in range(self.B):
+                        if self._slot_req[t] is not None:
+                            self._queue.appendleft(self._slot_req[t])
+                            self._slot_req[t] = None
+                    raise RuntimeError(
+                        f"cache capacity exhausted (pos={int(self._cache['pos'])}, "
+                        f"need {P + self.max_new} more of {self.C}); raise "
+                        "max_cache_len, or catch this, reset(), and run() again "
+                        "(in-flight requests were re-queued)."
+                    )
+                row = np.full((P,), self.pad, np.int32)
+                mrow = np.zeros((P,), np.int32)
+                row[: req.prompt.size] = req.prompt
+                mrow[: req.prompt.size] = 1
+                # left-align inside the bucket so the last real token sits at P-1
+                row_j, mrow_j = left_align(row[None], mrow[None])
+                self._cache, state, fin0 = self._admit_fn(P)(
+                    self.params, self._cache, state, s, row_j[0], mrow_j[0],
+                    jnp.int32(req.rid), self._rng,
+                )
+                self._slot_req[s] = req
+                if bool(fin0):
+                    self._sync(state)
+                    self._collect(s, np.asarray(state[3]))
+                    if self._slot_req[s] is None:
+                        free.insert(0, s)
+            if not self._queue and not any(r is not None for r in self._slot_req):
+                break
+            self._cache, state = self._decode()(self.params, self._cache, state)
+        self._sync(state)
+        wave, self._results = self._results, {}
+        return {rid: wave[rid] for rid in sorted(wave)}
