@@ -88,6 +88,7 @@ class ContinuousBatcher:
         pad_token_id: int = 0,
         cache_dtype=jnp.bfloat16,
         bucket_sizes: tuple = (16, 32, 64, 128, 256, 512, 1024),
+        sync_every: int = 8,
     ):
         module, mparams = _unwrap(model)
         self.module = module
@@ -115,6 +116,14 @@ class ContinuousBatcher:
         self.pad = pad_token_id
         self.cache_dtype = cache_dtype
         self.buckets = tuple(sorted(bucket_sizes))
+        if sync_every < 1:
+            raise ValueError(f"sync_every must be >= 1, got {sync_every}")
+        # How many decode steps to enqueue between host checks. The host
+        # round-trip (detecting finished slots) is the serving loop's only
+        # sync; batching K steps per check amortizes it — finished slots idle
+        # at most K-1 extra steps and the cache consumes at most K-1 extra
+        # columns per wave, both accounted for in the capacity reservation.
+        self.sync_every = sync_every
         self._rng = rng if rng is not None else jax.random.key(0)
         self._queue: deque[_Request] = deque()
         self._next_rid = 0
@@ -138,6 +147,10 @@ class ContinuousBatcher:
         self._out_buf = jnp.full((B, self.max_new), self.pad, jnp.int32)
         self._keys = jnp.broadcast_to(self._rng, (B,))
         self._slot_req: list[_Request | None] = [None] * B
+        # Host-side mirror of cache["pos"]: it advances deterministically
+        # (+bucket per admit, +sync_every per decode window), so capacity
+        # checks never need a device readback.
+        self._host_pos = 0
 
     def submit(self, prompt_ids) -> int:
         """Queue one prompt (1-D array of token ids). Returns a request id."""
@@ -208,45 +221,56 @@ class ContinuousBatcher:
             active = active.at[slot].set(~done0)
             return out["cache"], (tok, pos, n_out, active, out_buf, keys), done0
 
-        fn = jax.jit(run)
+        fn = jax.jit(run, donate_argnums=(1, 2))
         self._admit_fns[P] = fn
         return fn
 
     def _decode(self):
-        """Compiled one-token step for all B slots; inactive rows feed pads
-        and their freshly written cache column is invalidated."""
+        """Compiled ``sync_every``-token window for all B slots — ONE program
+        dispatch per host check (a ``lax.scan`` over steps), so neither local
+        dispatch overhead nor a remote tunnel's per-call RTT is paid per
+        token. Inactive rows feed pads and their freshly written cache
+        columns are invalidated."""
         if self._decode_fn is not None:
             return self._decode_fn
         module = self.module
         pad = self.pad
 
         def run(params, cache, state):
-            tok, pos, n_out, active, out_buf, keys = state
-            B = tok.shape[0]
-            col = cache["pos"]  # global slot this step writes
-            feed = jnp.where(active, tok, pad)
-            out = module.apply(params, input_ids=feed[:, None], cache=cache,
-                               positions=pos[:, None])
-            nxt = self._sample_rows(out["logits"][:, -1], keys, n_out)
-            nxt = jnp.where(active, nxt, pad)
-            cache = out["cache"]
-            # hole out the column for rows that didn't really produce a token
-            cache = {
-                **cache,
-                "kv_mask": cache["kv_mask"].at[:, col].set(
-                    jnp.where(active, cache["kv_mask"][:, col], 0)
-                ),
-            }
-            emit_idx = jnp.clip(n_out, 0, self.max_new - 1)
-            cur = out_buf[jnp.arange(B), emit_idx]
-            out_buf = out_buf.at[jnp.arange(B), emit_idx].set(
-                jnp.where(active, nxt, cur)
-            )
-            n_out = n_out + active.astype(jnp.int32)
-            still = active & (nxt != self.eos) & (n_out < self.max_new)
-            return cache, (nxt, pos + 1, n_out, still, out_buf, keys)
+            def one_step(carry, _):
+                cache, (tok, pos, n_out, active, out_buf, keys) = carry
+                B = tok.shape[0]
+                col = cache["pos"]  # global slot this step writes
+                feed = jnp.where(active, tok, pad)
+                out = module.apply(params, input_ids=feed[:, None], cache=cache,
+                                   positions=pos[:, None])
+                nxt = self._sample_rows(out["logits"][:, -1], keys, n_out)
+                nxt = jnp.where(active, nxt, pad)
+                cache2 = out["cache"]
+                # hole out the column for rows that didn't produce a token
+                cache2 = {
+                    **cache2,
+                    "kv_mask": cache2["kv_mask"].at[:, col].set(
+                        jnp.where(active, cache2["kv_mask"][:, col], 0)
+                    ),
+                }
+                emit_idx = jnp.clip(n_out, 0, self.max_new - 1)
+                cur = out_buf[jnp.arange(B), emit_idx]
+                out_buf = out_buf.at[jnp.arange(B), emit_idx].set(
+                    jnp.where(active, nxt, cur)
+                )
+                n_out = n_out + active.astype(jnp.int32)
+                still = active & (nxt != self.eos) & (n_out < self.max_new)
+                return (cache2, (nxt, pos + 1, n_out, still, out_buf, keys)), None
 
-        self._decode_fn = jax.jit(run)
+            (cache, state), _ = jax.lax.scan(
+                one_step, (cache, state), None, length=self.sync_every
+            )
+            return cache, state
+
+        # Donating cache+state halves the live KV footprint (the cache is the
+        # engine's dominant allocation and is dead after each window).
+        self._decode_fn = jax.jit(run, donate_argnums=(1, 2))
         return self._decode_fn
 
     # ----------------------------------------------------------------- loop
@@ -288,20 +312,20 @@ class ContinuousBatcher:
                 req = self._queue.popleft()
                 s = free.pop(0)
                 P = self._bucket(req.prompt.size)
-                if int(self._cache["pos"]) + P + self.max_new > self.C:
-                    # Recoverable: put the victim AND every in-flight request
-                    # back on the queue, so catch + reset() + run() retries
-                    # everything (finished results are already banked).
+                if self._host_pos + P + self.max_new + self.sync_every - 1 > self.C:
                     self._queue.appendleft(req)
-                    for t in range(self.B):
-                        if self._slot_req[t] is not None:
-                            self._queue.appendleft(self._slot_req[t])
-                            self._slot_req[t] = None
+                    if any(r is not None for r in self._slot_req):
+                        # Backpressure, not failure: let the in-flight slots
+                        # finish (each decode window frees capacity pressure
+                        # by retiring requests) and retry the admit later.
+                        break
+                    # Nothing in flight and still no room: a true dead end.
+                    # Re-queue is already done, so catch + reset() + run()
+                    # retries everything (finished results stay banked).
                     raise RuntimeError(
-                        f"cache capacity exhausted (pos={int(self._cache['pos'])}, "
+                        f"cache capacity exhausted (pos={self._host_pos}, "
                         f"need {P + self.max_new} more of {self.C}); raise "
-                        "max_cache_len, or catch this, reset(), and run() again "
-                        "(in-flight requests were re-queued)."
+                        "max_cache_len, or catch this, reset(), and run() again."
                     )
                 row = np.full((P,), self.pad, np.int32)
                 mrow = np.zeros((P,), np.int32)
@@ -309,19 +333,24 @@ class ContinuousBatcher:
                 mrow[: req.prompt.size] = 1
                 # left-align inside the bucket so the last real token sits at P-1
                 row_j, mrow_j = left_align(row[None], mrow[None])
-                self._cache, state, fin0 = self._admit_fn(P)(
+                self._cache, state, _fin0 = self._admit_fn(P)(
                     self.params, self._cache, state, s, row_j[0], mrow_j[0],
                     jnp.int32(req.rid), self._rng,
                 )
+                self._host_pos += P
+                # Keep the instance fields pointing at LIVE buffers: the admit
+                # donated the previous ones, and a capacity raise later in
+                # this pass must leave the engine in a clean recoverable state.
+                self._sync(state)
                 self._slot_req[s] = req
-                if bool(fin0):
-                    self._sync(state)
-                    self._collect(s, np.asarray(state[3]))
-                    if self._slot_req[s] is None:
-                        free.insert(0, s)
+                # (an immediate-eos slot is collected at the next loop-top
+                # check — no blocking readback of the admit result here)
             if not self._queue and not any(r is not None for r in self._slot_req):
                 break
+            # ONE dispatch advances all slots by sync_every tokens; the
+            # np.asarray at the loop top is the only blocking host round-trip.
             self._cache, state = self._decode()(self.params, self._cache, state)
+            self._host_pos += self.sync_every
         self._sync(state)
         wave, self._results = self._results, {}
         return {rid: wave[rid] for rid in sorted(wave)}

@@ -32,14 +32,16 @@ def _solo(model, prompt, max_new, eos=None):
     ))[0]
 
 
-def test_continuous_batching_matches_solo_greedy(llama):
+@pytest.mark.parametrize("sync_every", [1, 4])
+def test_continuous_batching_matches_solo_greedy(llama, sync_every):
     """6 ragged requests through 2 slots: each output token-identical to the
-    solo greedy decode, with slot refill mid-flight."""
+    solo greedy decode, with slot refill mid-flight — at every host-sync
+    cadence (async decode windows change only hole placement)."""
     rng = np.random.default_rng(80)
     prompts = [rng.integers(1, 256, (n,)).astype(np.int32) for n in (5, 9, 3, 12, 7, 4)]
     engine = ContinuousBatcher(llama, batch_slots=2, max_new_tokens=8,
                                max_cache_len=512, cache_dtype=jnp.float32,
-                               bucket_sizes=(8, 16))
+                               bucket_sizes=(8, 16), sync_every=sync_every)
     rids = [engine.submit(p) for p in prompts]
     outs = engine.run()
     for rid, p in zip(rids, prompts):
@@ -105,7 +107,7 @@ def test_continuous_batching_no_recompile_across_requests(llama):
 def test_continuous_batching_capacity_recovery_and_guards(llama):
     engine = ContinuousBatcher(llama, batch_slots=1, max_new_tokens=8,
                                max_cache_len=16, cache_dtype=jnp.float32,
-                               bucket_sizes=(8,))
+                               bucket_sizes=(8,), sync_every=1)
     p = np.arange(1, 6, dtype=np.int32)
     r1 = engine.submit(p)
     r2 = engine.submit(p)  # second cannot fit in 16 slots
