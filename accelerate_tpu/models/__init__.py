@@ -1,5 +1,6 @@
 from .bert import BertConfig, BertForSequenceClassification
 from .gpt2 import GPT2, GPT2Config
+from .gptx import GPTX, GPTXConfig
 from .llama import Llama, LlamaConfig
 from .moe import MoELlama, MoELlamaConfig
 from .t5 import T5Config, T5ForConditionalGeneration
@@ -14,7 +15,10 @@ def __getattr__(name):
                 "t5_config_from_hf", "t5_params_from_hf",
                 "mixtral_config_from_hf", "mixtral_params_from_hf",
                 "qwen2_config_from_hf", "qwen2_params_from_hf",
-                "gemma_config_from_hf", "gemma_params_from_hf"):
+                "gemma_config_from_hf", "gemma_params_from_hf",
+                "gpt_neox_config_from_hf", "gpt_neox_params_from_hf",
+                "gptj_config_from_hf", "gptj_params_from_hf",
+                "opt_config_from_hf", "opt_params_from_hf"):
         from . import convert
 
         return getattr(convert, name)

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 
 from .bert import BertConfig, BertForSequenceClassification
 from .gpt2 import GPT2, GPT2Config
+from .gptx import GPTX, GPTXConfig
 from .llama import Llama, LlamaConfig
 from .moe import MoELlama, MoELlamaConfig
 from .t5 import T5Config, T5ForConditionalGeneration
@@ -43,12 +44,14 @@ def _to_numpy(t, dtype=None) -> np.ndarray:
     return arr.astype(dtype) if dtype is not None else arr
 
 
-def _normalize_keys(state_dict) -> dict:
+def _normalize_keys(state_dict, prefixes=("model.", "transformer.", "bert.")) -> dict:
     """Strip the wrapper prefix transformers adds (``model.`` for Llama,
-    ``transformer.`` for GPT-2) so bare-backbone and LMHead checkpoints both map."""
+    ``transformer.`` for GPT-2) so bare-backbone and LMHead checkpoints both map.
+    First matching prefix wins; converters with nested wrappers pass their own
+    list (OPT: ``model.decoder.``)."""
     out = {}
     for k, v in state_dict.items():
-        for prefix in ("model.", "transformer.", "bert."):
+        for prefix in prefixes:
             if k.startswith(prefix):
                 k = k[len(prefix):]
                 break
@@ -620,6 +623,279 @@ def t5_params_from_hf(state_dict, config: T5Config, dtype=jnp.float32) -> dict:
     return params
 
 
+# --------------------------------------------------- classic GPTs (gptx.py)
+def _map_act(act: str) -> str:
+    """HF activation_function names → the zoo's three classic-GPT activations."""
+    if act in ("gelu", "gelu_python"):
+        return "gelu"
+    if act in ("gelu_new", "gelu_fast", "gelu_pytorch_tanh"):
+        return "gelu_tanh"
+    if act == "relu":
+        return "relu"
+    raise ValueError(f"activation {act!r} is not supported by the classic-GPT zoo model")
+
+
+def gpt_neox_config_from_hf(hf_config) -> GPTXConfig:
+    """GPT-NeoX (reference baseline model family: GPT-NeoX-20B, BASELINE.md).
+    Partial half-split rotary (``rotary_pct``), parallel residual with two
+    norms, fused per-head-interleaved QKV (de-interleaved at conversion)."""
+    get = _getter(hf_config)
+    head_dim = get("hidden_size") // get("num_attention_heads")
+    rotary_dim = int(head_dim * get("rotary_pct", 0.25))
+    if rotary_dim % 2:
+        raise ValueError(f"rotary_pct yields odd rotary_dim {rotary_dim} at head_dim {head_dim}")
+    rope_scaling = get("rope_scaling")
+    if rope_scaling:
+        rope_scaling = dict(rope_scaling)
+        rope_type = rope_scaling.get("rope_type", rope_scaling.get("type", "default"))
+        if rope_type not in ("linear", "llama3", "yarn", "default"):
+            # Mirrors llama_config_from_hf: converting would silently
+            # mis-position long contexts ('dynamic' needs cache-capacity
+            # pinning the classic-GPT skeleton doesn't carry).
+            raise ValueError(
+                f"rope_type={rope_type!r} is not supported for GPT-NeoX checkpoints "
+                "(supported: linear, llama3, yarn)"
+            )
+    # Sequential NeoX checkpoints (use_parallel_residual=False) reuse the same
+    # params with OPT's residual topology.
+    parallel = bool(get("use_parallel_residual", True))
+    return GPTXConfig(
+        vocab_size=get("vocab_size"),
+        hidden_size=get("hidden_size"),
+        intermediate_size=get("intermediate_size"),
+        num_hidden_layers=get("num_hidden_layers"),
+        num_attention_heads=get("num_attention_heads"),
+        max_position_embeddings=get("max_position_embeddings", 2048),
+        layer_norm_eps=get("layer_norm_eps", 1e-5),
+        position_style="rotary_neox",
+        rotary_dim=rotary_dim,
+        rope_theta=get("rotary_emb_base", get("rope_theta", 10000.0)),
+        rope_scaling=rope_scaling,
+        parallel_residual=parallel,
+        hidden_act=_map_act(get("hidden_act", "gelu")),
+        attention_bias=bool(get("attention_bias", True)),
+        tie_word_embeddings=bool(get("tie_word_embeddings", False)),
+    )
+
+
+def gpt_neox_params_from_hf(state_dict, config: GPTXConfig, dtype=jnp.float32) -> dict:
+    sd = _normalize_keys(state_dict, prefixes=("gpt_neox.",))
+    L = config.num_hidden_layers
+    nh, hd, h = config.num_attention_heads, config.head_dim, config.hidden_size
+
+    def deinterleave(i):
+        # HF NeoX fuses QKV per head: rows are [q_h, k_h, v_h] blocks for each
+        # head h. Split to the zoo's contiguous [Q | K | V] column layout.
+        w = _to_numpy(sd[f"layers.{i}.attention.query_key_value.weight"], dtype)
+        w = w.reshape(nh, 3, hd, h)
+        wq, wk, wv = (w[:, j].reshape(nh * hd, h) for j in range(3))
+        out = {"w": np.concatenate([wq, wk, wv], axis=0).T}
+        bkey = f"layers.{i}.attention.query_key_value.bias"
+        if bkey in sd:
+            b = _to_numpy(sd[bkey], dtype).reshape(nh, 3, hd)
+            out["b"] = np.concatenate([b[:, j].reshape(nh * hd) for j in range(3)])
+        return out
+
+    qkv = [deinterleave(i) for i in range(L)]
+    attn = {
+        "w_qkv": jnp.asarray(np.stack([q["w"] for q in qkv])),
+        "wo": _stack(sd, "layers.{i}.attention.dense.weight", L, transpose=True, dtype=dtype),
+    }
+    if config.attention_bias:
+        attn["b_qkv"] = jnp.asarray(np.stack([q["b"] for q in qkv]))
+        attn["bo"] = _stack(sd, "layers.{i}.attention.dense.bias", L, dtype=dtype)
+
+    def ln(name):
+        return {
+            "scale": _stack(sd, f"layers.{{i}}.{name}.weight", L, dtype=dtype),
+            "bias": _stack(sd, f"layers.{{i}}.{name}.bias", L, dtype=dtype),
+        }
+
+    params = {
+        "embed": {"wte": jnp.asarray(_to_numpy(sd["embed_in.weight"], dtype))},
+        "layers": {
+            "attn": attn,
+            "mlp": {
+                "w_in": _stack(sd, "layers.{i}.mlp.dense_h_to_4h.weight", L, transpose=True, dtype=dtype),
+                "b_in": _stack(sd, "layers.{i}.mlp.dense_h_to_4h.bias", L, dtype=dtype),
+                "w_out": _stack(sd, "layers.{i}.mlp.dense_4h_to_h.weight", L, transpose=True, dtype=dtype),
+                "b_out": _stack(sd, "layers.{i}.mlp.dense_4h_to_h.bias", L, dtype=dtype),
+            },
+            "ln_1": ln("input_layernorm"),
+            "ln_2": ln("post_attention_layernorm"),
+        },
+        "ln_f": {
+            "scale": jnp.asarray(_to_numpy(sd["final_layer_norm.weight"], dtype)),
+            "bias": jnp.asarray(_to_numpy(sd["final_layer_norm.bias"], dtype)),
+        },
+    }
+    if not config.tie_word_embeddings:
+        params["lm_head"] = {"weight": jnp.asarray(_to_numpy(sd["embed_out.weight"], dtype).T)}
+    return params
+
+
+def gptj_config_from_hf(hf_config) -> GPTXConfig:
+    """GPT-J (reference baseline model family: GPT-J-6B, BASELINE.md).
+    Interleaved-pair rotary on ``rotary_dim`` lanes, parallel residual sharing
+    ONE layernorm, bias-free attention, untied LM head with bias."""
+    get = _getter(hf_config)
+    n_embd = get("n_embd") or get("hidden_size")
+    rotary_dim = get("rotary_dim")
+    if rotary_dim is None:
+        raise ValueError("GPT-J checkpoints without rotary_dim are not supported")
+    return GPTXConfig(
+        vocab_size=get("vocab_size"),
+        hidden_size=n_embd,
+        intermediate_size=get("n_inner") or 4 * n_embd,
+        num_hidden_layers=get("n_layer") or get("num_hidden_layers"),
+        num_attention_heads=get("n_head") or get("num_attention_heads"),
+        max_position_embeddings=get("n_positions") or get("max_position_embeddings", 2048),
+        layer_norm_eps=get("layer_norm_epsilon", 1e-5),
+        position_style="rotary_gptj",
+        rotary_dim=rotary_dim,
+        parallel_residual=True,
+        shared_layernorm=True,
+        hidden_act=_map_act(get("activation_function", "gelu_new")),
+        attention_bias=False,
+        tie_word_embeddings=bool(get("tie_word_embeddings", False)),
+        lm_head_bias=True,
+    )
+
+
+def gptj_params_from_hf(state_dict, config: GPTXConfig, dtype=jnp.float32) -> dict:
+    sd = _normalize_keys(state_dict)
+    L = config.num_hidden_layers
+
+    def qkv(i):
+        mats = [
+            _to_numpy(sd[f"h.{i}.attn.{p}_proj.weight"], dtype).T for p in ("q", "k", "v")
+        ]
+        return np.concatenate(mats, axis=1)
+
+    params = {
+        "embed": {"wte": jnp.asarray(_to_numpy(sd["wte.weight"], dtype))},
+        "layers": {
+            "attn": {
+                "w_qkv": jnp.asarray(np.stack([qkv(i) for i in range(L)])),
+                "wo": _stack(sd, "h.{i}.attn.out_proj.weight", L, transpose=True, dtype=dtype),
+            },
+            "mlp": {
+                "w_in": _stack(sd, "h.{i}.mlp.fc_in.weight", L, transpose=True, dtype=dtype),
+                "b_in": _stack(sd, "h.{i}.mlp.fc_in.bias", L, dtype=dtype),
+                "w_out": _stack(sd, "h.{i}.mlp.fc_out.weight", L, transpose=True, dtype=dtype),
+                "b_out": _stack(sd, "h.{i}.mlp.fc_out.bias", L, dtype=dtype),
+            },
+            "ln_1": {
+                "scale": _stack(sd, "h.{i}.ln_1.weight", L, dtype=dtype),
+                "bias": _stack(sd, "h.{i}.ln_1.bias", L, dtype=dtype),
+            },
+        },
+        "ln_f": {
+            "scale": jnp.asarray(_to_numpy(sd["ln_f.weight"], dtype)),
+            "bias": jnp.asarray(_to_numpy(sd["ln_f.bias"], dtype)),
+        },
+    }
+    if not config.tie_word_embeddings:
+        params["lm_head"] = {
+            "weight": jnp.asarray(_to_numpy(sd["lm_head.weight"], dtype).T),
+            "bias": jnp.asarray(_to_numpy(sd["lm_head.bias"], dtype)),
+        }
+    return params
+
+
+def opt_config_from_hf(hf_config) -> GPTXConfig:
+    """OPT (reference baseline model family: OPT-30B offload regime,
+    BASELINE.md). Learned positions at a +2 table offset, sequential pre-LN
+    blocks, relu FFN, tied head."""
+    get = _getter(hf_config)
+    if not get("do_layer_norm_before", True):
+        raise ValueError(
+            "do_layer_norm_before=False (OPT-350M) is not supported: the zoo "
+            "model is pre-LN; converting would silently misplace every norm"
+        )
+    if get("_remove_final_layer_norm"):
+        raise ValueError("_remove_final_layer_norm checkpoints (early OPT snapshots) are not supported")
+    h = get("hidden_size")
+    proj = get("word_embed_proj_dim", h) or h
+    if proj != h:
+        raise ValueError(
+            f"word_embed_proj_dim={proj} != hidden_size={h} (OPT-350M's factored "
+            "embedding) is not supported"
+        )
+    if not get("enable_bias", True):
+        raise ValueError("enable_bias=False OPT variants are not supported")
+    if not get("layer_norm_elementwise_affine", True):
+        raise ValueError("layer_norm_elementwise_affine=False OPT variants are not supported")
+    return GPTXConfig(
+        vocab_size=get("vocab_size"),
+        hidden_size=h,
+        intermediate_size=get("ffn_dim"),
+        num_hidden_layers=get("num_hidden_layers"),
+        num_attention_heads=get("num_attention_heads"),
+        max_position_embeddings=get("max_position_embeddings", 2048),
+        layer_norm_eps=1e-5,
+        position_style="learned",
+        position_offset=2,
+        parallel_residual=False,
+        hidden_act=_map_act(get("activation_function", "relu")),
+        attention_bias=True,
+        tie_word_embeddings=bool(get("tie_word_embeddings", True)),
+    )
+
+
+def opt_params_from_hf(state_dict, config: GPTXConfig, dtype=jnp.float32) -> dict:
+    sd = _normalize_keys(state_dict, prefixes=("model.decoder.", "decoder.", "model."))
+    L = config.num_hidden_layers
+
+    def qkv_w(i):
+        return np.concatenate(
+            [_to_numpy(sd[f"layers.{i}.self_attn.{p}_proj.weight"], dtype).T for p in ("q", "k", "v")],
+            axis=1,
+        )
+
+    def qkv_b(i):
+        return np.concatenate(
+            [_to_numpy(sd[f"layers.{i}.self_attn.{p}_proj.bias"], dtype) for p in ("q", "k", "v")]
+        )
+
+    def ln(name):
+        return {
+            "scale": _stack(sd, f"layers.{{i}}.{name}.weight", L, dtype=dtype),
+            "bias": _stack(sd, f"layers.{{i}}.{name}.bias", L, dtype=dtype),
+        }
+
+    params = {
+        "embed": {
+            "wte": jnp.asarray(_to_numpy(sd["embed_tokens.weight"], dtype)),
+            "wpe": jnp.asarray(_to_numpy(sd["embed_positions.weight"], dtype)),
+        },
+        "layers": {
+            "attn": {
+                "w_qkv": jnp.asarray(np.stack([qkv_w(i) for i in range(L)])),
+                "b_qkv": jnp.asarray(np.stack([qkv_b(i) for i in range(L)])),
+                "wo": _stack(sd, "layers.{i}.self_attn.out_proj.weight", L, transpose=True, dtype=dtype),
+                "bo": _stack(sd, "layers.{i}.self_attn.out_proj.bias", L, dtype=dtype),
+            },
+            "mlp": {
+                "w_in": _stack(sd, "layers.{i}.fc1.weight", L, transpose=True, dtype=dtype),
+                "b_in": _stack(sd, "layers.{i}.fc1.bias", L, dtype=dtype),
+                "w_out": _stack(sd, "layers.{i}.fc2.weight", L, transpose=True, dtype=dtype),
+                "b_out": _stack(sd, "layers.{i}.fc2.bias", L, dtype=dtype),
+            },
+            # OPT names its pre-MLP norm "final_layer_norm" per layer.
+            "ln_1": ln("self_attn_layer_norm"),
+            "ln_2": ln("final_layer_norm"),
+        },
+        "ln_f": {
+            "scale": jnp.asarray(_to_numpy(sd["final_layer_norm.weight"], dtype)),
+            "bias": jnp.asarray(_to_numpy(sd["final_layer_norm.bias"], dtype)),
+        },
+    }
+    if not config.tie_word_embeddings:
+        params["lm_head"] = {"weight": jnp.asarray(_to_numpy(sd["lm_head.weight"], dtype).T)}
+    return params
+
+
 # ----------------------------------------------------------------- dispatcher
 _CONVERTERS = {
     "llama": (Llama, llama_config_from_hf, llama_params_from_hf),
@@ -633,6 +909,10 @@ _CONVERTERS = {
     "mistral": (Llama, llama_config_from_hf, llama_params_from_hf),
     "gemma": (Llama, gemma_config_from_hf, gemma_params_from_hf),
     "gemma2": (Llama, gemma2_config_from_hf, gemma2_params_from_hf),
+    # The classic-GPT trio behind the reference's BASELINE.md inference tables.
+    "gpt_neox": (GPTX, gpt_neox_config_from_hf, gpt_neox_params_from_hf),
+    "gptj": (GPTX, gptj_config_from_hf, gptj_params_from_hf),
+    "opt": (GPTX, opt_config_from_hf, opt_params_from_hf),
 }
 
 

@@ -1,0 +1,294 @@
+"""Classic-GPT family (GPT-NeoX / GPT-J / OPT) — HF parity and contract tests.
+
+These are the three architectures behind the reference's headline big-model
+inference tables (BASELINE.md: GPT-J-6B / GPT-NeoX-20B / OPT-30B;
+reference driver ``benchmarks/big_model_inference/big_model_inference.py``).
+Parity at tiny scale pins the whole recipe: NeoX's partial half-split rotary
+and per-head-interleaved fused QKV, GPT-J's interleaved-pair rotary and shared
+layernorm, OPT's offset learned-position table and sequential pre-LN blocks.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+def _logits_close(ours, theirs, atol):
+    ours = np.asarray(ours, np.float32)
+    theirs = theirs.detach().float().numpy()
+    np.testing.assert_allclose(ours, theirs, atol=atol, rtol=1e-3)
+
+
+@pytest.fixture(scope="module")
+def hf_neox():
+    cfg = transformers.GPTNeoXConfig(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        max_position_embeddings=64,
+        rotary_pct=0.25,  # partial rotary: 4 of 16 lanes — pins the passthrough split
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    return transformers.GPTNeoXForCausalLM(cfg).eval()
+
+
+@pytest.fixture(scope="module")
+def hf_gptj():
+    cfg = transformers.GPTJConfig(
+        vocab_size=128,
+        n_embd=64,
+        n_layer=2,
+        n_head=4,
+        n_positions=64,
+        rotary_dim=8,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(1)
+    return transformers.GPTJForCausalLM(cfg).eval()
+
+
+@pytest.fixture(scope="module")
+def hf_opt():
+    cfg = transformers.OPTConfig(
+        vocab_size=128,
+        hidden_size=64,
+        ffn_dim=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        max_position_embeddings=64,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(2)
+    return transformers.OPTForCausalLM(cfg).eval()
+
+
+# ------------------------------------------------------------- logits parity
+def test_neox_logits_match_hf(hf_neox):
+    from accelerate_tpu.models.convert import from_hf
+
+    model, params = from_hf(hf_neox)
+    assert model.config.rotary_dim == 4  # rotary_pct honored, not full-width
+    ids = np.random.default_rng(0).integers(0, 128, (2, 16)).astype(np.int32)
+    ours = model.apply(params, input_ids=ids)["logits"]
+    with torch.no_grad():
+        theirs = hf_neox(torch.tensor(ids, dtype=torch.long)).logits
+    _logits_close(ours, theirs, atol=2e-4)
+
+
+def test_neox_sequential_residual_logits_match_hf():
+    """use_parallel_residual=False NeoX checkpoints map onto the sequential
+    (OPT-topology) path of the same skeleton."""
+    from accelerate_tpu.models.convert import from_hf
+
+    cfg = transformers.GPTNeoXConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, max_position_embeddings=64,
+        rotary_pct=1.0, use_parallel_residual=False, attn_implementation="eager",
+    )
+    torch.manual_seed(3)
+    hf = transformers.GPTNeoXForCausalLM(cfg).eval()
+    model, params = from_hf(hf)
+    assert not model.config.parallel_residual
+    ids = np.random.default_rng(1).integers(0, 128, (2, 12)).astype(np.int32)
+    ours = model.apply(params, input_ids=ids)["logits"]
+    with torch.no_grad():
+        theirs = hf(torch.tensor(ids, dtype=torch.long)).logits
+    _logits_close(ours, theirs, atol=2e-4)
+
+
+def test_gptj_logits_match_hf(hf_gptj):
+    from accelerate_tpu.models.convert import from_hf
+
+    model, params = from_hf(hf_gptj)
+    assert model.config.shared_layernorm and model.config.parallel_residual
+    ids = np.random.default_rng(2).integers(0, 128, (2, 16)).astype(np.int32)
+    ours = model.apply(params, input_ids=ids)["logits"]
+    with torch.no_grad():
+        theirs = hf_gptj(torch.tensor(ids, dtype=torch.long)).logits
+    _logits_close(ours, theirs, atol=2e-4)
+
+
+def test_opt_logits_match_hf(hf_opt):
+    from accelerate_tpu.models.convert import from_hf
+
+    model, params = from_hf(hf_opt)
+    assert model.config.position_style == "learned" and model.config.position_offset == 2
+    ids = np.random.default_rng(3).integers(0, 128, (2, 16)).astype(np.int32)
+    ours = model.apply(params, input_ids=ids)["logits"]
+    with torch.no_grad():
+        theirs = hf_opt(torch.tensor(ids, dtype=torch.long)).logits
+    _logits_close(ours, theirs, atol=2e-4)
+
+
+def test_opt_masked_logits_match_hf(hf_opt):
+    """Right-padded rows: OPT derives positions from the attention mask (the
+    +2 offset table); real positions must match HF through the mask channel."""
+    from accelerate_tpu.models.convert import from_hf
+
+    model, params = from_hf(hf_opt)
+    ids = np.random.default_rng(4).integers(0, 128, (2, 12)).astype(np.int32)
+    mask = np.ones((2, 12), np.int32)
+    mask[0, 8:] = 0
+    from accelerate_tpu.generation import mask_positions
+    import jax.numpy as jnp
+
+    pos = mask_positions(jnp.asarray(mask))
+    ours = model.apply(params, input_ids=ids, attention_mask=mask, positions=pos)["logits"]
+    with torch.no_grad():
+        theirs = hf_opt(
+            torch.tensor(ids, dtype=torch.long), attention_mask=torch.tensor(mask)
+        ).logits
+    _logits_close(np.asarray(ours)[0, :8], theirs[0, :8], atol=2e-4)
+    _logits_close(np.asarray(ours)[1], theirs[1], atol=2e-4)
+
+
+# ------------------------------------------------------------------ generate
+def test_neox_generate_matches_hf_greedy(hf_neox):
+    import jax.numpy as jnp
+
+    from accelerate_tpu.generation import generate
+    from accelerate_tpu.models.convert import from_hf
+
+    model, params = from_hf(hf_neox)
+    prompt = np.random.default_rng(5).integers(0, 128, (1, 8)).astype(np.int32)
+    ours = generate(model, prompt, max_new_tokens=8, temperature=0.0,
+                    cache_dtype=jnp.float32)
+    with torch.no_grad():
+        theirs = hf_neox.generate(
+            torch.tensor(prompt, dtype=torch.long),
+            max_new_tokens=8, eos_token_id=None, do_sample=False, pad_token_id=0,
+        )
+    np.testing.assert_array_equal(np.asarray(ours)[0], theirs[0].numpy())
+
+
+def test_opt_generate_matches_hf_greedy(hf_opt):
+    import jax.numpy as jnp
+
+    from accelerate_tpu.generation import generate
+    from accelerate_tpu.models.convert import from_hf
+
+    model, params = from_hf(hf_opt)
+    prompt = np.random.default_rng(6).integers(0, 128, (1, 8)).astype(np.int32)
+    ours = generate(model, prompt, max_new_tokens=8, temperature=0.0,
+                    cache_dtype=jnp.float32)
+    with torch.no_grad():
+        theirs = hf_opt.generate(
+            torch.tensor(prompt, dtype=torch.long),
+            max_new_tokens=8, eos_token_id=None, do_sample=False, pad_token_id=0,
+        )
+    np.testing.assert_array_equal(np.asarray(ours)[0], theirs[0].numpy())
+
+
+def test_gptj_cached_decode_matches_full_forward(hf_gptj):
+    """Prefill+decode through the KV cache reproduces the full forward's
+    logits — pins the interleaved-rope positions in the cached path."""
+    import jax.numpy as jnp
+
+    from accelerate_tpu.models.convert import from_hf
+
+    model, params = from_hf(hf_gptj)
+    ids = np.random.default_rng(7).integers(0, 128, (2, 10)).astype(np.int32)
+    full = model.apply(params, input_ids=ids)["logits"]
+
+    cache = model.init_cache(2, 16, dtype=jnp.float32)
+    out = model.apply(params, input_ids=ids[:, :6], cache=cache)
+    cache = out["cache"]
+    logits = [out["logits"]]
+    for t in range(6, 10):
+        out = model.apply(params, input_ids=ids[:, t:t + 1], cache=cache)
+        cache = out["cache"]
+        logits.append(out["logits"])
+    stitched = np.concatenate([np.asarray(l) for l in logits], axis=1)
+    np.testing.assert_allclose(stitched, np.asarray(full), atol=2e-4, rtol=1e-3)
+
+
+# ------------------------------------------------------------------ training
+def test_gptx_trains_under_accelerator(hf_neox):
+    import optax
+
+    from accelerate_tpu import Accelerator, ParallelismConfig
+    from accelerate_tpu.models.convert import from_hf
+
+    acc = Accelerator(parallelism_config=ParallelismConfig(tp_size=2, fsdp_size=2, dp_size=2))
+    model, params = from_hf(hf_neox)
+    pmodel, popt = acc.prepare(model, optax.sgd(1e-2))
+    wqkv = pmodel.params["layers"]["attn"]["w_qkv"]
+    assert "tp" in jax.tree_util.tree_leaves(tuple(wqkv.sharding.spec)), wqkv.sharding
+    ids = np.random.default_rng(8).integers(0, 128, (4, 16)).astype(np.int32)
+    step = acc.build_train_step(pmodel, popt)
+    assert np.isfinite(float(step({"input_ids": ids, "labels": ids})))
+
+
+# -------------------------------------------------------------------- guards
+def test_opt_unsupported_variants_raise():
+    from accelerate_tpu.models.convert import opt_config_from_hf
+
+    base = dict(vocab_size=128, hidden_size=64, ffn_dim=128, num_hidden_layers=2,
+                num_attention_heads=4, max_position_embeddings=64)
+    with pytest.raises(ValueError, match="do_layer_norm_before"):
+        opt_config_from_hf({**base, "do_layer_norm_before": False})
+    with pytest.raises(ValueError, match="word_embed_proj_dim"):
+        opt_config_from_hf({**base, "word_embed_proj_dim": 32})
+    with pytest.raises(ValueError, match="enable_bias"):
+        opt_config_from_hf({**base, "enable_bias": False})
+
+
+def test_gptx_config_validation():
+    from accelerate_tpu.models.gptx import GPTXConfig
+
+    with pytest.raises(ValueError, match="position_style"):
+        GPTXConfig.tiny(position_style="alibi")
+    with pytest.raises(ValueError, match="rotary_dim is meaningless"):
+        GPTXConfig.tiny(position_style="learned", rotary_dim=8)
+    with pytest.raises(ValueError, match="shared_layernorm"):
+        GPTXConfig.tiny(shared_layernorm=True, parallel_residual=False)
+    with pytest.raises(ValueError, match="even"):
+        GPTXConfig.tiny(rotary_dim=7)
+
+
+def test_neox_linear_rope_scaling_logits_match_hf():
+    """Long-context NeoX checkpoints with linear rope scaling convert and
+    match HF — the scaling dict threads through to the rotary tables."""
+    from accelerate_tpu.models.convert import from_hf
+
+    cfg = transformers.GPTNeoXConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, max_position_embeddings=64,
+        rotary_pct=0.5, rope_scaling={"rope_type": "linear", "factor": 2.0},
+        attn_implementation="eager",
+    )
+    torch.manual_seed(4)
+    hf = transformers.GPTNeoXForCausalLM(cfg).eval()
+    model, params = from_hf(hf)
+    assert model.config.rope_scaling is not None
+    ids = np.random.default_rng(9).integers(0, 128, (2, 16)).astype(np.int32)
+    ours = model.apply(params, input_ids=ids)["logits"]
+    with torch.no_grad():
+        theirs = hf(torch.tensor(ids, dtype=torch.long)).logits
+    _logits_close(ours, theirs, atol=2e-4)
+
+
+def test_neox_dynamic_rope_scaling_rejected():
+    from accelerate_tpu.models.convert import gpt_neox_config_from_hf
+
+    with pytest.raises(ValueError, match="rope_type"):
+        gpt_neox_config_from_hf({
+            "vocab_size": 128, "hidden_size": 64, "intermediate_size": 128,
+            "num_hidden_layers": 2, "num_attention_heads": 4,
+            "rotary_pct": 0.5, "rope_scaling": {"rope_type": "dynamic", "factor": 2.0},
+        })
+
+
+def test_gptj_without_rotary_dim_raises():
+    from accelerate_tpu.models.convert import gptj_config_from_hf
+
+    with pytest.raises(ValueError, match="rotary_dim"):
+        gptj_config_from_hf({"vocab_size": 128, "n_embd": 64, "n_layer": 2,
+                             "n_head": 4, "rotary_dim": None})
