@@ -37,6 +37,19 @@ PRESETS = {
                            num_layers=12, num_decoder_layers=12, num_heads=12)),
     "t5-large": ("t5", dict(vocab_size=32128, d_model=1024, d_kv=64, d_ff=4096,
                             num_layers=24, num_decoder_layers=24, num_heads=16)),
+    # The reference's BASELINE.md big-model-inference trio (models/gptx.py).
+    "gpt-j-6b": ("gptx", dict(vocab_size=50400, hidden_size=4096, intermediate_size=16384,
+                              num_hidden_layers=28, num_attention_heads=16,
+                              position_style="rotary_gptj", rotary_dim=64,
+                              shared_layernorm=True, attention_bias=False, lm_head_bias=True)),
+    "gpt-neox-20b": ("gptx", dict(vocab_size=50432, hidden_size=6144, intermediate_size=24576,
+                                  num_hidden_layers=44, num_attention_heads=64,
+                                  position_style="rotary_neox", rotary_dim=24)),
+    "opt-30b": ("gptx", dict(vocab_size=50272, hidden_size=7168, intermediate_size=28672,
+                             num_hidden_layers=48, num_attention_heads=56,
+                             position_style="learned", position_offset=2,
+                             parallel_residual=False, hidden_act="relu",
+                             tie_word_embeddings=True)),
 }
 
 DTYPE_BYTES = {"float32": 4, "bf16": 2, "int8": 1, "int4": 0.5}
@@ -55,10 +68,14 @@ def _model_from_hf_config(hf: dict):
     model_type = hf.get("model_type")
     if model_type is None:
         arch = (hf.get("architectures") or [""])[0].lower()
-        for known in ("mixtral", "gemma2", "gemma", "qwen2", "mistral", "llama",
-                      "gpt2", "bert", "t5"):
+        for known, mtype in (("mixtral", "mixtral"), ("gemma2", "gemma2"),
+                             ("gemma", "gemma"), ("qwen2", "qwen2"),
+                             ("mistral", "mistral"), ("llama", "llama"),
+                             ("gptneox", "gpt_neox"), ("gptj", "gptj"),
+                             ("gpt2", "gpt2"), ("opt", "opt"),
+                             ("bert", "bert"), ("t5", "t5")):
             if known in arch:
-                model_type = known
+                model_type = mtype
                 break
     cls, config_fn, _params_fn = _get_converter(model_type)
     try:
@@ -133,6 +150,10 @@ def create_empty_model(model_name: str):
             from ..models import T5Config, T5ForConditionalGeneration
 
             model = T5ForConditionalGeneration(T5Config(**kw))
+        elif family == "gptx":
+            from ..models import GPTX, GPTXConfig
+
+            model = GPTX(GPTXConfig(**kw))
         else:
             from ..models import BertConfig, BertForSequenceClassification
 

@@ -38,19 +38,38 @@ SIZES = {
 }
 
 
-def build(size: str):
-    from accelerate_tpu.models import Llama, LlamaConfig
-
+def build(size: str, family: str = "llama"):
     h, inter, L, nh, nkv, vocab = SIZES[size]
-    cfg = LlamaConfig(
+    if family == "llama":
+        from accelerate_tpu.models import Llama, LlamaConfig
+
+        return Llama(LlamaConfig(
+            vocab_size=vocab, hidden_size=h, intermediate_size=inter,
+            num_hidden_layers=L, num_attention_heads=nh, num_key_value_heads=nkv,
+            max_position_embeddings=2048,
+        ))
+    # The baseline's own architectures (BASELINE.md tables: GPT-J / GPT-NeoX /
+    # OPT) at the scaled-down SIZES shapes — same three placement regimes.
+    from accelerate_tpu.models import GPTX, GPTXConfig
+
+    rotary_dim = max(2, (h // nh) // 4 // 2 * 2)
+    recipes = {
+        "neox": dict(position_style="rotary_neox", rotary_dim=rotary_dim),
+        "gptj": dict(position_style="rotary_gptj", rotary_dim=rotary_dim,
+                     shared_layernorm=True, attention_bias=False, lm_head_bias=True),
+        "opt": dict(position_style="learned", position_offset=2,
+                    parallel_residual=False, hidden_act="relu",
+                    tie_word_embeddings=True),
+    }
+    return GPTX(GPTXConfig(
         vocab_size=vocab, hidden_size=h, intermediate_size=inter,
-        num_hidden_layers=L, num_attention_heads=nh, num_key_value_heads=nkv,
-        max_position_embeddings=2048,
-    )
-    return Llama(cfg)
+        num_hidden_layers=L, num_attention_heads=nh,
+        max_position_embeddings=2048, **recipes[family],
+    ))
 
 
-def run_scenario(name, size, checkpoint, device_map, offload_dir, prompt_len, n_tokens):
+def run_scenario(name, size, family, checkpoint, device_map, offload_dir,
+                 prompt_len, n_tokens):
     import jax
     import jax.numpy as jnp
 
@@ -59,8 +78,11 @@ def run_scenario(name, size, checkpoint, device_map, offload_dir, prompt_len, n_
     from accelerate_tpu.generation import generate
 
     with init_empty_weights():
-        model = build(size)
+        model = build(size, family)
         model.init_params(jax.random.key(0))
+    # The dispatched model may come back wrapped (StreamedScanModel for the
+    # offload regimes) — read static facts off the bare zoo model now.
+    n_params, vocab = model.num_params(), model.config.vocab_size
 
     t0 = time.perf_counter()
     model = load_checkpoint_and_dispatch(
@@ -68,9 +90,7 @@ def run_scenario(name, size, checkpoint, device_map, offload_dir, prompt_len, n_
     )
     load_time = time.perf_counter() - t0
 
-    ids = np.random.default_rng(0).integers(
-        0, build(size).config.vocab_size, (1, prompt_len)
-    ).astype(np.int32)
+    ids = np.random.default_rng(0).integers(0, vocab, (1, prompt_len)).astype(np.int32)
 
     # Warmup (compile) with a 2-token generation, then timed run.
     generate(model, ids, max_new_tokens=2, cache_dtype=jnp.bfloat16).block_until_ready()
@@ -79,10 +99,9 @@ def run_scenario(name, size, checkpoint, device_map, offload_dir, prompt_len, n_
     out.block_until_ready()
     gen_time = time.perf_counter() - t0
 
-    n_params = build(size).num_params()
     print(json.dumps({
         "scenario": name,
-        "model": f"llama-{size}",
+        "model": f"{family}-{size}",
         "params": n_params,
         "load_time_s": round(load_time, 3),
         "s_per_token": round(gen_time / n_tokens, 4),
@@ -94,6 +113,10 @@ def run_scenario(name, size, checkpoint, device_map, offload_dir, prompt_len, n_
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("size", nargs="?", default=None, choices=list(SIZES))
+    parser.add_argument("--family", default="llama",
+                        choices=["llama", "neox", "gptj", "opt"],
+                        help="architecture recipe; neox/gptj/opt mirror the "
+                             "reference baseline's own model families")
     parser.add_argument("--tokens", type=int, default=32)
     parser.add_argument("--prompt-len", type=int, default=64)
     parser.add_argument("--scenarios", default="on_chip,cpu_offload,disk_offload")
@@ -110,22 +133,26 @@ def main():
     from accelerate_tpu.checkpointing import export_full_weights
 
     # Materialize a real checkpoint once so load time is measured honestly.
-    model = build(size)
+    model = build(size, args.family)
     model.init_params(jax.random.key(0))
     tmp = tempfile.mkdtemp(prefix="bmi_ckpt_")
     export_full_weights(model.params, tmp, max_shard_size="1GB")
+    top_keys = list(model.params)
     del model
+
+    def offload_map(where):
+        # Layer stack offloaded; every other top-level group stays HBM-resident
+        # (key names differ per family: final_norm/ln_f, optional lm_head/wpe).
+        return {k: ("tpu:0" if k != "layers" else where) for k in top_keys}
 
     scenarios = {
         "on_chip": ("auto", None),
-        "cpu_offload": ({"layers": "cpu", "embed": "tpu:0", "final_norm": "tpu:0",
-                         "lm_head": "tpu:0"}, None),
-        "disk_offload": ({"layers": "disk", "embed": "tpu:0", "final_norm": "tpu:0",
-                          "lm_head": "tpu:0"}, tempfile.mkdtemp(prefix="bmi_disk_")),
+        "cpu_offload": (offload_map("cpu"), None),
+        "disk_offload": (offload_map("disk"), tempfile.mkdtemp(prefix="bmi_disk_")),
     }
     for name in args.scenarios.split(","):
         device_map, offload_dir = scenarios[name]
-        run_scenario(name, size, tmp, device_map, offload_dir,
+        run_scenario(name, size, args.family, tmp, device_map, offload_dir,
                      args.prompt_len, args.tokens)
 
 

@@ -117,6 +117,34 @@ def test_estimate_memory_presets():
     assert "llama-7b" in PRESETS
 
 
+def test_estimate_memory_baseline_trio_presets():
+    """The reference's BASELINE.md families estimate at their published sizes."""
+    from accelerate_tpu.commands.estimate import create_empty_model
+    from accelerate_tpu.utils.modeling import calculate_maximum_sizes
+
+    for name, params_b in (("gpt-j-6b", 6.05), ("gpt-neox-20b", 20.6), ("opt-30b", 30.0)):
+        tree = create_empty_model(name)
+        total, _ = calculate_maximum_sizes(tree)
+        assert abs(total / 4e9 - params_b) / params_b < 0.05, (name, total)
+
+
+def test_estimate_memory_arch_name_fallback_gptx(tmp_path):
+    """A config.json with only `architectures` (no model_type) routes the
+    classic-GPT names through the converter registry."""
+    hf = {
+        "architectures": ["GPTNeoXForCausalLM"], "vocab_size": 128,
+        "hidden_size": 64, "intermediate_size": 128, "num_hidden_layers": 2,
+        "num_attention_heads": 4, "rotary_pct": 0.25,
+    }
+    path = tmp_path / "config.json"
+    path.write_text(json.dumps(hf))
+    from accelerate_tpu.commands.estimate import create_empty_model
+    from accelerate_tpu.utils.modeling import calculate_maximum_sizes
+
+    total, _ = calculate_maximum_sizes(create_empty_model(str(path)))
+    assert total > 0
+
+
 def test_estimate_memory_from_config_json(tmp_path):
     hf = {
         "model_type": "llama", "vocab_size": 128, "hidden_size": 16,
