@@ -58,7 +58,8 @@ DTYPE_BYTES = {"float32": 4, "bf16": 2, "int8": 1, "int4": 0.5}
 def _model_from_hf_config(hf: dict):
     """An (uninitialized) zoo model from an HF config dict, routed through the
     converter registry — one mapping shared with ``from_hf`` for every
-    supported family (llama/mistral/qwen2/gemma/gemma-2/mixtral/gpt2/bert/t5).
+    supported family (llama/mistral/qwen2/gemma/gemma-2/mixtral/gpt2/
+    gpt_neox/gptj/opt/bert/t5).
 
     Estimation needs SHAPES only, so converter numerics guards (unsupported
     activation/rope recipes) fall back to a size-keys-only Llama mapping

@@ -24,9 +24,16 @@ scheduling idea of vLLM/Orca, shaped for XLA's static-compilation model:
   between full drains, not to a single sequence. The engine raises an
   actionable error when capacity would overflow instead of corrupting state.
 
+**Prefix caching** (``set_prefix``): a prompt prefix shared by every request
+(system prompt, few-shot block, a long document) is prefilled ONCE into the
+head of the cache and stays valid for all slots across evictions — requests
+then submit only their suffixes. Prefill compute and cache columns for the
+prefix are paid once per wave instead of once per request.
+
 Correctness contract (pinned by tests/test_serving.py): in greedy mode each
 request's output is EXACTLY ``generate(model, prompt, temperature=0)`` for
-that prompt alone, regardless of how requests interleave. In sampling mode
+that prompt alone (with a prefix set: for ``prefix + suffix``), regardless of
+how requests interleave. In sampling mode
 each request draws from its own stream — ``fold_in(engine_rng, request_id)``
 folded again by step index — so a request's sampled tokens depend only on
 (engine rng, request id), not on traffic or slot assignment; they are
@@ -128,16 +135,20 @@ class ContinuousBatcher:
         self._queue: deque[_Request] = deque()
         self._next_rid = 0
         self._results: dict[int, np.ndarray] = {}
-        self._admit_fns: dict[int, object] = {}
+        self._admit_fns: dict[tuple, object] = {}
+        self._prefix_fns: dict[int, object] = {}
         self._decode_fn = None
+        self._prefix_tokens: np.ndarray | None = None
         self.reset()
 
     # ------------------------------------------------------------- lifecycle
-    def reset(self):
+    def reset(self, keep_prefix: bool = True):
         """Fresh cache and slot state. Queued (not-yet-admitted) requests and
         already-finished results survive; in-flight slots are wiped — the
         capacity-error path re-queues them first, so catch + ``reset()`` +
-        ``run()`` retries everything."""
+        ``run()`` retries everything. A shared prefix (``set_prefix``) is
+        re-prefilled automatically so the retry flow stays exact; pass
+        ``keep_prefix=False`` to drop it."""
         B = self.B
         self._cache = self.module.init_cache(B, self.C, dtype=self.cache_dtype)
         self._tok = jnp.full((B,), self.pad, jnp.int32)
@@ -151,6 +162,75 @@ class ContinuousBatcher:
         # (+bucket per admit, +sync_every per decode window), so capacity
         # checks never need a device readback.
         self._host_pos = 0
+        # Shared-prefix state: number of leading cache columns holding the
+        # common prefix (valid for every slot, never evicted).
+        self._pfx = 0
+        if keep_prefix and self._prefix_tokens is not None:
+            tokens, self._prefix_tokens = self._prefix_tokens, None
+            self.set_prefix(tokens)
+        elif not keep_prefix:
+            self._prefix_tokens = None
+
+    def set_prefix(self, prefix_ids) -> int:
+        """Shared-prefix caching: prefill ONE copy of a prompt prefix common to
+        every request (a system prompt, few-shot examples, a long document)
+        into the head of the cache, valid for all slots. Subsequent
+        ``submit()`` calls pass only each request's *suffix*; outputs are
+        exactly ``generate(model, prefix + suffix)`` per request (pinned by
+        tests/test_serving.py). The prefix occupies its length ONCE instead of
+        once per admitted request — the capacity (and prefill-compute) win of
+        vLLM-style prompt caching, shaped for the static slot scheme: prefix
+        columns sit below every admit's write offset, so slot-causal attention
+        sees them and eviction never touches them.
+
+        Must be called on a fresh cache (right after construction or
+        ``reset()``); ``reset()`` re-prefills it automatically so the
+        capacity-retry flow stays exact. Returns the prefix length."""
+        prefix = np.asarray(prefix_ids, np.int32).reshape(-1)
+        if prefix.size == 0:
+            raise ValueError("empty prefix")
+        if self._host_pos != 0 or any(r is not None for r in self._slot_req):
+            raise RuntimeError(
+                "set_prefix needs a fresh cache (no admitted requests, no "
+                "prior prefix): call reset(keep_prefix=False) first."
+            )
+        P = int(prefix.size)
+        if P + self.buckets[0] + self.max_new + self.sync_every - 1 > self.C:
+            raise ValueError(
+                f"prefix length {P} leaves no room for even one "
+                f"smallest-bucket request within max_cache_len={self.C}"
+            )
+        if P not in self._prefix_fns:
+            module = self.module
+            cache_dtype = self.cache_dtype
+
+            def fill(params, cache, ids):
+                # Prefill ONE row against a throwaway batch-1 cache of exactly
+                # the prefix length, then broadcast the resulting KV columns
+                # into every slot's row — identical state to a B-row prefill
+                # at 1/B the FLOPs (the rows would be bitwise copies).
+                mask = jnp.ones(ids.shape, jnp.int32)
+                small = module.init_cache(1, P, dtype=cache_dtype)
+                out = module.apply(params, input_ids=ids, attention_mask=mask,
+                                   cache=small, positions=mask_positions(mask))
+                sk, sv = out["cache"]["k"], out["cache"]["v"]
+                B = cache["kv_mask"].shape[0]
+                wide = lambda t: jnp.broadcast_to(t, (t.shape[0], B) + t.shape[2:])
+                return {
+                    **cache,
+                    "k": cache["k"].at[:, :, :P].set(wide(sk)),
+                    "v": cache["v"].at[:, :, :P].set(wide(sv)),
+                    "pos": cache["pos"] + P,
+                    "kv_mask": cache["kv_mask"].at[:, :P].set(1),
+                }
+
+            self._prefix_fns[P] = jax.jit(fill, donate_argnums=(1,))
+        self._cache = self._prefix_fns[P](self.params, self._cache,
+                                          jnp.asarray(prefix)[None])
+        self._host_pos = P
+        self._pfx = P
+        self._prefix_tokens = prefix
+        return P
 
     def submit(self, prompt_ids) -> int:
         """Queue one prompt (1-D array of token ids). Returns a request id."""
@@ -188,9 +268,12 @@ class ContinuousBatcher:
         """Compiled prefill of ONE slot's prompt (bucket length P): the whole
         (B, P) chunk runs so shapes stay request-independent; rows other than
         the target slot carry a zero attention mask, so their kv_mask stays
-        invalid for the written block automatically."""
-        if P in self._admit_fns:
-            return self._admit_fns[P]
+        invalid for the written block automatically. Keyed on (P, prefix
+        columns): with a shared prefix, eviction spares the prefix region and
+        token positions start past the prefix."""
+        pfx = self._pfx
+        if (P, pfx) in self._admit_fns:
+            return self._admit_fns[(P, pfx)]
         module = self.module
         pad = self.pad
 
@@ -198,13 +281,14 @@ class ContinuousBatcher:
             tok, pos, n_out, active, out_buf, keys = state
             B = tok.shape[0]
             # evict the slot's previous occupant: its KV must stop being
-            # attendable before the new prompt writes into the same row
-            cache = {**cache, "kv_mask": cache["kv_mask"].at[slot].set(0)}
+            # attendable before the new prompt writes into the same row —
+            # but the shared-prefix columns stay valid for every occupant
+            cache = {**cache, "kv_mask": cache["kv_mask"].at[slot, pfx:].set(0)}
             ids = jnp.zeros((B, P), jnp.int32).at[slot].set(prompt_row)
             mask = jnp.zeros((B, P), jnp.int32).at[slot].set(mask_row)
             out = module.apply(params, input_ids=ids, attention_mask=mask,
-                               cache=cache, positions=mask_positions(mask))
-            real_len = jnp.sum(mask_row).astype(jnp.int32)
+                               cache=cache, positions=mask_positions(mask) + pfx)
+            real_len = jnp.sum(mask_row).astype(jnp.int32) + pfx
             key = jax.random.fold_in(base_rng, rid)  # the request's own stream
             keys = keys.at[slot].set(key)
             first = self._sample_rows(
@@ -222,7 +306,7 @@ class ContinuousBatcher:
             return out["cache"], (tok, pos, n_out, active, out_buf, keys), done0
 
         fn = jax.jit(run, donate_argnums=(1, 2))
-        self._admit_fns[P] = fn
+        self._admit_fns[(P, pfx)] = fn
         return fn
 
     def _decode(self):

@@ -98,8 +98,8 @@ def test_continuous_batching_no_recompile_across_requests(llama):
     for _ in range(5):
         engine.submit(rng.integers(1, 256, (5,)).astype(np.int32))
     engine.run()
-    assert list(engine._admit_fns) == [8]
-    admit_compiles = engine._admit_fns[8]._cache_size()
+    assert list(engine._admit_fns) == [(8, 0)]  # (bucket, prefix columns)
+    admit_compiles = engine._admit_fns[(8, 0)]._cache_size()
     decode_compiles = engine._decode_fn._cache_size()
     assert admit_compiles == 1 and decode_compiles == 1
 
@@ -152,6 +152,62 @@ def test_continuous_batching_sampled_streams_are_traffic_independent(llama):
     for r in rids:
         np.testing.assert_array_equal(a[r], b[r], err_msg=f"rid {r}")
     assert any(not np.array_equal(a[r], c[r]) for r in rids)
+
+
+@pytest.mark.parametrize("family", ["llama", "gpt2"])
+def test_prefix_caching_matches_solo_concat(llama, family):
+    """set_prefix: requests submit only suffixes, and each greedy output is
+    token-identical to solo generate(prefix + suffix). GPT-2 pins the
+    absolute-position (wpe) path; slot refills cross the eviction path, so
+    exactness also proves eviction spares the prefix columns."""
+    if family == "llama":
+        model = llama
+    else:
+        model = GPT2(GPT2Config.tiny(num_hidden_layers=2))
+        model.init_params(jax.random.key(3))
+    rng = np.random.default_rng(90)
+    prefix = rng.integers(1, 256, (11,)).astype(np.int32)
+    suffixes = [rng.integers(1, 256, (n,)).astype(np.int32) for n in (4, 7, 3, 6, 5)]
+    # GPT-2's learned table caps the cache length at max_position_embeddings.
+    engine = ContinuousBatcher(model, batch_slots=2, max_new_tokens=6,
+                               max_cache_len=512 if family == "llama" else 128,
+                               cache_dtype=jnp.float32,
+                               bucket_sizes=(8,), sync_every=2)
+    assert engine.set_prefix(prefix) == 11
+    assert engine._host_pos == 11  # prefix columns paid once, not per request
+    rids = [engine.submit(s) for s in suffixes]
+    outs = engine.run()
+    for rid, s in zip(rids, suffixes):
+        ref = _solo(model, np.concatenate([prefix, s]), 6)
+        np.testing.assert_array_equal(outs[rid], ref[: len(outs[rid])], err_msg=f"rid {rid}")
+        assert all(x == 0 for x in ref[len(outs[rid]):])
+
+
+def test_prefix_caching_survives_reset_and_guards(llama):
+    """reset() re-prefills the prefix (so the capacity-retry flow stays
+    exact); reset(keep_prefix=False) drops it; set_prefix demands a fresh
+    cache and rejects degenerate lengths."""
+    rng = np.random.default_rng(91)
+    prefix = rng.integers(1, 256, (10,)).astype(np.int32)
+    engine = ContinuousBatcher(llama, batch_slots=1, max_new_tokens=4,
+                               max_cache_len=128, cache_dtype=jnp.float32,
+                               bucket_sizes=(8,))
+    engine.set_prefix(prefix)
+    with pytest.raises(RuntimeError, match="fresh cache"):
+        engine.set_prefix(prefix)  # prefix already in place
+    suffix = rng.integers(1, 256, (5,)).astype(np.int32)
+    r1 = engine.submit(suffix)
+    out1 = engine.run()[r1]
+    engine.reset()  # keep_prefix=True default: re-prefilled
+    assert engine._pfx == 10 and engine._host_pos == 10
+    r2 = engine.submit(suffix)
+    np.testing.assert_array_equal(engine.run()[r2], out1)
+    engine.reset(keep_prefix=False)
+    assert engine._pfx == 0 and engine._host_pos == 0
+    with pytest.raises(ValueError, match="empty"):
+        engine.set_prefix(np.zeros((0,), np.int32))
+    with pytest.raises(ValueError, match="no room"):
+        engine.set_prefix(np.arange(1, 125, dtype=np.int32))
 
 
 def test_continuous_batching_waves_return_only_new_results(llama):
