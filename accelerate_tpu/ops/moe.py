@@ -76,25 +76,33 @@ def _route(router_logits, k: int, capacity: int):
     return expert_idx, gate_vals, onehot, pos, keep, aux_loss
 
 
-def top_k_routing(router_logits, k: int, capacity: int):
+def top_k_routing(router_logits, k: int, capacity: int, dtype=jnp.float32):
     """Build dispatch/combine tensors from router logits (the einsum back-end).
 
-    router_logits: (B, S, E). Returns (dispatch (B,S,E,C) float, combine
-    (B,S,E,C) float, aux_loss scalar). Tokens beyond an expert's capacity are
-    dropped (their combine weights are zero → they ride the residual stream
-    only, the standard Switch behavior).
+    router_logits: (B, S, E). Returns (dispatch (B,S,E,C), combine (B,S,E,C),
+    aux_loss scalar), dispatch/combine in ``dtype``. Tokens beyond an expert's
+    capacity are dropped (their combine weights are zero → they ride the
+    residual stream only, the standard Switch behavior).
+
+    ``dtype`` sizes the C-width one-hot intermediates — the path's dominant
+    HBM traffic (the (B,S·k,E,C) slot tensor). Routing arithmetic (softmax,
+    cumsum ranks, aux) stays fp32 regardless; one-hot values are exact in any
+    float dtype, and gate values were cast to the compute dtype at the combine
+    einsum anyway, so bf16 here changes traffic, not semantics.
     """
     B, S, E = router_logits.shape
     expert_idx, gate_vals, onehot, pos, keep, aux_loss = _route(router_logits, k, capacity)
     slot = jnp.einsum(
         "bte,btec->btec",
-        keep,
-        jax.nn.one_hot(pos.astype(jnp.int32), capacity, dtype=jnp.float32),
+        keep.astype(dtype),
+        jax.nn.one_hot(pos.astype(jnp.int32), capacity, dtype=dtype),
     )
     slot = slot.reshape(B, S, k, E, capacity)
 
     dispatch = jnp.max(slot, axis=2)  # (B,S,E,C) — a token occupies ≤1 slot per expert
-    combine = jnp.einsum("bske,bskec->bsec", onehot * gate_vals[..., None], slot)
+    combine = jnp.einsum(
+        "bske,bskec->bsec", (onehot * gate_vals[..., None]).astype(dtype), slot
+    )
     return dispatch, combine, aux_loss
 
 
@@ -148,6 +156,69 @@ def moe_ffn_sorted(x, router_w, w_gate, w_up, w_down, *, k: int, capacity_factor
     return out.reshape(B, S, h), aux
 
 
+def moe_ffn_indexed(x, router_w, w_gate, w_up, w_down, *, k: int, capacity_factor: float = 1.25):
+    """Gather-based capacity-slot dispatch — dense expert matmuls without the
+    one-hot einsums OR the sorted path's scatter-add.
+
+    The einsum back-end pays two O(B·S·E·C·h) dispatch/combine matmuls
+    (~20% extra FLOPs at the bench shape) just to move tokens; the sorted
+    back-end avoids them but pays argsort + ragged_dot + a scatter-add.
+    This back-end moves tokens with *indices* instead:
+
+    1. scatter the claim ranks into a ``(B, E, C)`` slot→token index map
+       (O(S·k) elements — no C-sized one-hot ever exists),
+    2. gather tokens into ``(E, B, C, h)`` capacity slots and run the SAME
+       dense batched expert einsums as the einsum path (full MXU tiles,
+       no ragged group dim),
+    3. combine by gathering each claim's output slot and summing the k
+       gate-weighted rows — a pure gather, no scatter.
+
+    Routing memory is O(B·S·k·E + B·E·C·h) — subquadratic in S at drop-free
+    capacity, like sorted. Drop semantics are identical to both other paths
+    (same ``_route`` front-end); unfilled slots default to token 0 and compute
+    harmless padding work that the combine never reads (gate 0). Not
+    ep-shardable for the same reason as sorted: the gather indices are opaque
+    to the partitioner — ``moe_ffn`` keeps einsum under ep.
+    """
+    B, S, h = x.shape
+    E = router_w.shape[-1]
+    capacity = router_capacity(S, E, k, capacity_factor)
+    router_logits = (x @ router_w.astype(x.dtype)).astype(jnp.float32)
+    expert_idx, gate_vals, _onehot, pos, keep, aux = _route(router_logits, k, capacity)
+
+    Sk = S * k
+    e_j = expert_idx.reshape(B, Sk)  # chosen expert per claim
+    # Rank of each claim within its expert's slots, and whether it was kept.
+    p_j = jnp.take_along_axis(pos, e_j[..., None], axis=2)[..., 0].astype(jnp.int32)
+    kept_j = jnp.sum(keep, axis=-1)  # (B, Sk) ∈ {0,1}
+
+    # Slot→token map: claim j of row b sits at slot (e_j, p_j); dropped claims
+    # aim at row C (out of bounds) and are dropped by the scatter.
+    tok_j = jnp.broadcast_to((jnp.arange(Sk, dtype=jnp.int32) // k)[None], (B, Sk))
+    b_idx = jnp.broadcast_to(jnp.arange(B, dtype=jnp.int32)[:, None], (B, Sk))
+    p_sc = jnp.where(kept_j > 0, p_j, capacity)
+    slot_tok = jnp.zeros((B, E, capacity), jnp.int32).at[b_idx, e_j, p_sc].set(
+        tok_j, mode="drop"
+    )
+
+    expert_in = jnp.take_along_axis(
+        x, slot_tok.reshape(B, E * capacity)[..., None], axis=1
+    ).reshape(B, E, capacity, h).transpose(1, 0, 2, 3)  # (E, B, C, h)
+    expert_in = _constrain_expert_layout(expert_in)
+    gated = jax.nn.silu(jnp.einsum("ebch,ehi->ebci", expert_in, w_gate.astype(x.dtype)))
+    up = jnp.einsum("ebch,ehi->ebci", expert_in, w_up.astype(x.dtype))
+    expert_out = jnp.einsum("ebci,eih->ebch", gated * up, w_down.astype(x.dtype))
+
+    # Combine: gather each claim's output slot, weight by its gate (0 when
+    # dropped — the clipped gather row is then never read into the sum).
+    eo = expert_out.transpose(1, 0, 2, 3).reshape(B, E * capacity, h)
+    flat_ec = e_j * capacity + jnp.clip(p_j, 0, capacity - 1)
+    y = jnp.take_along_axis(eo, flat_ec[..., None], axis=1)  # (B, Sk, h)
+    g = (gate_vals.reshape(B, Sk) * kept_j).astype(x.dtype)
+    out = jnp.sum((y * g[..., None]).reshape(B, S, k, h), axis=2)
+    return out, aux
+
+
 def moe_ffn_einsum(x, router_w, w_gate, w_up, w_down, *, k: int, capacity_factor: float = 1.25):
     """Dense one-hot einsum MoE layer (GShard form) — the ``ep``-sharded path.
 
@@ -163,9 +234,9 @@ def moe_ffn_einsum(x, router_w, w_gate, w_up, w_down, *, k: int, capacity_factor
     E = router_w.shape[-1]
     capacity = router_capacity(S, E, k, capacity_factor)
     router_logits = (x @ router_w.astype(x.dtype)).astype(jnp.float32)
-    dispatch, combine, aux = top_k_routing(router_logits, k, capacity)
+    dispatch, combine, aux = top_k_routing(router_logits, k, capacity, dtype=x.dtype)
 
-    expert_in = jnp.einsum("bsec,bsh->ebch", dispatch.astype(x.dtype), x)
+    expert_in = jnp.einsum("bsec,bsh->ebch", dispatch, x)
     expert_in = _constrain_expert_layout(expert_in)
     gated = jax.nn.silu(jnp.einsum("ebch,ehi->ebci", expert_in, w_gate.astype(x.dtype)))
     up = jnp.einsum("ebch,ehi->ebci", expert_in, w_up.astype(x.dtype))
@@ -190,7 +261,7 @@ def moe_ffn(x, router_w, w_gate, w_up, w_down, *, k: int, capacity_factor: float
     - long sequences or drop-free capacity → **sorted** (einsum memory is
       O(S²) at Mixtral's drop-free cf = E/k).
 
-    Override with ``ACCELERATE_MOE_DISPATCH=sorted|einsum``."""
+    Override with ``ACCELERATE_MOE_DISPATCH=sorted|einsum|indexed``."""
     import os
 
     impl = os.environ.get("ACCELERATE_MOE_DISPATCH", "auto")
@@ -207,8 +278,14 @@ def moe_ffn(x, router_w, w_gate, w_up, w_down, *, k: int, capacity_factor: float
         else:
             S = x.shape[1]
             impl = "einsum" if (S <= 2048 and capacity_factor <= 2.0) else "sorted"
-    fn = moe_ffn_sorted if impl == "sorted" else moe_ffn_einsum
-    return fn(x, router_w, w_gate, w_up, w_down, k=k, capacity_factor=capacity_factor)
+    fns = {"sorted": moe_ffn_sorted, "einsum": moe_ffn_einsum,
+           "indexed": moe_ffn_indexed}
+    if impl not in fns:
+        raise ValueError(
+            f"ACCELERATE_MOE_DISPATCH={impl!r} is not a dispatch back-end "
+            f"(valid: auto|{'|'.join(sorted(fns))})"
+        )
+    return fns[impl](x, router_w, w_gate, w_up, w_down, k=k, capacity_factor=capacity_factor)
 
 
 def _constrain_expert_layout(t):

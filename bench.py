@@ -123,10 +123,11 @@ def main():
         # MoE datapoint (VERDICT r3 ask #2): 8-expert, top-2, Mixtral-style
         # sparsity at bench scale (946M total / ~330M active per token). The
         # auto dispatch resolves to the einsum back-end at this shape — it
-        # measured 33.9% vs sorted ragged_dot's 25.5% on v5e (PERF.md; run
-        # with ACCELERATE_MOE_DISPATCH=sorted for the grouped-matmul path).
-        # MFU counts ACTIVE FLOPs only (router + k experts), the standard
-        # MoE accounting.
+        # measured 37.8% at batch 16 vs indexed 32.9 / sorted 25.5 on v5e
+        # (PERF.md; ACCELERATE_MOE_DISPATCH overrides, BENCH_MOE_BATCH /
+        # BENCH_MOE_REMAT sweep the envelope: b8 33.5, b16 37.8, b20 37.5,
+        # b24 and remat-off OOM at compile). MFU counts ACTIVE FLOPs only
+        # (router + k experts), the standard MoE accounting.
         from accelerate_tpu.models import MoELlamaConfig
 
         metric_name = "moe8e_train_mfu_per_chip"
@@ -141,10 +142,10 @@ def main():
             num_experts=8,
             moe_top_k=2,
             capacity_factor=1.25,
-            remat=True,
+            remat=os.environ.get("BENCH_MOE_REMAT", "1") == "1",
             remat_policy="dots_with_no_batch_dims_saveable",
         )
-        batch, seq, steps, warmup = 8, 1024, 20, 3
+        batch, seq, steps, warmup = int(os.environ.get("BENCH_MOE_BATCH", "16")), 1024, 20, 3
     elif mode == "340m":
         metric_name = "llama340m_train_mfu_per_chip"
         cfg = LlamaConfig(

@@ -155,6 +155,63 @@ def test_sorted_and_einsum_dispatch_agree():
         np.testing.assert_allclose(float(aux_s), float(aux_e), rtol=1e-6)
 
 
+def test_indexed_dispatch_agrees_and_grads_match():
+    """The gather-based capacity-slot path (moe_ffn_indexed) is a third
+    implementation of the same routing semantics: outputs bit-match the
+    einsum path in fp32 (same dense expert einsums, exact index moves) and
+    gradients agree — droppy and drop-free regimes both."""
+    from accelerate_tpu.ops.moe import moe_ffn_einsum, moe_ffn_indexed
+
+    rng = np.random.default_rng(1)
+    B, S, h, i, E, k = 2, 16, 8, 16, 4, 2
+    x = jnp.asarray(rng.standard_normal((B, S, h)), jnp.float32)
+    router = jnp.asarray(rng.standard_normal((h, E)), jnp.float32)
+    wg = jnp.asarray(rng.standard_normal((E, h, i)) * 0.1, jnp.float32)
+    wu = jnp.asarray(rng.standard_normal((E, h, i)) * 0.1, jnp.float32)
+    wd = jnp.asarray(rng.standard_normal((E, i, h)) * 0.1, jnp.float32)
+    for cf in (1.0, float(E) / k):
+        out_i, aux_i = moe_ffn_indexed(x, router, wg, wu, wd, k=k, capacity_factor=cf)
+        out_e, aux_e = moe_ffn_einsum(x, router, wg, wu, wd, k=k, capacity_factor=cf)
+        np.testing.assert_allclose(np.asarray(out_i), np.asarray(out_e), atol=1e-6)
+        np.testing.assert_allclose(float(aux_i), float(aux_e), rtol=1e-6)
+
+    def loss(fn, w):
+        o, a = fn(x, router, w, wu, wd, k=k, capacity_factor=1.25)
+        return jnp.sum(o ** 2) + a
+
+    gi = jax.grad(lambda w: loss(moe_ffn_indexed, w))(wg)
+    ge = jax.grad(lambda w: loss(moe_ffn_einsum, w))(wg)
+    np.testing.assert_allclose(np.asarray(gi), np.asarray(ge), atol=1e-6)
+
+
+def test_indexed_dispatch_memory_is_subquadratic():
+    """Like the sorted path, indexed never materializes a (S,E,C)-shaped
+    one-hot: at drop-free capacity its biggest routing buffer is the
+    (E, C, h) slot store, linear in S."""
+    import re
+
+    from accelerate_tpu.ops.moe import moe_ffn_indexed
+
+    B, S, h, i, E, k = 1, 2048, 64, 128, 8, 2
+    cf = float(E) / k  # drop-free: einsum dispatch would be (B,S,E,S·k/E·cf) ≈ S²
+    x = jax.ShapeDtypeStruct((B, S, h), jnp.float32)
+    router = jax.ShapeDtypeStruct((h, E), jnp.float32)
+    wg = jax.ShapeDtypeStruct((E, h, i), jnp.float32)
+    wd = jax.ShapeDtypeStruct((E, i, h), jnp.float32)
+    hlo = jax.jit(
+        lambda x, r, g, u, d: moe_ffn_indexed(x, r, g, u, d, k=k, capacity_factor=cf)
+    ).lower(x, router, wg, wg, wd).compile().as_text()
+    # The einsum path's dispatch one-hot at drop-free capacity: C = S·k·cf/E
+    # = S, so (B,S,E,C) is B·S²·E elements. The indexed path's biggest buffer
+    # is the (E,B,C,i) expert intermediate — linear in S.
+    quadratic = B * S * E * S
+    biggest = 0
+    for shape in re.findall(r"\w+\[([0-9,]+)\]", hlo):
+        n = int(np.prod([int(d) for d in shape.split(",")]))
+        biggest = max(biggest, n)
+    assert 0 < biggest < quadratic // 4, (biggest, quadratic)
+
+
 def test_sorted_dispatch_memory_is_subquadratic():
     """At S=2048/E=8 with Mixtral's drop-free capacity, the einsum path's
     dispatch tensor is (B,S,E,C≈S) ≈ 34M elements; the sorted path must
