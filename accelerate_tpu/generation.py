@@ -757,13 +757,17 @@ def generate(
                 "sequence."
             )
         n = num_return_sequences
-        input_ids = jnp.repeat(jnp.asarray(input_ids, jnp.int32), n, axis=0)
+        input_ids = jnp.repeat(jnp.asarray(input_ids), n, axis=0)
         if attention_mask is not None:
             attention_mask = jnp.repeat(jnp.asarray(attention_mask, jnp.int32), n, axis=0)
         num_return_sequences = 1
 
-    input_ids = jnp.asarray(input_ids, jnp.int32)
-    B, S = input_ids.shape
+    # Token prompts cast to int32; float arrays pass through unchanged — an
+    # encoder-decoder's "prompt" may be continuous encoder input (Whisper's
+    # (B, n_mels, T) log-mel features).
+    input_ids = jnp.asarray(input_ids)
+    if jnp.issubdtype(input_ids.dtype, jnp.integer):
+        input_ids = input_ids.astype(jnp.int32)
     if attention_mask is not None:
         attention_mask = jnp.asarray(attention_mask, jnp.int32)
     if rng is None:
@@ -788,6 +792,7 @@ def generate(
         # model's own pad-mask default, keeping one implementation.
         return fn(params, input_ids, attention_mask, rng)
 
+    B, S = input_ids.shape
     if isinstance(model, StreamedScanModel):
         new_tokens = _generate_streamed(
             model, input_ids, attention_mask, max_new_tokens,

@@ -33,6 +33,7 @@ from .gptx import GPTX, GPTXConfig
 from .llama import Llama, LlamaConfig
 from .moe import MoELlama, MoELlamaConfig
 from .t5 import T5Config, T5ForConditionalGeneration
+from .whisper import WhisperConfig, WhisperForConditionalGeneration
 
 
 def _to_numpy(t, dtype=None) -> np.ndarray:
@@ -896,6 +897,101 @@ def opt_params_from_hf(state_dict, config: GPTXConfig, dtype=jnp.float32) -> dic
     return params
 
 
+# -------------------------------------------------------------------- whisper
+def whisper_config_from_hf(hf_config) -> WhisperConfig:
+    """Whisper (audio seq2seq; HF ``WhisperForConditionalGeneration``)."""
+    get = _getter(hf_config)
+    act = get("activation_function", "gelu")
+    if act != "gelu":
+        raise ValueError(f"activation_function={act!r} is not supported (Whisper uses exact gelu)")
+    if get("scale_embedding"):
+        raise ValueError("scale_embedding=True Whisper variants are not supported")
+    if get("tie_word_embeddings", True) is False:
+        raise ValueError("untied-head Whisper variants are not supported (proj_out is tied)")
+    return WhisperConfig(
+        vocab_size=get("vocab_size"),
+        num_mel_bins=get("num_mel_bins", 80),
+        d_model=get("d_model"),
+        encoder_layers=get("encoder_layers"),
+        encoder_attention_heads=get("encoder_attention_heads"),
+        decoder_layers=get("decoder_layers"),
+        decoder_attention_heads=get("decoder_attention_heads"),
+        encoder_ffn_dim=get("encoder_ffn_dim"),
+        decoder_ffn_dim=get("decoder_ffn_dim"),
+        max_source_positions=get("max_source_positions", 1500),
+        max_target_positions=get("max_target_positions", 448),
+        decoder_start_token_id=get("decoder_start_token_id", 50257),
+        pad_token_id=get("pad_token_id", 50256),
+        eos_token_id=get("eos_token_id", 50256),
+    )
+
+
+def whisper_params_from_hf(state_dict, config, dtype=jnp.float32) -> dict:
+    sd = _normalize_keys(state_dict)  # strips the "model." wrapper
+
+    def attn(side, L, name):
+        p = {
+            "wq": _stack(sd, f"{side}.layers.{{i}}.{name}.q_proj.weight", L, transpose=True, dtype=dtype),
+            "bq": _stack(sd, f"{side}.layers.{{i}}.{name}.q_proj.bias", L, dtype=dtype),
+            "wk": _stack(sd, f"{side}.layers.{{i}}.{name}.k_proj.weight", L, transpose=True, dtype=dtype),
+            "wv": _stack(sd, f"{side}.layers.{{i}}.{name}.v_proj.weight", L, transpose=True, dtype=dtype),
+            "bv": _stack(sd, f"{side}.layers.{{i}}.{name}.v_proj.bias", L, dtype=dtype),
+            "wo": _stack(sd, f"{side}.layers.{{i}}.{name}.out_proj.weight", L, transpose=True, dtype=dtype),
+            "bo": _stack(sd, f"{side}.layers.{{i}}.{name}.out_proj.bias", L, dtype=dtype),
+        }
+        return p
+
+    def ln(side, L, name):
+        return {
+            "scale": _stack(sd, f"{side}.layers.{{i}}.{name}.weight", L, dtype=dtype),
+            "bias": _stack(sd, f"{side}.layers.{{i}}.{name}.bias", L, dtype=dtype),
+        }
+
+    def mlp(side, L):
+        return {
+            "w_in": _stack(sd, f"{side}.layers.{{i}}.fc1.weight", L, transpose=True, dtype=dtype),
+            "b_in": _stack(sd, f"{side}.layers.{{i}}.fc1.bias", L, dtype=dtype),
+            "w_out": _stack(sd, f"{side}.layers.{{i}}.fc2.weight", L, transpose=True, dtype=dtype),
+            "b_out": _stack(sd, f"{side}.layers.{{i}}.fc2.bias", L, dtype=dtype),
+        }
+
+    def top_ln(key):
+        return {"scale": jnp.asarray(_to_numpy(sd[f"{key}.weight"], dtype)),
+                "bias": jnp.asarray(_to_numpy(sd[f"{key}.bias"], dtype))}
+
+    Le, Ld = config.encoder_layers, config.decoder_layers
+    # torch Conv1d stores (out, in, K); ours is (K, in, out).
+    conv = lambda k: {"w": jnp.asarray(_to_numpy(sd[f"{k}.weight"], dtype).transpose(2, 1, 0)),
+                      "b": jnp.asarray(_to_numpy(sd[f"{k}.bias"], dtype))}
+    return {
+        "encoder": {
+            "conv1": conv("encoder.conv1"),
+            "conv2": conv("encoder.conv2"),
+            "pos": jnp.asarray(_to_numpy(sd["encoder.embed_positions.weight"], dtype)),
+            "layers": {
+                "self_attn": attn("encoder", Le, "self_attn"),
+                "self_norm": ln("encoder", Le, "self_attn_layer_norm"),
+                "mlp": mlp("encoder", Le),
+                "mlp_norm": ln("encoder", Le, "final_layer_norm"),
+            },
+            "final_norm": top_ln("encoder.layer_norm"),
+        },
+        "decoder": {
+            "embed": jnp.asarray(_to_numpy(sd["decoder.embed_tokens.weight"], dtype)),
+            "pos": jnp.asarray(_to_numpy(sd["decoder.embed_positions.weight"], dtype)),
+            "layers": {
+                "self_attn": attn("decoder", Ld, "self_attn"),
+                "self_norm": ln("decoder", Ld, "self_attn_layer_norm"),
+                "cross_attn": attn("decoder", Ld, "encoder_attn"),
+                "cross_norm": ln("decoder", Ld, "encoder_attn_layer_norm"),
+                "mlp": mlp("decoder", Ld),
+                "mlp_norm": ln("decoder", Ld, "final_layer_norm"),
+            },
+            "final_norm": top_ln("decoder.layer_norm"),
+        },
+    }
+
+
 # ----------------------------------------------------------------- dispatcher
 _CONVERTERS = {
     "llama": (Llama, llama_config_from_hf, llama_params_from_hf),
@@ -913,6 +1009,8 @@ _CONVERTERS = {
     "gpt_neox": (GPTX, gpt_neox_config_from_hf, gpt_neox_params_from_hf),
     "gptj": (GPTX, gptj_config_from_hf, gptj_params_from_hf),
     "opt": (GPTX, opt_config_from_hf, opt_params_from_hf),
+    "whisper": (WhisperForConditionalGeneration, whisper_config_from_hf,
+                whisper_params_from_hf),
 }
 
 

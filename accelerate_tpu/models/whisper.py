@@ -1,0 +1,398 @@
+"""Whisper — speech-to-text encoder-decoder (audio model family).
+
+The reference's big-model machinery is modality-agnostic (device_map dispatch
+and generate work for any transformers model); this gives the zoo an audio
+family so that claim holds here too. Same seq2seq protocol as T5
+(``encode``/``decode``/``init_cache``/``precompute_cross_kv``), so
+``generate()`` drives it unchanged; same TPU-first skeleton as the decoders
+(stacked-layer ``lax.scan``, Megatron tp rules, fp32 norms/logits).
+
+Architecture (OpenAI Whisper, HF ``WhisperForConditionalGeneration``):
+
+- **Encoder**: log-mel features (B, n_mels, T) → two gelu Conv1d's (the second
+  stride-2) → add a FIXED sinusoidal position table (stored in the checkpoint,
+  so it converts as a weight) → pre-LN self-attention layers → final norm.
+- **Decoder**: token embedding + LEARNED positions (indexed by absolute
+  position — the decode cache offsets them), pre-LN blocks of causal
+  self-attention, cross-attention over the encoder output, gelu MLP.
+- Quirk pinned by parity tests: ``k_proj`` carries NO bias while q/v/out do.
+- Head: tied to the token embedding, no bias.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..modules import ModelOutput, Module
+from ..ops.attention import attention as _attention, cached_attention
+from ..ops.losses import cross_entropy_loss
+
+
+def _layer_norm(x, scale, bias, eps=1e-5):
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=-1, keepdims=True)
+    return ((x - mean) * jax.lax.rsqrt(var + eps) * scale + bias).astype(dtype)
+
+
+@dataclass
+class WhisperConfig:
+    vocab_size: int = 51865
+    num_mel_bins: int = 80
+    d_model: int = 384
+    encoder_layers: int = 4
+    encoder_attention_heads: int = 6
+    decoder_layers: int = 4
+    decoder_attention_heads: int = 6
+    encoder_ffn_dim: int = 1536
+    decoder_ffn_dim: int = 1536
+    max_source_positions: int = 1500  # AFTER the stride-2 conv
+    max_target_positions: int = 448
+    decoder_start_token_id: int = 50257
+    pad_token_id: int = 50256
+    eos_token_id: int = 50256
+    layer_norm_eps: float = 1e-5
+    attention_impl: str = "auto"
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.encoder_attention_heads
+
+    def __post_init__(self):
+        if self.encoder_attention_heads != self.decoder_attention_heads:
+            raise ValueError("encoder/decoder head counts must match (Whisper ties them)")
+
+    @classmethod
+    def tiny(cls, **kw):
+        defaults = dict(
+            vocab_size=256, num_mel_bins=8, d_model=64,
+            encoder_layers=2, encoder_attention_heads=4,
+            decoder_layers=2, decoder_attention_heads=4,
+            encoder_ffn_dim=128, decoder_ffn_dim=128,
+            max_source_positions=32, max_target_positions=32,
+            decoder_start_token_id=1, pad_token_id=0, eos_token_id=2,
+        )
+        defaults.update(kw)
+        return cls(**defaults)
+
+
+class WhisperForConditionalGeneration(Module):
+    def __init__(self, config: WhisperConfig):
+        self.config = config
+        self.params = None
+
+    # ------------------------------------------------------------------- init
+    def _attn_params(self, key, L, h):
+        ks = jax.random.split(key, 4)
+        d = lambda k, shape, fan: (jax.random.normal(k, shape, jnp.float32) / np.sqrt(fan))
+        return {
+            "wq": d(ks[0], (L, h, h), h), "bq": jnp.zeros((L, h), jnp.float32),
+            "wk": d(ks[1], (L, h, h), h),  # no bias — the Whisper quirk
+            "wv": d(ks[2], (L, h, h), h), "bv": jnp.zeros((L, h), jnp.float32),
+            "wo": d(ks[3], (L, h, h), h), "bo": jnp.zeros((L, h), jnp.float32),
+        }
+
+    def _side(self, key, L, h, ffn, cross: bool):
+        ks = jax.random.split(key, 4)
+        d = lambda k, shape, fan: (jax.random.normal(k, shape, jnp.float32) / np.sqrt(fan))
+        ln = lambda: {"scale": jnp.ones((L, h), jnp.float32), "bias": jnp.zeros((L, h), jnp.float32)}
+        layers = {
+            "self_attn": self._attn_params(ks[0], L, h),
+            "self_norm": ln(),
+            "mlp": {
+                "w_in": d(ks[1], (L, h, ffn), h), "b_in": jnp.zeros((L, ffn), jnp.float32),
+                "w_out": d(ks[2], (L, ffn, h), ffn), "b_out": jnp.zeros((L, h), jnp.float32),
+            },
+            "mlp_norm": ln(),
+        }
+        if cross:
+            layers["cross_attn"] = self._attn_params(ks[3], L, h)
+            layers["cross_norm"] = ln()
+        return layers
+
+    @staticmethod
+    def _sinusoids(length: int, channels: int) -> np.ndarray:
+        """Whisper's fixed encoder position table (checkpoints store it, so a
+        fresh init must match the same formula)."""
+        log_timescale = np.log(10000.0) / (channels // 2 - 1)
+        inv = np.exp(-log_timescale * np.arange(channels // 2))
+        angles = np.arange(length)[:, None] * inv[None]
+        return np.concatenate([np.sin(angles), np.cos(angles)], axis=1).astype(np.float32)
+
+    def init(self, rng, *example_inputs, **kwargs):
+        cfg = self.config
+        h = cfg.d_model
+        keys = jax.random.split(rng, 8)
+        d = lambda k, shape, fan: (jax.random.normal(k, shape, jnp.float32) / np.sqrt(fan))
+        ln = lambda: {"scale": jnp.ones((h,), jnp.float32), "bias": jnp.zeros((h,), jnp.float32)}
+        return {
+            "encoder": {
+                "conv1": {"w": d(keys[0], (3, cfg.num_mel_bins, h), 3 * cfg.num_mel_bins),
+                          "b": jnp.zeros((h,), jnp.float32)},
+                "conv2": {"w": d(keys[1], (3, h, h), 3 * h),
+                          "b": jnp.zeros((h,), jnp.float32)},
+                "pos": jnp.asarray(self._sinusoids(cfg.max_source_positions, h)),
+                "layers": self._side(keys[2], cfg.encoder_layers, h, cfg.encoder_ffn_dim, cross=False),
+                "final_norm": ln(),
+            },
+            "decoder": {
+                "embed": d(keys[3], (cfg.vocab_size, h), h),
+                "pos": d(keys[4], (cfg.max_target_positions, h), h),
+                "layers": self._side(keys[5], cfg.decoder_layers, h, cfg.decoder_ffn_dim, cross=True),
+                "final_norm": ln(),
+            },
+        }
+
+    # --------------------------------------------------------------- sharding
+    def sharding_rules(self):
+        return [
+            (r"decoder/embed", P("tp", "fsdp")),
+            (r"decoder/pos", P(None, "fsdp")),
+            (r"encoder/pos", P(None, "fsdp")),
+            (r"attn/w[qkv]", P(None, "fsdp", "tp")),
+            (r"attn/b[qv]", P(None, "tp")),
+            (r"attn/wo", P(None, "tp", "fsdp")),
+            (r"mlp/w_in", P(None, "fsdp", "tp")),
+            (r"mlp/b_in", P(None, "tp")),
+            (r"mlp/w_out", P(None, "tp", "fsdp")),
+            (r"conv", P()),
+            (r"norm", P()),
+        ]
+
+    # --------------------------------------------------------------- building blocks
+    def _attend(self, x, kv, attn, nh, mask_bias=None, causal=False):
+        """Standard MHA; ``kv`` is ``x`` for self-attention or the encoder
+        output for cross-attention. ``mask_bias`` is fp32 additive, broadcast
+        against (B, nh, T, K) scores."""
+        B, T, h = x.shape
+        K = kv.shape[1]
+        hd = h // nh
+        q = (x @ attn["wq"] + attn["bq"]).reshape(B, T, nh, hd)
+        k = (kv @ attn["wk"]).reshape(B, K, nh, hd)
+        v = (kv @ attn["wv"] + attn["bv"]).reshape(B, K, nh, hd)
+        if T == K and mask_bias is None:
+            out = _attention(q, k, v, causal=causal, mask=None,
+                             impl=self.config.attention_impl)
+        else:
+            scores = jnp.einsum("bthd,bkhd->bhtk", q, k).astype(jnp.float32)
+            scores = scores * (hd ** -0.5)
+            if causal and T == K:
+                scores = jnp.where(
+                    jnp.tril(jnp.ones((T, T), bool))[None, None], scores, -1e30
+                )
+            if mask_bias is not None:
+                scores = scores + mask_bias
+            probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+            out = jnp.einsum("bhtk,bkhd->bthd", probs, v)
+        return out.reshape(B, T, h) @ attn["wo"] + attn["bo"]
+
+    def _block(self, layer, x, enc_out, nh, eps, cross: bool, causal: bool,
+               enc_bias=None, self_bias=None):
+        z = _layer_norm(x, layer["self_norm"]["scale"], layer["self_norm"]["bias"], eps)
+        x = x + self._attend(z, z, layer["self_attn"], nh, mask_bias=self_bias,
+                             causal=causal)
+        if cross:
+            z = _layer_norm(x, layer["cross_norm"]["scale"], layer["cross_norm"]["bias"], eps)
+            x = x + self._attend(z, enc_out, layer["cross_attn"], nh, mask_bias=enc_bias)
+        z = _layer_norm(x, layer["mlp_norm"]["scale"], layer["mlp_norm"]["bias"], eps)
+        mid = jax.nn.gelu(z @ layer["mlp"]["w_in"] + layer["mlp"]["b_in"], approximate=False)
+        x = x + mid @ layer["mlp"]["w_out"] + layer["mlp"]["b_out"]
+        return x
+
+    # ----------------------------------------------------------------- encoder
+    def encode(self, params, input_features, attention_mask=None):
+        """Log-mel features (B, n_mels, T) → encoder states (B, T//2, d).
+        Whisper encoders attend the full (fixed-length) window — the returned
+        mask is all-ones, present only to satisfy the seq2seq protocol."""
+        cfg = self.config
+        enc = params["encoder"]
+        x = jnp.transpose(input_features, (0, 2, 1))  # (B, T, n_mels)
+        dn = ("NHC", "HIO", "NHC")  # 1-D conv over the time axis
+        x = jax.nn.gelu(jax.lax.conv_general_dilated(
+            x, enc["conv1"]["w"].astype(x.dtype), (1,), ((1, 1),),
+            dimension_numbers=dn) + enc["conv1"]["b"], approximate=False)
+        x = jax.nn.gelu(jax.lax.conv_general_dilated(
+            x, enc["conv2"]["w"].astype(x.dtype), (2,), ((1, 1),),
+            dimension_numbers=dn) + enc["conv2"]["b"], approximate=False)
+        S = x.shape[1]
+        if S > cfg.max_source_positions:
+            raise ValueError(
+                f"encoder sequence {S} (after stride-2) exceeds "
+                f"max_source_positions {cfg.max_source_positions}")
+        x = x + enc["pos"][:S].astype(x.dtype)
+        nh, eps = cfg.encoder_attention_heads, cfg.layer_norm_eps
+
+        def step(x, layer):
+            return self._block(layer, x, None, nh, eps, cross=False, causal=False), None
+
+        x, _ = jax.lax.scan(step, x, enc["layers"])
+        x = _layer_norm(x, enc["final_norm"]["scale"], enc["final_norm"]["bias"], eps)
+        # HF Whisper ignores encoder attention masks (fixed 30s windows); a
+        # user-supplied mask is at mel-frame length, NOT the stride-2 output
+        # length, so passing it through would break cross-attention. Always
+        # return the all-ones mask at the encoder's own length.
+        return x, jnp.ones(x.shape[:2], jnp.int32)
+
+    # ----------------------------------------------------------------- decoder
+    def _decoder_stack(self, params, y, enc_out, enc_bias=None, self_bias=None):
+        cfg = self.config
+        nh, eps = cfg.decoder_attention_heads, cfg.layer_norm_eps
+        dec = params["decoder"]
+
+        def step(y, layer):
+            return self._block(layer, y, enc_out, nh, eps, cross=True,
+                               causal=True, enc_bias=enc_bias,
+                               self_bias=self_bias), None
+
+        y, _ = jax.lax.scan(step, y, dec["layers"])
+        return _layer_norm(y, dec["final_norm"]["scale"], dec["final_norm"]["bias"], eps)
+
+    def _head(self, params, y):
+        return (y @ params["decoder"]["embed"].T.astype(y.dtype)).astype(jnp.float32)
+
+    def _shift_right(self, labels):
+        cfg = self.config
+        start = jnp.full((labels.shape[0], 1), cfg.decoder_start_token_id, labels.dtype)
+        shifted = jnp.concatenate([start, labels[:, :-1]], axis=1)
+        return jnp.where(shifted == -100, cfg.pad_token_id, shifted)
+
+    def apply(
+        self,
+        params,
+        input_features=None,
+        attention_mask=None,
+        decoder_input_ids=None,
+        decoder_attention_mask=None,
+        labels=None,
+        train: bool = False,
+        rngs=None,
+        **kwargs,
+    ):
+        if input_features is None:
+            input_features = kwargs.get("input_ids")  # protocol alias
+        if decoder_input_ids is None:
+            if labels is None:
+                raise ValueError("Need decoder_input_ids or labels")
+            decoder_input_ids = self._shift_right(labels)
+        enc_out, _mask = self.encode(params, input_features, attention_mask)
+        y = jnp.take(params["decoder"]["embed"], decoder_input_ids, axis=0)
+        T = decoder_input_ids.shape[1]
+        y = (y + params["decoder"]["pos"][:T]).astype(enc_out.dtype)
+        self_bias = None
+        if decoder_attention_mask is not None:
+            self_bias = jnp.where(
+                decoder_attention_mask[:, None, None, :].astype(bool), 0.0, -1e30
+            ).astype(jnp.float32)
+        y = self._decoder_stack(params, y, enc_out, self_bias=self_bias)
+        logits = self._head(params, y)
+        out = ModelOutput(logits=logits, encoder_last_hidden_state=enc_out)
+        if labels is not None:
+            # HF convention: labels arrive pre-masked with -100. Do NOT mask
+            # pad_token_id here — real Whisper checkpoints have
+            # pad_token_id == eos_token_id, so that would silently erase the
+            # EOS supervision (T5's pad != eos makes the pattern safe there).
+            out["loss"] = cross_entropy_loss(logits, labels)
+        return out
+
+    # ------------------------------------------------------------- generation
+    def init_cache(self, batch_size: int, max_len: int, dtype=jnp.bfloat16):
+        cfg = self.config
+        if max_len > cfg.max_target_positions:
+            raise ValueError(
+                f"cache length {max_len} exceeds max_target_positions "
+                f"{cfg.max_target_positions} (learned decoder positions)")
+        shape = (cfg.decoder_layers, batch_size, max_len,
+                 cfg.decoder_attention_heads, cfg.head_dim)
+        return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype),
+                "pos": jnp.zeros((), jnp.int32)}
+
+    def precompute_cross_kv(self, params, enc_out):
+        """Cross-attention K/V per decoder layer, computed once per generation.
+        Returns arrays (L, B, S, nh, hd)."""
+        cfg = self.config
+        nh, hd = cfg.decoder_attention_heads, cfg.head_dim
+        B, S, _ = enc_out.shape
+        ca = params["decoder"]["layers"]["cross_attn"]
+        ck = jnp.einsum("bsh,lhi->lbsi", enc_out, ca["wk"]).reshape(-1, B, S, nh, hd)
+        cv = (jnp.einsum("bsh,lhi->lbsi", enc_out, ca["wv"])
+              + ca["bv"][:, None, None, :]).reshape(-1, B, S, nh, hd)
+        return ck, cv
+
+    def decode(self, params, decoder_input_ids, cache, enc_out, enc_attention_mask,
+               cross_kv=None):
+        """One cached decoder chunk (prefill or single step): self-attention
+        through the cache, cross-attention against precomputed encoder K/V."""
+        cfg = self.config
+        B, Tc = decoder_input_ids.shape
+        nh, hd, eps = cfg.decoder_attention_heads, cfg.head_dim, cfg.layer_norm_eps
+        pos = cache["pos"]
+        if cross_kv is None:
+            cross_kv = self.precompute_cross_kv(params, enc_out)
+        ck, cv = cross_kv
+        enc_bias = None
+        if enc_attention_mask is not None:
+            enc_bias = jnp.where(
+                enc_attention_mask[:, None, None, :].astype(bool), 0.0, -1e30
+            ).astype(jnp.float32)
+
+        positions = pos + jnp.arange(Tc, dtype=jnp.int32)
+        y = jnp.take(params["decoder"]["embed"], decoder_input_ids, axis=0)
+        y = y + jnp.take(params["decoder"]["pos"], positions, axis=0)
+        y = y.astype(params["decoder"]["embed"].dtype)
+        q_positions = jnp.broadcast_to(positions[None], (B, Tc))
+
+        dec = params["decoder"]
+
+        def step(y, inp):
+            layer, k_cache, v_cache, lck, lcv = inp
+            z = _layer_norm(y, layer["self_norm"]["scale"], layer["self_norm"]["bias"], eps)
+            q = (z @ layer["self_attn"]["wq"] + layer["self_attn"]["bq"]).reshape(B, Tc, nh, hd)
+            k = (z @ layer["self_attn"]["wk"]).reshape(B, Tc, nh, hd)
+            v = (z @ layer["self_attn"]["wv"] + layer["self_attn"]["bv"]).reshape(B, Tc, nh, hd)
+            k_cache = jax.lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype), (0, pos, 0, 0))
+            v_cache = jax.lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype), (0, pos, 0, 0))
+            attn = cached_attention(q, k_cache, v_cache, q_positions=q_positions)
+            y = y + (attn.reshape(B, Tc, -1) @ layer["self_attn"]["wo"] + layer["self_attn"]["bo"])
+            z = _layer_norm(y, layer["cross_norm"]["scale"], layer["cross_norm"]["bias"], eps)
+            qc = (z @ layer["cross_attn"]["wq"] + layer["cross_attn"]["bq"]).reshape(B, Tc, nh, hd)
+            scores = jnp.einsum("bthd,bkhd->bhtk", qc, lck.astype(qc.dtype)) * (hd ** -0.5)
+            scores = scores.astype(jnp.float32)
+            if enc_bias is not None:
+                scores = scores + enc_bias
+            probs = jax.nn.softmax(scores, axis=-1).astype(y.dtype)
+            a = jnp.einsum("bhtk,bkhd->bthd", probs, lcv.astype(y.dtype))
+            y = y + (a.reshape(B, Tc, -1) @ layer["cross_attn"]["wo"] + layer["cross_attn"]["bo"])
+            z = _layer_norm(y, layer["mlp_norm"]["scale"], layer["mlp_norm"]["bias"], eps)
+            mid = jax.nn.gelu(z @ layer["mlp"]["w_in"] + layer["mlp"]["b_in"], approximate=False)
+            y = y + mid @ layer["mlp"]["w_out"] + layer["mlp"]["b_out"]
+            return y, (k_cache, v_cache)
+
+        y, (nk, nv) = jax.lax.scan(step, y, (dec["layers"], cache["k"], cache["v"], ck, cv))
+        y = _layer_norm(y, dec["final_norm"]["scale"], dec["final_norm"]["bias"], eps)
+        return ModelOutput(
+            logits=self._head(params, y),
+            cache={"k": nk, "v": nv, "pos": pos + Tc},
+        )
+
+    # -------------------------------------------------------------- estimation
+    def num_params(self) -> int:
+        cfg = self.config
+        h = cfg.d_model
+        attn = 4 * h * h + 3 * h  # wq/wk/wv/wo + q/v/o biases
+        enc_layer = attn + 2 * h * cfg.encoder_ffn_dim + cfg.encoder_ffn_dim + h + 4 * h
+        dec_layer = 2 * attn + 2 * h * cfg.decoder_ffn_dim + cfg.decoder_ffn_dim + h + 6 * h
+        total = cfg.encoder_layers * enc_layer + cfg.decoder_layers * dec_layer
+        total += 3 * cfg.num_mel_bins * h + h + 3 * h * h + h  # convs
+        total += cfg.max_source_positions * h + cfg.max_target_positions * h
+        total += cfg.vocab_size * h + 4 * h  # embed + two final norms
+        return total
+
+    def flops_per_token(self) -> float:
+        return 6 * self.num_params()
