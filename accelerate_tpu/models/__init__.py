@@ -16,6 +16,8 @@ def __getattr__(name):
                 "t5_config_from_hf", "t5_params_from_hf",
                 "mixtral_config_from_hf", "mixtral_params_from_hf",
                 "qwen2_config_from_hf", "qwen2_params_from_hf",
+                "qwen3_config_from_hf", "qwen3_params_from_hf",
+                "phi3_config_from_hf", "phi3_params_from_hf",
                 "gemma_config_from_hf", "gemma_params_from_hf",
                 "gpt_neox_config_from_hf", "gpt_neox_params_from_hf",
                 "gptj_config_from_hf", "gptj_params_from_hf",

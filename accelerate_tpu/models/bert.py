@@ -57,13 +57,7 @@ class BertConfig:
         return cls(**kw)
 
 
-def layer_norm(x, scale, bias, eps):
-    dtype = x.dtype
-    x = x.astype(jnp.float32)
-    mean = jnp.mean(x, axis=-1, keepdims=True)
-    var = jnp.var(x, axis=-1, keepdims=True)
-    out = (x - mean) * jax.lax.rsqrt(var + eps) * scale + bias
-    return out.astype(dtype)
+from ..ops.norms import layer_norm
 
 
 class BertForSequenceClassification(Module):

@@ -268,17 +268,11 @@ def gemma2_params_from_hf(state_dict, config: LlamaConfig, dtype=jnp.float32) ->
 
 
 # --------------------------------------------------------------------- qwen2
-def qwen2_config_from_hf(hf_config) -> LlamaConfig:
-    """Qwen2 = the Llama recipe + QKV biases; map onto LlamaConfig with
-    ``attention_bias=True``."""
-    get = _getter(hf_config)
-    cfg = llama_config_from_hf(hf_config)
-    import dataclasses
-
-    # Qwen2 windows layer i iff use_sliding_window and i >= max_window_layers
-    # (HF Qwen2Config layer_types default). Uniform cases map onto
-    # sliding_window; mixed cases drive the segmented layer scan via
-    # layer_windows (two runs: full then windowed; VERDICT r2 #5).
+def _qwen_windows(get):
+    """Qwen2/Qwen3 window rule: layer i is windowed iff use_sliding_window and
+    i >= max_window_layers (HF layer_types default). Uniform cases map onto
+    sliding_window; mixed cases drive the segmented layer scan via
+    layer_windows (two runs: full then windowed; VERDICT r2 #5)."""
     window, layer_windows = None, None
     if get("use_sliding_window"):
         L = get("num_hidden_layers")
@@ -290,6 +284,17 @@ def qwen2_config_from_hf(hf_config) -> LlamaConfig:
             window = w  # every layer windowed
         else:
             layer_windows = (None,) * mwl + (w,) * (L - mwl)
+    return window, layer_windows
+
+
+def qwen2_config_from_hf(hf_config) -> LlamaConfig:
+    """Qwen2 = the Llama recipe + QKV biases; map onto LlamaConfig with
+    ``attention_bias=True``."""
+    get = _getter(hf_config)
+    cfg = llama_config_from_hf(hf_config)
+    import dataclasses
+
+    window, layer_windows = _qwen_windows(get)
     return dataclasses.replace(
         cfg, attention_bias=True, sliding_window=window, layer_windows=layer_windows
     )
@@ -298,6 +303,90 @@ def qwen2_config_from_hf(hf_config) -> LlamaConfig:
 # Qwen2's QKV-bias loading rides the generalized Llama converter (the config
 # forces attention_bias=True above).
 qwen2_params_from_hf = llama_params_from_hf
+
+
+def qwen3_config_from_hf(hf_config) -> LlamaConfig:
+    """Qwen3 = the Llama recipe + per-head QK RMSNorm (``qk_norm``), bias-free
+    projections, decoupled head_dim."""
+    get = _getter(hf_config)
+    cfg = llama_config_from_hf(hf_config)
+    import dataclasses
+
+    window, layer_windows = _qwen_windows(get)
+    return dataclasses.replace(
+        cfg, qk_norm=True, sliding_window=window, layer_windows=layer_windows
+    )
+
+
+def qwen3_params_from_hf(state_dict, config: LlamaConfig, dtype=jnp.float32) -> dict:
+    params = llama_params_from_hf(state_dict, config, dtype=dtype)
+    sd = _normalize_keys(state_dict)
+    L = config.num_hidden_layers
+    params["layers"]["attn"].update({
+        "q_norm": _stack(sd, "layers.{i}.self_attn.q_norm.weight", L, dtype=dtype),
+        "k_norm": _stack(sd, "layers.{i}.self_attn.k_norm.weight", L, dtype=dtype),
+    })
+    return params
+
+
+def phi3_config_from_hf(hf_config) -> LlamaConfig:
+    """Phi-3 = the Llama recipe with FUSED qkv/gate_up projections (split at
+    conversion). Longrope-scaled long-context variants are rejected by the
+    shared rope validation (llama_config_from_hf)."""
+    get = _getter(hf_config)
+    prf = get("partial_rotary_factor", 1.0) or 1.0
+    if prf != 1.0:
+        # Phi-4-mini ships model_type 'phi3' with partial rotary; the zoo
+        # Llama rotates the full head — converting would silently mis-rotate
+        # (measured 7.9e-3 logit error at 2 layers, compounding with depth).
+        raise ValueError(
+            f"partial_rotary_factor={prf} is not supported for phi3-type "
+            "checkpoints (the zoo Llama applies full-width rotary)"
+        )
+    return llama_config_from_hf(hf_config)
+
+
+def phi3_params_from_hf(state_dict, config: LlamaConfig, dtype=jnp.float32) -> dict:
+    sd = _normalize_keys(state_dict)
+    L = config.num_hidden_layers
+    nh, nkv, hd = config.num_attention_heads, config.num_key_value_heads, config.head_dim
+    inter = config.intermediate_size
+
+    wq, wk, wv, wg, wu = [], [], [], [], []
+    for i in range(L):
+        qkv = _to_numpy(sd[f"layers.{i}.self_attn.qkv_proj.weight"], dtype)  # (q+k+v, h)
+        wq.append(qkv[: nh * hd].T)
+        wk.append(qkv[nh * hd: nh * hd + nkv * hd].T)
+        wv.append(qkv[nh * hd + nkv * hd:].T)
+        gu = _to_numpy(sd[f"layers.{i}.mlp.gate_up_proj.weight"], dtype)  # (2i, h)
+        wg.append(gu[:inter].T)
+        wu.append(gu[inter:].T)
+
+    params = {
+        "embed": {"weight": jnp.asarray(_to_numpy(sd["embed_tokens.weight"], dtype))},
+        "layers": {
+            "attn": {
+                "wq": jnp.asarray(np.stack(wq)),
+                "wk": jnp.asarray(np.stack(wk)),
+                "wv": jnp.asarray(np.stack(wv)),
+                "wo": _stack(sd, "layers.{i}.self_attn.o_proj.weight", L, transpose=True, dtype=dtype),
+            },
+            "mlp": {
+                "w_gate": jnp.asarray(np.stack(wg)),
+                "w_up": jnp.asarray(np.stack(wu)),
+                "w_down": _stack(sd, "layers.{i}.mlp.down_proj.weight", L, transpose=True, dtype=dtype),
+            },
+            "input_norm": {"weight": _stack(sd, "layers.{i}.input_layernorm.weight", L, dtype=dtype)},
+            "post_attn_norm": {
+                "weight": _stack(sd, "layers.{i}.post_attention_layernorm.weight", L, dtype=dtype)
+            },
+        },
+        "final_norm": {"weight": jnp.asarray(_to_numpy(sd["norm.weight"], dtype))},
+    }
+    if not config.tie_word_embeddings:
+        head = sd.get("lm_head.weight", sd["embed_tokens.weight"])
+        params["lm_head"] = {"weight": jnp.asarray(_to_numpy(head, dtype).T)}
+    return params
 
 
 # ---------------------------------------------------------------------- gpt2
@@ -1000,6 +1089,8 @@ _CONVERTERS = {
     "t5": (T5ForConditionalGeneration, t5_config_from_hf, t5_params_from_hf),
     "mixtral": (MoELlama, mixtral_config_from_hf, mixtral_params_from_hf),
     "qwen2": (Llama, qwen2_config_from_hf, qwen2_params_from_hf),
+    "qwen3": (Llama, qwen3_config_from_hf, qwen3_params_from_hf),
+    "phi3": (Llama, phi3_config_from_hf, phi3_params_from_hf),
     # Mistral is the Llama recipe + sliding-window attention; the generalized
     # Llama converter handles both (sliding_window flows from the config).
     "mistral": (Llama, llama_config_from_hf, llama_params_from_hf),

@@ -55,6 +55,9 @@ class LlamaConfig:
     matmul_precision: str = "default"  # 'default' | 'int8' (QAT w/ STE bwd, ops/int8.py)
     # QKV projection biases (the Qwen2 recipe; Llama proper is bias-free).
     attention_bias: bool = False
+    # Per-head RMSNorm on Q and K after the head reshape, before rope — the
+    # Qwen3 recipe (weights are head_dim-wide, shared across heads).
+    qk_norm: bool = False
     # Sliding-window attention (the Mistral recipe): each query attends only
     # the previous `sliding_window` positions. None = full causal.
     sliding_window: int | None = None
@@ -327,6 +330,14 @@ class Llama(Module):
                         if cfg.attention_bias
                         else {}
                     ),
+                    **(
+                        {
+                            "q_norm": jnp.ones((L, hd), jnp.float32),
+                            "k_norm": jnp.ones((L, hd), jnp.float32),
+                        }
+                        if cfg.qk_norm
+                        else {}
+                    ),
                 },
                 "mlp": {
                     "w_gate": dense(keys[5], (L, h, inter)),
@@ -442,6 +453,9 @@ class Llama(Module):
         q = q.reshape(B, S, nh, hd)
         k = k.reshape(B, S, nkv, hd)
         v = v.reshape(B, S, nkv, hd)
+        if "q_norm" in a:  # Qwen3 per-head QK norm (static pytree structure)
+            q = rms_norm(q, a["q_norm"], cfg.rms_norm_eps)
+            k = rms_norm(k, a["k_norm"], cfg.rms_norm_eps)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
         new_cache = None
@@ -754,6 +768,8 @@ class Llama(Module):
         attn = h * (cfg.num_attention_heads + 2 * cfg.num_key_value_heads) * cfg.head_dim + cfg.num_attention_heads * cfg.head_dim * h
         if cfg.attention_bias:
             attn += (cfg.num_attention_heads + 2 * cfg.num_key_value_heads) * cfg.head_dim
+        if cfg.qk_norm:
+            attn += 2 * cfg.head_dim
         mlp = 3 * h * inter
         norms = 2 * h
         total = L * (attn + mlp + norms) + cfg.vocab_size * h + h

@@ -34,12 +34,7 @@ from ..ops.attention import attention as _attention, cached_attention
 from ..ops.losses import cross_entropy_loss
 
 
-def _layer_norm(x, scale, bias, eps=1e-5):
-    dtype = x.dtype
-    x = x.astype(jnp.float32)
-    mean = jnp.mean(x, axis=-1, keepdims=True)
-    var = jnp.mean(jnp.square(x - mean), axis=-1, keepdims=True)
-    return ((x - mean) * jax.lax.rsqrt(var + eps) * scale + bias).astype(dtype)
+from ..ops.norms import layer_norm as _layer_norm
 
 
 @dataclass

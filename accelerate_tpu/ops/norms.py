@@ -1,0 +1,21 @@
+"""Shared normalization primitives for the model zoo.
+
+One fp32-accumulated LayerNorm serves GPT-2, BERT, GPTX, and Whisper (each
+previously carried a byte-equivalent copy); RMSNorm lives in ``models/llama.py``
+next to its rope siblings. The fp32 round-trip is the mixed-precision contract:
+statistics and the affine transform run in fp32, the output returns in the
+input dtype.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def layer_norm(x, scale, bias, eps=1e-5):
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=-1, keepdims=True)
+    return ((x - mean) * jax.lax.rsqrt(var + eps) * scale + bias).astype(dtype)

@@ -1211,3 +1211,82 @@ def test_beam_search_with_eos_matches_hf(hf_llama):
                 np.testing.assert_array_equal(o[: len(t)], t,
                                               err_msg=f"seed={seed} eos={eos_tok} lp={lp}")
                 assert all(x == 0 for x in o[len(t):])
+
+
+# ----------------------------------------------------------- qwen3 and phi-3
+@pytest.fixture(scope="module")
+def hf_qwen3():
+    cfg = transformers.Qwen3Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=64, tie_word_embeddings=False,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(20)
+    return transformers.Qwen3ForCausalLM(cfg).eval()
+
+
+def test_qwen3_logits_match_hf(hf_qwen3):
+    """Qwen3: per-head QK RMSNorm before rope (qk_norm) — logits parity pins
+    the norm placement and the head_dim decoupling."""
+    from accelerate_tpu.models.convert import from_hf
+
+    model, params = from_hf(hf_qwen3)
+    assert model.config.qk_norm
+    assert "q_norm" in params["layers"]["attn"]
+    ids = np.random.default_rng(30).integers(0, 128, (2, 16)).astype(np.int32)
+    ours = model.apply(params, input_ids=ids)["logits"]
+    with torch.no_grad():
+        theirs = hf_qwen3(torch.tensor(ids, dtype=torch.long)).logits
+    _logits_close(ours, theirs, atol=2e-4)
+
+
+def test_qwen3_generate_matches_hf_greedy(hf_qwen3):
+    import jax.numpy as jnp
+
+    from accelerate_tpu.generation import generate
+    from accelerate_tpu.models.convert import from_hf
+
+    model, params = from_hf(hf_qwen3)
+    prompt = np.random.default_rng(31).integers(0, 128, (1, 8)).astype(np.int32)
+    ours = generate(model, prompt, max_new_tokens=8, temperature=0.0,
+                    cache_dtype=jnp.float32)
+    with torch.no_grad():
+        theirs = hf_qwen3.generate(
+            torch.tensor(prompt, dtype=torch.long),
+            max_new_tokens=8, eos_token_id=None, do_sample=False, pad_token_id=0,
+        )
+    np.testing.assert_array_equal(np.asarray(ours)[0], theirs[0].numpy())
+
+
+def test_phi3_logits_match_hf():
+    """Phi-3: fused qkv_proj / gate_up_proj split at conversion."""
+    from accelerate_tpu.models.convert import from_hf
+
+    cfg = transformers.Phi3Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, pad_token_id=0, bos_token_id=1,
+        eos_token_id=2, attn_implementation="eager",
+    )
+    torch.manual_seed(21)
+    hf = transformers.Phi3ForCausalLM(cfg).eval()
+    model, params = from_hf(hf)
+    ids = np.random.default_rng(32).integers(0, 128, (2, 16)).astype(np.int32)
+    ours = model.apply(params, input_ids=ids)["logits"]
+    with torch.no_grad():
+        theirs = hf(torch.tensor(ids, dtype=torch.long)).logits
+    _logits_close(ours, theirs, atol=2e-4)
+
+
+def test_phi3_longrope_rejected():
+    from accelerate_tpu.models.convert import phi3_config_from_hf
+
+    with pytest.raises(ValueError, match="rope_type"):
+        phi3_config_from_hf({
+            "vocab_size": 128, "hidden_size": 64, "intermediate_size": 128,
+            "num_hidden_layers": 2, "num_attention_heads": 4,
+            "num_key_value_heads": 2,
+            "rope_scaling": {"rope_type": "longrope", "long_factor": [1.0],
+                             "short_factor": [1.0]},
+        })
