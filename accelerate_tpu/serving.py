@@ -232,6 +232,13 @@ class ContinuousBatcher:
         self._prefix_tokens = prefix
         return P
 
+    @property
+    def cache_columns_used(self) -> int:
+        """Global cache columns consumed so far this wave (prefix + admits +
+        decode windows, out of ``max_cache_len``) — the capacity a ``reset()``
+        reclaims. Public mirror of the engine's host-side position counter."""
+        return self._host_pos
+
     def submit(self, prompt_ids) -> int:
         """Queue one prompt (1-D array of token ids). Returns a request id."""
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)

@@ -123,6 +123,7 @@ def test_by_feature_examples(script, args, tmp_path):
         "inference/pippy/gpt2.py",
         "inference/pippy/t5.py",
         "inference/distributed/distributed_inference.py",
+        "inference/continuous_batching.py",
     ],
 )
 def test_inference_examples(script):

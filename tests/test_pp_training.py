@@ -17,6 +17,13 @@ from accelerate_tpu.utils.dataclasses import PipelineParallelPlugin
 
 
 def _tiny_cfg(model_cls=Llama, **kw):
+    from accelerate_tpu.models import GPTX, GPTXConfig
+
+    if model_cls is GPTX:  # no GQA knob in the classic-GPT config
+        defaults = dict(vocab_size=128, hidden_size=64, intermediate_size=128,
+                        num_attention_heads=2, num_hidden_layers=4)
+        defaults.update(kw)
+        return GPTXConfig.tiny(**defaults)
     defaults = dict(
         vocab_size=128, hidden_size=64, intermediate_size=128,
         num_attention_heads=2, num_key_value_heads=2, num_hidden_layers=4,
@@ -447,3 +454,21 @@ def test_microbatch_roundtrip():
         back = unmicrobatch(xs, mesh)
     assert xs.shape == (2, 8, 3)
     np.testing.assert_array_equal(np.asarray(back), x)
+
+
+def test_pp_training_gptx():
+    """GPTX (classic-GPT trio) pipelines like GPT2/Llama: pp2 matches the
+    unsharded single-step numerics and the layer stack lands on pp."""
+    from accelerate_tpu.models import GPTX
+
+    _, params_base, _ = _run_training(ParallelismConfig(), steps=1, model_cls=GPTX)
+    _, params_pp, pmodel = _run_training(
+        ParallelismConfig(pp_size=2, dp_size=4), steps=1, model_cls=GPTX
+    )
+    for (pa, la), (pb, lb) in zip(
+        jax.tree_util.tree_leaves_with_path(params_base),
+        jax.tree_util.tree_leaves_with_path(params_pp),
+    ):
+        np.testing.assert_allclose(la, lb, atol=2e-4, err_msg=str(pa))
+    wqkv = pmodel.params["layers"]["attn"]["w_qkv"]
+    assert wqkv.sharding.spec[0] == "pp", wqkv.sharding
