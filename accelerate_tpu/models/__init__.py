@@ -5,6 +5,7 @@ from .llama import Llama, LlamaConfig
 from .moe import MoELlama, MoELlamaConfig
 from .t5 import T5Config, T5ForConditionalGeneration
 from .vision import ConvNetConfig, ConvNetForImageClassification
+from .vit import ViTConfig, ViTForImageClassification
 from .whisper import WhisperConfig, WhisperForConditionalGeneration
 
 
@@ -22,7 +23,8 @@ def __getattr__(name):
                 "gpt_neox_config_from_hf", "gpt_neox_params_from_hf",
                 "gptj_config_from_hf", "gptj_params_from_hf",
                 "opt_config_from_hf", "opt_params_from_hf",
-                "whisper_config_from_hf", "whisper_params_from_hf"):
+                "whisper_config_from_hf", "whisper_params_from_hf",
+                "vit_config_from_hf", "vit_params_from_hf"):
         from . import convert
 
         return getattr(convert, name)

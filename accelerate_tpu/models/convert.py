@@ -33,6 +33,7 @@ from .gptx import GPTX, GPTXConfig
 from .llama import Llama, LlamaConfig
 from .moe import MoELlama, MoELlamaConfig
 from .t5 import T5Config, T5ForConditionalGeneration
+from .vit import ViTConfig, ViTForImageClassification
 from .whisper import WhisperConfig, WhisperForConditionalGeneration
 
 
@@ -1081,6 +1082,102 @@ def whisper_params_from_hf(state_dict, config, dtype=jnp.float32) -> dict:
     }
 
 
+# ------------------------------------------------------------------------ vit
+def vit_config_from_hf(hf_config) -> "ViTConfig":
+    from .vit import ViTConfig
+
+    get = _getter(hf_config)
+    act = get("hidden_act", "gelu")
+    if act not in ("gelu", "gelu_python"):
+        raise ValueError(f"hidden_act={act!r} is not supported (zoo ViT uses exact gelu)")
+    if not get("qkv_bias", True):
+        raise ValueError("qkv_bias=False ViT variants are not supported")
+    n_labels = get("num_labels")
+    if n_labels is None:
+        n_labels = len(get("id2label") or {}) or 1000
+    return ViTConfig(
+        image_size=get("image_size", 224),
+        patch_size=get("patch_size", 16),
+        num_channels=get("num_channels", 3),
+        hidden_size=get("hidden_size"),
+        num_hidden_layers=get("num_hidden_layers"),
+        num_attention_heads=get("num_attention_heads"),
+        intermediate_size=get("intermediate_size"),
+        num_labels=n_labels,
+        layer_norm_eps=get("layer_norm_eps", 1e-12),
+    )
+
+
+def vit_params_from_hf(state_dict, config, dtype=jnp.float32) -> dict:
+    sd = _normalize_keys(state_dict, prefixes=("vit.",))
+    L = config.num_hidden_layers
+    h = config.hidden_size
+
+    def qkv(i, what):
+        mats = [
+            _to_numpy(sd[f"encoder.layer.{i}.attention.attention.{p}.{what}"], dtype)
+            for p in ("query", "key", "value")
+        ]
+        if what == "weight":
+            return np.concatenate([m.T for m in mats], axis=1)  # (h, 3h)
+        return np.concatenate(mats)
+
+    def ln(name):
+        return {
+            "scale": _stack(sd, f"encoder.layer.{{i}}.{name}.weight", L, dtype=dtype),
+            "bias": _stack(sd, f"encoder.layer.{{i}}.{name}.bias", L, dtype=dtype),
+        }
+
+    # Conv kernel (h, C, P, P) → (C·P·P, h) in the (c, ph, pw) lane order the
+    # model's reshape-patchify produces.
+    proj = _to_numpy(sd["embeddings.patch_embeddings.projection.weight"], dtype)
+    params = {
+        "embed": {
+            "patch": {"w": jnp.asarray(proj.reshape(h, -1).T),
+                      "b": jnp.asarray(_to_numpy(sd["embeddings.patch_embeddings.projection.bias"], dtype))},
+            "cls": jnp.asarray(_to_numpy(sd["embeddings.cls_token"], dtype)),
+            "pos": jnp.asarray(_to_numpy(sd["embeddings.position_embeddings"], dtype)[0]),
+        },
+        "layers": {
+            "attn": {
+                "w_qkv": jnp.asarray(np.stack([qkv(i, "weight") for i in range(L)])),
+                "b_qkv": jnp.asarray(np.stack([qkv(i, "bias") for i in range(L)])),
+                "wo": _stack(sd, "encoder.layer.{i}.attention.output.dense.weight", L, transpose=True, dtype=dtype),
+                "bo": _stack(sd, "encoder.layer.{i}.attention.output.dense.bias", L, dtype=dtype),
+            },
+            "mlp": {
+                "w_in": _stack(sd, "encoder.layer.{i}.intermediate.dense.weight", L, transpose=True, dtype=dtype),
+                "b_in": _stack(sd, "encoder.layer.{i}.intermediate.dense.bias", L, dtype=dtype),
+                "w_out": _stack(sd, "encoder.layer.{i}.output.dense.weight", L, transpose=True, dtype=dtype),
+                "b_out": _stack(sd, "encoder.layer.{i}.output.dense.bias", L, dtype=dtype),
+            },
+            "ln_1": ln("layernorm_before"),
+            "ln_2": ln("layernorm_after"),
+        },
+        "ln_f": {
+            "scale": jnp.asarray(_to_numpy(sd["layernorm.weight"], dtype)),
+            "bias": jnp.asarray(_to_numpy(sd["layernorm.bias"], dtype)),
+        },
+    }
+    head_w = sd.get("classifier.weight")
+    if head_w is not None:
+        params["classifier"] = {
+            "w": jnp.asarray(_to_numpy(head_w, dtype).T),
+            "b": jnp.asarray(_to_numpy(sd["classifier.bias"], dtype)),
+        }
+    else:  # backbone-only checkpoint: fresh head, in the requested dtype
+        import jax as _jax
+
+        head = np.asarray(
+            _jax.random.normal(_jax.random.key(0), (h, config.num_labels)) / np.sqrt(h)
+        )
+        params["classifier"] = {
+            "w": jnp.asarray(head.astype(np.dtype(dtype))),
+            "b": jnp.zeros((config.num_labels,), dtype),
+        }
+    return params
+
+
 # ----------------------------------------------------------------- dispatcher
 _CONVERTERS = {
     "llama": (Llama, llama_config_from_hf, llama_params_from_hf),
@@ -1102,6 +1199,7 @@ _CONVERTERS = {
     "opt": (GPTX, opt_config_from_hf, opt_params_from_hf),
     "whisper": (WhisperForConditionalGeneration, whisper_config_from_hf,
                 whisper_params_from_hf),
+    "vit": (ViTForImageClassification, vit_config_from_hf, vit_params_from_hf),
 }
 
 

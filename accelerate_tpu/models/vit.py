@@ -1,0 +1,186 @@
+"""ViT — vision transformer for image classification.
+
+Fills the CV-transformer slot beside the ConvNet workload (the reference's
+``cv_example`` is model-agnostic torch; here the model is part of the
+framework). TPU-first patching: the stride-P conv IS a reshape + one matmul
+(patches are non-overlapping), so the embedding rides the MXU with no conv op;
+encoder layers run as one stacked-layer ``lax.scan`` with the shared fp32
+LayerNorm and the ops attention kernel dispatch.
+
+HF counterpart: ``ViTForImageClassification`` (parity in tests/test_vit.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..modules import ModelOutput, Module
+from ..ops.attention import attention as _attention
+from ..ops.losses import cross_entropy_loss
+from ..ops.norms import layer_norm
+
+
+@dataclass
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_channels: int = 3
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    num_labels: int = 1000
+    layer_norm_eps: float = 1e-12
+    qkv_bias: bool = True
+    attention_impl: str = "auto"
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    def __post_init__(self):
+        if self.image_size % self.patch_size:
+            raise ValueError(
+                f"image_size {self.image_size} not divisible by patch_size {self.patch_size}"
+            )
+
+    @classmethod
+    def tiny(cls, **kw):
+        defaults = dict(image_size=32, patch_size=8, hidden_size=64,
+                        num_hidden_layers=2, num_attention_heads=4,
+                        intermediate_size=128, num_labels=10)
+        defaults.update(kw)
+        return cls(**defaults)
+
+
+class ViTForImageClassification(Module):
+    def __init__(self, config: ViTConfig):
+        self.config = config
+        self.params = None
+
+    # ------------------------------------------------------------------- init
+    def init(self, rng, *example_inputs, **kwargs):
+        cfg = self.config
+        h, L = cfg.hidden_size, cfg.num_hidden_layers
+        keys = jax.random.split(rng, 8)
+        d = lambda k, shape, fan: (jax.random.normal(k, shape, jnp.float32) / np.sqrt(fan))
+        patch_dim = cfg.num_channels * cfg.patch_size ** 2
+        ln = lambda: {"scale": jnp.ones((L, h), jnp.float32), "bias": jnp.zeros((L, h), jnp.float32)}
+        return {
+            "embed": {
+                "patch": {"w": d(keys[0], (patch_dim, h), patch_dim),
+                          "b": jnp.zeros((h,), jnp.float32)},
+                "cls": jnp.zeros((1, 1, h), jnp.float32),
+                "pos": d(keys[1], (cfg.num_patches + 1, h), h),
+            },
+            "layers": {
+                "attn": {
+                    "w_qkv": d(keys[2], (L, h, 3 * h), h),
+                    "b_qkv": jnp.zeros((L, 3 * h), jnp.float32),
+                    "wo": d(keys[3], (L, h, h), h),
+                    "bo": jnp.zeros((L, h), jnp.float32),
+                },
+                "mlp": {
+                    "w_in": d(keys[4], (L, h, cfg.intermediate_size), h),
+                    "b_in": jnp.zeros((L, cfg.intermediate_size), jnp.float32),
+                    "w_out": d(keys[5], (L, cfg.intermediate_size, h), cfg.intermediate_size),
+                    "b_out": jnp.zeros((L, h), jnp.float32),
+                },
+                "ln_1": ln(),
+                "ln_2": ln(),
+            },
+            "ln_f": {"scale": jnp.ones((h,), jnp.float32), "bias": jnp.zeros((h,), jnp.float32)},
+            "classifier": {"w": d(keys[6], (h, cfg.num_labels), h),
+                           "b": jnp.zeros((cfg.num_labels,), jnp.float32)},
+        }
+
+    # --------------------------------------------------------------- sharding
+    def sharding_rules(self):
+        return [
+            (r"embed/patch/w", P(None, "tp")),
+            (r"embed/pos", P(None, "fsdp")),
+            (r"attn/w_qkv", P(None, "fsdp", "tp")),
+            (r"attn/b_qkv", P(None, "tp")),
+            (r"attn/wo", P(None, "tp", "fsdp")),
+            (r"mlp/w_in", P(None, "fsdp", "tp")),
+            (r"mlp/b_in", P(None, "tp")),
+            (r"mlp/w_out", P(None, "tp", "fsdp")),
+            (r"ln_", P()),
+            (r"classifier", P()),
+        ]
+
+    # ---------------------------------------------------------------- forward
+    def _patchify(self, pixel_values):
+        """(B, C, H, W) → (B, N, C·P·P) with the (c, ph, pw) lane order the
+        converter's kernel flattening matches — the stride-P conv as one
+        reshape + matmul."""
+        cfg = self.config
+        B, C, H, W = pixel_values.shape
+        if (H, W) != (cfg.image_size, cfg.image_size) or C != cfg.num_channels:
+            # The position table is a fixed (grid+1)-row grid; a different
+            # size would silently apply a meaningless partial grid (HF ViT
+            # raises on this mismatch too).
+            raise ValueError(
+                f"pixel_values {(C, H, W)} do not match the configured "
+                f"({cfg.num_channels}, {cfg.image_size}, {cfg.image_size})"
+            )
+        Ph, Pw = H // cfg.patch_size, W // cfg.patch_size
+        x = pixel_values.reshape(B, C, Ph, cfg.patch_size, Pw, cfg.patch_size)
+        x = x.transpose(0, 2, 4, 1, 3, 5)  # (B, Ph, Pw, C, p, p)
+        return x.reshape(B, Ph * Pw, C * cfg.patch_size ** 2)
+
+    def apply(self, params, pixel_values=None, labels=None, train: bool = False,
+              rngs=None, **kwargs):
+        cfg = self.config
+        eps = cfg.layer_norm_eps
+        emb = params["embed"]
+        x = self._patchify(jnp.asarray(pixel_values)) @ emb["patch"]["w"] + emb["patch"]["b"]
+        B, N, h = x.shape
+        cls = jnp.broadcast_to(emb["cls"].astype(x.dtype), (B, 1, h))
+        x = jnp.concatenate([cls, x], axis=1) + emb["pos"][: N + 1].astype(x.dtype)
+        nh, hd = cfg.num_attention_heads, cfg.head_dim
+
+        def block(x, layer):
+            z = layer_norm(x, layer["ln_1"]["scale"], layer["ln_1"]["bias"], eps)
+            qkv = z @ layer["attn"]["w_qkv"] + layer["attn"]["b_qkv"]
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            T = z.shape[1]
+            attn = _attention(
+                q.reshape(B, T, nh, hd), k.reshape(B, T, nh, hd),
+                v.reshape(B, T, nh, hd), causal=False, mask=None,
+                impl=cfg.attention_impl,
+            )
+            x = x + (attn.reshape(B, T, h) @ layer["attn"]["wo"] + layer["attn"]["bo"])
+            z = layer_norm(x, layer["ln_2"]["scale"], layer["ln_2"]["bias"], eps)
+            mid = jax.nn.gelu(z @ layer["mlp"]["w_in"] + layer["mlp"]["b_in"], approximate=False)
+            return x + (mid @ layer["mlp"]["w_out"] + layer["mlp"]["b_out"]), None
+
+        x, _ = jax.lax.scan(block, x, params["layers"])
+        x = layer_norm(x, params["ln_f"]["scale"], params["ln_f"]["bias"], eps)
+        logits = (x[:, 0] @ params["classifier"]["w"] + params["classifier"]["b"]).astype(jnp.float32)
+        out = ModelOutput(logits=logits)
+        if labels is not None:
+            out["loss"] = cross_entropy_loss(logits, jnp.asarray(labels))
+        return out
+
+    # -------------------------------------------------------------- estimation
+    def num_params(self) -> int:
+        cfg = self.config
+        h, L, inter = cfg.hidden_size, cfg.num_hidden_layers, cfg.intermediate_size
+        patch_dim = cfg.num_channels * cfg.patch_size ** 2
+        layer = 3 * h * h + 3 * h + h * h + h + 2 * h * inter + inter + h + 4 * h
+        return (L * layer + patch_dim * h + h + h + (cfg.num_patches + 1) * h
+                + 2 * h + h * cfg.num_labels + cfg.num_labels)
+
+    def flops_per_token(self) -> float:
+        return 6 * self.num_params()
