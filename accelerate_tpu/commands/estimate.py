@@ -74,6 +74,8 @@ def _model_from_hf_config(hf: dict):
                              ("mistral", "mistral"), ("llama", "llama"),
                              ("gptneox", "gpt_neox"), ("gptj", "gptj"),
                              ("gpt2", "gpt2"), ("opt", "opt"),
+                             ("qwen3", "qwen3"), ("phi3", "phi3"),
+                             ("whisper", "whisper"), ("vit", "vit"),
                              ("bert", "bert"), ("t5", "t5")):
             if known in arch:
                 model_type = mtype

@@ -362,9 +362,9 @@ def assisted_generate(
     permanent masked holes, so the cache is over-allocated to
     ``S + max_new_tokens·(γ+1)`` slots (the worst case of one accepted token
     per round). Rope/wpe positions stay exact per row (they ride the
-    ``positions`` channel, not slot indices); sliding-window models are
-    rejected for B>1 because window masks measure slot distance, which holes
-    would stretch.
+    ``positions`` channel, not slot indices); sliding-window models are exact
+    too — ``cached_attention`` measures windows in valid-slot distance, so
+    the rejected-slot holes don't stretch the window (ops/attention.py).
     """
     module, mparams = _unwrap(model)
     dmodule, dmparams = _unwrap(draft_model)
@@ -377,17 +377,6 @@ def assisted_generate(
     gamma = num_draft_tokens
     eos = -1 if eos_token_id is None else eos_token_id
     if B != 1:
-        for m in (module, dmodule):
-            cfg = getattr(m, "config", None)
-            ws = getattr(cfg, "layer_windows", None)
-            if getattr(cfg, "sliding_window", None) or (
-                ws is not None and any(w is not None for w in ws)
-            ):
-                raise ValueError(
-                    "batched assisted generation does not support sliding-window "
-                    "attention (window masks measure cache-slot distance; the "
-                    "batched path leaves masked holes). Use batch 1."
-                )
         return _assisted_generate_batched(
             module, dmodule, params, draft_params, input_ids, attention_mask,
             max_new_tokens=max_new_tokens, gamma=gamma, eos=eos,
@@ -762,22 +751,24 @@ def generate(
             attention_mask = jnp.repeat(jnp.asarray(attention_mask, jnp.int32), n, axis=0)
         num_return_sequences = 1
 
-    # Token prompts cast to int32; float arrays pass through unchanged — an
-    # encoder-decoder's "prompt" may be continuous encoder input (Whisper's
-    # (B, n_mels, T) log-mel features).
+    if isinstance(model, StreamedScanModel):
+        module, mparams = model, None
+    else:
+        module, mparams = _unwrap(model)
+
+    # Token prompts cast to int32. Float arrays pass through unchanged ONLY
+    # for encoder-decoders, whose "prompt" may be continuous encoder input
+    # (Whisper's (B, n_mels, T) log-mel features); decoder-only models keep
+    # the unconditional cast (the pre-Whisper behavior — float token ids
+    # truncate, they don't error deep inside the jitted embedding lookup).
     input_ids = jnp.asarray(input_ids)
-    if jnp.issubdtype(input_ids.dtype, jnp.integer):
+    if jnp.issubdtype(input_ids.dtype, jnp.integer) or not hasattr(module, "encode"):
         input_ids = input_ids.astype(jnp.int32)
     if attention_mask is not None:
         attention_mask = jnp.asarray(attention_mask, jnp.int32)
     if rng is None:
         rng = jax.random.key(0)
     eos = -1 if eos_token_id is None else eos_token_id
-
-    if isinstance(model, StreamedScanModel):
-        module, mparams = model, None
-    else:
-        module, mparams = _unwrap(model)
     if hasattr(module, "encode"):
         # Encoder-decoder (T5-style): the "prompt" is the encoder input; decoding
         # starts fresh from decoder_start_token_id, so the return is always

@@ -30,6 +30,15 @@ head of the cache and stays valid for all slots across evictions — requests
 then submit only their suffixes. Prefill compute and cache columns for the
 prefix are paid once per wave instead of once per request.
 
+**Per-request generation controls** (``submit`` kwargs): each request may
+carry its own ``max_new_tokens``, ``temperature``, ``eos_token_id``, and
+``stop_sequences``, heterogeneously within one wave. Per-slot scalars ride the
+engine state through the same compiled programs — nothing recompiles as the
+mix changes. Length/temperature/eos act on-device per slot; multi-token stop
+sequences are detected host-side at the sync cadence (the slot frees at most
+``sync_every - 1`` steps late) and the OUTPUT is truncated exactly at the
+first stop occurrence, so results never depend on cadence.
+
 Correctness contract (pinned by tests/test_serving.py): in greedy mode each
 request's output is EXACTLY ``generate(model, prompt, temperature=0)`` for
 that prompt alone (with a prefix set: for ``prefix + suffix``), regardless of
@@ -40,8 +49,10 @@ folded again by step index — so a request's sampled tokens depend only on
 reproducible but not bit-equal to a solo ``generate()`` (whose split chain
 differs).
 
-Sliding-window models are rejected: window masks measure cache-slot distance,
-which the holes would stretch (same restriction as batched assisted).
+Sliding-window models serve exactly: ``cached_attention`` measures windows in
+VALID-slot distance, so the slot scheme's masked holes don't stretch the
+window (ops/attention.py — on the contiguous solo cache the two distances
+coincide, which is what makes engine output == solo output).
 """
 
 from __future__ import annotations
@@ -57,10 +68,32 @@ import jax.numpy as jnp
 from .generation import _unwrap, left_align, mask_positions
 
 
+def _first_stop_end(row: np.ndarray, stops: tuple) -> int | None:
+    """End index (exclusive) of the earliest-ending completed stop-sequence
+    occurrence in ``row``, or None. Earliest END, so a later-starting shorter
+    stop that completes first wins — the order generation actually stops in."""
+    best = None
+    for s in stops:
+        L = int(s.size)
+        if L > row.size:
+            continue
+        win = np.lib.stride_tricks.sliding_window_view(row, L)
+        hits = np.nonzero((win == s).all(axis=1))[0]
+        if hits.size:
+            end = int(hits[0]) + L
+            if best is None or end < best:
+                best = end
+    return best
+
+
 @dataclass
 class _Request:
     rid: int
     prompt: np.ndarray  # (P,) real tokens, no padding
+    max_new: int
+    temperature: float
+    eos: int  # -1 = none
+    stop: tuple  # tuple of np.int32 arrays; () = none
 
 
 class ContinuousBatcher:
@@ -102,16 +135,6 @@ class ContinuousBatcher:
         self.params = params if params is not None else mparams
         if self.params is None:
             raise ValueError("Model has no params; pass params= or init the model first.")
-        cfg = getattr(module, "config", None)
-        ws = getattr(cfg, "layer_windows", None)
-        if getattr(cfg, "sliding_window", None) or (
-            ws is not None and any(w is not None for w in ws)
-        ):
-            raise ValueError(
-                "ContinuousBatcher does not support sliding-window attention "
-                "(window masks measure cache-slot distance; the slot scheme "
-                "leaves masked holes)."
-            )
         if hasattr(module, "encode"):
             raise ValueError("ContinuousBatcher supports decoder-only cached models.")
         self.B = batch_slots
@@ -157,6 +180,11 @@ class ContinuousBatcher:
         self._active = jnp.zeros((B,), bool)
         self._out_buf = jnp.full((B, self.max_new), self.pad, jnp.int32)
         self._keys = jnp.broadcast_to(self._rng, (B,))
+        # Per-slot generation controls (heterogeneous per request; traced
+        # values, so the compiled programs are shared across any mix).
+        self._slot_max = jnp.full((B,), self.max_new, jnp.int32)
+        self._slot_temp = jnp.full((B,), float(self.temperature or 0.0), jnp.float32)
+        self._slot_eos = jnp.full((B,), self.eos, jnp.int32)
         self._slot_req: list[_Request | None] = [None] * B
         # Host-side mirror of cache["pos"]: it advances deterministically
         # (+bucket per admit, +sync_every per decode window), so capacity
@@ -239,8 +267,41 @@ class ContinuousBatcher:
         reclaims. Public mirror of the engine's host-side position counter."""
         return self._host_pos
 
-    def submit(self, prompt_ids) -> int:
-        """Queue one prompt (1-D array of token ids). Returns a request id."""
+    @property
+    def cache_utilization(self) -> float:
+        """Fraction of the consumed cache area (B rows × ``cache_columns_used``
+        columns) whose slots are valid for their row — the engine's capacity
+        honesty metric. Holes from eviction, retired requests, and
+        inactive-row decode writes all count against it, so under
+        heterogeneous lengths this DECAYS across a wave (columns are never
+        reclaimed until ``reset()``); measured decay motivates sizing
+        ``max_cache_len`` to total wave tokens (see tests/test_serving.py's
+        utilization test and PERF.md)."""
+        if self._host_pos == 0:
+            return 1.0
+        km = np.asarray(jax.device_get(self._cache["kv_mask"]))[:, : self._host_pos]
+        return float(km.mean())
+
+    def submit(
+        self,
+        prompt_ids,
+        *,
+        max_new_tokens: int | None = None,
+        temperature: float | None = None,
+        eos_token_id: int | None = None,
+        stop_sequences=None,
+    ) -> int:
+        """Queue one prompt (1-D array of token ids). Returns a request id.
+
+        Per-request overrides (engine defaults when omitted):
+        ``max_new_tokens`` (must be <= the engine's, which sizes the output
+        buffer), ``temperature`` (0 = greedy; rows mix freely within one
+        wave), ``eos_token_id``, and ``stop_sequences`` — an iterable of
+        token-id sequences; generation stops at the first completed
+        occurrence, which is INCLUDED in the returned ids (like eos). Stop
+        detection runs host-side at the sync cadence, but the returned output
+        is truncated at the exact first occurrence, so results are
+        cadence-independent."""
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
@@ -249,26 +310,48 @@ class ContinuousBatcher:
                 f"prompt length {prompt.size} exceeds the largest bucket "
                 f"{self.buckets[-1]}; raise bucket_sizes."
             )
+        max_new = self.max_new if max_new_tokens is None else int(max_new_tokens)
+        if not (1 <= max_new <= self.max_new):
+            raise ValueError(
+                f"per-request max_new_tokens must be in [1, {self.max_new}] "
+                f"(the engine's max_new_tokens sizes the output buffer), got {max_new}"
+            )
+        temp = float(self.temperature or 0.0) if temperature is None else float(temperature)
+        eos = self.eos if eos_token_id is None else int(eos_token_id)
+        stop = ()
+        if stop_sequences:
+            stop = tuple(np.asarray(s, np.int32).reshape(-1) for s in stop_sequences)
+            if any(s.size == 0 for s in stop):
+                raise ValueError("empty stop sequence")
         rid = self._next_rid
         self._next_rid += 1
-        self._queue.append(_Request(rid, prompt))
+        self._queue.append(_Request(rid, prompt, max_new, temp, eos, stop))
         return rid
 
     # ------------------------------------------------------------- sampling
-    def _sample_rows(self, logits, keys, step_idx):
+    def _sample_rows(self, logits, keys, step_idx, temps):
         """Per-row draw from per-request streams: row r's key folded by its
         own step index — sampled tokens depend only on (engine rng, request
-        id, step), never on traffic or slot assignment."""
-        if not (self.temperature and self.temperature > 0.0):
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        id, step), never on traffic or slot assignment. ``temps`` (B,) is the
+        per-request temperature; 0 rows take the raw argmax (exact greedy),
+        so greedy and sampled requests mix inside one compiled program."""
         from .generation import _warp_scores
 
-        warped = _warp_scores(logits, self.temperature, self.top_k, self.top_p)
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        # Per-row temperature is a traced value, so _warp_scores' scalar
+        # temperature short-circuit can't apply — divide by a safe temp here,
+        # then reuse _warp_scores (at T=1) for the top-k/top-p chain so the
+        # masking semantics can never diverge from generate()'s. top_k/top_p
+        # stay engine-global (static).
+        safe_t = jnp.where(temps > 0.0, temps, 1.0)
+        scores = _warp_scores(logits.astype(jnp.float32) / safe_t[:, None],
+                              1.0, self.top_k, self.top_p)
 
         def one(lg, k, n):
             return jax.random.categorical(jax.random.fold_in(k, n), lg).astype(jnp.int32)
 
-        return jax.vmap(one)(warped, keys, step_idx)
+        sampled = jax.vmap(one)(scores, keys, step_idx)
+        return jnp.where(temps > 0.0, sampled, greedy)
 
     # ------------------------------------------------------------- compiled
     def _admit_fn(self, P: int):
@@ -284,8 +367,10 @@ class ContinuousBatcher:
         module = self.module
         pad = self.pad
 
-        def run(params, cache, state, slot, prompt_row, mask_row, rid, base_rng):
-            tok, pos, n_out, active, out_buf, keys = state
+        def run(params, cache, state, slot, prompt_row, mask_row, rid, base_rng,
+                req_max, req_temp, req_eos):
+            (tok, pos, n_out, active, out_buf, keys,
+             slot_max, slot_temp, slot_eos) = state
             B = tok.shape[0]
             # evict the slot's previous occupant: its KV must stop being
             # attendable before the new prompt writes into the same row —
@@ -298,8 +383,12 @@ class ContinuousBatcher:
             real_len = jnp.sum(mask_row).astype(jnp.int32) + pfx
             key = jax.random.fold_in(base_rng, rid)  # the request's own stream
             keys = keys.at[slot].set(key)
+            slot_max = slot_max.at[slot].set(req_max)
+            slot_temp = slot_temp.at[slot].set(req_temp)
+            slot_eos = slot_eos.at[slot].set(req_eos)
             first = self._sample_rows(
-                out["logits"][slot, -1][None], key[None], jnp.zeros((1,), jnp.int32)
+                out["logits"][slot, -1][None], key[None],
+                jnp.zeros((1,), jnp.int32), req_temp[None],
             )[0]
             tok = tok.at[slot].set(first)
             pos = pos.at[slot].set(real_len)
@@ -308,9 +397,11 @@ class ContinuousBatcher:
             # active only if there is room and the first token wasn't eos
             out_buf = out_buf.at[slot].set(jnp.full((self.max_new,), pad, jnp.int32))
             out_buf = out_buf.at[slot, 0].set(first)
-            done0 = (first == self.eos) | (self.max_new <= 1)
+            done0 = (first == req_eos) | (req_max <= 1)
             active = active.at[slot].set(~done0)
-            return out["cache"], (tok, pos, n_out, active, out_buf, keys), done0
+            state = (tok, pos, n_out, active, out_buf, keys,
+                     slot_max, slot_temp, slot_eos)
+            return out["cache"], state, done0
 
         fn = jax.jit(run, donate_argnums=(1, 2))
         self._admit_fns[(P, pfx)] = fn
@@ -329,13 +420,15 @@ class ContinuousBatcher:
 
         def run(params, cache, state):
             def one_step(carry, _):
-                cache, (tok, pos, n_out, active, out_buf, keys) = carry
+                cache, state = carry
+                (tok, pos, n_out, active, out_buf, keys,
+                 slot_max, slot_temp, slot_eos) = state
                 B = tok.shape[0]
                 col = cache["pos"]  # global slot this step writes
                 feed = jnp.where(active, tok, pad)
                 out = module.apply(params, input_ids=feed[:, None], cache=cache,
                                    positions=pos[:, None])
-                nxt = self._sample_rows(out["logits"][:, -1], keys, n_out)
+                nxt = self._sample_rows(out["logits"][:, -1], keys, n_out, slot_temp)
                 nxt = jnp.where(active, nxt, pad)
                 cache2 = out["cache"]
                 # hole out the column for rows that didn't produce a token
@@ -351,8 +444,10 @@ class ContinuousBatcher:
                     jnp.where(active, nxt, cur)
                 )
                 n_out = n_out + active.astype(jnp.int32)
-                still = active & (nxt != self.eos) & (n_out < self.max_new)
-                return (cache2, (nxt, pos + 1, n_out, still, out_buf, keys)), None
+                still = active & (nxt != slot_eos) & (n_out < slot_max)
+                state = (nxt, pos + 1, n_out, still, out_buf, keys,
+                         slot_max, slot_temp, slot_eos)
+                return (cache2, state), None
 
             (cache, state), _ = jax.lax.scan(
                 one_step, (cache, state), None, length=self.sync_every
@@ -378,24 +473,48 @@ class ContinuousBatcher:
         row = np.asarray(self._out_buf[s])
         n = int(self._n_out[s])
         row = row[:n].copy()
-        if self.eos >= 0 and (row == self.eos).any():
-            row = row[: int(np.argmax(row == self.eos)) + 1]
+        if req.eos >= 0 and (row == req.eos).any():
+            row = row[: int(np.argmax(row == req.eos)) + 1]
+        end = _first_stop_end(row, req.stop)
+        if end is not None:
+            # Exact truncation at the first completed stop occurrence —
+            # tokens decoded past it (host scan lags by <= sync_every - 1
+            # steps) are discarded, so output is cadence-independent.
+            row = row[:end]
         self._results[req.rid] = row
         self._slot_req[s] = None
 
     def _sync(self, state):
         (self._tok, self._pos, self._n_out, self._active, self._out_buf,
-         self._keys) = state
+         self._keys, self._slot_max, self._slot_temp, self._slot_eos) = state
 
     def run(self) -> dict[int, np.ndarray]:
         """Drive admits + decode until the queue drains and all slots finish.
         Returns THIS wave's results only: {request_id: generated token ids
         (eos included, no pads)} for every request finished during the call."""
         state = (self._tok, self._pos, self._n_out, self._active, self._out_buf,
-                 self._keys)
+                 self._keys, self._slot_max, self._slot_temp, self._slot_eos)
         while True:
             self._sync(state)  # _collect reads the instance fields
-            active_np = np.asarray(state[3])
+            active_np = np.array(state[3])  # writable copy: the stop scan flips entries
+            # Host-side stop-sequence scan: frees a matched slot at the sync
+            # cadence (<= sync_every - 1 steps late; the OUTPUT is truncated
+            # exactly in _collect, so only slot-turnaround timing varies).
+            stop_slots = [
+                s for s in range(self.B)
+                if active_np[s] and self._slot_req[s] is not None and self._slot_req[s].stop
+            ]
+            if stop_slots:
+                out_np = np.asarray(state[4])
+                n_np = np.asarray(state[2])
+                new_active = state[3]
+                for s in stop_slots:
+                    row = out_np[s][: int(n_np[s])]
+                    if _first_stop_end(row, self._slot_req[s].stop) is not None:
+                        new_active = new_active.at[s].set(False)
+                        active_np[s] = False
+                state = state[:3] + (new_active,) + state[4:]
+                self._sync(state)
             for s in range(self.B):
                 self._collect(s, active_np)
             free = [s for s in range(self.B) if self._slot_req[s] is None]
@@ -403,7 +522,7 @@ class ContinuousBatcher:
                 req = self._queue.popleft()
                 s = free.pop(0)
                 P = self._bucket(req.prompt.size)
-                if self._host_pos + P + self.max_new + self.sync_every - 1 > self.C:
+                if self._host_pos + P + req.max_new + self.sync_every - 1 > self.C:
                     self._queue.appendleft(req)
                     if any(r is not None for r in self._slot_req):
                         # Backpressure, not failure: let the in-flight slots
@@ -415,7 +534,7 @@ class ContinuousBatcher:
                     # retries everything (finished results stay banked).
                     raise RuntimeError(
                         f"cache capacity exhausted (pos={self._host_pos}, "
-                        f"need {P + self.max_new} more of {self.C}); raise "
+                        f"need {P + req.max_new} more of {self.C}); raise "
                         "max_cache_len, or catch this, reset(), and run() again."
                     )
                 row = np.full((P,), self.pad, np.int32)
@@ -427,6 +546,8 @@ class ContinuousBatcher:
                 self._cache, state, _fin0 = self._admit_fn(P)(
                     self.params, self._cache, state, s, row_j[0], mrow_j[0],
                     jnp.int32(req.rid), self._rng,
+                    jnp.int32(req.max_new), jnp.float32(req.temperature),
+                    jnp.int32(req.eos),
                 )
                 self._host_pos += P
                 # Keep the instance fields pointing at LIVE buffers: the admit

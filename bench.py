@@ -76,8 +76,11 @@ def main():
 
     on_tpu = resolve_backend() == "tpu"
     mode = os.environ.get("BENCH_CONFIG", "large" if on_tpu else "tiny")
-    if mode not in ("large", "long", "340m", "tiny", "moe"):
-        raise ValueError(f"BENCH_CONFIG must be large|long|340m|tiny|moe, got {mode!r}")
+    if mode not in ("large", "ref-shape", "long", "340m", "tiny", "moe", "moe-ceiling"):
+        raise ValueError(
+            "BENCH_CONFIG must be large|ref-shape|long|340m|tiny|moe|moe-ceiling, "
+            f"got {mode!r}"
+        )
     if mode == "large":
         # ~740M params — tuned on-chip (PERF.md): wider-and-shallower beats
         # deep at fixed params (fewer, larger matmuls per elementwise byte),
@@ -99,6 +102,25 @@ def main():
             remat_policy="dots_with_no_batch_dims_saveable",
         )
         batch, seq, steps, warmup = 12, 1024, 20, 3
+    elif mode == "ref-shape":
+        # The FIXED round-3 anchor shape (VERDICT r4 weak #2): h1408/L20/b8 is
+        # a Llama-proportioned ~725M tower, held constant round-over-round so
+        # framework regressions can't hide behind benchmark-shape choice. The
+        # 'large' config above is the swept-best shape and may move; this one
+        # must not. r3 measured 57.0% MFU here.
+        metric_name = "llama725m_refshape_train_mfu_per_chip"
+        cfg = LlamaConfig(
+            vocab_size=32000,
+            hidden_size=1408,
+            intermediate_size=5632,
+            num_hidden_layers=20,
+            num_attention_heads=11,  # head_dim 128
+            num_key_value_heads=11,
+            max_position_embeddings=1024,
+            remat=True,
+            remat_policy="dots_with_no_batch_dims_saveable",
+        )
+        batch, seq, steps, warmup = 8, 1024, 20, 3
     elif mode == "long":
         # Long-context datapoint (VERDICT r2 #3): same ~740M wide-shallow
         # model at S=4096 through the Mosaic flash kernel with tuned tiles
@@ -145,6 +167,32 @@ def main():
             remat=os.environ.get("BENCH_MOE_REMAT", "1") == "1",
             remat_policy="dots_with_no_batch_dims_saveable",
         )
+        # BENCH_MOE_CF sweeps the capacity factor (1.0 = no padding headroom,
+        # more drops; 4.0 = E/k drop-free); BENCH_MOE_SEQ the sequence length.
+        cfg.capacity_factor = float(os.environ.get("BENCH_MOE_CF", cfg.capacity_factor))
+        seq = int(os.environ.get("BENCH_MOE_SEQ", "1024"))
+        cfg.max_position_embeddings = seq
+        batch, steps, warmup = int(os.environ.get("BENCH_MOE_BATCH", "16")), 20, 3
+    elif mode == "moe-ceiling":
+        # Routing-free ceiling for the MoE config (VERDICT r4 ask #3): a DENSE
+        # model with intermediate_size = k·i — the same active FLOPs per token
+        # as BENCH_CONFIG=moe's router+top-2 experts, but zero routing,
+        # dispatch, padding, or combine work. Its MFU is the number the MoE
+        # path would measure if routing were free; the moe configs' gap to it
+        # is the true routing tax (their gap to 65% is mostly the narrower
+        # h1024 shape, not MoE-ness).
+        metric_name = "moe_ceiling_dense_active_mfu_per_chip"
+        cfg = LlamaConfig(
+            vocab_size=32000,
+            hidden_size=1024,
+            intermediate_size=5632,  # k=2 experts' worth of i=2816
+            num_hidden_layers=12,
+            num_attention_heads=8,
+            num_key_value_heads=8,
+            max_position_embeddings=1024,
+            remat=True,
+            remat_policy="dots_with_no_batch_dims_saveable",
+        )
         batch, seq, steps, warmup = int(os.environ.get("BENCH_MOE_BATCH", "16")), 1024, 20, 3
     elif mode == "340m":
         metric_name = "llama340m_train_mfu_per_chip"
@@ -175,7 +223,11 @@ def main():
     # adafactor in the large config: factored second moments cost ~0 extra HBM
     # (vs Adam's 8 bytes/param), which is what lets the dots-saveable remat
     # policy fit — the standard TPU-pretraining optimizer choice (T5/PaLM).
-    tx = optax.adafactor(3e-4) if mode in ("large", "long", "moe") else optax.adamw(3e-4)
+    tx = (
+        optax.adafactor(3e-4)
+        if mode in ("large", "ref-shape", "long", "moe", "moe-ceiling")
+        else optax.adamw(3e-4)
+    )
     pmodel, popt = accelerator.prepare(model, tx)
     step = accelerator.build_train_step(pmodel, popt)
 
@@ -225,6 +277,14 @@ def main():
                     "backend": jax.default_backend(),
                     "device": str(jax.devices()[0].device_kind),
                     "seq": seq,
+                    "batch": batch,
+                    # Explicit model shape (VERDICT r4 weak #2): the metric's
+                    # identity is (name, shape) — shape drift must be visible
+                    # in the JSON, not hidden behind a stable metric name.
+                    "shape": (
+                        f"h{cfg.hidden_size}/i{cfg.intermediate_size}"
+                        f"/L{cfg.num_hidden_layers}/a{cfg.num_attention_heads}"
+                    ),
                     "attention_impl": resolved_impl,
                     **(
                         # auto resolves to einsum at this shape (S<=2048,
@@ -241,10 +301,12 @@ def main():
 
 _FAIL_METRIC = {
     "large": "llama700m_train_mfu_per_chip",
+    "ref-shape": "llama725m_refshape_train_mfu_per_chip",
     "long": "llama700m_long4k_train_mfu_per_chip",
     "340m": "llama340m_train_mfu_per_chip",
     "tiny": "llama_tiny_train_mfu_per_chip",
     "moe": "moe8e_train_mfu_per_chip",
+    "moe-ceiling": "moe_ceiling_dense_active_mfu_per_chip",
 }
 
 if __name__ == "__main__":

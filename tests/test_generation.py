@@ -379,15 +379,28 @@ def test_assisted_b1_mask_trims_to_dense_prompt(model_and_params):
     np.testing.assert_array_equal(out, ref)
 
 
-def test_assisted_batched_rejects_windowed(model_and_params):
+def test_assisted_batched_windowed_exact(model_and_params):
+    """Sliding-window models are exact under BATCHED speculative decoding:
+    window masks measure valid-slot distance (ops/attention.py), so the
+    rejected-slot holes don't stretch the window. Output == the target's own
+    greedy decode per row (the speculative guarantee)."""
     from accelerate_tpu.generation import assisted_generate
-    from accelerate_tpu.models import Llama, LlamaConfig
 
-    windowed = Llama(LlamaConfig.tiny(num_hidden_layers=1, sliding_window=4))
+    windowed = Llama(LlamaConfig.tiny(num_hidden_layers=2, sliding_window=4))
     windowed.init_params(jax.random.key(9))
-    with pytest.raises(ValueError, match="sliding-window"):
-        assisted_generate(windowed, windowed, np.zeros((2, 4), np.int32),
-                          max_new_tokens=2)
+    rng = np.random.default_rng(58)
+    ids = rng.integers(1, 256, (2, 9)).astype(np.int32)
+    mask = np.ones((2, 9), np.int32)
+    mask[1, 6:] = 0
+    ids = np.where(mask, ids, 0).astype(np.int32)
+    ref = np.asarray(generate(windowed, ids, attention_mask=mask, max_new_tokens=7,
+                              temperature=0.0, cache_dtype=jnp.float32,
+                              include_prompt=False))
+    out = np.asarray(assisted_generate(
+        windowed, windowed, ids, attention_mask=mask, max_new_tokens=7,
+        num_draft_tokens=3, cache_dtype=jnp.float32, include_prompt=False,
+    ))
+    np.testing.assert_array_equal(out, ref)
 
 
 def test_generate_assistant_model_entry_point(model_and_params):
