@@ -15,6 +15,14 @@ from .config_args import ClusterConfig, default_config_file
 
 
 def _ask(prompt: str, default, cast=str, choices=None):
+    # Fixed-choice questions get the cursor menu on a real terminal (the
+    # reference's selection-menu UX); free-form values and non-TTY sessions
+    # (pipes, CI, tests mocking input()) keep the plain prompt contract.
+    if choices is not None:
+        from .menu import interactive_tty, select
+
+        if interactive_tty():
+            return select(prompt, choices, default=default)
     suffix = f" [{default}]" if default is not None else ""
     while True:
         raw = input(f"{prompt}{suffix}: ").strip()
@@ -32,6 +40,11 @@ def _ask(prompt: str, default, cast=str, choices=None):
 
 
 def _yesno(prompt: str, default: bool = False) -> bool:
+    from .menu import interactive_tty, select
+
+    if interactive_tty():
+        order = ["yes", "no"]
+        return select(prompt, order, default="yes" if default else "no") == "yes"
     raw = input(f"{prompt} [{'yes' if default else 'no'}]: ").strip().lower()
     if not raw:
         return default

@@ -79,6 +79,11 @@ class WhisperConfig:
 
 
 class WhisperForConditionalGeneration(Module):
+    # Encoder-decoder pipeline training: pp splits the DECODER stack, the
+    # encoder (fixed 30s audio window, runs once) stays pp-replicated — the
+    # same design as T5 (see T5ForConditionalGeneration's class docstring).
+    pipeline_capable = True
+
     def __init__(self, config: WhisperConfig):
         self.config = config
         self.params = None
@@ -147,10 +152,21 @@ class WhisperForConditionalGeneration(Module):
 
     # --------------------------------------------------------------- sharding
     def sharding_rules(self):
+        """tp/fsdp rules on both stacks; the DECODER layer stack additionally
+        shards its leading (layer) dim on ``pp`` — pipeline stages own
+        contiguous decoder blocks, the encoder stays pp-replicated (same
+        split as T5, see ``T5ForConditionalGeneration``'s class docstring)."""
         return [
             (r"decoder/embed", P("tp", "fsdp")),
             (r"decoder/pos", P(None, "fsdp")),
             (r"encoder/pos", P(None, "fsdp")),
+            (r"decoder/layers/.*attn/w[qkv]", P("pp", "fsdp", "tp")),
+            (r"decoder/layers/.*attn/b[qv]", P("pp", "tp")),
+            (r"decoder/layers/.*attn/wo", P("pp", "tp", "fsdp")),
+            (r"decoder/layers/mlp/w_in", P("pp", "fsdp", "tp")),
+            (r"decoder/layers/mlp/b_in", P("pp", "tp")),
+            (r"decoder/layers/mlp/w_out", P("pp", "tp", "fsdp")),
+            (r"decoder/layers/", P("pp")),  # per-layer biases/norms ride pp
             (r"attn/w[qkv]", P(None, "fsdp", "tp")),
             (r"attn/b[qv]", P(None, "tp")),
             (r"attn/wo", P(None, "tp", "fsdp")),
@@ -249,6 +265,20 @@ class WhisperForConditionalGeneration(Module):
         y, _ = jax.lax.scan(step, y, dec["layers"])
         return _layer_norm(y, dec["final_norm"]["scale"], dec["final_norm"]["bias"], eps)
 
+    def pipeline_layer_params(self, params):
+        """The pipelined stack (decoder layers) for resolve_pipeline_spec."""
+        return params["decoder"]["layers"]
+
+    def block(self, layer, x, ctx):
+        """One decoder block for the pipeline stage protocol — encoder output
+        and the optional decoder pad bias arrive via the microbatched context."""
+        cfg = self.config
+        return self._block(
+            layer, x, ctx["enc_out"], cfg.decoder_attention_heads,
+            cfg.layer_norm_eps, cross=True, causal=True,
+            enc_bias=ctx.get("enc_bias"), self_bias=ctx.get("self_bias"),
+        )
+
     def _head(self, params, y):
         return (y @ params["decoder"]["embed"].T.astype(y.dtype)).astype(jnp.float32)
 
@@ -268,6 +298,7 @@ class WhisperForConditionalGeneration(Module):
         labels=None,
         train: bool = False,
         rngs=None,
+        pipeline=None,
         **kwargs,
     ):
         if input_features is None:
@@ -285,7 +316,15 @@ class WhisperForConditionalGeneration(Module):
             self_bias = jnp.where(
                 decoder_attention_mask[:, None, None, :].astype(bool), 0.0, -1e30
             ).astype(jnp.float32)
-        y = self._decoder_stack(params, y, enc_out, self_bias=self_bias)
+        if pipeline is not None:
+            # GPipe over the decoder stack; encoder replicated (class note).
+            dec = params["decoder"]
+            ctx = {"enc_out": enc_out, "self_bias": self_bias}
+            y, _ = pipeline.run(self, dec["layers"], y, ctx)
+            y = _layer_norm(y, dec["final_norm"]["scale"],
+                            dec["final_norm"]["bias"], self.config.layer_norm_eps)
+        else:
+            y = self._decoder_stack(params, y, enc_out, self_bias=self_bias)
         logits = self._head(params, y)
         out = ModelOutput(logits=logits, encoder_last_hidden_state=enc_out)
         if labels is not None:

@@ -85,24 +85,32 @@ def top_k_routing(router_logits, k: int, capacity: int, dtype=jnp.float32):
     residual stream only, the standard Switch behavior).
 
     ``dtype`` sizes the C-width one-hot intermediates — the path's dominant
-    HBM traffic (the (B,S·k,E,C) slot tensor). Routing arithmetic (softmax,
-    cumsum ranks, aux) stays fp32 regardless; one-hot values are exact in any
-    float dtype, and gate values were cast to the compute dtype at the combine
-    einsum anyway, so bf16 here changes traffic, not semantics.
+    HBM traffic. Routing arithmetic (softmax, cumsum ranks, aux) stays fp32
+    regardless; one-hot values are exact in any float dtype, and gate values
+    were cast to the compute dtype at the combine einsum anyway, so bf16 here
+    changes traffic, not semantics.
+
+    Construction collapses the k dim BEFORE any C-width tensor exists:
+    ``top_k`` returns distinct experts per token, so a token holds at most
+    one claim per expert and the per-(token, expert) claim rank / kept flag /
+    gate reduce over k in O(B·S·k·E) — the C-width one-hot is then built
+    once at (B,S,E,C). The previous form materialized the (B,S·k,E,C) slot
+    tensor (k× the traffic) plus a 5-D max and a C-width combine einsum; the
+    r5 on-chip attribution measured that front-end at 5.1 ms/layer against
+    9.2 ms of expert matmuls (benchmarks/moe_op_attribution.py), which is
+    what paid for this rewrite.
     """
     B, S, E = router_logits.shape
     expert_idx, gate_vals, onehot, pos, keep, aux_loss = _route(router_logits, k, capacity)
-    slot = jnp.einsum(
-        "bte,btec->btec",
-        keep.astype(dtype),
-        jax.nn.one_hot(pos.astype(jnp.int32), capacity, dtype=dtype),
-    )
-    slot = slot.reshape(B, S, k, E, capacity)
+    keep4 = keep.reshape(B, S, k, E)  # {0,1}: claim kept under capacity
+    # Per (token, expert): rank of its (unique) claim, kept flag, gate value.
+    rank = jnp.sum(pos.reshape(B, S, k, E) * keep4, axis=2)  # (B,S,E)
+    claimed = jnp.max(keep4, axis=2)  # (B,S,E)
+    gate_e = jnp.einsum("bske,bsk->bse", keep4, gate_vals)  # 0 when dropped
 
-    dispatch = jnp.max(slot, axis=2)  # (B,S,E,C) — a token occupies ≤1 slot per expert
-    combine = jnp.einsum(
-        "bske,bskec->bsec", (onehot * gate_vals[..., None]).astype(dtype), slot
-    )
+    slotoh = jax.nn.one_hot(rank.astype(jnp.int32), capacity, dtype=dtype)  # (B,S,E,C)
+    dispatch = claimed.astype(dtype)[..., None] * slotoh
+    combine = gate_e.astype(dtype)[..., None] * slotoh
     return dispatch, combine, aux_loss
 
 
@@ -265,13 +273,13 @@ def moe_ffn(x, router_w, w_gate, w_up, w_down, *, k: int, capacity_factor: float
 
     - ep > 1 in the mesh → **einsum** (the ep-shardable form; ragged_dot's
       group dim is opaque to the partitioner).
-    - otherwise, short sequences at modest capacity → **einsum** too: measured
-      on v5e at the bench shape (E8 k2 cf1.25, h1024/i2816), einsum's dense
-      dispatch matmuls beat sort+``lax.ragged_dot`` end-to-end 33.9% vs 25.5%
-      active-MFU at S=1024 and tie (28.6% vs 28.4%) at S=4096 — the grouped
-      custom-call is real MXU work but the sort/gather/scatter wrapper costs
-      more than einsum's extra dispatch FLOPs until the O(S·E·C) dispatch
-      tensors get large (PERF.md).
+    - otherwise, short sequences at modest capacity → **einsum** too: the r5
+      op-level attribution (PERF.md; benchmarks/moe_op_attribution.py) shows
+      ``lax.ragged_dot`` runs 31% below the dense per-expert einsums at the
+      bench shape (127 vs 181 TF/s fwd+bwd) and the row gathers cost more
+      than einsum's dispatch matmuls — end-to-end einsum 42.6% vs sorted
+      27.7% active-MFU at S=1024/cf1.0 on v5e; sorted ties einsum near
+      S=4096 (30.8% vs 31.3%).
     - long sequences or drop-free capacity → **sorted** (einsum memory is
       O(S²) at Mixtral's drop-free cf = E/k).
 

@@ -517,12 +517,27 @@ class ContinuousBatcher:
                 self._sync(state)
             for s in range(self.B):
                 self._collect(s, active_np)
+            # Capacity reservation must cover the LONGEST remaining run among
+            # active slots, not just the incoming request's own max_new:
+            # decode windows consume global columns until the longest-running
+            # request finishes, so a short admit reserving only its own
+            # length would let a long-running neighbor push cache['pos'] past
+            # max_cache_len with no runtime guard (the clamped writes would
+            # silently corrupt the last column). r5 review finding.
+            n_np = np.asarray(state[2])
+            max_remaining = max(
+                (self._slot_req[s].max_new - int(n_np[s])
+                 for s in range(self.B)
+                 if self._slot_req[s] is not None and active_np[s]),
+                default=0,
+            )
             free = [s for s in range(self.B) if self._slot_req[s] is None]
             while free and self._queue:
                 req = self._queue.popleft()
                 s = free.pop(0)
                 P = self._bucket(req.prompt.size)
-                if self._host_pos + P + req.max_new + self.sync_every - 1 > self.C:
+                reserve = max(req.max_new, max_remaining)
+                if self._host_pos + P + reserve + self.sync_every - 1 > self.C:
                     self._queue.appendleft(req)
                     if any(r is not None for r in self._slot_req):
                         # Backpressure, not failure: let the in-flight slots
@@ -534,7 +549,7 @@ class ContinuousBatcher:
                     # retries everything (finished results stay banked).
                     raise RuntimeError(
                         f"cache capacity exhausted (pos={self._host_pos}, "
-                        f"need {P + req.max_new} more of {self.C}); raise "
+                        f"need {P + reserve} more of {self.C}); raise "
                         "max_cache_len, or catch this, reset(), and run() again."
                     )
                 row = np.full((P,), self.pad, np.int32)
@@ -555,6 +570,7 @@ class ContinuousBatcher:
                 # this pass must leave the engine in a clean recoverable state.
                 self._sync(state)
                 self._slot_req[s] = req
+                max_remaining = max(max_remaining, req.max_new)
                 # (an immediate-eos slot is collected at the next loop-top
                 # check — no blocking readback of the admit result here)
             if not self._queue and not any(r is not None for r in self._slot_req):

