@@ -48,7 +48,18 @@ def _read_key() -> str:
         if ch == "\x1b":
             nxt = sys.stdin.read(1)
             if nxt == "[":
-                return "\x1b[" + sys.stdin.read(1)
+                # CSI sequences end at a final byte in @..~ (0x40-0x7e);
+                # parameterized forms (Shift+Down = \x1b[1;2B, PgUp =
+                # \x1b[5~) carry parameter bytes first — consume the whole
+                # sequence so leftovers can't replay as fake keypresses.
+                seq = "\x1b["
+                while True:
+                    b = sys.stdin.read(1)
+                    if not b:
+                        return seq
+                    seq += b
+                    if "@" <= b <= "~":
+                        return seq
             return ch
         return ch
     finally:

@@ -61,6 +61,14 @@ from ..ops.norms import layer_norm
 
 
 class BertForSequenceClassification(Module):
+    # Encoder pipeline training (Megatron's BertTrainStep parity, reference
+    # utils/megatron_lm.py:445): the encoder stack splits across pp stages
+    # through the same GPipe schedule as the decoder families — the stage
+    # protocol below (embed/block/head) was already pipeline-shaped. Dropout
+    # must be off under the pipeline (the stage body carries no rng channel);
+    # apply() raises rather than silently changing the training recipe.
+    pipeline_capable = True
+
     def __init__(self, config: BertConfig):
         self.config = config
         self.params = None
@@ -196,12 +204,23 @@ class BertForSequenceClassification(Module):
         labels=None,
         train: bool = False,
         rngs=None,
+        pipeline=None,
         **kwargs,
     ):
         cfg = self.config
         x, ctx = self.embed(params, input_ids, None, attention_mask, token_type_ids)
         dropout_rng = (rngs or {}).get("dropout") if train else None
         drop_rate = cfg.hidden_dropout_prob if train else 0.0
+
+        if pipeline is not None:
+            if drop_rate > 0.0 and dropout_rng is not None:
+                raise ValueError(
+                    "Pipelined BERT training has no per-stage dropout rng "
+                    "channel; set hidden_dropout_prob=0.0 (or train without "
+                    "the pipeline) rather than silently dropping dropout."
+                )
+            x, _ = pipeline.run(self, params["layers"], x, ctx)
+            return self.head(params, x, labels=labels, attention_mask=attention_mask)
 
         def scan_body(carry, layer):
             x, rng = carry

@@ -454,13 +454,14 @@ def _pipeline_train_grads(spec, module, params, batch, compute_dtype=jnp.float32
     boundary stages pay them (r4 ran them on every stage each tick — a
     ~(1+2(P-1)/M)x head tax, VERDICT r4 weak #4); pinned by the HLO test
     (head dot nested under ``conditional``, never in the unconditional tick
-    body) and executed green by the numerics tests. With tp or fsdp axes in
-    the mesh the select form (compute-everywhere, pick the boundary stage's
-    result) is kept: the cond there deadlocks XLA CPU's in-process
-    communicator — observed r5 as the fwd-ring and bwd-ring ppermutes
-    cross-scheduled across devices once the branches perturb thunk order
-    (4-of-8 rendezvous timeout, rendezvous.cc) — and an on-host repro is
-    the gate for ever shipping that composition. On those meshes the
+    body) and executed green by the numerics tests. With ANY in-stage
+    collective axis in the mesh (tp, fsdp, ep, sp) the select form
+    (compute-everywhere, pick the boundary stage's result) is kept: the cond
+    there deadlocks XLA CPU's in-process communicator — observed r5 as the
+    fwd-ring and bwd-ring ppermutes cross-scheduled across devices once the
+    branches perturb thunk order (4-of-8 rendezvous timeout, rendezvous.cc)
+    — and an on-host repro is the gate for ever shipping those
+    compositions. On those meshes the
     sealed-axes pre-gather already replicates the head params; the waste is
     the boundary matmul replay, not extra collectives. Consequently NO
     (B, S, H) tensor ever crosses the shard_map boundary: stage-layer
@@ -546,11 +547,13 @@ def _pipeline_train_grads(spec, module, params, batch, compute_dtype=jnp.float32
     # head-waste measurement (PERF.md).
     import os as _os
 
-    cond_safe = (
-        mesh.shape.get("tp", 1) == 1
-        and mesh.shape.get("fsdp", 1) == 1
-        and _os.environ.get("ACCELERATE_PP_HEAD_SELECT", "0") != "1"
-    )
+    # Any in-stage collective axis (tp/fsdp partial sums and gathers, ep
+    # expert combines, sp ring/Ulysses permutes) disqualifies the cond — the
+    # deadlock mechanism is branch-perturbed thunk ordering against ANY
+    # unconditional in-body collective, not tp/fsdp specifically.
+    cond_safe = all(
+        mesh.shape.get(ax, 1) == 1 for ax in ("tp", "fsdp", "ep", "sp")
+    ) and _os.environ.get("ACCELERATE_PP_HEAD_SELECT", "0") != "1"
 
     def stage_select(pred, on_true, on_false):
         if cond_safe:

@@ -670,6 +670,12 @@ def test_selection_menu_cursor_navigation():
     assert got == "1f1b"
     with pytest.raises(KeyboardInterrupt):
         select("Pick", ["a"], read_key=feed(["\x03"]), out=io.StringIO())
+    # Parameterized CSI sequences (Shift+Down = ESC [ 1 ; 2 B) arrive whole
+    # and are ignored — their parameter bytes must not replay as fake
+    # keypresses (a stray "2" would teleport the highlight).
+    got = select("Pick", ["a", "b", "c"],
+                 read_key=feed(["\x1b[1;2B", "\r"]), out=io.StringIO())
+    assert got == "a"
 
 
 def test_wizard_uses_menu_on_tty(monkeypatch):

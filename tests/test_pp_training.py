@@ -521,23 +521,67 @@ def test_t5_rejects_1f1b():
         acc.prepare(model, optax.sgd(0.01))
 
 
-def test_bert_warns_loudly_on_pp_mesh(caplog):
-    """A pp mesh under a non-pipelinable model (BERT) must WARN about the
-    GSPMD fallback, not silently degrade (VERDICT r4 ask #4)."""
+def test_non_pipelinable_model_warns_loudly_on_pp_mesh(caplog):
+    """A pp mesh under a non-pipelinable model must WARN about the GSPMD
+    fallback, not silently degrade (VERDICT r4 ask #4). ViT is the remaining
+    non-capable family now that BERT pipelines."""
     import logging
 
-    from accelerate_tpu.models import BertConfig, BertForSequenceClassification
+    from accelerate_tpu.models.vit import ViTConfig, ViTForImageClassification
 
     AcceleratorState._reset_state(reset_partial_state=True)
     GradientState._reset_state()
     acc = Accelerator(parallelism_config=ParallelismConfig(pp_size=2))
-    model = BertForSequenceClassification(BertConfig.tiny())
+    model = ViTForImageClassification(ViTConfig.tiny())
     model.init_params(jax.random.key(0))
     with caplog.at_level(logging.WARNING, logger="accelerate_tpu.parallel.pipeline"):
         acc.prepare(model, optax.sgd(0.01))
     assert any("not pipeline-capable" in r.message for r in caplog.records), (
         [r.message for r in caplog.records]
     )
+
+
+def test_bert_encoder_pipelines_pp2():
+    """BERT pipeline-trains across pp stages (Megatron BertTrainStep parity):
+    pp2 losses match the unsharded run; dropout under the pipeline raises
+    instead of silently turning off."""
+    from accelerate_tpu.models import BertConfig, BertForSequenceClassification
+
+    def run(pcfg):
+        AcceleratorState._reset_state(reset_partial_state=True)
+        GradientState._reset_state()
+        acc = Accelerator(parallelism_config=pcfg)
+        model = BertForSequenceClassification(
+            BertConfig.tiny(num_hidden_layers=4, hidden_dropout_prob=0.0)
+        )
+        model.init_params(jax.random.key(0))
+        pmodel, popt = acc.prepare(model, optax.sgd(0.01))
+        ids = np.random.default_rng(0).integers(3, 100, (8, 12)).astype(np.int32)
+        lab = np.random.default_rng(1).integers(0, 2, (8,)).astype(np.int32)
+        step = acc.build_train_step(pmodel, popt)
+        return [float(step({"input_ids": ids, "labels": lab})) for _ in range(2)], pmodel
+
+    base, _ = run(ParallelismConfig())
+    pp, pmodel = run(ParallelismConfig(pp_size=2, tp_size=2))
+    np.testing.assert_allclose(pp, base, rtol=1e-5)
+    assert pmodel.handle.pipeline_spec is not None
+    wq = pmodel.params["layers"]["attn"]["wq"]
+    assert wq.sharding.spec[0] == "pp", wq.sharding
+
+    # Dropout under the pipeline: loud error, not a silent recipe change.
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+    acc = Accelerator(parallelism_config=ParallelismConfig(pp_size=2))
+    model = BertForSequenceClassification(
+        BertConfig.tiny(num_hidden_layers=4, hidden_dropout_prob=0.1)
+    )
+    model.init_params(jax.random.key(0))
+    pmodel, popt = acc.prepare(model, optax.sgd(0.01))
+    step = acc.build_train_step(pmodel, popt)
+    ids = np.zeros((8, 12), np.int32)
+    lab = np.zeros((8,), np.int32)
+    with pytest.raises(ValueError, match="dropout"):
+        step({"input_ids": ids, "labels": lab})
 
 
 def _hlo_computations(hlo: str):
