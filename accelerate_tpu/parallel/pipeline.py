@@ -740,14 +740,14 @@ def resolve_pipeline_spec(module, params, mesh: Mesh, num_microbatches: int = 0,
         return None
     if not getattr(module, "pipeline_capable", False):
         # Loud, not silent (VERDICT r4 ask #4): a pp mesh under a
-        # non-pipelinable model (BERT's bidirectional stack) degrades to
+        # non-pipelinable model (ViT is the remaining family) degrades to
         # GSPMD layer-dim sharding, which all-gathers stage weights every
         # step — the user asked for pipeline stages and isn't getting them.
         logger.warning(
             "pp=%d requested but %s is not pipeline-capable: falling back to "
             "GSPMD layer-dim sharding (all-gathers stage weights every step). "
-            "Use a pipeline-capable model family (Llama/GPT-2/GPT-NeoX/T5) or "
-            "drop pp from the mesh.", pp, type(module).__name__,
+            "Use a pipeline-capable family (the decoder zoo, BERT, T5, "
+            "Whisper) or drop pp from the mesh.", pp, type(module).__name__,
         )
         return None
     # The pipelined layer stack: modules whose stack lives elsewhere than

@@ -47,16 +47,26 @@ def main():
     system_prompt = rng.integers(1, cfg.vocab_size, 24).astype(np.int32)
     engine.set_prefix(system_prompt)  # prefilled once, shared by every slot
 
-    # Six ragged user turns; each submits only its suffix.
+    # Six ragged user turns; each submits only its suffix — and each may carry
+    # its OWN generation controls (length / temperature / eos / stop
+    # sequences), heterogeneously within the wave, with no recompiles.
     turns = [rng.integers(1, cfg.vocab_size, int(n)).astype(np.int32)
              for n in rng.integers(3, 14, 6)]
-    rids = [engine.submit(t) for t in turns]
+    rids = [
+        engine.submit(turns[0]),                           # engine defaults
+        engine.submit(turns[1], max_new_tokens=3),         # short completion
+        engine.submit(turns[2], temperature=0.8),          # sampled
+        engine.submit(turns[3], stop_sequences=[[7, 7]]),  # stop on a bigram
+        engine.submit(turns[4]),
+        engine.submit(turns[5], max_new_tokens=5),
+    ]
     outputs = engine.run()
 
     for rid, turn in zip(rids, turns):
         print(f"request {rid}: {len(turn)}-token turn -> {outputs[rid].tolist()}")
     print(f"cache columns used: {engine.cache_columns_used} "
-          f"(prefix paid once: {len(system_prompt)})")
+          f"(prefix paid once: {len(system_prompt)}); "
+          f"utilization {engine.cache_utilization:.2f}")
 
 
 if __name__ == "__main__":

@@ -381,3 +381,30 @@ def test_capacity_reservation_covers_longest_active_request(llama):
     outs2 = engine.run()
     if r_short in outs2:
         np.testing.assert_array_equal(outs2[r_short], _solo(llama, short_p, 24)[:2])
+
+
+def test_prefix_caching_composes_with_per_request_controls(llama):
+    """set_prefix + heterogeneous per-request settings in one wave: each
+    output equals the solo decode of prefix + suffix under that request's own
+    controls (the two r5 serving features compose)."""
+    rng = np.random.default_rng(101)
+    prefix = rng.integers(1, 256, (10,)).astype(np.int32)
+    sufs = [rng.integers(1, 256, (n,)).astype(np.int32) for n in (4, 6, 3)]
+    solos = [_solo(llama, np.concatenate([prefix, s]), 8) for s in sufs]
+    engine = ContinuousBatcher(llama, batch_slots=2, max_new_tokens=8,
+                               max_cache_len=512, cache_dtype=jnp.float32,
+                               bucket_sizes=(8,), sync_every=2)
+    engine.set_prefix(prefix)
+    r0 = engine.submit(sufs[0], max_new_tokens=3)
+    r1 = engine.submit(sufs[1], temperature=0.0)
+    r2 = engine.submit(sufs[2], stop_sequences=[solos[2][1:3]])
+    outs = engine.run()
+    np.testing.assert_array_equal(outs[r0], solos[0][:3])
+    np.testing.assert_array_equal(outs[r1], solos[1])  # full 8 tokens, no eos
+    # Independent oracle for the stop cut: the earliest window of solos[2]
+    # equal to the bigram, end-inclusive — computed here, not via the
+    # engine's own helper.
+    stop2 = solos[2][1:3]
+    ends = [i + 2 for i in range(len(solos[2]) - 1)
+            if np.array_equal(solos[2][i:i + 2], stop2)]
+    np.testing.assert_array_equal(outs[r2], solos[2][: min(ends)])
