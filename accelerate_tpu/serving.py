@@ -19,10 +19,13 @@ scheduling idea of vLLM/Orca, shaped for XLA's static-compilation model:
   positions ride the separate per-row ``positions`` channel, so absolute- and
   rotary-position models are exact.
 - The cost of that simplicity is cache capacity: slots consume global cache
-  columns even while other rows hole them out, so ``max_cache_len`` should be
-  sized to roughly the total tokens (prompt + generated) the engine will see
-  between full drains, not to a single sequence. The engine raises an
-  actionable error when capacity would overflow instead of corrupting state.
+  columns even while other rows hole them out. ``compact()`` reclaims the
+  holes — a stable full-cache gather pulls each row's valid columns to the
+  front, drops retired requests' columns, and rewinds the write offset —
+  and runs automatically at the backpressure point, so ``max_cache_len``
+  sizes to the working set of concurrently LIVE tokens, not the whole
+  queue. A genuinely-too-small cache still raises an actionable error
+  instead of corrupting state.
 
 **Prefix caching** (``set_prefix``): a prompt prefix shared by every request
 (system prompt, few-shot block, a long document) is prefilled ONCE into the
@@ -161,6 +164,12 @@ class ContinuousBatcher:
         self._admit_fns: dict[tuple, object] = {}
         self._prefix_fns: dict[int, object] = {}
         self._decode_fn = None
+        self._compact_fn = None
+        # Compaction reclaims columns only when something RETIRED since the
+        # last compact (retirement is what creates dead columns); keying the
+        # auto-trigger on this flag — not on position movement — keeps
+        # sustained backpressure from re-gathering the cache every window.
+        self._retired_since_compact = False
         self._prefix_tokens: np.ndarray | None = None
         self.reset()
 
@@ -187,9 +196,11 @@ class ContinuousBatcher:
         self._slot_eos = jnp.full((B,), self.eos, jnp.int32)
         self._slot_req: list[_Request | None] = [None] * B
         # Host-side mirror of cache["pos"]: it advances deterministically
-        # (+bucket per admit, +sync_every per decode window), so capacity
-        # checks never need a device readback.
+        # (+bucket per admit, +sync_every per decode window; compact() rewinds
+        # it from the one readback it already pays), so capacity checks never
+        # need a device readback.
         self._host_pos = 0
+        self._retired_since_compact = False
         # Shared-prefix state: number of leading cache columns holding the
         # common prefix (valid for every slot, never evicted).
         self._pfx = 0
@@ -267,16 +278,67 @@ class ContinuousBatcher:
         reclaims. Public mirror of the engine's host-side position counter."""
         return self._host_pos
 
+    def compact(self) -> int:
+        """Reclaim holed cache columns: gather each row's VALID slots to the
+        front (stable, so relative order is preserved) and rewind the global
+        write offset to the longest row's valid count. Returns the number of
+        columns freed.
+
+        Why this is exact (pinned by tests): rope/wpe rotations are baked
+        into K at write time and ride the gather unchanged; causal masking
+        needs only slot ORDER (every valid key lands below the new write
+        offset); sliding windows measure valid-slot distance, which a
+        permutation of holes cannot change; and the shared prefix — valid in
+        every row, first in every row's order — keeps columns [0, pfx).
+        In-flight slot state (rope positions, output buffers) is untouched.
+
+        Cost: one full-cache gather (O(L·B·C·H·D) bytes), so it runs when
+        capacity pressure makes the alternative a dead-end — ``run()``
+        triggers it automatically on backpressure — or explicitly between
+        waves. This is the compaction step the r5 utilization measurement
+        motivated (PERF.md): a wave of heterogeneous lengths reclaims the
+        ~90% of consumed area that holes occupy instead of requiring
+        ``reset()``."""
+        if self._host_pos == 0:
+            return 0
+        if self._compact_fn is None:
+            def run(cache, dead, pfx):
+                km = cache["kv_mask"]
+                # A retired request's columns stay valid until its slot is
+                # re-admitted (eviction is lazy); compaction is exactly when
+                # they die — their output is already collected. Prefix
+                # columns survive (valid for every future occupant).
+                col = jnp.arange(km.shape[1])[None]
+                km = jnp.where(dead[:, None] & (col >= pfx), 0, km)
+                # Stable argsort of (1 - valid): valid slots first, in order.
+                perm = jnp.argsort(1 - km, axis=1, stable=True)  # (B, C)
+                pk = perm[None, :, :, None, None]
+                return {
+                    "k": jnp.take_along_axis(cache["k"], pk, axis=2),
+                    "v": jnp.take_along_axis(cache["v"], pk, axis=2),
+                    "kv_mask": jnp.take_along_axis(km, perm, axis=1),
+                    "pos": jnp.max(jnp.sum(km, axis=1)).astype(cache["pos"].dtype),
+                }
+
+            self._compact_fn = jax.jit(run, donate_argnums=(0,))
+        dead = jnp.asarray([r is None for r in self._slot_req])
+        self._cache = self._compact_fn(self._cache, dead, jnp.int32(self._pfx))
+        new_pos = int(self._cache["pos"])
+        freed = self._host_pos - new_pos
+        self._host_pos = new_pos
+        self._retired_since_compact = False
+        return freed
+
     @property
     def cache_utilization(self) -> float:
         """Fraction of the consumed cache area (B rows × ``cache_columns_used``
         columns) whose slots are valid for their row — the engine's capacity
         honesty metric. Holes from eviction, retired requests, and
         inactive-row decode writes all count against it, so under
-        heterogeneous lengths this DECAYS across a wave (columns are never
-        reclaimed until ``reset()``); measured decay motivates sizing
-        ``max_cache_len`` to total wave tokens (see tests/test_serving.py's
-        utilization test and PERF.md)."""
+        heterogeneous lengths this decays across a wave until ``compact()``
+        (auto-triggered at backpressure, or explicit) reclaims the holes;
+        the r5 measured decay that motivated compaction is recorded in
+        PERF.md."""
         if self._host_pos == 0:
             return 1.0
         km = np.asarray(jax.device_get(self._cache["kv_mask"]))[:, : self._host_pos]
@@ -483,6 +545,7 @@ class ContinuousBatcher:
             row = row[:end]
         self._results[req.rid] = row
         self._slot_req[s] = None
+        self._retired_since_compact = True  # its columns are now reclaimable
 
     def _sync(self, state):
         (self._tok, self._pos, self._n_out, self._active, self._out_buf,
@@ -537,7 +600,15 @@ class ContinuousBatcher:
                 s = free.pop(0)
                 P = self._bucket(req.prompt.size)
                 reserve = max(req.max_new, max_remaining)
-                if self._host_pos + P + reserve + self.sync_every - 1 > self.C:
+                need = P + reserve + self.sync_every - 1
+                if self._host_pos + need > self.C and self._retired_since_compact:
+                    # Capacity pressure + something retired since the last
+                    # compact: reclaim its columns before deferring or
+                    # dead-ending. The retirement flag (not position
+                    # movement) gates this, so sustained backpressure while
+                    # one long request runs never re-gathers the cache.
+                    self.compact()
+                if self._host_pos + need > self.C:
                     self._queue.appendleft(req)
                     if any(r is not None for r in self._slot_req):
                         # Backpressure, not failure: let the in-flight slots

@@ -104,27 +104,30 @@ def test_continuous_batching_no_recompile_across_requests(llama):
     assert admit_compiles == 1 and decode_compiles == 1
 
 
-def test_continuous_batching_capacity_recovery_and_guards(llama):
+def test_continuous_batching_capacity_compaction_and_guards(llama):
+    """Auto-compaction: the retired first request's columns are reclaimed at
+    the backpressure point, so a cache sized for ONE request serves a queue
+    of them in a single run() (this scenario raised and required reset()
+    before r5's compact()). A cache too small for even one request still
+    dead-ends loudly — compaction has nothing to reclaim there."""
     engine = ContinuousBatcher(llama, batch_slots=1, max_new_tokens=8,
                                max_cache_len=16, cache_dtype=jnp.float32,
                                bucket_sizes=(8,), sync_every=1)
     p = np.arange(1, 6, dtype=np.int32)
     r1 = engine.submit(p)
-    r2 = engine.submit(p)  # second cannot fit in 16 slots
-    with pytest.raises(RuntimeError, match="capacity"):
-        engine.run()
-    # documented recovery: reset + run retries every unfinished request
-    engine.reset()
+    r2 = engine.submit(p)  # only fits after r1's columns are compacted away
     outs = engine.run()
-    assert set(outs) >= {r2}  # the re-queued victims all finish
-    collected = dict(outs)
-    while engine._queue or any(s is not None for s in engine._slot_req):
-        engine.reset()
-        collected.update(engine.run())
-    assert set(collected) == {r1, r2}
-    np.testing.assert_array_equal(collected[r1], collected[r2])  # same prompt
+    assert set(outs) == {r1, r2}
+    np.testing.assert_array_equal(outs[r1], outs[r2])  # same prompt
+    np.testing.assert_array_equal(outs[r1], _solo(llama, p, 8)[: len(outs[r1])])
     with pytest.raises(ValueError, match="bucket"):
         engine.submit(np.arange(1, 11, dtype=np.int32))  # > largest bucket
+    tiny = ContinuousBatcher(llama, batch_slots=1, max_new_tokens=8,
+                             max_cache_len=12, cache_dtype=jnp.float32,
+                             bucket_sizes=(8,), sync_every=1)
+    tiny.submit(p)
+    with pytest.raises(RuntimeError, match="capacity"):
+        tiny.run()
     # (sliding-window models are no longer rejected — valid-slot-distance
     # windows serve them exactly: test_windowed_model_serves_exactly)
 
@@ -408,3 +411,47 @@ def test_prefix_caching_composes_with_per_request_controls(llama):
     ends = [i + 2 for i in range(len(solos[2]) - 1)
             if np.array_equal(solos[2][i:i + 2], stop2)]
     np.testing.assert_array_equal(outs[r2], solos[2][: min(ends)])
+
+
+def test_compaction_preserves_exactness_with_prefix_and_windows():
+    """compact() mid-service: outputs stay token-identical to solo decode for
+    a SLIDING-WINDOW model with a shared prefix — the hardest layout case
+    (rope baked into K, valid-distance windows, prefix pinned at the cache
+    head). Three waves through a cache sized for ~one wave."""
+    model = Llama(LlamaConfig.tiny(num_hidden_layers=2, num_attention_heads=4,
+                                   num_key_value_heads=2, sliding_window=5))
+    model.init_params(jax.random.key(21))
+    rng = np.random.default_rng(102)
+    prefix = rng.integers(1, 256, (6,)).astype(np.int32)
+    sufs = [rng.integers(1, 256, (n,)).astype(np.int32) for n in (5, 7, 4, 6, 5, 7)]
+    engine = ContinuousBatcher(model, batch_slots=2, max_new_tokens=6,
+                               max_cache_len=64, cache_dtype=jnp.float32,
+                               bucket_sizes=(8,), sync_every=2)
+    engine.set_prefix(prefix)
+    rids = [engine.submit(s) for s in sufs]
+    outs = engine.run()  # compaction triggers under this capacity
+    for rid, s in zip(rids, sufs):
+        ref = _solo(model, np.concatenate([prefix, s]), 6)
+        np.testing.assert_array_equal(outs[rid], ref[: len(outs[rid])], err_msg=f"rid {rid}")
+    assert engine._pfx == 6  # prefix survived compaction at the cache head
+
+
+def test_explicit_compact_reclaims_columns(llama):
+    """compact() between waves reclaims the holes the utilization metric
+    measures, without reset() (results and queue untouched)."""
+    engine = ContinuousBatcher(llama, batch_slots=2, max_new_tokens=6,
+                               max_cache_len=512, cache_dtype=jnp.float32,
+                               bucket_sizes=(8, 16), sync_every=2)
+    rng = np.random.default_rng(103)
+    rids = [engine.submit(rng.integers(1, 256, (n,)).astype(np.int32))
+            for n in (5, 12, 7, 4)]
+    engine.run()
+    used_before = engine.cache_columns_used
+    freed = engine.compact()
+    assert freed > 0 and engine.cache_columns_used == used_before - freed
+    assert engine.cache_utilization >= 0.4  # retired holes reclaimed
+    # The engine still serves exactly after an explicit compact.
+    p = rng.integers(1, 256, (6,)).astype(np.int32)
+    r = engine.submit(p)
+    out = engine.run()[r]
+    np.testing.assert_array_equal(out, _solo(llama, p, 6)[: len(out)])
