@@ -672,12 +672,28 @@ class Accelerator:
             return "model"
         if hasattr(obj, "init") and hasattr(obj, "apply"):
             return "model"
+        from .modules import is_torch_module
+
+        if is_torch_module(obj):
+            # Route to prepare_model → as_module, whose error points at from_hf.
+            return "model"
         if hasattr(obj, "__iter__") and not callable(obj):
             return "dataloader"
         if _is_torch_dataloader(obj):
             return "dataloader"
         if callable(obj):
-            return "scheduler"
+            # Only a schedule (int step -> lr, optax convention) belongs here.
+            # Anything else callable — a loss function, a metric, a model
+            # factory — must not be silently wrapped in AcceleratedScheduler.
+            if _looks_like_schedule(obj):
+                return "scheduler"
+            raise TypeError(
+                f"prepare() received a callable ({getattr(obj, '__name__', type(obj).__name__)}) "
+                "that does not look like an LR schedule (a schedule takes a single "
+                "integer step count, e.g. optax.cosine_decay_schedule(...)). Loss "
+                "functions are registered with accelerator.set_loss_fn(...), and "
+                "models must expose init/apply (see accelerate_tpu.modules.as_module)."
+            )
         return "other"
 
     def prepare_model(self, model, device_placement=None, evaluation_mode: bool = False):
@@ -1073,12 +1089,19 @@ class Accelerator:
 
     def gather_for_metrics(self, input_data, use_gather_object: bool = False):
         """Gather and drop the duplicated tail samples of the final batch
-        (reference :2751-2823)."""
-        try:
-            all_tensors = ops.gather(input_data) if not use_gather_object else ops.gather_object(input_data)
-        except Exception:
+        (reference :2751-2823).
+
+        Non-tensor payloads (strings, object-dtype arrays, arbitrary
+        picklables) route through ``gather_object`` *by detection*, not by
+        catching everything: a genuine collective failure (shape mismatch,
+        dead host, backend error) on tensor data must surface, not silently
+        degrade to the pickle path."""
+        if not use_gather_object and self.num_processes > 1:
+            use_gather_object = _has_object_leaves(input_data)
+        if use_gather_object:
             all_tensors = ops.gather_object(input_data)
-            use_gather_object = True
+        else:
+            all_tensors = ops.gather(input_data)
         if not self.gradient_state.end_of_dataloader:
             return all_tensors
         remainder = self.gradient_state.remainder
@@ -1219,6 +1242,40 @@ class Accelerator:
 
     def __repr__(self):
         return f"Accelerator(state={self.state!r})"
+
+
+def _looks_like_schedule(obj) -> bool:
+    """Heuristic for optax-style LR schedules: a callable whose signature
+    accepts exactly one required positional argument (the step count).
+    Unsignaturable callables (C extensions) pass — AcceleratedScheduler's own
+    ``schedule(0)`` probe is the backstop there."""
+    import inspect
+
+    try:
+        sig = inspect.signature(obj)
+    except (TypeError, ValueError):
+        return True
+    required = [
+        p for p in sig.parameters.values()
+        if p.default is inspect.Parameter.empty
+        and p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+    ]
+    has_varargs = any(p.kind == p.VAR_POSITIONAL for p in sig.parameters.values())
+    return len(required) == 1 or (len(required) == 0 and has_varargs)
+
+
+def _has_object_leaves(data) -> bool:
+    """True when ``data`` contains a leaf the tensor all-gather cannot carry:
+    an object/string-dtype array, or any non-array leaf (str, None, dataclass,
+    ...) other than plain numbers inside the nested containers."""
+    if isinstance(data, (list, tuple)):
+        return any(_has_object_leaves(v) for v in data)
+    if isinstance(data, dict):
+        return any(_has_object_leaves(v) for v in data.values())
+    if ops.is_tensor_like(data):
+        dtype = np.asarray(data).dtype if not hasattr(data, "dtype") else data.dtype
+        return dtype == object or np.issubdtype(dtype, np.str_) or np.issubdtype(dtype, np.bytes_)
+    return not isinstance(data, (int, float, complex, bool, np.number))
 
 
 def _is_torch_dataloader(obj) -> bool:

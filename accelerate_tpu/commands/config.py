@@ -118,6 +118,14 @@ def get_user_input() -> ClusterConfig:
             # File-backed trackers need a logging root; without one every
             # launched process would fail at Accelerator() startup.
             project_dir = _ask("  trackers need a logging root — project directory", ".")
+    compile_cache_dir = ""
+    if _yesno(
+        "Enable the persistent XLA compilation cache (restarted jobs skip recompiles)?",
+        False,
+    ):
+        compile_cache_dir = _ask(
+            "  compilation cache directory", "~/.cache/accelerate_tpu/xla_cache"
+        )
     mixed_precision = _ask(
         "Do you wish to use mixed precision? (no/bf16/fp16/fp8)", "bf16", str, ["no", "bf16", "fp16", "fp8"]
     )
@@ -148,6 +156,7 @@ def get_user_input() -> ClusterConfig:
         checkpoint_total_limit=ckpt_limit,
         checkpoint_auto_naming=ckpt_auto,
         log_with=log_with,
+        compile_cache_dir=compile_cache_dir,
     )
 
 

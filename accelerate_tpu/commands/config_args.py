@@ -93,6 +93,10 @@ class ClusterConfig:
     checkpoint_total_limit: int = 0  # 0 = keep all
     checkpoint_auto_naming: bool = False
     log_with: str = ""  # comma-separated tracker names ('' = none)
+    # Persistent XLA compilation cache directory ('' = disabled). Exported as
+    # ACCELERATE_COMPILE_CACHE_DIR so restarted jobs load compiled programs
+    # instead of re-paying minutes of XLA compiles per process start.
+    compile_cache_dir: str = ""
     extra: dict = field(default_factory=dict)
 
     def to_dict(self) -> dict:

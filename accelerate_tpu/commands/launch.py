@@ -24,6 +24,7 @@ import subprocess
 import sys
 
 from ..utils.constants import (
+    ENV_COMPILE_CACHE_DIR,
     ENV_COORDINATOR,
     ENV_CPU,
     ENV_DEBUG_MODE,
@@ -78,6 +79,11 @@ def launch_command_parser(subparsers=None) -> argparse.ArgumentParser:
         ("dcn", "multi-slice count (0 = auto-detect slices)"),
     ):
         parser.add_argument(f"--{axis}_size", type=int, default=None, help=helptext)
+    parser.add_argument(
+        "--compile_cache_dir", default=None,
+        help="Persistent XLA compilation cache directory (exported as "
+             "ACCELERATE_COMPILE_CACHE_DIR; restarted jobs skip recompiles)",
+    )
     parser.add_argument("-m", "--module", action="store_true", help="Run script as a python module")
     parser.add_argument("training_script", help="Path to the script to launch")
     parser.add_argument(
@@ -109,6 +115,7 @@ def _merge_config(args) -> ClusterConfig:
         ("ep_size", "ep_size"),
         ("dcn_size", "dcn_size"),
         ("max_restarts", "max_restarts"),
+        ("compile_cache_dir", "compile_cache_dir"),
     ]:
         val = getattr(args, flag, None)
         if val is not None:
@@ -152,6 +159,8 @@ def prepare_launch_env(cfg: ClusterConfig, process_id: int | None = None, attemp
         env["ACCELERATE_CHECKPOINT_AUTO_NAMING"] = "1"
     if cfg.log_with:
         env["ACCELERATE_LOG_WITH"] = cfg.log_with
+    if cfg.compile_cache_dir:
+        env[ENV_COMPILE_CACHE_DIR] = os.path.expanduser(cfg.compile_cache_dir)
     # Plugins (e.g. the axon tunnel) may have pinned JAX_PLATFORMS in *this*
     # process's environ at jax-import time; children must re-discover their own
     # backend, so only forward the value we set deliberately.

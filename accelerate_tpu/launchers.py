@@ -83,9 +83,13 @@ def _debug_worker_inline(rank: int, num_processes: int, port: int, function, arg
     # identity so this child reads its own env contract.
     _set_debug_env(rank, num_processes, port)
     from .state import AcceleratorState, GradientState
+    from .utils.environment import maybe_enable_compilation_cache
 
     AcceleratorState._reset_state(reset_partial_state=True)
     GradientState._reset_state()
+    # Forked children share the parent's compile-cache env contract but not
+    # its jax.config mutations — re-apply before the child's first compile.
+    maybe_enable_compilation_cache()
     function(*args)
 
 
@@ -107,6 +111,9 @@ def _debug_worker_pickled(rank: int, num_processes: int, port: int, fn_path: str
     import pickle
 
     _set_debug_env(rank, num_processes, port)
+    from .utils.environment import maybe_enable_compilation_cache
+
+    maybe_enable_compilation_cache()
     with open(fn_path, "rb") as f:
         function, args = pickle.load(f)
     function(*args)

@@ -28,19 +28,27 @@ class MultiProcessAdapter(logging.LoggerAdapter):
             pass
         main_process_only = kwargs.pop("main_process_only", True)
         in_order = kwargs.pop("in_order", False)
-        if self.isEnabledFor(level):
-            if self._should_log(main_process_only):
-                msg, kwargs = self.process(msg, kwargs)
-                self.logger.log(level, msg, *args, **kwargs)
-            elif in_order:
-                from .state import PartialState
+        if in_order:
+            # EVERY process must walk the same barrier sequence — ALL filters
+            # (rank AND logger level, which can differ per host) decide only
+            # who emits inside it. The old form let a process that passed a
+            # filter log-and-return without entering the loop while the
+            # others sat in num_processes barriers: a latent multi-host hang.
+            from .state import PartialState
 
-                state = PartialState()
-                for i in range(state.num_processes):
-                    if i == state.process_index:
-                        msg, kwargs = self.process(msg, kwargs)
-                        self.logger.log(level, msg, *args, **kwargs)
-                    state.wait_for_everyone()
+            state = PartialState()
+            for i in range(state.num_processes):
+                if (
+                    i == state.process_index
+                    and self.isEnabledFor(level)
+                    and self._should_log(main_process_only)
+                ):
+                    msg, kwargs = self.process(msg, kwargs)
+                    self.logger.log(level, msg, *args, **kwargs)
+                state.wait_for_everyone()
+        elif self.isEnabledFor(level) and self._should_log(main_process_only):
+            msg, kwargs = self.process(msg, kwargs)
+            self.logger.log(level, msg, *args, **kwargs)
 
     @functools.lru_cache(None)
     def warning_once(self, *args, **kwargs):

@@ -289,7 +289,9 @@ class GPT2(Module):
         else:
             body = lambda x, layer: self.block(layer, x, ctx)
             if cfg.remat:
-                policy = getattr(jax.checkpoint_policies, cfg.remat_policy)
+                from ..utils.dataclasses import resolve_remat_policy
+
+                policy = resolve_remat_policy(cfg.remat_policy, getattr(cfg, "remat_save_names", ()))
                 body = jax.checkpoint(body, policy=policy)
 
             def scan_step(x, layer):

@@ -24,6 +24,7 @@ kernels to collectives — is TPU-native.
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 from functools import partial
 
@@ -33,8 +34,11 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from jax.ad_checkpoint import checkpoint_name
+
 from ..modules import ModelOutput, Module
 from ..ops.losses import cross_entropy_loss
+from ..utils.dataclasses import resolve_remat_policy
 
 
 @dataclass
@@ -93,8 +97,21 @@ class LlamaConfig:
     # from hidden states (ops/losses.fused_cross_entropy_loss): the (B·S, V)
     # fp32 logit tensor never materializes. Training-memory lever for large
     # vocab x long context; outputs carry loss but NO logits when it engages.
+    # The companion knobs are the vocab128k tuning surface (swept by
+    # benchmarks/vocab128k_profile.py; ACCELERATE_FUSED_LOSS_* envs override
+    # per-run without touching the config).
     fused_loss: bool = False
     fused_loss_chunk: int = 8192  # vocab tile per scan step
+    fused_loss_dtype: str = "fp32"  # 'fp32' | 'bf16' (bf16 chunk exp, fp32 accum)
+    fused_loss_unroll: int = 1  # chunk-scan unroll factor; 0 = fully unrolled
+    fused_loss_backward: str = "custom"  # 'custom' (single-pass VJP) | 'ad'
+    # Intermediates saved under remat_policy='names_saveable' — must be a
+    # subset of the checkpoint_name tags the block plants ('attn_out',
+    # 'mlp_out'). Saving only the residual-stream contributions costs 2·(B,S,h)
+    # per layer where dots-saveable keeps every projection (q/k/v/gate/up ≈
+    # (3h + 2·intermediate)·B·S) — the policy for shapes like h2048/i8192
+    # where the MLP dots alone exceed the HBM the policy was meant to save.
+    remat_save_names: tuple = ("attn_out", "mlp_out")
 
     def __post_init__(self):
         if self.head_dim is None:
@@ -103,6 +120,19 @@ class LlamaConfig:
             raise ValueError(f"hidden_act must be silu|gelu_tanh, got {self.hidden_act!r}")
         if self.fused_loss_chunk <= 0:
             raise ValueError(f"fused_loss_chunk must be > 0, got {self.fused_loss_chunk}")
+        if self.fused_loss_dtype not in ("fp32", "bf16"):
+            raise ValueError(
+                f"fused_loss_dtype must be fp32|bf16, got {self.fused_loss_dtype!r}"
+            )
+        if self.fused_loss_unroll < 0:
+            raise ValueError(
+                f"fused_loss_unroll must be >= 0, got {self.fused_loss_unroll}"
+            )
+        if self.fused_loss_backward not in ("custom", "ad"):
+            raise ValueError(
+                f"fused_loss_backward must be custom|ad, got {self.fused_loss_backward!r}"
+            )
+        self.remat_save_names = tuple(self.remat_save_names)
         if self.layer_windows is not None:
             self.layer_windows = tuple(self.layer_windows)
             if len(self.layer_windows) != self.num_hidden_layers:
@@ -149,6 +179,22 @@ class LlamaConfig:
         )
         defaults.update(kw)
         return cls(**defaults)
+
+
+def _fused_loss_overrides(cfg) -> dict:
+    """Fused-loss tuning knobs with per-run env overrides — the sweep surface
+    (``ACCELERATE_FUSED_LOSS_{CHUNK,DTYPE,UNROLL,BACKWARD}``) used by bench.py
+    and benchmarks/vocab128k_profile.py without touching the config object."""
+    chunk = int(os.environ.get("ACCELERATE_FUSED_LOSS_CHUNK", "0") or 0)
+    unroll = os.environ.get("ACCELERATE_FUSED_LOSS_UNROLL", "")
+    return {
+        "vocab_chunk": chunk if chunk > 0 else cfg.fused_loss_chunk,
+        "chunk_dtype": os.environ.get("ACCELERATE_FUSED_LOSS_DTYPE", "") or cfg.fused_loss_dtype,
+        "unroll": int(unroll) if unroll else cfg.fused_loss_unroll,
+        "custom_backward": (
+            os.environ.get("ACCELERATE_FUSED_LOSS_BACKWARD", "") or cfg.fused_loss_backward
+        ) == "custom",
+    }
 
 
 def rms_norm(x, weight, eps):
@@ -400,18 +446,19 @@ class Llama(Module):
         B, S = input_ids.shape
         from ..parallel.sharding import embedding_lookup
 
-        x = embedding_lookup(params["embed"]["weight"], input_ids)
-        x = x.astype(params["embed"]["weight"].dtype)
-        if cfg.embedding_multiplier != 1.0:
-            # Gemma scales the lookup only — the tied head stays unscaled.
-            x = x * jnp.asarray(cfg.embedding_multiplier, x.dtype)
-        if positions is None:
-            positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (B, S))
-        cos, sin = rope_tables(
-            positions, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling,
-            seq_len=rope_seq_len if rope_seq_len is not None else S,
-            max_position_embeddings=cfg.max_position_embeddings,
-        )
+        with jax.named_scope("embed"):
+            x = embedding_lookup(params["embed"]["weight"], input_ids)
+            x = x.astype(params["embed"]["weight"].dtype)
+            if cfg.embedding_multiplier != 1.0:
+                # Gemma scales the lookup only — the tied head stays unscaled.
+                x = x * jnp.asarray(cfg.embedding_multiplier, x.dtype)
+            if positions is None:
+                positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (B, S))
+            cos, sin = rope_tables(
+                positions, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling,
+                seq_len=rope_seq_len if rope_seq_len is not None else S,
+                max_position_embeddings=cfg.max_position_embeddings,
+            )
         return x, {"cos": cos, "sin": sin, "attention_mask": attention_mask}
 
     _WINDOW_FROM_CONFIG = object()  # sentinel: use cfg.sliding_window
@@ -443,52 +490,54 @@ class Llama(Module):
             if cfg.query_pre_attn_scalar is not None
             else None
         )
-        h = rms_norm(x, layer["input_norm"]["weight"], cfg.rms_norm_eps)
-        a = layer["attn"]
-        q = self._mm(h, a["wq"])
-        k = self._mm(h, a["wk"])
-        v = self._mm(h, a["wv"])
-        if "bq" in a:  # Qwen2-style QKV biases (static pytree structure)
-            q, k, v = q + a["bq"], k + a["bk"], v + a["bv"]
-        q = q.reshape(B, S, nh, hd)
-        k = k.reshape(B, S, nkv, hd)
-        v = v.reshape(B, S, nkv, hd)
-        if "q_norm" in a:  # Qwen3 per-head QK norm (static pytree structure)
-            q = rms_norm(q, a["q_norm"], cfg.rms_norm_eps)
-            k = rms_norm(k, a["k_norm"], cfg.rms_norm_eps)
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
-        new_cache = None
-        if cache_layer is not None:
-            from ..ops.attention import cached_attention
+        with jax.named_scope("attn"):
+            h = rms_norm(x, layer["input_norm"]["weight"], cfg.rms_norm_eps)
+            a = layer["attn"]
+            q = self._mm(h, a["wq"])
+            k = self._mm(h, a["wk"])
+            v = self._mm(h, a["wv"])
+            if "bq" in a:  # Qwen2-style QKV biases (static pytree structure)
+                q, k, v = q + a["bq"], k + a["bk"], v + a["bv"]
+            q = q.reshape(B, S, nh, hd)
+            k = k.reshape(B, S, nkv, hd)
+            v = v.reshape(B, S, nkv, hd)
+            if "q_norm" in a:  # Qwen3 per-head QK norm (static pytree structure)
+                q = rms_norm(q, a["q_norm"], cfg.rms_norm_eps)
+                k = rms_norm(k, a["k_norm"], cfg.rms_norm_eps)
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
+            new_cache = None
+            if cache_layer is not None:
+                from ..ops.attention import cached_attention
 
-            pos = ctx["cache_pos"]
-            k_cache = jax.lax.dynamic_update_slice(
-                cache_layer["k"], k.astype(cache_layer["k"].dtype), (0, pos, 0, 0)
-            )
-            v_cache = jax.lax.dynamic_update_slice(
-                cache_layer["v"], v.astype(cache_layer["v"].dtype), (0, pos, 0, 0)
-            )
-            attn_out = cached_attention(
-                q, k_cache, v_cache,
-                q_positions=ctx["positions"],
-                kv_mask=ctx.get("kv_mask"),
-                window=window,
-                softcap=cfg.attn_logit_softcap,
-                scale=scale,
-            )
-            new_cache = {"k": k_cache, "v": v_cache}
-        else:
-            if nkv != nh:
-                rep = nh // nkv
-                k = jnp.repeat(k, rep, axis=2)
-                v = jnp.repeat(v, rep, axis=2)
-            attn_out = _attention(
-                q, k, v, causal=True, mask=ctx["attention_mask"],
-                impl=cfg.attention_impl, window=window,
-                softcap=cfg.attn_logit_softcap, scale=scale,
-            )
-        attn_out = self._mm(attn_out.reshape(B, S, nh * hd), layer["attn"]["wo"])
+                pos = ctx["cache_pos"]
+                k_cache = jax.lax.dynamic_update_slice(
+                    cache_layer["k"], k.astype(cache_layer["k"].dtype), (0, pos, 0, 0)
+                )
+                v_cache = jax.lax.dynamic_update_slice(
+                    cache_layer["v"], v.astype(cache_layer["v"].dtype), (0, pos, 0, 0)
+                )
+                attn_out = cached_attention(
+                    q, k_cache, v_cache,
+                    q_positions=ctx["positions"],
+                    kv_mask=ctx.get("kv_mask"),
+                    window=window,
+                    softcap=cfg.attn_logit_softcap,
+                    scale=scale,
+                )
+                new_cache = {"k": k_cache, "v": v_cache}
+            else:
+                if nkv != nh:
+                    rep = nh // nkv
+                    k = jnp.repeat(k, rep, axis=2)
+                    v = jnp.repeat(v, rep, axis=2)
+                attn_out = _attention(
+                    q, k, v, causal=True, mask=ctx["attention_mask"],
+                    impl=cfg.attention_impl, window=window,
+                    softcap=cfg.attn_logit_softcap, scale=scale,
+                )
+            attn_out = self._mm(attn_out.reshape(B, S, nh * hd), layer["attn"]["wo"])
+            attn_out = checkpoint_name(attn_out, "attn_out")
         if cfg.sandwich_norms:
             # Gemma-2: norm each sub-block's OUTPUT before the residual add.
             x = x + rms_norm(attn_out, layer["post_attn_norm"]["weight"], cfg.rms_norm_eps)
@@ -510,8 +559,9 @@ class Llama(Module):
             if self.config.hidden_act == "silu"
             else lambda x: jax.nn.gelu(x, approximate=True)
         )
-        gated = act(self._mm(h2, layer["mlp"]["w_gate"])) * self._mm(h2, layer["mlp"]["w_up"])
-        return self._mm(gated, layer["mlp"]["w_down"])
+        with jax.named_scope("mlp"):
+            gated = act(self._mm(h2, layer["mlp"]["w_gate"])) * self._mm(h2, layer["mlp"]["w_up"])
+            return checkpoint_name(self._mm(gated, layer["mlp"]["w_down"]), "mlp_out")
 
     def _mm(self, a, b):
         """Block matmul through the precision dispatcher (ops/int8.py). The
@@ -538,33 +588,47 @@ class Llama(Module):
         return shifted
 
     def head(self, params, x, labels=None, attention_mask=None):
-        """Final norm + LM head (+ shifted-label loss)."""
+        """Final norm + LM head (+ shifted-label loss).
+
+        The tied head keeps the embed table in its native (V, h) layout all
+        the way into the matmul/fused loss: the old ``.T`` materialized a
+        transposed copy of the table every step (~0.5 GB at V=128k bf16)
+        whose cast/transpose gradient ops no dot-oriented remat policy could
+        name."""
         cfg = self.config
-        x = rms_norm(x, params["final_norm"]["weight"], cfg.rms_norm_eps)
-        if cfg.tie_word_embeddings:
-            head_w = params["embed"]["weight"].T.astype(x.dtype)
-        else:
-            head_w = params["lm_head"]["weight"]
-        if labels is not None and cfg.fused_loss:
-            # Streaming-logsumexp loss from hidden states: the full logit
-            # tensor never exists (see LlamaConfig.fused_loss).
-            from ..ops.losses import fused_cross_entropy_loss
+        with jax.named_scope("lm_head"):
+            x = rms_norm(x, params["final_norm"]["weight"], cfg.rms_norm_eps)
+            if cfg.tie_word_embeddings:
+                head_w = params["embed"]["weight"].astype(x.dtype)  # (V, h)
+            else:
+                head_w = params["lm_head"]["weight"]  # (h, V)
+            if labels is not None and cfg.fused_loss:
+                # Streaming-logsumexp loss from hidden states: the full logit
+                # tensor never exists (see LlamaConfig.fused_loss).
+                from ..ops.losses import fused_cross_entropy_loss
 
-            loss = fused_cross_entropy_loss(
-                x, head_w, self._shift_labels(labels, attention_mask),
-                logit_cap=cfg.final_logit_softcap,
-                vocab_chunk=cfg.fused_loss_chunk,
-            )
-            return ModelOutput(loss=loss)
-        logits = x @ head_w
-        if cfg.final_logit_softcap is not None:
-            from ..ops.attention import softcap_scores
+                knobs = _fused_loss_overrides(cfg)
+                loss = fused_cross_entropy_loss(
+                    x, head_w, self._shift_labels(labels, attention_mask),
+                    logit_cap=cfg.final_logit_softcap,
+                    head_transposed=cfg.tie_word_embeddings,
+                    **knobs,
+                )
+                return ModelOutput(loss=loss)
+            if cfg.tie_word_embeddings:
+                logits = jax.lax.dot_general(x, head_w, (((2,), (1,)), ((), ())))
+            else:
+                logits = x @ head_w
+            if cfg.final_logit_softcap is not None:
+                from ..ops.attention import softcap_scores
 
-            logits = softcap_scores(logits.astype(jnp.float32), cfg.final_logit_softcap)
-        out = ModelOutput(logits=logits)
-        if labels is not None:
-            out["loss"] = cross_entropy_loss(logits, self._shift_labels(labels, attention_mask))
-        return out
+                logits = softcap_scores(logits.astype(jnp.float32), cfg.final_logit_softcap)
+            out = ModelOutput(logits=logits)
+            if labels is not None:
+                out["loss"] = cross_entropy_loss(
+                    logits, self._shift_labels(labels, attention_mask)
+                )
+            return out
 
     # ------------------------------------------------------------------ cache
     def init_cache(self, batch_size: int, max_len: int, dtype=jnp.bfloat16):
@@ -664,7 +728,7 @@ class Llama(Module):
                 return x, auxes
 
             if cfg.remat:
-                policy = getattr(jax.checkpoint_policies, cfg.remat_policy)
+                policy = resolve_remat_policy(cfg.remat_policy, cfg.remat_save_names)
                 scan_step = jax.checkpoint(scan_step, policy=policy)
 
             x, aux_stack = jax.lax.scan(scan_step, x, seg)

@@ -122,10 +122,32 @@ class FlaxLinenAdapter(Module):
             return self.linen_module.apply(params, *args, **call_kwargs)
 
 
+def is_torch_module(model) -> bool:
+    """True for a live ``torch.nn.Module`` (without importing torch eagerly)."""
+    if "torch" not in str(type(model).__mro__):
+        return False
+    try:
+        import torch.nn as tnn
+
+        return isinstance(model, tnn.Module)
+    except ImportError:
+        return False
+
+
 def as_module(model) -> Module:
     """Coerce any supported model object to the Module protocol."""
     if isinstance(model, Module):
         return model
+    if is_torch_module(model):
+        # torch Modules happen to expose ``apply`` (their recursive-apply
+        # helper), so without this check they would be mis-wrapped as
+        # FunctionalModel and fail deep inside the first trace.
+        raise TypeError(
+            f"Cannot prepare a torch.nn.Module ({type(model).__name__}) directly: "
+            "this framework runs pure JAX functions. Convert the checkpoint first — "
+            "accelerate_tpu.from_hf(hf_model) maps supported transformers "
+            "architectures to the model zoo (see models/convert.py)."
+        )
     try:
         import flax.linen as nn
 

@@ -40,6 +40,239 @@ def cross_entropy_loss(
     return jnp.sum(nll) / denom
 
 
+# --------------------------------------------------------------------- fused CE
+#
+# The (B·S, V) fp32 logit tensor is the largest activation of an LM train step
+# (2.1 GB at B4·S1024·V=128256, plus backward copies); the fused path computes
+# the same loss by scanning the LM head's vocab dimension in chunks, carrying
+# running (max, sumexp, label_logit) streaming-logsumexp statistics — the flash
+# trick applied to the classifier. Peak memory is O(T·vocab_chunk).
+#
+# Two backward strategies:
+#
+# - "custom" (default): a hand-written VJP. The forward stores only
+#   (x, w, logz, label_logit); the backward makes ONE chunked pass computing
+#   dL/dx and dL/dw directly from the recomputed chunk softmax (p = exp(y -
+#   logz)), plus a single gather/scatter for the label column. Differentiating
+#   the forward scan would instead replay every chunk through the carry chain
+#   (max/rescale/sum) and drag its sequential dependency structure into the
+#   backward — the custom VJP drops that entirely.
+# - "ad": the original jax.checkpoint-over-scan form, kept as the
+#   cross-checking reference (tests assert grad equality between the two).
+
+
+def _chunk_logits(x, w_chunk, *, transposed: bool, cap, dtype):
+    """One vocab slice of logits: (T, width) in ``dtype``.
+
+    ``transposed`` means ``w_chunk`` is (width, h) rows of a (V, h) table —
+    the tied-embedding layout — contracted via dot_general so no transposed
+    copy of the table ever materializes.
+    """
+    mm = jnp.promote_types(x.dtype, w_chunk.dtype)
+    x, w_chunk = x.astype(mm), w_chunk.astype(mm)
+    if transposed:
+        z = jax.lax.dot_general(x, w_chunk, (((1,), (1,)), ((), ())))
+    else:
+        z = x @ w_chunk
+    z = z.astype(dtype)
+    if cap is not None:
+        z = jnp.tanh(z / cap) * cap
+    return z
+
+
+def _chunk_starts(V: int, vocab_chunk: int):
+    """Static (start, width) pairs covering [0, V): full chunks + ragged tail."""
+    n_full = V // vocab_chunk
+    spans = [(i * vocab_chunk, vocab_chunk) for i in range(n_full)]
+    tail = V - n_full * vocab_chunk
+    if tail:
+        spans.append((n_full * vocab_chunk, tail))
+    return spans
+
+
+def _slice_w(w, base, width, transposed):
+    if transposed:
+        return jax.lax.slice_in_dim(w, base, base + width, axis=0)
+    return jax.lax.slice_in_dim(w, base, base + width, axis=1)
+
+
+def _stack_full_chunks(w, n_full, vocab_chunk, transposed):
+    """(n_full, ...) stacked full chunks for the scan path. Row-major (V, h)
+    tables reshape for free; the (h, V) layout pays one transposed copy."""
+    h = w.shape[1] if transposed else w.shape[0]
+    if transposed:
+        return w[: n_full * vocab_chunk].reshape(n_full, vocab_chunk, h)
+    return jnp.moveaxis(
+        w[:, : n_full * vocab_chunk].reshape(h, n_full, vocab_chunk), 1, 0
+    )
+
+
+def _fold_stats(carry, z, base, width, safe_labels):
+    """Fold one chunk's logits into the running (max, sumexp, label_logit).
+    Accumulators stay fp32 regardless of the chunk dtype (the bf16 variant
+    computes the exp in bf16 and accumulates the row-sum in fp32)."""
+    m, se, label_logit = carry
+    m_c = jnp.max(z, axis=-1).astype(jnp.float32)
+    m_new = jnp.maximum(m, m_c)
+    e = jnp.exp(z - m_new[:, None].astype(z.dtype))
+    se = se * jnp.exp(m - m_new) + jnp.sum(e, axis=-1, dtype=jnp.float32)
+    hit = (safe_labels >= base) & (safe_labels < base + width)
+    local = jnp.take_along_axis(
+        z, jnp.clip(safe_labels - base, 0, width - 1)[:, None], axis=-1
+    )[:, 0].astype(jnp.float32)
+    label_logit = jnp.where(hit, local, label_logit)
+    return m_new, se, label_logit
+
+
+def _streaming_stats_fwd(x, w, safe_labels, *, vocab_chunk, logit_cap, cd,
+                         transposed, unroll):
+    """Chunked forward pass → (logz, label_logit), both (T,) fp32."""
+    T = x.shape[0]
+    V = w.shape[0] if transposed else w.shape[-1]
+    n_full = V // vocab_chunk
+    init = (
+        jnp.full((T,), -jnp.inf, jnp.float32),
+        jnp.zeros((T,), jnp.float32),
+        jnp.zeros((T,), jnp.float32),
+    )
+    carry = init
+    full_unrolled = unroll == 0 or unroll >= n_full
+    if n_full and not full_unrolled:
+        w_chunks = _stack_full_chunks(w, n_full, vocab_chunk, transposed)
+
+        def body(carry, inp):
+            w_c, c_idx = inp
+            z = _chunk_logits(x, w_c, transposed=transposed, cap=logit_cap, dtype=cd)
+            return _fold_stats(carry, z, c_idx * vocab_chunk, vocab_chunk, safe_labels), None
+
+        # The checkpoint matters only on the AD path; on the custom-VJP path
+        # nothing differentiates through this scan, so it costs nothing.
+        body = jax.checkpoint(body)
+        carry, _ = jax.lax.scan(
+            body, init, (w_chunks, jnp.arange(n_full)), unroll=max(unroll, 1)
+        )
+        spans = _chunk_starts(V, vocab_chunk)[n_full:]
+    else:
+        spans = _chunk_starts(V, vocab_chunk)
+    for base, width in spans:
+
+        def one(carry, w_c, _base=base, _width=width):
+            z = _chunk_logits(x, w_c, transposed=transposed, cap=logit_cap, dtype=cd)
+            return _fold_stats(carry, z, _base, _width, safe_labels)
+
+        carry = jax.checkpoint(one)(carry, _slice_w(w, base, width, transposed))
+    m, se, label_logit = carry
+    return m + jnp.log(se), label_logit
+
+
+def _streaming_stats_bwd(x, w, safe_labels, logz, label_logit, g_logz, g_label,
+                         *, vocab_chunk, logit_cap, cd, transposed, unroll):
+    """Single-pass backward: recompute each chunk's capped logits, form
+    g_y = p·g_logz (softmax term), chain through the softcap, and accumulate
+    dx / dw per chunk. The label column contributes once, outside the loop,
+    via a (T,)-row gather of w and a (T→V) scatter-add into dw — the
+    embedding-gradient pattern, not a per-chunk one-hot."""
+    T, h = x.shape
+    V = w.shape[0] if transposed else w.shape[-1]
+    n_full = V // vocab_chunk
+    mm = jnp.promote_types(x.dtype, w.dtype)
+
+    def chunk_grads(w_c, base, width):
+        z = _chunk_logits(x, w_c, transposed=transposed, cap=logit_cap, dtype=cd)
+        p = jnp.exp(z.astype(jnp.float32) - logz[:, None])
+        g_y = p * g_logz[:, None]
+        if logit_cap is not None:
+            g_y = g_y * (1.0 - jnp.square(z.astype(jnp.float32) / logit_cap))
+        # Cast the fp32 cotangent back to the matmul dtype — exactly where the
+        # AD path's convert_element_type cotangent lands.
+        g_y = g_y.astype(mm)
+        w_c, x_mm = w_c.astype(mm), x.astype(mm)
+        if transposed:
+            dx_c = g_y @ w_c  # (T,c)@(c,h)
+            dw_c = jax.lax.dot_general(g_y, x_mm, (((0,), (0,)), ((), ())))  # (c,h)
+        else:
+            dx_c = jax.lax.dot_general(g_y, w_c, (((1,), (1,)), ((), ())))
+            dw_c = jax.lax.dot_general(x_mm, g_y, (((0,), (0,)), ((), ())))  # (h,c)
+        return dx_c.astype(jnp.float32), dw_c
+
+    dx = jnp.zeros((T, h), jnp.float32)
+    # dw is assembled by PAD + ADD of the chunk grads — the exact structure AD
+    # gives a sliced weight (cotangent of slice = pad). Concatenating the
+    # chunk dots along the vocab dim instead triggers a GSPMD mis-partition
+    # when that dim is tp-sharded (observed on XLA CPU: each shard's concat
+    # silently drops the cross-shard reduction of the T-contracted dots).
+    dw = jnp.zeros(w.shape, jnp.promote_types(x.dtype, w.dtype))
+
+    def place(dw, dw_c, base, width):
+        if transposed:
+            return dw + jnp.pad(dw_c, ((base, V - base - width), (0, 0)))
+        return dw + jnp.pad(dw_c, ((0, 0), (base, V - base - width)))
+
+    full_unrolled = unroll == 0 or unroll >= n_full
+    if n_full and not full_unrolled:
+        w_chunks = _stack_full_chunks(w, n_full, vocab_chunk, transposed)
+
+        def body(dx, inp):
+            w_c, c_idx = inp
+            dx_c, dw_c = chunk_grads(w_c, c_idx * vocab_chunk, vocab_chunk)
+            return dx + dx_c, dw_c
+
+        dx, dw_stack = jax.lax.scan(
+            body, dx, (w_chunks, jnp.arange(n_full)), unroll=max(unroll, 1)
+        )
+        for i in range(n_full):
+            dw = place(dw, dw_stack[i], i * vocab_chunk, vocab_chunk)
+        spans = _chunk_starts(V, vocab_chunk)[n_full:]
+    else:
+        spans = _chunk_starts(V, vocab_chunk)
+    for base, width in spans:
+        dx_c, dw_c = chunk_grads(_slice_w(w, base, width, transposed), base, width)
+        dx = dx + dx_c
+        dw = place(dw, dw_c, base, width)
+
+    # Label-column term: d label_logit / dx = t'(y_label) · w[label];
+    # d/dw scatters t'(y_label)·g_label·x into the label rows.
+    gl = g_label
+    if logit_cap is not None:
+        gl = gl * (1.0 - jnp.square(label_logit / logit_cap))
+    w_lab = w[safe_labels] if transposed else w[:, safe_labels].T  # (T, h)
+    dx = dx + gl[:, None] * w_lab.astype(jnp.float32)
+    scatter = (gl[:, None] * x.astype(jnp.float32)).astype(dw.dtype)
+    if transposed:
+        dw = dw.at[safe_labels].add(scatter)
+    else:
+        dw = dw.T.at[safe_labels].add(scatter).T
+    return dx.astype(x.dtype), dw.astype(w.dtype)
+
+
+def _streaming_stats(x, w, safe_labels, *, vocab_chunk, logit_cap, cd,
+                     transposed, unroll, custom_backward):
+    """(logz, label_logit) with the selected backward strategy."""
+    kw = dict(vocab_chunk=vocab_chunk, logit_cap=logit_cap, cd=cd,
+              transposed=transposed, unroll=unroll)
+    if not custom_backward:
+        return _streaming_stats_fwd(x, w, safe_labels, **kw)
+
+    @jax.custom_vjp
+    def stats(x, w):
+        return _streaming_stats_fwd(x, w, safe_labels, **kw)
+
+    def fwd(x, w):
+        logz, label_logit = _streaming_stats_fwd(x, w, safe_labels, **kw)
+        return (logz, label_logit), (x, w, logz, label_logit)
+
+    def bwd(res, g):
+        x, w, logz, label_logit = res
+        g_logz, g_label = g
+        return _streaming_stats_bwd(
+            x, w, safe_labels, logz, label_logit,
+            g_logz.astype(jnp.float32), g_label.astype(jnp.float32), **kw
+        )
+
+    stats.defvjp(fwd, bwd)
+    return stats(x, w)
+
+
 def fused_cross_entropy_loss(
     hidden: jax.Array,
     head_weight: jax.Array,
@@ -49,75 +282,54 @@ def fused_cross_entropy_loss(
     z_loss: float = 0.0,
     vocab_chunk: int = 8192,
     logit_cap: float | None = None,
+    chunk_dtype: str = "fp32",
+    unroll: int = 1,
+    head_transposed: bool = False,
+    custom_backward: bool = True,
 ):
     """Cross-entropy straight from hidden states — full logits never exist.
 
-    The (B·S, V) fp32 logit tensor is the largest activation of an LM train
-    step (1 GB at B2·S4096·V32000, plus its gradient); this computes the same
-    loss by scanning the LM head's vocab dimension in chunks, carrying running
-    ``(max, sumexp, label_logit)`` streaming-logsumexp statistics — the flash
-    trick applied to the classifier. Each chunk's partial logits live only
-    transiently (the scan body is rematerialized in the backward), so peak
-    memory is O(B·S·vocab_chunk).
+    hidden: (B, S, h) — any float dtype. labels: (B, S) int with
+    ``ignore_index`` holes. ``head_weight``: (h, V), or (V, h) with
+    ``head_transposed=True`` — the tied-embedding layout, chunked by rows so
+    the table is never transposed-copied (at 128k-vocab bf16 that copy costs
+    ~0.5 GB per step).
 
-    hidden: (B, S, h) — any float dtype, promoted to fp32 per chunk.
-    head_weight: (h, V). labels: (B, S) int with ``ignore_index`` holes.
+    Tuning knobs (swept by ``benchmarks/vocab128k_profile.py``; defaults are
+    the winning vocab128k recipe):
+
+    - ``vocab_chunk``: vocab tile per step; peak memory is O(T·vocab_chunk).
+    - ``chunk_dtype``: ``"fp32"`` (exact vs the dense path) or ``"bf16"`` —
+      chunk logits/exp in bf16, running (max, sumexp) accumulated in fp32;
+      halves the bytes of the largest transient at ~1e-2 relative loss error.
+    - ``unroll``: scan unroll factor for the full chunks (0 = fully unrolled
+      Python loop — no scan machinery at all).
+    - ``custom_backward``: single-pass hand-written VJP (default) vs
+      differentiating the forward scan (``False``; the reference
+      implementation the tests cross-check against).
+
     ``logit_cap`` applies Gemma-2-style tanh softcapping per chunk.
     Returns the mean NLL over non-ignored positions (+ optional z-loss).
     """
+    if chunk_dtype not in ("fp32", "bf16"):
+        raise ValueError(f"chunk_dtype must be fp32|bf16, got {chunk_dtype!r}")
+    if vocab_chunk <= 0:
+        raise ValueError(f"vocab_chunk must be > 0, got {vocab_chunk}")
     B, S, h = hidden.shape
-    V = head_weight.shape[-1]
     T = B * S
     x = hidden.reshape(T, h)
     labels = labels.reshape(T)
     valid = labels != ignore_index
     safe_labels = jnp.where(valid, labels, 0)
-
-    def update(carry, w_c, base, width):
-        """Fold one vocab slice into the running (max, sumexp, label_logit)."""
-        m, se, label_logit = carry
-        logits_c = (x @ w_c).astype(jnp.float32)  # (T, width)
-        if logit_cap is not None:
-            logits_c = jnp.tanh(logits_c / logit_cap) * logit_cap
-        m_c = jnp.max(logits_c, axis=-1)
-        m_new = jnp.maximum(m, m_c)
-        se = se * jnp.exp(m - m_new) + jnp.sum(jnp.exp(logits_c - m_new[:, None]), axis=-1)
-        hit = (safe_labels >= base) & (safe_labels < base + width)
-        local = jnp.take_along_axis(
-            logits_c, jnp.clip(safe_labels - base, 0, width - 1)[:, None], axis=-1
-        )[:, 0]
-        label_logit = jnp.where(hit, local, label_logit)
-        return m_new, se, label_logit
-
-    init = (
-        jnp.full((T,), -jnp.inf, jnp.float32),
-        jnp.zeros((T,), jnp.float32),
-        jnp.zeros((T,), jnp.float32),
+    logz, label_logit = _streaming_stats(
+        x, head_weight, safe_labels,
+        vocab_chunk=vocab_chunk,
+        logit_cap=logit_cap,
+        cd=jnp.bfloat16 if chunk_dtype == "bf16" else jnp.float32,
+        transposed=head_transposed,
+        unroll=unroll,
+        custom_backward=custom_backward,
     )
-    # Full chunks ride a scan; a ragged tail (V % vocab_chunk) is folded by one
-    # extra call — never a padded copy of the whole head weight (at 128k-vocab
-    # bf16 heads that copy would cost ~1 GB per step).
-    n_full = V // vocab_chunk
-    carry = init
-    if n_full:
-        w_chunks = jnp.moveaxis(
-            head_weight[:, : n_full * vocab_chunk].reshape(h, n_full, vocab_chunk), 1, 0
-        )  # (n_full, h, chunk)
-
-        def body(carry, inp):
-            w_c, c_idx = inp
-            return update(carry, w_c, c_idx * vocab_chunk, vocab_chunk), None
-
-        body = jax.checkpoint(body)  # recompute chunk logits in the backward
-        carry, _ = jax.lax.scan(body, init, (w_chunks, jnp.arange(n_full)))
-    tail = V - n_full * vocab_chunk
-    if tail:
-        tail_fn = jax.checkpoint(
-            lambda c, w_t: update(c, w_t, n_full * vocab_chunk, tail)
-        )
-        carry = tail_fn(carry, head_weight[:, n_full * vocab_chunk :])
-    m, se, label_logit = carry
-    logz = m + jnp.log(se)
     nll = logz - label_logit
     if z_loss > 0.0:
         nll = nll + z_loss * jnp.square(logz)

@@ -205,7 +205,11 @@ class PipelineSpec:
                         return (x, aux_acc), None
 
                     if remat:
-                        policy = getattr(jax.checkpoint_policies, remat_policy)
+                        from ..utils.dataclasses import resolve_remat_policy
+
+                        policy = resolve_remat_policy(
+                            remat_policy, getattr(cfg, "remat_save_names", ())
+                        )
                         block_body = jax.checkpoint(block_body, policy=policy)
                     (x, aux_acc), _ = lax.scan(block_body, (x, aux_acc), seg)
                     return x, aux_acc

@@ -50,7 +50,11 @@ from .utils.constants import (
     ENV_NUM_PROCESSES,
     ENV_PROCESS_ID,
 )
-from .utils.environment import parse_choice_from_env, parse_flag_from_env
+from .utils.environment import (
+    maybe_enable_compilation_cache,
+    parse_choice_from_env,
+    parse_flag_from_env,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -136,6 +140,10 @@ class PartialState:
                 jax.config.update("jax_platforms", "cpu")
             except Exception:
                 logger.warning("cpu=True requested but platform switch failed")
+        # Persistent XLA compilation cache (ACCELERATE_COMPILE_CACHE_DIR):
+        # configured before the first compile so restarted jobs (and every
+        # bench re-run) load their programs instead of re-building them.
+        maybe_enable_compilation_cache()
         _maybe_init_jax_distributed()
 
         platform = jax.default_backend()

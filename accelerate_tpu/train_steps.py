@@ -56,6 +56,17 @@ class GPTTrainStep(AbstractTrainStep):
 
     def loss_fn(self, outputs, batch):
         if "loss" in outputs and outputs["loss"] is not None:
+            if self.z_loss or self.label_smoothing:
+                # The model computed its loss in-graph (e.g. the fused
+                # vocab-chunked head, which never materializes logits), so the
+                # step-level regularizers cannot be applied — fail loudly
+                # instead of silently training without them.
+                raise ValueError(
+                    "GPTTrainStep(z_loss/label_smoothing) cannot be applied: the "
+                    "model already computed its loss in-graph (fused_loss head or "
+                    "in-model labels). Configure the regularizer on the model "
+                    "config, or run the dense head without in-model labels."
+                )
             return outputs["loss"]
         logits = outputs["logits"][:, :-1]
         labels = batch["labels"][:, 1:]

@@ -37,6 +37,10 @@ ENV_MIXED_PRECISION = "ACCELERATE_MIXED_PRECISION"
 ENV_CPU = "ACCELERATE_USE_CPU"
 ENV_DEBUG_MODE = "ACCELERATE_DEBUG_MODE"
 ENV_MESH_SHAPE = "ACCELERATE_MESH_SHAPE"
+# Persistent XLA compilation cache (jax_compilation_cache_dir): set to a
+# directory to stop every process start from re-paying minutes of compiles.
+ENV_COMPILE_CACHE_DIR = "ACCELERATE_COMPILE_CACHE_DIR"
+ENV_COMPILE_CACHE_MIN_SECS = "ACCELERATE_COMPILE_CACHE_MIN_COMPILE_SECS"
 
 # ``dcn`` is the slice axis of a multi-slice pod: replicas connected by
 # data-center network rather than ICI. It is outermost so only the axes meant

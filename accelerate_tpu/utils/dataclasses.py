@@ -34,6 +34,35 @@ class KwargsHandler:
         return {k: v for k, v in this_dict.items() if default_dict[k] != v}
 
 
+def resolve_remat_policy(name: str, save_names: tuple = ()):
+    """Map a config ``remat_policy`` string to a ``jax.checkpoint`` policy.
+
+    Every ``jax.checkpoint_policies`` attribute name works unchanged;
+    ``"names_saveable"`` additionally resolves to
+    ``save_only_these_names(*save_names)`` — the policy keyed off the
+    ``checkpoint_name`` tags the model zoo plants on block intermediates
+    (Llama tags ``attn_out``/``mlp_out``), so remat keeps exactly the named
+    residual-stream contributions instead of every dot output.
+    """
+    import jax
+
+    if name == "names_saveable":
+        if not save_names:
+            raise ValueError(
+                "remat_policy='names_saveable' needs a non-empty remat_save_names "
+                "tuple (the checkpoint_name tags to keep, e.g. ('attn_out', 'mlp_out'))."
+            )
+        return jax.checkpoint_policies.save_only_these_names(*save_names)
+    try:
+        return getattr(jax.checkpoint_policies, name)
+    except AttributeError:
+        raise ValueError(
+            f"Unknown remat_policy {name!r}: expected 'names_saveable' or a "
+            "jax.checkpoint_policies attribute (e.g. 'nothing_saveable', "
+            "'dots_with_no_batch_dims_saveable')."
+        ) from None
+
+
 class EnumWithContains(enum.EnumMeta):
     def __contains__(cls, item):
         try:

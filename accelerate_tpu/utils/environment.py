@@ -13,7 +13,7 @@ import functools
 import os
 from contextlib import contextmanager
 
-from .constants import ENV_PREFIX
+from .constants import ENV_COMPILE_CACHE_DIR, ENV_COMPILE_CACHE_MIN_SECS, ENV_PREFIX
 
 
 def str_to_bool(value: str) -> int:
@@ -63,6 +63,40 @@ def pin_cpu_platform(n_devices: int = 8) -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+
+
+def maybe_enable_compilation_cache(cache_dir: str | None = None) -> str | None:
+    """Point JAX's persistent compilation cache at ``cache_dir`` (or the
+    ``ACCELERATE_COMPILE_CACHE_DIR`` env contract). Returns the resolved
+    directory, or None when the feature is not configured.
+
+    Idempotent and safe to call at any point before the first compile of the
+    programs that should hit the cache; ``PartialState`` calls it on
+    construction so every entrypoint that builds an ``Accelerator`` (bench.py,
+    launched scripts, notebook_launcher workers) gets it for free. XLA's
+    default gates skip sub-second compiles, which on a tunneled or CPU test
+    rig covers exactly nothing — ``ACCELERATE_COMPILE_CACHE_MIN_COMPILE_SECS``
+    (default 0: persist everything) tunes that.
+    """
+    cache_dir = cache_dir or os.environ.get(ENV_COMPILE_CACHE_DIR) or None
+    if not cache_dir:
+        return None
+    cache_dir = os.path.abspath(os.path.expanduser(cache_dir))
+    os.makedirs(cache_dir, exist_ok=True)
+
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    min_secs = float(os.environ.get(ENV_COMPILE_CACHE_MIN_SECS, "0") or 0.0)
+    for opt, val in (
+        ("jax_persistent_cache_min_compile_time_secs", min_secs),
+        ("jax_persistent_cache_min_entry_size_bytes", -1),
+    ):
+        try:
+            jax.config.update(opt, val)
+        except Exception:  # older jax without the knob — the dir alone works
+            pass
+    return cache_dir
 
 
 def get_int_from_env(env_keys, default: int) -> int:

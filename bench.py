@@ -1,8 +1,17 @@
 """Benchmark: flagship Llama training throughput on the available chip(s).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where value is
-model FLOPs utilization (MFU) of the fused train step and vs_baseline compares to
-the BASELINE.json north-star of 45% MFU (reference fsdp2 target).
+Prints ONE JSON line PER CONFIG: {"metric", "value", "unit", "vs_baseline"}
+where value is model FLOPs utilization (MFU) of the fused train step and
+vs_baseline compares to the BASELINE.json north-star of 45% MFU (reference
+fsdp2 target). BENCH_CONFIG takes a comma-separated list; on TPU it defaults
+to "large,vocab128k" so the realistic-shape 128k-vocab row is a standing
+headline next to the swept-shape one (the headline row stays first).
+
+vocab128k sweep envs: BENCH_VOCAB_CHUNK / BENCH_FUSED_DTYPE /
+BENCH_FUSED_UNROLL / BENCH_FUSED_BWD / BENCH_REMAT_POLICY (mirrored by
+benchmarks/vocab128k_profile.py at the op level); ACCELERATE_COMPILE_CACHE_DIR
+enables the persistent compilation cache — the second run of this script then
+compiles from cache (cold/warm timings in PERF.md).
 """
 
 import json
@@ -68,19 +77,36 @@ def resolve_backend() -> str:
 
 
 def main():
+    on_tpu = resolve_backend() == "tpu"
+    modes = [
+        m.strip()
+        for m in os.environ.get("BENCH_CONFIG", "large,vocab128k" if on_tpu else "tiny").split(",")
+        if m.strip()
+    ]
+    for mode in modes:
+        if mode not in ("large", "ref-shape", "long", "340m", "tiny", "moe", "moe-ceiling", "vocab128k"):
+            raise ValueError(
+                "BENCH_CONFIG must be a comma-separated subset of "
+                f"large|ref-shape|long|340m|tiny|moe|moe-ceiling|vocab128k, got {mode!r}"
+            )
+    for mode in modes:
+        try:
+            run_one(mode)
+        except Exception as exc:  # one config failing must not mute the others
+            _print_failure(mode, exc)
+        finally:
+            import gc
+
+            gc.collect()  # drop the previous config's params before the next compile
+
+
+def run_one(mode: str):
     import jax
     import optax
 
     from accelerate_tpu import Accelerator
     from accelerate_tpu.models import Llama, LlamaConfig
 
-    on_tpu = resolve_backend() == "tpu"
-    mode = os.environ.get("BENCH_CONFIG", "large" if on_tpu else "tiny")
-    if mode not in ("large", "ref-shape", "long", "340m", "tiny", "moe", "moe-ceiling", "vocab128k"):
-        raise ValueError(
-            "BENCH_CONFIG must be large|ref-shape|long|340m|tiny|moe|moe-ceiling|vocab128k, "
-            f"got {mode!r}"
-        )
     if mode == "large":
         # ~740M params — tuned on-chip (PERF.md): wider-and-shallower beats
         # deep at fixed params (fewer, larger matmuls per elementwise byte),
@@ -218,6 +244,11 @@ def main():
         # the depth defaults to 8 (~0.7B) — V stays full 128k because the
         # LOGITS allocation (B·S·V fp32 = 4.2 GB at b8) is what the fused
         # loss exists to eliminate, and that is depth-independent.
+        # Sweep surface (PERF.md records the winning knobs, which are the
+        # library defaults): BENCH_VOCAB_CHUNK tiles the vocab scan,
+        # BENCH_FUSED_DTYPE=bf16 halves the chunk-exp bytes, BENCH_FUSED_BWD
+        # ad|custom A/Bs the single-pass VJP, BENCH_FUSED_UNROLL unrolls the
+        # chunk scan, BENCH_REMAT_POLICY swaps e.g. names_saveable in.
         cfg = LlamaConfig(
             vocab_size=128256,
             hidden_size=2048,
@@ -228,8 +259,14 @@ def main():
             max_position_embeddings=1024,
             tie_word_embeddings=True,
             remat=True,
-            remat_policy="dots_with_no_batch_dims_saveable",
+            remat_policy=os.environ.get(
+                "BENCH_REMAT_POLICY", "dots_with_no_batch_dims_saveable"
+            ),
             fused_loss=fused,
+            fused_loss_chunk=int(os.environ.get("BENCH_VOCAB_CHUNK", "8192")),
+            fused_loss_dtype=os.environ.get("BENCH_FUSED_DTYPE", "fp32"),
+            fused_loss_unroll=int(os.environ.get("BENCH_FUSED_UNROLL", "1")),
+            fused_loss_backward=os.environ.get("BENCH_FUSED_BWD", "custom"),
         )
         batch, seq, steps, warmup = int(os.environ.get("BENCH_VOCAB_BATCH", "8")), 1024, 20, 3
     elif mode == "340m":
@@ -272,7 +309,13 @@ def main():
     ids = np.random.default_rng(0).integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
     data = {"input_ids": ids, "labels": ids}
 
-    for _ in range(warmup):
+    t_compile = time.perf_counter()
+    loss = step(data)
+    float(loss)
+    # First step ≈ trace + XLA compile (+ one step): the number the persistent
+    # compilation cache (ACCELERATE_COMPILE_CACHE_DIR) collapses on re-runs.
+    compile_s = time.perf_counter() - t_compile
+    for _ in range(warmup - 1):
         loss = step(data)
     float(loss)  # hard host sync: block_until_ready does not block through axon
     t0 = time.perf_counter()
@@ -324,6 +367,26 @@ def main():
                         f"/L{cfg.num_hidden_layers}/a{cfg.num_attention_heads}"
                     ),
                     "attention_impl": resolved_impl,
+                    "compile_s": round(compile_s, 2),
+                    **(
+                        {"compile_cache": os.environ["ACCELERATE_COMPILE_CACHE_DIR"]}
+                        if os.environ.get("ACCELERATE_COMPILE_CACHE_DIR")
+                        else {}
+                    ),
+                    **(
+                        {
+                            "fused_loss": {
+                                "enabled": cfg.fused_loss,
+                                "chunk": cfg.fused_loss_chunk,
+                                "dtype": cfg.fused_loss_dtype,
+                                "unroll": cfg.fused_loss_unroll,
+                                "backward": cfg.fused_loss_backward,
+                                "remat_policy": cfg.remat_policy,
+                            }
+                        }
+                        if mode == "vocab128k"
+                        else {}
+                    ),
                     **(
                         # auto resolves to einsum at this shape (S<=2048,
                         # cf<=2, no ep axis) — see ops/moe.py moe_ffn.
@@ -348,24 +411,25 @@ _FAIL_METRIC = {
     "vocab128k": "llama_v128k_train_mfu_per_chip",
 }
 
+def _print_failure(mode: str, exc: Exception):
+    # Match the success-path metric name so a 0.0 failure record lands in the
+    # same series instead of looking like a gap.
+    print(
+        json.dumps(
+            {
+                "metric": _FAIL_METRIC.get(mode, "llama_train_mfu_per_chip"),
+                "value": 0.0,
+                "unit": "fraction_of_peak_bf16",
+                "vs_baseline": 0.0,
+                "detail": {"error": f"{type(exc).__name__}: {exc}"[:500]},
+            }
+        )
+    )
+
+
 if __name__ == "__main__":
     try:
         main()
     except Exception as exc:  # emit a parseable JSON line no matter what
-        print(
-            json.dumps(
-                {
-                    # Match the success-path metric name so a 0.0 failure record
-                    # lands in the same series instead of looking like a gap.
-                    "metric": _FAIL_METRIC.get(
-                        os.environ.get("BENCH_CONFIG", "large"),
-                        "llama_train_mfu_per_chip",
-                    ),
-                    "value": 0.0,
-                    "unit": "fraction_of_peak_bf16",
-                    "vs_baseline": 0.0,
-                    "detail": {"error": f"{type(exc).__name__}: {exc}"[:500]},
-                }
-            )
-        )
+        _print_failure(os.environ.get("BENCH_CONFIG", "large").split(",")[0].strip(), exc)
         sys.exit(0)

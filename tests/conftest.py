@@ -16,7 +16,30 @@ from accelerate_tpu.utils.environment import pin_cpu_platform  # noqa: E402
 
 pin_cpu_platform(8)
 
+# Session-scoped persistent compilation cache (dogfooding the
+# ACCELERATE_COMPILE_CACHE_DIR contract): the suite launches dozens of
+# subprocesses (CLI/launcher/example tests) that would each re-compile the
+# same tiny programs; inheriting this env lets them load from the cache
+# instead. Fresh dir per session, removed at session end — no cross-run
+# state. Tests that need their own cache dir (test_compile_cache.py)
+# override the var in their env.
+_owned_cache_dir = None
+if "ACCELERATE_COMPILE_CACHE_DIR" not in os.environ:
+    import tempfile
+
+    _owned_cache_dir = tempfile.mkdtemp(prefix="at_test_xla_cache_")
+    os.environ["ACCELERATE_COMPILE_CACHE_DIR"] = _owned_cache_dir
+
 import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _cleanup_session_compile_cache():
+    yield
+    if _owned_cache_dir is not None:
+        import shutil
+
+        shutil.rmtree(_owned_cache_dir, ignore_errors=True)
 
 
 @pytest.fixture(autouse=True)

@@ -174,6 +174,58 @@ def test_gather_for_metrics_trims_remainder():
     assert np.allclose(total, ds.x)
 
 
+def test_gather_for_metrics_object_payload_and_error_surface(monkeypatch):
+    """Object payloads (strings, object-dtype arrays) are DETECTED and routed
+    through gather_object on a pod; a genuine collective failure on tensor
+    data must surface instead of silently degrading to the pickle path (the
+    old blanket ``except Exception`` swallowed it)."""
+    from accelerate_tpu.accelerator import _has_object_leaves
+
+    assert _has_object_leaves(["a", "b"])
+    assert _has_object_leaves({"txt": ["x"], "ok": [np.ones(2)]})
+    assert _has_object_leaves(np.array([{"k": 1}, None], dtype=object))
+    assert not _has_object_leaves({"ok": [np.ones(2), jnp.ones(3)], "n": 3})
+
+    accelerator = Accelerator()
+    # world=1: gather is the identity for every payload, object or not
+    assert accelerator.gather_for_metrics(["a", "b"]) == ["a", "b"]
+    assert accelerator.gather_for_metrics({"txt": ["x"]})["txt"] == ["x"]
+
+    from accelerate_tpu.utils import operations as ops_mod
+
+    def boom(_):
+        raise RuntimeError("collective failed")
+
+    monkeypatch.setattr(ops_mod, "gather", boom)
+    with pytest.raises(RuntimeError, match="collective failed"):
+        accelerator.gather_for_metrics(np.ones((4, 2)))
+
+
+def test_prepare_rejects_non_schedule_callables():
+    """A loss function handed to prepare() must fail loudly instead of being
+    wrapped in AcceleratedScheduler (the old callable catch-all)."""
+    accelerator = Accelerator()
+
+    def loss_fn(outputs, batch):
+        return outputs["loss"]
+
+    with pytest.raises(TypeError, match="set_loss_fn"):
+        accelerator.prepare(loss_fn)
+    # A real schedule (one positional arg) still classifies as scheduler.
+    sched = accelerator.prepare(optax.constant_schedule(0.1))
+    from accelerate_tpu.scheduler import AcceleratedScheduler
+
+    assert isinstance(sched, AcceleratedScheduler)
+
+
+def test_prepare_torch_module_points_at_from_hf():
+    torch = pytest.importorskip("torch")
+
+    accelerator = Accelerator()
+    with pytest.raises(TypeError, match="from_hf"):
+        accelerator.prepare(torch.nn.Linear(2, 2))
+
+
 def test_set_trigger_roundtrip():
     accelerator = Accelerator()
     assert not accelerator.check_trigger()

@@ -258,6 +258,8 @@ def test_config_wizard_roundtrips_through_launch(tmp_path):
         "3",                 # total limit
         "yes",               # configure tracking?
         "json",              # trackers
+        "yes",               # persistent compilation cache?
+        str(tmp_path / "xla_cache"),  # cache dir
         "bf16",              # mixed precision
     ])
     with mock.patch("builtins.input", lambda *a: next(answers)):
@@ -265,11 +267,13 @@ def test_config_wizard_roundtrips_through_launch(tmp_path):
     assert cfg.fsdp_min_shard_size == 1024 and cfg.fsdp_cpu_offload
     assert cfg.gradient_accumulation_steps == 4 and cfg.log_with == "json"
     assert cfg.checkpoint_total_limit == 3 and cfg.checkpoint_auto_naming
+    assert cfg.compile_cache_dir == str(tmp_path / "xla_cache")
     config_path = tmp_path / "cfg.yaml"
     cfg.to_yaml_file(str(config_path))
 
     script = tmp_path / "probe.py"
     script.write_text(
+        "import os\n"
         "from accelerate_tpu import Accelerator\n"
         "acc = Accelerator()\n"
         "assert acc.fsdp_plugin is not None and acc.fsdp_plugin.min_shard_size == 1024\n"
@@ -279,6 +283,9 @@ def test_config_wizard_roundtrips_through_launch(tmp_path):
         "assert [str(t) for t in acc.log_with] == ['json'], acc.log_with\n"
         "assert acc.project_configuration.automatic_checkpoint_naming\n"
         "assert acc.project_configuration.total_limit == 3\n"
+        "assert os.environ['ACCELERATE_COMPILE_CACHE_DIR'].endswith('xla_cache')\n"
+        "import jax\n"
+        "assert jax.config.jax_compilation_cache_dir.endswith('xla_cache')\n"
         "print('ROUNDTRIP_OK')\n"
     )
     result = subprocess.run(

@@ -1,0 +1,78 @@
+"""Persistent XLA compilation cache (ACCELERATE_COMPILE_CACHE_DIR contract):
+the second trace of a program must be served from the cache directory instead
+of re-paying the XLA compile — the 'every process start re-pays minutes of
+compiles' fix. Runs in subprocesses because the cache config must land before
+the process's first compile to represent a cold start faithfully."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_PROBE = """
+import json, os, sys, time
+import numpy as np
+import optax
+from accelerate_tpu import Accelerator
+from accelerate_tpu.models import Llama, LlamaConfig
+import jax
+
+acc = Accelerator()
+assert jax.config.jax_compilation_cache_dir == os.environ["ACCELERATE_COMPILE_CACHE_DIR"]
+model = Llama(LlamaConfig.tiny())
+model.init_params(jax.random.key(0))
+pmodel, popt = acc.prepare(model, optax.sgd(0.05))
+step = acc.build_train_step(pmodel, popt)
+ids = np.random.default_rng(0).integers(0, 256, (4, 16)).astype(np.int32)
+t0 = time.perf_counter()
+loss = float(step({"input_ids": ids, "labels": ids}))
+print(json.dumps({"first_step_s": time.perf_counter() - t0, "loss": loss}))
+"""
+
+
+def _run_probe(cache_dir, tmp_path):
+    script = tmp_path / "probe.py"
+    script.write_text(_PROBE)
+    env = {
+        **os.environ,
+        "PYTHONPATH": REPO_ROOT,
+        "JAX_PLATFORMS": "cpu",
+        "ACCELERATE_COMPILE_CACHE_DIR": str(cache_dir),
+    }
+    result = subprocess.run(
+        [sys.executable, str(script)], capture_output=True, text=True,
+        cwd=REPO_ROOT, timeout=600, env=env,
+    )
+    assert result.returncode == 0, result.stdout[-1500:] + result.stderr[-1500:]
+    return json.loads(result.stdout.strip().splitlines()[-1])
+
+
+def test_second_trace_hits_cache_dir(tmp_path):
+    cache_dir = tmp_path / "xla_cache"
+    cold = _run_probe(cache_dir, tmp_path)
+    entries = {f for f in os.listdir(cache_dir) if f.endswith("-cache")}
+    assert entries, "cold run wrote no cache entries"
+    # The bench model's fused train step must be among the cached programs.
+    assert any("_step" in f or "jit" in f for f in entries)
+
+    warm = _run_probe(cache_dir, tmp_path)
+    after = {f for f in os.listdir(cache_dir) if f.endswith("-cache")}
+    assert after == entries, (
+        "warm run recompiled (new cache entries appeared): "
+        f"{sorted(after - entries)[:5]}"
+    )
+    assert abs(cold["loss"] - warm["loss"]) < 1e-6
+
+
+def test_cache_helper_is_noop_without_env(monkeypatch, tmp_path):
+    from accelerate_tpu.utils.environment import maybe_enable_compilation_cache
+
+    monkeypatch.delenv("ACCELERATE_COMPILE_CACHE_DIR", raising=False)
+    assert maybe_enable_compilation_cache() is None
+    resolved = maybe_enable_compilation_cache(str(tmp_path / "c"))
+    assert resolved == str(tmp_path / "c") and os.path.isdir(resolved)
+    import jax
+
+    assert jax.config.jax_compilation_cache_dir == resolved

@@ -128,3 +128,33 @@ def test_logger_in_order_kwarg(caplog):
     with caplog.at_level(logging.INFO, logger="at_test_logger_order"):
         logger.info("ordered", in_order=True)
     assert any("ordered" in r.message for r in caplog.records)
+
+
+def test_logger_in_order_barrier_is_symmetric(caplog, monkeypatch):
+    """With main_process_only=True + in_order=True, EVERY process — including
+    the one that passes the filter — must walk the same wait_for_everyone()
+    sequence. The old code let main log-and-return while the others entered
+    num_processes barriers: a latent multi-host hang."""
+    import accelerate_tpu.logging as at_logging
+
+    class FakeState:
+        num_processes = 4
+        process_index = 0  # the MAIN process — previously skipped the loop
+        barrier_calls = 0
+
+        def wait_for_everyone(self):
+            FakeState.barrier_calls += 1
+
+        @property
+        def is_main_process(self):
+            return self.process_index == 0
+
+    import accelerate_tpu.state as at_state
+
+    monkeypatch.setattr(at_state, "PartialState", FakeState)
+    logger = get_logger("at_test_logger_sym")
+    with caplog.at_level(logging.INFO, logger="at_test_logger_sym"):
+        logger.info("sym", main_process_only=True, in_order=True)
+    assert any("sym" in r.message for r in caplog.records)
+    # Main walked all num_processes barriers, same as every non-main rank.
+    assert FakeState.barrier_calls == 4
