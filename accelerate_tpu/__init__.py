@@ -87,4 +87,14 @@ def __getattr__(name):
         from . import train_steps
 
         return getattr(train_steps, name)
+    if name in (
+        "run_resilient",
+        "PreemptionWatcher",
+        "FaultPlan",
+        "SimulatedFault",
+        "GoodputLedger",
+    ):
+        from . import resilience
+
+        return getattr(resilience, name)
     raise AttributeError(f"module 'accelerate_tpu' has no attribute {name!r}")

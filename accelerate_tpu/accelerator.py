@@ -442,6 +442,8 @@ class Accelerator:
         self._offload_opt_state = bool(fsdp_plugin.cpu_offload) if fsdp_plugin is not None else False
         self.step = 0
         self.flag_tensor = None
+        self._resilience_step = 0
+        self._preemption_watcher = None
         self._models: list[PreparedModel] = []
         self._optimizers: list[AcceleratedOptimizer] = []
         self._schedulers: list[AcceleratedScheduler] = []
@@ -1019,7 +1021,9 @@ class Accelerator:
             def value_and_grads(params, batch, rng):
                 return jax.value_and_grad(loss_of)(params, batch, rng)
 
-        @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+        from .utils.environment import safe_donate_argnums
+
+        @partial(jax.jit, donate_argnums=safe_donate_argnums((0, 1, 2, 3)))
         def _step(params, opt_state, accum_grads, count, batch, rng, clip_norm):
             loss, grads = value_and_grads(params, batch, rng)
             accum_grads = jax.tree_util.tree_map(
@@ -1187,10 +1191,24 @@ class Accelerator:
             for tracker in self.trackers:
                 tracker.log(values, step=step, **((log_kwargs or {}).get(tracker.name, {})))
 
+    def log_goodput(self, step: int | None = None):
+        """Push the goodput/badput wall-clock breakdown (resilience/goodput.py)
+        through the active trackers as ``goodput/*`` series — productive step
+        time vs compile / checkpoint save / restore / restart downtime."""
+        from .resilience.goodput import get_ledger
+
+        self.log({f"goodput/{k}": v for k, v in get_ledger().summary().items()}, step=step)
+
     def end_training(self):
+        """Flush trackers AND join queued async checkpoint writes: a script
+        that returns right after a non-blocking ``save_state`` must not drop
+        shard writes still draining on orbax's background thread (an atexit
+        hook in ``checkpointing`` is the backstop for scripts that never call
+        this)."""
         if self.is_main_process:
             for tracker in self.trackers:
                 tracker.finish()
+        self.finish_pending_saves()
         self.wait_for_everyone()
 
     # ----------------------------------------------------------- checkpointing
@@ -1223,6 +1241,57 @@ class Accelerator:
         from .checkpointing import save_model as _save_model
 
         return _save_model(self, model, save_directory, max_shard_size, safe_serialization)
+
+    # -------------------------------------------------------------- resilience
+    @property
+    def preemption_watcher(self):
+        """The process-wide :class:`~.resilience.preemption.PreemptionWatcher`,
+        installed on first access (or earlier, by ``PartialState`` when the
+        launcher exported ACCELERATE_HANDLE_PREEMPTION)."""
+        if self._preemption_watcher is None:
+            from .resilience.preemption import get_default_watcher
+
+            self._preemption_watcher = get_default_watcher(install=True)
+        return self._preemption_watcher
+
+    def checkpoint_on_preemption(self, output_dir: str | None = None,
+                                 step: int | None = None) -> bool:
+        """Call once per training step: emergency-checkpoint if preempted.
+
+        Three things happen, in order: (1) the deterministic fault plan
+        (ACCELERATE_FAULT_PLAN, resilience/faults.py) fires any fault scheduled
+        for this step; (2) the preemption watcher's per-host flags (SIGTERM/
+        SIGINT, maintenance poller) are combined into an all-host agreement —
+        one scalar collective, so every process must call this at the same step
+        boundary; (3) on agreement, a SYNCHRONOUS ``save_state`` runs (queued
+        async writes joined too — the grace window is short and a half-written
+        emergency checkpoint is worse than none) and True is returned so the
+        training loop can exit cleanly for ``run_resilient`` / the launcher to
+        restart-and-resume.
+
+        ``step`` defaults to an internal once-per-call counter; pass the loop's
+        own global step when resuming mid-plan so fault steps stay aligned.
+        """
+        from .resilience.faults import active_plan
+        from .resilience.goodput import get_ledger
+
+        self._resilience_step += 1
+        step = self._resilience_step if step is None else step
+        # Install the watcher BEFORE the fault plan can deliver a signal: a
+        # 'sigterm' fault at the first hooked step must hit the sticky-flag
+        # handler, not the default disposition (process death).
+        watcher = self.preemption_watcher
+        plan = active_plan()
+        if plan is not None:
+            plan.maybe_fire(step)
+        if not watcher.sync(self.state):
+            return False
+        logger.warning(f"Preemption agreed at step {step}: taking an emergency checkpoint.")
+        self.save_state(output_dir)  # ckpt_save time recorded by checkpointing
+        with get_ledger().track("ckpt_save"):
+            self.finish_pending_saves()
+        self.wait_for_everyone()
+        return True
 
     def skip_first_batches(self, dataloader, num_batches: int = 0):
         return skip_first_batches(dataloader, num_batches)

@@ -19,11 +19,13 @@ there); array payloads differ by design:
 
 from __future__ import annotations
 
+import atexit
 import json
 import os
 import pickle
 import random
 import shutil
+import time
 from pathlib import Path
 
 import numpy as np
@@ -77,6 +79,13 @@ def finish_pending_saves():
         ck.close()  # release the background writer thread/resources
 
 
+# A script that exits right after a non-blocking save_state must not drop the
+# shard writes still draining on orbax's background thread. Accelerator.
+# end_training() is the polite join; this is the backstop for scripts that
+# never call it (trivially reentrant: the queue is empty on the second join).
+atexit.register(finish_pending_saves)
+
+
 def _reap_pending(max_pending: int = 4):
     """Bound the queue of unjoined background checkpointers: a long run calling
     ``save_state(blocking=False)`` to explicit output dirs (no rotation, no
@@ -110,6 +119,9 @@ def save_accelerator_state(accelerator, output_dir: str | None = None, safe_seri
     time, so subsequent optimizer steps don't corrupt the checkpoint). Join
     explicitly with :func:`finish_pending_saves`; ``load_accelerator_state``
     joins automatically."""
+    from .resilience.goodput import get_ledger
+
+    _t_save = time.perf_counter()
     project = accelerator.project_configuration
     if output_dir is None:
         if project.automatic_checkpoint_naming:
@@ -202,6 +214,19 @@ def save_accelerator_state(accelerator, output_dir: str | None = None, safe_seri
                  rng_state, accelerator, all_processes=True)
     if project.automatic_checkpoint_naming:
         project.iteration += 1
+    # Fault injection (resilience/faults.py): a pending partial_ckpt fault
+    # turns this save into the on-disk state of one interrupted mid-write —
+    # committed writes are joined first so the corruption is deterministic.
+    from .resilience.faults import active_plan
+
+    plan = active_plan()
+    if plan is not None and plan._pending_partial_ckpt:
+        finish_pending_saves()
+        plan.maybe_corrupt_checkpoint(output_dir)
+    # Host-blocked save time is checkpoint badput (goodput ledger); a
+    # non-blocking save's background drain intentionally isn't counted —
+    # training overlaps it, which is the point.
+    get_ledger().add("ckpt_save", time.perf_counter() - _t_save)
     logger.info(f"Saved accelerator state to {output_dir}")
     return output_dir
 
@@ -251,6 +276,9 @@ def _checkpoint_complete(path: str, accelerator) -> bool:
 
 def load_accelerator_state(accelerator, input_dir: str | None = None, **kwargs):
     """Reference ``load_accelerator_state`` :179 + driver :3426."""
+    from .resilience.goodput import get_ledger
+
+    _t_load = time.perf_counter()
     finish_pending_saves()  # never resume from a checkpoint still being written
     project = accelerator.project_configuration
     if input_dir is None:
@@ -263,14 +291,29 @@ def load_accelerator_state(accelerator, input_dir: str | None = None, **kwargs):
         )
         # Newest complete folder wins: a crash mid non-blocking save leaves the
         # newest checkpoint_N partially written — fall back rather than fail.
+        incomplete = []
         for f in reversed(folders):
             candidate = os.path.join(base, f)
             if _checkpoint_complete(candidate, accelerator):
                 input_dir = candidate
                 break
             logger.warning(f"Skipping incomplete checkpoint {candidate}")
+            incomplete.append(candidate)
+        # Align the auto-naming state with what's actually on disk: incomplete
+        # folders can never be resumed — delete the litter — and the next save
+        # must target the index after the resumed folder (or 0 when nothing
+        # survived), or a restarted process (iteration reset to 0) collides
+        # with leftover folders on its first save and crash-loops.
+        if accelerator.is_main_process:
+            for junk in incomplete:
+                shutil.rmtree(junk, ignore_errors=True)
+        accelerator.wait_for_everyone()
         if input_dir is None:
+            # Nothing resumable, but the litter is gone and the naming state
+            # aligned: the caller can start fresh and save safely.
+            project.iteration = 0
             raise FileNotFoundError(f"No complete checkpoint found under {base}")
+        project.iteration = int(os.path.basename(input_dir).rsplit("_", 1)[-1]) + 1
     input_dir = os.path.abspath(input_dir)
 
     ckptr = _checkpointer()
@@ -325,6 +368,7 @@ def load_accelerator_state(accelerator, input_dir: str | None = None, **kwargs):
         for i, model in enumerate(accelerator._models):
             if f"model_{i}_key_counter" in rng_state:
                 model.handle.step_counter = rng_state[f"model_{i}_key_counter"]
+    get_ledger().add("ckpt_restore", time.perf_counter() - _t_load)
     logger.info(f"Loaded accelerator state from {input_dir}")
     return input_dir
 

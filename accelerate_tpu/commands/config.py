@@ -103,11 +103,15 @@ def get_user_input() -> ClusterConfig:
         )
         pp_mbs = _ask("Pipeline microbatches? (0 = one per stage; >=4x pp for utilization)", 0, int)
     accum = _ask("How many gradient accumulation steps?", 1, int)
-    project_dir, ckpt_limit, ckpt_auto = None, 0, False
+    project_dir, ckpt_limit, ckpt_auto, handle_preemption = None, 0, False, False
     if _yesno("Do you want to configure checkpointing?", False):
         project_dir = _ask("  project directory (checkpoints/logs root)", ".")
         ckpt_auto = _yesno("  automatic checkpoint naming (checkpoints/checkpoint_<n>)?", True)
         ckpt_limit = _ask("  how many checkpoints to keep? (0 = all)", 0, int)
+        handle_preemption = _yesno(
+            "  handle preemption (SIGTERM -> emergency checkpoint; resume via "
+            "run_resilient)?", False
+        )
     log_with = ""
     if _yesno("Do you want to configure experiment tracking?", False):
         log_with = _ask(
@@ -157,6 +161,7 @@ def get_user_input() -> ClusterConfig:
         checkpoint_auto_naming=ckpt_auto,
         log_with=log_with,
         compile_cache_dir=compile_cache_dir,
+        handle_preemption=handle_preemption,
     )
 
 

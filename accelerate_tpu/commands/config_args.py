@@ -97,6 +97,12 @@ class ClusterConfig:
     # ACCELERATE_COMPILE_CACHE_DIR so restarted jobs load compiled programs
     # instead of re-paying minutes of XLA compiles per process start.
     compile_cache_dir: str = ""
+    # Resilience (resilience/): install the SIGTERM/SIGINT preemption watcher
+    # at startup (ACCELERATE_HANDLE_PREEMPTION), and an optional deterministic
+    # fault-injection plan for drills/CI (ACCELERATE_FAULT_PLAN, e.g.
+    # "step:37=kill;step:80=partial_ckpt").
+    handle_preemption: bool = False
+    fault_plan: str = ""
     extra: dict = field(default_factory=dict)
 
     def to_dict(self) -> dict:

@@ -28,10 +28,13 @@ from ..utils.constants import (
     ENV_COORDINATOR,
     ENV_CPU,
     ENV_DEBUG_MODE,
+    ENV_FAULT_PLAN,
+    ENV_HANDLE_PREEMPTION,
     ENV_MESH_SHAPE,
     ENV_MIXED_PRECISION,
     ENV_NUM_PROCESSES,
     ENV_PROCESS_ID,
+    ENV_RESTART_ATTEMPT,
 )
 from .config_args import ClusterConfig, load_config_from_file
 
@@ -84,6 +87,19 @@ def launch_command_parser(subparsers=None) -> argparse.ArgumentParser:
         help="Persistent XLA compilation cache directory (exported as "
              "ACCELERATE_COMPILE_CACHE_DIR; restarted jobs skip recompiles)",
     )
+    parser.add_argument(
+        "--handle_preemption", action="store_true", default=None,
+        help="Install the SIGTERM/SIGINT preemption watcher at startup "
+             "(ACCELERATE_HANDLE_PREEMPTION): scripts calling "
+             "Accelerator.checkpoint_on_preemption() each step then take an "
+             "emergency checkpoint and exit cleanly when the platform preempts.",
+    )
+    parser.add_argument(
+        "--fault_plan", default=None,
+        help="Deterministic fault-injection plan for resilience drills, e.g. "
+             "'step:37=kill;step:80=partial_ckpt' (exported as "
+             "ACCELERATE_FAULT_PLAN; see docs/resilience.md for the grammar).",
+    )
     parser.add_argument("-m", "--module", action="store_true", help="Run script as a python module")
     parser.add_argument("training_script", help="Path to the script to launch")
     parser.add_argument(
@@ -116,6 +132,8 @@ def _merge_config(args) -> ClusterConfig:
         ("dcn_size", "dcn_size"),
         ("max_restarts", "max_restarts"),
         ("compile_cache_dir", "compile_cache_dir"),
+        ("handle_preemption", "handle_preemption"),
+        ("fault_plan", "fault_plan"),
     ]:
         val = getattr(args, flag, None)
         if val is not None:
@@ -130,7 +148,7 @@ def prepare_launch_env(cfg: ClusterConfig, process_id: int | None = None, attemp
     resume-vs-fresh decisions off it the way torchrun scripts use
     TORCHELASTIC_RESTART_COUNT."""
     env = dict(os.environ)
-    env["ACCELERATE_RESTART_ATTEMPT"] = str(attempt)
+    env[ENV_RESTART_ATTEMPT] = str(attempt)
     # Make sure workers can import accelerate_tpu even without a pip install.
     import accelerate_tpu
 
@@ -161,6 +179,10 @@ def prepare_launch_env(cfg: ClusterConfig, process_id: int | None = None, attemp
         env["ACCELERATE_LOG_WITH"] = cfg.log_with
     if cfg.compile_cache_dir:
         env[ENV_COMPILE_CACHE_DIR] = os.path.expanduser(cfg.compile_cache_dir)
+    if cfg.handle_preemption:
+        env[ENV_HANDLE_PREEMPTION] = "1"
+    if cfg.fault_plan:
+        env[ENV_FAULT_PLAN] = cfg.fault_plan
     # Plugins (e.g. the axon tunnel) may have pinned JAX_PLATFORMS in *this*
     # process's environ at jax-import time; children must re-discover their own
     # backend, so only forward the value we set deliberately.
@@ -265,6 +287,12 @@ def launch_command(args) -> None:
     cfg = _merge_config(args)
     if cfg.max_restarts < 0:
         raise ValueError(f"--max_restarts must be >= 0, got {cfg.max_restarts}")
+    if cfg.fault_plan:
+        # Fail a malformed plan at launch, not after every worker has paid the
+        # XLA compile and hit its first checkpoint_on_preemption call.
+        from ..resilience.faults import FaultPlan
+
+        FaultPlan.parse(cfg.fault_plan)
     if cfg.max_restarts > 0 and cfg.num_machines > 1:
         raise ValueError(
             "--max_restarts only applies to single-machine jobs: on a pod, a "

@@ -121,7 +121,9 @@ class LocalSGDTrainer:
 
         import optax
 
-        @partial(jax.jit, donate_argnums=(0, 1, 2))
+        from .utils.environment import safe_donate_argnums
+
+        @partial(jax.jit, donate_argnums=safe_donate_argnums((0, 1, 2)))
         def _step(params_rep, opt_rep, count, batch, rng):
             def one(params, opt, local_batch, r):
                 loss, grads = jax.value_and_grad(loss_of)(

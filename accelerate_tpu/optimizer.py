@@ -35,9 +35,24 @@ def _tree_zeros_like(tree):
     return jax.tree_util.tree_map(jnp.zeros_like, tree)
 
 
-@partial(jax.jit, donate_argnums=(0,))
+_accumulate_grads_fn = None
+
+
 def _accumulate_grads(accum, new, scale):
-    return jax.tree_util.tree_map(lambda a, g: a + g * scale, accum, new)
+    # Lazily jitted so the donation decision (safe_donate_argnums — donation is
+    # unsafe on CPU when the persistent compilation cache is active) is made
+    # after the backend and cache are configured, not at import time.
+    global _accumulate_grads_fn
+    if _accumulate_grads_fn is None:
+        from .utils.environment import safe_donate_argnums
+
+        _accumulate_grads_fn = jax.jit(
+            lambda accum, new, scale: jax.tree_util.tree_map(
+                lambda a, g: a + g * scale, accum, new
+            ),
+            donate_argnums=safe_donate_argnums((0,)),
+        )
+    return _accumulate_grads_fn(accum, new, scale)
 
 
 @jax.jit
@@ -136,9 +151,11 @@ class AcceleratedOptimizer:
     def _build_update_fn(self):
         import optax
 
+        from .utils.environment import safe_donate_argnums
+
         tx = self.tx
 
-        @partial(jax.jit, donate_argnums=(0, 1, 2))
+        @partial(jax.jit, donate_argnums=safe_donate_argnums((0, 1, 2)))
         def _update(params, opt_state, grads, max_clip_norm, inv_scale):
             grads = jax.tree_util.tree_map(lambda g: g * inv_scale, grads)
             gnorm = _global_norm(grads)

@@ -1,0 +1,38 @@
+"""Resilience subsystem — preemption-aware training for multi-host SPMD jobs.
+
+Four layers, smallest mechanism first:
+
+- :mod:`.preemption` — SIGTERM/SIGINT → sticky flag, all-host agreement via a
+  scalar collective, pluggable maintenance-event poller;
+- :mod:`.faults` — deterministic, env-driven fault injection
+  (``ACCELERATE_FAULT_PLAN``) so every recovery path below runs in CI;
+- :mod:`.runner` — :func:`run_resilient`: resume from the newest complete
+  checkpoint, exponential backoff + jitter, crash-loop budget;
+- :mod:`.goodput` — the wall-clock ledger (productive step time vs compile /
+  checkpoint / restart badput) surfaced by ``Accelerator.log_goodput()`` and
+  ``bench.py``.
+
+Driven from training code via ``Accelerator.checkpoint_on_preemption()`` (one
+call per step) and ``run_resilient(train_fn, accelerator)``; driven from the
+CLI via ``accelerate-tpu launch --handle_preemption [--max_restarts N]``.
+"""
+
+from .faults import FaultPlan, SimulatedFault, active_plan, reset_active_plan, set_active_plan
+from .goodput import GoodputLedger, get_ledger
+from .preemption import PreemptionWatcher, gce_maintenance_poller, get_default_watcher, reset_default_watcher
+from .runner import run_resilient
+
+__all__ = [
+    "FaultPlan",
+    "GoodputLedger",
+    "PreemptionWatcher",
+    "SimulatedFault",
+    "active_plan",
+    "gce_maintenance_poller",
+    "get_default_watcher",
+    "get_ledger",
+    "reset_active_plan",
+    "reset_default_watcher",
+    "run_resilient",
+    "set_active_plan",
+]

@@ -1,0 +1,158 @@
+"""Deterministic fault injection — preemption you can run in CI.
+
+Preemption handling that is only ever exercised by real TPU maintenance events
+is untested code on the critical path. This module makes the failure modes
+reproducible: a fault *plan* parsed from ``ACCELERATE_FAULT_PLAN`` names the
+training step at which each fault fires, and ``Accelerator.
+checkpoint_on_preemption()`` (called once per step) fires them. The grammar:
+
+    ACCELERATE_FAULT_PLAN="step:37=kill;step:80=partial_ckpt"
+
+i.e. ``;``-separated entries of ``step:<N>=<action>[:<arg>]`` with actions
+
+- ``kill``          raise :class:`SimulatedFault` — the in-process stand-in for
+                    a hard preemption (``run_resilient`` catches it and
+                    restarts, exactly like a relaunched gang);
+- ``sigterm``       deliver a real SIGTERM to this process — exercises the
+                    :mod:`.preemption` watcher → emergency-checkpoint path;
+- ``partial_ckpt``  make the NEXT checkpoint save commit only partially
+                    (missing item dir + orbax tmp litter), the on-disk
+                    signature of a save interrupted mid-write — exercises the
+                    newest-complete-checkpoint fallback on resume;
+- ``stall:<secs>``  sleep, simulating a straggling host / hung I/O.
+
+Each fault fires at most once per plan instance, so an auto-resumed run that
+replays the faulting step does not crash-loop on its own injection.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import signal
+import time
+from dataclasses import dataclass, field
+
+from ..logging import get_logger
+from ..utils.constants import ENV_FAULT_PLAN
+
+logger = get_logger(__name__)
+
+_ACTIONS = ("kill", "sigterm", "partial_ckpt", "stall")
+
+
+class SimulatedFault(RuntimeError):
+    """Raised by the ``kill`` action: the injectable analog of a preemption
+    that kills the process before any handler runs."""
+
+    def __init__(self, step: int):
+        super().__init__(f"fault injection: simulated kill at step {step}")
+        self.step = step
+
+
+@dataclass
+class Fault:
+    step: int
+    action: str
+    arg: str | None = None
+    fired: bool = False
+
+
+@dataclass
+class FaultPlan:
+    faults: list[Fault] = field(default_factory=list)
+    # Set by a fired ``partial_ckpt`` fault; consumed by the next save.
+    _pending_partial_ckpt: bool = False
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        faults = []
+        for entry in spec.split(";"):
+            entry = entry.strip()
+            if not entry:
+                continue
+            try:
+                lhs, action = entry.split("=", 1)
+                kind, step = lhs.split(":", 1)
+                if kind.strip() != "step":
+                    raise ValueError
+                step = int(step)
+                action, _, arg = action.strip().partition(":")
+                if action not in _ACTIONS:
+                    raise ValueError
+                if action == "stall" and arg:
+                    float(arg)  # a bad duration must fail at parse, not mid-run
+            except ValueError:
+                raise ValueError(
+                    f"Bad fault-plan entry {entry!r}: expected "
+                    "'step:<N>=<action>[:<arg>]' with action in "
+                    f"{'/'.join(_ACTIONS)} (e.g. 'step:37=kill;step:80=partial_ckpt')."
+                ) from None
+            faults.append(Fault(step=step, action=action, arg=arg or None))
+        return cls(faults=sorted(faults, key=lambda f: f.step))
+
+    @classmethod
+    def from_env(cls) -> "FaultPlan | None":
+        spec = os.environ.get(ENV_FAULT_PLAN, "").strip()
+        return cls.parse(spec) if spec else None
+
+    # ------------------------------------------------------------------ fire
+    def maybe_fire(self, step: int):
+        """Fire every not-yet-fired fault scheduled for ``step``."""
+        for f in self.faults:
+            if f.fired or f.step != step:
+                continue
+            f.fired = True
+            logger.warning(f"Fault injection: firing {f.action} at step {step}")
+            if f.action == "kill":
+                raise SimulatedFault(step)
+            if f.action == "sigterm":
+                os.kill(os.getpid(), signal.SIGTERM)
+            elif f.action == "partial_ckpt":
+                self._pending_partial_ckpt = True
+            elif f.action == "stall":
+                time.sleep(float(f.arg) if f.arg else 1.0)
+
+    def maybe_corrupt_checkpoint(self, output_dir: str) -> bool:
+        """Consume a pending ``partial_ckpt`` fault: leave ``output_dir`` in
+        the exact on-disk state of an interrupted non-blocking save — a
+        manifest-listed item dir missing plus ``.orbax-checkpoint-tmp`` litter
+        — so ``_checkpoint_complete`` rejects it and resume falls back."""
+        if not self._pending_partial_ckpt:
+            return False
+        self._pending_partial_ckpt = False
+        from ..utils.constants import MODEL_NAME
+
+        item = os.path.join(output_dir, MODEL_NAME)
+        shutil.rmtree(item, ignore_errors=True)
+        os.makedirs(item + ".orbax-checkpoint-tmp-0", exist_ok=True)
+        logger.warning(f"Fault injection: left {output_dir} partially written")
+        return True
+
+
+# ------------------------------------------------------- process-wide plan
+# One plan per process so fired-state survives in-process restarts
+# (run_resilient re-entering train_fn must not re-fire the same fault).
+_UNSET = object()
+_active_plan = _UNSET
+
+
+def active_plan() -> FaultPlan | None:
+    """The process's fault plan: lazily parsed from ACCELERATE_FAULT_PLAN on
+    first use (None when the env is unset), or whatever ``set_active_plan``
+    installed programmatically."""
+    global _active_plan
+    if _active_plan is _UNSET:
+        _active_plan = FaultPlan.from_env()
+    return _active_plan
+
+
+def set_active_plan(plan: FaultPlan | None):
+    global _active_plan
+    _active_plan = plan
+
+
+def reset_active_plan():
+    """Forget the cached plan (tests); the next ``active_plan()`` re-reads env."""
+    global _active_plan
+    _active_plan = _UNSET

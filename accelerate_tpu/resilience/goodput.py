@@ -1,0 +1,116 @@
+"""Goodput accounting — where did the wall-clock go?
+
+At pod scale the question that decides cost is not "how fast is a step" but
+"what fraction of the job's wall-clock was spent stepping". Everything else —
+XLA compiles, checkpoint saves, restores after a preemption, restart backoff —
+is *badput*: time the chips were reserved but no tokens were trained. This
+module keeps one process-wide ledger that the rest of the framework feeds
+(``checkpointing`` times saves/restores, ``run_resilient`` times restart
+downtime, ``bench.py`` times compiles and steps) and that surfaces in two
+places: ``Accelerator.log_goodput()`` pushes the breakdown through the normal
+tracker path, and ``bench.py`` embeds it in its JSON lines.
+
+The categories follow the goodput decomposition used by large TPU trainers
+(productive step time vs program-acquisition and checkpoint overheads): one
+goodput bucket (``step``) and four badput buckets (``compile``, ``ckpt_save``,
+``ckpt_restore``, ``restart``); wall-clock not attributed to any bucket is
+reported as ``other_s`` (data feeding, host-side logging, eval, idle).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+
+GOODPUT_CATEGORY = "step"
+BADPUT_CATEGORIES = ("compile", "ckpt_save", "ckpt_restore", "restart")
+CATEGORIES = (GOODPUT_CATEGORY,) + BADPUT_CATEGORIES
+
+
+class GoodputLedger:
+    """Wall-clock classifier. All methods are thread-safe (orbax background
+    writers and async hosts may report concurrently with the train loop)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self):
+        """Start a fresh accounting window (bench.py calls this per config)."""
+        with self._lock:
+            self._t0 = time.perf_counter()
+            self.seconds = {c: 0.0 for c in CATEGORIES}
+            self.counts = {c: 0 for c in CATEGORIES}
+            self.restarts = 0
+
+    # ------------------------------------------------------------- recording
+    def add(self, category: str, seconds: float, count: int = 1):
+        if category not in CATEGORIES:
+            raise ValueError(f"unknown goodput category {category!r}; choose from {CATEGORIES}")
+        with self._lock:
+            self.seconds[category] += float(seconds)
+            self.counts[category] += count
+
+    @contextmanager
+    def track(self, category: str):
+        """Attribute the wall-clock of a ``with`` block to ``category``."""
+        if category not in CATEGORIES:
+            raise ValueError(f"unknown goodput category {category!r}; choose from {CATEGORIES}")
+        t = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add(category, time.perf_counter() - t)
+
+    def record_step(self, seconds: float, steps: int = 1):
+        self.add(GOODPUT_CATEGORY, seconds, count=steps)
+
+    def record_restart(self, downtime_s: float = 0.0):
+        with self._lock:
+            self.restarts += 1
+            self.seconds["restart"] += float(downtime_s)
+            self.counts["restart"] += 1
+
+    def mark_process_start(self, attempt: int = 0):
+        """Called by ``PartialState`` at process birth: a nonzero
+        ACCELERATE_RESTART_ATTEMPT means the launcher relaunched the gang —
+        count those incarnations even though their downtime was paid in a
+        previous process we cannot measure from here."""
+        if attempt > 0:
+            with self._lock:
+                self.restarts = max(self.restarts, int(attempt))
+
+    # --------------------------------------------------------------- reading
+    @property
+    def wall_s(self) -> float:
+        return time.perf_counter() - self._t0
+
+    def summary(self) -> dict:
+        """Flat goodput/badput breakdown — the schema shared by
+        ``Accelerator.log_goodput()`` and ``bench.py``'s JSON lines."""
+        with self._lock:
+            wall = max(time.perf_counter() - self._t0, 1e-9)
+            productive = self.seconds[GOODPUT_CATEGORY]
+            badput = sum(self.seconds[c] for c in BADPUT_CATEGORIES)
+            out = {
+                "goodput_fraction": round(min(productive / wall, 1.0), 4),
+                "badput_fraction": round(min(badput / wall, 1.0), 4),
+                "wall_s": round(wall, 3),
+                "productive_s": round(productive, 3),
+                "badput_s": round(badput, 3),
+                "other_s": round(max(wall - productive - badput, 0.0), 3),
+                "steps": self.counts[GOODPUT_CATEGORY],
+                "restarts": self.restarts,
+            }
+            for c in BADPUT_CATEGORIES:
+                out[f"{c}_s"] = round(self.seconds[c], 3)
+            return out
+
+
+_LEDGER = GoodputLedger()
+
+
+def get_ledger() -> GoodputLedger:
+    """The process-wide ledger every layer reports into."""
+    return _LEDGER

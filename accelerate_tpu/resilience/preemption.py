@@ -1,0 +1,184 @@
+"""Preemption detection — signals in, one agreed-on bit out.
+
+TPU slices are preempted with a SIGTERM and a short grace window (spot/
+preemptible VMs, maintenance events, pod evictions). Under single-program
+multi-host execution the *whole slice* must act on it together: if only the
+signaled host stops to checkpoint, every other host deadlocks in its next
+collective. So detection is split in two:
+
+- a :class:`PreemptionWatcher` turns SIGTERM/SIGINT into a **sticky local
+  flag** (signal handlers must do nearly nothing — the actual checkpoint runs
+  on the training thread at the next step boundary), optionally OR-ing in a
+  pluggable *maintenance-event poller* (e.g. the GCE metadata server, polled
+  at a bounded rate);
+- :meth:`PreemptionWatcher.sync` turns the per-host flags into an all-host
+  agreement with one tiny sum collective (the same idiom as
+  ``Accelerator.check_trigger``): **any** flagged host means **every** host
+  checkpoints and exits at the same step.
+
+``Accelerator.checkpoint_on_preemption()`` drives this once per training step;
+the launcher installs the default watcher early (ACCELERATE_HANDLE_PREEMPTION)
+so a SIGTERM during compile or data loading is not lost.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+import time
+from typing import Callable
+
+import numpy as np
+
+from ..logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class PreemptionWatcher:
+    """Sticky preemption flag fed by signals and an optional poller.
+
+    ``poller`` is any zero-arg callable returning truthy when the platform has
+    announced an upcoming maintenance event; it is rate-limited to one call per
+    ``poll_interval_s`` and its result is sticky (once preempting, always
+    preempting — the grace window only shrinks).
+    """
+
+    def __init__(
+        self,
+        signals: tuple = (signal.SIGTERM, signal.SIGINT),
+        poller: Callable[[], bool] | None = None,
+        poll_interval_s: float = 5.0,
+    ):
+        self.signals = tuple(signals)
+        self.poller = poller
+        self.poll_interval_s = poll_interval_s
+        self._flag = False
+        self._signal_received = None
+        self._prev_handlers = None
+        self._last_poll = 0.0
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------- lifecycle
+    def install(self) -> "PreemptionWatcher":
+        """Install the signal handlers (idempotent; main thread only — the
+        Python signal API's constraint, same as every trainer's)."""
+        if self._prev_handlers is not None:
+            return self
+        self._prev_handlers = {}
+        for sig in self.signals:
+            self._prev_handlers[sig] = signal.signal(sig, self._handler)
+        return self
+
+    def uninstall(self):
+        if self._prev_handlers is None:
+            return
+        for sig, prev in self._prev_handlers.items():
+            signal.signal(sig, prev)
+        self._prev_handlers = None
+
+    def __enter__(self):
+        return self.install()
+
+    def __exit__(self, *exc):
+        self.uninstall()
+        return False
+
+    def _handler(self, signum, frame):
+        # Handlers must be async-signal-safe-ish: set the flag, log, return.
+        # The training thread acts at the next checkpoint_on_preemption().
+        self._flag = True
+        self._signal_received = signum
+        logger.warning(
+            f"Received signal {signal.Signals(signum).name}: preemption flagged; "
+            "an emergency checkpoint will be taken at the next step boundary."
+        )
+        # A second SIGINT should still interrupt hard (developer Ctrl-C twice).
+        if signum == signal.SIGINT and self._prev_handlers is not None:
+            prev = self._prev_handlers.pop(signum, signal.default_int_handler)
+            signal.signal(signum, prev)
+
+    # ------------------------------------------------------------- detection
+    @property
+    def preemption_requested(self) -> bool:
+        """This host's sticky flag (signal OR a previous positive poll)."""
+        return self._flag
+
+    def poll(self) -> bool:
+        """Local flag, refreshed from the maintenance poller (rate-limited)."""
+        if self._flag or self.poller is None:
+            return self._flag
+        now = time.monotonic()
+        with self._lock:
+            if now - self._last_poll < self.poll_interval_s:
+                return self._flag
+            self._last_poll = now
+        try:
+            if self.poller():
+                self._flag = True
+                logger.warning("Maintenance-event poller reported an upcoming event.")
+        except Exception as exc:  # a flaky metadata server must not kill training
+            logger.warning(f"Maintenance poller failed ({exc!r}); ignoring.")
+        return self._flag
+
+    def sync(self, state=None) -> bool:
+        """All-host agreement: True everywhere iff ANY host is flagged.
+
+        Single-process topologies short-circuit to the local flag (no device
+        round-trip per step); multi-host runs pay one scalar sum collective —
+        every process must therefore call ``sync`` at the same step boundary,
+        which ``checkpoint_on_preemption``'s once-per-step contract provides.
+        """
+        local = self.poll()
+        if state is None:
+            from ..state import PartialState
+
+            state = PartialState()
+        if state.num_processes <= 1:
+            return local
+        from ..utils import operations as ops
+
+        total = ops.reduce(np.asarray(int(local), dtype=np.int32), reduction="sum")
+        agreed = float(np.asarray(total)) >= 1
+        if agreed:
+            self._flag = True  # agreement is sticky on every host
+        return agreed
+
+
+def gce_maintenance_poller(timeout_s: float = 0.5) -> bool:
+    """Poll the GCE metadata server for an upcoming maintenance event — the
+    pluggable poller for GCP TPU VMs (pass as ``PreemptionWatcher(poller=...)``).
+    Returns False on any error: off-GCP hosts simply never fire."""
+    import urllib.request
+
+    req = urllib.request.Request(
+        "http://metadata.google.internal/computeMetadata/v1/instance/maintenance-event",
+        headers={"Metadata-Flavor": "Google"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            return resp.read().decode().strip() != "NONE"
+    except Exception:
+        return False
+
+
+_default_watcher: PreemptionWatcher | None = None
+
+
+def get_default_watcher(install: bool = True) -> PreemptionWatcher:
+    """The process-wide watcher shared by ``PartialState`` (env-driven install)
+    and ``Accelerator.checkpoint_on_preemption``."""
+    global _default_watcher
+    if _default_watcher is None:
+        _default_watcher = PreemptionWatcher()
+    if install:
+        _default_watcher.install()
+    return _default_watcher
+
+
+def reset_default_watcher():
+    """Uninstall and forget the default watcher (tests)."""
+    global _default_watcher
+    if _default_watcher is not None:
+        _default_watcher.uninstall()
+    _default_watcher = None

@@ -1,0 +1,157 @@
+"""Auto-resume runner — restart is the recovery primitive on TPU.
+
+XLA collectives cannot survive a lost participant, so "elastic" on a slice
+means: the whole gang dies, a new incarnation starts, and training resumes
+from the newest *complete* checkpoint. :func:`run_resilient` is that loop in
+process form — the in-process twin of ``accelerate-tpu launch --max_restarts``
+(which relaunches whole processes). It wraps a user ``train_fn`` with
+
+- **auto-resume**: before every attempt, restore from the newest complete
+  checkpoint (``load_accelerator_state`` already skips partially-written
+  folders and falls back), so ``train_fn`` only needs to start its loop at
+  ``accelerator.step``;
+- **bounded retries**: exponential backoff with jitter between attempts
+  (restarting a whole slice-worth of hosts at the same instant is how
+  coordinators get hammered), giving up after ``max_restarts``;
+- **crash-loop detection**: a restart *budget per time window* — a job that
+  dies instantly N times in a row is broken, not preempted, and burning the
+  restart budget on it hides the real failure;
+- **goodput accounting**: restore time and backoff downtime land in the
+  :mod:`.goodput` ledger, and the final breakdown is pushed through
+  ``accelerator.log_goodput()``.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import random
+import time
+from typing import Any, Callable
+
+from ..logging import get_logger
+from .goodput import get_ledger
+
+logger = get_logger(__name__)
+
+
+def run_resilient(
+    train_fn: Callable,
+    accelerator,
+    *,
+    max_restarts: int = 3,
+    backoff_base_s: float = 1.0,
+    backoff_max_s: float = 60.0,
+    backoff_jitter: float = 0.25,
+    restart_budget: int | None = None,
+    restart_window_s: float = 600.0,
+    resume: bool = True,
+    checkpoint_dir: str | None = None,
+) -> Any:
+    """Run ``train_fn(accelerator, attempt)`` to completion through failures.
+
+    ``train_fn`` must be written resumable: loop from ``accelerator.step``
+    (restored by ``load_state``) and call ``accelerator.save_state()``
+    periodically plus ``accelerator.checkpoint_on_preemption()`` each step.
+    ``train_fn`` taking a single argument is also accepted.
+
+    ``checkpoint_dir`` resumes from an explicit folder; the default resumes
+    via the project configuration's ``automatic_checkpoint_naming`` layout.
+    ``restart_budget`` restarts within ``restart_window_s`` seconds trip the
+    crash-loop detector (a ``RuntimeError`` that preserves the original
+    failure as its cause); ``None`` disables the window check.
+
+    Returns whatever ``train_fn`` returns. Raises the last failure once
+    ``max_restarts`` is exhausted.
+    """
+    if max_restarts < 0:
+        raise ValueError(f"max_restarts must be >= 0, got {max_restarts}")
+    ledger = get_ledger()
+    restart_times: collections.deque = collections.deque()
+    attempt = 0
+    while True:
+        try:
+            # Resume INSIDE the guarded region: a failing restore (torn array
+            # file, transient filesystem error) must consume a retry like any
+            # other failure, not bypass the backoff/budget machinery.
+            if resume:
+                _try_resume(accelerator, checkpoint_dir)
+            result = _call_train_fn(train_fn, accelerator, attempt)
+            accelerator.log_goodput()
+            return result
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as exc:
+            attempt += 1
+            if attempt > max_restarts:
+                logger.error(
+                    f"Training failed and the restart budget is exhausted "
+                    f"({max_restarts} restarts): {exc!r}"
+                )
+                raise
+            now = time.monotonic()
+            restart_times.append(now)
+            if restart_budget is not None:
+                while restart_times and now - restart_times[0] > restart_window_s:
+                    restart_times.popleft()
+                if len(restart_times) > restart_budget:
+                    raise RuntimeError(
+                        f"Crash loop detected: {len(restart_times)} restarts within "
+                        f"{restart_window_s:.0f}s exceeds the budget of {restart_budget}. "
+                        "The job is failing deterministically, not being preempted — "
+                        "fix the failure instead of restarting through it."
+                    ) from exc
+            delay = min(backoff_max_s, backoff_base_s * (2 ** (attempt - 1)))
+            delay *= 1.0 + random.uniform(0.0, backoff_jitter)
+            logger.warning(
+                f"Attempt {attempt}/{max_restarts} failed ({type(exc).__name__}: {exc}); "
+                f"resuming from the newest complete checkpoint in {delay:.1f}s."
+            )
+            t = time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            ledger.record_restart(time.perf_counter() - t)
+
+
+def _call_train_fn(train_fn, accelerator, attempt):
+    import inspect
+
+    try:
+        params = list(inspect.signature(train_fn).parameters.values())
+        positional = [
+            p for p in params if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+        ]
+        # Only a second POSITIONAL slot (or *args) can receive attempt —
+        # keyword-only params must not count toward the arity.
+        takes_attempt = len(positional) >= 2 or any(
+            p.kind == p.VAR_POSITIONAL for p in params
+        )
+    except (TypeError, ValueError):
+        takes_attempt = True
+    return train_fn(accelerator, attempt) if takes_attempt else train_fn(accelerator)
+
+
+def _try_resume(accelerator, checkpoint_dir):
+    """Restore from the newest complete checkpoint if one exists; a fresh run
+    (nothing saved yet) starts clean instead of failing."""
+    from ..checkpointing import _checkpoint_complete
+    from ..utils.constants import CHECKPOINT_DIR_PREFIX
+
+    project = accelerator.project_configuration
+    # No ckpt_restore tracking here: load_accelerator_state records its own
+    # elapsed time in the ledger — wrapping it again would double-count.
+    if checkpoint_dir is not None:
+        if os.path.isdir(checkpoint_dir) and _checkpoint_complete(checkpoint_dir, accelerator):
+            accelerator.load_state(checkpoint_dir)
+        return
+    if not (project.automatic_checkpoint_naming and project.project_dir):
+        return
+    base = os.path.join(project.project_dir, "checkpoints")
+    if not os.path.isdir(base) or not any(
+        f.startswith(f"{CHECKPOINT_DIR_PREFIX}_") for f in os.listdir(base)
+    ):
+        return
+    try:
+        accelerator.load_state()  # newest COMPLETE folder; skips litter
+    except FileNotFoundError:
+        logger.warning(f"No complete checkpoint under {base}; starting fresh.")

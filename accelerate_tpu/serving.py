@@ -69,6 +69,7 @@ import jax
 import jax.numpy as jnp
 
 from .generation import _unwrap, left_align, mask_positions
+from .utils.environment import safe_donate_argnums
 
 
 def _first_stop_end(row: np.ndarray, stops: tuple) -> int | None:
@@ -263,7 +264,7 @@ class ContinuousBatcher:
                     "kv_mask": cache["kv_mask"].at[:, :P].set(1),
                 }
 
-            self._prefix_fns[P] = jax.jit(fill, donate_argnums=(1,))
+            self._prefix_fns[P] = jax.jit(fill, donate_argnums=safe_donate_argnums((1,)))
         self._cache = self._prefix_fns[P](self.params, self._cache,
                                           jnp.asarray(prefix)[None])
         self._host_pos = P
@@ -320,7 +321,7 @@ class ContinuousBatcher:
                     "pos": jnp.max(jnp.sum(km, axis=1)).astype(cache["pos"].dtype),
                 }
 
-            self._compact_fn = jax.jit(run, donate_argnums=(0,))
+            self._compact_fn = jax.jit(run, donate_argnums=safe_donate_argnums((0,)))
         dead = jnp.asarray([r is None for r in self._slot_req])
         self._cache = self._compact_fn(self._cache, dead, jnp.int32(self._pfx))
         new_pos = int(self._cache["pos"])

@@ -46,9 +46,11 @@ from .utils.constants import (
     ENV_COORDINATOR,
     ENV_CPU,
     ENV_DEBUG_MODE,
+    ENV_HANDLE_PREEMPTION,
     ENV_MIXED_PRECISION,
     ENV_NUM_PROCESSES,
     ENV_PROCESS_ID,
+    ENV_RESTART_ATTEMPT,
 )
 from .utils.environment import (
     maybe_enable_compilation_cache,
@@ -145,6 +147,20 @@ class PartialState:
         # bench re-run) load their programs instead of re-building them.
         maybe_enable_compilation_cache()
         _maybe_init_jax_distributed()
+        # Resilience wiring (resilience/): count this gang incarnation in the
+        # goodput ledger (the launcher increments ACCELERATE_RESTART_ATTEMPT on
+        # every relaunch), and install the preemption watcher EARLY when the
+        # launch contract asks for it — a SIGTERM during the first compile or
+        # data-loader warmup must set the sticky flag, not kill the process.
+        from .resilience.goodput import get_ledger
+
+        get_ledger().mark_process_start(
+            attempt=int(os.environ.get(ENV_RESTART_ATTEMPT, "0") or 0)
+        )
+        if parse_flag_from_env(ENV_HANDLE_PREEMPTION):
+            from .resilience.preemption import get_default_watcher
+
+            get_default_watcher(install=True)
 
         platform = jax.default_backend()
         if self._cpu and platform != "cpu":

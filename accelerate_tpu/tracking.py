@@ -120,6 +120,10 @@ class JSONTracker(GeneralTracker):
         self.dir = os.path.join(logging_dir, run_name)
         os.makedirs(self.dir, exist_ok=True)
         self.path = os.path.join(self.dir, "metrics.jsonl")
+        # Persistent line-buffered handle, flushed per record: a preempted or
+        # SIGKILLed run keeps every metric logged up to the kill — reopening
+        # per call would also be an open/close syscall pair per step.
+        self._file = open(self.path, "a", buffering=1)
         self._t0 = time.time()
 
     @property
@@ -135,8 +139,15 @@ class JSONTracker(GeneralTracker):
     def log(self, values: dict, step: int | None = None, **kwargs):
         record = {"_step": step, "_time": round(time.time() - self._t0, 3)}
         record.update({k: (float(v) if hasattr(v, "__float__") else v) for k, v in values.items()})
-        with open(self.path, "a") as f:
-            f.write(json.dumps(record, default=str) + "\n")
+        if self._file.closed:  # logging after finish() reopens rather than dies
+            self._file = open(self.path, "a", buffering=1)
+        self._file.write(json.dumps(record, default=str) + "\n")
+        self._file.flush()
+
+    @on_main_process
+    def finish(self):
+        if not self._file.closed:
+            self._file.close()
 
     @on_main_process
     def log_images(self, values: dict, step: int | None = None, **kwargs):

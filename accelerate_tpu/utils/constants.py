@@ -41,6 +41,13 @@ ENV_MESH_SHAPE = "ACCELERATE_MESH_SHAPE"
 # directory to stop every process start from re-paying minutes of compiles.
 ENV_COMPILE_CACHE_DIR = "ACCELERATE_COMPILE_CACHE_DIR"
 ENV_COMPILE_CACHE_MIN_SECS = "ACCELERATE_COMPILE_CACHE_MIN_COMPILE_SECS"
+# Resilience contract (resilience/): install the SIGTERM/SIGINT preemption
+# watcher at PartialState init, the deterministic fault-injection plan
+# ("step:<N>=<action>[:<arg>];..."), and the gang incarnation counter the
+# launcher increments on every relaunch (TORCHELASTIC_RESTART_COUNT analog).
+ENV_HANDLE_PREEMPTION = "ACCELERATE_HANDLE_PREEMPTION"
+ENV_FAULT_PLAN = "ACCELERATE_FAULT_PLAN"
+ENV_RESTART_ATTEMPT = "ACCELERATE_RESTART_ATTEMPT"
 
 # ``dcn`` is the slice axis of a multi-slice pod: replicas connected by
 # data-center network rather than ICI. It is outermost so only the axes meant

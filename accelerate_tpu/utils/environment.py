@@ -99,6 +99,26 @@ def maybe_enable_compilation_cache(cache_dir: str | None = None) -> str | None:
     return cache_dir
 
 
+def safe_donate_argnums(argnums: tuple) -> tuple:
+    """Gate buffer donation on backends where it is actually safe.
+
+    jaxlib 0.4.3x CPU executables **deserialized from the persistent
+    compilation cache** mis-handle input-output aliasing: running them with
+    donated inputs corrupts the allocator heap (reproducible segfault /
+    ``malloc(): memory corruption`` once an orbax *restore* churns the heap —
+    exactly the resume-after-restart path the compilation cache exists to
+    accelerate). Donation on CPU buys nothing (host RAM, no HBM pressure), so
+    when both features would combine — CPU backend AND an active persistent
+    cache — donation is dropped; TPU/GPU always keep it, where it is the
+    HBM-pressure win the fused train step is built around.
+    """
+    import jax
+
+    if jax.default_backend() == "cpu" and jax.config.jax_compilation_cache_dir:
+        return ()
+    return tuple(argnums)
+
+
 def get_int_from_env(env_keys, default: int) -> int:
     """Return the first positive int found among env_keys."""
     for key in env_keys:

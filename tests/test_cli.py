@@ -256,6 +256,7 @@ def test_config_wizard_roundtrips_through_launch(tmp_path):
         str(tmp_path / "proj"),  # project dir
         "yes",               # auto naming
         "3",                 # total limit
+        "yes",               # handle preemption (SIGTERM watcher)
         "yes",               # configure tracking?
         "json",              # trackers
         "yes",               # persistent compilation cache?
@@ -267,6 +268,7 @@ def test_config_wizard_roundtrips_through_launch(tmp_path):
     assert cfg.fsdp_min_shard_size == 1024 and cfg.fsdp_cpu_offload
     assert cfg.gradient_accumulation_steps == 4 and cfg.log_with == "json"
     assert cfg.checkpoint_total_limit == 3 and cfg.checkpoint_auto_naming
+    assert cfg.handle_preemption
     assert cfg.compile_cache_dir == str(tmp_path / "xla_cache")
     config_path = tmp_path / "cfg.yaml"
     cfg.to_yaml_file(str(config_path))
@@ -284,6 +286,9 @@ def test_config_wizard_roundtrips_through_launch(tmp_path):
         "assert acc.project_configuration.automatic_checkpoint_naming\n"
         "assert acc.project_configuration.total_limit == 3\n"
         "assert os.environ['ACCELERATE_COMPILE_CACHE_DIR'].endswith('xla_cache')\n"
+        "assert os.environ.get('ACCELERATE_HANDLE_PREEMPTION') == '1'\n"
+        "from accelerate_tpu.resilience.preemption import get_default_watcher\n"
+        "assert get_default_watcher(install=False)._prev_handlers is not None\n"
         "import jax\n"
         "assert jax.config.jax_compilation_cache_dir.endswith('xla_cache')\n"
         "print('ROUNDTRIP_OK')\n"
