@@ -292,6 +292,13 @@ class PreparedModel:
         args, kwargs = self.accelerator._place_batch((args, kwargs))
         if self.training:
             scaler = self.accelerator.scaler
+            if scaler is not None:
+                # The previous step's deferred overflow outcome must land
+                # before its scale seeds this forward (optimizer.py keeps the
+                # hot path async by resolving found_inf lazily, here).
+                opt = self.accelerator._optimizer_for_handle(handle)
+                if opt is not None:
+                    opt._resolve_pending_finite()
             loss_scale = jnp.float32(scaler.scale if scaler is not None else 1.0)
             loss, outputs, grads = self._train_call(handle.params, args, kwargs, rng, loss_scale)
             handle.pending = (loss, grads)
@@ -444,6 +451,7 @@ class Accelerator:
         self.flag_tensor = None
         self._resilience_step = 0
         self._preemption_watcher = None
+        self._health_guard = None
         self._models: list[PreparedModel] = []
         self._optimizers: list[AcceleratedOptimizer] = []
         self._schedulers: list[AcceleratedScheduler] = []
@@ -1272,11 +1280,15 @@ class Accelerator:
         ``step`` defaults to an internal once-per-call counter; pass the loop's
         own global step when resuming mid-plan so fault steps stay aligned.
         """
+        from .health.hang import beat_default
         from .resilience.faults import active_plan
         from .resilience.goodput import get_ledger
 
         self._resilience_step += 1
         step = self._resilience_step if step is None else step
+        # A completed step boundary is a heartbeat: loops that only call this
+        # hook (no guard_step) still keep the hang watchdog fed.
+        beat_default(step)
         # Install the watcher BEFORE the fault plan can deliver a signal: a
         # 'sigterm' fault at the first hooked step must hit the sticky-flag
         # handler, not the default disposition (process death).
@@ -1295,6 +1307,62 @@ class Accelerator:
 
     def skip_first_batches(self, dataloader, num_batches: int = 0):
         return skip_first_batches(dataloader, num_batches)
+
+    # -------------------------------------------------------------- health
+    @property
+    def health_guard(self):
+        """The :class:`~.health.guard.HealthGuard` driven by ``guard_step``,
+        built lazily from the env contract (ACCELERATE_GUARD_NUMERICS /
+        ACCELERATE_SPIKE_ZSCORE — the launcher's --guard_numerics /
+        --spike_zscore flags); ``configure_health`` overrides it."""
+        if self._health_guard is None:
+            self._health_guard = self._build_health_guard()
+        return self._health_guard
+
+    def configure_health(self, **kwargs):
+        """Build the health guard explicitly (kwargs go to
+        :class:`~.health.guard.HealthGuard`); replaces any lazy/env guard."""
+        from .health.guard import HealthGuard
+
+        self._health_guard = HealthGuard(**kwargs)
+        return self._health_guard
+
+    def _build_health_guard(self):
+        from .health.guard import HealthGuard
+        from .utils.constants import ENV_GUARD_NUMERICS, ENV_SPIKE_ZSCORE
+
+        # The sentinel is always-on by default; the env can only widen or
+        # disable it ("0"/"false"), mirroring the launch-flag semantics.
+        kwargs: dict = {
+            "numerics": os.environ.get(ENV_GUARD_NUMERICS, "").strip().lower()
+            not in ("0", "false", "no")
+        }
+        zscore = os.environ.get(ENV_SPIKE_ZSCORE, "").strip()
+        if zscore:
+            kwargs["spike_zscore"] = float(zscore)
+        return HealthGuard(**kwargs)
+
+    def guard_step(self, loss=None, step: int | None = None):
+        """Call once per training step, after the optimizer step: run the
+        training-health protocol (docs/health.md) on this step's ``loss``.
+
+        Heartbeats the hang watchdog, consumes any ``nan``/``loss_spike``
+        fault scheduled for this step, folds the numerics + spike verdict
+        into one on-device dispatch, drains prior verdicts without blocking,
+        agrees any trip across hosts, and applies the recovery action —
+        rollback to the last-known-good snapshot (quarantining the poisoned
+        step so ``health_guard.should_skip`` excludes it on replay) or
+        skip+quarantine. Returns a :class:`~.health.guard.HealthVerdict`;
+        after ``verdict.rolled_back`` the loop must re-read ``self.step``.
+
+        ``step`` defaults to ``self.step`` — the 1-based count the resilient
+        loop convention maintains (the same numbering fault plans use).
+        """
+        from .health.hang import beat_default
+
+        step = self.step if step is None else step
+        beat_default(step)
+        return self.health_guard.guard_step(self, loss, step)
 
     # ---------------------------------------------------------------- profile
     @contextlib.contextmanager

@@ -176,6 +176,8 @@ def save_accelerator_state(accelerator, output_dir: str | None = None, safe_seri
     # Sharded optimizer state.
     for i, opt in enumerate(accelerator._optimizers):
         suffix = "" if i == 0 else f"_{i}"
+        if hasattr(opt, "_resolve_pending_finite"):
+            opt._resolve_pending_finite()  # step_count/scale must be final on disk
         if opt.opt_state is not None:
             _queue_save(os.path.join(output_dir, f"{OPTIMIZER_NAME}{suffix}"), opt.opt_state)
             expected_items.append(f"{OPTIMIZER_NAME}{suffix}")

@@ -112,6 +112,23 @@ def get_user_input() -> ClusterConfig:
             "  handle preemption (SIGTERM -> emergency checkpoint; resume via "
             "run_resilient)?", False
         )
+    # Tri-state: skipping the section leaves None (nothing exported, library
+    # defaults apply); explicit answers — including "no"/0 — reach the workers.
+    guard_numerics, spike_zscore, hang_timeout = None, None, 0.0
+    if _yesno(
+        "Do you want to configure training-health guards (NaN sentinel, "
+        "loss-spike rollback, hang watchdog)?", False
+    ):
+        guard_numerics = _yesno(
+            "  always-on numerics sentinel (on-device finite loss/grad checks)?", True
+        )
+        spike_zscore = _ask(
+            "  loss-spike robust z-score threshold (0 disables the detector)", 6.0, float
+        )
+        hang_timeout = _ask(
+            "  hang watchdog timeout in seconds (0 = disabled; dumps stacks and "
+            "exits 113 for the launcher to restart)", 0.0, float
+        )
     log_with = ""
     if _yesno("Do you want to configure experiment tracking?", False):
         log_with = _ask(
@@ -162,6 +179,9 @@ def get_user_input() -> ClusterConfig:
         log_with=log_with,
         compile_cache_dir=compile_cache_dir,
         handle_preemption=handle_preemption,
+        guard_numerics=guard_numerics,
+        spike_zscore=spike_zscore,
+        hang_timeout=hang_timeout,
     )
 
 

@@ -103,6 +103,15 @@ class ClusterConfig:
     # "step:37=kill;step:80=partial_ckpt").
     handle_preemption: bool = False
     fault_plan: str = ""
+    # Training-health guards (health/): numerics sentinel + spike detector
+    # driven by Accelerator.guard_step, and the hang watchdog's heartbeat
+    # deadline (ACCELERATE_HANG_TIMEOUT; 0.0 = disabled). The first two are
+    # TRI-state: None = not configured (nothing exported; guard_step's own
+    # defaults apply — sentinel on, z=6.0), True/False and a float (0 =
+    # detector off) are explicit answers that must reach the workers.
+    guard_numerics: bool | None = None
+    spike_zscore: float | None = None
+    hang_timeout: float = 0.0
     extra: dict = field(default_factory=dict)
 
     def to_dict(self) -> dict:

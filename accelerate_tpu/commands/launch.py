@@ -29,12 +29,15 @@ from ..utils.constants import (
     ENV_CPU,
     ENV_DEBUG_MODE,
     ENV_FAULT_PLAN,
+    ENV_GUARD_NUMERICS,
     ENV_HANDLE_PREEMPTION,
+    ENV_HANG_TIMEOUT,
     ENV_MESH_SHAPE,
     ENV_MIXED_PRECISION,
     ENV_NUM_PROCESSES,
     ENV_PROCESS_ID,
     ENV_RESTART_ATTEMPT,
+    ENV_SPIKE_ZSCORE,
 )
 from .config_args import ClusterConfig, load_config_from_file
 
@@ -96,9 +99,31 @@ def launch_command_parser(subparsers=None) -> argparse.ArgumentParser:
     )
     parser.add_argument(
         "--fault_plan", default=None,
-        help="Deterministic fault-injection plan for resilience drills, e.g. "
-             "'step:37=kill;step:80=partial_ckpt' (exported as "
-             "ACCELERATE_FAULT_PLAN; see docs/resilience.md for the grammar).",
+        help="Deterministic fault-injection plan for resilience/health drills, "
+             "e.g. 'step:37=kill;step:40=loss_spike:50x;step:80=hang:600' "
+             "(exported as ACCELERATE_FAULT_PLAN; see docs/resilience.md and "
+             "docs/health.md for the grammar).",
+    )
+    parser.add_argument(
+        "--guard_numerics", action="store_true", default=None,
+        help="Always-on training-health guard (ACCELERATE_GUARD_NUMERICS): "
+             "on-device finite checks of loss/grad-norm plus the loss-spike "
+             "detector, driven by Accelerator.guard_step() each step "
+             "(docs/health.md). The sentinel defaults on for loops that call "
+             "guard_step; this flag pins it on explicitly.",
+    )
+    parser.add_argument(
+        "--spike_zscore", type=float, default=None,
+        help="Robust z-score threshold for the loss-spike detector "
+             "(ACCELERATE_SPIKE_ZSCORE; library default 6.0; 0 disables).",
+    )
+    parser.add_argument(
+        "--hang_timeout", type=float, default=None,
+        help="Hang-watchdog deadline in seconds (ACCELERATE_HANG_TIMEOUT): "
+             "when no training step completes within the deadline, every "
+             "thread's stack is dumped and the process exits with code 113 "
+             "so --max_restarts (or the scheduler) can restart the gang "
+             "instead of burning reserved chips on a deadlock.",
     )
     parser.add_argument("-m", "--module", action="store_true", help="Run script as a python module")
     parser.add_argument("training_script", help="Path to the script to launch")
@@ -134,6 +159,9 @@ def _merge_config(args) -> ClusterConfig:
         ("compile_cache_dir", "compile_cache_dir"),
         ("handle_preemption", "handle_preemption"),
         ("fault_plan", "fault_plan"),
+        ("guard_numerics", "guard_numerics"),
+        ("spike_zscore", "spike_zscore"),
+        ("hang_timeout", "hang_timeout"),
     ]:
         val = getattr(args, flag, None)
         if val is not None:
@@ -183,6 +211,15 @@ def prepare_launch_env(cfg: ClusterConfig, process_id: int | None = None, attemp
         env[ENV_HANDLE_PREEMPTION] = "1"
     if cfg.fault_plan:
         env[ENV_FAULT_PLAN] = cfg.fault_plan
+    # Tri-state health knobs: None = not configured (export nothing, library
+    # defaults apply); an explicit False / 0 must reach the workers as a
+    # disable, not vanish behind a truthiness check.
+    if cfg.guard_numerics is not None:
+        env[ENV_GUARD_NUMERICS] = "1" if cfg.guard_numerics else "0"
+    if cfg.spike_zscore is not None:
+        env[ENV_SPIKE_ZSCORE] = str(cfg.spike_zscore)
+    if cfg.hang_timeout:
+        env[ENV_HANG_TIMEOUT] = str(cfg.hang_timeout)
     # Plugins (e.g. the axon tunnel) may have pinned JAX_PLATFORMS in *this*
     # process's environ at jax-import time; children must re-discover their own
     # backend, so only forward the value we set deliberately.
@@ -228,8 +265,8 @@ def simple_launcher(args, cfg: ClusterConfig) -> int:
             return 0
         if attempt < cfg.max_restarts:
             print(
-                f"Process failed (rc={proc.returncode}); restart "
-                f"{attempt + 1}/{cfg.max_restarts} (resume from the latest "
+                f"Process failed (rc={proc.returncode}){_rc_hint(proc.returncode)}; "
+                f"restart {attempt + 1}/{cfg.max_restarts} (resume from the latest "
                 "checkpoint is the script's responsibility via load_state)."
             )
     return proc.returncode
@@ -248,10 +285,19 @@ def multi_process_launcher(args, cfg: ClusterConfig) -> int:
             return 0
         if attempt < cfg.max_restarts:
             print(
-                f"Gang failed (rc={rc}); restarting all ranks "
+                f"Gang failed (rc={rc}){_rc_hint(rc)}; restarting all ranks "
                 f"{attempt + 1}/{cfg.max_restarts}."
             )
     return rc
+
+
+def _rc_hint(rc: int) -> str:
+    """Name the exit codes with framework-defined meaning."""
+    from ..health.hang import HANG_EXIT_CODE
+
+    if rc == HANG_EXIT_CODE:
+        return " [hang watchdog: no step within --hang_timeout; stacks on stderr]"
+    return ""
 
 
 def _run_gang_once(args, cfg: ClusterConfig, attempt: int = 0) -> int:
@@ -289,10 +335,15 @@ def launch_command(args) -> None:
         raise ValueError(f"--max_restarts must be >= 0, got {cfg.max_restarts}")
     if cfg.fault_plan:
         # Fail a malformed plan at launch, not after every worker has paid the
-        # XLA compile and hit its first checkpoint_on_preemption call.
+        # XLA compile and hit its first checkpoint_on_preemption call. Covers
+        # the health kinds (nan/loss_spike/hang) and their arguments too.
         from ..resilience.faults import FaultPlan
 
         FaultPlan.parse(cfg.fault_plan)
+    if cfg.spike_zscore and cfg.spike_zscore < 0:
+        raise ValueError(f"--spike_zscore must be >= 0, got {cfg.spike_zscore}")
+    if cfg.hang_timeout and cfg.hang_timeout < 0:
+        raise ValueError(f"--hang_timeout must be >= 0, got {cfg.hang_timeout}")
     if cfg.max_restarts > 0 and cfg.num_machines > 1:
         raise ValueError(
             "--max_restarts only applies to single-machine jobs: on a pod, a "

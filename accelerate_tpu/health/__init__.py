@@ -1,0 +1,50 @@
+"""Training-health watchdog — guards against the faults that come from *inside*.
+
+The resilience subsystem (:mod:`..resilience`) recovers from external faults:
+preemptions, kills, torn checkpoints. This package covers the internal half —
+the failures that silently destroy a run while every process stays alive:
+
+- :mod:`.numerics` — always-on, on-device finite checks of loss and grad-norm
+  (piggybacking on the global norm the optimizer already computes — no extra
+  host syncs in any precision mode), plus an on-trip bisection pass that
+  attributes *which* param-tree leaves went non-finite;
+- :mod:`.spike` — a loss-spike detector keeping rolling robust statistics
+  (EMA + a streaming MAD proxy) as device-side state updated inside the same
+  dispatch as the check;
+- :mod:`.rollback` — in-memory last-known-good snapshots taken every K steps,
+  restored (with RNG streams and optimizer bookkeeping) when a guard trips;
+- :mod:`.hang` — a host-side heartbeat watchdog that converts a silent
+  multi-host deadlock into stack dumps + a distinct exit code (or an in-process
+  :class:`~.hang.HangDetected` for ``run_resilient`` to restart through);
+- :mod:`.guard` — :class:`~.guard.HealthGuard`, the per-step orchestrator
+  driven by ``Accelerator.guard_step()``: verdicts are drained without blocking
+  the dispatch thread, trips are agreed across hosts with one scalar exchange
+  (the :mod:`..resilience.preemption` pattern), and the chosen action —
+  rollback or skip+quarantine — is applied identically on every host.
+
+Drills: the fault plan grammar (``ACCELERATE_FAULT_PLAN``) accepts ``nan``,
+``loss_spike:<mult>x`` and ``hang:<secs>`` kinds so every recovery path here
+runs deterministically in CI. See ``docs/health.md``.
+"""
+
+from .guard import HealthGuard, HealthVerdict
+from .hang import HANG_EXIT_CODE, HangDetected, HangWatchdog
+from .numerics import NONFINITE_GRAD, NONFINITE_LOSS, NumericsSentinel, finite_scalar, nonfinite_leaves
+from .rollback import LastKnownGood
+from .spike import LOSS_SPIKE, SpikeDetector
+
+__all__ = [
+    "HANG_EXIT_CODE",
+    "HangDetected",
+    "HangWatchdog",
+    "HealthGuard",
+    "HealthVerdict",
+    "LOSS_SPIKE",
+    "LastKnownGood",
+    "NONFINITE_GRAD",
+    "NONFINITE_LOSS",
+    "NumericsSentinel",
+    "SpikeDetector",
+    "finite_scalar",
+    "nonfinite_leaves",
+]

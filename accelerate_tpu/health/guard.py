@@ -1,0 +1,340 @@
+"""HealthGuard — the per-step orchestrator behind ``Accelerator.guard_step()``.
+
+One call per training step, after the optimizer step, mirroring the
+``checkpoint_on_preemption`` contract:
+
+    verdict = accelerator.guard_step(loss)        # step defaults to accelerator.step
+    if verdict.rolled_back:
+        continue                                   # loop re-reads accelerator.step
+
+Per step the guard does four things, none of which stall the dispatch thread:
+
+1. **observe** — one jitted dispatch folds the numerics flags
+   (:mod:`.numerics`) and the spike-statistics update (:mod:`.spike`) into a
+   single int32 verdict that stays on device;
+2. **drain** — pending verdicts whose results have materialized are fetched
+   (a copy, not a stall — instrumented via :mod:`...utils.transfer`); unready
+   verdicts wait, so detection may lag dispatch by a step or two on async
+   backends but never serializes it;
+3. **agree** — with >1 process the per-host flags are combined so EVERY host
+   trips (or doesn't) at the same step: one scalar device collective (the
+   :mod:`...resilience.preemption` idiom), falling back to the JAX
+   coordination-service KV store on backends without multiprocess
+   computations (the 2-process CPU harness);
+4. **act** — healthy steps refresh the last-known-good snapshot every
+   ``snapshot_every`` steps (:mod:`.rollback`); a trip either rolls every
+   host back to the snapshot and quarantines the poisoned step, or just
+   quarantines it (``on_trip="skip"``). Rollback wall-clock lands in the
+   goodput ledger's ``rollback`` badput class.
+
+Training loops consult :meth:`HealthGuard.should_skip` before computing a
+step so a quarantined batch is never replayed — which is exactly what makes
+the post-rollback trajectory bit-exact with a run that never saw the batch.
+"""
+
+from __future__ import annotations
+
+import collections
+from dataclasses import dataclass
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..logging import get_logger
+from ..utils.transfer import array_is_ready, host_fetch
+from .numerics import NONFINITE_GRAD, NONFINITE_LOSS, NumericsSentinel
+from .rollback import LastKnownGood, restore_accelerator, snapshot_accelerator
+from .spike import LOSS_SPIKE, SpikeDetector
+
+logger = get_logger(__name__)
+
+_FLAG_NAMES = {NONFINITE_LOSS: "non-finite loss", NONFINITE_GRAD: "non-finite grad norm", LOSS_SPIKE: "loss spike"}
+_FLAG_BITS = 3
+
+
+def describe_flags(flags: int) -> str:
+    names = [name for bit, name in _FLAG_NAMES.items() if flags & bit]
+    return " + ".join(names) if names else "healthy"
+
+
+@dataclass
+class HealthVerdict:
+    """What ``guard_step`` decided for (up to) this step."""
+
+    step: int
+    flags: int = 0
+    tripped: bool = False
+    action: str | None = None  # "rollback" | "skip" | None
+    resume_step: int | None = None
+    quarantined_step: int | None = None
+    rolled_back: bool = False
+    zscore: float | None = None
+
+    @property
+    def description(self) -> str:
+        return describe_flags(self.flags)
+
+
+_GUARD_SEQ = 0
+
+
+@dataclass
+class _Pending:
+    step: int
+    flags: object  # int32 device scalar
+    z: object  # float32 device scalar
+
+
+class HealthGuard:
+    """See module docstring. ``numerics=False`` disables the finite checks,
+    ``spike_zscore=0`` disables the spike detector; ``on_trip`` picks the
+    recovery action; ``snapshot_every`` the last-known-good cadence."""
+
+    def __init__(
+        self,
+        numerics: bool = True,
+        check_grads: bool = True,
+        spike_zscore: float = 6.0,
+        spike_warmup: int = 20,
+        ema_decay: float = 0.98,
+        snapshot_every: int = 25,
+        on_trip: str = "rollback",
+        max_pending: int = 8,
+        agreement_timeout_s: float = 120.0,
+    ):
+        if on_trip not in ("rollback", "skip"):
+            raise ValueError(f"on_trip must be 'rollback' or 'skip', got {on_trip!r}")
+        self.sentinel = NumericsSentinel(check_grads=check_grads) if numerics else None
+        self.spike = (
+            SpikeDetector(zscore=spike_zscore, warmup_steps=spike_warmup, ema_decay=ema_decay)
+            if spike_zscore and spike_zscore > 0
+            else None
+        )
+        self.lkg = LastKnownGood(every_steps=snapshot_every)
+        self.on_trip = on_trip
+        self.max_pending = int(max_pending)
+        self.agreement_timeout_s = float(agreement_timeout_s)
+        self.quarantined: set[int] = set()
+        self.trips = 0
+        self._spike_state = None
+        self._pending: collections.deque[_Pending] = collections.deque()
+        self._verdict_fns: dict = {}
+        self._kv_agreement = False
+        self._agree_epoch = 0
+        # KV keys/barriers must be unique per (guard, step) and IDENTICAL
+        # across ranks: ranks construct guards in the same program order, so a
+        # process-wide construction counter lines up.
+        global _GUARD_SEQ
+        _GUARD_SEQ += 1
+        self._guard_id = _GUARD_SEQ
+
+    @property
+    def enabled(self) -> bool:
+        return self.sentinel is not None or self.spike is not None
+
+    # ------------------------------------------------------------ quarantine
+    def quarantine(self, step: int):
+        """Mark ``step``'s batch poisoned: ``should_skip`` will skip it."""
+        self.quarantined.add(int(step))
+
+    def should_skip(self, step: int) -> bool:
+        return int(step) in self.quarantined
+
+    # --------------------------------------------------------------- observe
+    def _get_verdict_fn(self, with_gnorm: bool):
+        fn = self._verdict_fns.get(with_gnorm)
+        if fn is None:
+            sentinel, spike = self.sentinel, self.spike
+
+            def verdict(state, loss, gnorm=None):
+                flags = sentinel.flags(loss, gnorm) if sentinel is not None else jnp.int32(0)
+                if spike is not None:
+                    state, sflags, z = spike.update(state, loss)
+                    flags = flags | sflags
+                else:
+                    z = jnp.float32(0.0)
+                return state, flags, z
+
+            fn = jax.jit(verdict) if with_gnorm else jax.jit(lambda s, l: verdict(s, l))
+            self._verdict_fns[with_gnorm] = fn
+        return fn
+
+    def observe(self, loss, gnorm=None, step: int = 0):
+        """Dispatch this step's on-device verdict; nothing is fetched here."""
+        if not self.enabled:
+            return
+        if self._spike_state is None:
+            self._spike_state = self.spike.init_state() if self.spike is not None else ()
+        fn = self._get_verdict_fn(gnorm is not None)
+        args = (self._spike_state, loss) + ((gnorm,) if gnorm is not None else ())
+        self._spike_state, flags, z = fn(*args)
+        self._pending.append(_Pending(step=int(step), flags=flags, z=z))
+
+    # ----------------------------------------------------------------- drain
+    def _drain(self, force: bool = False):
+        """Fetch materialized verdicts (all of them when ``force``); returns
+        ``(or_of_flags, first_tripped_step, its_zscore)``."""
+        flags, trip_step, trip_z = 0, None, None
+        while self._pending:
+            entry = self._pending[0]
+            if not force and not array_is_ready(entry.flags):
+                break
+            self._pending.popleft()
+            f = int(host_fetch(entry.flags))
+            if f and trip_step is None:
+                trip_step = entry.step
+                trip_z = float(host_fetch(entry.z))
+            flags |= f
+        return flags, trip_step, trip_z
+
+    # ------------------------------------------------------------- agreement
+    def _agree(self, local_flags: int, state) -> int:
+        """All-host OR of the verdict bits: any host's trip is every host's
+        trip, at the same step — the preemption-sync contract."""
+        if state is None or getattr(state, "num_processes", 1) <= 1:
+            return local_flags
+        if not self._kv_agreement:
+            try:
+                from ..utils import operations as ops
+
+                vec = np.asarray([(local_flags >> b) & 1 for b in range(_FLAG_BITS)], np.int32)
+                total = np.asarray(ops.reduce(vec, reduction="sum"))
+                return int(sum(1 << b for b in range(_FLAG_BITS) if total[b] > 0))
+            except Exception as exc:
+                logger.warning(
+                    f"Device-collective health agreement unavailable "
+                    f"({type(exc).__name__}: {exc}); using the coordination-service "
+                    "KV exchange instead."
+                )
+                self._kv_agreement = True
+        return self._agree_kv(local_flags, state)
+
+    def _agree_kv(self, local_flags: int, state) -> int:
+        from ..utils.agreement import kv_or_exchange
+
+        self._agree_epoch += 1
+        return kv_or_exchange(
+            local_flags,
+            state.num_processes,
+            state.process_index,
+            namespace=f"at_health/{self._guard_id}/{self._agree_epoch}",
+            timeout_ms=int(self.agreement_timeout_s * 1000),
+        )
+
+    # ----------------------------------------------------------------- check
+    def check(self, loss, gnorm=None, step: int = 0, state=None):
+        """Observe + drain + agree, no recovery action: returns
+        ``(agreed_flags, trip_step, zscore)``. The building block shared by
+        :meth:`guard_step` and loops driving the guard directly (e.g. the
+        multi-host agreement drills)."""
+        if loss is not None:
+            self.observe(loss, gnorm=gnorm, step=step)
+        multi = state is not None and getattr(state, "num_processes", 1) > 1
+        # Multi-host: drain fully so every host votes on the same step window.
+        flags, trip_step, z = self._drain(force=multi)
+        while len(self._pending) > self.max_pending:
+            f2, s2, z2 = self._drain(force=True)
+            flags |= f2
+            if trip_step is None:
+                trip_step, z = s2, z2
+        agreed = self._agree(flags, state)
+        if agreed and trip_step is None:
+            trip_step = int(step)  # a remote host tripped; adopt the shared step
+        return agreed, trip_step, z
+
+    # ------------------------------------------------------------- guard_step
+    def guard_step(self, accelerator, loss, step: int) -> HealthVerdict:
+        """The full per-step protocol against a live :class:`Accelerator`."""
+        step = int(step)
+        loss = self._maybe_inject_fault(loss, step)
+        gnorm = None
+        # Under an fp16 GradScaler a non-finite grad norm is ROUTINE — the
+        # scale-growth probe overflows by design, the jitted update already
+        # skipped conditionally and the scaler backed off. Tripping (and
+        # rolling back / quarantining a healthy batch) on it would fight the
+        # scaler every growth interval, so the grad check defers to it.
+        if (
+            self.sentinel is not None
+            and self.sentinel.check_grads
+            and getattr(accelerator, "scaler", None) is None
+        ):
+            for model in accelerator._models:
+                if model.handle.last_grad_norm is not None:
+                    gnorm = model.handle.last_grad_norm
+                    break
+        flags, trip_step, z = self.check(loss, gnorm=gnorm, step=step, state=accelerator.state)
+        if not flags:
+            if self.enabled and self.lkg.due(step):
+                # No verdict drain here: the snapshot ring keeps one spare, and
+                # rollback picks the newest snapshot OLDER than the trip — so a
+                # capture that later turns out poisoned is skipped over rather
+                # than guarded against with a blocking fetch.
+                snapshot_accelerator(accelerator, self.lkg, step, extra_device=self._spike_state)
+            return HealthVerdict(step=step)
+        return self._handle_trip(accelerator, flags, trip_step if trip_step is not None else step, z)
+
+    def _maybe_inject_fault(self, loss, step: int):
+        if loss is None:
+            # A loss-less guard_step (heartbeat/drain only) must not consume
+            # the scheduled fault — it would mark the drill fired with nothing
+            # injected; the fault waits for a step that reports its loss.
+            return loss
+        from ..resilience.faults import active_plan
+
+        plan = active_plan()
+        fault = plan.take_data_fault(step) if plan is not None else None
+        if fault is None:
+            return loss
+        if fault.action == "nan":
+            logger.warning(f"Fault injection: poisoning the step-{step} loss with NaN")
+            return jnp.float32(jnp.nan)
+        mult = float(str(fault.arg).rstrip("xX")) if fault.arg else 50.0
+        logger.warning(f"Fault injection: spiking the step-{step} loss {mult:g}x")
+        return jnp.asarray(loss, jnp.float32) * jnp.float32(mult)
+
+    def _handle_trip(self, accelerator, flags: int, trip_step: int, z) -> HealthVerdict:
+        self.trips += 1
+        logger.error(
+            f"Health guard tripped at step {trip_step}: {describe_flags(flags)}"
+            + (f" (robust z={z:.2f})" if z else "")
+        )
+        if flags & (NONFINITE_LOSS | NONFINITE_GRAD) and self.sentinel is not None:
+            for model in accelerator._models:
+                self.sentinel.attribute(model.handle.params, label="params")
+        action = self.on_trip
+        if action == "rollback" and self.lkg.snapshot_step(trip_step) is None:
+            logger.error(
+                "No last-known-good snapshot predates the trip; degrading to "
+                "skip+quarantine."
+            )
+            action = "skip"
+        self.quarantine(trip_step)
+        self._pending.clear()  # the poisoned timeline's verdicts are moot
+        rolled_back = False
+        if action == "rollback":
+            from ..resilience.goodput import get_ledger
+
+            with get_ledger().track("rollback"):
+                resume_step, spike_state = restore_accelerator(
+                    accelerator, self.lkg, before_step=trip_step
+                )
+            # Anything captured at/after the trip sits on the discarded
+            # timeline — a later trip must never restore it.
+            self.lkg.discard_from(trip_step)
+            if spike_state is not None:
+                self._spike_state = spike_state
+            rolled_back = True
+        else:
+            resume_step = trip_step
+        return HealthVerdict(
+            step=trip_step,
+            flags=flags,
+            tripped=True,
+            action=action,
+            resume_step=resume_step,
+            quarantined_step=trip_step,
+            rolled_back=rolled_back,
+            zscore=z,
+        )

@@ -1,0 +1,226 @@
+"""Hang/straggler watchdog — a silent multi-host deadlock costs the whole pod.
+
+One wedged host — a stuck collective, a hung storage mount, a deadlocked data
+worker — freezes every other host in its next collective, and an SPMD job
+burns its full reservation producing nothing, with no process ever *failing*.
+The watchdog converts that silence into action: a daemon thread arms after the
+first heartbeat (so multi-minute first-step compiles don't false-positive),
+and when no step boundary beats it within ``timeout_s`` it
+
+1. dumps every Python thread's stack plus live-device-array stats to stderr
+   (the post-mortem a hung job never leaves behind),
+2. books the stalled window as ``hang`` badput in the goodput ledger,
+3. fires its action: ``"exit"`` (default) hard-exits with the distinct
+   :data:`HANG_EXIT_CODE` so a supervising launcher (``accelerate-tpu launch
+   --max_restarts``) restarts the gang, or ``"raise"`` async-raises
+   :class:`HangDetected` in the training thread so an in-process
+   ``run_resilient(..., hang_timeout_s=...)`` loop can restart-and-resume —
+   the ``"raise`` mode can only preempt Python-level stalls; a hang inside a
+   C++ collective needs ``"exit"`` and a process-level supervisor.
+
+Heartbeats ride the hooks training loops already call per step
+(``Accelerator.guard_step`` / ``checkpoint_on_preemption``), so enabling the
+watchdog (``ACCELERATE_HANG_TIMEOUT`` / ``--hang_timeout``) needs no loop
+changes.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import faulthandler
+import os
+import sys
+import threading
+import time
+
+from ..logging import get_logger
+
+logger = get_logger(__name__)
+
+# Distinct exit code (outside the shell/signal ranges) so supervisors can tell
+# "watchdog killed a hung gang" from ordinary failures.
+HANG_EXIT_CODE = 113
+
+
+class HangDetected(RuntimeError):
+    """Raised (asynchronously, in the training thread) by a watchdog in
+    ``on_hang="raise"`` mode; ``run_resilient`` treats it like any failure."""
+
+    def __init__(self, idle_s: float = 0.0, step=None):
+        # Args must be optional: PyThreadState_SetAsyncExc delivers the CLASS
+        # and the interpreter instantiates it with no arguments.
+        at = f" after step {step}" if step is not None else ""
+        super().__init__(f"hang watchdog: no step completed{f' in {idle_s:.1f}s' if idle_s else ''}{at}")
+        self.idle_s = idle_s
+        self.step = step
+
+
+def _dump_diagnostics(idle_s: float, step):
+    try:
+        sys.stderr.write(
+            f"\n=== hang watchdog: no heartbeat for {idle_s:.1f}s "
+            f"(last step: {step}) — thread stacks follow ===\n"
+        )
+        faulthandler.dump_traceback(file=sys.stderr)
+        try:
+            import jax
+
+            arrays = jax.live_arrays()
+            nbytes = sum(getattr(a, "nbytes", 0) for a in arrays)
+            sys.stderr.write(
+                f"=== live device arrays: {len(arrays)} "
+                f"({nbytes / 2**20:.1f} MiB) ===\n"
+            )
+        except Exception:
+            pass
+        sys.stderr.flush()
+    except Exception:
+        pass  # diagnostics must never mask the hang handling itself
+
+
+def _async_raise(thread_ident: int, exc_type) -> bool:
+    res = ctypes.pythonapi.PyThreadState_SetAsyncExc(
+        ctypes.c_ulong(thread_ident), ctypes.py_object(exc_type)
+    )
+    return res == 1
+
+
+class HangWatchdog:
+    """Heartbeat deadline on a daemon thread; see module docstring.
+
+    ``on_hang``: ``"exit"`` | ``"raise"`` | a zero-arg callable. The countdown
+    arms on the first :meth:`beat` (compiles and data warmup run un-timed) and
+    fires at most once per :meth:`start`.
+    """
+
+    def __init__(self, timeout_s: float = 300.0, on_hang="exit", poll_interval_s: float | None = None):
+        if timeout_s <= 0:
+            raise ValueError(f"timeout_s must be > 0, got {timeout_s}")
+        if on_hang not in ("exit", "raise") and not callable(on_hang):
+            raise ValueError(f"on_hang must be 'exit', 'raise' or a callable, got {on_hang!r}")
+        self.timeout_s = float(timeout_s)
+        self.on_hang = on_hang
+        self.poll_interval_s = poll_interval_s or max(min(timeout_s / 4.0, 5.0), 0.05)
+        self._last_beat: float | None = None
+        self._last_step = None
+        self._fired = False
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self._target_ident: int | None = None
+
+    # ------------------------------------------------------------- lifecycle
+    def start(self, target_thread: threading.Thread | None = None) -> "HangWatchdog":
+        """Idempotent; ``target_thread`` (default: the caller's thread) is
+        where ``on_hang='raise'`` delivers :class:`HangDetected`."""
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._target_ident = (target_thread or threading.current_thread()).ident
+        self._stop.clear()
+        self._fired = False
+        self._last_beat = None
+        self._thread = threading.Thread(target=self._run, name="hang-watchdog", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.poll_interval_s * 4)
+            self._thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # ------------------------------------------------------------ heartbeats
+    def beat(self, step=None):
+        """A step boundary completed — reset the countdown (arms on first call)."""
+        self._last_beat = time.monotonic()
+        if step is not None:
+            self._last_step = step
+
+    def rearm(self):
+        """Reset after a handled trip: the countdown disarms until the next
+        beat and the watchdog may fire again (``run_resilient`` re-arms
+        between attempts)."""
+        self._fired = False
+        self._last_beat = None
+
+    @property
+    def fired(self) -> bool:
+        return self._fired
+
+    # ---------------------------------------------------------------- thread
+    def _run(self):
+        while not self._stop.wait(self.poll_interval_s):
+            if self._last_beat is None or self._fired:
+                continue  # not armed yet / already handled
+            idle = time.monotonic() - self._last_beat
+            if idle <= self.timeout_s:
+                continue
+            self._fired = True
+            logger.error(
+                f"Hang watchdog tripped: no step boundary in {idle:.1f}s "
+                f"(timeout {self.timeout_s:.1f}s)."
+            )
+            _dump_diagnostics(idle, self._last_step)
+            try:
+                from ..resilience.goodput import get_ledger
+
+                get_ledger().add("hang", idle)
+            except Exception:
+                pass
+            if self.on_hang == "exit":
+                os._exit(HANG_EXIT_CODE)
+            elif self.on_hang == "raise":
+                if not _async_raise(self._target_ident, HangDetected):
+                    logger.error("Hang watchdog could not interrupt the training thread.")
+            else:
+                try:
+                    self.on_hang()
+                except Exception as exc:
+                    logger.error(f"Hang watchdog on_hang callback failed: {exc!r}")
+
+
+# ------------------------------------------------------ process-wide default
+_default_watchdog: HangWatchdog | None = None
+
+
+def get_default_watchdog() -> HangWatchdog | None:
+    return _default_watchdog
+
+
+def set_default_watchdog(watchdog: HangWatchdog | None):
+    global _default_watchdog
+    _default_watchdog = watchdog
+
+
+def install_default_watchdog(timeout_s: float, on_hang="exit") -> HangWatchdog:
+    """Start (or retune) the process-wide watchdog ``Accelerator`` hooks beat.
+    Called by ``PartialState`` when ``ACCELERATE_HANG_TIMEOUT`` is set."""
+    global _default_watchdog
+    if _default_watchdog is None:
+        _default_watchdog = HangWatchdog(timeout_s=timeout_s, on_hang=on_hang)
+        _default_watchdog.start(threading.main_thread())
+    else:
+        _default_watchdog.timeout_s = float(timeout_s)
+        _default_watchdog.on_hang = on_hang
+        _default_watchdog.start(threading.main_thread())
+    return _default_watchdog
+
+
+def beat_default(step=None):
+    """Cheap per-step hook: heartbeat the default watchdog if one is running."""
+    if _default_watchdog is not None:
+        _default_watchdog.beat(step)
+
+
+def reset_default_watchdog():
+    """Stop and forget the default watchdog (tests)."""
+    global _default_watchdog
+    if _default_watchdog is not None:
+        _default_watchdog.stop()
+    _default_watchdog = None

@@ -1,0 +1,200 @@
+"""Last-known-good rollback — recovery without touching disk.
+
+Checkpoint-restart recovers from a poisoned run, but at the cost of a full
+restore plus everything since the last (typically infrequent) save. For the
+internal faults the health guard catches — one bad batch, one NaN update —
+the cheapest recovery is an **in-memory snapshot** taken every K steps:
+device-resident copies of params and optimizer state plus the host-side
+bookkeeping (step, RNG streams, checkpoint-naming index) needed to make the
+replay *bit-exact*. Restore is a buffer copy, not an I/O storm, so K can be
+small (tens of steps) where checkpoint cadence is thousands.
+
+Donation safety: the fused train step donates its input buffers, so holding a
+reference to the live params is not a snapshot — the next step would
+invalidate it. :func:`device_clone` forces a real device-side copy (a jitted
+flatten/unflatten that cannot be input-forwarded or aliased), bit-preserving
+for every dtype including ``-0.0`` and NaN payloads.
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import random
+import shutil
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..logging import get_logger
+
+logger = get_logger(__name__)
+
+_clone_fns: dict = {}
+
+
+def _reshape_copy(x):
+    key = (x.shape, str(x.dtype))
+    fn = _clone_fns.get(key)
+    if fn is None:
+        # flatten+restore defeats jit's input-output buffer forwarding and,
+        # absent donation, XLA must materialize a fresh output buffer — a
+        # true copy, bit-exact for every value including -0.0 and NaNs.
+        fn = jax.jit(lambda a: jnp.reshape(jnp.reshape(a, (-1,)), a.shape))
+        _clone_fns[key] = fn
+    return fn(x)
+
+
+def device_clone(tree):
+    """Deep-copy a pytree: jax arrays get fresh device buffers (donation-proof),
+    everything else is ``copy.deepcopy``-ed."""
+    return jax.tree_util.tree_map(
+        lambda x: _reshape_copy(x) if isinstance(x, jax.Array) else copy.deepcopy(x), tree
+    )
+
+
+class LastKnownGood:
+    """A short ring of snapshots; ``capture`` clones in, ``restore`` clones
+    out (so a snapshot survives being restored more than once).
+
+    Why a ring and not one slot: on async backends a verdict can lag its step
+    by a few dispatches, so the newest snapshot may postdate — and contain —
+    the fault. ``restore(before_step=trip_step)`` picks the newest snapshot
+    *strictly older* than the trip, which lets the guard capture without ever
+    force-draining the verdict queue: the healthy path stays wait-free and a
+    poisoned snapshot is simply skipped over. ``keep=2`` covers any lag up to
+    a full snapshot interval (the guard's pending window is far shorter)."""
+
+    def __init__(self, every_steps: int = 25, keep: int = 2):
+        if every_steps < 1:
+            raise ValueError(f"every_steps must be >= 1, got {every_steps}")
+        if keep < 1:
+            raise ValueError(f"keep must be >= 1, got {keep}")
+        self.every_steps = int(every_steps)
+        self.keep = int(keep)
+        self._snapshots: list = []  # [(step, device_state, host_state)] oldest→newest
+
+    @property
+    def step(self) -> int | None:
+        """Step of the newest snapshot (None before the first capture)."""
+        return self._snapshots[-1][0] if self._snapshots else None
+
+    def snapshot_step(self, before_step: int | None = None) -> int | None:
+        """Step of the snapshot ``restore`` would pick."""
+        for step, _, _ in reversed(self._snapshots):
+            if before_step is None or step < before_step:
+                return step
+        return None
+
+    def due(self, step: int) -> bool:
+        return step % self.every_steps == 0 or not self._snapshots
+
+    def capture(self, step: int, device_state=None, host_state=None):
+        device = device_clone(device_state) if device_state is not None else None
+        self._snapshots.append((int(step), device, copy.deepcopy(host_state)))
+        del self._snapshots[: -self.keep]
+
+    def discard_from(self, step: int):
+        """Drop snapshots at/after ``step`` — they were captured on a timeline
+        a rollback is about to discard."""
+        self._snapshots = [s for s in self._snapshots if s[0] < step]
+
+    def restore(self, before_step: int | None = None):
+        """→ ``(step, device_state, host_state)`` of the newest snapshot older
+        than ``before_step`` (newest overall when None) — fresh copies each
+        call. Raises when no qualifying snapshot exists."""
+        for step, device, host in reversed(self._snapshots):
+            if before_step is None or step < before_step:
+                return (
+                    step,
+                    device_clone(device) if device is not None else None,
+                    copy.deepcopy(host),
+                )
+        raise RuntimeError(
+            f"no last-known-good snapshot older than step {before_step} is held"
+        )
+
+
+# ---------------------------------------------------- accelerator integration
+def snapshot_accelerator(accelerator, lkg: LastKnownGood, step: int, extra_device=None):
+    """Capture everything a bit-exact replay needs, into ``lkg``."""
+    for opt in accelerator._optimizers:
+        resolve = getattr(opt, "_resolve_pending_finite", None)
+        if resolve is not None:
+            resolve()  # scaler scale / step_count must be final before copying
+    device = {
+        "params": [m.handle.params for m in accelerator._models],
+        "opt_states": [opt.opt_state for opt in accelerator._optimizers],
+        # The accumulation buffer rides along: None on the imperative path at
+        # a step boundary, a zeros (or partially accumulated) tree on the
+        # fused build_train_step path — which reads it on every call and must
+        # never see it nulled by a rollback.
+        "accum_grads": [opt._accum_grads for opt in accelerator._optimizers],
+        "extra": extra_device,
+    }
+    host = {
+        "step": accelerator.step,
+        "step_counters": [m.handle.step_counter for m in accelerator._models],
+        "opt_meta": [
+            {
+                "step_count": opt._step_count,
+                "scale": opt.scaler.scale if opt.scaler is not None else None,
+            }
+            for opt in accelerator._optimizers
+        ],
+        "scheduler_states": [s.state_dict() for s in accelerator._schedulers],
+        "python_rng": random.getstate(),
+        "numpy_rng": np.random.get_state(),
+        "iteration": accelerator.project_configuration.iteration,
+    }
+    lkg.capture(step, device_state=device, host_state=host)
+
+
+def restore_accelerator(accelerator, lkg: LastKnownGood, before_step: int | None = None):
+    """Roll the accelerator back to the newest snapshot older than
+    ``before_step``; returns its step (and the snapshot's extra device
+    payload). Auto-named checkpoints saved *after* the snapshot belong to the
+    discarded timeline and are deleted so the replay's own saves cannot
+    collide."""
+    step, device, host = lkg.restore(before_step)
+    for model, params in zip(accelerator._models, device["params"]):
+        model.handle.params = params
+    for opt, opt_state, accum, meta in zip(
+        accelerator._optimizers, device["opt_states"], device["accum_grads"], host["opt_meta"]
+    ):
+        opt.opt_state = opt_state
+        opt._accum_grads = accum
+        opt._pending_clip_norm = None
+        opt._pending_finite = None
+        opt._step_was_skipped = False
+        opt._step_count = meta["step_count"]
+        if opt.scaler is not None and meta["scale"] is not None:
+            opt.scaler.scale = meta["scale"]
+    for model, counter in zip(accelerator._models, host["step_counters"]):
+        model.handle.step_counter = counter
+    for sched, state in zip(accelerator._schedulers, host["scheduler_states"]):
+        sched.load_state_dict(state)
+    random.setstate(host["python_rng"])
+    np.random.set_state(host["numpy_rng"])
+    accelerator.step = host["step"]
+    project = accelerator.project_configuration
+    project.iteration = host["iteration"]
+    if project.automatic_checkpoint_naming and project.project_dir and accelerator.is_main_process:
+        from ..utils.constants import CHECKPOINT_DIR_PREFIX
+
+        base = os.path.join(project.project_dir, "checkpoints")
+        if os.path.isdir(base):
+            for folder in os.listdir(base):
+                if not folder.startswith(f"{CHECKPOINT_DIR_PREFIX}_"):
+                    continue
+                try:
+                    index = int(folder.rsplit("_", 1)[-1])
+                except ValueError:
+                    continue
+                if index >= host["iteration"]:
+                    logger.warning(f"Rollback: deleting post-snapshot checkpoint {folder}")
+                    shutil.rmtree(os.path.join(base, folder), ignore_errors=True)
+    logger.warning(f"Rolled back to last-known-good snapshot at step {step}.")
+    return step, device.get("extra")

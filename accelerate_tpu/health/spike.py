@@ -1,0 +1,82 @@
+"""Loss-spike detector — rolling robust statistics as device-side state.
+
+A bad batch (corrupted shard, tokenizer glitch, poisoned document) shows up as
+a loss far outside the recent distribution *before* it wrecks the optimizer
+state. Plain mean/std statistics are the wrong tool — the spike itself drags
+the std up, masking follow-on spikes — so the detector keeps an EMA of the
+loss and an EMA of the absolute deviation (a streaming proxy for the MAD,
+scaled by the usual 1.4826 normal-consistency constant) and trips on the
+robust z-score.
+
+Two properties matter for correctness:
+
+- the statistics live as device arrays and are updated by a pure function the
+  guard folds into its single per-step dispatch — no host sync to keep them;
+- a tripped (or non-finite) observation does NOT update the statistics: the
+  poisoned loss must not drag the baseline toward itself, and a rolled-back
+  replay re-observing the same healthy window reproduces the state bit-exactly
+  (the property the bit-exact rollback drills pin).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# Verdict bit (numerics.py owns 1 and 2).
+LOSS_SPIKE = 4
+
+_MAD_TO_SIGMA = 1.4826  # E|X-mu| consistency constant for a normal
+
+
+class SpikeDetector:
+    """EMA + MAD-proxy z-score over the scalar loss.
+
+    ``zscore``: robust z threshold that trips the detector. ``warmup_steps``:
+    healthy observations required before trips are allowed (the first steps of
+    a run legitimately fall fast). ``ema_decay``: smoothing for both the level
+    and deviation EMAs.
+    """
+
+    def __init__(self, zscore: float = 6.0, warmup_steps: int = 20, ema_decay: float = 0.98):
+        if zscore <= 0:
+            raise ValueError(f"zscore must be > 0, got {zscore}")
+        if not 0.0 < ema_decay < 1.0:
+            raise ValueError(f"ema_decay must be in (0, 1), got {ema_decay}")
+        self.zscore = float(zscore)
+        self.warmup_steps = int(warmup_steps)
+        self.ema_decay = float(ema_decay)
+
+    # ---------------------------------------------------------------- state
+    def init_state(self):
+        """(ema, mad_proxy, healthy_count) — all device-friendly scalars."""
+        return (jnp.float32(0.0), jnp.float32(0.0), jnp.int32(0))
+
+    def update(self, state, loss):
+        """Traceable: ``(new_state, flags, z)`` for one observation.
+
+        ``flags`` is LOSS_SPIKE or 0; ``z`` the robust z-score (0 while the
+        statistics are still warming up). Composed into the guard's jitted
+        verdict — callers never dispatch this alone.
+        """
+        ema, mad, count = state
+        loss32 = jnp.asarray(loss, jnp.float32)
+        finite = jnp.isfinite(loss32)
+        warm = count >= self.warmup_steps
+        dev = jnp.abs(loss32 - ema)
+        sigma = _MAD_TO_SIGMA * mad
+        z = jnp.where(warm & finite, dev / (sigma + 1e-12), 0.0)
+        spike = warm & finite & (z > self.zscore)
+        # Healthy observations advance the EMAs; spikes and non-finite losses
+        # are excluded so the baseline cannot be dragged toward the fault.
+        healthy = finite & ~spike
+        # Effective decay min(d, n/(n+1)): the first observations form a plain
+        # running mean (a 0.98 EMA seeded at the first loss would take ~50
+        # steps to forget it, making the whole warmup window a false baseline)
+        # and the statistics glide into the EMA once n/(n+1) crosses d.
+        cnt = count.astype(jnp.float32)
+        d = jnp.minimum(jnp.float32(self.ema_decay), cnt / (cnt + 1.0))
+        new_ema = jnp.where(healthy, d * ema + (1 - d) * loss32, ema)
+        new_mad = jnp.where(healthy, jnp.where(count == 0, 0.0, d * mad + (1 - d) * dev), mad)
+        new_count = jnp.where(healthy, count + 1, count)
+        flags = jnp.where(spike, LOSS_SPIKE, 0).astype(jnp.int32)
+        return (new_ema, new_mad, new_count), flags, z

@@ -111,6 +111,10 @@ class AcceleratedOptimizer:
         self._accum_grads = None
         self._pending_clip_norm = None
         self._step_was_skipped = False
+        # Device-side finite flag of the last update, resolved to a host bool
+        # LAZILY (property access / next step / checkpoint) so step() never
+        # stalls the dispatch thread on a device→host sync.
+        self._pending_finite = None
         self._update_fn = None
         self._step_count = 0  # optimizer steps actually applied
 
@@ -207,6 +211,10 @@ class AcceleratedOptimizer:
         self._ensure_initialized()
         if self._update_fn is None:
             self._update_fn = self._build_update_fn()
+        # The previous step's outcome must be final before its scale is read
+        # (backoff/growth ordering is unchanged — only the sync moved off the
+        # dispatch path to where the value is already materialized).
+        self._resolve_pending_finite()
         inv_scale = 1.0 / self.scaler.scale if self.scaler is not None else 1.0
         clip = self._pending_clip_norm if self._pending_clip_norm is not None else -1.0
         if self.host_offload:
@@ -222,12 +230,13 @@ class AcceleratedOptimizer:
         self._pending_clip_norm = None
         self.handle.last_grad_norm = gnorm
         if self.scaler is not None:
-            found_inf = not bool(finite)  # one scalar host sync per real step
-            self._step_was_skipped = found_inf
-            self.scaler.update(found_inf)
+            # NO host sync here: the device flag resolves lazily through the
+            # step_was_skipped property, the next step(), or a checkpoint —
+            # the hot loop stays async (the health guard reads the same flag
+            # via gnorm without ever forcing it).
+            self._pending_finite = finite
         else:
             self._step_was_skipped = False
-        if not self._step_was_skipped:
             self._step_count += 1
 
     def _to_host(self, tree):
@@ -266,9 +275,30 @@ class AcceleratedOptimizer:
             lambda x: jax.device_put(x, cpu) if isinstance(x, jax.Array) else x, tree
         )
 
+    def _resolve_pending_finite(self):
+        """Apply the deferred overflow outcome of the last fp16 step: one
+        counted device→host fetch (utils/transfer.py) — by the time anything
+        calls this, the update has long since executed, so the fetch is a copy
+        rather than a stall."""
+        if self._pending_finite is None:
+            return
+        from .utils.transfer import host_fetch
+
+        found_inf = not bool(host_fetch(self._pending_finite))
+        self._pending_finite = None
+        self._step_was_skipped = found_inf
+        self.scaler.update(found_inf)
+        if not found_inf:
+            self._step_count += 1
+
     @property
     def step_was_skipped(self) -> bool:
-        """Whether the last ``step()`` was skipped on overflow (reference :186-189)."""
+        """Whether the last ``step()`` was skipped on overflow (reference :186-189).
+        Accessing it resolves the deferred device-side flag — consumers that
+        need THIS step's verdict (``AcceleratedScheduler.step`` must not count
+        an LR step for a skipped update) inherently pay the fetch here; loops
+        without such a consumer never pay it at all."""
+        self._resolve_pending_finite()
         return self._step_was_skipped
 
     def zero_grad(self, set_to_none: bool = True):
@@ -316,6 +346,7 @@ class AcceleratedOptimizer:
         return False
 
     def state_dict(self):
+        self._resolve_pending_finite()  # scale/step_count must be final
         return {"opt_state": self.opt_state, "step_count": self._step_count,
                 "scale": self.scaler.scale if self.scaler else None}
 

@@ -5,12 +5,15 @@ Four layers, smallest mechanism first:
 - :mod:`.preemption` — SIGTERM/SIGINT → sticky flag, all-host agreement via a
   scalar collective, pluggable maintenance-event poller;
 - :mod:`.faults` — deterministic, env-driven fault injection
-  (``ACCELERATE_FAULT_PLAN``) so every recovery path below runs in CI;
+  (``ACCELERATE_FAULT_PLAN``) so every recovery path below — and the health
+  subsystem's (``nan``/``loss_spike``/``hang`` kinds, :mod:`..health`) — runs
+  in CI;
 - :mod:`.runner` — :func:`run_resilient`: resume from the newest complete
-  checkpoint, exponential backoff + jitter, crash-loop budget;
+  checkpoint, exponential backoff + jitter, crash-loop budget, and optional
+  hang conversion (``hang_timeout_s``, via the health watchdog);
 - :mod:`.goodput` — the wall-clock ledger (productive step time vs compile /
-  checkpoint / restart badput) surfaced by ``Accelerator.log_goodput()`` and
-  ``bench.py``.
+  checkpoint / restart / rollback / hang badput) surfaced by
+  ``Accelerator.log_goodput()`` and ``bench.py``.
 
 Driven from training code via ``Accelerator.checkpoint_on_preemption()`` (one
 call per step) and ``run_resilient(train_fn, accelerator)``; driven from the

@@ -19,10 +19,22 @@ i.e. ``;``-separated entries of ``step:<N>=<action>[:<arg>]`` with actions
                     (missing item dir + orbax tmp litter), the on-disk
                     signature of a save interrupted mid-write — exercises the
                     newest-complete-checkpoint fallback on resume;
-- ``stall:<secs>``  sleep, simulating a straggling host / hung I/O.
+- ``stall:<secs>``  sleep, simulating a straggling host / hung I/O;
+- ``hang:<secs>``   sleep *interruptibly* for a long time (default 3600s),
+                    simulating a deadlocked host — exercises the health
+                    subsystem's hang watchdog (``docs/health.md``), whose
+                    ``raise`` mode can preempt this Python-level stall;
+- ``nan``           poison the step's observed loss with NaN — consumed by
+                    ``Accelerator.guard_step()`` (NOT fired here), exercising
+                    the numerics sentinel → rollback path;
+- ``loss_spike:<mult>x``  multiply the step's observed loss (default 50x) —
+                    consumed by ``guard_step()``, exercising the spike
+                    detector → rollback path.
 
 Each fault fires at most once per plan instance, so an auto-resumed run that
-replays the faulting step does not crash-loop on its own injection.
+replays the faulting step does not crash-loop on its own injection. The data
+faults (``nan``/``loss_spike``) fire only when the training loop calls
+``guard_step`` — on a loop without the health guard they stay inert.
 """
 
 from __future__ import annotations
@@ -38,7 +50,10 @@ from ..utils.constants import ENV_FAULT_PLAN
 
 logger = get_logger(__name__)
 
-_ACTIONS = ("kill", "sigterm", "partial_ckpt", "stall")
+_ACTIONS = ("kill", "sigterm", "partial_ckpt", "stall", "hang", "nan", "loss_spike")
+# Data faults poison the step's observed loss; they are consumed by the health
+# guard (Accelerator.guard_step) rather than fired by maybe_fire.
+_DATA_ACTIONS = ("nan", "loss_spike")
 
 
 class SimulatedFault(RuntimeError):
@@ -80,8 +95,14 @@ class FaultPlan:
                 action, _, arg = action.strip().partition(":")
                 if action not in _ACTIONS:
                     raise ValueError
-                if action == "stall" and arg:
+                if action in ("stall", "hang") and arg:
                     float(arg)  # a bad duration must fail at parse, not mid-run
+                if action == "loss_spike" and arg:
+                    # '50x' or '50' — the multiplier must be a positive number.
+                    if float(arg.rstrip("xX")) <= 0:
+                        raise ValueError
+                if action == "nan" and arg:
+                    raise ValueError  # nan takes no argument
             except ValueError:
                 raise ValueError(
                     f"Bad fault-plan entry {entry!r}: expected "
@@ -98,9 +119,9 @@ class FaultPlan:
 
     # ------------------------------------------------------------------ fire
     def maybe_fire(self, step: int):
-        """Fire every not-yet-fired fault scheduled for ``step``."""
+        """Fire every not-yet-fired (non-data) fault scheduled for ``step``."""
         for f in self.faults:
-            if f.fired or f.step != step:
+            if f.fired or f.step != step or f.action in _DATA_ACTIONS:
                 continue
             f.fired = True
             logger.warning(f"Fault injection: firing {f.action} at step {step}")
@@ -112,6 +133,22 @@ class FaultPlan:
                 self._pending_partial_ckpt = True
             elif f.action == "stall":
                 time.sleep(float(f.arg) if f.arg else 1.0)
+            elif f.action == "hang":
+                # Interruptible stall: sleep in slices so the hang watchdog's
+                # 'raise' mode can preempt it with an async HangDetected (a
+                # single long sleep would absorb the exception until it ends).
+                deadline = time.monotonic() + (float(f.arg) if f.arg else 3600.0)
+                while time.monotonic() < deadline:
+                    time.sleep(0.05)
+
+    def take_data_fault(self, step: int):
+        """Consume (at most) one data fault scheduled for ``step`` — called by
+        the health guard, which applies it to the observed loss."""
+        for f in self.faults:
+            if not f.fired and f.step == step and f.action in _DATA_ACTIONS:
+                f.fired = True
+                return f
+        return None
 
     def maybe_corrupt_checkpoint(self, output_dir: str) -> bool:
         """Consume a pending ``partial_ckpt`` fault: leave ``output_dir`` in

@@ -12,9 +12,12 @@ tracker path, and ``bench.py`` embeds it in its JSON lines.
 
 The categories follow the goodput decomposition used by large TPU trainers
 (productive step time vs program-acquisition and checkpoint overheads): one
-goodput bucket (``step``) and four badput buckets (``compile``, ``ckpt_save``,
-``ckpt_restore``, ``restart``); wall-clock not attributed to any bucket is
-reported as ``other_s`` (data feeding, host-side logging, eval, idle).
+goodput bucket (``step``) and six badput buckets — ``compile``, ``ckpt_save``,
+``ckpt_restore``, ``restart``, plus the health subsystem's ``rollback``
+(last-known-good restores after a NaN/loss-spike trip, health/rollback.py) and
+``hang`` (time a wedged run sat before the watchdog fired, health/hang.py).
+Wall-clock not attributed to any bucket is reported as ``other_s`` (data
+feeding, host-side logging, eval, idle).
 """
 
 from __future__ import annotations
@@ -24,7 +27,7 @@ import time
 from contextlib import contextmanager
 
 GOODPUT_CATEGORY = "step"
-BADPUT_CATEGORIES = ("compile", "ckpt_save", "ckpt_restore", "restart")
+BADPUT_CATEGORIES = ("compile", "ckpt_save", "ckpt_restore", "restart", "rollback", "hang")
 CATEGORIES = (GOODPUT_CATEGORY,) + BADPUT_CATEGORIES
 
 

@@ -34,6 +34,8 @@ from ..logging import get_logger
 
 logger = get_logger(__name__)
 
+_WATCHER_SEQ = 0
+
 
 class PreemptionWatcher:
     """Sticky preemption flag fed by signals and an optional poller.
@@ -58,6 +60,13 @@ class PreemptionWatcher:
         self._prev_handlers = None
         self._last_poll = 0.0
         self._lock = threading.Lock()
+        self._kv_sync = False
+        self._sync_epoch = 0
+        # KV namespaces must be unique per (watcher, sync) and identical
+        # across ranks — same construction order, the SPMD contract.
+        global _WATCHER_SEQ
+        _WATCHER_SEQ += 1
+        self._watcher_id = _WATCHER_SEQ
 
     # ------------------------------------------------------------- lifecycle
     def install(self) -> "PreemptionWatcher":
@@ -128,6 +137,9 @@ class PreemptionWatcher:
         round-trip per step); multi-host runs pay one scalar sum collective —
         every process must therefore call ``sync`` at the same step boundary,
         which ``checkpoint_on_preemption``'s once-per-step contract provides.
+        Backends that cannot run multiprocess computations (the 2-process CPU
+        harness) fall back to the coordination-service KV exchange, same as
+        the health guard's agreement.
         """
         local = self.poll()
         if state is None:
@@ -136,10 +148,32 @@ class PreemptionWatcher:
             state = PartialState()
         if state.num_processes <= 1:
             return local
-        from ..utils import operations as ops
+        agreed = None
+        if not self._kv_sync:
+            try:
+                from ..utils import operations as ops
 
-        total = ops.reduce(np.asarray(int(local), dtype=np.int32), reduction="sum")
-        agreed = float(np.asarray(total)) >= 1
+                total = ops.reduce(np.asarray(int(local), dtype=np.int32), reduction="sum")
+                agreed = float(np.asarray(total)) >= 1
+            except Exception as exc:
+                logger.warning(
+                    f"Device-collective preemption sync unavailable "
+                    f"({type(exc).__name__}: {exc}); using the coordination-"
+                    "service KV exchange instead."
+                )
+                self._kv_sync = True
+        if agreed is None:
+            from ..utils.agreement import kv_or_exchange
+
+            self._sync_epoch += 1
+            agreed = bool(
+                kv_or_exchange(
+                    int(local),
+                    state.num_processes,
+                    state.process_index,
+                    namespace=f"at_preempt/{self._watcher_id}/{self._sync_epoch}",
+                )
+            )
         if agreed:
             self._flag = True  # agreement is sticky on every host
         return agreed

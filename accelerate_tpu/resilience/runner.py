@@ -18,7 +18,15 @@ process form — the in-process twin of ``accelerate-tpu launch --max_restarts``
   restart budget on it hides the real failure;
 - **goodput accounting**: restore time and backoff downtime land in the
   :mod:`.goodput` ledger, and the final breakdown is pushed through
-  ``accelerator.log_goodput()``.
+  ``accelerator.log_goodput()``;
+- **hang conversion** (``hang_timeout_s``): a :class:`~..health.hang.
+  HangWatchdog` in ``raise`` mode runs for the duration — when no step
+  boundary beats it within the deadline it async-raises
+  :class:`~..health.hang.HangDetected` in the training thread, turning a
+  silent Python-level stall into an ordinary restartable failure. (A hang
+  inside a C++ collective can't be preempted in-process: the default
+  env-installed watchdog handles that by exiting with the distinct
+  ``HANG_EXIT_CODE`` for a process-level supervisor to restart.)
 """
 
 from __future__ import annotations
@@ -47,6 +55,7 @@ def run_resilient(
     restart_window_s: float = 600.0,
     resume: bool = True,
     checkpoint_dir: str | None = None,
+    hang_timeout_s: float | None = None,
 ) -> Any:
     """Run ``train_fn(accelerator, attempt)`` to completion through failures.
 
@@ -69,6 +78,48 @@ def run_resilient(
     ledger = get_ledger()
     restart_times: collections.deque = collections.deque()
     attempt = 0
+    watchdog = None
+    prev_watchdog = None
+    if hang_timeout_s is not None:
+        from ..health import hang as hang_mod
+
+        watchdog = hang_mod.HangWatchdog(timeout_s=hang_timeout_s, on_hang="raise")
+        # Install as the process default so the per-step Accelerator hooks
+        # (guard_step / checkpoint_on_preemption) heartbeat it with no loop
+        # changes; the previous default is restored on the way out. The
+        # previous watchdog must be SUSPENDED meanwhile — an armed exit-mode
+        # watchdog that stops receiving beats would os._exit(113) a perfectly
+        # healthy run.
+        prev_watchdog = hang_mod.get_default_watchdog()
+        if prev_watchdog is not None:
+            prev_watchdog.stop()
+        hang_mod.set_default_watchdog(watchdog)
+        watchdog.start()
+    try:
+        return _run_resilient_loop(
+            train_fn, accelerator, ledger, restart_times, attempt, max_restarts,
+            backoff_base_s, backoff_max_s, backoff_jitter, restart_budget,
+            restart_window_s, resume, checkpoint_dir, watchdog,
+        )
+    finally:
+        if watchdog is not None:
+            import threading
+
+            from ..health import hang as hang_mod
+
+            watchdog.stop()
+            hang_mod.set_default_watchdog(prev_watchdog)
+            if prev_watchdog is not None:
+                # start() resumes it disarmed (re-arms on the next beat): the
+                # env-installed deadline keeps guarding whatever follows.
+                prev_watchdog.start(threading.main_thread())
+
+
+def _run_resilient_loop(
+    train_fn, accelerator, ledger, restart_times, attempt, max_restarts,
+    backoff_base_s, backoff_max_s, backoff_jitter, restart_budget,
+    restart_window_s, resume, checkpoint_dir, watchdog,
+):
     while True:
         try:
             # Resume INSIDE the guarded region: a failing restore (torn array
@@ -82,6 +133,8 @@ def run_resilient(
         except (KeyboardInterrupt, SystemExit):
             raise
         except Exception as exc:
+            if watchdog is not None:
+                watchdog.rearm()  # the next attempt gets a fresh deadline
             attempt += 1
             if attempt > max_restarts:
                 logger.error(

@@ -47,6 +47,7 @@ from .utils.constants import (
     ENV_CPU,
     ENV_DEBUG_MODE,
     ENV_HANDLE_PREEMPTION,
+    ENV_HANG_TIMEOUT,
     ENV_MIXED_PRECISION,
     ENV_NUM_PROCESSES,
     ENV_PROCESS_ID,
@@ -161,6 +162,20 @@ class PartialState:
             from .resilience.preemption import get_default_watcher
 
             get_default_watcher(install=True)
+        # Hang watchdog (health/hang.py): started here so it guards the whole
+        # process life; it only arms on the first step heartbeat, so a long
+        # first compile cannot false-positive.
+        hang_timeout = os.environ.get(ENV_HANG_TIMEOUT, "").strip()
+        if hang_timeout:
+            from .health.hang import install_default_watchdog
+
+            try:
+                install_default_watchdog(float(hang_timeout))
+            except ValueError:
+                raise ValueError(
+                    f"{ENV_HANG_TIMEOUT}={hang_timeout!r} must be a positive "
+                    "number of seconds"
+                ) from None
 
         platform = jax.default_backend()
         if self._cpu and platform != "cpu":

@@ -1,0 +1,110 @@
+"""Multi-host health-agreement drill, run under the real 2-process launcher::
+
+    accelerate-tpu launch --cpu --num_processes 2 -m \
+        accelerate_tpu.test_utils.health_agreement_script
+
+Proves the property ``tests/test_health.py`` pins: when ONE host's guard trips
+(a loss spike injected on rank 0 only), EVERY host learns of it through the
+agreement exchange at the same step and rolls back identically — the resumed
+state is bit-exact against a clean run that pre-quarantined the same batch,
+on every rank, and the ranks agree with each other.
+
+The training here is deliberately host-side (a scalar updated with
+deterministic per-step increments): on CPU backends the XLA runtime refuses
+multiprocess computations, which is exactly the environment where the guard's
+coordination-service (KV-store) agreement fallback must carry the decision —
+the device-collective path stays covered by the single-process drills. The
+spike statistics are still real device state (single-device jit), snapshotted
+and restored through :class:`~accelerate_tpu.health.LastKnownGood` like the
+full integration does.
+"""
+
+from __future__ import annotations
+
+from accelerate_tpu import PartialState
+from accelerate_tpu.health import HealthGuard, LastKnownGood
+
+TOTAL, TRIP, SNAPSHOT_EVERY = 12, 8, 3
+
+
+def _loss(step: int) -> float:
+    return 10.0 / step  # deterministic, smoothly decreasing
+
+
+def _grad(step: int) -> float:
+    return 0.25 * step  # deterministic toy "update"
+
+
+def run(state, inject_rank: int | None):
+    guard = HealthGuard(spike_warmup=3, spike_zscore=6.0, snapshot_every=SNAPSHOT_EVERY)
+    lkg = LastKnownGood(every_steps=SNAPSHOT_EVERY)
+    if inject_rank is None:
+        guard.quarantine(TRIP)  # the clean comparator never sees the batch
+    w, step, trips = 0.0, 0, 0
+    while step < TOTAL:
+        nxt = step + 1
+        if guard.should_skip(nxt):
+            step = nxt
+            continue
+        w += _grad(nxt)
+        loss = _loss(nxt)
+        if inject_rank == state.process_index and nxt == TRIP:
+            loss *= 500.0  # one host's bad batch
+        step = nxt
+        flags, trip_step, _z = guard.check(loss, step=nxt, state=state)
+        if flags:
+            trips += 1
+            guard.quarantine(trip_step)
+            guard._pending.clear()
+            step, spike_state, host = lkg.restore()
+            guard._spike_state = spike_state
+            w = host["w"]
+        elif lkg.due(nxt):
+            lkg.capture(nxt, device_state=guard._spike_state, host_state={"w": w})
+    return w, trips, guard
+
+
+def main():
+    state = PartialState()
+    assert state.num_processes >= 2, "run under `launch --num_processes 2`"
+
+    clean_w, clean_trips, _ = run(state, inject_rank=None)
+    assert clean_trips == 0, f"clean run tripped {clean_trips}x on rank {state.process_index}"
+
+    faulted_w, faulted_trips, guard = run(state, inject_rank=0)
+    # Rank 0 tripped locally; every OTHER rank must have tripped via agreement.
+    assert faulted_trips == 1, f"rank {state.process_index} saw {faulted_trips} trips"
+    assert guard.should_skip(TRIP)
+    assert faulted_w == clean_w, (
+        f"rank {state.process_index}: rolled-back run diverged "
+        f"({faulted_w!r} != {clean_w!r})"
+    )
+
+    # The preemption watcher's sync rides the same fallback: a flag raised on
+    # rank 0 only must come back agreed-True on every rank.
+    from accelerate_tpu.resilience.preemption import PreemptionWatcher
+
+    watcher = PreemptionWatcher(signals=())
+    if state.process_index == 0:
+        watcher._flag = True
+    assert watcher.sync(state) is True, f"rank {state.process_index} missed the preemption"
+    assert watcher.preemption_requested  # agreement is sticky everywhere
+
+    # Cross-rank check: exchange finals through the coordination KV store.
+    from jax._src.distributed import global_state as dist_state
+
+    client = dist_state.client
+    if client is not None:
+        client.key_value_set(f"at_health_drill/final/{state.process_index}", repr(faulted_w))
+        client.wait_at_barrier("at_health_drill/final_barrier", 60_000)
+        finals = {
+            rank: client.blocking_key_value_get(f"at_health_drill/final/{rank}", 60_000)
+            for rank in range(state.num_processes)
+        }
+        assert len(set(finals.values())) == 1, f"ranks disagree: {finals}"
+
+    print(f"HEALTH_AGREE_OK rank={state.process_index} final={faulted_w}")
+
+
+if __name__ == "__main__":
+    main()

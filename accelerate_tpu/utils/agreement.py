@@ -1,0 +1,47 @@
+"""All-host agreement for host-side flag words, without device collectives.
+
+The preferred transport for "does ANY host want X?" is a tiny device-side sum
+collective (the ``check_trigger`` idiom) — it rides the same interconnect as
+training. But some backends cannot run multiprocess computations at all (the
+2-process CPU test harness is one), and the question still needs answering.
+This helper carries the bits over the JAX coordination service instead: each
+rank publishes its word in the KV store, everyone meets at a barrier, then
+ORs all ranks' words. Callers must provide a namespace that is unique per
+exchange AND identical across ranks (same construction/call order — the SPMD
+contract these exchanges exist to protect).
+"""
+
+from __future__ import annotations
+
+
+def kv_or_exchange(
+    local_flags: int,
+    num_processes: int,
+    process_index: int,
+    namespace: str,
+    timeout_ms: int = 120_000,
+) -> int:
+    """OR of every rank's ``local_flags`` via the coordination-service KV
+    store; returns ``local_flags`` unchanged when no distributed client is up
+    (single-process, or tests faking a state object)."""
+    from jax._src.distributed import global_state as dist_state
+
+    client = dist_state.client
+    if client is None:
+        return int(local_flags)
+    client.key_value_set(f"{namespace}/{process_index}", str(int(local_flags)))
+    client.wait_at_barrier(f"{namespace}/barrier", timeout_ms)
+    agreed = 0
+    for rank in range(num_processes):
+        agreed |= int(client.blocking_key_value_get(f"{namespace}/{rank}", timeout_ms))
+    # Namespaces are single-use, and the fallback path runs once per step:
+    # without cleanup the coordinator accrues num_processes keys per exchange
+    # for the life of the job. The second barrier keeps rank 0's directory
+    # delete from racing a slower rank's reads.
+    client.wait_at_barrier(f"{namespace}/done", timeout_ms)
+    if process_index == 0:
+        try:
+            client.key_value_delete(namespace)
+        except Exception:
+            pass  # cleanup is best-effort; correctness never depends on it
+    return agreed

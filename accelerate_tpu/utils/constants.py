@@ -48,6 +48,13 @@ ENV_COMPILE_CACHE_MIN_SECS = "ACCELERATE_COMPILE_CACHE_MIN_COMPILE_SECS"
 ENV_HANDLE_PREEMPTION = "ACCELERATE_HANDLE_PREEMPTION"
 ENV_FAULT_PLAN = "ACCELERATE_FAULT_PLAN"
 ENV_RESTART_ATTEMPT = "ACCELERATE_RESTART_ATTEMPT"
+# Training-health contract (health/): the always-on numerics sentinel ("0"
+# disables it), the loss-spike robust z-score threshold, and the hang
+# watchdog's heartbeat deadline in seconds (installed at PartialState init so
+# a hang during the first compile is still caught once stepping begins).
+ENV_GUARD_NUMERICS = "ACCELERATE_GUARD_NUMERICS"
+ENV_SPIKE_ZSCORE = "ACCELERATE_SPIKE_ZSCORE"
+ENV_HANG_TIMEOUT = "ACCELERATE_HANG_TIMEOUT"
 
 # ``dcn`` is the slice axis of a multi-slice pod: replicas connected by
 # data-center network rather than ICI. It is outermost so only the axes meant

@@ -1,0 +1,49 @@
+"""Counted device→host fetches — the instrument behind the async-hot-loop tests.
+
+The hot training loop must never stall the dispatching thread on a device→host
+round-trip: a blocking fetch serializes dispatch behind the device, turning an
+async pipeline into lock-step. Every place the framework *deliberately* pulls a
+scalar to the host (the optimizer's deferred ``found_inf`` resolution, the
+health guard's verdict drain) routes through :func:`host_fetch`, so tests can
+assert the hot path's transfer budget instead of hoping.
+
+A fetch of an array whose result is already materialized (``Array.is_ready()``)
+costs a copy but no stall; a fetch of an in-flight array additionally counts as
+*blocking* — the thing the deferred-resolution machinery exists to avoid.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_stats = {"fetches": 0, "blocking": 0}
+
+
+def array_is_ready(x) -> bool:
+    """Whether ``x``'s result is materialized (True for non-jax values)."""
+    is_ready = getattr(x, "is_ready", None)
+    if callable(is_ready):
+        try:
+            return bool(is_ready())
+        except Exception:
+            return True
+    return True
+
+
+def host_fetch(x):
+    """Pull ``x`` to the host as numpy, counting the transfer (and whether it
+    had to block on an unmaterialized result)."""
+    _stats["fetches"] += 1
+    if not array_is_ready(x):
+        _stats["blocking"] += 1
+    return np.asarray(x)
+
+
+def transfer_stats() -> dict:
+    """Snapshot of the counters: ``{"fetches": total, "blocking": stalls}``."""
+    return dict(_stats)
+
+
+def reset_transfer_stats():
+    _stats["fetches"] = 0
+    _stats["blocking"] = 0

@@ -337,6 +337,13 @@ def run_one(mode: str):
 
     resolved_impl = resolve_auto_impl(seq, cfg.num_attention_heads, cfg.head_dim, batch=batch)
 
+    # Health self-report (health/numerics.py): a bench row produced by a run
+    # whose loss went non-finite is noise, not a measurement — flag it in the
+    # JSON instead of leaving the reader to infer it from final_loss.
+    from accelerate_tpu.health import finite_scalar
+
+    finite_loss = finite_scalar(final_loss)
+
     steps_per_sec = steps / dt
     tokens_per_sec = steps_per_sec * batch * seq
     n_params = model.num_params()
@@ -377,9 +384,11 @@ def run_one(mode: str):
                     "compile_s": round(compile_s, 2),
                     # Wall-clock classification for this config's window
                     # (resilience/goodput.py): productive step time vs
-                    # compile / checkpoint / restart badput. Warmup steps are
-                    # unattributed and land in other_s by design.
+                    # compile / checkpoint / restart / rollback / hang
+                    # badput. Warmup steps are unattributed and land in
+                    # other_s by design.
                     "goodput": ledger.summary(),
+                    "health": {"finite_final_loss": finite_loss},
                     **(
                         {"compile_cache": os.environ["ACCELERATE_COMPILE_CACHE_DIR"]}
                         if os.environ.get("ACCELERATE_COMPILE_CACHE_DIR")

@@ -1,0 +1,94 @@
+"""Feature: training-health watchdog (see docs/health.md).
+
+A training loop guarded end-to-end: the always-on numerics sentinel and the
+loss-spike detector ride each step via ``accelerator.guard_step(loss)``, an
+in-memory last-known-good snapshot is refreshed every ``--snapshot_every``
+steps, and a trip rolls the run back and quarantines the poisoned batch —
+``health_guard.should_skip`` keeps it out of the replay. Pass ``--fault_plan``
+to drill deterministically (the same grammar CI uses, tests/test_health.py):
+
+Run:
+    python examples/by_feature/health_guarded_training.py
+    # drill: spike the step-8 loss 50x, watch the rollback recover
+    python examples/by_feature/health_guarded_training.py \
+        --fault_plan "step:8=loss_spike:50x"
+    # drill: poison the step-8 loss with NaN
+    python examples/by_feature/health_guarded_training.py \
+        --fault_plan "step:8=nan"
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.resilience import FaultPlan, set_active_plan
+from accelerate_tpu.test_utils import RegressionModel
+
+
+def batch_for_step(step, batch_size=16):
+    """Per-step batch regenerated from the step index — after a rollback the
+    replay feeds byte-identical data with no stateful loader."""
+    rng = np.random.default_rng(1000 + step)
+    x = rng.normal(size=(batch_size,)).astype(np.float32)
+    return {"x": x, "y": (2.0 * x + 3.0).astype(np.float32)}
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--total_steps", type=int, default=24)
+    parser.add_argument("--snapshot_every", type=int, default=4)
+    parser.add_argument("--spike_zscore", type=float, default=8.0)
+    parser.add_argument("--fault_plan", default=os.environ.get("ACCELERATE_FAULT_PLAN", ""))
+    args = parser.parse_args()
+
+    if args.fault_plan:
+        set_active_plan(FaultPlan.parse(args.fault_plan))
+
+    accelerator = Accelerator()
+    model = RegressionModel()
+    model.init_params(None)
+    pmodel, optimizer = accelerator.prepare(model, optax.adam(0.05))
+    guard = accelerator.configure_health(
+        spike_zscore=args.spike_zscore,
+        spike_warmup=5,
+        snapshot_every=args.snapshot_every,
+    )
+
+    # A while-loop over accelerator.step (not a fixed range): a rollback moves
+    # the step counter backwards and the loop simply re-reads it.
+    while accelerator.step < args.total_steps:
+        step = accelerator.step + 1
+        if guard.should_skip(step):  # batch quarantined by an earlier trip
+            accelerator.step = step
+            continue
+        out = pmodel(**batch_for_step(step))
+        accelerator.backward(out.loss)
+        optimizer.step()
+        optimizer.zero_grad()
+        accelerator.step = step
+        verdict = accelerator.guard_step(out.loss)
+        if verdict.tripped:
+            accelerator.print(
+                f"step {verdict.step}: {verdict.description} -> {verdict.action}; "
+                f"resuming from step {verdict.resume_step}"
+            )
+
+    from accelerate_tpu.resilience.goodput import get_ledger
+
+    summary = get_ledger().summary()
+    accelerator.print(
+        f"done at step {accelerator.step} | a={float(pmodel.params['a']):.3f} "
+        f"b={float(pmodel.params['b']):.3f} | trips={guard.trips} "
+        f"quarantined={sorted(guard.quarantined)} rollback_s={summary['rollback_s']}"
+    )
+
+
+if __name__ == "__main__":
+    main()

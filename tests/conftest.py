@@ -54,11 +54,22 @@ def _reset_singletons():
     GradientState._reset_state()
 
 
-# Pinned seeds: the resilience tests assert BIT-EXACT resume (params, optimizer
-# moments, RNG streams), and run_resilient's backoff jitter draws from
-# random.random — every test starts from the same host-RNG state so fault
+# Pinned seeds: the resilience AND health tests (markers `resilience` /
+# `health`, registered in pyproject) assert BIT-EXACT resume/rollback (params,
+# optimizer moments, RNG streams), and run_resilient's backoff jitter draws
+# from random.random — every test starts from the same host-RNG state so fault
 # drills are reproducible run-over-run.
 os.environ.setdefault("ACCELERATE_SEED", "0")
+
+
+@pytest.fixture(autouse=True)
+def _reset_health_watchdog():
+    """The hang watchdog is a process-global daemon thread by design; never
+    let one test's watchdog outlive it and fire into another test."""
+    yield
+    from accelerate_tpu.health.hang import reset_default_watchdog
+
+    reset_default_watchdog()
 
 
 @pytest.fixture(autouse=True)

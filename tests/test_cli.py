@@ -257,6 +257,10 @@ def test_config_wizard_roundtrips_through_launch(tmp_path):
         "yes",               # auto naming
         "3",                 # total limit
         "yes",               # handle preemption (SIGTERM watcher)
+        "yes",               # configure training-health guards?
+        "yes",               # numerics sentinel
+        "7.0",               # spike z-score threshold
+        "240",               # hang watchdog timeout (s)
         "yes",               # configure tracking?
         "json",              # trackers
         "yes",               # persistent compilation cache?
@@ -269,6 +273,7 @@ def test_config_wizard_roundtrips_through_launch(tmp_path):
     assert cfg.gradient_accumulation_steps == 4 and cfg.log_with == "json"
     assert cfg.checkpoint_total_limit == 3 and cfg.checkpoint_auto_naming
     assert cfg.handle_preemption
+    assert cfg.guard_numerics and cfg.spike_zscore == 7.0 and cfg.hang_timeout == 240.0
     assert cfg.compile_cache_dir == str(tmp_path / "xla_cache")
     config_path = tmp_path / "cfg.yaml"
     cfg.to_yaml_file(str(config_path))
@@ -289,6 +294,12 @@ def test_config_wizard_roundtrips_through_launch(tmp_path):
         "assert os.environ.get('ACCELERATE_HANDLE_PREEMPTION') == '1'\n"
         "from accelerate_tpu.resilience.preemption import get_default_watcher\n"
         "assert get_default_watcher(install=False)._prev_handlers is not None\n"
+        "assert os.environ.get('ACCELERATE_GUARD_NUMERICS') == '1'\n"
+        "assert os.environ.get('ACCELERATE_SPIKE_ZSCORE') == '7.0'\n"
+        "assert acc.health_guard.spike.zscore == 7.0\n"
+        "from accelerate_tpu.health.hang import get_default_watchdog\n"
+        "assert get_default_watchdog() is not None\n"
+        "assert get_default_watchdog().timeout_s == 240.0\n"
         "import jax\n"
         "assert jax.config.jax_compilation_cache_dir.endswith('xla_cache')\n"
         "print('ROUNDTRIP_OK')\n"

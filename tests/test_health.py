@@ -1,0 +1,623 @@
+"""Training-health watchdog tests — NaN sentinel, loss-spike rollback, hang
+detection (ISSUE 3 acceptance: a fault-injected NaN or 50x spike at step N is
+detected AT step N, rolled back to the last-known-good snapshot, the poisoned
+batch skipped, and the final params/opt-state/RNG/step are BIT-exact vs a
+clean run that never saw the batch; an injected hang converts into a bounded
+restart; the always-on sentinel adds no blocking host transfer per step).
+
+All deterministic and CPU-fast: faults come from the resilience fault-plan
+grammar, seeds are pinned in conftest, and the model is the scalar
+RegressionModel."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.health import (
+    HANG_EXIT_CODE,
+    HangDetected,
+    HangWatchdog,
+    LOSS_SPIKE,
+    LastKnownGood,
+    NONFINITE_GRAD,
+    NONFINITE_LOSS,
+    SpikeDetector,
+    nonfinite_leaves,
+)
+from accelerate_tpu.health.rollback import device_clone
+from accelerate_tpu.resilience import FaultPlan, run_resilient, set_active_plan
+from accelerate_tpu.resilience.goodput import get_ledger
+from accelerate_tpu.test_utils import RegressionModel
+from accelerate_tpu.utils.transfer import reset_transfer_stats, transfer_stats
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.health
+
+
+@pytest.fixture(autouse=True)
+def _reset_plan():
+    yield
+    from accelerate_tpu.resilience import reset_active_plan
+
+    reset_active_plan()
+
+
+# ---------------------------------------------------------------- harness
+def _build():
+    from accelerate_tpu.state import AcceleratorState, GradientState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    accelerator = Accelerator()
+    model = RegressionModel()
+    model.init_params(None)
+    pmodel, popt = accelerator.prepare(model, optax.adam(0.1))
+    return accelerator, pmodel, popt
+
+
+def _batch(step):
+    rng = np.random.default_rng(100 + step)
+    x = rng.normal(size=(8,)).astype(np.float32)
+    return {"x": x, "y": (2.0 * x + 3.0).astype(np.float32)}
+
+
+def _run_guarded(accelerator, pmodel, popt, guard, total=12):
+    """The guarded-loop contract from docs/health.md: while over
+    accelerator.step (re-read after rollbacks), quarantine check before each
+    batch, guard_step after the optimizer step."""
+    trips = []
+    while accelerator.step < total:
+        step = accelerator.step + 1
+        if guard.should_skip(step):
+            accelerator.step = step
+            continue
+        out = pmodel(**_batch(step))
+        accelerator.backward(out.loss)
+        popt.step()
+        popt.zero_grad()
+        accelerator.step = step
+        verdict = accelerator.guard_step(out.loss)
+        if verdict.tripped:
+            trips.append(verdict)
+    return trips
+
+
+def _final_state(accelerator, pmodel, popt):
+    params = {k: np.asarray(v) for k, v in accelerator.get_state_dict(pmodel).items()}
+    opt = [np.asarray(jax.device_get(l)) for l in jax.tree_util.tree_leaves(popt.opt_state)]
+    return params, opt, accelerator.step, pmodel.handle.step_counter
+
+
+def _assert_bit_exact(state_a, state_b):
+    params_a, opt_a, step_a, rngc_a = state_a
+    params_b, opt_b, step_b, rngc_b = state_b
+    assert step_a == step_b
+    assert rngc_a == rngc_b  # RNG key counter: identical dropout streams
+    for key in params_a:
+        assert np.array_equal(params_a[key], params_b[key]), key
+    assert len(opt_a) == len(opt_b)
+    for la, lb in zip(opt_a, opt_b):
+        assert np.array_equal(la, lb)
+
+
+# --------------------------------------------------- fault-plan extensions
+def test_fault_plan_health_kinds_grammar():
+    plan = FaultPlan.parse("step:8=nan;step:12=loss_spike:50x;step:20=hang:600")
+    assert [(f.step, f.action, f.arg) for f in plan.faults] == [
+        (8, "nan", None), (12, "loss_spike", "50x"), (20, "hang", "600")
+    ]
+    for bad in (
+        "step:3=loss_spike:0x",      # non-positive multiplier
+        "step:3=loss_spike:manyx",   # non-numeric multiplier
+        "step:3=nan:grads",          # nan takes no argument
+        "step:3=hang:forever",       # non-numeric duration
+    ):
+        with pytest.raises(ValueError, match="fault-plan"):
+            FaultPlan.parse(bad)
+
+
+def test_data_faults_consumed_by_guard_not_maybe_fire():
+    plan = FaultPlan.parse("step:2=nan")
+    plan.maybe_fire(2)  # control-fault path must NOT consume a data fault
+    fault = plan.take_data_fault(2)
+    assert fault is not None and fault.action == "nan"
+    assert plan.take_data_fault(2) is None  # fires at most once
+
+
+def test_launch_validates_health_fault_kinds():
+    from accelerate_tpu.commands.launch import launch_command, launch_command_parser
+
+    args = launch_command_parser().parse_args(
+        ["--cpu", "--fault_plan", "step:3=loss_spike:nope", "x.py"]
+    )
+    with pytest.raises(ValueError, match="fault-plan"):
+        launch_command(args)
+
+
+# --------------------------------------------------------- spike detector
+def _feed(det, state, losses):
+    update = jax.jit(det.update)
+    flags = []
+    for loss in losses:
+        state, f, _z = update(state, jnp.float32(loss))
+        flags.append(int(f))
+    return state, flags
+
+
+def test_spike_detector_warmup_then_trip():
+    det = SpikeDetector(zscore=6.0, warmup_steps=3)
+    state = det.init_state()
+    # A 100x outlier during warmup must NOT trip (early losses fall fast).
+    state, flags = _feed(det, state, [10.0, 9.0, 1000.0])
+    assert flags == [0, 0, 0]
+    state = det.init_state()
+    state, flags = _feed(det, state, [10.0, 9.5, 9.0, 8.5, 8.0, 400.0])
+    assert flags[:-1] == [0] * 5 and flags[-1] == LOSS_SPIKE
+
+
+def test_spike_statistics_not_poisoned_by_trip_or_nan():
+    det = SpikeDetector(zscore=6.0, warmup_steps=2)
+    state = det.init_state()
+    state, _ = _feed(det, state, [10.0, 9.5, 9.0])
+    baseline = [np.asarray(s) for s in state]
+    # Neither a spike nor a NaN may advance the statistics...
+    state, flags = _feed(det, state, [500.0, float("nan")])
+    assert flags[0] == LOSS_SPIKE and flags[1] == 0  # NaN is the sentinel's job
+    for before, after in zip(baseline, state):
+        assert np.array_equal(before, np.asarray(after))
+    # ...so the next healthy loss is judged against the unpolluted baseline.
+    state, flags = _feed(det, state, [8.8])
+    assert flags == [0]
+
+
+# ------------------------------------------------------ numerics sentinel
+def test_numerics_flags_bits():
+    from accelerate_tpu.health.numerics import numerics_flags
+
+    assert int(numerics_flags(jnp.float32(1.0), jnp.float32(1.0))) == 0
+    assert int(numerics_flags(jnp.float32(np.nan), jnp.float32(1.0))) == NONFINITE_LOSS
+    assert int(numerics_flags(jnp.float32(1.0), jnp.float32(np.inf))) == NONFINITE_GRAD
+    assert int(numerics_flags(jnp.float32(np.inf), jnp.float32(np.nan))) == (
+        NONFINITE_LOSS | NONFINITE_GRAD
+    )
+
+
+def test_nonfinite_leaves_bisection_names_the_culprit():
+    tree = {
+        "layer0": {"w": jnp.ones((4, 4)), "b": jnp.zeros((4,))},
+        "layer1": {"w": jnp.full((4, 4), jnp.nan), "b": jnp.zeros((4,))},
+        "meta": {"step": jnp.int32(3)},  # non-float leaves are skipped
+    }
+    assert nonfinite_leaves(tree) == ["layer1.w"]
+    assert nonfinite_leaves({"a": jnp.ones(3)}) == []
+
+
+# ------------------------------------------------------- rollback snapshot
+def test_device_clone_bit_exact_and_fresh_buffers():
+    x = jnp.asarray(np.array([-0.0, 1.5, np.nan, np.inf], np.float32))
+    clone = device_clone({"x": x, "n": 3, "s": "tag"})
+    assert np.array_equal(
+        np.asarray(clone["x"]).view(np.uint32), np.asarray(x).view(np.uint32)
+    )  # bit-exact incl. -0.0 and the NaN payload
+    assert clone["x"].unsafe_buffer_pointer() != x.unsafe_buffer_pointer()
+    assert clone["n"] == 3 and clone["s"] == "tag"
+
+
+def test_lkg_restore_is_repeatable():
+    lkg = LastKnownGood(every_steps=2)
+    assert lkg.due(1)  # nothing captured yet
+    lkg.capture(4, device_state={"w": jnp.float32(7.0)}, host_state={"k": [1, 2]})
+    for _ in range(2):  # restoring must not consume the snapshot
+        step, device, host = lkg.restore()
+        assert step == 4 and float(device["w"]) == 7.0 and host["k"] == [1, 2]
+    host["k"].append(3)
+    assert lkg.restore()[2]["k"] == [1, 2]  # the snapshot is isolated
+
+
+# --------------------------------------------- the acceptance drills
+@pytest.mark.parametrize(
+    "plan,guard_kwargs,expected",
+    [
+        ("step:8=nan", dict(spike_warmup=50, snapshot_every=3), "non-finite loss"),
+        (
+            "step:8=loss_spike:50x",
+            dict(spike_warmup=6, spike_zscore=8.0, snapshot_every=3),
+            "loss spike",
+        ),
+    ],
+)
+def test_fault_drill_rolls_back_bit_exact(plan, guard_kwargs, expected):
+    """The tentpole drill: the injected fault at step 8 is detected AT step 8,
+    the run rolls back to the step-6 snapshot, skips the poisoned batch on
+    replay, and lands bit-exact on a clean run that pre-quarantined batch 8."""
+    set_active_plan(None)
+    acc_a, pmodel_a, popt_a = _build()
+    guard_a = acc_a.configure_health(**guard_kwargs)
+    guard_a.quarantine(8)  # the comparator never sees the batch
+    assert _run_guarded(acc_a, pmodel_a, popt_a, guard_a) == []
+    state_a = _final_state(acc_a, pmodel_a, popt_a)
+
+    get_ledger().reset()
+    set_active_plan(FaultPlan.parse(plan))
+    acc_b, pmodel_b, popt_b = _build()
+    guard_b = acc_b.configure_health(**guard_kwargs)
+    trips = _run_guarded(acc_b, pmodel_b, popt_b, guard_b)
+
+    assert [t.step for t in trips] == [8]  # detected at the injected step
+    assert trips[0].description == expected
+    assert trips[0].rolled_back and trips[0].resume_step == 6
+    assert guard_b.should_skip(8)
+    _assert_bit_exact(state_a, _final_state(acc_b, pmodel_b, popt_b))
+    summary = get_ledger().summary()
+    assert summary["rollback_s"] > 0.0  # the restore was booked as badput
+
+
+def test_fused_train_step_drill_rolls_back_bit_exact():
+    """Same drill through build_train_step: the fused path reads the live
+    handle/opt-state/accum-buffer on every call, so a rollback's restored
+    trees (including the accumulation buffer) must slot straight back in."""
+
+    def run_fused(accelerator, pmodel, popt, guard, total=12):
+        step_fn = accelerator.build_train_step(pmodel, popt)
+        trips = []
+        while accelerator.step < total:
+            step = accelerator.step + 1
+            if guard.should_skip(step):
+                accelerator.step = step
+                continue
+            loss = step_fn(_batch(step))
+            accelerator.step = step
+            verdict = accelerator.guard_step(loss)
+            if verdict.tripped:
+                trips.append(verdict)
+        return trips
+
+    set_active_plan(None)
+    acc_a, pmodel_a, popt_a = _build()
+    guard_a = acc_a.configure_health(spike_warmup=50, snapshot_every=3)
+    guard_a.quarantine(8)
+    assert run_fused(acc_a, pmodel_a, popt_a, guard_a) == []
+    state_a = _final_state(acc_a, pmodel_a, popt_a)
+
+    set_active_plan(FaultPlan.parse("step:8=nan"))
+    acc_b, pmodel_b, popt_b = _build()
+    guard_b = acc_b.configure_health(spike_warmup=50, snapshot_every=3)
+    trips = run_fused(acc_b, pmodel_b, popt_b, guard_b)
+    assert [t.step for t in trips] == [8] and trips[0].rolled_back
+    _assert_bit_exact(state_a, _final_state(acc_b, pmodel_b, popt_b))
+
+
+def test_skip_mode_quarantines_without_rollback():
+    set_active_plan(FaultPlan.parse("step:8=nan"))
+    accelerator, pmodel, popt = _build()
+    guard = accelerator.configure_health(
+        spike_warmup=50, snapshot_every=3, on_trip="skip"
+    )
+    trips = _run_guarded(accelerator, pmodel, popt, guard)
+    assert len(trips) == 1 and trips[0].action == "skip" and not trips[0].rolled_back
+    assert accelerator.step == 12  # no rewind: the loop ran straight through
+    assert guard.should_skip(8)
+
+
+def test_trip_before_first_snapshot_degrades_to_skip():
+    set_active_plan(FaultPlan.parse("step:1=nan"))
+    accelerator, pmodel, popt = _build()
+    guard = accelerator.configure_health(spike_warmup=50, snapshot_every=5)
+    trips = _run_guarded(accelerator, pmodel, popt, guard, total=3)
+    assert len(trips) == 1 and trips[0].action == "skip"
+    assert accelerator.step == 3
+
+
+# ----------------------------------------------- async hot-loop guarantees
+def test_sentinel_adds_no_blocking_transfer_per_step():
+    """Acceptance: the always-on sentinel never stalls the dispatch thread —
+    every verdict fetch lands on an already-materialized scalar."""
+    accelerator, pmodel, popt = _build()
+    accelerator.configure_health(spike_warmup=4, snapshot_every=4)
+    reset_transfer_stats()
+    assert _run_guarded(accelerator, pmodel, popt, accelerator.health_guard) == []
+    stats = transfer_stats()
+    assert stats["blocking"] == 0, stats
+    # Bounded work too: at most one verdict fetch per step (12 steps) plus the
+    # snapshot-boundary force-drains.
+    assert stats["fetches"] <= 12 + 3, stats
+
+
+def test_optimizer_found_inf_sync_is_lazy():
+    """Satellite: step() must not pay the found_inf host sync; the property
+    resolves it later with the semantics (skip + scale backoff) intact."""
+    accelerator = Accelerator(mixed_precision="fp16")
+    model = RegressionModel()
+    model.init_params(None)
+    pmodel, popt = accelerator.prepare(model, optax.sgd(0.1))
+    out = pmodel(**_batch(1))
+    accelerator.backward(out.loss)
+    scale_before = popt.scaler.scale
+    popt._accum_grads = jax.tree_util.tree_map(
+        lambda g: jnp.full_like(g, jnp.inf), popt._accum_grads
+    )
+    reset_transfer_stats()
+    popt.step()
+    assert transfer_stats()["fetches"] == 0  # the hot path stayed async
+    assert popt._pending_finite is not None  # outcome deferred, not dropped
+    assert popt.step_was_skipped  # property access resolves...
+    assert transfer_stats()["fetches"] == 1  # ...with exactly one fetch
+    assert popt.scaler.scale == scale_before * 0.5
+    assert popt._step_count == 0
+
+
+def test_optimizer_no_scaler_never_fetches():
+    accelerator, pmodel, popt = _build()
+    reset_transfer_stats()
+    for step in range(1, 5):
+        out = pmodel(**_batch(step))
+        accelerator.backward(out.loss)
+        popt.step()
+        popt.zero_grad()
+        assert not popt.step_was_skipped
+    assert transfer_stats()["fetches"] == 0
+    assert popt._step_count == 4
+
+
+def test_fp16_deferred_resolution_keeps_scaler_semantics():
+    """The deferred resolve lands before the next forward reads the scale, so
+    backoff-then-recover dynamics match the old eager-sync behavior."""
+    accelerator = Accelerator(mixed_precision="fp16")
+    model = RegressionModel()
+    model.init_params(None)
+    pmodel, popt = accelerator.prepare(model, optax.sgd(0.1))
+    stepped = False
+    for step in range(1, 21):
+        out = pmodel(**_batch(step))
+        accelerator.backward(out.loss)
+        popt.step()
+        popt.zero_grad()
+        if not popt.step_was_skipped:
+            stepped = True
+            break
+    assert stepped, f"no successful step after 20 tries (scale={popt.scaler.scale})"
+
+
+# -------------------------------------------------------------- hang drill
+def test_hang_watchdog_converts_hang_into_restart():
+    """Acceptance: an injected hang is detected by the watchdog, converted to
+    a restartable failure, and run_resilient completes the run."""
+    set_active_plan(FaultPlan.parse("step:5=hang:600"))
+    get_ledger().reset()
+    accelerator, pmodel, popt = _build()
+
+    def train_fn(acc, attempt=0):
+        for step in range(acc.step, 10):
+            out = pmodel(**_batch(step + 1))
+            acc.backward(out.loss)
+            popt.step()
+            popt.zero_grad()
+            acc.step = step + 1
+            acc.checkpoint_on_preemption(step=acc.step)
+        return acc.step
+
+    result = run_resilient(
+        train_fn, accelerator, max_restarts=2, backoff_base_s=0.0,
+        backoff_jitter=0.0, resume=False, hang_timeout_s=1.5,
+    )
+    assert result == 10
+    summary = get_ledger().summary()
+    assert summary["hang_s"] > 0.0  # the stalled window was booked as badput
+    assert summary["restarts"] == 1
+
+
+def test_hang_watchdog_exit_mode_uses_distinct_code():
+    """Default (production) mode: a hang hard-exits with HANG_EXIT_CODE so a
+    process supervisor can restart the gang; stacks land on stderr."""
+    script = (
+        "import sys, time, threading; sys.path.insert(0, %r)\n"
+        "from accelerate_tpu.health.hang import HangWatchdog\n"
+        "w = HangWatchdog(timeout_s=0.3, poll_interval_s=0.05).start()\n"
+        "w.beat(step=7)\n"
+        "time.sleep(30)  # 'hung': never beats again\n"
+    ) % REPO_ROOT
+    proc = subprocess.run(
+        [sys.executable, "-c", script], capture_output=True, text=True, timeout=120
+    )
+    assert proc.returncode == HANG_EXIT_CODE, (proc.returncode, proc.stderr[-1000:])
+    assert "hang watchdog" in proc.stderr
+    assert "Thread" in proc.stderr or "thread" in proc.stderr  # stack dump present
+
+
+def test_hang_watchdog_arms_on_first_beat():
+    import time
+
+    w = HangWatchdog(timeout_s=0.2, on_hang="raise", poll_interval_s=0.05)
+    with w:
+        time.sleep(0.5)  # no beat yet: a long first compile must not trip it
+        assert not w.fired
+
+
+def test_run_resilient_suspends_env_watchdog():
+    """An armed env-installed watchdog must be suspended while run_resilient's
+    own watchdog owns the heartbeats — otherwise it stops being fed and kills
+    a perfectly healthy run."""
+    import threading
+    import time
+
+    from accelerate_tpu.health.hang import HangWatchdog, get_default_watchdog, set_default_watchdog
+
+    prev = HangWatchdog(timeout_s=0.4, on_hang="raise", poll_interval_s=0.05)
+    set_default_watchdog(prev)
+    prev.start(threading.main_thread())
+    prev.beat(step=1)  # armed: without suspension it would fire below
+    accelerator = Accelerator()
+
+    def train_fn(acc):
+        time.sleep(1.0)  # longer than prev's deadline, no beats
+        return "done"
+
+    assert run_resilient(train_fn, accelerator, resume=False, hang_timeout_s=30.0) == "done"
+    assert not prev.fired
+    restored = get_default_watchdog()
+    assert restored is prev
+    assert prev._thread is not None and prev._thread.is_alive()  # guarding again
+
+
+def test_lossless_guard_step_does_not_consume_data_fault():
+    """guard_step() without a loss is a heartbeat/drain call: a nan scheduled
+    for that step must stay armed for the call that actually reports a loss."""
+    from accelerate_tpu.resilience.faults import active_plan
+
+    set_active_plan(FaultPlan.parse("step:5=nan"))
+    accelerator, pmodel, popt = _build()
+    accelerator.configure_health(spike_warmup=50)
+    accelerator.step = 5
+    assert not accelerator.guard_step().tripped  # loss-less: nothing injected
+    assert not active_plan().faults[0].fired
+    verdict = accelerator.guard_step(jnp.float32(1.0), step=5)
+    assert active_plan().faults[0].fired
+    assert verdict.tripped and verdict.flags & NONFINITE_LOSS
+
+
+def test_hang_detected_constructs_with_no_args():
+    # PyThreadState_SetAsyncExc instantiates the class with no arguments.
+    exc = HangDetected()
+    assert "hang watchdog" in str(exc)
+
+
+# ------------------------------------------------- multi-host agreement
+def test_two_process_trip_agreement_rolls_back_identically():
+    """Satellite: on the real 2-process CPU harness, a spike injected on rank
+    0 only trips EVERY rank at the same step; both roll back identically and
+    land bit-exact on the clean comparator (the script asserts it per-rank
+    and cross-rank; see test_utils/health_agreement_script.py)."""
+    env = {k: v for k, v in os.environ.items() if not k.startswith("ACCELERATE_")}
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "accelerate_tpu.commands.launch", "--cpu",
+            "--num_processes", "2", "-m",
+            "accelerate_tpu.test_utils.health_agreement_script",
+        ],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO_ROOT,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:] + proc.stdout[-2000:]
+    assert proc.stdout.count("HEALTH_AGREE_OK") == 2
+
+
+# ------------------------------------------------ config / launch / env
+def test_launch_flags_export_health_env():
+    from accelerate_tpu.commands.launch import _merge_config, launch_command_parser, prepare_launch_env
+
+    args = launch_command_parser().parse_args(
+        ["--cpu", "--guard_numerics", "--spike_zscore", "7.5",
+         "--hang_timeout", "120", "x.py"]
+    )
+    env = prepare_launch_env(_merge_config(args))
+    assert env["ACCELERATE_GUARD_NUMERICS"] == "1"
+    assert env["ACCELERATE_SPIKE_ZSCORE"] == "7.5"
+    assert env["ACCELERATE_HANG_TIMEOUT"] == "120.0"
+
+    # Tri-state: unconfigured exports nothing (library defaults apply)...
+    bare = prepare_launch_env(_merge_config(launch_command_parser().parse_args(["--cpu", "x.py"])))
+    assert "ACCELERATE_GUARD_NUMERICS" not in bare and "ACCELERATE_SPIKE_ZSCORE" not in bare
+    # ...while an explicit 0 must reach the workers as a disable.
+    off = prepare_launch_env(_merge_config(
+        launch_command_parser().parse_args(["--cpu", "--spike_zscore", "0", "x.py"])
+    ))
+    assert off["ACCELERATE_SPIKE_ZSCORE"] == "0.0"
+
+
+def test_explicit_zero_zscore_disables_detector(monkeypatch):
+    accelerator, _, _ = _build()
+    monkeypatch.setenv("ACCELERATE_SPIKE_ZSCORE", "0.0")
+    guard = accelerator.health_guard
+    assert guard.spike is None and guard.sentinel is not None
+
+
+def test_fp16_scaler_overflow_does_not_trip_guard():
+    """A scale-growth overflow is the scaler's business (skip + backoff on
+    device); the guard must not roll back and quarantine the healthy batch."""
+    from accelerate_tpu.state import AcceleratorState, GradientState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    accelerator = Accelerator(mixed_precision="fp16")
+    model = RegressionModel()
+    model.init_params(None)
+    pmodel, popt = accelerator.prepare(model, optax.sgd(0.1))
+    guard = accelerator.configure_health(spike_warmup=50, snapshot_every=3)
+    out = pmodel(**_batch(1))
+    accelerator.backward(out.loss)
+    popt._accum_grads = jax.tree_util.tree_map(
+        lambda g: jnp.full_like(g, jnp.inf), popt._accum_grads
+    )
+    popt.step()  # overflow: skipped on device, scale will back off
+    accelerator.step = 1
+    verdict = accelerator.guard_step(out.loss)
+    assert not verdict.tripped, verdict
+    assert popt.step_was_skipped  # the scaler machinery still did its job
+    assert guard.trips == 0 and not guard.quarantined
+
+
+def test_cluster_config_health_fields_roundtrip(tmp_path):
+    from accelerate_tpu.commands.config_args import ClusterConfig, load_config_from_file
+
+    cfg = ClusterConfig(guard_numerics=True, spike_zscore=9.0, hang_timeout=300.0)
+    path = str(tmp_path / "cfg.yaml")
+    cfg.to_yaml_file(path)
+    loaded = load_config_from_file(path)
+    assert loaded.guard_numerics is True
+    assert loaded.spike_zscore == 9.0
+    assert loaded.hang_timeout == 300.0
+
+
+def test_guard_env_contract(monkeypatch):
+    accelerator, _, _ = _build()
+    monkeypatch.setenv("ACCELERATE_SPIKE_ZSCORE", "11.0")
+    guard = accelerator.health_guard
+    assert guard.sentinel is not None  # always-on by default
+    assert guard.spike.zscore == 11.0
+    accelerator._health_guard = None
+    monkeypatch.setenv("ACCELERATE_GUARD_NUMERICS", "0")
+    assert accelerator.health_guard.sentinel is None
+
+
+def test_partial_state_installs_env_watchdog(monkeypatch):
+    from accelerate_tpu.health.hang import get_default_watchdog, reset_default_watchdog
+    from accelerate_tpu.state import PartialState
+
+    reset_default_watchdog()
+    PartialState._reset_state()
+    monkeypatch.setenv("ACCELERATE_HANG_TIMEOUT", "45")
+    PartialState()
+    watchdog = get_default_watchdog()
+    assert watchdog is not None and watchdog.timeout_s == 45.0
+    assert not watchdog.fired  # armed only after the first beat
+
+
+# ------------------------------------------------------------- example
+def test_health_guarded_training_example(tmp_path):
+    script = os.path.join(REPO_ROOT, "examples", "by_feature", "health_guarded_training.py")
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    runner = (
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import runpy, sys\n"
+        "sys.argv = [sys.argv[1]] + sys.argv[2:]\n"
+        "runpy.run_path(sys.argv[0], run_name='__main__')\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", runner, script, "--fault_plan", "step:8=loss_spike:50x"],
+        capture_output=True, text=True, timeout=900, env=env, cwd=REPO_ROOT,
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    assert "loss spike -> rollback" in proc.stdout
+    assert "trips=1" in proc.stdout and "quarantined=[8]" in proc.stdout

@@ -345,6 +345,22 @@ def test_goodput_ledger_summary_breakdown():
         ledger.add("not_a_category", 1.0)
 
 
+def test_goodput_health_badput_classes():
+    """The health subsystem's badput classes (rollback = last-known-good
+    restores, hang = time a wedged run sat before the watchdog fired) classify
+    like any other badput and ride the same summary schema bench.py embeds."""
+    ledger = GoodputLedger()
+    ledger.record_step(3.0, steps=3)
+    with ledger.track("rollback"):
+        pass
+    ledger.add("rollback", 0.4)
+    ledger.add("hang", 1.1)
+    s = ledger.summary()
+    assert s["rollback_s"] >= 0.4 and s["hang_s"] == 1.1
+    assert s["badput_s"] == round(s["rollback_s"] + s["hang_s"], 3)
+    assert s["steps"] == 3
+
+
 def test_checkpoint_io_lands_in_ledger(tmp_path):
     acc, pmodel, popt = _build(tmp_path)
     get_ledger().reset()
