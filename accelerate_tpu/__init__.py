@@ -97,4 +97,16 @@ def __getattr__(name):
         from . import resilience
 
         return getattr(resilience, name)
+    if name in (
+        "Telemetry",
+        "StepTimeline",
+        "StragglerMonitor",
+        "MetricsRegistry",
+        "get_registry",
+        "get_telemetry",
+        "span",
+    ):
+        from . import telemetry
+
+        return getattr(telemetry, name)
     raise AttributeError(f"module 'accelerate_tpu' has no attribute {name!r}")

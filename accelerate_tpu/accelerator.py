@@ -452,6 +452,7 @@ class Accelerator:
         self._resilience_step = 0
         self._preemption_watcher = None
         self._health_guard = None
+        self._telemetry = None
         self._models: list[PreparedModel] = []
         self._optimizers: list[AcceleratedOptimizer] = []
         self._schedulers: list[AcceleratedScheduler] = []
@@ -620,6 +621,12 @@ class Accelerator:
         ``AcceleratedOptimizer``, dataloaders → sharded device-feeding loaders,
         schedules → ``AcceleratedScheduler``. Order is preserved.
         """
+        from .telemetry import span
+
+        with span("prepare"):
+            return self._prepare(*args, device_placement=device_placement)
+
+    def _prepare(self, *args, device_placement=None):
         import optax
 
         result = []
@@ -1064,6 +1071,18 @@ class Accelerator:
             optimizer._accum_grads = jax.tree_util.tree_map(jnp.zeros_like, handle.params)
         count_box = [jnp.int32(0)]
 
+        from .telemetry import span
+        from .telemetry.timeline import batch_token_count
+
+        # The MFU estimate needs the model's flop count; the zoo models expose
+        # it, anything else leaves the timeline at tokens/s only.
+        flops_fn = getattr(handle.module, "flops_per_token", None)
+        if self.telemetry.enabled and callable(flops_fn):
+            try:
+                self.telemetry.timeline.set_model_flops(float(flops_fn()))
+            except Exception:
+                pass
+
         def _step_args(batch, rng, clip_norm):
             return (
                 handle.params, optimizer.opt_state, optimizer._accum_grads,
@@ -1082,8 +1101,20 @@ class Accelerator:
                 )
             handle.step_counter += 1
             rng = jax.random.fold_in(handle.rng, handle.step_counter)
-            (handle.params, optimizer.opt_state, optimizer._accum_grads,
-             count_box[0], loss) = _step(*_step_args(batch, rng, clip_norm))
+            # self.telemetry (not a build-time capture) so a later
+            # configure_telemetry() redirects the feed, and ACCELERATE_
+            # TELEMETRY=0 strips the per-step instrumentation entirely.
+            telemetry = self.telemetry
+            if not telemetry.enabled:
+                (handle.params, optimizer.opt_state, optimizer._accum_grads,
+                 count_box[0], loss) = _step(*_step_args(batch, rng, clip_norm))
+                return loss
+            with span("train_step"):
+                (handle.params, optimizer.opt_state, optimizer._accum_grads,
+                 count_box[0], loss) = _step(*_step_args(batch, rng, clip_norm))
+            # Per-step timeline sample: a clock read + deque append; the loss
+            # scalar is retained (never fetched) so the dispatch stays async.
+            telemetry.on_fused_step(tokens=batch_token_count(batch), loss=loss)
             return loss
 
         def lower(batch, clip_norm: float = 0.0):
@@ -1108,12 +1139,15 @@ class Accelerator:
         catching everything: a genuine collective failure (shape mismatch,
         dead host, backend error) on tensor data must surface, not silently
         degrade to the pickle path."""
+        from .telemetry import span
+
         if not use_gather_object and self.num_processes > 1:
             use_gather_object = _has_object_leaves(input_data)
-        if use_gather_object:
-            all_tensors = ops.gather_object(input_data)
-        else:
-            all_tensors = ops.gather(input_data)
+        with span("gather_for_metrics"):
+            if use_gather_object:
+                all_tensors = ops.gather_object(input_data)
+            else:
+                all_tensors = ops.gather(input_data)
         if not self.gradient_state.end_of_dataloader:
             return all_tensors
         remainder = self.gradient_state.remainder
@@ -1207,6 +1241,56 @@ class Accelerator:
 
         self.log({f"goodput/{k}": v for k, v in get_ledger().summary().items()}, step=step)
 
+    # -------------------------------------------------------------- telemetry
+    @property
+    def telemetry(self):
+        """The process-wide :class:`~.telemetry.Telemetry` — always-on step
+        timeline, span ring, metrics registry, straggler monitor — built from
+        the launcher's env contract (ACCELERATE_TELEMETRY /
+        ACCELERATE_METRICS_PORT / ACCELERATE_STRAGGLER_THRESHOLD) on first
+        access; ``configure_telemetry`` overrides it."""
+        if self._telemetry is None:
+            from .telemetry import get_telemetry
+
+            self._telemetry = get_telemetry()
+        return self._telemetry
+
+    def configure_telemetry(self, **kwargs):
+        """Build the telemetry stack explicitly (kwargs go to
+        :class:`~.telemetry.Telemetry`); replaces the lazy/env default for
+        this process so framework-internal hooks see the same instance."""
+        from .telemetry import Telemetry, set_telemetry
+
+        previous = self._telemetry
+        self._telemetry = Telemetry(**kwargs)
+        # A fused step built before this call keeps feeding the (now current)
+        # instance via the self.telemetry indirection; carry the model flop
+        # count over so its MFU estimate survives the swap.
+        if previous is not None and self._telemetry.timeline._flops_per_token is None:
+            self._telemetry.timeline._flops_per_token = previous.timeline._flops_per_token
+        set_telemetry(self._telemetry)
+        return self._telemetry
+
+    def log_telemetry(self, step: int | None = None):
+        """Push the step-timeline summary and the metrics-registry snapshot
+        through the active trackers — ``telemetry/*`` for the timeline schema
+        (docs/observability.md) and ``metrics/*`` for every registered
+        counter/gauge (goodput classes, health trips, restarts, ...)."""
+        telemetry = self.telemetry
+        values: dict = {}
+
+        def flatten(prefix, value):
+            if isinstance(value, dict):
+                for key, inner in value.items():
+                    flatten(f"{prefix}/{key}", inner)
+            else:
+                values[prefix] = value
+
+        flatten("telemetry", telemetry.summary())
+        for name, val in telemetry.registry.snapshot().items():
+            values[f"metrics/{name}"] = val
+        self.log(values, step=step if step is not None else self.step)
+
     def end_training(self):
         """Flush trackers AND join queued async checkpoint writes: a script
         that returns right after a non-blocking ``save_state`` must not drop
@@ -1232,8 +1316,10 @@ class Accelerator:
         returns immediately (training continues while HBM drains to disk);
         join with ``finish_pending_saves()`` or let ``load_state`` join."""
         from .checkpointing import save_accelerator_state
+        from .telemetry import span
 
-        return save_accelerator_state(self, output_dir, **save_model_func_kwargs)
+        with span("checkpoint_save"):
+            return save_accelerator_state(self, output_dir, **save_model_func_kwargs)
 
     def finish_pending_saves(self):
         from .checkpointing import finish_pending_saves
@@ -1242,8 +1328,10 @@ class Accelerator:
 
     def load_state(self, input_dir: str | None = None, **load_model_func_kwargs):
         from .checkpointing import load_accelerator_state
+        from .telemetry import span
 
-        return load_accelerator_state(self, input_dir, **load_model_func_kwargs)
+        with span("checkpoint_restore"):
+            return load_accelerator_state(self, input_dir, **load_model_func_kwargs)
 
     def save_model(self, model, save_directory, max_shard_size="10GB", safe_serialization=True):
         from .checkpointing import save_model as _save_model
@@ -1289,6 +1377,15 @@ class Accelerator:
         # A completed step boundary is a heartbeat: loops that only call this
         # hook (no guard_step) still keep the hang watchdog fed.
         beat_default(step)
+        # ...and a telemetry boundary — but only when no health guard is in
+        # play: guard_step is then the designated timeline feeder, and its
+        # numbering (self.step) can diverge from the private resilience
+        # counter here (resumes restore self.step; accumulation counts
+        # micro-steps), which would defeat the per-step dedupe and
+        # double-sample every step. Guard-less resilient loops keep their
+        # timeline through this hook with its own consistent numbering.
+        if self._health_guard is None:
+            self.telemetry.on_step(step, state=self.state)
         # Install the watcher BEFORE the fault plan can deliver a signal: a
         # 'sigterm' fault at the first hooked step must hit the sticky-flag
         # handler, not the default disposition (process death).
@@ -1362,6 +1459,10 @@ class Accelerator:
 
         step = self.step if step is None else step
         beat_default(step)
+        # Same-step telemetry sample BEFORE any rollback rewinds the count;
+        # the straggler exchange inside is collective, and guard_step already
+        # carries the every-host-same-step contract it needs.
+        self.telemetry.on_step(step, loss=loss, state=self.state)
         return self.health_guard.guard_step(self, loss, step)
 
     # ---------------------------------------------------------------- profile

@@ -129,6 +129,25 @@ def get_user_input() -> ClusterConfig:
             "  hang watchdog timeout in seconds (0 = disabled; dumps stacks and "
             "exits 113 for the launcher to restart)", 0.0, float
         )
+    # Tri-state like the health section: skipping leaves None (nothing
+    # exported; telemetry defaults ON), explicit answers reach the workers.
+    telemetry, metrics_port, straggler_threshold = None, 0, 0.0
+    if _yesno(
+        "Do you want to configure observability (step timeline, metrics "
+        "endpoint, straggler alerts)?", False
+    ):
+        telemetry = _yesno(
+            "  always-on telemetry (per-step timeline, spans, metrics registry)?",
+            True,
+        )
+        metrics_port = _ask(
+            "  Prometheus metrics port (0 = no HTTP endpoint; the registry "
+            "still feeds trackers)", 0, int
+        )
+        straggler_threshold = _ask(
+            "  straggler alert ratio vs the cross-host median step time "
+            "(0 = library default 1.5)", 0.0, float
+        )
     log_with = ""
     if _yesno("Do you want to configure experiment tracking?", False):
         log_with = _ask(
@@ -182,6 +201,9 @@ def get_user_input() -> ClusterConfig:
         guard_numerics=guard_numerics,
         spike_zscore=spike_zscore,
         hang_timeout=hang_timeout,
+        telemetry=telemetry,
+        metrics_port=metrics_port,
+        straggler_threshold=straggler_threshold,
     )
 
 

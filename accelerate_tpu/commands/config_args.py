@@ -112,6 +112,16 @@ class ClusterConfig:
     guard_numerics: bool | None = None
     spike_zscore: float | None = None
     hang_timeout: float = 0.0
+    # Telemetry (telemetry/): the always-on step timeline/span/metrics stack.
+    # ``telemetry`` is TRI-state like the health knobs (None = not configured,
+    # nothing exported, library default ON; an explicit False must reach the
+    # workers as ACCELERATE_TELEMETRY=0). ``metrics_port`` > 0 starts the
+    # Prometheus endpoint on every worker (ACCELERATE_METRICS_PORT);
+    # ``straggler_threshold`` tunes the cross-host slowness ratio that raises
+    # an alert (0.0 = library default 1.5; ACCELERATE_STRAGGLER_THRESHOLD).
+    telemetry: bool | None = None
+    metrics_port: int = 0
+    straggler_threshold: float = 0.0
     extra: dict = field(default_factory=dict)
 
     def to_dict(self) -> dict:

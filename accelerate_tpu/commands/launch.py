@@ -33,11 +33,14 @@ from ..utils.constants import (
     ENV_HANDLE_PREEMPTION,
     ENV_HANG_TIMEOUT,
     ENV_MESH_SHAPE,
+    ENV_METRICS_PORT,
     ENV_MIXED_PRECISION,
     ENV_NUM_PROCESSES,
     ENV_PROCESS_ID,
     ENV_RESTART_ATTEMPT,
     ENV_SPIKE_ZSCORE,
+    ENV_STRAGGLER_THRESHOLD,
+    ENV_TELEMETRY,
 )
 from .config_args import ClusterConfig, load_config_from_file
 
@@ -118,6 +121,28 @@ def launch_command_parser(subparsers=None) -> argparse.ArgumentParser:
              "(ACCELERATE_SPIKE_ZSCORE; library default 6.0; 0 disables).",
     )
     parser.add_argument(
+        "--telemetry", action=argparse.BooleanOptionalAction, default=None,
+        help="Pin the telemetry stack on (or, --no-telemetry, off) explicitly "
+             "(ACCELERATE_TELEMETRY; on by default — the always-on per-step "
+             "timeline, span ring, metrics registry, and straggler monitor "
+             "behind Accelerator.telemetry, docs/observability.md).",
+    )
+    parser.add_argument(
+        "--metrics_port", type=int, default=None,
+        help="Serve the Prometheus metrics endpoint on this port on every "
+             "worker (ACCELERATE_METRICS_PORT): /metrics exposes the shared "
+             "registry — step time, tokens/s, MFU, goodput/badput classes, "
+             "health trips, restarts, straggler skew. Co-located workers "
+             "(CPU-sim gangs) serve on port + local_process_index.",
+    )
+    parser.add_argument(
+        "--straggler_threshold", type=float, default=None,
+        help="Cross-host slowness ratio that raises a straggler alert "
+             "(ACCELERATE_STRAGGLER_THRESHOLD; library default 1.5): a host "
+             "whose mean step time exceeds threshold x the cross-host median "
+             "is named in a rate-limited warning and the skew gauges.",
+    )
+    parser.add_argument(
         "--hang_timeout", type=float, default=None,
         help="Hang-watchdog deadline in seconds (ACCELERATE_HANG_TIMEOUT): "
              "when no training step completes within the deadline, every "
@@ -162,6 +187,9 @@ def _merge_config(args) -> ClusterConfig:
         ("guard_numerics", "guard_numerics"),
         ("spike_zscore", "spike_zscore"),
         ("hang_timeout", "hang_timeout"),
+        ("telemetry", "telemetry"),
+        ("metrics_port", "metrics_port"),
+        ("straggler_threshold", "straggler_threshold"),
     ]:
         val = getattr(args, flag, None)
         if val is not None:
@@ -220,6 +248,14 @@ def prepare_launch_env(cfg: ClusterConfig, process_id: int | None = None, attemp
         env[ENV_SPIKE_ZSCORE] = str(cfg.spike_zscore)
     if cfg.hang_timeout:
         env[ENV_HANG_TIMEOUT] = str(cfg.hang_timeout)
+    # Telemetry is tri-state like the health knobs: None exports nothing
+    # (library default: ON), an explicit disable must reach the workers.
+    if cfg.telemetry is not None:
+        env[ENV_TELEMETRY] = "1" if cfg.telemetry else "0"
+    if cfg.metrics_port:
+        env[ENV_METRICS_PORT] = str(int(cfg.metrics_port))
+    if cfg.straggler_threshold:
+        env[ENV_STRAGGLER_THRESHOLD] = str(cfg.straggler_threshold)
     # Plugins (e.g. the axon tunnel) may have pinned JAX_PLATFORMS in *this*
     # process's environ at jax-import time; children must re-discover their own
     # backend, so only forward the value we set deliberately.
@@ -344,6 +380,13 @@ def launch_command(args) -> None:
         raise ValueError(f"--spike_zscore must be >= 0, got {cfg.spike_zscore}")
     if cfg.hang_timeout and cfg.hang_timeout < 0:
         raise ValueError(f"--hang_timeout must be >= 0, got {cfg.hang_timeout}")
+    if cfg.metrics_port and not (0 < cfg.metrics_port < 65536):
+        raise ValueError(f"--metrics_port must be in [1, 65535], got {cfg.metrics_port}")
+    if cfg.straggler_threshold and cfg.straggler_threshold < 1.0:
+        raise ValueError(
+            f"--straggler_threshold must be >= 1.0 (a ratio to the cross-host "
+            f"median step time), got {cfg.straggler_threshold}"
+        )
     if cfg.max_restarts > 0 and cfg.num_machines > 1:
         raise ValueError(
             "--max_restarts only applies to single-machine jobs: on a pod, a "

@@ -48,6 +48,23 @@ _PYTORCH_DATALOADER_KWARGS = (
 ).split()
 
 
+_BATCHES_COUNTER = None  # telemetry.metrics.cached_handles accessor
+
+
+def _batches_counter():
+    """The telemetry batch counter — the yield loops pay only the .inc()
+    (cached_handles hoists the registry lookup, keyed on reset generation)."""
+    global _BATCHES_COUNTER
+    if _BATCHES_COUNTER is None:
+        from .telemetry.metrics import cached_handles
+
+        _BATCHES_COUNTER = cached_handles(lambda registry: registry.counter(
+            "accelerate_dataloader_batches_total",
+            "Batches yielded by prepared data loaders",
+        ))
+    return _BATCHES_COUNTER()
+
+
 def _is_torch_loader(obj) -> bool:
     try:
         import torch.utils.data as tud
@@ -562,6 +579,7 @@ class DataLoaderShard(DataLoaderStateMixin):
                                 self.remainder = actual * jax.process_count()
                             batch = self._pad_batch_to(batch, expected_local)
                     self._num_batches_fetched += 1
+                    _batches_counter().inc()
                     yield self._device_feed(batch, None)
                     batches_yielded += 1
             if nxt is None:
@@ -712,6 +730,7 @@ class DataLoaderDispatcher(DataLoaderStateMixin):
                 if have_prev and skipped >= effective_skip:
                     self.end_of_dataloader = True
                     self._num_batches_fetched += 1
+                    _batches_counter().inc()
                     yield self._emit(prev)
                 break
             if have_prev:
@@ -719,6 +738,7 @@ class DataLoaderDispatcher(DataLoaderStateMixin):
                     skipped += 1
                 else:
                     self._num_batches_fetched += 1
+                    _batches_counter().inc()
                     yield self._emit(prev)
             prev = batch
             have_prev = True

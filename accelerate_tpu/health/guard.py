@@ -300,6 +300,15 @@ class HealthGuard:
             f"Health guard tripped at step {trip_step}: {describe_flags(flags)}"
             + (f" (robust z={z:.2f})" if z else "")
         )
+        # Telemetry: trips (and rollbacks, below) land in the shared metrics
+        # registry so scrapers/trackers see them next to goodput and restarts.
+        from ..telemetry.metrics import get_registry
+
+        get_registry().counter(
+            "accelerate_health_trips_total",
+            "Health-guard trips by verdict kind",
+            labelnames=("kind",),
+        ).inc(kind=describe_flags(flags))
         if flags & (NONFINITE_LOSS | NONFINITE_GRAD) and self.sentinel is not None:
             for model in accelerator._models:
                 self.sentinel.attribute(model.handle.params, label="params")
@@ -326,6 +335,10 @@ class HealthGuard:
             if spike_state is not None:
                 self._spike_state = spike_state
             rolled_back = True
+            get_registry().counter(
+                "accelerate_health_rollbacks_total",
+                "Last-known-good rollbacks applied by the health guard",
+            ).inc()
         else:
             resume_step = trip_step
         return HealthVerdict(

@@ -10,6 +10,12 @@ from __future__ import annotations
 import functools
 import logging
 import os
+import sys
+
+# Per-callsite emission counters behind ``log_every_n`` — module-global so
+# every adapter wrapping the same (or different) loggers shares one count per
+# source line, which is what "don't flood the log from this loop" means.
+_EVERY_N_COUNTS: dict = {}
 
 
 class MultiProcessAdapter(logging.LoggerAdapter):
@@ -49,6 +55,23 @@ class MultiProcessAdapter(logging.LoggerAdapter):
         elif self.isEnabledFor(level) and self._should_log(main_process_only):
             msg, kwargs = self.process(msg, kwargs)
             self.logger.log(level, msg, *args, **kwargs)
+
+    def log_every_n(self, n: int, level, msg, *args, **kwargs):
+        """Rate-limited ``log``: emits the 1st and then every ``n``-th call
+        *per callsite* (keyed on the caller's file:line, shared across adapter
+        instances), so per-step telemetry warnings — straggler alerts, skew
+        reports — cannot flood a multi-thousand-step run. Suppressed calls
+        still count, and the emitted record notes the suppression."""
+        if n <= 0:
+            raise ValueError(f"log_every_n needs n >= 1, got {n}")
+        frame = sys._getframe(1)
+        key = (frame.f_code.co_filename, frame.f_lineno)
+        count = _EVERY_N_COUNTS.get(key, 0)
+        _EVERY_N_COUNTS[key] = count + 1
+        if count % n == 0:
+            if count and n > 1:
+                msg = f"{msg} [1/{n} of {count + 1} occurrences logged]"
+            self.log(level, msg, *args, **kwargs)
 
     @functools.lru_cache(None)
     def warning_once(self, *args, **kwargs):

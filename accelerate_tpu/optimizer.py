@@ -64,6 +64,28 @@ def _global_norm(grads):
     return jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in jax.tree_util.tree_leaves(grads)))
 
 
+_STEP_COUNTERS = None  # telemetry.metrics.cached_handles accessor
+
+
+def _count_optimizer_step(skipped: bool):
+    """Publish applied/overflow-skipped updates into the telemetry registry;
+    each step pays only the .inc() (cached_handles hoists the lookup)."""
+    global _STEP_COUNTERS
+    if _STEP_COUNTERS is None:
+        from .telemetry.metrics import cached_handles
+
+        _STEP_COUNTERS = cached_handles(lambda registry: (
+            registry.counter(
+                "accelerate_optimizer_steps_total", "Optimizer updates applied"
+            ),
+            registry.counter(
+                "accelerate_optimizer_skipped_steps_total",
+                "Optimizer updates skipped on fp16 overflow",
+            ),
+        ))
+    _STEP_COUNTERS()[skipped].inc()
+
+
 class GradScalerState:
     """Dynamic loss-scaler (fp16) state, mirroring torch GradScaler semantics the
     reference relies on (``optimizer.py:162-177``): on non-finite grads the step is
@@ -238,6 +260,7 @@ class AcceleratedOptimizer:
         else:
             self._step_was_skipped = False
             self._step_count += 1
+            _count_optimizer_step(skipped=False)
 
     def _to_host(self, tree):
         """Move the optimizer state to host memory (async device→host DMA); the
@@ -290,6 +313,7 @@ class AcceleratedOptimizer:
         self.scaler.update(found_inf)
         if not found_inf:
             self._step_count += 1
+        _count_optimizer_step(skipped=found_inf)
 
     @property
     def step_was_skipped(self) -> bool:

@@ -55,6 +55,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..utils.jax_compat import shard_map
+
 logger = logging.getLogger(__name__)
 
 
@@ -179,7 +181,11 @@ class PipelineSpec:
             segments = _window_segments(seq_or_none) if seq_or_none is not None else None
 
             def body(stage_layers, x, ctx_local):
-                aux_acc = tuple(jnp.zeros((), jnp.float32) for _ in aux_keys)
+                # Aux accumulators ride as (1,) vectors, never rank-0: the
+                # 0.4.x shard_map transpose rematerializes device-varying
+                # residuals through an all-axes out_spec, which has no dim to
+                # pin on a scalar ("add at least one (singleton) axis").
+                aux_acc = tuple(jnp.zeros((1,), jnp.float32) for _ in aux_keys)
 
                 def run_segment(x, aux_acc, seg, pattern):
                     p = len(pattern)
@@ -315,7 +321,7 @@ class PipelineSpec:
                     for k, v in ctx_local.items()
                 }
                 x_in = jnp.where(stage == 0, inp, state)
-                aux_in = tuple(jnp.where(stage == 0, jnp.zeros((), jnp.float32), a) for a in aux_state)
+                aux_in = tuple(jnp.where(stage == 0, jnp.zeros((1,), jnp.float32), a) for a in aux_state)
                 y, aux_y = stage_fn(x_in, ctx_local)
                 aux_y = tuple(a + b for a, b in zip(aux_in, aux_y))
                 # Last stage banks the finished microbatch.
@@ -325,9 +331,11 @@ class PipelineSpec:
                 outputs = lax.dynamic_update_index_in_dim(
                     outputs, jnp.where(write, y, cur), out_idx, 0
                 )
+                # Slice (not index) so the aux update stays rank-1 end to end
+                # (same rank-0 residual rule as the accumulators above).
                 aux_out = tuple(
-                    lax.dynamic_update_index_in_dim(
-                        ao, jnp.where(write, ay, lax.dynamic_index_in_dim(ao, out_idx, keepdims=False)), out_idx, 0
+                    lax.dynamic_update_slice_in_dim(
+                        ao, jnp.where(write, ay, lax.dynamic_slice_in_dim(ao, out_idx, 1)), out_idx, 0
                     )
                     for ao, ay in zip(aux_out, aux_y)
                 )
@@ -339,7 +347,7 @@ class PipelineSpec:
             outputs = jnp.zeros_like(xs)
             aux_out = tuple(jnp.zeros((M,), jnp.float32) for _ in aux_keys)
             state = jnp.zeros_like(xs[0])
-            aux_state = tuple(jnp.zeros((), jnp.float32) for _ in aux_keys)
+            aux_state = tuple(jnp.zeros((1,), jnp.float32) for _ in aux_keys)
             (state, aux_state, outputs, aux_out), _ = lax.scan(
                 tick, (state, aux_state, outputs, aux_out), jnp.arange(M + n_stages - 1)
             )
@@ -361,7 +369,7 @@ class PipelineSpec:
             aux_out = tuple(lax.psum(a, "pp") for a in aux_out)
             return outputs, aux_out
 
-        out, aux_out = jax.shard_map(
+        out, aux_out = shard_map(
             per_stage,
             mesh=mesh,
             in_specs=(P("pp"), P(), P()),
@@ -640,6 +648,10 @@ def _pipeline_train_grads(spec, module, params, batch, compute_dtype=jnp.float32
                     lambda: xleaf,
                 )
                 y_, aux_ = body(stage, _cast_floats(l32, compute_dtype), x_, ctx_b)
+                # body carries aux as (1,) vectors (GPipe transpose rule);
+                # here the objective must stay scalar, and differentiation is
+                # local to the manual region so rank-0 is safe.
+                aux_ = tuple(jnp.reshape(a, ()) for a in aux_)
                 hsum = stage_select(
                     is_last, lambda: head_sum(o32, y_, lab_b, msk_b, cnt_b),
                     lambda: jnp.zeros((), jnp.float32),
@@ -695,7 +707,7 @@ def _pipeline_train_grads(spec, module, params, batch, compute_dtype=jnp.float32
         aux_sums = tuple(lax.psum(a, "pp") for a in aux_sums)
         return gL, gO, loss_sum, aux_sums
 
-    gL, gO, loss_sum, aux_sums = jax.shard_map(
+    gL, gO, loss_sum, aux_sums = shard_map(
         per_stage,
         mesh=mesh,
         in_specs=(P("pp"), P(), P(), P(), P(), P(), P(), P(), P()),

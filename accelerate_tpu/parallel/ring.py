@@ -143,7 +143,9 @@ def _flash_block_fwd(q, k_cur, v_cur, mask_cur, chunk_rel, m, l, acc):
 # ------------------------------------------------------------------- forward
 def _ring_fwd_local(q, k, v, mask, axis_name, causal, block_impl):
     """Per-device forward ring. Returns (out, lse) with lse = m + log l."""
-    n = jax.lax.axis_size(axis_name)
+    from ..utils.jax_compat import axis_size
+
+    n = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, s_loc, h, d = q.shape
     pos_q = idx * s_loc + jnp.arange(s_loc)
@@ -258,7 +260,9 @@ def _flash_block_bwd(q, k_cur, v_cur, mask_cur, chunk_rel, l_g, m_g, dout, delta
 def _ring_bwd_local(q, k, v, mask, out, lse, dout, axis_name, causal, block_impl):
     """Per-device backward ring. dk/dv accumulators rotate with their KV chunk,
     so each chunk's gradient arrives home after ``n`` hops."""
-    n = jax.lax.axis_size(axis_name)
+    from ..utils.jax_compat import axis_size
+
+    n = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, s_loc, h, d = q.shape
     pos_q = idx * s_loc + jnp.arange(s_loc)
@@ -365,7 +369,7 @@ def ring_attention(
     qkv_spec = P(batch_axes, axis_name, head_axis, None)
     mask_spec = P(batch_axes, axis_name)
 
-    from jax import shard_map
+    from ..utils.jax_compat import shard_map
 
     if mask is None:
         fn = shard_map(

@@ -75,7 +75,7 @@ def ulysses_attention(q, k, v, *, causal=True, mask=None, mesh=None, axis_name: 
         # Outbound: scatter sequence back, gather heads.
         return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
-    from jax import shard_map
+    from ..utils.jax_compat import shard_map
 
     if mask is None:
         fn = shard_map(

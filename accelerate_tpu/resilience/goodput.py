@@ -8,7 +8,11 @@ module keeps one process-wide ledger that the rest of the framework feeds
 (``checkpointing`` times saves/restores, ``run_resilient`` times restart
 downtime, ``bench.py`` times compiles and steps) and that surfaces in two
 places: ``Accelerator.log_goodput()`` pushes the breakdown through the normal
-tracker path, and ``bench.py`` embeds it in its JSON lines.
+tracker path, and ``bench.py`` embeds it in its JSON lines. The telemetry
+registry (telemetry/metrics.py) additionally exports the summary as
+``accelerate_goodput_*``/``accelerate_badput_seconds`` gauges via a
+scrape-time collector, so the Prometheus endpoint and ``log_telemetry`` see
+the same numbers with zero per-step cost.
 
 The categories follow the goodput decomposition used by large TPU trainers
 (productive step time vs program-acquisition and checkpoint overheads): one

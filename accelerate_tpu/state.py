@@ -48,6 +48,7 @@ from .utils.constants import (
     ENV_DEBUG_MODE,
     ENV_HANDLE_PREEMPTION,
     ENV_HANG_TIMEOUT,
+    ENV_METRICS_PORT,
     ENV_MIXED_PRECISION,
     ENV_NUM_PROCESSES,
     ENV_PROCESS_ID,
@@ -176,7 +177,6 @@ class PartialState:
                     f"{ENV_HANG_TIMEOUT}={hang_timeout!r} must be a positive "
                     "number of seconds"
                 ) from None
-
         platform = jax.default_backend()
         if self._cpu and platform != "cpu":
             logger.warning(
@@ -205,6 +205,17 @@ class PartialState:
             self.distributed_type = DistributedType.NO
         self._mesh = None
         self._parallelism_config = None
+        # Telemetry wiring (telemetry/): the opt-in Prometheus endpoint starts
+        # at init — like the watchdog, it must serve for the whole process
+        # life, including a multi-minute first compile — while the timeline/
+        # straggler pieces build lazily on first Accelerator.telemetry access.
+        # After process discovery so co-located workers (the CPU-sim gang)
+        # offset the port by their local rank instead of fighting for one
+        # bind; the shared helper degrades a bind failure to a warning.
+        if os.environ.get(ENV_METRICS_PORT, "").strip():
+            from .telemetry import start_endpoint_from_env
+
+            start_endpoint_from_env(self.local_process_index)
 
     def __repr__(self) -> str:
         return (

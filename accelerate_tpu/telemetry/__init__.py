@@ -1,0 +1,304 @@
+"""Unified telemetry — one answer to "what is this run doing right now?"
+
+The subsystems that grew their own observability silos — bench probes, the
+goodput ledger, health verdicts, transfer counters — publish into ONE stack:
+
+- :mod:`.spans` — nestable ``span("data_load")`` blocks recorded into a
+  lock-free ring buffer AND a ``jax.profiler.TraceAnnotation``, so host-side
+  and XLA-trace views share names (the framework pre-instruments
+  prepare / train_step / checkpoint / gather);
+- :mod:`.timeline` — the always-on per-step timeline: step wall time,
+  tokens/s, achieved-MFU estimate, compile events, deliberate device→host
+  transfer counts, device memory — with zero forced host syncs (device
+  scalars drain only when materialized);
+- :mod:`.metrics` — the process-wide counter/gauge/histogram registry every
+  layer (goodput, health, resilience, data loader, optimizer, serving)
+  publishes into, exported as a Prometheus endpoint
+  (``launch --metrics_port``) and as structured records through the tracker
+  stack (``Accelerator.log_telemetry``);
+- :mod:`.straggler` — periodic cross-host step-time aggregation over the
+  one-scalar-collective/KV-agreement machinery, naming the slow host.
+
+:class:`Telemetry` binds them behind ``Accelerator.telemetry``; the per-step
+hooks loops already call (``guard_step`` / ``checkpoint_on_preemption``) and
+the fused ``build_train_step`` feed it automatically. See
+docs/observability.md.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    MetricsServer,
+    get_registry,
+    start_default_server,
+    stop_default_server,
+)
+from .spans import SpanRecord, SpanRing, get_span_ring, reset_spans, span
+from .straggler import SkewReport, StragglerMonitor
+from .timeline import StepTimeline, device_memory_stats, device_peak_flops
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "MetricsServer",
+    "SkewReport",
+    "SpanRecord",
+    "SpanRing",
+    "StepTimeline",
+    "StragglerMonitor",
+    "Telemetry",
+    "device_memory_stats",
+    "device_peak_flops",
+    "get_registry",
+    "get_span_ring",
+    "get_telemetry",
+    "install_default_collectors",
+    "reset_spans",
+    "reset_telemetry",
+    "set_telemetry",
+    "span",
+    "start_default_server",
+    "start_endpoint_from_env",
+    "stop_default_server",
+]
+
+
+def install_default_collectors(registry: MetricsRegistry | None = None):
+    """Register the pull-model publishers (idempotent per registry): the
+    goodput ledger (goodput/badput classes + restarts), the transfer counters,
+    and device memory — all refreshed at scrape/snapshot time, zero per-step
+    cost."""
+    registry = registry if registry is not None else get_registry()
+    if getattr(registry, "_at_default_collectors", False):
+        return
+    registry._at_default_collectors = True
+
+    def _goodput(reg: MetricsRegistry):
+        from ..resilience.goodput import BADPUT_CATEGORIES, get_ledger
+
+        summary = get_ledger().summary()
+        reg.gauge(
+            "accelerate_goodput_fraction",
+            "Fraction of wall-clock spent in productive steps",
+        ).set(summary["goodput_fraction"])
+        reg.gauge(
+            "accelerate_goodput_seconds", "Productive step wall-clock"
+        ).set(summary["productive_s"])
+        badput = reg.gauge(
+            "accelerate_badput_seconds",
+            "Wall-clock lost per badput class",
+            labelnames=("category",),
+        )
+        for category in BADPUT_CATEGORIES:
+            badput.set(summary[f"{category}_s"], category=category)
+        reg.gauge(
+            "accelerate_restarts", "Gang incarnations observed by the ledger"
+        ).set(summary["restarts"])
+
+    def _transfers(reg: MetricsRegistry):
+        from ..utils.transfer import transfer_stats
+
+        stats = transfer_stats()
+        reg.gauge(
+            "accelerate_host_fetches",
+            "Deliberate device-to-host fetches (utils/transfer.py)",
+        ).set(stats["fetches"])
+        reg.gauge(
+            "accelerate_host_fetches_blocking",
+            "Device-to-host fetches that stalled on an unmaterialized result",
+        ).set(stats["blocking"])
+
+    def _memory(reg: MetricsRegistry):
+        stats = device_memory_stats()
+        if not stats:
+            return
+        reg.gauge("accelerate_device_bytes_in_use", "Live device memory").set(
+            stats["bytes_in_use"]
+        )
+        reg.gauge("accelerate_device_peak_bytes", "Peak device memory").set(
+            stats["peak_bytes_in_use"]
+        )
+        if stats.get("bytes_limit"):
+            reg.gauge("accelerate_device_bytes_limit", "Device memory limit").set(
+                stats["bytes_limit"]
+            )
+
+    registry.register_collector(_goodput)
+    registry.register_collector(_transfers)
+    registry.register_collector(_memory)
+
+
+def start_endpoint_from_env(local_rank: int | None = None) -> "MetricsServer | None":
+    """Start the env-contract Prometheus endpoint (ACCELERATE_METRICS_PORT),
+    shared by PartialState's init install and ``get_telemetry``'s fallback so
+    the contract cannot drift between them: 0/unset = no endpoint, co-located
+    workers offset the port by their local rank (``local_rank``; defaults to
+    ACCELERATE_LOCAL_PROCESS_ID), and a bind failure degrades to a warning —
+    never a training failure. Returns the running server, or None."""
+    import logging
+
+    from ..utils.constants import ENV_METRICS_PORT
+
+    port_raw = os.environ.get(ENV_METRICS_PORT, "").strip()
+    if not port_raw:
+        return None
+    try:
+        port = int(port_raw)
+    except ValueError:
+        raise ValueError(
+            f"{ENV_METRICS_PORT}={port_raw!r} must be an integer port"
+        ) from None
+    if port <= 0:
+        # Env contract: 0 = no HTTP endpoint (the registry still feeds
+        # trackers). Ephemeral-port binding is the explicit-API path
+        # (Telemetry(metrics_port=0)), never the env's.
+        return None
+    install_default_collectors()
+    if local_rank is None:
+        local_rank = int(os.environ.get("ACCELERATE_LOCAL_PROCESS_ID", "0") or 0)
+    if local_rank:
+        port += local_rank
+    try:
+        return start_default_server(port)
+    except (OSError, OverflowError) as exc:
+        # OverflowError: the local-rank offset pushed past 65535 — same
+        # degradation as an in-use port.
+        logging.getLogger(__name__).warning(
+            "metrics endpoint could not bind port %s (%s); continuing without "
+            "the HTTP exposition (the registry still feeds trackers).",
+            port, exc,
+        )
+        return None
+
+
+class Telemetry:
+    """Binds timeline + straggler monitor + registry (+ optional endpoint).
+
+    ``enabled=False`` turns every hook into a no-op (ACCELERATE_TELEMETRY=0).
+    ``metrics_port`` starts the process-wide Prometheus endpoint (0 binds an
+    ephemeral port; None leaves HTTP off — the registry still feeds trackers).
+    A custom ``registry`` scopes the timeline/straggler series only (tests);
+    the framework-wide publishers (health guard, optimizer, data loader,
+    serving, spans) always target the global ``get_registry()``.
+    """
+
+    def __init__(
+        self,
+        enabled: bool = True,
+        timeline: StepTimeline | None = None,
+        straggler: StragglerMonitor | None = None,
+        straggler_every: int = 50,
+        straggler_threshold: float = 1.5,
+        metrics_port: int | None = None,
+        registry: MetricsRegistry | None = None,
+    ):
+        self.enabled = bool(enabled)
+        self.registry = registry if registry is not None else get_registry()
+        install_default_collectors(self.registry)
+        self.timeline = timeline or StepTimeline(registry=self.registry)
+        self.straggler = straggler or StragglerMonitor(
+            every_steps=straggler_every,
+            slow_ratio=straggler_threshold,
+            registry=self.registry,
+        )
+        self.server: MetricsServer | None = None
+        if metrics_port is not None:
+            self.server = start_default_server(int(metrics_port), registry=self.registry)
+        self._seen_timeline_n = 0
+        self._last_hook_step = None
+
+    # -------------------------------------------------------------- per-step
+    def on_step(self, step: int, tokens: int | None = None, loss=None,
+                state=None) -> None:
+        """Per-step hook (``guard_step``/``checkpoint_on_preemption`` call it).
+        Records a timeline sample unless the fused path already did since the
+        last hook; repeated hooks at one step (a loop calling both) count
+        once. Drives the periodic straggler exchange when ``state`` is given —
+        that exchange is a collective, so hooks must stay SPMD-aligned."""
+        if not self.enabled:
+            return
+        step = int(step)
+        if self.timeline.boundaries < self._seen_timeline_n:
+            # The timeline was reset (bench.py does this per config): the
+            # dedupe watermarks are from the old window and would silently
+            # swallow the new window's first samples.
+            self._seen_timeline_n = 0
+            self._last_hook_step = None
+        if step != self._last_hook_step:
+            if self.timeline.boundaries == self._seen_timeline_n:
+                self.timeline.step_end(step=step, tokens=tokens, loss=loss)
+            self._seen_timeline_n = self.timeline.boundaries
+            self._last_hook_step = step
+        if state is not None and self.straggler.due(step):
+            window_s, window_steps = self.timeline.take_window()
+            if window_steps:
+                self.straggler.report(state, window_s / window_steps, step=step)
+
+    def on_fused_step(self, tokens: int | None = None, loss=None) -> None:
+        """Fed by ``build_train_step``'s compiled step — one call per
+        microbatch dispatch, host-side cost of a clock read."""
+        if not self.enabled:
+            return
+        self.timeline.step_end(tokens=tokens, loss=loss)
+
+    # --------------------------------------------------------------- reading
+    def summary(self) -> dict:
+        out = {"timeline": self.timeline.summary()}
+        if self.straggler.last_report is not None:
+            out["straggler"] = self.straggler.last_report.to_dict()
+        return out
+
+    def close(self):
+        if self.server is not None:
+            stop_default_server()
+            self.server = None
+
+
+_DEFAULT: Telemetry | None = None
+
+
+def get_telemetry() -> Telemetry:
+    """The process-wide default, built from the launcher's env contract on
+    first use (ACCELERATE_TELEMETRY / ACCELERATE_METRICS_PORT /
+    ACCELERATE_STRAGGLER_THRESHOLD)."""
+    global _DEFAULT
+    if _DEFAULT is None:
+        from ..utils.constants import ENV_STRAGGLER_THRESHOLD, ENV_TELEMETRY
+
+        from .metrics import default_server
+
+        enabled = os.environ.get(ENV_TELEMETRY, "").strip().lower() not in (
+            "0", "false", "no",
+        )
+        # Threshold 0/unset = library default 1.5 (the convention the config
+        # wizard documents and prepare_launch_env's truthiness gate implies).
+        threshold_raw = os.environ.get(ENV_STRAGGLER_THRESHOLD, "").strip()
+        threshold = float(threshold_raw) if threshold_raw else 0.0
+        telemetry = Telemetry(
+            enabled=enabled,
+            straggler_threshold=threshold if threshold > 0 else 1.5,
+        )
+        # Reuse the server PartialState already installed (its port carries
+        # the co-located-worker offset — re-requesting the raw env port would
+        # warn spuriously); otherwise run the same shared env install.
+        telemetry.server = default_server() or start_endpoint_from_env()
+        _DEFAULT = telemetry
+    return _DEFAULT
+
+
+def set_telemetry(telemetry: Telemetry | None):
+    global _DEFAULT
+    _DEFAULT = telemetry
+
+
+def reset_telemetry():
+    """Drop the default instance — tests."""
+    set_telemetry(None)

@@ -1,0 +1,391 @@
+"""Process-wide metrics registry + Prometheus exposition.
+
+One registry per process that every subsystem publishes into — the goodput
+ledger's wall-clock classes, health-guard trips, resilience restarts, the data
+loader's batch counter, the optimizer's applied/skipped steps, the serving
+engine's request/token counters, and the step timeline's per-step series. Two
+export paths share it:
+
+- **pull**: ``MetricsServer`` serves the Prometheus text exposition format on
+  an opt-in HTTP port (``launch --metrics_port`` / ACCELERATE_METRICS_PORT) at
+  ``/metrics`` (plus a trivial ``/healthz``), so a pod's hosts can be scraped
+  like any other fleet service;
+- **push**: ``MetricsRegistry.snapshot()`` flattens the same series into a
+  dict ``Accelerator.log_telemetry()`` hands to the tracker stack
+  (JSONTracker et al.), so runs without a scraper still persist the series.
+
+Publishers push eagerly (a counter ``inc`` under one short lock); sources that
+are cheaper to read than to track — the goodput ledger, the transfer
+counters, device memory stats — register *collectors* instead, callables the
+registry invokes right before each scrape/snapshot so exported gauges are
+always current without any per-step work.
+
+This module deliberately imports nothing from the rest of the framework so
+any layer (state, optimizer, serving, data loader) can publish without import
+cycles.
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+_DEFAULT_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0,
+)
+
+
+def _escape_label(value) -> str:
+    return str(value).replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
+
+
+def _series_suffix(labelnames, labelvalues) -> str:
+    if not labelnames:
+        return ""
+    inner = ",".join(
+        f'{k}="{_escape_label(v)}"' for k, v in zip(labelnames, labelvalues)
+    )
+    return "{" + inner + "}"
+
+
+class _Metric:
+    """One named metric holding a family of label-keyed series."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str, labelnames, lock):
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._lock = lock
+        self._series: dict = {}
+
+    def _key(self, labels: dict) -> tuple:
+        if set(labels) != set(self.labelnames):
+            raise ValueError(
+                f"metric {self.name!r} takes labels {self.labelnames}, got "
+                f"{tuple(labels)}"
+            )
+        return tuple(str(labels[k]) for k in self.labelnames)
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return float(self._series.get(self._key(labels), 0.0))
+
+    def expose(self) -> list[str]:
+        lines = []
+        if self.help:
+            lines.append(f"# HELP {self.name} {self.help}")
+        lines.append(f"# TYPE {self.name} {self.kind}")
+        with self._lock:
+            for key in sorted(self._series):
+                lines.append(
+                    f"{self.name}{_series_suffix(self.labelnames, key)} "
+                    f"{self._series[key]}"
+                )
+        return lines
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                f"{self.name}{_series_suffix(self.labelnames, key)}": float(v)
+                for key, v in self._series.items()
+            }
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, amount: float = 1.0, **labels):
+        if amount < 0:
+            raise ValueError(f"counter {self.name} cannot decrease (inc {amount})")
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + float(amount)
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, value: float, **labels):
+        with self._lock:
+            self._series[self._key(labels)] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels):
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + float(amount)
+
+    def dec(self, amount: float = 1.0, **labels):
+        self.inc(-amount, **labels)
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name, help, labelnames, lock, buckets=None):
+        super().__init__(name, help, labelnames, lock)
+        self.buckets = tuple(sorted(buckets or _DEFAULT_BUCKETS))
+
+    def observe(self, value: float, **labels):
+        key = self._key(labels)
+        value = float(value)
+        with self._lock:
+            state = self._series.get(key)
+            if state is None:
+                state = self._series[key] = [[0] * len(self.buckets), 0.0, 0]
+            counts, _, _ = state
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+            state[1] += value
+            state[2] += 1
+
+    def value(self, **labels):
+        """(sum, count) of the series — histograms have no single value."""
+        with self._lock:
+            state = self._series.get(self._key(labels))
+            return (state[1], state[2]) if state else (0.0, 0)
+
+    def expose(self) -> list[str]:
+        lines = []
+        if self.help:
+            lines.append(f"# HELP {self.name} {self.help}")
+        lines.append(f"# TYPE {self.name} {self.kind}")
+        with self._lock:
+            for key in sorted(self._series):
+                counts, total, n = self._series[key]
+                # observe() fills every bucket the value fits in, so counts
+                # are already cumulative — the exposition's le-semantics.
+                for b, c in zip(self.buckets, counts):
+                    suffix = _series_suffix(self.labelnames + ("le",), key + (b,))
+                    lines.append(f"{self.name}_bucket{suffix} {c}")
+                inf = _series_suffix(self.labelnames + ("le",), key + ("+Inf",))
+                lines.append(f"{self.name}_bucket{inf} {n}")
+                tail = _series_suffix(self.labelnames, key)
+                lines.append(f"{self.name}_sum{tail} {total}")
+                lines.append(f"{self.name}_count{tail} {n}")
+        return lines
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = {}
+            for key, (_, total, n) in self._series.items():
+                tail = _series_suffix(self.labelnames, key)
+                out[f"{self.name}_sum{tail}"] = float(total)
+                out[f"{self.name}_count{tail}"] = float(n)
+            return out
+
+
+class MetricsRegistry:
+    """Get-or-create metric families; see module docstring."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._metrics: dict[str, _Metric] = {}
+        self._collectors: list = []
+
+    def _get_or_make(self, cls, name, help, labelnames, **kwargs):
+        with self._lock:
+            metric = self._metrics.get(name)
+            if metric is None:
+                metric = self._metrics[name] = cls(
+                    name, help, labelnames, self._lock, **kwargs
+                )
+                return metric
+            if not isinstance(metric, cls) or metric.labelnames != tuple(labelnames):
+                raise ValueError(
+                    f"metric {name!r} already registered as {metric.kind} with "
+                    f"labels {metric.labelnames}"
+                )
+            return metric
+
+    def counter(self, name: str, help: str = "", labelnames=()) -> Counter:
+        return self._get_or_make(Counter, name, help, labelnames)
+
+    def gauge(self, name: str, help: str = "", labelnames=()) -> Gauge:
+        return self._get_or_make(Gauge, name, help, labelnames)
+
+    def histogram(self, name: str, help: str = "", labelnames=(), buckets=None) -> Histogram:
+        return self._get_or_make(Histogram, name, help, labelnames, buckets=buckets)
+
+    # ------------------------------------------------------------- collectors
+    def register_collector(self, fn):
+        """``fn(registry)`` runs before every scrape/snapshot; refresh gauges
+        from sources that are cheaper to read than to track per event."""
+        with self._lock:
+            if fn not in self._collectors:
+                self._collectors.append(fn)
+
+    def collect(self):
+        with self._lock:
+            collectors = list(self._collectors)
+        for fn in collectors:
+            try:
+                fn(self)
+            except Exception:  # a broken collector must not poison the scrape
+                pass
+
+    # ---------------------------------------------------------------- exports
+    def prometheus_text(self) -> str:
+        self.collect()
+        with self._lock:
+            metrics = [self._metrics[name] for name in sorted(self._metrics)]
+        lines = []
+        for metric in metrics:
+            lines.extend(metric.expose())
+        return "\n".join(lines) + "\n"
+
+    def snapshot(self) -> dict:
+        """Flat ``{"name{label=\"v\"}": value}`` dict for the tracker stack."""
+        self.collect()
+        with self._lock:
+            metrics = list(self._metrics.values())
+        out = {}
+        for metric in metrics:
+            out.update(metric.snapshot())
+        return out
+
+    def reset(self):
+        """Drop every metric and collector — tests only."""
+        global _RESET_GENERATION
+        with self._lock:
+            self._metrics.clear()
+            self._collectors.clear()
+            # Let telemetry's install_default_collectors() re-register after
+            # a reset (it guards on this marker attribute)...
+            vars(self).pop("_at_default_collectors", None)
+            # ...and invalidate every module-cached publisher handle (data
+            # loader, optimizer, serving, spans) so they re-resolve against
+            # the live registry instead of incrementing orphaned metrics.
+            _RESET_GENERATION += 1
+
+
+_REGISTRY = MetricsRegistry()
+_RESET_GENERATION = 0
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-wide registry every subsystem publishes into."""
+    return _REGISTRY
+
+
+def reset_generation() -> int:
+    """Bumped by :meth:`MetricsRegistry.reset` — hot-path publishers cache
+    their metric handles keyed on this so a reset rebuilds them instead of
+    leaving increments on orphaned (unexported) objects."""
+    return _RESET_GENERATION
+
+
+def cached_handles(factory):
+    """The hoisted-handle discipline for hot-path publishers, in one place:
+    returns a zero-arg accessor memoizing ``factory(get_registry())`` keyed on
+    :func:`reset_generation`, so the hot path pays only the cached-handle use
+    while a registry reset transparently rebuilds."""
+    state = [None]  # (generation, handles)
+
+    def get():
+        cached = state[0]
+        if cached is None or cached[0] != _RESET_GENERATION:
+            cached = state[0] = (_RESET_GENERATION, factory(get_registry()))
+        return cached[1]
+
+    return get
+
+
+# ---------------------------------------------------------------- HTTP server
+class _MetricsHandler(BaseHTTPRequestHandler):
+    registry: MetricsRegistry = None
+
+    def do_GET(self):  # noqa: N802 (http.server contract)
+        if self.path.split("?")[0] in ("/metrics", "/metrics/"):
+            body = self.registry.prometheus_text().encode()
+            ctype = "text/plain; version=0.0.4; charset=utf-8"
+        elif self.path.split("?")[0] in ("/", "/healthz"):
+            body, ctype = b"ok\n", "text/plain"
+        else:
+            self.send_error(404)
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):  # scrapes must not spam stderr
+        pass
+
+
+class MetricsServer:
+    """Background Prometheus endpoint. ``port=0`` binds an ephemeral port
+    (tests); ``start()`` returns the bound port."""
+
+    def __init__(self, port: int, registry: MetricsRegistry | None = None,
+                 host: str = "0.0.0.0"):
+        self.registry = registry or get_registry()
+        self._host = host
+        self._requested_port = int(port)
+        self._httpd = None
+        self._thread = None
+
+    @property
+    def port(self) -> int | None:
+        return self._httpd.server_address[1] if self._httpd is not None else None
+
+    def start(self) -> int:
+        if self._httpd is not None:
+            return self.port
+        handler = type("Handler", (_MetricsHandler,), {"registry": self.registry})
+        self._httpd = ThreadingHTTPServer((self._host, self._requested_port), handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="at-metrics-server", daemon=True
+        )
+        self._thread.start()
+        return self.port
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+            self._thread = None
+
+
+_SERVER: MetricsServer | None = None
+
+
+def default_server() -> MetricsServer | None:
+    """The running process-wide endpoint, if any (started by PartialState's
+    env install or an earlier start_default_server)."""
+    return _SERVER
+
+
+def start_default_server(port: int, registry: MetricsRegistry | None = None) -> MetricsServer:
+    """Idempotent process-wide endpoint: the first caller binds, later callers
+    get the running server (a port mismatch is logged, not fatal — PartialState
+    and an explicit Telemetry config may both ask)."""
+    global _SERVER
+    if _SERVER is not None:
+        if port not in (_SERVER._requested_port, _SERVER.port):
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "metrics server already listening on port %s; ignoring request "
+                "for port %s", _SERVER.port, port,
+            )
+        return _SERVER
+    server = MetricsServer(port, registry=registry)
+    # Publish the global only after a successful bind: a failed start must
+    # not leave a zombie server that every later caller "reuses".
+    server.start()
+    _SERVER = server
+    return _SERVER
+
+
+def stop_default_server():
+    global _SERVER
+    if _SERVER is not None:
+        _SERVER.stop()
+        _SERVER = None

@@ -1,0 +1,132 @@
+"""Nestable host-side spans with XLA-trace name parity.
+
+``span("data_load")`` times a block of host code into a lock-free ring buffer
+AND enters a ``jax.profiler.TraceAnnotation`` of the same name, so the label a
+user (or the framework — prepare/train_step/checkpoint/gather are
+pre-instrumented) sees in the step timeline is the label they find in a
+captured XLA/perfetto trace. Spans nest; each record carries its depth and its
+``outer/inner`` path.
+
+The ring is a fixed-size slot array indexed by an ``itertools.count`` — the
+one CPython-atomic primitive that makes concurrent pushes (orbax background
+writers, the serving loop, the train thread) safe without a lock on the hot
+path. A full ring overwrites the oldest records; ``total`` keeps counting so
+wraparound is observable.
+
+Span durations also land in the shared metrics registry as the
+``accelerate_span_seconds{name=...}`` histogram, so the Prometheus endpoint
+answers "where does the wall-clock go" without a trace capture.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass
+
+try:  # host-side runtime trace annotation; absent on exotic builds
+    from jax.profiler import TraceAnnotation as _TraceAnnotation
+except Exception:  # pragma: no cover
+    _TraceAnnotation = None
+
+
+@dataclass
+class SpanRecord:
+    name: str
+    start_s: float  # time.perf_counter() at entry
+    duration_s: float
+    depth: int  # 0 = top-level
+    path: str  # "outer/inner"
+
+
+class SpanRing:
+    """Fixed-capacity overwrite-oldest span store; push is lock-free.
+
+    Each slot stores ``(index, record)`` where the index comes from one
+    ``itertools.count`` draw — the CPython-atomic primitive — and ordering /
+    ``total`` are DERIVED from the stored indices at read time. There is no
+    separate length bookkeeping a concurrent pusher could regress (the
+    read-modify-write that a plain ``self._n = i + 1`` hides)."""
+
+    def __init__(self, capacity: int = 4096):
+        if capacity <= 0:
+            raise ValueError(f"capacity must be positive, got {capacity}")
+        self.capacity = capacity
+        self._slots = [None] * capacity
+        self._ctr = itertools.count()
+
+    def push(self, record: SpanRecord):
+        i = next(self._ctr)  # atomic under the GIL: unique slot per push
+        self._slots[i % self.capacity] = (i, record)
+
+    @property
+    def total(self) -> int:
+        """Spans ever pushed (keeps growing after wraparound)."""
+        return max((s[0] for s in self._slots if s is not None), default=-1) + 1
+
+    def snapshot(self) -> list[SpanRecord]:
+        """The retained records, oldest first."""
+        kept = sorted((s for s in self._slots if s is not None), key=lambda s: s[0])
+        return [record for _, record in kept]
+
+    def clear(self):
+        self._slots = [None] * self.capacity
+        self._ctr = itertools.count()
+
+
+_RING = SpanRing()
+_tls = threading.local()
+_SPAN_HIST = None
+
+
+def get_span_ring() -> SpanRing:
+    return _RING
+
+
+def reset_spans():
+    _RING.clear()
+
+
+def _span_hist():
+    global _SPAN_HIST
+    if _SPAN_HIST is None:
+        from .metrics import cached_handles
+
+        _SPAN_HIST = cached_handles(lambda registry: registry.histogram(
+            "accelerate_span_seconds",
+            "Host wall-clock of instrumented spans",
+            labelnames=("name",),
+        ))
+    return _SPAN_HIST()
+
+
+@contextmanager
+def span(name: str, ring: SpanRing | None = None, record_metric: bool = True):
+    """Time a block into the span ring (and the XLA trace). Nestable; safe on
+    any thread; never raises from instrumentation."""
+    ring = _RING if ring is None else ring
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    path = "/".join(stack) + "/" + name if stack else name
+    stack.append(name)
+    ann = _TraceAnnotation(name) if _TraceAnnotation is not None else None
+    if ann is not None:
+        ann.__enter__()
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        duration = time.perf_counter() - t0
+        if ann is not None:
+            ann.__exit__(None, None, None)
+        stack.pop()
+        ring.push(SpanRecord(name=name, start_s=t0, duration_s=duration,
+                             depth=len(stack), path=path))
+        if record_metric:
+            try:
+                _span_hist().observe(duration, name=name)
+            except Exception:  # pragma: no cover - instrumentation never raises
+                pass

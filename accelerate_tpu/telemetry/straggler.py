@@ -1,0 +1,161 @@
+"""Cross-host straggler detection — which host is slow?
+
+SPMD training runs the same program everywhere, so one host's slow data feed,
+thermal throttle, or flaky NIC shows up only as every OTHER host idling in
+its next collective; nothing fails and nothing logs. The monitor makes the
+skew measurable: every ``every_steps`` steps each host contributes its mean
+step wall-time over the window and the per-host vector is exchanged — one
+tiny collective over the existing machinery (the one-scalar-collective idiom
+of the preemption/health agreement; backends without multiprocess
+computations fall back to the coordination-service KV gather the same way the
+health guard does). Every host then knows min/median/max and WHICH host is
+slow, and a host exceeding ``slow_ratio`` × median raises a rate-limited log
+warning (``MultiProcessAdapter.log_every_n`` — a flapping straggler cannot
+flood a multi-thousand-step run).
+
+The exchange is a collective: every host must drive it at the same step, the
+contract all the per-step hooks (``guard_step``/``checkpoint_on_preemption``)
+already obey.
+"""
+
+from __future__ import annotations
+
+import logging
+import statistics
+from dataclasses import asdict, dataclass, field
+
+import numpy as np
+
+from ..logging import get_logger
+
+logger = get_logger(__name__)
+
+# KV namespaces must be unique per exchange AND identical across ranks
+# (utils/agreement.py contract): ranks construct monitors in the same SPMD
+# program order, so a process-wide construction counter lines up — the
+# HealthGuard _GUARD_SEQ idiom. A per-instance epoch alone would reuse
+# namespaces when a restart (or configure_telemetry) builds a fresh monitor.
+_MONITOR_SEQ = 0
+
+
+@dataclass
+class SkewReport:
+    """One straggler-exchange outcome, identical on every host."""
+
+    step: int
+    per_host_s: list = field(default_factory=list)
+    min_s: float = 0.0
+    median_s: float = 0.0
+    max_s: float = 0.0
+    slowest_host: int = 0
+    ratio: float = 1.0  # max / median
+    tripped: bool = False
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+
+class StragglerMonitor:
+    """Periodic per-host step-time aggregation; see module docstring."""
+
+    def __init__(self, every_steps: int = 50, slow_ratio: float = 1.5,
+                 registry=None):
+        if every_steps < 1:
+            raise ValueError(f"every_steps must be >= 1, got {every_steps}")
+        if slow_ratio < 1.0:
+            raise ValueError(f"slow_ratio must be >= 1.0, got {slow_ratio}")
+        from .metrics import get_registry
+
+        self.every_steps = int(every_steps)
+        self.slow_ratio = float(slow_ratio)
+        self.last_report: SkewReport | None = None
+        self._kv = False
+        self._epoch = 0
+        global _MONITOR_SEQ
+        _MONITOR_SEQ += 1
+        self._monitor_id = _MONITOR_SEQ
+        registry = registry if registry is not None else get_registry()
+        self._ratio_gauge = registry.gauge(
+            "accelerate_step_time_skew_ratio",
+            "Max/median cross-host step-time ratio from the last exchange",
+        )
+        self._slowest_gauge = registry.gauge(
+            "accelerate_slowest_host", "Process index of the slowest host"
+        )
+        self._host_gauge = registry.gauge(
+            "accelerate_host_step_seconds",
+            "Per-host mean step time from the last exchange",
+            labelnames=("host",),
+        )
+
+    def due(self, step: int) -> bool:
+        return step > 0 and step % self.every_steps == 0
+
+    # ---------------------------------------------------------------- report
+    def report(self, state, local_mean_s: float, step: int = 0) -> SkewReport | None:
+        """Exchange this host's window mean and return the agreed skew report.
+        COLLECTIVE: every process must call at the same step."""
+        if local_mean_s is None:
+            return None
+        values = self._exchange(float(local_mean_s), state)
+        median = statistics.median(values)
+        slowest = int(max(range(len(values)), key=values.__getitem__))
+        ratio = (values[slowest] / median) if median > 0 else 1.0
+        report = SkewReport(
+            step=int(step),
+            per_host_s=[round(v, 6) for v in values],
+            min_s=min(values),
+            median_s=median,
+            max_s=values[slowest],
+            slowest_host=slowest,
+            ratio=ratio,
+            tripped=len(values) > 1 and ratio > self.slow_ratio,
+        )
+        self._ratio_gauge.set(ratio)
+        self._slowest_gauge.set(slowest)
+        for host, v in enumerate(values):
+            self._host_gauge.set(v, host=host)
+        if report.tripped:
+            logger.log_every_n(
+                10,
+                logging.WARNING,
+                f"straggler: host {slowest} mean step time "
+                f"{values[slowest] * 1e3:.1f}ms is {ratio:.2f}x the median "
+                f"{median * 1e3:.1f}ms (threshold {self.slow_ratio:.2f}x) at "
+                f"step {step}",
+            )
+        self.last_report = report
+        return report
+
+    # -------------------------------------------------------------- exchange
+    def _exchange(self, local: float, state) -> list[float]:
+        """All-hosts gather of one float: a length-num_processes one-hot vector
+        summed by a device collective; KV fallback where multiprocess
+        computations are unimplemented (the 2-process CPU harness)."""
+        n = int(getattr(state, "num_processes", 1) or 1)
+        if n <= 1:
+            return [local]
+        idx = int(getattr(state, "process_index", 0))
+        if not self._kv:
+            try:
+                from ..utils import operations as ops
+
+                vec = np.zeros((n,), np.float32)
+                vec[idx] = local
+                total = np.asarray(ops.reduce(vec, reduction="sum"))
+                return [float(x) for x in total]
+            except Exception as exc:
+                logger.warning(
+                    f"Device-collective straggler exchange unavailable "
+                    f"({type(exc).__name__}: {exc}); using the "
+                    "coordination-service KV gather instead."
+                )
+                self._kv = True
+        from ..utils.agreement import kv_all_gather
+
+        self._epoch += 1
+        raw = kv_all_gather(
+            repr(local), n, idx,
+            namespace=f"at_straggler/{self._monitor_id}/{self._epoch}",
+        )
+        return [float(v) for v in raw]

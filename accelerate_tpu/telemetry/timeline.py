@@ -1,0 +1,275 @@
+"""Always-on per-step timeline — what is this run doing right now?
+
+``StepTimeline`` records one sample per training step with the same
+no-forced-host-sync discipline as the health guard: the only per-step work is
+a ``perf_counter`` read, a deque append, and a couple of registry updates.
+Device scalars (the step loss) are *retained*, not fetched — they drain
+through :func:`...utils.transfer.host_fetch` only once materialized
+(``summary()`` checks ``is_ready`` first), so a telemetry-enabled loop adds
+ZERO blocking device→host transfers per step versus telemetry-off — the
+acceptance bar tests/test_telemetry.py pins with the transfer counters.
+
+A sample's wall time is the gap between consecutive step boundaries (the
+first boundary only sets the baseline — it covers trace+compile, which the
+goodput ledger already classifies). ``summary()`` folds in everything the
+"which host / which step / which resource" questions need: step-time
+quantiles, tokens/s, an achieved-MFU estimate from the model flop count
+(``set_model_flops`` — ``Accelerator.build_train_step`` wires it from
+``module.flops_per_token()``), compile events from the goodput ledger,
+deliberate device→host transfer counts (and how many blocked) from
+``utils/transfer.py``, and live/peak device memory via
+``jax.local_devices()[*].memory_stats()``.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from dataclasses import dataclass
+
+import jax
+
+from ..utils.transfer import array_is_ready, host_fetch
+
+# bf16 peak FLOPs per chip by generation (fallback: v5e) — the denominator of
+# the MFU estimate; bench.py's peak_flops_per_chip delegates here.
+_PEAK_FLOPS_BF16 = {
+    "v5 lite": 197e12,
+    "v5litepod": 197e12,
+    "v4": 275e12,
+    "v5p": 459e12,
+    "v5": 459e12,
+    "v6 lite": 918e12,
+    "v6e": 918e12,
+}
+
+
+def device_peak_flops(device=None) -> float:
+    """bf16 peak for the local chip generation (fallback: v5e)."""
+    try:
+        if device is None:
+            device = jax.devices()[0]
+        kind = device.device_kind.lower()
+    except Exception:
+        return 197e12
+    for key, val in _PEAK_FLOPS_BF16.items():
+        if key in kind:
+            return val
+    return 197e12
+
+
+def device_memory_stats() -> dict:
+    """Summed ``memory_stats()`` over local devices; {} when the backend has
+    none (CPU). A pure host call — never syncs the device stream."""
+    in_use = peak = limit = 0
+    found = False
+    for device in jax.local_devices():
+        stats_fn = getattr(device, "memory_stats", None)
+        if stats_fn is None:
+            continue
+        try:
+            stats = stats_fn() or {}
+        except Exception:
+            continue
+        if not stats:
+            continue
+        found = True
+        in_use += int(stats.get("bytes_in_use", 0))
+        peak += int(stats.get("peak_bytes_in_use", stats.get("bytes_in_use", 0)))
+        limit += int(stats.get("bytes_limit", 0))
+    if not found:
+        return {}
+    return {"bytes_in_use": in_use, "peak_bytes_in_use": peak, "bytes_limit": limit}
+
+
+def batch_token_count(batch) -> int | None:
+    """Tokens in a language-model batch (``input_ids`` element count); None
+    for batches without one — the timeline then reports step time only."""
+    if isinstance(batch, dict):
+        ids = batch.get("input_ids")
+        if ids is not None and hasattr(ids, "shape"):
+            count = 1
+            for dim in ids.shape:
+                count *= int(dim)
+            return count
+    return None
+
+
+@dataclass
+class StepSample:
+    step: int | None
+    wall_s: float
+    tokens: int | None
+
+
+def _quantile(sorted_vals, q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(int(q * len(sorted_vals)), len(sorted_vals) - 1)
+    return sorted_vals[idx]
+
+
+class StepTimeline:
+    """See module docstring. ``clock`` is injectable for deterministic tests."""
+
+    def __init__(self, capacity: int = 1024, registry=None, clock=time.perf_counter):
+        from ..utils import transfer
+        from .metrics import get_registry
+
+        self._clock = clock
+        self._registry = registry if registry is not None else get_registry()
+        self._ring: collections.deque[StepSample] = collections.deque(maxlen=capacity)
+        self._count = 0
+        self._boundaries = 0
+        self._last_end = None
+        self._last_step = None
+        self._flops_per_token = None
+        # Retained (NOT fetched) device loss scalars; drained when materialized.
+        self._pending_loss: collections.deque = collections.deque(maxlen=4)
+        self._last_loss = None
+        self._window_s = 0.0
+        self._window_steps = 0
+        self._transfer0 = transfer.transfer_stats()
+        self._steps_total = self._registry.counter(
+            "accelerate_steps_total", "Training steps observed by the timeline"
+        )
+        self._step_hist = self._registry.histogram(
+            "accelerate_step_seconds", "Wall-clock per training step"
+        )
+        self._tokens_gauge = self._registry.gauge(
+            "accelerate_tokens_per_second", "Instantaneous training throughput"
+        )
+        self._mfu_gauge = self._registry.gauge(
+            "accelerate_mfu_estimate", "Achieved model-FLOPs utilization estimate"
+        )
+
+    # ------------------------------------------------------------- configure
+    def set_model_flops(self, flops_per_token: float):
+        """Forward+backward FLOPs per token — enables the MFU estimate."""
+        self._flops_per_token = float(flops_per_token) if flops_per_token else None
+
+    @property
+    def count(self) -> int:
+        """Completed step samples (the first boundary is baseline only)."""
+        return self._count
+
+    @property
+    def boundaries(self) -> int:
+        """Every ``step_end`` call, INCLUDING the baseline — what the hook
+        dedupe compares, so a fused baseline still marks the step covered."""
+        return self._boundaries
+
+    @property
+    def last_wall_s(self) -> float | None:
+        return self._ring[-1].wall_s if self._ring else None
+
+    # ------------------------------------------------------------- recording
+    def step_end(self, step: int | None = None, tokens: int | None = None,
+                 loss=None) -> float | None:
+        """Mark a step boundary; returns this step's wall time (None on the
+        baseline call). ``loss`` may be an in-flight device scalar — it is
+        retained, never fetched here."""
+        now = self._clock()
+        wall = None
+        self._boundaries += 1
+        if self._last_end is not None:
+            wall = now - self._last_end
+            self._count += 1
+            self._ring.append(StepSample(step=step, wall_s=wall, tokens=tokens))
+            self._window_s += wall
+            self._window_steps += 1
+            self._steps_total.inc()
+            self._step_hist.observe(wall)
+            if tokens and wall > 0:
+                tps = tokens / wall
+                self._tokens_gauge.set(tps)
+                if self._flops_per_token:
+                    self._mfu_gauge.set(
+                        tps * self._flops_per_token
+                        / (device_peak_flops() * jax.device_count())
+                    )
+        self._last_end = now
+        self._last_step = step if step is not None else self._last_step
+        if loss is not None:
+            self._pending_loss.append(loss)
+        return wall
+
+    def _drain_loss(self):
+        """Fetch retained losses whose results have materialized (a counted
+        copy via host_fetch, never a stall); unready ones stay queued."""
+        while self._pending_loss:
+            head = self._pending_loss[0]
+            if not array_is_ready(head):
+                break
+            self._pending_loss.popleft()
+            try:
+                self._last_loss = float(host_fetch(head))
+            except Exception:
+                self._last_loss = None
+
+    def take_window(self) -> tuple[float, int]:
+        """(seconds, steps) accumulated since the last take — the straggler
+        monitor's per-report window."""
+        out = (self._window_s, self._window_steps)
+        self._window_s, self._window_steps = 0.0, 0
+        return out
+
+    # --------------------------------------------------------------- reading
+    def summary(self) -> dict:
+        """The step-timeline schema (docs/observability.md); also embedded in
+        bench.py's per-config JSON lines as ``detail.telemetry``."""
+        from ..resilience.goodput import get_ledger
+        from ..utils import transfer
+
+        samples = list(self._ring)
+        walls = sorted(s.wall_s for s in samples)
+        token_samples = [s for s in samples if s.tokens]
+        tok_time = sum(s.wall_s for s in token_samples)
+        tokens_per_s = (
+            sum(s.tokens for s in token_samples) / tok_time if tok_time > 0 else None
+        )
+        mfu = None
+        if tokens_per_s is not None and self._flops_per_token:
+            mfu = (
+                tokens_per_s * self._flops_per_token
+                / (device_peak_flops() * jax.device_count())
+            )
+        self._drain_loss()
+        now_stats = transfer.transfer_stats()
+        ledger = get_ledger()
+        out = {
+            "steps": self._count,
+            "last_step": self._last_step,
+            "step_s": {
+                "mean": sum(walls) / len(walls) if walls else 0.0,
+                "p50": _quantile(walls, 0.50),
+                "p90": _quantile(walls, 0.90),
+                "max": walls[-1] if walls else 0.0,
+            },
+            "tokens_per_s": tokens_per_s,
+            "mfu_estimate": mfu,
+            "last_loss": self._last_loss,
+            "compile": {
+                "count": ledger.counts.get("compile", 0),
+                "seconds": round(ledger.seconds.get("compile", 0.0), 3),
+            },
+            "transfers": {
+                "fetches": now_stats["fetches"] - self._transfer0["fetches"],
+                "blocking": now_stats["blocking"] - self._transfer0["blocking"],
+            },
+            "memory": device_memory_stats(),
+        }
+        return out
+
+    def reset(self):
+        from ..utils import transfer
+
+        self._ring.clear()
+        self._count = 0
+        self._boundaries = 0
+        self._last_end = None
+        self._last_step = None
+        self._pending_loss.clear()
+        self._last_loss = None
+        self._window_s, self._window_steps = 0.0, 0
+        self._transfer0 = transfer.transfer_stats()

@@ -1,0 +1,50 @@
+"""Multi-host straggler drill, run under the real 2-process launcher::
+
+    accelerate-tpu launch --cpu --num_processes 2 -m \
+        accelerate_tpu.test_utils.straggler_script
+
+Proves the property ``tests/test_telemetry.py`` pins: when one rank is slow,
+EVERY rank's straggler exchange identifies the same slow rank by index, with
+the same per-host vector and skew ratio. Per-host step times are synthetic
+(rank 1 is deterministically 5x slower) so the assertion is exact; the
+exchange itself is real — on CPU backends the XLA runtime refuses
+multiprocess computations, so this drill exercises exactly the
+coordination-service KV fallback the monitor must degrade to (the
+device-collective path stays covered by the single-process tests).
+"""
+
+from __future__ import annotations
+
+from accelerate_tpu import PartialState
+from accelerate_tpu.telemetry import StragglerMonitor
+
+FAST_S, SLOW_S, SLOW_RANK = 0.010, 0.050, 1
+
+
+def main():
+    state = PartialState()
+    assert state.num_processes >= 2, "run under `launch --num_processes 2`"
+
+    monitor = StragglerMonitor(every_steps=4, slow_ratio=1.3)
+    local_mean = SLOW_S if state.process_index == SLOW_RANK else FAST_S
+    assert not monitor.due(3) and monitor.due(4)
+
+    report = monitor.report(state, local_mean, step=4)
+    assert report is not None
+    assert report.slowest_host == SLOW_RANK, report
+    assert report.tripped, report
+    assert abs(report.max_s - SLOW_S) < 1e-9 and abs(report.min_s - FAST_S) < 1e-9, report
+    assert report.ratio > 1.3, report
+
+    # A second exchange must agree too (fresh KV namespace per epoch).
+    report2 = monitor.report(state, local_mean, step=8)
+    assert report2.slowest_host == SLOW_RANK and report2.per_host_s == report.per_host_s
+
+    print(
+        f"STRAGGLER_OK rank={state.process_index} slowest={report.slowest_host} "
+        f"ratio={report.ratio:.3f}"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -14,26 +14,28 @@ contract these exchanges exist to protect).
 from __future__ import annotations
 
 
-def kv_or_exchange(
-    local_flags: int,
+def kv_all_gather(
+    value: str,
     num_processes: int,
     process_index: int,
     namespace: str,
     timeout_ms: int = 120_000,
-) -> int:
-    """OR of every rank's ``local_flags`` via the coordination-service KV
-    store; returns ``local_flags`` unchanged when no distributed client is up
-    (single-process, or tests faking a state object)."""
+) -> list[str]:
+    """All-ranks gather of one string via the coordination-service KV store;
+    returns ``[value]`` unchanged when no distributed client is up
+    (single-process, or tests faking a state object). The generic transport
+    under :func:`kv_or_exchange` and the telemetry straggler exchange."""
     from jax._src.distributed import global_state as dist_state
 
     client = dist_state.client
     if client is None:
-        return int(local_flags)
-    client.key_value_set(f"{namespace}/{process_index}", str(int(local_flags)))
+        return [value]
+    client.key_value_set(f"{namespace}/{process_index}", value)
     client.wait_at_barrier(f"{namespace}/barrier", timeout_ms)
-    agreed = 0
-    for rank in range(num_processes):
-        agreed |= int(client.blocking_key_value_get(f"{namespace}/{rank}", timeout_ms))
+    gathered = [
+        client.blocking_key_value_get(f"{namespace}/{rank}", timeout_ms)
+        for rank in range(num_processes)
+    ]
     # Namespaces are single-use, and the fallback path runs once per step:
     # without cleanup the coordinator accrues num_processes keys per exchange
     # for the life of the job. The second barrier keeps rank 0's directory
@@ -44,4 +46,22 @@ def kv_or_exchange(
             client.key_value_delete(namespace)
         except Exception:
             pass  # cleanup is best-effort; correctness never depends on it
+    return gathered
+
+
+def kv_or_exchange(
+    local_flags: int,
+    num_processes: int,
+    process_index: int,
+    namespace: str,
+    timeout_ms: int = 120_000,
+) -> int:
+    """OR of every rank's ``local_flags`` via the coordination-service KV
+    store; returns ``local_flags`` unchanged when no distributed client is up
+    (single-process, or tests faking a state object)."""
+    agreed = 0
+    for word in kv_all_gather(
+        str(int(local_flags)), num_processes, process_index, namespace, timeout_ms
+    ):
+        agreed |= int(word)
     return agreed

@@ -55,6 +55,14 @@ ENV_RESTART_ATTEMPT = "ACCELERATE_RESTART_ATTEMPT"
 ENV_GUARD_NUMERICS = "ACCELERATE_GUARD_NUMERICS"
 ENV_SPIKE_ZSCORE = "ACCELERATE_SPIKE_ZSCORE"
 ENV_HANG_TIMEOUT = "ACCELERATE_HANG_TIMEOUT"
+# Telemetry contract (telemetry/): the always-on step timeline + span ring
+# ("0" disables the per-step hooks), the opt-in Prometheus endpoint's port
+# (empty or 0 = no HTTP server; the registry still feeds the tracker stack),
+# and the straggler monitor's slowness ratio (a host slower than threshold ×
+# the cross-host median step time raises a rate-limited warning).
+ENV_TELEMETRY = "ACCELERATE_TELEMETRY"
+ENV_METRICS_PORT = "ACCELERATE_METRICS_PORT"
+ENV_STRAGGLER_THRESHOLD = "ACCELERATE_STRAGGLER_THRESHOLD"
 
 # ``dcn`` is the slice axis of a multi-slice pod: replicas connected by
 # data-center network rather than ICI. It is outermost so only the axes meant

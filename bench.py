@@ -24,24 +24,20 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import numpy as np
 
 
+# JSON-line schema version: bump when the line's structure changes so the
+# BENCH_*.json trajectory stays machine-comparable as the detail payload
+# grows. v2 = schema_version field + detail.telemetry timeline summary.
+BENCH_SCHEMA_VERSION = 2
+
+
 def peak_flops_per_chip() -> float:
-    """bf16 peak for the local chip generation (fallback: v5e)."""
+    """bf16 peak for the local chip generation (fallback: v5e) — the shared
+    table in telemetry/timeline.py, which the MFU gauge also uses."""
     import jax
 
-    kind = jax.devices()[0].device_kind.lower()
-    table = {
-        "v5 lite": 197e12,  # v5e bf16
-        "v5litepod": 197e12,
-        "v4": 275e12,
-        "v5p": 459e12,
-        "v5": 459e12,
-        "v6 lite": 918e12,  # trillium
-        "v6e": 918e12,
-    }
-    for key, val in table.items():
-        if key in kind:
-            return val
-    return 197e12
+    from accelerate_tpu.telemetry.timeline import device_peak_flops
+
+    return device_peak_flops(jax.devices()[0])
 
 
 def resolve_backend() -> str:
@@ -293,6 +289,7 @@ def run_one(mode: str):
     ledger.reset()  # fresh goodput window per config
 
     accelerator = Accelerator(mixed_precision="bf16")
+    accelerator.telemetry.timeline.reset()  # fresh step-timeline window too
     if mode == "moe":
         from accelerate_tpu.models import MoELlama
 
@@ -357,6 +354,11 @@ def run_one(mode: str):
         flops_per_token = 6 * n_params + attn_flops
     mfu = tokens_per_sec * flops_per_token / (peak_flops_per_chip() * jax.device_count())
 
+    # Telemetry (telemetry/): the fused step fed the per-step timeline; its
+    # summary rides each config's JSON line so step-time quantiles, transfer
+    # counts, and memory travel with the MFU headline.
+    telemetry_summary = accelerator.telemetry.timeline.summary()
+
     print(
         json.dumps(
             {
@@ -364,6 +366,7 @@ def run_one(mode: str):
                 "value": round(float(mfu), 4),
                 "unit": "fraction_of_peak_bf16",
                 "vs_baseline": round(float(mfu) / 0.45, 4),
+                "schema_version": BENCH_SCHEMA_VERSION,
                 "detail": {
                     "steps_per_sec": round(steps_per_sec, 3),
                     "tokens_per_sec": round(tokens_per_sec, 1),
@@ -389,6 +392,7 @@ def run_one(mode: str):
                     # other_s by design.
                     "goodput": ledger.summary(),
                     "health": {"finite_final_loss": finite_loss},
+                    "telemetry": telemetry_summary,
                     **(
                         {"compile_cache": os.environ["ACCELERATE_COMPILE_CACHE_DIR"]}
                         if os.environ.get("ACCELERATE_COMPILE_CACHE_DIR")
@@ -442,6 +446,7 @@ def _print_failure(mode: str, exc: Exception):
                 "value": 0.0,
                 "unit": "fraction_of_peak_bf16",
                 "vs_baseline": 0.0,
+                "schema_version": BENCH_SCHEMA_VERSION,
                 "detail": {"error": f"{type(exc).__name__}: {exc}"[:500]},
             }
         )

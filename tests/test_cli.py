@@ -261,6 +261,10 @@ def test_config_wizard_roundtrips_through_launch(tmp_path):
         "yes",               # numerics sentinel
         "7.0",               # spike z-score threshold
         "240",               # hang watchdog timeout (s)
+        "yes",               # configure observability?
+        "yes",               # always-on telemetry
+        "0",                 # metrics port (0 = no HTTP endpoint)
+        "1.8",               # straggler alert ratio
         "yes",               # configure tracking?
         "json",              # trackers
         "yes",               # persistent compilation cache?
@@ -274,6 +278,8 @@ def test_config_wizard_roundtrips_through_launch(tmp_path):
     assert cfg.checkpoint_total_limit == 3 and cfg.checkpoint_auto_naming
     assert cfg.handle_preemption
     assert cfg.guard_numerics and cfg.spike_zscore == 7.0 and cfg.hang_timeout == 240.0
+    assert cfg.telemetry is True and cfg.metrics_port == 0
+    assert cfg.straggler_threshold == 1.8
     assert cfg.compile_cache_dir == str(tmp_path / "xla_cache")
     config_path = tmp_path / "cfg.yaml"
     cfg.to_yaml_file(str(config_path))
@@ -295,6 +301,9 @@ def test_config_wizard_roundtrips_through_launch(tmp_path):
         "from accelerate_tpu.resilience.preemption import get_default_watcher\n"
         "assert get_default_watcher(install=False)._prev_handlers is not None\n"
         "assert os.environ.get('ACCELERATE_GUARD_NUMERICS') == '1'\n"
+        "assert os.environ.get('ACCELERATE_TELEMETRY') == '1'\n"
+        "assert os.environ.get('ACCELERATE_STRAGGLER_THRESHOLD') == '1.8'\n"
+        "assert acc.telemetry.straggler.slow_ratio == 1.8\n"
         "assert os.environ.get('ACCELERATE_SPIKE_ZSCORE') == '7.0'\n"
         "assert acc.health_guard.spike.zscore == 7.0\n"
         "from accelerate_tpu.health.hang import get_default_watchdog\n"
