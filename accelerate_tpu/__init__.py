@@ -63,6 +63,10 @@ def __getattr__(name):
         from .data_loader import skip_first_batches
 
         return skip_first_batches
+    if name == "DeviceBatchPrefetcher":
+        from .data_loader import DeviceBatchPrefetcher
+
+        return DeviceBatchPrefetcher
     if name == "prepare_pippy":
         from .inference import prepare_pippy
 

@@ -449,6 +449,7 @@ class Accelerator:
         self._offload_opt_state = bool(fsdp_plugin.cpu_offload) if fsdp_plugin is not None else False
         self.step = 0
         self.flag_tensor = None
+        self._train_window = None  # lazy: ACCELERATE_TRAIN_WINDOW, then 1
         self._resilience_step = 0
         self._preemption_watcher = None
         self._health_guard = None
@@ -539,6 +540,35 @@ class Accelerator:
         self.gradient_state.plugin_kwargs.update({"num_steps": value})
 
     @property
+    def train_window(self) -> int:
+        """Dispatch-amortization window K: how many full train steps
+        ``build_train_window`` fuses into ONE compiled program (1 = one
+        dispatch per step, the ``build_train_step`` shape). Default comes from
+        the launcher contract (``--train_window`` → ACCELERATE_TRAIN_WINDOW),
+        else 1; ``build_train_window(window=K)`` pins it."""
+        if self._train_window is None:
+            from .utils.constants import ENV_TRAIN_WINDOW
+
+            raw = os.environ.get(ENV_TRAIN_WINDOW, "").strip()
+            try:
+                value = int(raw) if raw else 1
+            except ValueError:
+                raise ValueError(
+                    f"{ENV_TRAIN_WINDOW}={raw!r} is not an integer"
+                ) from None
+            if value < 1:
+                raise ValueError(f"{ENV_TRAIN_WINDOW} must be >= 1, got {value}")
+            self._train_window = value
+        return self._train_window
+
+    @train_window.setter
+    def train_window(self, value):
+        value = int(value)
+        if value < 1:
+            raise ValueError(f"train_window must be >= 1, got {value}")
+        self._train_window = value
+
+    @property
     def fp8_backend(self):
         """Which low-precision backend serves ``mixed_precision='fp8'`` (reference
         ``fp8_backend`` property :3939-3952): "INT8" (QAT matmuls) or "BF16"
@@ -599,6 +629,11 @@ class Accelerator:
 
     def _place_batch(self, batch):
         """Ensure host arrays in a forward call are global mesh arrays."""
+        return self._place_with(batch, make_global_batch)
+
+    def _place_with(self, batch, placer):
+        """Host ndarray leaves → ``placer(x, mesh)``; device-resident leaves
+        (and non-arrays) pass through untouched."""
         if not self.device_placement:
             return batch
 
@@ -608,7 +643,7 @@ class Accelerator:
             if isinstance(x, jax.Array):
                 return x
             if isinstance(x, np.ndarray):
-                return make_global_batch(x, mesh)
+                return placer(x, mesh)
             return x
 
         return jax.tree_util.tree_map(_one, batch)
@@ -1006,20 +1041,12 @@ class Accelerator:
         )
 
     # ----------------------------------------------------------- fused step
-    def build_train_step(self, model: PreparedModel, optimizer: AcceleratedOptimizer, loss_fn=None):
-        """ONE compiled XLA program per microbatch: forward + backward + buffer
-        accumulation + (conditional) optimizer update, with params/opt-state/grad
-        buffers donated. This is the TPU-shaped hot loop — no host round-trips, no
-        retraces across accumulation boundaries (SURVEY.md §7 hard part 3).
-
-        Returns ``step(batch) -> loss`` operating on the shared handle state.
-        """
-        import optax
-
+    def _fused_value_and_grads(self, model: PreparedModel, loss_fn=None):
+        """The ``(params, batch, rng) -> (loss, grads)`` core shared by
+        ``build_train_step`` and ``build_train_window`` — one definition so
+        the 1F1B/GSPMD routing and loss contract cannot diverge between the
+        per-step and windowed programs."""
         handle = model.handle
-        optimizer._ensure_initialized()
-        accum = self.gradient_accumulation_steps
-        tx = optimizer.tx
         spec = handle.pipeline_spec
         if model._uses_1f1b():
             model._check_1f1b_loss_fn(loss_fn if loss_fn is not None else model.loss_fn)
@@ -1036,10 +1063,24 @@ class Accelerator:
             def value_and_grads(params, batch, rng):
                 return jax.value_and_grad(loss_of)(params, batch, rng)
 
-        from .utils.environment import safe_donate_argnums
+        return value_and_grads
 
-        @partial(jax.jit, donate_argnums=safe_donate_argnums((0, 1, 2, 3)))
-        def _step(params, opt_state, accum_grads, count, batch, rng, clip_norm):
+    def _fused_step_body(self, model: PreparedModel, optimizer: AcceleratedOptimizer,
+                         accum: int, loss_fn=None):
+        """``(params, opt_state, accum_grads, count, batch, rng, clip_norm) ->
+        (params, opt_state, accum_grads, count, loss)`` — the per-step math
+        both fused programs compile: forward+backward via
+        :meth:`_fused_value_and_grads`, grad accumulation at ``1/accum``
+        scale, global-norm clip, conditional ``tx.update``/apply, buffer
+        zero-reset. One definition so ``build_train_window``'s bit-exactness
+        vs K sequential ``build_train_step`` calls is structural, not
+        maintained by hand."""
+        import optax
+
+        tx = optimizer.tx
+        value_and_grads = self._fused_value_and_grads(model, loss_fn)
+
+        def step_body(params, opt_state, accum_grads, count, batch, rng, clip_norm):
             loss, grads = value_and_grads(params, batch, rng)
             accum_grads = jax.tree_util.tree_map(
                 lambda a, g: a + g / accum, accum_grads, grads
@@ -1050,9 +1091,13 @@ class Accelerator:
             def upd(operand):
                 params, opt_state, grads = operand
                 gnorm = jnp.sqrt(
-                    sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in jax.tree_util.tree_leaves(grads))
+                    sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                        for g in jax.tree_util.tree_leaves(grads))
                 )
-                factor = jnp.where((clip_norm > 0) & (gnorm > clip_norm), clip_norm / (gnorm + 1e-6), 1.0)
+                factor = jnp.where(
+                    (clip_norm > 0) & (gnorm > clip_norm),
+                    clip_norm / (gnorm + 1e-6), 1.0,
+                )
                 grads = jax.tree_util.tree_map(lambda g: g * factor, grads)
                 updates, new_opt = tx.update(grads, opt_state, params)
                 new_params = optax.apply_updates(params, updates)
@@ -1067,13 +1112,23 @@ class Accelerator:
             )
             return params, opt_state, accum_grads, count, loss
 
-        if optimizer._accum_grads is None:
-            optimizer._accum_grads = jax.tree_util.tree_map(jnp.zeros_like, handle.params)
+        return step_body
+
+    def _fused_build_prologue(self, handle, optimizer: AcceleratedOptimizer,
+                              accum: int, builder: str):
+        """Shared scaffolding for both fused builders: lazily zero the donated
+        accumulation buffer, seed the device-resident micro-step count, feed
+        the model's flop count to the timeline, and return ``(count_box,
+        check_stale_accum)`` — the stale-accumulation guard each wrapper calls
+        per dispatch. One definition so the builders' build-time contract
+        cannot drift apart."""
+        # A (re)build restarts the compiled program's accumulation state: the
+        # device micro-step count seeds at 0 below, so the buffer must start
+        # zeroed too — a partially-filled buffer left by a prior build (or by
+        # imperative backward() calls) would desynchronize the boundary and
+        # silently fold extra microbatches into the first update.
+        optimizer._accum_grads = jax.tree_util.tree_map(jnp.zeros_like, handle.params)
         count_box = [jnp.int32(0)]
-
-        from .telemetry import span
-        from .telemetry.timeline import batch_token_count
-
         # The MFU estimate needs the model's flop count; the zoo models expose
         # it, anything else leaves the timeline at tokens/s only.
         flops_fn = getattr(handle.module, "flops_per_token", None)
@@ -1083,6 +1138,45 @@ class Accelerator:
             except Exception:
                 pass
 
+        def check_stale_accum():
+            if self.gradient_accumulation_steps != accum:
+                # The compiled program bakes the accumulation scale in; a
+                # mid-run change would silently diverge from the imperative
+                # path (which reads GradientState live) — fail instead.
+                raise RuntimeError(
+                    f"gradient_accumulation_steps changed from {accum} to "
+                    f"{self.gradient_accumulation_steps} after {builder}; "
+                    f"call {builder} again to pick up the new value."
+                )
+
+        return count_box, check_stale_accum
+
+    def build_train_step(self, model: PreparedModel, optimizer: AcceleratedOptimizer, loss_fn=None):
+        """ONE compiled XLA program per microbatch: forward + backward + buffer
+        accumulation + (conditional) optimizer update, with params/opt-state/grad
+        buffers donated. This is the TPU-shaped hot loop — no host round-trips, no
+        retraces across accumulation boundaries (SURVEY.md §7 hard part 3).
+
+        Returns ``step(batch) -> loss`` operating on the shared handle state.
+        """
+        handle = model.handle
+        optimizer._ensure_initialized()
+        accum = self.gradient_accumulation_steps
+        step_body = self._fused_step_body(model, optimizer, accum, loss_fn)
+
+        from .utils.environment import safe_donate_argnums
+
+        @partial(jax.jit, donate_argnums=safe_donate_argnums((0, 1, 2, 3)))
+        def _step(params, opt_state, accum_grads, count, batch, rng, clip_norm):
+            return step_body(params, opt_state, accum_grads, count, batch, rng, clip_norm)
+
+        from .telemetry import span
+        from .telemetry.timeline import batch_token_count
+
+        count_box, check_stale_accum = self._fused_build_prologue(
+            handle, optimizer, accum, "build_train_step"
+        )
+
         def _step_args(batch, rng, clip_norm):
             return (
                 handle.params, optimizer.opt_state, optimizer._accum_grads,
@@ -1090,15 +1184,7 @@ class Accelerator:
             )
 
         def step(batch, clip_norm: float = 0.0):
-            if self.gradient_accumulation_steps != accum:
-                # The compiled program bakes the accumulation scale in; a
-                # mid-run change would silently diverge from the imperative
-                # path (which reads GradientState live) — fail instead.
-                raise RuntimeError(
-                    f"gradient_accumulation_steps changed from {accum} to "
-                    f"{self.gradient_accumulation_steps} after build_train_step; "
-                    "call build_train_step again to pick up the new value."
-                )
+            check_stale_accum()
             handle.step_counter += 1
             rng = jax.random.fold_in(handle.rng, handle.step_counter)
             # self.telemetry (not a build-time capture) so a later
@@ -1125,6 +1211,143 @@ class Accelerator:
 
         step.lower = lower
         return step
+
+    # --------------------------------------------------------- fused windows
+    def build_train_window(self, model: PreparedModel, optimizer: AcceleratedOptimizer,
+                           window: int | None = None, loss_fn=None):
+        """ONE compiled XLA program per K steps: ``lax.scan`` of K full train
+        steps (forward + backward + accumulation + conditional update, buffers
+        donated) over a K-stacked device-resident batch window — the
+        dispatch-amortized hot loop (docs/performance.md "Dispatch
+        amortization"). Each launch pays ONE program dispatch where
+        ``build_train_step`` pays K, which is the whole game on a
+        high-latency control path (the tunneled rig's ~0.5 s RTT per
+        dispatch); the per-step math — accumulation scale, clip, RNG fold-in
+        sequence — is bit-identical to K sequential fused steps.
+
+        ``window`` defaults to (and pins) :attr:`train_window`
+        (ACCELERATE_TRAIN_WINDOW / ``launch --train_window``); ``window=1``
+        is exactly the ``build_train_step`` program with a leading length-1
+        batch axis. Composes with gradient accumulation (K in-window
+        micro-steps advance the same accumulation counter), the health guard
+        (``guard_step(losses, step=..., window=K)`` dispatches one windowed
+        verdict, quarantines the exact in-window step, and snapshots at
+        window boundaries), preemption hooks
+        (``checkpoint_on_preemption(window=K)``), and the 1F1B/fused-loss
+        paths via the shared forward core.
+
+        Returns ``step_window(window_batch) -> losses`` where ``window_batch``
+        has a leading K axis on every leaf (``DeviceBatchPrefetcher(...,
+        window=K)`` builds these, already on device, for K > 1; at
+        ``window=1`` the prefetcher deliberately yields PLAIN batches shaped
+        for ``build_train_step`` — the unwindowed async-prefetch pairing —
+        so stack a length-1 leading axis yourself to feed a K=1 window
+        program) and ``losses`` is the retained per-step K-vector — drain it
+        through the timeline's no-blocking-fetch discipline, never
+        ``float()`` it mid-loop.
+        """
+        window = self.train_window if window is None else int(window)
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        # Pin the accelerator-level knob so the stale-config check below has
+        # one source of truth (mirrors gradient_accumulation_steps semantics).
+        self.train_window = window
+        handle = model.handle
+        optimizer._ensure_initialized()
+        accum = self.gradient_accumulation_steps
+        step_body = self._fused_step_body(model, optimizer, accum, loss_fn)
+
+        from .utils.environment import safe_donate_argnums
+
+        @partial(jax.jit, donate_argnums=safe_donate_argnums((0, 1, 2, 3)))
+        def _window(params, opt_state, accum_grads, count, batches, counters,
+                    base_rng, clip_norm):
+            def body(carry, xs):
+                params, opt_state, accum_grads, count = carry
+                batch, counter = xs
+                # Same stream as the per-step program: fold_in of the handle
+                # key at this step's counter value.
+                rng = jax.random.fold_in(base_rng, counter)
+                params, opt_state, accum_grads, count, loss = step_body(
+                    params, opt_state, accum_grads, count, batch, rng, clip_norm
+                )
+                return (params, opt_state, accum_grads, count), loss
+
+            (params, opt_state, accum_grads, count), losses = jax.lax.scan(
+                body, (params, opt_state, accum_grads, count), (batches, counters)
+            )
+            return params, opt_state, accum_grads, count, losses
+
+        from .telemetry import span
+        from .telemetry.timeline import batch_token_count
+
+        count_box, check_stale_accum = self._fused_build_prologue(
+            handle, optimizer, accum, "build_train_window"
+        )
+
+        def _check_leading_axis(batch):
+            for leaf in jax.tree_util.tree_leaves(batch):
+                if hasattr(leaf, "shape") and np.ndim(leaf) > 0:
+                    if leaf.shape[0] != window:
+                        hint = (
+                            "Use DeviceBatchPrefetcher(..., window=K) or "
+                            "np.stack K batches."
+                            if window > 1 else
+                            "Stack a length-1 leading axis (np.expand_dims, "
+                            "axis=0) — DeviceBatchPrefetcher(window=1) yields "
+                            "PLAIN batches shaped for build_train_step."
+                        )
+                        raise ValueError(
+                            f"build_train_window(window={window}) expects every "
+                            f"batch leaf stacked on a leading K axis; got leading "
+                            f"dim {leaf.shape[0]} (shape {tuple(leaf.shape)}). "
+                            + hint
+                        )
+
+        def step_window(batch, clip_norm: float = 0.0):
+            check_stale_accum()
+            if self.train_window != window:
+                raise RuntimeError(
+                    f"train_window changed from {window} to {self.train_window} "
+                    "after build_train_window; the compiled program scans exactly "
+                    f"{window} steps per dispatch — call build_train_window again "
+                    "to pick up the new value."
+                )
+            _check_leading_axis(batch)
+            counters = jnp.arange(
+                handle.step_counter + 1, handle.step_counter + window + 1, dtype=jnp.int32
+            )
+            handle.step_counter += window
+            telemetry = self.telemetry
+            args = (
+                handle.params, optimizer.opt_state, optimizer._accum_grads,
+                count_box[0], self._place_window_batch(batch), counters,
+                handle.rng, jnp.float32(clip_norm),
+            )
+            if not telemetry.enabled:
+                (handle.params, optimizer.opt_state, optimizer._accum_grads,
+                 count_box[0], losses) = _window(*args)
+                return losses
+            with span("train_window"):
+                (handle.params, optimizer.opt_state, optimizer._accum_grads,
+                 count_box[0], losses) = _window(*args)
+            # One boundary, K steps: the timeline splits wall time and tokens
+            # per step and retains the K-vector of losses (no fetch here).
+            telemetry.on_fused_step(
+                tokens=batch_token_count(batch), loss=losses, steps=window
+            )
+            return losses
+
+        step_window.window = window
+        return step_window
+
+    def _place_window_batch(self, batch):
+        """Host leaves of a K-stacked window → global mesh arrays (window axis
+        replicated, batch axis — dim 1 — on the data axes). Device-resident
+        leaves (the prefetcher's output) pass through untouched."""
+        from .parallel.sharding import make_global_window_batch
+
+        return self._place_with(batch, make_global_window_batch)
 
     # ------------------------------------------------------------ collectives
     def gather(self, tensor):
@@ -1351,7 +1574,7 @@ class Accelerator:
         return self._preemption_watcher
 
     def checkpoint_on_preemption(self, output_dir: str | None = None,
-                                 step: int | None = None) -> bool:
+                                 step: int | None = None, window: int = 1) -> bool:
         """Call once per training step: emergency-checkpoint if preempted.
 
         Three things happen, in order: (1) the deterministic fault plan
@@ -1367,12 +1590,18 @@ class Accelerator:
 
         ``step`` defaults to an internal once-per-call counter; pass the loop's
         own global step when resuming mid-plan so fault steps stay aligned.
+        Windowed loops (``build_train_window``) call this once per window with
+        ``window=K`` so the internal counter keeps per-STEP numbering (fault
+        plans and resume positions stay window-size-independent); kill-style
+        faults scheduled anywhere inside the window fire at its boundary — the
+        earliest point host control returns from the fused program.
         """
         from .health.hang import beat_default
         from .resilience.faults import active_plan
         from .resilience.goodput import get_ledger
 
-        self._resilience_step += 1
+        window = max(int(window), 1)
+        self._resilience_step += window
         step = self._resilience_step if step is None else step
         # A completed step boundary is a heartbeat: loops that only call this
         # hook (no guard_step) still keep the hang watchdog fed.
@@ -1385,14 +1614,17 @@ class Accelerator:
         # double-sample every step. Guard-less resilient loops keep their
         # timeline through this hook with its own consistent numbering.
         if self._health_guard is None:
-            self.telemetry.on_step(step, state=self.state)
+            self.telemetry.on_step(step, state=self.state, window=window)
         # Install the watcher BEFORE the fault plan can deliver a signal: a
         # 'sigterm' fault at the first hooked step must hit the sticky-flag
         # handler, not the default disposition (process death).
         watcher = self.preemption_watcher
         plan = active_plan()
         if plan is not None:
-            plan.maybe_fire(step)
+            # Windowed loops: a kill/sigterm/stall scheduled at ANY in-window
+            # step fires at this boundary, where host control first returns.
+            for in_window in range(step - window + 1, step + 1):
+                plan.maybe_fire(in_window)
         if not watcher.sync(self.state):
             return False
         logger.warning(f"Preemption agreed at step {step}: taking an emergency checkpoint.")
@@ -1439,9 +1671,15 @@ class Accelerator:
             kwargs["spike_zscore"] = float(zscore)
         return HealthGuard(**kwargs)
 
-    def guard_step(self, loss=None, step: int | None = None):
+    def guard_step(self, loss=None, step: int | None = None, window: int = 1):
         """Call once per training step, after the optimizer step: run the
         training-health protocol (docs/health.md) on this step's ``loss``.
+
+        Windowed loops (``build_train_window``) call this once per WINDOW:
+        ``loss`` is the retained K-vector the window returned, ``step`` the
+        last in-window step, and ``window=K`` — one verdict dispatch covers
+        all K losses, a trip quarantines the exact in-window step, and
+        last-known-good snapshots are captured at window boundaries.
 
         Heartbeats the hang watchdog, consumes any ``nan``/``loss_spike``
         fault scheduled for this step, folds the numerics + spike verdict
@@ -1461,9 +1699,14 @@ class Accelerator:
         beat_default(step)
         # Same-step telemetry sample BEFORE any rollback rewinds the count;
         # the straggler exchange inside is collective, and guard_step already
-        # carries the every-host-same-step contract it needs.
-        self.telemetry.on_step(step, loss=loss, state=self.state)
-        return self.health_guard.guard_step(self, loss, step)
+        # carries the every-host-same-step contract it needs. (Under windowed
+        # dispatch the fused boundary already fed the timeline; the hook's
+        # boundary-watermark dedupe makes this a no-op sample then.)
+        # The K-vector rides through unchanged: step_end retains it unfetched
+        # (drain takes the last element), and when build_train_window already
+        # fed this boundary the dedupe watermark skips the fallback entirely.
+        self.telemetry.on_step(step, state=self.state, loss=loss, window=window)
+        return self.health_guard.guard_step(self, loss, step, window=window)
 
     # ---------------------------------------------------------------- profile
     @contextlib.contextmanager

@@ -148,6 +148,23 @@ def get_user_input() -> ClusterConfig:
             "  straggler alert ratio vs the cross-host median step time "
             "(0 = library default 1.5)", 0.0, float
         )
+    # Tri-state like the health section: declining leaves both UNSPECIFIED
+    # (None / '') so an inherited ACCELERATE_TRAIN_WINDOW/XLA_PRESET still
+    # flows through at launch; answering — even with the defaults 1/'off' —
+    # is an explicit choice that scrubs stale inherited values.
+    train_window, xla_preset = None, ""
+    if _yesno(
+        "Do you want to configure dispatch amortization (fused train windows, "
+        "XLA latency-hiding presets)?", False
+    ):
+        train_window = _ask(
+            "  train window K (steps fused into one XLA program per dispatch; "
+            "1 = one dispatch per step)", 1, int
+        )
+        xla_preset = _ask(
+            "  XLA latency-hiding preset (off/latency/collective_matmul)",
+            "off", str, ["off", "latency", "collective_matmul"],
+        )
     log_with = ""
     if _yesno("Do you want to configure experiment tracking?", False):
         log_with = _ask(
@@ -204,6 +221,8 @@ def get_user_input() -> ClusterConfig:
         telemetry=telemetry,
         metrics_port=metrics_port,
         straggler_threshold=straggler_threshold,
+        train_window=train_window,
+        xla_preset=xla_preset,
     )
 
 

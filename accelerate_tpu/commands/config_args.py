@@ -122,6 +122,16 @@ class ClusterConfig:
     telemetry: bool | None = None
     metrics_port: int = 0
     straggler_threshold: float = 0.0
+    # Dispatch amortization (docs/performance.md): ``train_window`` is the K
+    # Accelerator.build_train_window fuses per dispatch (tri-state like
+    # ``telemetry``: None = unspecified, an inherited ACCELERATE_TRAIN_WINDOW
+    # flows through; an EXPLICIT 1 = per-step dispatch, scrubbed from the
+    # worker env; > 1 exported as ACCELERATE_TRAIN_WINDOW); ``xla_preset``
+    # names the curated latency-hiding LIBTPU_INIT_ARGS preset installed at
+    # PartialState init before backend creation ('' = unspecified, 'off' =
+    # explicitly none; utils/xla_flags.py: latency | collective_matmul).
+    train_window: int | None = None
+    xla_preset: str = ""
     extra: dict = field(default_factory=dict)
 
     def to_dict(self) -> dict:

@@ -41,6 +41,8 @@ from ..utils.constants import (
     ENV_SPIKE_ZSCORE,
     ENV_STRAGGLER_THRESHOLD,
     ENV_TELEMETRY,
+    ENV_TRAIN_WINDOW,
+    ENV_XLA_PRESET,
 )
 from .config_args import ClusterConfig, load_config_from_file
 
@@ -143,6 +145,23 @@ def launch_command_parser(subparsers=None) -> argparse.ArgumentParser:
              "is named in a rate-limited warning and the skew gauges.",
     )
     parser.add_argument(
+        "--train_window", type=int, default=None,
+        help="Dispatch-amortization window K (ACCELERATE_TRAIN_WINDOW): "
+             "Accelerator.build_train_window fuses K full train steps into "
+             "ONE compiled program per dispatch — the per-step dispatch RTT "
+             "is paid once per K steps (docs/performance.md 'Dispatch "
+             "amortization'). 1 = one dispatch per step.",
+    )
+    parser.add_argument(
+        "--xla_preset", default=None,
+        help="Curated XLA latency-hiding flag preset installed into "
+             "LIBTPU_INIT_ARGS before backend creation "
+             "(ACCELERATE_XLA_PRESET): off | latency (latency-hiding "
+             "scheduler + async all-gather/reduce-scatter/collective-permute "
+             "fusion) | collective_matmul (latency + windowed-einsum). "
+             "Echoed into telemetry snapshots.",
+    )
+    parser.add_argument(
         "--hang_timeout", type=float, default=None,
         help="Hang-watchdog deadline in seconds (ACCELERATE_HANG_TIMEOUT): "
              "when no training step completes within the deadline, every "
@@ -190,6 +209,8 @@ def _merge_config(args) -> ClusterConfig:
         ("telemetry", "telemetry"),
         ("metrics_port", "metrics_port"),
         ("straggler_threshold", "straggler_threshold"),
+        ("train_window", "train_window"),
+        ("xla_preset", "xla_preset"),
     ]:
         val = getattr(args, flag, None)
         if val is not None:
@@ -256,6 +277,20 @@ def prepare_launch_env(cfg: ClusterConfig, process_id: int | None = None, attemp
         env[ENV_METRICS_PORT] = str(int(cfg.metrics_port))
     if cfg.straggler_threshold:
         env[ENV_STRAGGLER_THRESHOLD] = str(cfg.straggler_threshold)
+    # Dispatch amortization: the window K reaches Accelerator.train_window;
+    # the XLA preset is installed by PartialState BEFORE backend creation in
+    # the worker (libtpu reads LIBTPU_INIT_ARGS once at init).
+    if cfg.train_window and cfg.train_window > 1:
+        env[ENV_TRAIN_WINDOW] = str(int(cfg.train_window))
+    elif cfg.train_window is not None:
+        # An explicit --train_window 1 beats a stale inherited env value —
+        # env = dict(os.environ) above would otherwise forward it silently.
+        env.pop(ENV_TRAIN_WINDOW, None)
+    if cfg.xla_preset and cfg.xla_preset not in ("off", "none"):
+        env[ENV_XLA_PRESET] = cfg.xla_preset
+    elif cfg.xla_preset:
+        # Same for an explicit --xla_preset off/none.
+        env.pop(ENV_XLA_PRESET, None)
     # Plugins (e.g. the axon tunnel) may have pinned JAX_PLATFORMS in *this*
     # process's environ at jax-import time; children must re-discover their own
     # backend, so only forward the value we set deliberately.
@@ -387,6 +422,17 @@ def launch_command(args) -> None:
             f"--straggler_threshold must be >= 1.0 (a ratio to the cross-host "
             f"median step time), got {cfg.straggler_threshold}"
         )
+    if cfg.train_window is not None and cfg.train_window < 1:
+        raise ValueError(f"--train_window must be >= 1, got {cfg.train_window}")
+    if cfg.xla_preset:
+        # Fail an unknown preset at launch, not after every worker compiled.
+        from ..utils.xla_flags import XLA_PRESETS
+
+        if cfg.xla_preset not in XLA_PRESETS and cfg.xla_preset != "none":
+            raise ValueError(
+                f"--xla_preset must be one of {sorted(XLA_PRESETS)}, got "
+                f"{cfg.xla_preset!r}"
+            )
     if cfg.max_restarts > 0 and cfg.num_machines > 1:
         raise ValueError(
             "--max_restarts only applies to single-machine jobs: on a pod, a "

@@ -776,6 +776,280 @@ class DataLoaderDispatcher(DataLoaderStateMixin):
         return global_np_batch
 
 
+class DeviceBatchPrefetcher:
+    """Async host→device input feed — the train loop must never wait on an
+    upload.
+
+    A background thread pulls host batches from any iterable (typically a
+    prepared :class:`DataLoaderShard`), ``device_put``s each one onto the
+    mesh's data-axis sharding ``prefetch`` batches ahead of the consumer
+    (single-host ``device_put`` and multi-host
+    ``make_array_from_process_local_data`` global-batch forms, via
+    ``parallel/sharding.py``), and — with ``window=K`` — stacks K consecutive
+    batches into one K-leading-axis window buffer shaped for
+    ``Accelerator.build_train_window`` (window axis replicated, batch axis
+    sharded).
+
+    Every upload is counted through :func:`~.utils.transfer.host_put`; when
+    the *training* thread has to wait for a batch that is not staged yet the
+    wait is recorded via :func:`~.utils.transfer.record_input_wait` as a
+    blocking input transfer plus its wall-clock — so "the loop never blocks
+    on input" is a measured property (``StepTimeline.summary()['transfers']``,
+    ``bench.py`` ``detail.input_wait_s``), not an assertion. The FIRST batch
+    of an iteration is pipeline fill (nothing could have been staged yet) and
+    is excluded, the same way the timeline's first boundary is baseline-only.
+
+    Resume contract: ``state_dict()``/``load_state_dict()`` delegate to the
+    wrapped loader (sampler-RNG snapshot included) but report the CONSUMER
+    position — whole windows handed to the train loop — not the producer's
+    read-ahead, so a checkpoint taken at a window boundary resumes bit-exact:
+    staged-but-unconsumed batches are re-read from the replayed epoch order.
+
+    Note: a wrapped shard loader's own ``end_of_dataloader`` flag flips when
+    the *producer* reaches the tail (up to ``prefetch×window`` batches early);
+    windowed loops should drive accumulation boundaries off step counts, not
+    the dataloader flag.
+    """
+
+    _SENTINEL = object()
+
+    def __init__(self, loader, mesh=None, prefetch: int = 2, window: int = 1):
+        if prefetch < 1:
+            raise ValueError(f"prefetch must be >= 1, got {prefetch}")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        self.loader = loader
+        self.prefetch = int(prefetch)
+        self.window = int(window)
+        self._mesh = mesh
+        self._consumed = 0  # base batches handed to the train loop this epoch
+        self._resume_consumed = 0
+        # Epoch-identity snapshot (iteration + sampler RNG) taken at the
+        # epoch's FIRST batch: the producer's read-ahead can exhaust the
+        # wrapped loader — whose epilogue advances iteration and drops the
+        # epoch RNG — while staged windows are still unconsumed, so the live
+        # state_dict() near an epoch tail describes the NEXT epoch.
+        self._epoch_identity = None
+
+    @property
+    def mesh(self):
+        if self._mesh is not None:
+            return self._mesh
+        state = getattr(self.loader, "state", None)
+        if state is not None:
+            return state.mesh
+        return PartialState().mesh
+
+    def __len__(self):
+        n = len(self.loader)
+        return n // self.window if self.window > 1 else n
+
+    # ------------------------------------------------------------------ feed
+    def _stage(self, batches, mesh):
+        """window host batches → ONE device-resident buffer (counted upload).
+        Already-placed device leaves pass through (stacked on device for
+        windows) without an h2d count — their upload happened elsewhere; in a
+        MIXED batch only the host leaves are uploaded, so a device-resident
+        leaf never round-trips through ``np.asarray`` (a blocking, uncounted
+        device→host readback plus a redundant re-upload)."""
+        if self.window == 1:
+            batch = batches[0]
+            placer = lambda b: make_global_batch(b, mesh)
+        else:
+            def _stack(*xs):
+                # A device leaf in ANY slot routes through jnp.stack (mixed
+                # host/device inputs accepted) — np.asarray on a jax.Array
+                # would be a blocking, uncounted device→host readback.
+                if any(isinstance(x, jax.Array) for x in xs):
+                    import jax.numpy as jnp
+
+                    return jnp.stack(xs, axis=0)
+                return np.stack([np.asarray(x) for x in xs], axis=0)
+
+            from .parallel.sharding import make_global_window_batch
+
+            batch = jax.tree_util.tree_map(_stack, *batches)
+            placer = lambda b: make_global_window_batch(b, mesh)
+
+        leaves = [l for l in jax.tree_util.tree_leaves(batch) if hasattr(l, "shape")]
+        if leaves and all(isinstance(l, jax.Array) for l in leaves):
+            return batch
+        from .utils.transfer import host_put as _put
+
+        if leaves and any(isinstance(l, jax.Array) for l in leaves):
+            return _put(batch, lambda b: jax.tree_util.tree_map(
+                lambda l: l if isinstance(l, jax.Array) else placer(l), b))
+        return _put(batch, placer)
+
+    def __iter__(self):
+        import queue
+        import threading
+        import time
+
+        from .utils.transfer import record_input_wait
+
+        resume = self._resume_consumed
+        self._resume_consumed = 0
+        self._consumed = resume
+        q: queue.Queue = queue.Queue(maxsize=self.prefetch)
+        stop = threading.Event()
+        box = {"error": None}
+        mesh = self.mesh  # resolved on the consumer thread (singletons)
+        loader = self.loader
+
+        def _offer(item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def produce():
+            # The wrapped shard loader would otherwise device-feed on its own;
+            # the prefetcher owns placement (counted, window-shaped), so its
+            # put is suspended for the duration of this iteration.
+            restore_put = None
+            if getattr(loader, "put_on_device", None) is True:
+                restore_put = True
+                loader.put_on_device = False
+            try:
+                stack = []
+                first = True
+                for batch in loader:
+                    if first:
+                        # The shard's iterator has started: its state_dict now
+                        # names THIS epoch (iteration, sampler RNG). Snapshot
+                        # the identity before read-ahead can cross the epoch
+                        # boundary and advance it under the consumer.
+                        first = False
+                        if hasattr(loader, "state_dict"):
+                            try:
+                                ident = dict(loader.state_dict())
+                            except Exception:
+                                ident = None
+                            if ident is not None:
+                                ident.pop("base_state", None)
+                                ident.pop("num_batches_fetched", None)
+                                self._epoch_identity = ident
+                    if stop.is_set():
+                        return
+                    stack.append(batch)
+                    if len(stack) < self.window:
+                        continue
+                    staged = self._stage(stack, mesh)
+                    stack = []
+                    if not _offer(staged):
+                        return
+                if stack:
+                    logger.info(
+                        "DeviceBatchPrefetcher: dropping %d tail batch(es) that "
+                        "do not fill a window of %d", len(stack), self.window,
+                    )
+            except BaseException as exc:  # surfaced on the consumer thread
+                box["error"] = exc
+            finally:
+                if restore_put:
+                    loader.put_on_device = True
+                _offer(self._SENTINEL)
+
+        thread = threading.Thread(
+            target=produce, name="accelerate-device-prefetch", daemon=True
+        )
+
+        # An ABANDONED iterator (consumer broke out and never exhausted or
+        # closed it) leaves the producer alive into interpreter teardown,
+        # where a daemon thread woken mid-XLA/queue C++ frames aborts the
+        # process ("terminate called without an active exception"). Stop it
+        # at atexit — before daemon threads are frozen — and let the
+        # generator's own finally unregister on every normal path.
+        import atexit
+
+        def _shutdown():
+            stop.set()
+            thread.join(timeout=1.0)
+
+        atexit.register(_shutdown)
+        thread.start()
+        delivered = False
+        try:
+            while True:
+                waited = 0.0
+                try:
+                    item = q.get_nowait()
+                except queue.Empty:
+                    t0 = time.perf_counter()
+                    item = q.get()
+                    waited = time.perf_counter() - t0
+                if item is self._SENTINEL:
+                    if box["error"] is not None:
+                        raise box["error"]
+                    # Natural exhaustion: the epoch is over, position resets
+                    # (mirrors DataLoaderShard's between-epoch semantics) and
+                    # the identity snapshot retires — a between-epoch
+                    # checkpoint must name the NEXT epoch, not replay this one.
+                    self._consumed = 0
+                    self._epoch_identity = None
+                    break
+                if delivered and waited > 1e-3:
+                    # Steady-state stall: the batch was not staged when the
+                    # train loop asked — the blocking-input event the prefetch
+                    # depth exists to prevent. Sub-millisecond waits are
+                    # get_nowait-vs-get scheduler jitter (the producer enqueued
+                    # between the two calls), not an input stall.
+                    record_input_wait(waited)
+                delivered = True
+                self._consumed += self.window
+                yield item
+        finally:
+            stop.set()
+            try:
+                atexit.unregister(_shutdown)
+            except Exception:
+                pass  # interpreter teardown: atexit module may be gone
+            # Pre-bound: an abandoned generator finalized at interpreter
+            # shutdown has lost the local `queue` module reference, and
+            # `except queue.Empty` would itself raise.
+            empty = queue.Empty
+            try:
+                while True:
+                    q.get_nowait()
+            except empty:
+                pass
+            thread.join(timeout=5.0)
+
+    # -------------------------------------------------- resume (stateful) API
+    def state_dict(self):
+        """The wrapped loader's resume state with the position rewritten to
+        the CONSUMER's (whole windows yielded), so staged-but-unconsumed
+        read-ahead is replayed after a resume instead of lost."""
+        sd = dict(self.loader.state_dict()) if hasattr(self.loader, "state_dict") else {}
+        if self._epoch_identity is not None:
+            # Mid-epoch for the CONSUMER: read-ahead may have crossed the
+            # epoch boundary, advancing the live iteration and dropping the
+            # epoch RNG — the snapshot taken at this epoch's first batch is
+            # the consumer's truth.
+            sd.update(self._epoch_identity)
+        sd["num_batches_fetched"] = max(self._consumed, self._resume_consumed)
+        # A stateful base's own snapshot was taken at the PRODUCER's read-ahead
+        # position (up to prefetch×window batches past the consumer) and would
+        # take precedence on resume, silently dropping staged-but-unconsumed
+        # batches — force the consumer-count skip-replay path instead.
+        sd.pop("base_state", None)
+        return sd
+
+    def load_state_dict(self, sd):
+        self._resume_consumed = sd.get("num_batches_fetched", 0)
+        self._consumed = 0
+        # The restored checkpoint may be from a different epoch than the one
+        # a prior partial iteration snapshotted; a stale identity would be
+        # overlaid onto the restored state by the next state_dict().
+        self._epoch_identity = None
+        if hasattr(self.loader, "load_state_dict"):
+            self.loader.load_state_dict(sd)
+
+
 class SkipBatchSampler:
     """Batch sampler skipping the first ``skip_batches`` batches (reference :1296)."""
 

@@ -83,8 +83,12 @@ _GUARD_SEQ = 0
 @dataclass
 class _Pending:
     step: int
-    flags: object  # int32 device scalar
+    flags: object  # int32 device scalar (OR over the window when idx is set)
     z: object  # float32 device scalar
+    # In-window trip offset (int32 device scalar) for windowed verdicts:
+    # ``step`` is then the FIRST in-window step and the tripped step resolves
+    # to ``step + idx`` at drain time. None for per-step verdicts.
+    idx: object = None
 
 
 class HealthGuard:
@@ -161,12 +165,56 @@ class HealthGuard:
             self._verdict_fns[with_gnorm] = fn
         return fn
 
-    def observe(self, loss, gnorm=None, step: int = 0):
-        """Dispatch this step's on-device verdict; nothing is fetched here."""
+    def _get_window_verdict_fn(self):
+        """Windowed dispatch: ONE jitted verdict over the K-vector of losses a
+        fused train window returns — a ``lax.scan`` of the exact per-step
+        update, so the spike statistics evolve bit-identically to K sequential
+        scalar verdicts. Returns (state, OR-of-flags, z at the first trip,
+        first-tripped in-window index)."""
+        fn = self._verdict_fns.get("window")
+        if fn is None:
+            sentinel, spike = self.sentinel, self.spike
+
+            def verdict(state, losses):
+                def one(st, loss):
+                    flags = sentinel.flags(loss) if sentinel is not None else jnp.int32(0)
+                    if spike is not None:
+                        st, sflags, z = spike.update(st, loss)
+                        flags = flags | sflags
+                    else:
+                        z = jnp.float32(0.0)
+                    return st, (flags, z)
+
+                state, (flags_vec, z_vec) = jax.lax.scan(
+                    one, state, jnp.asarray(losses, jnp.float32)
+                )
+                idx = jnp.argmax(flags_vec != 0).astype(jnp.int32)
+                combined = jax.lax.reduce(
+                    flags_vec, jnp.int32(0), jax.lax.bitwise_or, (0,)
+                )
+                return state, combined, z_vec[idx], idx
+
+            fn = jax.jit(verdict)
+            self._verdict_fns["window"] = fn
+        return fn
+
+    def observe(self, loss, gnorm=None, step: int = 0, window: int = 1):
+        """Dispatch this step's on-device verdict; nothing is fetched here.
+        With ``window > 1``, ``loss`` is the K-vector a fused train window
+        retained and ``step`` the LAST in-window step; the grad-norm check is
+        per-window-boundary state the fused program does not surface, so it
+        does not apply there."""
         if not self.enabled:
             return
         if self._spike_state is None:
             self._spike_state = self.spike.init_state() if self.spike is not None else ()
+        if window > 1:
+            fn = self._get_window_verdict_fn()
+            self._spike_state, flags, z, idx = fn(self._spike_state, loss)
+            self._pending.append(
+                _Pending(step=int(step) - int(window) + 1, flags=flags, z=z, idx=idx)
+            )
+            return
         fn = self._get_verdict_fn(gnorm is not None)
         args = (self._spike_state, loss) + ((gnorm,) if gnorm is not None else ())
         self._spike_state, flags, z = fn(*args)
@@ -185,6 +233,8 @@ class HealthGuard:
             f = int(host_fetch(entry.flags))
             if f and trip_step is None:
                 trip_step = entry.step
+                if entry.idx is not None:  # windowed verdict: resolve in-window
+                    trip_step += int(host_fetch(entry.idx))
                 trip_z = float(host_fetch(entry.z))
             flags |= f
         return flags, trip_step, trip_z
@@ -224,13 +274,13 @@ class HealthGuard:
         )
 
     # ----------------------------------------------------------------- check
-    def check(self, loss, gnorm=None, step: int = 0, state=None):
+    def check(self, loss, gnorm=None, step: int = 0, state=None, window: int = 1):
         """Observe + drain + agree, no recovery action: returns
         ``(agreed_flags, trip_step, zscore)``. The building block shared by
         :meth:`guard_step` and loops driving the guard directly (e.g. the
         multi-host agreement drills)."""
         if loss is not None:
-            self.observe(loss, gnorm=gnorm, step=step)
+            self.observe(loss, gnorm=gnorm, step=step, window=window)
         multi = state is not None and getattr(state, "num_processes", 1) > 1
         # Multi-host: drain fully so every host votes on the same step window.
         flags, trip_step, z = self._drain(force=multi)
@@ -245,10 +295,20 @@ class HealthGuard:
         return agreed, trip_step, z
 
     # ------------------------------------------------------------- guard_step
-    def guard_step(self, accelerator, loss, step: int) -> HealthVerdict:
-        """The full per-step protocol against a live :class:`Accelerator`."""
+    def guard_step(self, accelerator, loss, step: int, window: int = 1) -> HealthVerdict:
+        """The full per-step protocol against a live :class:`Accelerator`.
+
+        With ``window > 1`` the call runs once per fused train window: ``loss``
+        is the retained K-vector, ``step`` the LAST in-window step, the verdict
+        is one dispatch over all K losses, a trip's quarantine resolves to the
+        exact in-window step, and snapshot capture fires at the window boundary
+        whenever any in-window step crossed the snapshot cadence."""
         step = int(step)
-        loss = self._maybe_inject_fault(loss, step)
+        window = max(int(window), 1)
+        if window > 1:
+            loss = self._maybe_inject_window_faults(loss, step, window)
+        else:
+            loss = self._maybe_inject_fault(loss, step)
         gnorm = None
         # Under an fp16 GradScaler a non-finite grad norm is ROUTINE — the
         # scale-growth probe overflows by design, the jitted update already
@@ -256,7 +316,8 @@ class HealthGuard:
         # rolling back / quarantining a healthy batch) on it would fight the
         # scaler every growth interval, so the grad check defers to it.
         if (
-            self.sentinel is not None
+            window == 1
+            and self.sentinel is not None
             and self.sentinel.check_grads
             and getattr(accelerator, "scaler", None) is None
         ):
@@ -264,9 +325,11 @@ class HealthGuard:
                 if model.handle.last_grad_norm is not None:
                     gnorm = model.handle.last_grad_norm
                     break
-        flags, trip_step, z = self.check(loss, gnorm=gnorm, step=step, state=accelerator.state)
+        flags, trip_step, z = self.check(
+            loss, gnorm=gnorm, step=step, state=accelerator.state, window=window
+        )
         if not flags:
-            if self.enabled and self.lkg.due(step):
+            if self.enabled and self.lkg.due(step, window=window):
                 # No verdict drain here: the snapshot ring keeps one spare, and
                 # rollback picks the newest snapshot OLDER than the trip — so a
                 # capture that later turns out poisoned is skipped over rather
@@ -293,6 +356,38 @@ class HealthGuard:
         mult = float(str(fault.arg).rstrip("xX")) if fault.arg else 50.0
         logger.warning(f"Fault injection: spiking the step-{step} loss {mult:g}x")
         return jnp.asarray(loss, jnp.float32) * jnp.float32(mult)
+
+    def _maybe_inject_window_faults(self, losses, step: int, window: int):
+        """Windowed fault delivery: a ``nan``/``loss_spike`` fault scheduled at
+        any in-window step poisons exactly that element of the K-vector, so a
+        drill trips at — and quarantines — the right in-window step."""
+        if losses is None:
+            return losses
+        from ..resilience.faults import active_plan
+
+        plan = active_plan()
+        if plan is None:
+            return losses
+        first = step - window + 1
+        for i in range(window):
+            fault = plan.take_data_fault(first + i)
+            if fault is None:
+                continue
+            losses = jnp.asarray(losses, jnp.float32)
+            if fault.action == "nan":
+                logger.warning(
+                    f"Fault injection: poisoning the step-{first + i} loss "
+                    f"(window slot {i}) with NaN"
+                )
+                losses = losses.at[i].set(jnp.nan)
+            else:
+                mult = float(str(fault.arg).rstrip("xX")) if fault.arg else 50.0
+                logger.warning(
+                    f"Fault injection: spiking the step-{first + i} loss "
+                    f"(window slot {i}) {mult:g}x"
+                )
+                losses = losses.at[i].multiply(jnp.float32(mult))
+        return losses
 
     def _handle_trip(self, accelerator, flags: int, trip_step: int, z) -> HealthVerdict:
         self.trips += 1

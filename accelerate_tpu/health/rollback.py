@@ -88,8 +88,16 @@ class LastKnownGood:
                 return step
         return None
 
-    def due(self, step: int) -> bool:
-        return step % self.every_steps == 0 or not self._snapshots
+    def due(self, step: int, window: int = 1) -> bool:
+        """Whether a capture is due at this step boundary. ``window`` > 1 is
+        the K-step fused-window case: ``step`` is the boundary (last in-window
+        step) and the capture fires when ANY in-window step crossed the
+        cadence — boundaries are the only points the guard sees."""
+        from ..utils.cadence import window_cadence_due
+
+        if not self._snapshots:
+            return True
+        return window_cadence_due(step, window, self.every_steps, include_step0=True)
 
     def capture(self, step: int, device_state=None, host_state=None):
         device = device_clone(device_state) if device_state is not None else None

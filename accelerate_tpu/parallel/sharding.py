@@ -258,6 +258,13 @@ def apply_shardings(pytree, shardings):
     return jax.tree_util.tree_map(lambda x, s: jax.device_put(x, s), pytree, shardings)
 
 
+def data_parallel_degree(mesh: Mesh) -> int:
+    """How many ways the batch axis is split: the product of the data axes.
+    One definition — batch sharding, window sharding, and per-process batch
+    sizing must agree on it."""
+    return mesh.shape.get("dcn", 1) * mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+
+
 def make_global_batch(batch, mesh: Mesh, spec_fn=None):
     """Turn a process-local host batch into global device arrays sharded on the
     data axes.
@@ -269,7 +276,7 @@ def make_global_batch(batch, mesh: Mesh, spec_fn=None):
     ``jax.Array``, no host ever materializes it.
     """
     multi_host = jax.process_count() > 1
-    n_data = mesh.shape.get("dcn", 1) * mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+    n_data = data_parallel_degree(mesh)
 
     def _one(x):
         x = np.asarray(x)
@@ -291,9 +298,38 @@ def make_global_batch(batch, mesh: Mesh, spec_fn=None):
     return jax.tree_util.tree_map(_one, batch)
 
 
+def window_batch_spec(mesh: Mesh, x) -> P:
+    """Sharding for a K-stacked train-window leaf ``(K, B, ...)``: the window
+    axis stays replicated (the scanned program consumes one K-slice per step on
+    every device) while the batch axis — now dim 1 — rides the data axes."""
+    from ..utils.constants import BATCH_SHARDING_AXES
+
+    x = np.asarray(x)
+    n_data = data_parallel_degree(mesh)
+    if x.ndim >= 2 and x.shape[1] % n_data == 0:
+        return P(None, BATCH_SHARDING_AXES, *([None] * (x.ndim - 2)))
+    if jax.process_count() > 1:
+        # A replicated fallback would hand make_array_from_process_local_data
+        # per-process-DIFFERENT local data under a replicated sharding —
+        # silently corrupt. Mirror make_global_batch's divisibility error.
+        raise ValueError(
+            f"window batch leaf {x.shape} has no batch dim (dim 1) divisible by "
+            f"data-parallel degree {n_data} on a multi-host mesh; pad the batch "
+            "or change dp/fsdp."
+        )
+    return P()
+
+
+def make_global_window_batch(batch, mesh: Mesh):
+    """``make_global_batch`` for K-stacked window buffers (leading window axis
+    replicated, batch axis sharded) — same single-host ``device_put`` /
+    multi-host ``make_array_from_process_local_data`` forms."""
+    return make_global_batch(batch, mesh, spec_fn=lambda x: window_batch_spec(mesh, x))
+
+
 def local_batch_size_for(global_batch_size: int, mesh: Mesh) -> int:
     """How many samples this *process* should feed per step."""
-    n_data = mesh.shape.get("dcn", 1) * mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+    n_data = data_parallel_degree(mesh)
     if global_batch_size % n_data != 0:
         raise ValueError(
             f"global batch size {global_batch_size} not divisible by data-parallel degree {n_data}"

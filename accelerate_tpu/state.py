@@ -144,6 +144,13 @@ class PartialState:
                 jax.config.update("jax_platforms", "cpu")
             except Exception:
                 logger.warning("cpu=True requested but platform switch failed")
+        # XLA latency-hiding preset (ACCELERATE_XLA_PRESET): merged into
+        # LIBTPU_INIT_ARGS before ANY backend creation below — libtpu reads
+        # the variable once at init, so this must precede the compilation
+        # cache config, the distributed rendezvous, and default_backend().
+        from .utils.xla_flags import install_preset_from_env
+
+        install_preset_from_env()
         # Persistent XLA compilation cache (ACCELERATE_COMPILE_CACHE_DIR):
         # configured before the first compile so restarted jobs (and every
         # bench re-run) load their programs instead of re-building them.

@@ -115,6 +115,18 @@ def install_default_collectors(registry: MetricsRegistry | None = None):
             "accelerate_host_fetches_blocking",
             "Device-to-host fetches that stalled on an unmaterialized result",
         ).set(stats["blocking"])
+        reg.gauge(
+            "accelerate_host_puts",
+            "Deliberate host-to-device batch uploads (utils/transfer.py)",
+        ).set(stats["h2d_puts"])
+        reg.gauge(
+            "accelerate_host_puts_blocking",
+            "Input batches the train loop had to wait on (prefetch misses)",
+        ).set(stats["h2d_blocking"])
+        reg.gauge(
+            "accelerate_input_wait_seconds",
+            "Wall-clock the train loop spent waiting on input transfers",
+        ).set(stats["input_wait_s"])
 
     def _memory(reg: MetricsRegistry):
         stats = device_memory_stats()
@@ -217,12 +229,14 @@ class Telemetry:
 
     # -------------------------------------------------------------- per-step
     def on_step(self, step: int, tokens: int | None = None, loss=None,
-                state=None) -> None:
+                state=None, window: int = 1) -> None:
         """Per-step hook (``guard_step``/``checkpoint_on_preemption`` call it).
         Records a timeline sample unless the fused path already did since the
         last hook; repeated hooks at one step (a loop calling both) count
         once. Drives the periodic straggler exchange when ``state`` is given —
-        that exchange is a collective, so hooks must stay SPMD-aligned."""
+        that exchange is a collective, so hooks must stay SPMD-aligned.
+        Windowed loops hook once per K-step boundary with ``window=K`` so the
+        straggler cadence stays per-STEP correct."""
         if not self.enabled:
             return
         step = int(step)
@@ -234,20 +248,28 @@ class Telemetry:
             self._last_hook_step = None
         if step != self._last_hook_step:
             if self.timeline.boundaries == self._seen_timeline_n:
-                self.timeline.step_end(step=step, tokens=tokens, loss=loss)
+                # Fallback feed (the loop's fused program didn't): a windowed
+                # boundary still covers `window` training steps.
+                self.timeline.step_end(step=step, tokens=tokens, loss=loss,
+                                       steps=window)
             self._seen_timeline_n = self.timeline.boundaries
             self._last_hook_step = step
-        if state is not None and self.straggler.due(step):
+        if state is not None and self.straggler.due(step, window):
             window_s, window_steps = self.timeline.take_window()
             if window_steps:
                 self.straggler.report(state, window_s / window_steps, step=step)
 
-    def on_fused_step(self, tokens: int | None = None, loss=None) -> None:
+    def on_fused_step(self, tokens: int | None = None, loss=None,
+                      steps: int = 1) -> None:
         """Fed by ``build_train_step``'s compiled step — one call per
-        microbatch dispatch, host-side cost of a clock read."""
+        microbatch dispatch, host-side cost of a clock read. Under windowed
+        dispatch (``build_train_window``) one call covers ``steps`` training
+        steps: ``tokens`` is the window TOTAL and ``loss`` the retained
+        per-step K-vector — the timeline splits both so per-step statistics
+        stay correct (see ``StepTimeline.step_end``)."""
         if not self.enabled:
             return
-        self.timeline.step_end(tokens=tokens, loss=loss)
+        self.timeline.step_end(tokens=tokens, loss=loss, steps=steps)
 
     # --------------------------------------------------------------- reading
     def summary(self) -> dict:

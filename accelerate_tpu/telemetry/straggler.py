@@ -88,8 +88,14 @@ class StragglerMonitor:
             labelnames=("host",),
         )
 
-    def due(self, step: int) -> bool:
-        return step > 0 and step % self.every_steps == 0
+    def due(self, step: int, window: int = 1) -> bool:
+        """Whether an exchange is due at this step boundary. ``window`` > 1 is
+        the K-step fused-window case: boundaries advance by K, so the exchange
+        fires when ANY in-window step crossed the cadence (no step 0: there is
+        no step-time window to exchange before the first completed step)."""
+        from ..utils.cadence import window_cadence_due
+
+        return window_cadence_due(step, window, self.every_steps)
 
     # ---------------------------------------------------------------- report
     def report(self, state, local_mean_s: float, step: int = 0) -> SkewReport | None:

@@ -121,6 +121,7 @@ class StepTimeline:
         self._ring: collections.deque[StepSample] = collections.deque(maxlen=capacity)
         self._count = 0
         self._boundaries = 0
+        self._dispatches = 0
         self._last_end = None
         self._last_step = None
         self._flops_per_token = None
@@ -160,28 +161,49 @@ class StepTimeline:
         return self._boundaries
 
     @property
+    def dispatches(self) -> int:
+        """Program dispatches observed (each ``step_end`` boundary is one —
+        a K-step window boundary counts once while contributing K steps)."""
+        return self._dispatches
+
+    @property
     def last_wall_s(self) -> float | None:
         return self._ring[-1].wall_s if self._ring else None
 
     # ------------------------------------------------------------- recording
     def step_end(self, step: int | None = None, tokens: int | None = None,
-                 loss=None) -> float | None:
-        """Mark a step boundary; returns this step's wall time (None on the
-        baseline call). ``loss`` may be an in-flight device scalar — it is
-        retained, never fetched here."""
+                 loss=None, steps: int = 1) -> float | None:
+        """Mark a step boundary; returns the per-step wall time (None on the
+        baseline call). ``loss`` may be an in-flight device scalar — or, under
+        windowed dispatch, a retained K-vector — it is never fetched here.
+
+        ``steps`` is how many *training steps* this boundary covers: a K-step
+        fused train window is ONE dispatch but K steps, so the boundary's wall
+        time is split into K per-step samples and ``tokens`` (the boundary's
+        TOTAL) into K per-step token counts — tokens/s, the MFU estimate, and
+        the step-time quantiles stay per-step correct at any window size.
+        """
+        steps = max(int(steps), 1)
         now = self._clock()
         wall = None
         self._boundaries += 1
+        self._dispatches += 1
         if self._last_end is not None:
-            wall = now - self._last_end
-            self._count += 1
-            self._ring.append(StepSample(step=step, wall_s=wall, tokens=tokens))
-            self._window_s += wall
-            self._window_steps += 1
-            self._steps_total.inc()
-            self._step_hist.observe(wall)
-            if tokens and wall > 0:
-                tps = tokens / wall
+            wall = (now - self._last_end) / steps
+            per_tokens = tokens // steps if tokens else tokens
+            first = None if step is None else step - steps + 1
+            for i in range(steps):
+                self._count += 1
+                self._ring.append(StepSample(
+                    step=None if first is None else first + i,
+                    wall_s=wall, tokens=per_tokens,
+                ))
+                self._step_hist.observe(wall)
+            self._window_s += wall * steps
+            self._window_steps += steps
+            self._steps_total.inc(steps)
+            if per_tokens and wall > 0:
+                tps = per_tokens / wall
                 self._tokens_gauge.set(tps)
                 if self._flops_per_token:
                     self._mfu_gauge.set(
@@ -196,14 +218,18 @@ class StepTimeline:
 
     def _drain_loss(self):
         """Fetch retained losses whose results have materialized (a counted
-        copy via host_fetch, never a stall); unready ones stay queued."""
+        copy via host_fetch, never a stall); unready ones stay queued. A
+        windowed boundary retains a K-vector — its last element is the most
+        recent step's loss."""
+        import numpy as np
+
         while self._pending_loss:
             head = self._pending_loss[0]
             if not array_is_ready(head):
                 break
             self._pending_loss.popleft()
             try:
-                self._last_loss = float(host_fetch(head))
+                self._last_loss = float(np.asarray(host_fetch(head)).reshape(-1)[-1])
             except Exception:
                 self._last_loss = None
 
@@ -237,8 +263,14 @@ class StepTimeline:
         self._drain_loss()
         now_stats = transfer.transfer_stats()
         ledger = get_ledger()
+        from ..utils.xla_flags import active_preset
+
         out = {
             "steps": self._count,
+            # Program dispatches vs steps: equal in step-per-dispatch training;
+            # under K-step fused windows steps ≈ K × dispatches — the
+            # amortization bench.py's detail.dispatches makes visible.
+            "dispatches": self._dispatches,
             "last_step": self._last_step,
             "step_s": {
                 "mean": sum(walls) / len(walls) if walls else 0.0,
@@ -256,7 +288,14 @@ class StepTimeline:
             "transfers": {
                 "fetches": now_stats["fetches"] - self._transfer0["fetches"],
                 "blocking": now_stats["blocking"] - self._transfer0["blocking"],
+                "h2d_puts": now_stats["h2d_puts"] - self._transfer0.get("h2d_puts", 0),
+                "h2d_blocking": now_stats["h2d_blocking"]
+                - self._transfer0.get("h2d_blocking", 0),
+                "input_wait_s": round(
+                    now_stats["input_wait_s"] - self._transfer0.get("input_wait_s", 0.0), 6
+                ),
             },
+            "xla_preset": active_preset(),
             "memory": device_memory_stats(),
         }
         return out
@@ -267,6 +306,7 @@ class StepTimeline:
         self._ring.clear()
         self._count = 0
         self._boundaries = 0
+        self._dispatches = 0
         self._last_end = None
         self._last_step = None
         self._pending_loss.clear()

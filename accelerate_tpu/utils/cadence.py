@@ -1,0 +1,26 @@
+"""Shared windowed-cadence predicate.
+
+Windowed dispatch (``Accelerator.build_train_window``) hands hooks one
+boundary per K steps, so every every-N-steps consumer (snapshot capture,
+straggler exchange) must fire when ANY in-window step crossed its cadence —
+a boundary-only ``step % N == 0`` silently degrades the cadence to
+``lcm(K, N)``. One definition so the consumers cannot drift apart.
+"""
+
+from __future__ import annotations
+
+
+def window_cadence_due(step: int, window: int, every_steps: int,
+                       include_step0: bool = False) -> bool:
+    """True when any step in ``(step - window, step]`` lands on the cadence.
+
+    ``include_step0`` controls whether step 0 (and negatives) count: snapshot
+    capture wants them (a run's first boundary should capture), the straggler
+    exchange does not (there is no step-time window to exchange before the
+    first completed step).
+    """
+    lo = step - max(int(window), 1)
+    return any(
+        (include_step0 or s > 0) and s % every_steps == 0
+        for s in range(lo + 1, step + 1)
+    )

@@ -63,6 +63,13 @@ ENV_HANG_TIMEOUT = "ACCELERATE_HANG_TIMEOUT"
 ENV_TELEMETRY = "ACCELERATE_TELEMETRY"
 ENV_METRICS_PORT = "ACCELERATE_METRICS_PORT"
 ENV_STRAGGLER_THRESHOLD = "ACCELERATE_STRAGGLER_THRESHOLD"
+# Dispatch amortization (docs/performance.md "Dispatch amortization"): the
+# default K for Accelerator.build_train_window (1 = one dispatch per step),
+# and the curated XLA latency-hiding flag preset installed into
+# LIBTPU_INIT_ARGS at PartialState init, before backend creation
+# (utils/xla_flags.py: off | latency | collective_matmul).
+ENV_TRAIN_WINDOW = "ACCELERATE_TRAIN_WINDOW"
+ENV_XLA_PRESET = "ACCELERATE_XLA_PRESET"
 
 # ``dcn`` is the slice axis of a multi-slice pod: replicas connected by
 # data-center network rather than ICI. It is outermost so only the axes meant

@@ -1,22 +1,38 @@
-"""Counted device→host fetches — the instrument behind the async-hot-loop tests.
+"""Counted host↔device transfers — the instrument behind the async-hot-loop tests.
 
-The hot training loop must never stall the dispatching thread on a device→host
-round-trip: a blocking fetch serializes dispatch behind the device, turning an
-async pipeline into lock-step. Every place the framework *deliberately* pulls a
-scalar to the host (the optimizer's deferred ``found_inf`` resolution, the
-health guard's verdict drain) routes through :func:`host_fetch`, so tests can
-assert the hot path's transfer budget instead of hoping.
+The hot training loop must never stall the dispatching thread on a transfer in
+either direction:
 
-A fetch of an array whose result is already materialized (``Array.is_ready()``)
-costs a copy but no stall; a fetch of an in-flight array additionally counts as
-*blocking* — the thing the deferred-resolution machinery exists to avoid.
+- **device→host**: a blocking fetch serializes dispatch behind the device,
+  turning an async pipeline into lock-step. Every place the framework
+  *deliberately* pulls a scalar to the host (the optimizer's deferred
+  ``found_inf`` resolution, the health guard's verdict drain) routes through
+  :func:`host_fetch`, so tests can assert the hot path's transfer budget
+  instead of hoping. A fetch of an array whose result is already materialized
+  (``Array.is_ready()``) costs a copy but no stall; a fetch of an in-flight
+  array additionally counts as *blocking*.
+- **host→device**: a synchronous batch upload idles the accelerator between
+  steps. The :class:`~..data_loader.DeviceBatchPrefetcher` moves every input
+  ``device_put`` onto a background thread and routes it through
+  :func:`host_put`; when the *training* thread has to wait for a batch that
+  is not staged yet, the wait is recorded via :func:`record_input_wait` as a
+  blocking input transfer plus its wall-clock cost — which is what lets the
+  prefetcher's zero-blocking claim be measured, not asserted.
+
+``StepTimeline.summary()`` and the Prometheus registry expose both directions.
 """
 
 from __future__ import annotations
 
 import numpy as np
 
-_stats = {"fetches": 0, "blocking": 0}
+_stats = {
+    "fetches": 0,       # deliberate device→host fetches
+    "blocking": 0,      # ...that stalled on an unmaterialized result
+    "h2d_puts": 0,      # deliberate host→device batch uploads
+    "h2d_blocking": 0,  # input waits: the train loop stalled on an upload
+    "input_wait_s": 0.0,  # wall-clock the train loop spent in those stalls
+}
 
 
 def array_is_ready(x) -> bool:
@@ -39,11 +55,31 @@ def host_fetch(x):
     return np.asarray(x)
 
 
+def host_put(x, placer):
+    """Dispatch a deliberate host→device upload: ``placer(x)`` (a
+    ``device_put``/``make_global_batch`` closure), counted. The put itself is
+    async — dispatching it never blocks — so blocking is accounted on the
+    *consumer* side via :func:`record_input_wait`, not here."""
+    _stats["h2d_puts"] += 1
+    return placer(x)
+
+
+def record_input_wait(seconds: float):
+    """The training thread waited ``seconds`` for an input batch that was not
+    staged on device yet — one blocking host→device transfer from the hot
+    loop's point of view (the thing the prefetch depth exists to avoid)."""
+    _stats["h2d_blocking"] += 1
+    _stats["input_wait_s"] += float(seconds)
+
+
 def transfer_stats() -> dict:
-    """Snapshot of the counters: ``{"fetches": total, "blocking": stalls}``."""
+    """Snapshot of every counter (both directions)."""
     return dict(_stats)
 
 
 def reset_transfer_stats():
     _stats["fetches"] = 0
     _stats["blocking"] = 0
+    _stats["h2d_puts"] = 0
+    _stats["h2d_blocking"] = 0
+    _stats["input_wait_s"] = 0.0

@@ -1,0 +1,130 @@
+"""Curated XLA / libtpu latency-hiding flag presets.
+
+Once per-op efficiency is tuned, the next MFU points come from *overlap*:
+letting XLA's latency-hiding scheduler move collectives (and the grad
+all-reduce / fsdp reduce-scatter GSPMD inserted) behind compute instead of
+serializing them at their def-use sites. Those schedulers sit behind a set of
+``LIBTPU_INIT_ARGS`` flags that must be in the environment **before the TPU
+backend initializes** — which is why :class:`~..state.PartialState` installs
+the preset first thing, before the compilation cache, the distributed
+rendezvous, or any ``jax.default_backend()`` touch.
+
+The presets are additive token lists (each token ``--flag=value``):
+
+- ``latency`` — the latency-hiding scheduler plus async all-gather /
+  reduce-scatter / collective-permute / all-reduce fusion: the standard
+  overlap recipe for dp/fsdp training.
+- ``collective_matmul`` — everything in ``latency`` plus windowed-einsum
+  (collective matmul): tp/sp all-gathers are decomposed and overlapped with
+  the partial matmuls that consume them.
+
+Flags ride ``LIBTPU_INIT_ARGS`` (read by libtpu only), so installing a preset
+on a CPU/GPU rig is inert rather than a flag-parse crash — the selection is
+still echoed into telemetry snapshots so bench rows record what was asked.
+
+Selection surface: ``launch --xla_preset`` / ``ClusterConfig.xla_preset`` /
+``ACCELERATE_XLA_PRESET`` (see docs/performance.md "Dispatch amortization").
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+from .constants import ENV_XLA_PRESET
+
+logger = logging.getLogger(__name__)
+
+_LATENCY_TOKENS = (
+    "--xla_tpu_enable_latency_hiding_scheduler=true",
+    "--xla_enable_async_all_gather=true",
+    "--xla_enable_async_collective_permute=true",
+    "--xla_tpu_enable_async_collective_fusion=true",
+    "--xla_tpu_enable_async_collective_fusion_fuse_all_gather=true",
+    "--xla_tpu_enable_async_collective_fusion_multiple_steps=true",
+    "--xla_tpu_overlap_compute_collective_tc=true",
+    "--xla_tpu_enable_data_parallel_all_reduce_opt=true",
+    "--xla_tpu_data_parallel_opt_different_sized_ops=true",
+)
+
+XLA_PRESETS: dict[str, tuple[str, ...]] = {
+    "off": (),
+    "latency": _LATENCY_TOKENS,
+    "collective_matmul": _LATENCY_TOKENS + (
+        # Windowed einsum: decompose the tp/sp all-gather feeding a matmul and
+        # overlap each window's transfer with the previous window's compute.
+        "--xla_jf_spmd_threshold_for_windowed_einsum_mib=0",
+        "--xla_tpu_spmd_rewrite_einsum_with_reshape=true",
+    ),
+}
+
+_active_preset: str | None = None
+
+
+def active_preset() -> str | None:
+    """The preset installed in this process (None = none requested)."""
+    return _active_preset
+
+
+def _reset_active_preset():
+    """Test hook: forget the install record (env flags are left as-is)."""
+    global _active_preset
+    _active_preset = None
+
+
+def install_xla_preset(name: str) -> str | None:
+    """Merge the named preset's tokens into ``LIBTPU_INIT_ARGS`` (idempotent:
+    tokens already present — from an operator's own env or a previous install —
+    are kept, not duplicated, and an operator's explicit ``--flag=`` setting
+    wins over the preset's). Returns the installed name, or None for 'off'.
+
+    Must run before the first TPU backend touch in the process; installing
+    after is recorded (telemetry echoes the ask) but warned about, since
+    libtpu reads the variable once at init.
+    """
+    global _active_preset
+    key = (name or "").strip().lower()
+    if key in ("", "none"):
+        key = "off"
+    if key not in XLA_PRESETS:
+        raise ValueError(
+            f"unknown xla preset {name!r}; choose from {sorted(XLA_PRESETS)}"
+        )
+    if key == "off":
+        _active_preset = None
+        return None
+    existing = os.environ.get("LIBTPU_INIT_ARGS", "")
+    tokens = existing.split()
+    present_flags = {t.split("=", 1)[0] for t in tokens}
+    added = [
+        t for t in XLA_PRESETS[key] if t.split("=", 1)[0] not in present_flags
+    ]
+    if added:
+        os.environ["LIBTPU_INIT_ARGS"] = " ".join(tokens + added)
+    if _backend_already_initialized():
+        logger.warning(
+            "xla preset %r installed after the backend initialized; libtpu has "
+            "already read LIBTPU_INIT_ARGS — relaunch (or set the preset via "
+            "`launch --xla_preset` / ACCELERATE_XLA_PRESET) for it to apply.",
+            key,
+        )
+    _active_preset = key
+    return key
+
+
+def install_preset_from_env() -> str | None:
+    """The env-contract install ``PartialState`` runs at init (before backend
+    creation): ACCELERATE_XLA_PRESET names the preset; unset/empty = nothing."""
+    raw = os.environ.get(ENV_XLA_PRESET, "").strip()
+    if not raw:
+        return None
+    return install_xla_preset(raw)
+
+
+def _backend_already_initialized() -> bool:
+    try:
+        from jax._src import xla_bridge
+
+        return bool(getattr(xla_bridge, "_backends", None))
+    except Exception:
+        return False

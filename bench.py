@@ -288,6 +288,40 @@ def run_one(mode: str):
     ledger = get_ledger()
     ledger.reset()  # fresh goodput window per config
 
+    # Dispatch-amortization levers (docs/performance.md "Dispatch
+    # amortization"): BENCH_WINDOW=K runs the K-step fused train window
+    # (build_train_window) instead of the per-step fused program;
+    # BENCH_PREFETCH=N stages batches N ahead on a background thread
+    # (DeviceBatchPrefetcher). When either lever is engaged, the run executes
+    # a FIXED 8 warmup + 64 measured steps so rounds at different window
+    # sizes execute the same step sequence — identical final loss, and
+    # detail.dispatches compares directly round-over-round.
+    bench_window = int(os.environ.get("BENCH_WINDOW", "1") or 1)
+    bench_prefetch = int(os.environ.get("BENCH_PREFETCH", "0") or 0)
+    if bench_window < 1:
+        raise ValueError(f"BENCH_WINDOW must be >= 1, got {bench_window}")
+    amortized = "BENCH_WINDOW" in os.environ or bench_prefetch > 0
+    if amortized:
+        if 64 % bench_window or (bench_window <= 8 and 8 % bench_window):
+            # A window that does not divide the fixed 8+64 budget would run a
+            # DIFFERENT step sequence than other window sizes — final_loss and
+            # detail.dispatches stop being comparable round-over-round.
+            raise ValueError(
+                f"BENCH_WINDOW={bench_window} must divide the fixed 64 measured "
+                "steps (and 8 warmup steps when <= 8): use 1, 2, 4, 8, 16, 32 or 64."
+            )
+        warmup_disp = max(8 // bench_window, 1)
+        meas_disp = max(64 // bench_window, 1)
+        if bench_window > 8:
+            print(
+                f"# BENCH_WINDOW={bench_window}: warmup is one dispatch = "
+                f"{bench_window} steps (not 8); final_loss compares only "
+                "against rounds at the same window size.",
+                file=sys.stderr,
+            )
+    else:
+        warmup_disp, meas_disp = warmup, steps
+
     accelerator = Accelerator(mixed_precision="bf16")
     accelerator.telemetry.timeline.reset()  # fresh step-timeline window too
     if mode == "moe":
@@ -306,26 +340,54 @@ def run_one(mode: str):
         else optax.adamw(3e-4)
     )
     pmodel, popt = accelerator.prepare(model, tx)
-    step = accelerator.build_train_step(pmodel, popt)
+    if bench_window > 1:
+        step = accelerator.build_train_window(pmodel, popt, window=bench_window)
+    else:
+        step = accelerator.build_train_step(pmodel, popt)
 
     ids = np.random.default_rng(0).integers(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
     data = {"input_ids": ids, "labels": ids}
 
+    if bench_prefetch > 0:
+        from accelerate_tpu.data_loader import DeviceBatchPrefetcher
+
+        def _stream(n=(warmup_disp + meas_disp) * bench_window):
+            for _ in range(n):
+                yield data
+
+        _batches = iter(DeviceBatchPrefetcher(
+            _stream(), mesh=accelerator.mesh,
+            prefetch=bench_prefetch, window=bench_window,
+        ))
+        next_batch = lambda: next(_batches)  # noqa: E731
+    elif bench_window > 1:
+        window_data = {k: np.stack([v] * bench_window) for k, v in data.items()}
+        next_batch = lambda: window_data  # noqa: E731
+    else:
+        next_batch = lambda: data  # noqa: E731
+
+    def _sync(x):
+        # Hard host sync (block_until_ready does not block through axon);
+        # under windowed dispatch x is the per-step K-vector — last element
+        # is the newest step's loss.
+        return float(np.asarray(jax.device_get(x)).reshape(-1)[-1])
+
     t_compile = time.perf_counter()
     with ledger.track("compile"):
-        loss = step(data)
-        float(loss)
+        loss = step(next_batch())
+        _sync(loss)
     # First step ≈ trace + XLA compile (+ one step): the number the persistent
     # compilation cache (ACCELERATE_COMPILE_CACHE_DIR) collapses on re-runs.
     compile_s = time.perf_counter() - t_compile
-    for _ in range(warmup - 1):
-        loss = step(data)
-    float(loss)  # hard host sync: block_until_ready does not block through axon
+    for _ in range(warmup_disp - 1):
+        loss = step(next_batch())
+    _sync(loss)
     t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = step(data)
-    final_loss = float(loss)  # sync end of timed region
+    for _ in range(meas_disp):
+        loss = step(next_batch())
+    final_loss = _sync(loss)  # sync end of timed region
     dt = time.perf_counter() - t0
+    steps = meas_disp * bench_window  # measured steps this config actually ran
     ledger.record_step(dt, steps=steps)
 
     # Which attention kernel 'auto' resolved to at this shape (driver-visible
@@ -385,6 +447,18 @@ def run_one(mode: str):
                     ),
                     "attention_impl": resolved_impl,
                     "compile_s": round(compile_s, 2),
+                    # Dispatch amortization: program dispatches this config's
+                    # timeline saw (compile+warmup+measured; K-step windows
+                    # count once) and the wall-clock the train loop spent
+                    # blocked on input transfers — the two numbers the
+                    # BENCH_WINDOW / BENCH_PREFETCH levers exist to shrink.
+                    "dispatches": telemetry_summary["dispatches"],
+                    "input_wait_s": telemetry_summary["transfers"]["input_wait_s"],
+                    **(
+                        {"train_window": bench_window, "prefetch": bench_prefetch}
+                        if amortized
+                        else {}
+                    ),
                     # Wall-clock classification for this config's window
                     # (resilience/goodput.py): productive step time vs
                     # compile / checkpoint / restart / rollback / hang

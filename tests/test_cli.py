@@ -265,6 +265,9 @@ def test_config_wizard_roundtrips_through_launch(tmp_path):
         "yes",               # always-on telemetry
         "0",                 # metrics port (0 = no HTTP endpoint)
         "1.8",               # straggler alert ratio
+        "yes",               # configure dispatch amortization?
+        "4",                 # train window K
+        "latency",           # xla latency-hiding preset
         "yes",               # configure tracking?
         "json",              # trackers
         "yes",               # persistent compilation cache?
@@ -280,6 +283,7 @@ def test_config_wizard_roundtrips_through_launch(tmp_path):
     assert cfg.guard_numerics and cfg.spike_zscore == 7.0 and cfg.hang_timeout == 240.0
     assert cfg.telemetry is True and cfg.metrics_port == 0
     assert cfg.straggler_threshold == 1.8
+    assert cfg.train_window == 4 and cfg.xla_preset == "latency"
     assert cfg.compile_cache_dir == str(tmp_path / "xla_cache")
     config_path = tmp_path / "cfg.yaml"
     cfg.to_yaml_file(str(config_path))
@@ -306,6 +310,13 @@ def test_config_wizard_roundtrips_through_launch(tmp_path):
         "assert acc.telemetry.straggler.slow_ratio == 1.8\n"
         "assert os.environ.get('ACCELERATE_SPIKE_ZSCORE') == '7.0'\n"
         "assert acc.health_guard.spike.zscore == 7.0\n"
+        "assert os.environ.get('ACCELERATE_TRAIN_WINDOW') == '4'\n"
+        "assert acc.train_window == 4\n"
+        "assert os.environ.get('ACCELERATE_XLA_PRESET') == 'latency'\n"
+        "from accelerate_tpu.utils.xla_flags import active_preset\n"
+        "assert active_preset() == 'latency'\n"
+        "assert '--xla_tpu_enable_latency_hiding_scheduler=true' in "
+        "os.environ.get('LIBTPU_INIT_ARGS', '')\n"
         "from accelerate_tpu.health.hang import get_default_watchdog\n"
         "assert get_default_watchdog() is not None\n"
         "assert get_default_watchdog().timeout_s == 240.0\n"

@@ -102,6 +102,10 @@ def test_complete_cv_example_step_checkpointing(tmp_path):
         ("by_feature/pipeline_training.py", ["--pp", 2, "--microbatches", 4, "--num_steps", 4,
                                              "--schedule", "1f1b"]),
         ("by_feature/multi_slice_dcn.py", ["--slices", 2, "--tp", 2, "--num_steps", 4]),
+        # default --prefetch covers the toy epoch: the compute-free demo model
+        # gives the producer no device time to hide uploads in, so a shallower
+        # depth re-arms the example's h2d_blocking==0 assert as a load flake.
+        ("by_feature/dispatch_amortized_training.py", ["--window", 4]),
     ],
 )
 def test_by_feature_examples(script, args, tmp_path):

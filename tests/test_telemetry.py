@@ -119,7 +119,10 @@ def test_fused_loop_timeline_zero_blocking_transfers():
     reset_transfer_stats()
     for i in range(1, 9):
         step(_batch(i))
-    assert transfer_stats() == {"fetches": 0, "blocking": 0}  # hot loop async
+    assert transfer_stats() == {
+        "fetches": 0, "blocking": 0,  # hot loop async
+        "h2d_puts": 0, "h2d_blocking": 0, "input_wait_s": 0.0,  # no prefetcher in play
+    }
     timeline = accelerator.telemetry.timeline
     assert timeline.count == 7  # first boundary is the compile baseline
     summary = timeline.summary()
@@ -187,6 +190,15 @@ def test_on_step_dedupes_same_step_hooks():
     fused.on_fused_step()
     fused.on_step(2)
     assert fused.timeline.count == 1
+
+    # Fallback feed under windowed hooks: a loop whose own fused program does
+    # NOT feed the timeline still gets K per-step samples per K-step boundary,
+    # and a retained per-step K-vector of losses drains to its last element.
+    windowed = Telemetry(registry=MetricsRegistry())
+    windowed.on_step(4, window=4)  # baseline boundary
+    windowed.on_step(8, window=4, loss=np.arange(4.0))
+    assert windowed.timeline.count == 4
+    assert windowed.timeline.summary()["last_loss"] == 3.0
 
 
 def test_mfu_estimate_matches_known_flops():
@@ -298,6 +310,12 @@ def test_straggler_report_single_host():
     monitor = StragglerMonitor(every_steps=4, slow_ratio=1.5,
                                registry=MetricsRegistry())
     assert not monitor.due(3) and monitor.due(4)
+    # Windowed boundaries advance by K: the exchange is due when ANY in-window
+    # step crossed the cadence, not only when the boundary itself lands on it
+    # (every_steps=4, window=3 → boundaries 3, 6, 9, 12: step 4 is inside the
+    # [4..6] window, step 8 inside [7..9], neither boundary divides 4).
+    assert monitor.due(6, window=3) and monitor.due(9, window=3)
+    assert not monitor.due(3, window=3)
 
     class _State:
         num_processes, process_index = 1, 0

@@ -1,0 +1,650 @@
+"""Dispatch-amortization tests — K-step fused train windows, the async
+device-batch prefetcher, and the XLA latency-hiding preset surface (ISSUE 5
+acceptance: window=1 and window=8 are BIT-exact vs the unwindowed fused step
+in params/opt-state/RNG/step; the prefetched steady-state loop records ZERO
+blocking transfers in both directions; a mid-run checkpoint at a window
+boundary resumes bit-exact; a NaN injected at in-window step k trips the
+guard, rolls back, and quarantines exactly step k; stale-config changes to
+gradient_accumulation_steps or train_window raise pointed errors).
+
+All deterministic and CPU-fast: the model is the scalar RegressionModel,
+seeds are pinned in conftest, faults come from the fault-plan grammar."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import optax
+
+from accelerate_tpu import Accelerator, DeviceBatchPrefetcher
+from accelerate_tpu.data_loader import prepare_data_loader
+from accelerate_tpu.test_utils import RegressionModel
+from accelerate_tpu.utils.transfer import reset_transfer_stats, transfer_stats
+
+pytestmark = pytest.mark.window
+
+
+@pytest.fixture(autouse=True)
+def _reset_plan():
+    yield
+    from accelerate_tpu.resilience import reset_active_plan
+
+    reset_active_plan()
+
+
+# ---------------------------------------------------------------- harness
+def _build(**kwargs):
+    from accelerate_tpu.state import AcceleratorState, GradientState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    accelerator = Accelerator(**kwargs)
+    model = RegressionModel()
+    model.init_params(None)
+    pmodel, popt = accelerator.prepare(model, optax.adam(0.1))
+    return accelerator, pmodel, popt
+
+
+def _batch(step):
+    rng = np.random.default_rng(100 + step)
+    x = rng.normal(size=(8,)).astype(np.float32)
+    return {"x": x, "y": (2.0 * x + 3.0).astype(np.float32)}
+
+
+def _window_batch(steps):
+    return jax.tree_util.tree_map(lambda *xs: np.stack(xs), *[_batch(s) for s in steps])
+
+
+def _final_state(accelerator, pmodel, popt):
+    params = {k: np.asarray(v) for k, v in accelerator.get_state_dict(pmodel).items()}
+    opt = [np.asarray(jax.device_get(l)) for l in jax.tree_util.tree_leaves(popt.opt_state)]
+    return params, opt, accelerator.step, pmodel.handle.step_counter
+
+
+def _assert_bit_exact(state_a, state_b):
+    params_a, opt_a, step_a, rngc_a = state_a
+    params_b, opt_b, step_b, rngc_b = state_b
+    assert step_a == step_b
+    assert rngc_a == rngc_b  # RNG fold-in counter: identical streams
+    for key in params_a:
+        assert np.array_equal(params_a[key], params_b[key]), key
+    assert len(opt_a) == len(opt_b)
+    for la, lb in zip(opt_a, opt_b):
+        assert np.array_equal(la, lb)
+
+
+# ----------------------------------------------------------- window parity
+@pytest.mark.parametrize("accum", [1, 2])
+def test_window_1_and_8_bit_exact_vs_unwindowed(accum):
+    """window=1 and window=8 run the SAME math as 8 sequential fused steps:
+    params, optimizer moments, the RNG fold-in counter, and every per-step
+    loss are bit-identical — the amortization is free of semantic drift,
+    including under gradient accumulation."""
+    total = 8
+    acc, pm, po = _build(gradient_accumulation_steps=accum)
+    step = acc.build_train_step(pm, po)
+    ref_losses = [float(step(_batch(s))) for s in range(1, total + 1)]
+    acc.step = total
+    reference = _final_state(acc, pm, po)
+
+    acc, pm, po = _build(gradient_accumulation_steps=accum)
+    w1 = acc.build_train_window(pm, po, window=1)
+    w1_losses = [float(np.asarray(w1(_window_batch([s])))[0]) for s in range(1, total + 1)]
+    acc.step = total
+    _assert_bit_exact(reference, _final_state(acc, pm, po))
+    assert w1_losses == ref_losses
+
+    acc, pm, po = _build(gradient_accumulation_steps=accum)
+    w8 = acc.build_train_window(pm, po, window=8)
+    losses = np.asarray(w8(_window_batch(range(1, total + 1))))
+    acc.step = total
+    _assert_bit_exact(reference, _final_state(acc, pm, po))
+    assert losses.shape == (8,)
+    assert [float(l) for l in losses] == ref_losses
+
+
+def test_window_retains_losses_and_feeds_timeline_per_step():
+    """One window dispatch = one timeline boundary but K per-step samples;
+    the K losses stay retained (no fetch, no stall) until summary() drains
+    them, and `dispatches` counts programs, not steps."""
+    acc, pm, po = _build()
+    timeline = acc.telemetry.timeline
+    timeline.reset()
+    w = acc.build_train_window(pm, po, window=4)
+    reset_transfer_stats()
+    for chunk in range(3):
+        w(_window_batch(range(1 + 4 * chunk, 5 + 4 * chunk)))
+    assert transfer_stats()["blocking"] == 0
+    summary = timeline.summary()
+    assert summary["dispatches"] == 3
+    assert summary["steps"] == 8  # first boundary is baseline-only
+    assert summary["last_loss"] is not None
+    assert summary["transfers"]["blocking"] == 0
+
+
+def test_window_batch_leading_axis_validated():
+    acc, pm, po = _build()
+    w = acc.build_train_window(pm, po, window=4)
+    with pytest.raises(ValueError, match="leading K axis"):
+        w(_batch(1))  # unstacked batch: leading dim 8, not 4
+    # window=1 names the right remedy: DeviceBatchPrefetcher(window=1) feeds
+    # build_train_step (plain batches), not a K=1 window program.
+    acc, pm, po = _build()
+    w1 = acc.build_train_window(pm, po, window=1)
+    with pytest.raises(ValueError, match="build_train_step"):
+        w1(_batch(1))
+
+
+# ------------------------------------------------------- stale-config guard
+def test_stale_accum_error_fires_from_windowed_program():
+    acc, pm, po = _build(gradient_accumulation_steps=2)
+    w = acc.build_train_window(pm, po, window=2)
+    w(_window_batch([1, 2]))
+    acc.gradient_accumulation_steps = 4
+    with pytest.raises(RuntimeError, match="gradient_accumulation_steps changed"):
+        w(_window_batch([3, 4]))
+
+
+def test_stale_window_error_fires_after_change():
+    acc, pm, po = _build()
+    w = acc.build_train_window(pm, po, window=2)
+    assert acc.train_window == 2  # build pins the accelerator-level knob
+    w(_window_batch([1, 2]))
+    acc.train_window = 4
+    with pytest.raises(RuntimeError, match="train_window changed"):
+        w(_window_batch([3, 4]))
+
+
+def test_train_window_env_default(monkeypatch):
+    monkeypatch.setenv("ACCELERATE_TRAIN_WINDOW", "4")
+    acc, pm, po = _build()
+    assert acc.train_window == 4
+    w = acc.build_train_window(pm, po)  # window=None → env default
+    assert w.window == 4
+    with pytest.raises(ValueError):
+        acc.train_window = 0
+
+
+def test_train_window_env_validated(monkeypatch):
+    """The lazy env read enforces the same >=1 contract as the setter, and a
+    non-numeric value gets a pointed error naming the variable — not a bare
+    int() traceback from deep inside a build."""
+    monkeypatch.setenv("ACCELERATE_TRAIN_WINDOW", "0")
+    acc, _, _ = _build()
+    with pytest.raises(ValueError, match="must be >= 1"):
+        acc.train_window
+    acc._train_window = None
+    monkeypatch.setenv("ACCELERATE_TRAIN_WINDOW", "8x")
+    with pytest.raises(ValueError, match="not an integer"):
+        acc.train_window
+
+
+def test_rebuild_mid_accumulation_zeroes_partial_buffer():
+    """A (re)build restarts the compiled program's accumulation state: the
+    device micro-step count seeds at 0, so a partially-filled grad buffer
+    from a prior build must be discarded — otherwise the new program's first
+    boundary would silently fold the orphaned microbatches into its update."""
+    acc, pm, po = _build(gradient_accumulation_steps=2)
+    step = acc.build_train_step(pm, po)
+    step(_batch(99))  # 1 of 2 micro-steps: buffer holds a partial grad sum
+    assert any(np.any(np.asarray(l)) for l in jax.tree_util.tree_leaves(po._accum_grads))
+    acc.build_train_window(pm, po, window=2)  # rebuild discards the partial sum
+    assert all(
+        not np.any(np.asarray(l)) for l in jax.tree_util.tree_leaves(po._accum_grads)
+    )
+
+
+# ------------------------------------------------------------- prefetcher
+def test_prefetcher_steady_state_zero_blocking_both_directions():
+    """The acceptance bar: a windowed+prefetched steady-state loop records
+    zero blocking transfers in BOTH directions — every input was staged
+    before the loop asked (h2d) and no retained loss was force-fetched
+    (d2h). The H2D puts themselves are counted, so zero-blocking is a
+    measured property of a loop that did real uploads."""
+    acc, pm, po = _build()
+    acc.telemetry.timeline.reset()
+    w = acc.build_train_window(pm, po, window=2)
+    loader = prepare_data_loader([_batch(s) for s in range(1, 17)])
+    prefetcher = DeviceBatchPrefetcher(loader, prefetch=2, window=2)
+    reset_transfer_stats()
+    n = 0
+    for window_batch in prefetcher:
+        losses = w(window_batch)
+        n += 1
+    assert n == 8
+    stats = transfer_stats()
+    assert stats["h2d_puts"] == 8
+    assert stats["h2d_blocking"] == 0, stats
+    assert stats["input_wait_s"] == 0.0
+    summary = acc.telemetry.timeline.summary()
+    assert summary["transfers"]["blocking"] == 0
+    assert summary["transfers"]["h2d_blocking"] == 0
+    assert float(np.asarray(losses)[-1]) < 20.0  # it actually trained
+
+
+def test_prefetcher_starved_consumer_counts_input_waits():
+    """A producer slower than the consumer IS a blocking input path — the
+    counters must say so (the inverse of the zero-blocking claim)."""
+    import time
+
+    def slow_stream():
+        for s in range(1, 7):
+            time.sleep(0.05)
+            yield _batch(s)
+
+    _build()  # mesh/state singletons
+    prefetcher = DeviceBatchPrefetcher(slow_stream(), prefetch=1, window=1)
+    reset_transfer_stats()
+    consumed = list(prefetcher)
+    assert len(consumed) == 6
+    stats = transfer_stats()
+    assert stats["h2d_puts"] == 6
+    # The FIRST batch is pipeline fill (excluded); the rest all starved.
+    assert stats["h2d_blocking"] >= 4, stats
+    assert stats["input_wait_s"] > 0.0
+
+
+def test_prefetcher_window_stacks_and_drops_tail():
+    _build()
+    loader = prepare_data_loader([_batch(s) for s in range(1, 8)])  # 7 batches
+    prefetcher = DeviceBatchPrefetcher(loader, prefetch=2, window=3)
+    windows = list(prefetcher)
+    assert len(windows) == 2  # 7 = 2 full windows + dropped tail of 1
+    for wb in windows:
+        assert wb["x"].shape == (3, 8)
+        assert isinstance(wb["x"], jax.Array)
+    assert len(prefetcher) == 2
+
+
+def test_prefetcher_mixed_batch_uploads_only_host_leaves():
+    """A batch with SOME leaves already device-resident uploads only the host
+    leaves; the device leaves pass through as the SAME buffer — never
+    round-tripped through np.asarray (a blocking, uncounted D2H readback)."""
+    _build()
+    staged = jax.device_put(np.ones((8,), np.float32))
+
+    def stream():
+        for s in range(1, 4):
+            yield {"x": staged, "y": _batch(s)["y"]}
+
+    prefetcher = DeviceBatchPrefetcher(stream(), prefetch=1, window=1)
+    reset_transfer_stats()
+    out = list(prefetcher)
+    assert len(out) == 3
+    assert transfer_stats()["h2d_puts"] == 3  # the host leaf is still counted
+    for wb in out:
+        assert wb["x"] is staged  # pass-through, no readback or re-upload
+        assert isinstance(wb["y"], jax.Array)
+
+
+def test_prefetcher_window_stack_handles_mixed_slots():
+    """A leaf that is host in one window slot and device in another must
+    stack on device (jnp.stack accepts mixed inputs) — np.asarray on the
+    device slot would be a blocking, uncounted readback."""
+    _build()
+    staged = jax.device_put(np.ones((8,), np.float32))
+
+    def stream():
+        for s in range(1, 5):
+            b = _batch(s)
+            yield {"x": staged if s % 2 else b["x"], "y": b["y"]}
+
+    prefetcher = DeviceBatchPrefetcher(stream(), prefetch=1, window=4)
+    out = list(prefetcher)
+    assert len(out) == 1
+    for key in ("x", "y"):
+        assert isinstance(out[0][key], jax.Array)
+        assert out[0][key].shape == (4, 8)
+
+
+# ------------------------------------------------- mid-window resume drill
+def test_midwindow_checkpoint_resume_bit_exact(tmp_path):
+    """Preemption at a window boundary mid-epoch: checkpoint (including the
+    prefetcher's consumer position and the sampler-RNG contract), rebuild
+    everything from disk, finish — final state bit-exact vs the uninterrupted
+    windowed run. Staged-but-unconsumed read-ahead must be replayed, not
+    lost."""
+    K, total_windows = 2, 6
+    batches = [_batch(s) for s in range(1, K * total_windows + 1)]
+
+    def run(until=None):
+        acc, pm, po = _build()
+        w = acc.build_train_window(pm, po, window=K)
+        loader = prepare_data_loader(list(batches))
+        prefetcher = DeviceBatchPrefetcher(loader, prefetch=2, window=K)
+        chunk = 0
+        for window_batch in prefetcher:
+            w(window_batch)
+            chunk += 1
+            acc.step = chunk * K
+            if until is not None and chunk == until:
+                return acc, pm, po, prefetcher
+        return acc, pm, po, prefetcher
+
+    # Uninterrupted reference.
+    ref_acc, ref_pm, ref_po, _ = run()
+    reference = _final_state(ref_acc, ref_pm, ref_po)
+
+    # Interrupted at window 3 of 6: checkpoint params/opt + loader position.
+    acc, pm, po, prefetcher = run(until=3)
+    ckpt = tmp_path / "ckpt"
+    acc.register_for_checkpointing(prefetcher)
+    acc.save_state(str(ckpt))
+    acc.finish_pending_saves()
+    interrupted_sd = prefetcher.state_dict()
+    assert interrupted_sd["num_batches_fetched"] == 3 * K  # consumer, not producer
+
+    # Fresh build, restore, finish the epoch.
+    acc2, pm2, po2 = _build()
+    w2 = acc2.build_train_window(pm2, po2, window=K)
+    loader2 = prepare_data_loader(list(batches))
+    prefetcher2 = DeviceBatchPrefetcher(loader2, prefetch=2, window=K)
+    acc2.register_for_checkpointing(prefetcher2)
+    acc2.load_state(str(ckpt))
+    assert pm2.handle.step_counter == 3 * K
+    chunk = 3
+    for window_batch in prefetcher2:
+        w2(window_batch)
+        chunk += 1
+        acc2.step = chunk * K
+    assert chunk == total_windows
+    _assert_bit_exact(reference, _final_state(acc2, pm2, po2))
+
+
+def test_prefetcher_epoch_tail_checkpoint_keeps_epoch_identity():
+    """Deep read-ahead can finish the wrapped shard's epoch — its epilogue
+    advances `iteration` and drops the epoch RNG — while staged windows are
+    still unconsumed. A checkpoint there must keep the CONSUMER's epoch
+    identity so the remaining batches of THIS epoch replay on resume, not a
+    skip into the next epoch's order."""
+    import time
+
+    _build()
+    K, n = 2, 12
+    batches = [_batch(s) for s in range(1, n + 1)]
+    loader = prepare_data_loader(list(batches))
+    prefetcher = DeviceBatchPrefetcher(loader, prefetch=8, window=K)
+    it = iter(prefetcher)
+    for _ in range(3):  # consume 3 of 6 windows
+        next(it)
+    # The queue (depth 8) holds the whole epoch: wait for the producer to run
+    # the shard's epilogue under the still-mid-epoch consumer.
+    deadline = time.monotonic() + 5.0
+    while loader.iteration == 0 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert loader.iteration == 1  # the epilogue DID run...
+    sd = prefetcher.state_dict()
+    assert sd["num_batches_fetched"] == 3 * K
+    assert sd["iteration"] == 0  # ...but the checkpoint names the consumer's epoch
+    it.close()
+
+    loader2 = prepare_data_loader(list(batches))
+    prefetcher2 = DeviceBatchPrefetcher(loader2, prefetch=8, window=K)
+    prefetcher2.load_state_dict(sd)
+    remaining = list(prefetcher2)
+    assert len(remaining) == 3
+    for wi, wb in enumerate(remaining):
+        for k in range(K):
+            expect = _batch(7 + wi * K + k)["x"]
+            np.testing.assert_array_equal(np.asarray(wb["x"][k]), expect)
+
+
+def test_prefetcher_load_state_dict_clears_stale_epoch_identity():
+    """Same-process restore (auto-resume, guard rollback): a partial
+    iteration snapshotted epoch A's identity; loading a checkpoint from a
+    different epoch must retire it, or the next state_dict() would overlay
+    epoch A's iteration/RNG onto the restored position."""
+    import time
+
+    _build()
+    loader = prepare_data_loader([_batch(s) for s in range(1, 13)])
+    prefetcher = DeviceBatchPrefetcher(loader, prefetch=8, window=2)
+    it = iter(prefetcher)
+    next(it)  # producer runs: epoch-0 identity snapshotted
+    deadline = time.monotonic() + 5.0
+    while prefetcher._epoch_identity is None and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert prefetcher._epoch_identity is not None
+    it.close()
+    prefetcher.load_state_dict({"num_batches_fetched": 4, "iteration": 2})
+    sd = prefetcher.state_dict()
+    assert sd["iteration"] == 2 and sd["num_batches_fetched"] == 4
+
+
+def test_prefetcher_abandoned_at_exit_is_quiet(tmp_path):
+    """An abandoned prefetcher iterator finalized at interpreter shutdown must
+    not spew 'Exception ignored': the generator's cleanup runs after its local
+    `queue` module reference is torn down, so the drain's except clause must
+    not resolve the module at that point."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "abandon.py"
+    script.write_text(
+        "import numpy as np\n"
+        "from accelerate_tpu.data_loader import DeviceBatchPrefetcher\n"
+        "batches = [{'x': np.ones((4,), np.float32)} for _ in range(32)]\n"
+        "it = iter(DeviceBatchPrefetcher(batches, prefetch=2, window=1))\n"
+        "next(it)\n"  # start the producer, then abandon the generator
+    )
+    result = subprocess.run(
+        [sys.executable, str(script)], capture_output=True, text=True,
+        env={**os.environ, "PYTHONPATH": repo},
+    )
+    assert result.returncode == 0, result.stderr
+    assert "Exception ignored" not in result.stderr, result.stderr
+
+
+def test_prefetcher_state_dict_drops_producer_base_state():
+    """A stateful wrapped loader snapshots its base at the PRODUCER's
+    read-ahead position; passing that through would override the consumer
+    rewrite on resume (DataLoaderShard restores base_state and skips NO
+    batches), silently losing staged-but-unconsumed read-ahead. The
+    prefetcher must strip it so the consumer-count skip-replay path wins."""
+
+    class StatefulStub:
+        def __init__(self, batches):
+            self._batches = batches
+            self.fetched = 0
+
+        def __iter__(self):
+            for b in self._batches:
+                self.fetched += 1
+                yield b
+
+        def __len__(self):
+            return len(self._batches)
+
+        def state_dict(self):
+            return {
+                "num_batches_fetched": self.fetched,  # producer position
+                "base_state": {"producer_pos": self.fetched},
+                "sampler_rng": b"rng-snapshot",
+            }
+
+        def load_state_dict(self, sd):
+            pass
+
+    _build()  # mesh/state singletons
+    stub = StatefulStub([_batch(s) for s in range(1, 9)])
+    prefetcher = DeviceBatchPrefetcher(stub, prefetch=4, window=2)
+    it = iter(prefetcher)
+    next(it)  # one window consumed; producer has read further ahead
+    sd = prefetcher.state_dict()
+    assert "base_state" not in sd
+    assert sd["num_batches_fetched"] == 2  # consumer, not stub.fetched
+    assert sd["sampler_rng"] == b"rng-snapshot"  # RNG contract passes through
+    for _ in it:
+        pass
+
+
+# ------------------------------------------------- guarded windowed drill
+def test_guard_nan_at_in_window_step_trips_rolls_back_quarantines():
+    """A NaN injected at in-window step k (fault plan step:5=nan, window=2 →
+    slot 0 of the third window) trips the guard, rolls back to the
+    last-known-good snapshot, and quarantines exactly step 5; the replay that
+    skips the poisoned step lands BIT-exact on a clean run that never saw
+    it."""
+    from accelerate_tpu.resilience import FaultPlan, set_active_plan
+
+    K, total = 2, 13  # {6..13} refills whole windows after the skip of 5
+
+    acc, pm, po = _build()
+    guard = acc.configure_health(snapshot_every=2, spike_zscore=0)
+    w = acc.build_train_window(pm, po, window=K)
+    set_active_plan(FaultPlan.parse("step:5=nan"))
+    trips = []
+    while acc.step < total:
+        steps, s = [], acc.step
+        while len(steps) < K:
+            s += 1
+            if guard.should_skip(s):
+                continue
+            steps.append(s)
+        losses = w(_window_batch(steps))
+        acc.step = steps[-1]
+        verdict = acc.guard_step(losses, step=acc.step, window=K)
+        if verdict.tripped:
+            trips.append(verdict)
+    assert len(trips) == 1
+    assert trips[0].quarantined_step == 5  # the exact in-window step
+    assert trips[0].rolled_back and trips[0].action == "rollback"
+    assert guard.should_skip(5)
+    guarded = _final_state(acc, pm, po)
+
+    # Clean unwindowed run that pre-quarantined step 5.
+    acc2, pm2, po2 = _build()
+    step = acc2.build_train_step(pm2, po2)
+    while acc2.step < total:
+        s = acc2.step + 1
+        if s != 5:
+            step(_batch(s))
+        acc2.step = s
+    _assert_bit_exact(_final_state(acc2, pm2, po2), guarded)
+
+
+# ------------------------------------------------------- xla preset surface
+def test_xla_preset_merges_libtpu_args_idempotently(monkeypatch):
+    from accelerate_tpu.utils import xla_flags
+
+    monkeypatch.setenv(
+        "LIBTPU_INIT_ARGS",
+        "--xla_tpu_enable_latency_hiding_scheduler=false --xla_custom=1",
+    )
+    xla_flags._reset_active_preset()
+    assert xla_flags.install_xla_preset("latency") == "latency"
+    args = os.environ["LIBTPU_INIT_ARGS"].split()
+    # The operator's explicit setting wins; preset tokens appended once.
+    assert "--xla_tpu_enable_latency_hiding_scheduler=false" in args
+    assert "--xla_tpu_enable_latency_hiding_scheduler=true" not in args
+    assert "--xla_enable_async_all_gather=true" in args
+    assert "--xla_custom=1" in args
+    before = os.environ["LIBTPU_INIT_ARGS"]
+    xla_flags.install_xla_preset("latency")  # idempotent
+    assert os.environ["LIBTPU_INIT_ARGS"] == before
+    assert xla_flags.active_preset() == "latency"
+    # collective_matmul is a strict superset of latency.
+    assert set(xla_flags.XLA_PRESETS["latency"]) < set(
+        xla_flags.XLA_PRESETS["collective_matmul"]
+    )
+    xla_flags._reset_active_preset()
+
+
+def test_xla_preset_rejects_unknown_and_echoes_into_telemetry(monkeypatch):
+    from accelerate_tpu.utils import xla_flags
+
+    with pytest.raises(ValueError, match="unknown xla preset"):
+        xla_flags.install_xla_preset("warp_speed")
+    xla_flags._reset_active_preset()
+    xla_flags.install_xla_preset("latency")
+    try:
+        acc, _, _ = _build()
+        assert acc.telemetry.timeline.summary()["xla_preset"] == "latency"
+    finally:
+        xla_flags._reset_active_preset()
+
+
+def test_launch_exports_window_and_preset_env():
+    from accelerate_tpu.commands.config_args import ClusterConfig
+    from accelerate_tpu.commands.launch import prepare_launch_env
+
+    cfg = ClusterConfig(train_window=8, xla_preset="collective_matmul")
+    env = prepare_launch_env(cfg)
+    assert env["ACCELERATE_TRAIN_WINDOW"] == "8"
+    assert env["ACCELERATE_XLA_PRESET"] == "collective_matmul"
+    # window=1 / preset off export nothing (library defaults apply).
+    env = prepare_launch_env(ClusterConfig())
+    assert "ACCELERATE_TRAIN_WINDOW" not in env
+    assert "ACCELERATE_XLA_PRESET" not in env
+
+
+def test_launch_explicit_off_beats_inherited_env(monkeypatch):
+    """prepare_launch_env forwards the operator's environment; an explicit
+    --train_window 1 / --xla_preset off must REMOVE a stale inherited value,
+    not silently forward it to every worker."""
+    from accelerate_tpu.commands.config_args import ClusterConfig
+    from accelerate_tpu.commands.launch import prepare_launch_env
+
+    monkeypatch.setenv("ACCELERATE_TRAIN_WINDOW", "8")
+    monkeypatch.setenv("ACCELERATE_XLA_PRESET", "latency")
+    env = prepare_launch_env(ClusterConfig(train_window=1, xla_preset="off"))
+    assert "ACCELERATE_TRAIN_WINDOW" not in env
+    assert "ACCELERATE_XLA_PRESET" not in env
+    # ...but with no explicit flag the inherited values still flow through.
+    env = prepare_launch_env(ClusterConfig())
+    assert env["ACCELERATE_TRAIN_WINDOW"] == "8"
+    assert env["ACCELERATE_XLA_PRESET"] == "latency"
+
+
+def test_wizard_dispatch_section_tristate(monkeypatch):
+    """Declining the wizard's dispatch-amortization section leaves
+    train_window/xla_preset UNSPECIFIED (None/'') so an inherited env var
+    still flows at launch; opening the section and accepting the defaults
+    (1 / 'off') is an EXPLICIT choice that scrubs stale inherited values."""
+    from unittest import mock
+
+    from accelerate_tpu.commands.config import get_user_input
+    from accelerate_tpu.commands.launch import prepare_launch_env
+
+    def run(section, window, preset):
+        def fake_input(prompt=""):
+            if "dispatch amortization" in prompt:
+                return section
+            if "train window K" in prompt:
+                return window
+            if "latency-hiding preset" in prompt:
+                return preset
+            return ""  # every other question: accept the default
+
+        with mock.patch("builtins.input", fake_input):
+            return get_user_input()
+
+    cfg = run("no", "", "")
+    assert cfg.train_window is None and cfg.xla_preset == ""
+    cfg = run("yes", "", "")  # open the section, accept defaults 1 / 'off'
+    assert cfg.train_window == 1 and cfg.xla_preset == "off"
+    monkeypatch.setenv("ACCELERATE_TRAIN_WINDOW", "8")
+    monkeypatch.setenv("ACCELERATE_XLA_PRESET", "latency")
+    env = prepare_launch_env(cfg)
+    assert "ACCELERATE_TRAIN_WINDOW" not in env
+    assert "ACCELERATE_XLA_PRESET" not in env
+
+
+def test_launch_validates_window_and_preset(tmp_path):
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "noop.py"
+    script.write_text("print('ok')\n")
+    for flags in (["--train_window", "0"], ["--xla_preset", "warp_speed"]):
+        result = subprocess.run(
+            [sys.executable, "-m", "accelerate_tpu.commands.launch", "--cpu",
+             *flags, str(script)],
+            capture_output=True, text=True, cwd=repo,
+            env={**os.environ, "PYTHONPATH": repo},
+        )
+        assert result.returncode != 0
