@@ -451,6 +451,10 @@ class Accelerator:
         self.flag_tensor = None
         self._train_window = None  # lazy: ACCELERATE_TRAIN_WINDOW, then 1
         self._resilience_step = 0
+        # Bumped by every elastic reshard (resilience/elastic.py): fused
+        # programs built before a transition compiled for a mesh that no
+        # longer exists and must refuse to run.
+        self._mesh_epoch = 0
         self._preemption_watcher = None
         self._health_guard = None
         self._telemetry = None
@@ -1138,7 +1142,19 @@ class Accelerator:
             except Exception:
                 pass
 
+        build_epoch = self._mesh_epoch
+
         def check_stale_accum():
+            if self._mesh_epoch != build_epoch:
+                # The program compiled for shardings on a mesh an elastic
+                # transition has since replaced; running it would feed the
+                # dead layout. run_resilient re-enters train_fn so the
+                # rebuild is one call away.
+                raise RuntimeError(
+                    f"The device mesh was resharded (elastic world-size "
+                    f"change) after {builder}; call {builder} again so the "
+                    "program compiles for the new mesh and sharding layout."
+                )
             if self.gradient_accumulation_steps != accum:
                 # The compiled program bakes the accumulation scale in; a
                 # mid-run change would silently diverge from the imperative
@@ -1636,6 +1652,21 @@ class Accelerator:
 
     def skip_first_batches(self, dataloader, num_batches: int = 0):
         return skip_first_batches(dataloader, num_batches)
+
+    def reshard(self, devices=None, min_data_parallel: int = 1):
+        """Re-form the mesh over a different device set (elastic world-size
+        change) and redistribute all prepared state onto it — see
+        :func:`~.resilience.elastic.reshard_accelerator` and
+        docs/resilience.md "Elastic world size". Only the dp axis resizes;
+        gradient accumulation rescales to preserve the global batch. Every
+        fused program built before this call must be rebuilt (stale ones
+        raise pointedly). Normally driven by ``run_resilient(elastic=True)``
+        rather than called directly."""
+        from .resilience.elastic import reshard_accelerator
+
+        return reshard_accelerator(
+            self, devices=devices, min_data_parallel=min_data_parallel
+        )
 
     # -------------------------------------------------------------- health
     @property

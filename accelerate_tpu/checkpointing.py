@@ -189,8 +189,14 @@ def save_accelerator_state(accelerator, output_dir: str | None = None, safe_seri
     # Manifest of queued orbax items: each commits atomically (tmp-dir rename),
     # so on load "every listed dir exists and no tmp litter" == "all array
     # writes from this save committed" — even for saves queued non-blocking.
+    # The mesh record makes the checkpoint PORTABLE across world sizes:
+    # load_accelerator_state compares it with the live mesh and demands an
+    # explicit reshard=True (or elastic resume) on mismatch instead of
+    # surfacing an opaque XLA sharding failure mid-restore.
     _host_pickle_json(
-        os.path.join(output_dir, "manifest.json"), {"items": expected_items}, accelerator
+        os.path.join(output_dir, "manifest.json"),
+        {"items": expected_items, "mesh": _mesh_record(accelerator)},
+        accelerator,
     )
     if blocking:
         finish_pending_saves()
@@ -245,6 +251,88 @@ def _host_pickle_json(path, obj, accelerator):
             json.dump(obj, f)
 
 
+def _mesh_record(accelerator) -> dict:
+    """Mesh axis sizes, process count, and dp degree — the metadata that
+    decides whether a checkpoint restores in place or needs resharding."""
+    from .parallel.sharding import data_parallel_degree
+
+    mesh = accelerator.mesh
+    return {
+        "axes": {name: int(size) for name, size in mesh.shape.items()},
+        "process_count": int(max(jax.process_count(), 1)),
+        "data_parallel": int(data_parallel_degree(mesh)),
+        # Needed to restore the GLOBAL batch, not just the arrays: a fresh
+        # process relaunched at a different size rescales accumulation from
+        # this absolute record (save-time accum x save-time dp is the
+        # samples-per-update invariant).
+        "gradient_accumulation_steps": int(accelerator.gradient_accumulation_steps),
+    }
+
+
+def _check_mesh_compatible(input_dir: str, accelerator, reshard: bool):
+    """Compare the checkpoint's mesh record (manifest.json) with the live
+    mesh. Silent when they match or the checkpoint predates the record;
+    pointed error on mismatch unless ``reshard=True`` opted in."""
+    manifest_path = os.path.join(input_dir, "manifest.json")
+    if not os.path.isfile(manifest_path):
+        return
+    try:
+        with open(manifest_path) as f:
+            saved = json.load(f).get("mesh")
+    except (OSError, ValueError):
+        return
+    if not saved:
+        return  # pre-metadata checkpoint: nothing to compare
+    current = _mesh_record(accelerator)
+    if saved["axes"] == current["axes"] and saved.get("process_count") == current["process_count"]:
+        return
+    if reshard:
+        _rescale_accumulation(accelerator, saved, current)
+        logger.warning(
+            f"Resharding checkpoint {os.path.basename(input_dir)}: written under "
+            f"mesh {saved['axes']}, restoring onto {current['axes']} (host-sharded "
+            "read + device_put onto the target shardings; no full-replication "
+            "spike)."
+        )
+        return
+    raise RuntimeError(
+        f"Checkpoint {input_dir} was written under mesh {saved['axes']} "
+        f"({saved.get('process_count', '?')} process(es), "
+        f"dp={saved.get('data_parallel', '?')}) but the current mesh is "
+        f"{current['axes']} ({current['process_count']} process(es), "
+        f"dp={current['data_parallel']}): resharding is required. Pass "
+        "load_state(..., reshard=True) to redistribute the arrays onto "
+        "the current layout, or resume through "
+        "run_resilient(elastic=True) which does so automatically."
+    )
+
+
+def _rescale_accumulation(accelerator, saved: dict, current: dict):
+    """Hold samples_per_update = per_device_batch x dp x accum invariant
+    across a cross-mesh restore. The record is ABSOLUTE (save-time accum and
+    dp), so the rescale is idempotent: the in-process elastic path — where
+    ``reshard_accelerator`` already rescaled the live value — lands on the
+    same number, and a FRESH process relaunched at a different size (which
+    never saw a ``WorldSizeChange``) gets the contract applied here."""
+    from .resilience.elastic import rescaled_accumulation
+
+    saved_dp = saved.get("data_parallel")
+    saved_accum = saved.get("gradient_accumulation_steps")
+    if not saved_dp or not saved_accum:
+        return  # pre-record checkpoint: nothing to hold invariant against
+    new_accum = rescaled_accumulation(
+        saved_accum, saved_dp, current["data_parallel"], context="Cross-mesh restore"
+    )
+    if new_accum != accelerator.gradient_accumulation_steps:
+        logger.warning(
+            f"Cross-mesh restore: gradient accumulation "
+            f"{accelerator.gradient_accumulation_steps} -> {new_accum} "
+            f"(save-time {saved_accum} x dp {saved_dp} / dp "
+            f"{current['data_parallel']}; global batch preserved)."
+        )
+        accelerator.gradient_accumulation_steps = new_accum
+
+
 def _checkpoint_complete(path: str, accelerator) -> bool:
     """Did this checkpoint folder's array writes commit?
 
@@ -276,8 +364,18 @@ def _checkpoint_complete(path: str, accelerator) -> bool:
     return True
 
 
-def load_accelerator_state(accelerator, input_dir: str | None = None, **kwargs):
-    """Reference ``load_accelerator_state`` :179 + driver :3426."""
+def load_accelerator_state(accelerator, input_dir: str | None = None,
+                           reshard: bool = False, **kwargs):
+    """Reference ``load_accelerator_state`` :179 + driver :3426.
+
+    ``reshard=True`` accepts a checkpoint written under a DIFFERENT mesh
+    (axis sizes / process count) and restores it onto the live layout: every
+    array is read host-sharded by orbax/tensorstore against the abstract
+    target (each process fetches only the index ranges its new shards need)
+    and lands directly on the current ``NamedSharding`` — no host ever
+    materializes the full array and there is no replication spike. Without
+    it, a mesh mismatch raises a pointed error up front instead of an opaque
+    XLA sharding failure mid-restore."""
     from .resilience.goodput import get_ledger
 
     _t_load = time.perf_counter()
@@ -317,6 +415,7 @@ def load_accelerator_state(accelerator, input_dir: str | None = None, **kwargs):
             raise FileNotFoundError(f"No complete checkpoint found under {base}")
         project.iteration = int(os.path.basename(input_dir).rsplit("_", 1)[-1]) + 1
     input_dir = os.path.abspath(input_dir)
+    _check_mesh_compatible(input_dir, accelerator, reshard)
 
     ckptr = _checkpointer()
     for i, model in enumerate(accelerator._models):
@@ -361,6 +460,17 @@ def load_accelerator_state(accelerator, input_dir: str | None = None, **kwargs):
             with open(path, "rb") as f:
                 obj.load_state_dict(pickle.load(f))
     rng_path = os.path.join(input_dir, f"{RNG_STATE_NAME}_{accelerator.process_index}.pkl")
+    if not os.path.isfile(rng_path) and reshard:
+        # A grown gang has ranks the old world never had: fall back to rank
+        # 0's streams (identical across ranks at save time for the JAX key
+        # counters; host RNG divergence only affects host-side draws).
+        fallback = os.path.join(input_dir, f"{RNG_STATE_NAME}_0.pkl")
+        if os.path.isfile(fallback):
+            logger.warning(
+                f"No RNG state for process {accelerator.process_index} in "
+                f"{input_dir} (written by a smaller world); restoring rank 0's."
+            )
+            rng_path = fallback
     if os.path.isfile(rng_path):
         with open(rng_path, "rb") as f:
             rng_state = pickle.load(f)

@@ -104,6 +104,10 @@ def get_user_input() -> ClusterConfig:
         pp_mbs = _ask("Pipeline microbatches? (0 = one per stage; >=4x pp for utilization)", 0, int)
     accum = _ask("How many gradient accumulation steps?", 1, int)
     project_dir, ckpt_limit, ckpt_auto, handle_preemption = None, 0, False, False
+    # Elastic is tri-state like the health section below: skipping the
+    # checkpointing section leaves None (nothing exported), an explicit
+    # yes/no reaches the workers as ACCELERATE_ELASTIC=1/0.
+    elastic, min_dp = None, 0
     if _yesno("Do you want to configure checkpointing?", False):
         project_dir = _ask("  project directory (checkpoints/logs root)", ".")
         ckpt_auto = _yesno("  automatic checkpoint naming (checkpoints/checkpoint_<n>)?", True)
@@ -112,6 +116,16 @@ def get_user_input() -> ClusterConfig:
             "  handle preemption (SIGTERM -> emergency checkpoint; resume via "
             "run_resilient)?", False
         )
+        elastic = _yesno(
+            "  elastic world size (run_resilient re-forms the mesh at the dp "
+            "degree the surviving devices support and reshards the "
+            "checkpoint onto it)?", False
+        )
+        if elastic:
+            min_dp = _ask(
+                "  minimum data-parallel degree a shrink may re-form at "
+                "(0 = no floor)", 0, int
+            )
     # Tri-state: skipping the section leaves None (nothing exported, library
     # defaults apply); explicit answers — including "no"/0 — reach the workers.
     guard_numerics, spike_zscore, hang_timeout = None, None, 0.0
@@ -215,6 +229,8 @@ def get_user_input() -> ClusterConfig:
         log_with=log_with,
         compile_cache_dir=compile_cache_dir,
         handle_preemption=handle_preemption,
+        elastic=elastic,
+        min_data_parallel=min_dp,
         guard_numerics=guard_numerics,
         spike_zscore=spike_zscore,
         hang_timeout=hang_timeout,

@@ -103,6 +103,13 @@ class ClusterConfig:
     # "step:37=kill;step:80=partial_ckpt").
     handle_preemption: bool = False
     fault_plan: str = ""
+    # Elastic world-size training (resilience/elastic.py): TRI-state —
+    # None = not configured (nothing exported; run_resilient defaults off),
+    # an explicit True/False reaches workers as ACCELERATE_ELASTIC=1/0.
+    # ``min_data_parallel`` floors the dp degree a shrink may re-form at
+    # (0 = unspecified, library default 1; ACCELERATE_MIN_DATA_PARALLEL).
+    elastic: bool | None = None
+    min_data_parallel: int = 0
     # Training-health guards (health/): numerics sentinel + spike detector
     # driven by Accelerator.guard_step, and the hang watchdog's heartbeat
     # deadline (ACCELERATE_HANG_TIMEOUT; 0.0 = disabled). The first two are

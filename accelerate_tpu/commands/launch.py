@@ -28,12 +28,14 @@ from ..utils.constants import (
     ENV_COORDINATOR,
     ENV_CPU,
     ENV_DEBUG_MODE,
+    ENV_ELASTIC,
     ENV_FAULT_PLAN,
     ENV_GUARD_NUMERICS,
     ENV_HANDLE_PREEMPTION,
     ENV_HANG_TIMEOUT,
     ENV_MESH_SHAPE,
     ENV_METRICS_PORT,
+    ENV_MIN_DATA_PARALLEL,
     ENV_MIXED_PRECISION,
     ENV_NUM_PROCESSES,
     ENV_PROCESS_ID,
@@ -108,6 +110,23 @@ def launch_command_parser(subparsers=None) -> argparse.ArgumentParser:
              "e.g. 'step:37=kill;step:40=loss_spike:50x;step:80=hang:600' "
              "(exported as ACCELERATE_FAULT_PLAN; see docs/resilience.md and "
              "docs/health.md for the grammar).",
+    )
+    parser.add_argument(
+        "--elastic", action=argparse.BooleanOptionalAction, default=None,
+        help="Elastic world-size training (ACCELERATE_ELASTIC): "
+             "run_resilient re-forms the mesh at whatever dp degree the "
+             "surviving devices support after a shrink/grow (preemption took "
+             "a slice / maintenance returned one), reshards params+optimizer "
+             "state onto it, and rescales gradient accumulation to preserve "
+             "the global batch (docs/resilience.md 'Elastic world size'). "
+             "--no-elastic pins fixed-size restarts explicitly.",
+    )
+    parser.add_argument(
+        "--min_data_parallel", type=int, default=None,
+        help="Floor for the elastic dp degree (ACCELERATE_MIN_DATA_PARALLEL): "
+             "a shrink that would drop data parallelism below this refuses to "
+             "re-form — the job queues for capacity instead of limping on too "
+             "few replicas.",
     )
     parser.add_argument(
         "--guard_numerics", action="store_true", default=None,
@@ -203,6 +222,8 @@ def _merge_config(args) -> ClusterConfig:
         ("compile_cache_dir", "compile_cache_dir"),
         ("handle_preemption", "handle_preemption"),
         ("fault_plan", "fault_plan"),
+        ("elastic", "elastic"),
+        ("min_data_parallel", "min_data_parallel"),
         ("guard_numerics", "guard_numerics"),
         ("spike_zscore", "spike_zscore"),
         ("hang_timeout", "hang_timeout"),
@@ -260,6 +281,13 @@ def prepare_launch_env(cfg: ClusterConfig, process_id: int | None = None, attemp
         env[ENV_HANDLE_PREEMPTION] = "1"
     if cfg.fault_plan:
         env[ENV_FAULT_PLAN] = cfg.fault_plan
+    # Elastic is tri-state like the health knobs: None = not configured
+    # (nothing exported, run_resilient's default applies), and an explicit
+    # --no-elastic must reach the workers as a disable.
+    if cfg.elastic is not None:
+        env[ENV_ELASTIC] = "1" if cfg.elastic else "0"
+    if cfg.min_data_parallel:
+        env[ENV_MIN_DATA_PARALLEL] = str(int(cfg.min_data_parallel))
     # Tri-state health knobs: None = not configured (export nothing, library
     # defaults apply); an explicit False / 0 must reach the workers as a
     # disable, not vanish behind a truthiness check.
@@ -411,6 +439,10 @@ def launch_command(args) -> None:
         from ..resilience.faults import FaultPlan
 
         FaultPlan.parse(cfg.fault_plan)
+    if cfg.min_data_parallel and cfg.min_data_parallel < 1:
+        raise ValueError(
+            f"--min_data_parallel must be >= 1, got {cfg.min_data_parallel}"
+        )
     if cfg.spike_zscore and cfg.spike_zscore < 0:
         raise ValueError(f"--spike_zscore must be >= 0, got {cfg.spike_zscore}")
     if cfg.hang_timeout and cfg.hang_timeout < 0:

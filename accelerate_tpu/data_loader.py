@@ -159,6 +159,19 @@ class BatchSamplerShard:
         self.batch_size = getattr(batch_sampler, "batch_size", None)
         self.drop_last = getattr(batch_sampler, "drop_last", False)
 
+    def reassign(self, num_processes: int, process_index: int):
+        """Elastic world-size change (resilience/elastic.py): deal the same
+        underlying sampler out across a different process count. The wrapped
+        sampler — and therefore the shuffle-RNG stream ordering the epoch —
+        is untouched; only which slice this process draws changes."""
+        if self.split_batches and self.batch_size is not None and self.batch_size % num_processes != 0:
+            raise ValueError(
+                f"batch_size {self.batch_size} must be divisible by the new "
+                f"num_processes {num_processes} when split_batches=True"
+            )
+        self.num_processes = int(num_processes)
+        self.process_index = int(process_index)
+
     @property
     def total_length(self):
         return len(self.batch_sampler)
@@ -270,6 +283,20 @@ class IterableDatasetShard:
         self.process_index = process_index
         self.split_batches = split_batches
 
+    def reassign(self, num_processes: int, process_index: int):
+        """Elastic world-size change: re-slice the stream across a different
+        process count (see ``BatchSamplerShard.reassign``)."""
+        if self.split_batches and self.batch_size % num_processes != 0:
+            # __iter__ floors per_process = batch_size // num_processes: a
+            # non-dividing count would silently drop the remainder of every
+            # buffer — refuse like the map-style shard does.
+            raise ValueError(
+                f"batch_size {self.batch_size} must be divisible by the new "
+                f"num_processes {num_processes} when split_batches=True"
+            )
+        self.num_processes = int(num_processes)
+        self.process_index = int(process_index)
+
     def set_epoch(self, epoch):
         self.epoch = epoch
         if hasattr(self.dataset, "set_epoch"):
@@ -335,6 +362,28 @@ class suppress_exception:
 
     def __exit__(self, *exc):
         return True
+
+
+def _reassign_shard_objects(root, num_processes: int, process_index: int) -> int:
+    """Walk a wrapped loader chain (loader → batch_sampler/sampler/dataset)
+    and ``reassign`` every shard wrapper found; returns how many were
+    repointed. Shared by the prepared loaders' ``reassign_shards``."""
+    seen: set = set()
+    stack = [root]
+    updated = 0
+    while stack:
+        obj = stack.pop()
+        if obj is None or id(obj) in seen:
+            continue
+        seen.add(id(obj))
+        if isinstance(obj, (BatchSamplerShard, IterableDatasetShard)):
+            obj.reassign(num_processes, process_index)
+            updated += 1
+        for attr in ("base_loader", "batch_sampler", "sampler", "dataset"):
+            nxt = getattr(obj, attr, None)
+            if nxt is not None and not isinstance(nxt, (int, float, str, bytes)):
+                stack.append(nxt)
+    return updated
 
 
 class DataLoaderShard(DataLoaderStateMixin):
@@ -469,6 +518,14 @@ class DataLoaderShard(DataLoaderStateMixin):
         ds = self.dataset
         if hasattr(ds, "set_epoch"):
             ds.set_epoch(epoch)
+
+    def reassign_shards(self, num_processes: int, process_index: int):
+        """Elastic world-size change (resilience/elastic.py): point every
+        shard wrapper under this loader at the new world. The sampler-RNG
+        contract stays intact — the shuffle stream (and its
+        ``state_dict``/``load_state_dict`` snapshots) is untouched; only
+        which slice this process draws changes."""
+        _reassign_shard_objects(self.base_loader, num_processes, process_index)
 
     def __len__(self):
         n = len(self.base_loader)
@@ -690,6 +747,12 @@ class DataLoaderDispatcher(DataLoaderStateMixin):
         self.iteration = epoch
         if hasattr(self.base_loader, "set_epoch"):
             self.base_loader.set_epoch(epoch)
+
+    def reassign_shards(self, num_processes: int, process_index: int):
+        """See ``DataLoaderShard.reassign_shards`` — the dispatcher's own
+        slicing follows ``self.state`` live, but a wrapped shard sampler
+        still needs repointing."""
+        _reassign_shard_objects(self.base_loader, num_processes, process_index)
 
     def _fetch_and_scatter(self, iterator):
         """Process 0 fetches; batch is broadcast; each process keeps its slice

@@ -138,6 +138,25 @@ class HealthGuard:
     def enabled(self) -> bool:
         return self.sentinel is not None or self.spike is not None
 
+    def reset_after_reshard(self, mesh):
+        """Elastic world-size transition (resilience/elastic.py): snapshots
+        and in-flight verdicts were captured on the old mesh — stale state
+        that must be discarded, not restored. The spike statistics (tiny
+        scalars) survive the move: the global batch is preserved across the
+        transition, so the loss scale they model is unchanged."""
+        self.lkg.clear()
+        self._pending.clear()
+        self._verdict_fns.clear()  # compiled against the old layout
+        if self._spike_state is not None:
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            self._spike_state = jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, NamedSharding(mesh, P()))
+                if isinstance(x, jax.Array) else x,
+                self._spike_state,
+            )
+
     # ------------------------------------------------------------ quarantine
     def quarantine(self, step: int):
         """Mark ``step``'s batch poisoned: ``should_skip`` will skip it."""

@@ -109,6 +109,13 @@ class LastKnownGood:
         a rollback is about to discard."""
         self._snapshots = [s for s in self._snapshots if s[0] < step]
 
+    def clear(self):
+        """Drop every snapshot — after an elastic reshard the held device
+        arrays lay on a mesh that no longer exists; restoring one would
+        resurrect the dead layout (resilience/elastic.py discards, never
+        restores)."""
+        self._snapshots = []
+
     def restore(self, before_step: int | None = None):
         """→ ``(step, device_state, host_state)`` of the newest snapshot older
         than ``before_step`` (newest overall when None) — fresh copies each

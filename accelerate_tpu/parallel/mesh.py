@@ -207,6 +207,57 @@ def detect_num_slices(devices=None) -> int:
         return 1
 
 
+def elastic_parallelism_for(
+    mesh: Mesh, num_devices: int, min_data_parallel: int = 1
+) -> ParallelismConfig:
+    """Resolve the mesh shape an elastic restart re-forms on ``num_devices``.
+
+    The model axes (fsdp/tp/pp/sp/ep) and the slice axis (dcn) keep the sizes
+    of the current ``mesh`` — a checkpointed layout stays restorable shard-for-
+    shard — and only the dp degree absorbs the difference. Raises a pointed
+    error when the surviving devices cannot host the fixed axes, when dp would
+    not divide, or when it would fall below ``min_data_parallel`` (the floor a
+    fleet sets so a shrink queues for capacity instead of limping on too few
+    replicas)."""
+    fixed = {a: mesh_axis_size(mesh, a) for a in ("dcn", "fsdp", "tp", "pp", "sp", "ep")}
+    other = 1
+    for size in fixed.values():
+        other *= size
+    if num_devices < other or num_devices % other != 0:
+        raise ValueError(
+            f"Cannot re-form the mesh on {num_devices} device(s): the fixed "
+            f"non-dp axes {fixed} need a multiple of {other} devices. Only the "
+            "dp axis resizes elastically — shrink/grow in multiples of the "
+            "model-parallel degree, or retire the tp/pp/fsdp layout first."
+        )
+    dp = num_devices // other
+    if dp < max(int(min_data_parallel), 1):
+        raise ValueError(
+            f"Elastic resize refused: {num_devices} device(s) support dp={dp}, "
+            f"below the min_data_parallel floor of {min_data_parallel}. Raise "
+            "capacity (or lower --min_data_parallel) to resume."
+        )
+    return ParallelismConfig(
+        dp_size=dp,
+        fsdp_size=fixed["fsdp"],
+        tp_size=fixed["tp"],
+        pp_size=fixed["pp"],
+        sp_size=fixed["sp"],
+        ep_size=fixed["ep"],
+        dcn_size=fixed["dcn"],
+    )
+
+
+def build_elastic_mesh(
+    mesh: Mesh, devices, min_data_parallel: int = 1
+) -> tuple[Mesh, ParallelismConfig]:
+    """Re-form ``mesh`` over a different device set (elastic shrink/grow):
+    same non-dp axis sizes, dp resized to absorb ``devices``."""
+    devices = list(devices)
+    config = elastic_parallelism_for(mesh, len(devices), min_data_parallel)
+    return config.build_mesh(devices), config
+
+
 def default_mesh(devices=None) -> Mesh:
     """All devices on the ``dp`` axis — the DDP-equivalent default."""
     return ParallelismConfig().build_mesh(devices)

@@ -258,6 +258,35 @@ def apply_shardings(pytree, shardings):
     return jax.tree_util.tree_map(lambda x, s: jax.device_put(x, s), pytree, shardings)
 
 
+def respec_shardings(shardings, mesh: Mesh):
+    """Re-anchor a pytree of ``NamedSharding`` onto a different mesh, keeping
+    each leaf's PartitionSpec. The elastic contract (resilience/elastic.py)
+    keeps every non-dp axis size fixed, so a spec that divided its dims on the
+    old mesh still divides on the new one."""
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s.spec) if isinstance(s, NamedSharding) else s,
+        shardings,
+        is_leaf=lambda s: isinstance(s, NamedSharding),
+    )
+
+
+def transfer_to_mesh(tree, mesh: Mesh):
+    """``device_put`` every array leaf onto ``mesh``, preserving its
+    PartitionSpec layout (replicated when the leaf carries no named spec —
+    scalars, RNG keys, eagerly-created arrays). This is the live-array half of
+    elastic resharding: XLA moves each shard to its new owner directly, no
+    host gather and no full-replication HBM spike (the portable-redistribution
+    property of arxiv 2112.01075 that GSPMD metadata buys us)."""
+
+    def _one(x):
+        if not isinstance(x, jax.Array):
+            return x
+        spec = x.sharding.spec if isinstance(x.sharding, NamedSharding) else P()
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map(_one, tree)
+
+
 def data_parallel_degree(mesh: Mesh) -> int:
     """How many ways the batch axis is split: the product of the data axes.
     One definition — batch sharding, window sharding, and per-process batch

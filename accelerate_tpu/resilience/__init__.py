@@ -11,6 +11,12 @@ Four layers, smallest mechanism first:
 - :mod:`.runner` — :func:`run_resilient`: resume from the newest complete
   checkpoint, exponential backoff + jitter, crash-loop budget, and optional
   hang conversion (``hang_timeout_s``, via the health watchdog);
+- :mod:`.elastic` — elastic world-size restarts (``elastic=True``):
+  :func:`reshard_accelerator` re-forms the mesh at the dp degree the
+  surviving devices support and redistributes params/opt-state onto it,
+  rescaling gradient accumulation to preserve the global batch; the
+  ``shrink:N``/``grow:N`` fault kinds make the transition a deterministic
+  drill (docs/resilience.md "Elastic world size");
 - :mod:`.goodput` — the wall-clock ledger (productive step time vs compile /
   checkpoint / restart / rollback / hang badput) surfaced by
   ``Accelerator.log_goodput()`` and ``bench.py``.
@@ -20,7 +26,15 @@ call per step) and ``run_resilient(train_fn, accelerator)``; driven from the
 CLI via ``accelerate-tpu launch --handle_preemption [--max_restarts N]``.
 """
 
-from .faults import FaultPlan, SimulatedFault, active_plan, reset_active_plan, set_active_plan
+from .elastic import agree_world_size, reshard_accelerator
+from .faults import (
+    FaultPlan,
+    SimulatedFault,
+    WorldSizeChange,
+    active_plan,
+    reset_active_plan,
+    set_active_plan,
+)
 from .goodput import GoodputLedger, get_ledger
 from .preemption import PreemptionWatcher, gce_maintenance_poller, get_default_watcher, reset_default_watcher
 from .runner import run_resilient
@@ -30,12 +44,15 @@ __all__ = [
     "GoodputLedger",
     "PreemptionWatcher",
     "SimulatedFault",
+    "WorldSizeChange",
     "active_plan",
+    "agree_world_size",
     "gce_maintenance_poller",
     "get_default_watcher",
     "get_ledger",
     "reset_active_plan",
     "reset_default_watcher",
+    "reshard_accelerator",
     "run_resilient",
     "set_active_plan",
 ]

@@ -1,0 +1,267 @@
+"""Elastic world-size training — survive shrink/grow, not just restart.
+
+Production TPU fleets do not restart at a fixed size: preemption takes slices
+away and maintenance gives them back. The fixed-size story (runner.py) can
+only re-form the exact gang it lost; this module teaches the resilience
+subsystem to re-form the mesh at whatever dp degree the surviving devices
+support and *reshard* the training state onto it:
+
+- :func:`reshard_accelerator` is the transition: resolve the new mesh shape
+  (``parallel/mesh.py`` — tp/pp/fsdp/sp/ep and the slice axis stay fixed,
+  only dp absorbs the difference), redistribute every model's params and
+  every optimizer's state onto the new ``NamedSharding``s (a shard-to-shard
+  ``device_put`` — the portable-redistribution property of arxiv 2112.01075;
+  no host gather, no full-replication HBM spike), rescale gradient
+  accumulation to preserve the global batch (erroring pointedly when it
+  cannot divide), reassign data-loader shards with the sampler-RNG contract
+  intact, discard health-guard snapshots captured on the old mesh, and book
+  the whole transition as ``reshard`` badput plus world-size gauges in the
+  metrics registry.
+- ``run_resilient(elastic=True, min_data_parallel=...)`` (runner.py) drives
+  it when a :class:`~.faults.WorldSizeChange` (the deterministic
+  ``shrink:N``/``grow:N`` fault) or a real restart at a different device
+  count occurs, restoring state from the health subsystem's in-memory
+  last-known-good snapshot when the process survives, else from the newest
+  complete checkpoint (``load_state(reshard=True)`` — checkpoints carry a
+  mesh metadata record since this PR, see ``checkpointing.py``).
+- :func:`agree_world_size` is the multi-host piece: before re-forming, every
+  host must agree on the total surviving device count — one KV exchange over
+  the coordination service (the same fallback transport the health guard and
+  straggler monitor ride on collective-less rigs).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+from ..logging import get_logger
+from ..utils.constants import ENV_ELASTIC, ENV_MIN_DATA_PARALLEL
+from .goodput import get_ledger
+
+logger = get_logger(__name__)
+
+
+def elastic_from_env() -> bool:
+    """The launcher contract: ``--elastic`` → ACCELERATE_ELASTIC."""
+    from ..utils.environment import parse_flag_from_env
+
+    return parse_flag_from_env(ENV_ELASTIC)
+
+
+def min_data_parallel_from_env() -> int:
+    raw = os.environ.get(ENV_MIN_DATA_PARALLEL, "").strip()
+    if not raw:
+        return 1
+    try:
+        value = int(raw)
+    except ValueError:
+        raise ValueError(f"{ENV_MIN_DATA_PARALLEL}={raw!r} is not an integer") from None
+    if value < 1:
+        raise ValueError(f"{ENV_MIN_DATA_PARALLEL} must be >= 1, got {value}")
+    return value
+
+
+def agree_world_size(state, local_device_count: int | None = None) -> int:
+    """Every host's surviving local device count, summed — and agreed.
+
+    On a healthy backend ``jax.device_count()`` already answers this, but an
+    elastic restart needs the answer *before* trusting the device set (and on
+    collective-less rigs — multiprocess CPU — device collectives are
+    unavailable entirely), so the exchange rides the coordination-service KV
+    store: each rank posts its local count, all ranks read the same list back.
+    Single-process: returns ``local_device_count`` unchanged."""
+    from ..utils.agreement import kv_all_gather
+
+    if local_device_count is None:
+        local_device_count = jax.local_device_count()
+    if state is None or getattr(state, "num_processes", 1) <= 1:
+        return int(local_device_count)
+    counts = kv_all_gather(
+        str(int(local_device_count)),
+        state.num_processes,
+        state.process_index,
+        namespace=f"accelerate_tpu/elastic/world_size/{_next_agreement_epoch()}",
+    )
+    return sum(int(c) for c in counts)
+
+
+_AGREEMENT_EPOCH = 0
+
+
+def _next_agreement_epoch() -> int:
+    # KV namespaces are single-use and must be identical across ranks; ranks
+    # agree in the same program order, so a process-wide counter lines up.
+    global _AGREEMENT_EPOCH
+    _AGREEMENT_EPOCH += 1
+    return _AGREEMENT_EPOCH
+
+
+def rescaled_accumulation(accum: int, old_dp: int, new_dp: int, *, context: str) -> int:
+    """The global-batch invariant in one place: per-device batch is HBM-bound
+    and fixed, so ``samples_per_update = per_device_batch × dp × accum`` must
+    hold across any dp change — accumulation absorbs the difference or the
+    transition refuses. Shared by the in-process reshard and the cross-mesh
+    checkpoint restore so the two paths can never drift apart."""
+    accum, old_dp, new_dp = int(accum), int(old_dp), int(new_dp)
+    if old_dp == new_dp:
+        return accum
+    scaled = accum * old_dp
+    if scaled % new_dp != 0:
+        raise ValueError(
+            f"{context} dp {old_dp} -> {new_dp} cannot preserve the global "
+            f"batch: accumulation {accum} x dp {old_dp} = {scaled} "
+            f"micro-gradients per update is not divisible by the new dp "
+            f"degree. Use a dp that divides {scaled}, or change the global "
+            "batch deliberately."
+        )
+    return scaled // new_dp
+
+
+def resolve_resized_devices(devices, direction: str, factor: int):
+    """The device set after a ``shrink:N``/``grow:N`` transition.
+
+    Shrink keeps the leading ``len/N`` devices (the deterministic stand-in
+    for "the surviving slice"); grow extends back toward the full device set,
+    capped at what the platform actually exposes. Raises pointedly when a
+    shrink factor does not divide the current count."""
+    devices = list(devices)
+    if direction == "shrink":
+        if factor < 2 or len(devices) % factor != 0:
+            raise ValueError(
+                f"Cannot shrink {len(devices)} device(s) by {factor}x: the "
+                "factor must divide the current device count (shrink in "
+                "multiples of the slice size)."
+            )
+        return devices[: len(devices) // factor]
+    if direction == "grow":
+        # Capped at what the platform actually exposes; at full capacity the
+        # cap makes the resize a no-op (the caller keeps training at the
+        # current size — capacity that never materialized is not a fault).
+        available = list(jax.devices())
+        want = min(len(devices) * factor, len(available))
+        if want <= len(devices):
+            return devices
+        return available[:want]
+    raise ValueError(f"Unknown resize direction {direction!r}; use 'shrink' or 'grow'.")
+
+
+def reshard_accelerator(accelerator, devices=None, min_data_parallel: int = 1):
+    """Re-form the accelerator's mesh over ``devices`` and redistribute all
+    live training state onto it. Returns the new mesh.
+
+    Everything the training loop compiled against the old mesh is
+    invalidated: the prepared models' jitted calls are dropped (they rebuild
+    on next use) and the accelerator's mesh epoch is bumped so a stale
+    ``build_train_step``/``build_train_window`` program raises a pointed
+    error instead of silently feeding the wrong layout. The caller (normally
+    ``run_resilient``) re-enters the training function, which rebuilds its
+    fused step against the new mesh.
+    """
+    import dataclasses
+
+    from ..parallel.mesh import build_elastic_mesh
+    from ..parallel.sharding import (
+        data_parallel_degree,
+        respec_shardings,
+        transfer_to_mesh,
+    )
+
+    if devices is None:
+        devices = list(jax.devices())
+    old_mesh = accelerator.mesh
+    ledger = get_ledger()
+    with ledger.track("reshard"):
+        new_mesh, new_config = build_elastic_mesh(
+            old_mesh, devices, min_data_parallel=min_data_parallel
+        )
+        old_dp = data_parallel_degree(old_mesh)
+        new_dp = data_parallel_degree(new_mesh)
+        accum = accelerator.gradient_accumulation_steps
+        accelerator.gradient_accumulation_steps = rescaled_accumulation(
+            accum, old_dp, new_dp, context="Elastic resize"
+        )
+        # Swap the mesh into the process singletons BEFORE moving arrays, so
+        # every layer that reads accelerator.mesh live (batch placement, the
+        # sharding planner, telemetry) sees the new world.
+        accelerator.state.replace_mesh(new_mesh, new_config)
+        for model in accelerator._models:
+            handle = model.handle
+            handle.param_shardings = respec_shardings(handle.param_shardings, new_mesh)
+            handle.params = transfer_to_mesh(handle.params, new_mesh)
+            handle.rng = transfer_to_mesh(handle.rng, new_mesh)
+            handle.mesh = new_mesh
+            handle.pending = None
+            if handle.pipeline_spec is not None:
+                handle.pipeline_spec = dataclasses.replace(
+                    handle.pipeline_spec, mesh=new_mesh
+                )
+            model._train_call = None
+            model._eval_call = None
+        for opt in accelerator._optimizers:
+            # The cached plan anchored to the old mesh; replanned lazily from
+            # the (already re-anchored) param shardings on next use.
+            opt.opt_shardings = None
+            if opt.opt_state is not None:
+                opt.opt_state = transfer_to_mesh(opt.opt_state, new_mesh)
+            if opt._accum_grads is not None:
+                opt._accum_grads = transfer_to_mesh(opt._accum_grads, new_mesh)
+        # Health-guard snapshots hold device arrays laid out on the OLD mesh:
+        # restoring one after the transition would resurrect the dead layout.
+        # They are discarded, never restored (the spike statistics — tiny
+        # scalars — move with the guard).
+        guard = accelerator._health_guard
+        if guard is not None:
+            guard.reset_after_reshard(new_mesh)
+        reassign_data_shards(accelerator)
+        accelerator._mesh_epoch += 1
+        direction = "shrink" if new_dp < old_dp else "grow"
+        _publish_transition(direction, new_mesh, new_dp)
+        logger.warning(
+            f"Elastic reshard: dp {old_dp} -> {new_dp} over "
+            f"{len(devices)} device(s); gradient accumulation "
+            f"{accum} -> {accelerator.gradient_accumulation_steps} "
+            "(global batch preserved)."
+        )
+    return new_mesh
+
+
+def reassign_data_shards(accelerator, num_processes: int | None = None,
+                         process_index: int | None = None):
+    """Point every prepared loader at the new world size.
+
+    In-process (single-host drills) the process count does not change and
+    batch *placement* already follows the live mesh — this keeps the loaders'
+    shard bookkeeping (``BatchSamplerShard``/``IterableDatasetShard``
+    ``num_processes``/``process_index``) in line when a multi-host restart
+    re-enters with a different gang. The sampler-RNG contract is untouched:
+    reassignment changes which rows a process draws, never the shuffle stream
+    that orders them (the ``state_dict``/``load_state_dict`` snapshots keep
+    resuming bit-exact)."""
+    if num_processes is None:
+        num_processes = max(jax.process_count(), 1)
+    if process_index is None:
+        process_index = jax.process_index() if num_processes > 1 else 0
+    for loader in accelerator._dataloaders:
+        reassign = getattr(loader, "reassign_shards", None)
+        if reassign is not None:
+            reassign(num_processes=num_processes, process_index=process_index)
+
+
+def _publish_transition(direction: str, mesh, dp: int):
+    from ..telemetry.metrics import get_registry
+
+    registry = get_registry()
+    registry.counter(
+        "accelerate_reshard_transitions_total",
+        "Elastic world-size transitions applied",
+        labelnames=("direction",),
+    ).inc(direction=direction)
+    registry.gauge(
+        "accelerate_world_size", "Devices in the current training mesh"
+    ).set(float(mesh.size))
+    registry.gauge(
+        "accelerate_data_parallel_degree",
+        "Data-parallel degree (dcn x dp x fsdp) of the current mesh",
+    ).set(float(dp))

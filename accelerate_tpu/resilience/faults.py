@@ -29,7 +29,15 @@ i.e. ``;``-separated entries of ``step:<N>=<action>[:<arg>]`` with actions
                     the numerics sentinel → rollback path;
 - ``loss_spike:<mult>x``  multiply the step's observed loss (default 50x) —
                     consumed by ``guard_step()``, exercising the spike
-                    detector → rollback path.
+                    detector → rollback path;
+- ``shrink:<N>``    raise :class:`WorldSizeChange`: the in-process stand-in
+                    for a preemption that takes 1/N of the devices away —
+                    ``run_resilient(elastic=True)`` catches it and re-forms
+                    the mesh at the smaller dp degree (docs/resilience.md
+                    "Elastic world size");
+- ``grow:<N>``      raise :class:`WorldSizeChange` in the other direction —
+                    maintenance returned capacity, re-form N× wider (capped
+                    at the devices actually available).
 
 Each fault fires at most once per plan instance, so an auto-resumed run that
 replays the faulting step does not crash-loop on its own injection. The data
@@ -50,10 +58,15 @@ from ..utils.constants import ENV_FAULT_PLAN
 
 logger = get_logger(__name__)
 
-_ACTIONS = ("kill", "sigterm", "partial_ckpt", "stall", "hang", "nan", "loss_spike")
+_ACTIONS = (
+    "kill", "sigterm", "partial_ckpt", "stall", "hang", "nan", "loss_spike",
+    "shrink", "grow",
+)
 # Data faults poison the step's observed loss; they are consumed by the health
 # guard (Accelerator.guard_step) rather than fired by maybe_fire.
 _DATA_ACTIONS = ("nan", "loss_spike")
+# World-size faults change how many devices the next incarnation sees.
+_RESIZE_ACTIONS = ("shrink", "grow")
 
 
 class SimulatedFault(RuntimeError):
@@ -63,6 +76,21 @@ class SimulatedFault(RuntimeError):
     def __init__(self, step: int):
         super().__init__(f"fault injection: simulated kill at step {step}")
         self.step = step
+
+
+class WorldSizeChange(RuntimeError):
+    """Raised by the ``shrink:N``/``grow:N`` actions: the gang dies AND the
+    next incarnation will see a different device count (preemption took a
+    slice away / maintenance gave one back). ``run_resilient(elastic=True)``
+    converts it into a mesh re-form + reshard instead of a fixed-size restart."""
+
+    def __init__(self, step: int, direction: str, factor: int):
+        super().__init__(
+            f"fault injection: world size {direction} by {factor}x at step {step}"
+        )
+        self.step = step
+        self.direction = direction
+        self.factor = factor
 
 
 @dataclass
@@ -103,6 +131,11 @@ class FaultPlan:
                         raise ValueError
                 if action == "nan" and arg:
                     raise ValueError  # nan takes no argument
+                if action in _RESIZE_ACTIONS and arg:
+                    # 'shrink:2' halves the device count; the factor must be
+                    # an integer >= 2 (1 would be a no-op resize).
+                    if int(arg) < 2:
+                        raise ValueError
             except ValueError:
                 raise ValueError(
                     f"Bad fault-plan entry {entry!r}: expected "
@@ -127,6 +160,8 @@ class FaultPlan:
             logger.warning(f"Fault injection: firing {f.action} at step {step}")
             if f.action == "kill":
                 raise SimulatedFault(step)
+            if f.action in _RESIZE_ACTIONS:
+                raise WorldSizeChange(step, f.action, int(f.arg) if f.arg else 2)
             if f.action == "sigterm":
                 os.kill(os.getpid(), signal.SIGTERM)
             elif f.action == "partial_ckpt":

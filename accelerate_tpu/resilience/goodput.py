@@ -16,10 +16,11 @@ the same numbers with zero per-step cost.
 
 The categories follow the goodput decomposition used by large TPU trainers
 (productive step time vs program-acquisition and checkpoint overheads): one
-goodput bucket (``step``) and six badput buckets — ``compile``, ``ckpt_save``,
-``ckpt_restore``, ``restart``, plus the health subsystem's ``rollback``
+goodput bucket (``step``) and seven badput buckets — ``compile``, ``ckpt_save``,
+``ckpt_restore``, ``restart``, the health subsystem's ``rollback``
 (last-known-good restores after a NaN/loss-spike trip, health/rollback.py) and
-``hang`` (time a wedged run sat before the watchdog fired, health/hang.py).
+``hang`` (time a wedged run sat before the watchdog fired, health/hang.py),
+plus ``reshard`` (elastic world-size transitions, resilience/elastic.py).
 Wall-clock not attributed to any bucket is reported as ``other_s`` (data
 feeding, host-side logging, eval, idle).
 """
@@ -31,7 +32,12 @@ import time
 from contextlib import contextmanager
 
 GOODPUT_CATEGORY = "step"
-BADPUT_CATEGORIES = ("compile", "ckpt_save", "ckpt_restore", "restart", "rollback", "hang")
+# ``reshard`` is the elastic world-size transition (resilience/elastic.py):
+# re-forming the mesh at a new dp degree and redistributing params/opt-state
+# onto it — voluntary downtime, booked separately from crash ``restart``s.
+BADPUT_CATEGORIES = (
+    "compile", "ckpt_save", "ckpt_restore", "restart", "rollback", "hang", "reshard"
+)
 CATEGORIES = (GOODPUT_CATEGORY,) + BADPUT_CATEGORIES
 
 

@@ -56,6 +56,8 @@ def run_resilient(
     resume: bool = True,
     checkpoint_dir: str | None = None,
     hang_timeout_s: float | None = None,
+    elastic: bool | None = None,
+    min_data_parallel: int | None = None,
 ) -> Any:
     """Run ``train_fn(accelerator, attempt)`` to completion through failures.
 
@@ -70,11 +72,36 @@ def run_resilient(
     crash-loop detector (a ``RuntimeError`` that preserves the original
     failure as its cause); ``None`` disables the window check.
 
+    ``elastic=True`` (default: the launcher's ACCELERATE_ELASTIC contract)
+    survives **world-size changes**: when a
+    :class:`~.faults.WorldSizeChange` (the deterministic ``shrink:N``/
+    ``grow:N`` fault, or a real restart at a different device count)
+    surfaces, the mesh is re-formed at the dp degree the surviving devices
+    support — never below ``min_data_parallel`` (default: the
+    ACCELERATE_MIN_DATA_PARALLEL contract, else 1) — training state is
+    resharded onto it (from the health subsystem's in-memory last-known-good
+    snapshot when one exists, else from the newest complete checkpoint via
+    ``load_state(reshard=True)``), gradient accumulation is rescaled to
+    preserve the global batch, and ``train_fn`` is re-entered to rebuild its
+    compiled step for the new layout. Voluntary resizes are classified
+    separately from crashes: they consume neither ``max_restarts`` nor the
+    crash-loop budget, and their downtime books as ``reshard`` (not
+    ``restart``) badput — a fleet that legitimately resizes twice is not one
+    fault away from giving up.
+
     Returns whatever ``train_fn`` returns. Raises the last failure once
     ``max_restarts`` is exhausted.
     """
     if max_restarts < 0:
         raise ValueError(f"max_restarts must be >= 0, got {max_restarts}")
+    from .elastic import elastic_from_env, min_data_parallel_from_env
+
+    if elastic is None:
+        elastic = elastic_from_env()
+    if min_data_parallel is None:
+        min_data_parallel = min_data_parallel_from_env()
+    if min_data_parallel < 1:
+        raise ValueError(f"min_data_parallel must be >= 1, got {min_data_parallel}")
     ledger = get_ledger()
     restart_times: collections.deque = collections.deque()
     attempt = 0
@@ -100,6 +127,7 @@ def run_resilient(
             train_fn, accelerator, ledger, restart_times, attempt, max_restarts,
             backoff_base_s, backoff_max_s, backoff_jitter, restart_budget,
             restart_window_s, resume, checkpoint_dir, watchdog,
+            elastic, min_data_parallel,
         )
     finally:
         if watchdog is not None:
@@ -118,20 +146,108 @@ def run_resilient(
 def _run_resilient_loop(
     train_fn, accelerator, ledger, restart_times, attempt, max_restarts,
     backoff_base_s, backoff_max_s, backoff_jitter, restart_budget,
-    restart_window_s, resume, checkpoint_dir, watchdog,
+    restart_window_s, resume, checkpoint_dir, watchdog, elastic,
+    min_data_parallel,
 ):
+    from .faults import WorldSizeChange
+
+    skip_resume_once = False
     while True:
         try:
             # Resume INSIDE the guarded region: a failing restore (torn array
             # file, transient filesystem error) must consume a retry like any
             # other failure, not bypass the backoff/budget machinery.
-            if resume:
-                _try_resume(accelerator, checkpoint_dir)
+            if resume and not skip_resume_once:
+                _try_resume(accelerator, checkpoint_dir, reshard=elastic)
+            skip_resume_once = False
             result = _call_train_fn(train_fn, accelerator, attempt)
             accelerator.log_goodput()
             return result
         except (KeyboardInterrupt, SystemExit):
             raise
+        except WorldSizeChange as exc:
+            if watchdog is not None:
+                watchdog.rearm()
+            if not elastic:
+                raise RuntimeError(
+                    f"World-size change at step {exc.step} ({exc.direction} by "
+                    f"{exc.factor}x) but this run is not elastic: the fixed-size "
+                    "gang cannot re-form on a different device count. Pass "
+                    "run_resilient(elastic=True, min_data_parallel=...) — or "
+                    "launch with --elastic — to reshard and resume."
+                ) from exc
+            # A voluntary resize is not a crash: it consumes neither
+            # max_restarts nor the crash-loop budget, takes no exponential
+            # backoff, and books its downtime as `reshard` (inside
+            # reshard_accelerator), not `restart`.
+            from .elastic import (
+                agree_world_size,
+                reshard_accelerator,
+                resolve_resized_devices,
+            )
+
+            import jax
+
+            # Resize relative to the world the run is ACTUALLY on — the live
+            # mesh. It may cover a device subset (a prior manual or elastic
+            # reshard); a cached set or jax.devices() can only desync from it.
+            current = list(accelerator.mesh.devices.flat)
+            new_devices = resolve_resized_devices(current, exc.direction, exc.factor)
+            if (
+                exc.direction == "grow"
+                and len(new_devices) == len(current)
+            ):
+                # grow is capped at the devices the platform exposes; at full
+                # capacity the cap makes the resize a no-op — keep training
+                # from live state, don't rewind to a checkpoint.
+                logger.warning(
+                    f"World-size grow at step {exc.step} capped at the "
+                    f"{len(current)} attached device(s); continuing at the "
+                    "current size."
+                )
+                skip_resume_once = True
+                continue
+            # Multi-host: every rank must agree on the survivor count before
+            # re-forming — one KV exchange (no device collectives needed,
+            # they may be what just died). Single-process: a no-op echo.
+            local = sum(
+                1 for d in new_devices
+                if getattr(d, "process_index", 0) == jax.process_index()
+            )
+            if local == 0 and getattr(accelerator.state, "num_processes", 1) > 1:
+                # A count-only agreement would pass even when the shrunken
+                # set excludes every device THIS live host owns — it could
+                # never address the new mesh. Whole surviving hosts must own
+                # a share; anything else needs a gang restart at the new size.
+                raise RuntimeError(
+                    f"Elastic shrink at step {exc.step} leaves process "
+                    f"{jax.process_index()} with no devices in the surviving "
+                    "set: an in-process resize must keep every live host in "
+                    "the mesh. Restart the gang at the new size instead."
+                ) from exc
+            agreed = agree_world_size(accelerator.state, local_device_count=local)
+            if agreed != len(new_devices):
+                raise RuntimeError(
+                    f"Elastic resize disagreement: this rank resolved "
+                    f"{len(new_devices)} surviving device(s) but the gang "
+                    f"agreed on {agreed}. The hosts see different worlds — "
+                    "restart the gang instead of re-forming inconsistently."
+                ) from exc
+            restored_in_memory = _restore_from_snapshot(accelerator)
+            logger.warning(
+                f"World-size change at step {exc.step}: {exc.direction} "
+                f"{len(current)} -> {len(new_devices)} device(s); resharding and "
+                + ("replaying from the in-memory last-known-good snapshot."
+                   if restored_in_memory else
+                   "resuming from the newest complete checkpoint.")
+            )
+            reshard_accelerator(
+                accelerator, devices=new_devices, min_data_parallel=min_data_parallel
+            )
+            # An in-memory restore already positioned the run (and postdates
+            # any checkpoint restore would reach); re-loading on top of it
+            # would rewind the replay.
+            skip_resume_once = restored_in_memory
         except Exception as exc:
             if watchdog is not None:
                 watchdog.rearm()  # the next attempt gets a fresh deadline
@@ -184,9 +300,10 @@ def _call_train_fn(train_fn, accelerator, attempt):
     return train_fn(accelerator, attempt) if takes_attempt else train_fn(accelerator)
 
 
-def _try_resume(accelerator, checkpoint_dir):
+def _try_resume(accelerator, checkpoint_dir, reshard: bool = False):
     """Restore from the newest complete checkpoint if one exists; a fresh run
-    (nothing saved yet) starts clean instead of failing."""
+    (nothing saved yet) starts clean instead of failing. ``reshard=True``
+    (the elastic path) accepts checkpoints written under a different mesh."""
     from ..checkpointing import _checkpoint_complete
     from ..utils.constants import CHECKPOINT_DIR_PREFIX
 
@@ -195,7 +312,7 @@ def _try_resume(accelerator, checkpoint_dir):
     # elapsed time in the ledger — wrapping it again would double-count.
     if checkpoint_dir is not None:
         if os.path.isdir(checkpoint_dir) and _checkpoint_complete(checkpoint_dir, accelerator):
-            accelerator.load_state(checkpoint_dir)
+            accelerator.load_state(checkpoint_dir, reshard=reshard)
         return
     if not (project.automatic_checkpoint_naming and project.project_dir):
         return
@@ -205,6 +322,22 @@ def _try_resume(accelerator, checkpoint_dir):
     ):
         return
     try:
-        accelerator.load_state()  # newest COMPLETE folder; skips litter
+        accelerator.load_state(reshard=reshard)  # newest COMPLETE folder; skips litter
     except FileNotFoundError:
         logger.warning(f"No complete checkpoint under {base}; starting fresh.")
+
+
+def _restore_from_snapshot(accelerator) -> bool:
+    """Elastic transitions where the process survives: restore from the health
+    subsystem's in-memory last-known-good snapshot (newer than any checkpoint
+    cadence, zero disk I/O) when one is held. The snapshot's arrays still lay
+    on the OLD mesh — the caller reshards immediately after, and the
+    now-stale ring is discarded there. Returns whether a restore happened."""
+    guard = accelerator._health_guard
+    if guard is None or guard.lkg.step is None:
+        return False
+    from ..health.rollback import restore_accelerator
+
+    with get_ledger().track("reshard"):
+        restore_accelerator(accelerator, guard.lkg)
+    return True

@@ -549,6 +549,17 @@ class AcceleratorState:
     def mesh(self):
         return self._partial.mesh
 
+    def replace_mesh(self, mesh, parallelism_config: ParallelismConfig | None = None):
+        """Swap the process mesh after an elastic world-size change
+        (``resilience/elastic.py``): every property reading the mesh live —
+        batch placement, ``global_batch_divisor``, the sharding planner —
+        sees the new world immediately. The caller owns moving live arrays
+        onto it (``reshard_accelerator``)."""
+        self._partial.set_mesh(mesh, parallelism_config)
+        self.__dict__["_mesh"] = mesh
+        if parallelism_config is not None:
+            self.parallelism_config = parallelism_config
+
     @property
     def global_batch_divisor(self) -> int:
         """How many ways the global batch is sharded (dp*fsdp axes)."""

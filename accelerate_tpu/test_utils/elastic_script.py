@@ -1,0 +1,78 @@
+"""Multi-host elastic world-size drill, run under the real 2-process launcher::
+
+    accelerate-tpu launch --cpu --num_processes 2 --elastic \
+        --min_data_parallel 1 -m accelerate_tpu.test_utils.elastic_script
+
+Proves the multi-host half of the elastic contract (tests/test_elastic.py
+covers the single-process reshard mechanics on the 8-device mesh):
+
+- the launcher's ``--elastic``/``--min_data_parallel`` flags reach every
+  worker as ACCELERATE_ELASTIC / ACCELERATE_MIN_DATA_PARALLEL, and
+  ``run_resilient`` picks them up as its defaults;
+- before re-forming a gang at a new size, every host agrees on the total
+  surviving device count through :func:`~accelerate_tpu.resilience.elastic.
+  agree_world_size`. On CPU backends the XLA runtime refuses multiprocess
+  computations, which is exactly the environment where the exchange must
+  ride the coordination-service KV fallback — each rank posts its local
+  count (rank 0 simulates losing half its devices) and every rank reads the
+  same total back;
+- the agreed count resolves through the same mesh arithmetic the reshard
+  uses (``elastic_parallelism_for``), including the min_data_parallel floor.
+"""
+
+from __future__ import annotations
+
+import os
+
+from accelerate_tpu import PartialState
+from accelerate_tpu.parallel.mesh import elastic_parallelism_for
+from accelerate_tpu.resilience.elastic import (
+    agree_world_size,
+    elastic_from_env,
+    min_data_parallel_from_env,
+)
+
+
+def main():
+    state = PartialState()
+    assert state.num_processes >= 2, "run under `launch --num_processes 2`"
+
+    # 1. The env contract reached this worker.
+    assert os.environ.get("ACCELERATE_ELASTIC") == "1", os.environ.get("ACCELERATE_ELASTIC")
+    assert elastic_from_env() is True
+    assert min_data_parallel_from_env() == int(
+        os.environ.get("ACCELERATE_MIN_DATA_PARALLEL", "1")
+    )
+
+    # 2. World-size agreement over the KV fallback: rank 0 "lost" half of a
+    # simulated 4-device host, every other rank still holds 4 — all ranks
+    # must compute the identical survivor total.
+    local = 2 if state.process_index == 0 else 4
+    total = agree_world_size(state, local_device_count=local)
+    expected = 2 + 4 * (state.num_processes - 1)
+    assert total == expected, f"rank {state.process_index}: {total} != {expected}"
+
+    # A second exchange must not collide with the first (single-use KV
+    # namespaces) and must agree again.
+    assert agree_world_size(state, local_device_count=local) == expected
+
+    # 3. The agreed total resolves through the elastic mesh arithmetic —
+    # every non-dp axis fixed, dp absorbing the survivors — and the
+    # min_data_parallel floor refuses pointedly below it.
+    config = elastic_parallelism_for(state.mesh, expected, min_data_parallel=1)
+    assert config.dp_size * config.fsdp_size >= 1
+    try:
+        elastic_parallelism_for(state.mesh, expected, min_data_parallel=expected + 1)
+    except ValueError as exc:
+        assert "min_data_parallel" in str(exc)
+    else:
+        raise AssertionError("min_data_parallel floor did not refuse")
+
+    # No device barrier here: multiprocess CPU refuses collective
+    # computations (the whole reason this drill rides the KV transport).
+    # Every rank reports success; the test counts both.
+    print(f"ELASTIC_AGREEMENT_OK rank={state.process_index}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -48,6 +48,12 @@ ENV_COMPILE_CACHE_MIN_SECS = "ACCELERATE_COMPILE_CACHE_MIN_COMPILE_SECS"
 ENV_HANDLE_PREEMPTION = "ACCELERATE_HANDLE_PREEMPTION"
 ENV_FAULT_PLAN = "ACCELERATE_FAULT_PLAN"
 ENV_RESTART_ATTEMPT = "ACCELERATE_RESTART_ATTEMPT"
+# Elastic world-size training (resilience/elastic.py): opt run_resilient into
+# re-forming the mesh at whatever dp degree the surviving devices support
+# after a shrink/grow, and the floor below which a shrink refuses to re-form
+# (the job would rather queue for capacity than limp on too few replicas).
+ENV_ELASTIC = "ACCELERATE_ELASTIC"
+ENV_MIN_DATA_PARALLEL = "ACCELERATE_MIN_DATA_PARALLEL"
 # Training-health contract (health/): the always-on numerics sentinel ("0"
 # disables it), the loss-spike robust z-score threshold, and the hang
 # watchdog's heartbeat deadline in seconds (installed at PartialState init so

@@ -257,6 +257,8 @@ def test_config_wizard_roundtrips_through_launch(tmp_path):
         "yes",               # auto naming
         "3",                 # total limit
         "yes",               # handle preemption (SIGTERM watcher)
+        "yes",               # elastic world size (reshard on shrink/grow)
+        "2",                 # minimum data-parallel degree floor
         "yes",               # configure training-health guards?
         "yes",               # numerics sentinel
         "7.0",               # spike z-score threshold
@@ -280,6 +282,7 @@ def test_config_wizard_roundtrips_through_launch(tmp_path):
     assert cfg.gradient_accumulation_steps == 4 and cfg.log_with == "json"
     assert cfg.checkpoint_total_limit == 3 and cfg.checkpoint_auto_naming
     assert cfg.handle_preemption
+    assert cfg.elastic is True and cfg.min_data_parallel == 2
     assert cfg.guard_numerics and cfg.spike_zscore == 7.0 and cfg.hang_timeout == 240.0
     assert cfg.telemetry is True and cfg.metrics_port == 0
     assert cfg.straggler_threshold == 1.8
@@ -304,6 +307,11 @@ def test_config_wizard_roundtrips_through_launch(tmp_path):
         "assert os.environ.get('ACCELERATE_HANDLE_PREEMPTION') == '1'\n"
         "from accelerate_tpu.resilience.preemption import get_default_watcher\n"
         "assert get_default_watcher(install=False)._prev_handlers is not None\n"
+        "assert os.environ.get('ACCELERATE_ELASTIC') == '1'\n"
+        "assert os.environ.get('ACCELERATE_MIN_DATA_PARALLEL') == '2'\n"
+        "from accelerate_tpu.resilience.elastic import elastic_from_env, "
+        "min_data_parallel_from_env\n"
+        "assert elastic_from_env() is True and min_data_parallel_from_env() == 2\n"
         "assert os.environ.get('ACCELERATE_GUARD_NUMERICS') == '1'\n"
         "assert os.environ.get('ACCELERATE_TELEMETRY') == '1'\n"
         "assert os.environ.get('ACCELERATE_STRAGGLER_THRESHOLD') == '1.8'\n"

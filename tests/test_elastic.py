@@ -1,0 +1,660 @@
+"""Elastic world-size tests — shrink/grow drills, cross-mesh checkpoint
+resharding, accumulation rescale (ISSUE 6 acceptance: a deterministic
+`shrink:2` kill at step N must auto-resume at half dp with accumulation
+doubled, bit-exact vs a fresh same-checkpoint run at the new size, with the
+transition booked as `reshard` badput and visible in the metrics registry).
+
+All deterministic and CPU-fast on the virtual 8-device mesh: world-size
+faults come from resilience/faults.py plans (`shrink:N`/`grow:N`), data is
+regenerated from global sample indices so every world size feeds the same
+sequence, and the model is the scalar RegressionModel."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.parallel.mesh import elastic_parallelism_for
+from accelerate_tpu.parallel.sharding import data_parallel_degree
+from accelerate_tpu.resilience import (
+    FaultPlan,
+    WorldSizeChange,
+    reset_active_plan,
+    run_resilient,
+    set_active_plan,
+)
+from accelerate_tpu.resilience.elastic import resolve_resized_devices
+from accelerate_tpu.resilience.goodput import get_ledger
+from accelerate_tpu.test_utils import RegressionModel
+from accelerate_tpu.utils.dataclasses import ProjectConfiguration
+
+pytestmark = pytest.mark.elastic
+
+GLOBAL_BATCH = 16  # samples per optimizer update, preserved across resizes
+
+
+@pytest.fixture(autouse=True)
+def _reset_resilience():
+    from accelerate_tpu.resilience import reset_default_watcher
+
+    yield
+    reset_default_watcher()
+    reset_active_plan()
+
+
+# --------------------------------------------------------------- harness
+def _build(project_dir=None):
+    cfg = ProjectConfiguration(
+        project_dir=str(project_dir), automatic_checkpoint_naming=True
+    ) if project_dir is not None else ProjectConfiguration()
+    accelerator = Accelerator(project_config=cfg)
+    model = RegressionModel()
+    model.init_params(None)
+    pmodel, popt = accelerator.prepare(model, optax.adam(0.1))
+    return accelerator, pmodel, popt
+
+
+def _update_samples(update):
+    """The GLOBAL_BATCH samples update ``update`` trains on — a pure function
+    of the update index, so every world size (and every resume) feeds the
+    byte-identical sequence."""
+    rng = np.random.default_rng(100 + update)
+    x = rng.normal(size=(GLOBAL_BATCH,)).astype(np.float32)
+    return x, (2.0 * x + 3.0).astype(np.float32)
+
+
+def _microbatch(update, micro, accum):
+    """Slice micro-step ``micro`` of ``accum`` out of the update's global
+    batch: accumulation-of-means over equal slices equals the full-batch
+    mean, so the global batch contract holds at every (dp, accum) pair."""
+    x, y = _update_samples(update)
+    per = GLOBAL_BATCH // accum
+    sl = slice(micro * per, (micro + 1) * per)
+    return {"x": x[sl], "y": y[sl]}
+
+
+def _make_train_fn(pmodel, popt, total_updates, save_every=0, guard=False):
+    """A resumable, ELASTIC loop: re-reads the accumulation degree (rescaled
+    by a reshard) and rebuilds the fused step on every (re)entry, so a
+    world-size transition only has to re-enter it."""
+
+    def train_fn(accelerator, attempt=0):
+        accum = accelerator.gradient_accumulation_steps
+        step_fn = accelerator.build_train_step(pmodel, popt)
+        for u in range(accelerator.step, total_updates):
+            for m in range(accum):
+                loss = step_fn(_microbatch(u + 1, m, accum))
+            accelerator.step = u + 1
+            if save_every and accelerator.step % save_every == 0:
+                accelerator.save_state()
+            if guard:
+                accelerator.guard_step(loss, step=accelerator.step)
+            accelerator.checkpoint_on_preemption(step=accelerator.step)
+        return accelerator.step
+
+    return train_fn
+
+
+def _reset_accelerator_singletons():
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+
+
+def _final_state(accelerator, pmodel, popt):
+    params = accelerator.get_state_dict(pmodel)
+    opt_leaves = [
+        np.asarray(jax.device_get(l))
+        for l in jax.tree_util.tree_leaves(popt.opt_state)
+    ]
+    return params, opt_leaves, accelerator.step, pmodel.handle.step_counter
+
+
+def _assert_bit_exact(state_a, state_b):
+    params_a, opt_a, step_a, rngc_a = state_a
+    params_b, opt_b, step_b, rngc_b = state_b
+    assert step_a == step_b
+    assert rngc_a == rngc_b
+    for key in params_a:
+        assert np.array_equal(np.asarray(params_a[key]), np.asarray(params_b[key])), key
+    assert len(opt_a) == len(opt_b)
+    for la, lb in zip(opt_a, opt_b):
+        assert np.array_equal(la, lb)
+
+
+def _assert_close(params_a, params_b, rtol=1e-4):
+    for key in params_a:
+        np.testing.assert_allclose(
+            np.asarray(params_a[key]), np.asarray(params_b[key]), rtol=rtol, atol=1e-5,
+        )
+
+
+# ----------------------------------------------------------- fault grammar
+def test_fault_grammar_shrink_grow():
+    plan = FaultPlan.parse("step:5=shrink:2; step:9=grow:4;step:12=grow")
+    assert [(f.step, f.action, f.arg) for f in plan.faults] == [
+        (5, "shrink", "2"), (9, "grow", "4"), (12, "grow", None)
+    ]
+    for bad in ("step:3=shrink:0", "step:3=shrink:1", "step:3=grow:x", "step:3=shrink:1.5"):
+        with pytest.raises(ValueError, match="fault-plan"):
+            FaultPlan.parse(bad)
+
+
+def test_world_size_change_fires_once():
+    plan = FaultPlan.parse("step:4=shrink:2")
+    with pytest.raises(WorldSizeChange) as excinfo:
+        plan.maybe_fire(4)
+    assert excinfo.value.step == 4
+    assert excinfo.value.direction == "shrink"
+    assert excinfo.value.factor == 2
+    plan.maybe_fire(4)  # fired once: a resumed run replaying step 4 survives
+
+
+# ------------------------------------------------------- shape resolution
+def test_elastic_parallelism_keeps_model_axes_fixed():
+    acc, _, _ = _build()
+    cfg = elastic_parallelism_for(acc.mesh, 4)
+    assert cfg.dp_size == 4 and cfg.tp_size == 1 and cfg.fsdp_size == 1
+
+
+def test_elastic_parallelism_divisibility_and_floor_errors():
+    from accelerate_tpu.parallel.mesh import ParallelismConfig
+
+    mesh = ParallelismConfig(tp_size=2).build_mesh()  # dp4 x tp2 on 8 devices
+    with pytest.raises(ValueError, match="fixed non-dp axes"):
+        elastic_parallelism_for(mesh, 1)  # cannot host tp=2 on one device
+    with pytest.raises(ValueError, match="fixed non-dp axes"):
+        elastic_parallelism_for(mesh, 5)  # 5 devices don't divide by tp=2
+    with pytest.raises(ValueError, match="min_data_parallel"):
+        elastic_parallelism_for(mesh, 4, min_data_parallel=4)  # dp would be 2
+
+
+def test_resolve_resized_devices():
+    devices = list(jax.devices())
+    assert resolve_resized_devices(devices, "shrink", 2) == devices[:4]
+    assert resolve_resized_devices(devices[:4], "grow", 2) == devices
+    with pytest.raises(ValueError, match="must divide"):
+        resolve_resized_devices(devices, "shrink", 3)
+    # grow is capped at the attached devices; at full capacity it is a
+    # no-op, not a fault.
+    assert resolve_resized_devices(devices, "grow", 2) == devices
+
+
+# ----------------------------------------------------- reshard mechanics
+def test_reshard_moves_state_and_rescales_accum():
+    get_ledger().reset()
+    acc, pmodel, popt = _build()
+    step_fn = acc.build_train_step(pmodel, popt)
+    step_fn(_microbatch(1, 0, 1))
+    before = acc.get_state_dict(pmodel)
+    assert data_parallel_degree(acc.mesh) == 8
+
+    mesh = acc.reshard(devices=jax.devices()[:4])
+    assert data_parallel_degree(mesh) == 4
+    assert acc.gradient_accumulation_steps == 2  # global batch preserved
+    # Live arrays moved bit-exactly onto the new mesh.
+    after = acc.get_state_dict(pmodel)
+    for key in before:
+        assert np.array_equal(np.asarray(before[key]), np.asarray(after[key]))
+    assert pmodel.handle.mesh is mesh
+    for s in jax.tree_util.tree_leaves(
+        pmodel.handle.param_shardings,
+        is_leaf=lambda x: hasattr(x, "mesh"),
+    ):
+        assert s.mesh == mesh
+    # Transition booked as `reshard` badput + gauges/counters in the registry.
+    assert get_ledger().summary()["reshard_s"] > 0
+    from accelerate_tpu.telemetry.metrics import get_registry
+
+    snap = get_registry().snapshot()
+    assert snap['accelerate_reshard_transitions_total{direction="shrink"}'] >= 1
+    assert snap["accelerate_world_size"] == 4.0
+    assert snap["accelerate_data_parallel_degree"] == 4.0
+
+
+def test_stale_fused_programs_refuse_after_reshard():
+    acc, pmodel, popt = _build()
+    step_fn = acc.build_train_step(pmodel, popt)
+    step_fn(_microbatch(1, 0, 1))
+    acc.reshard(devices=jax.devices()[:4])
+    with pytest.raises(RuntimeError, match="resharded"):
+        step_fn(_microbatch(2, 0, 2))
+    # A rebuild against the new mesh trains again.
+    step_fn = acc.build_train_step(pmodel, popt)
+    step_fn(_microbatch(2, 0, 2))
+
+
+def test_reshard_accum_divisibility_error():
+    acc, pmodel, popt = _build()
+    acc.reshard(devices=jax.devices()[:4])  # dp4, accum 2
+    acc.gradient_accumulation_steps = 1  # operator broke the contract
+    with pytest.raises(ValueError, match="global batch"):
+        acc.reshard(devices=jax.devices())  # 1 * dp4 not divisible by dp8
+
+
+def test_reshard_discards_health_snapshots():
+    acc, pmodel, popt = _build()
+    guard = acc.configure_health(snapshot_every=1, spike_zscore=0)
+    step_fn = acc.build_train_step(pmodel, popt)
+    loss = step_fn(_microbatch(1, 0, 1))
+    acc.step = 1
+    acc.guard_step(loss, step=1)
+    assert guard.lkg.step is not None
+    acc.reshard(devices=jax.devices()[:4])
+    assert guard.lkg.step is None  # old-mesh snapshots discarded, not restored
+    assert len(guard._pending) == 0
+
+
+# -------------------------------------------- cross-mesh checkpoint restore
+def test_checkpoint_manifest_records_mesh(tmp_path):
+    import json
+
+    acc, pmodel, popt = _build(tmp_path)
+    acc.save_state()
+    manifest = json.loads(
+        (tmp_path / "checkpoints" / "checkpoint_0" / "manifest.json").read_text()
+    )
+    assert manifest["mesh"]["axes"]["dp"] == 8
+    assert manifest["mesh"]["process_count"] == 1
+    assert manifest["mesh"]["data_parallel"] == 8
+
+
+def test_cross_mesh_restore_requires_reshard_and_is_bit_exact(tmp_path):
+    """dp4 -> dp2 and dp2 -> dp4: a mesh mismatch raises the pointed
+    'resharding required' error, and reshard=True restores params, optimizer
+    moments, and RNG bit-exact across the layout change."""
+    acc, pmodel, popt = _build(tmp_path)
+    acc.reshard(devices=jax.devices()[:4])  # dp4
+    step_fn = acc.build_train_step(pmodel, popt)
+    for m in range(2):
+        step_fn(_microbatch(1, m, 2))
+    acc.step = 1
+    acc.save_state()  # checkpoint_0, written under dp4
+    state_dp4 = _final_state(acc, pmodel, popt)
+
+    acc.reshard(devices=jax.devices()[:2])  # dp2
+    with pytest.raises(RuntimeError, match="resharding is required"):
+        acc.load_state()
+    acc.load_state(reshard=True)
+    _assert_bit_exact(state_dp4, _final_state(acc, pmodel, popt))
+
+    # Continue at dp2, save, and restore that checkpoint back onto dp4.
+    step_fn = acc.build_train_step(pmodel, popt)
+    for m in range(4):
+        step_fn(_microbatch(2, m, 4))
+    acc.step = 2
+    acc.save_state()  # checkpoint_1, written under dp2
+    state_dp2 = _final_state(acc, pmodel, popt)
+
+    acc.reshard(devices=jax.devices()[:4])  # back to dp4
+    with pytest.raises(RuntimeError, match="resharding is required"):
+        acc.load_state()
+    acc.load_state(reshard=True)
+    _assert_bit_exact(state_dp2, _final_state(acc, pmodel, popt))
+
+
+def test_same_mesh_restore_needs_no_reshard_flag(tmp_path):
+    acc, pmodel, popt = _build(tmp_path)
+    acc.save_state()
+    acc.load_state()  # no mismatch, no flag needed
+
+
+# ------------------------------------------------- the acceptance scenario
+def test_shrink_drill_bit_exact_vs_fresh_run_at_new_size(tmp_path):
+    """shrink:2 kills at step 8: auto-resume re-forms at dp4 with accum
+    doubled from the step-6 checkpoint. The resumed run must be BIT-exact vs
+    a fresh run launched at the new size from the same checkpoint, and
+    final-params-equivalent to the uninterrupted dp8 baseline (same global
+    batch; only float reassociation differs)."""
+    total, save_every = 12, 3
+
+    # A: uninterrupted fixed-size baseline at dp8/accum1.
+    set_active_plan(None)
+    acc_a, pmodel_a, popt_a = _build(tmp_path / "baseline")
+    assert _make_train_fn(pmodel_a, popt_a, total, save_every)(acc_a) == total
+    params_a = acc_a.get_state_dict(pmodel_a)
+
+    # B: the elastic drill — kill at step 8, resume at dp4/accum2.
+    _reset_accelerator_singletons()
+    get_ledger().reset()
+    set_active_plan(FaultPlan.parse("step:8=shrink:2"))
+    acc_b, pmodel_b, popt_b = _build(tmp_path / "elastic")
+    result = run_resilient(
+        _make_train_fn(pmodel_b, popt_b, total, save_every),
+        acc_b,
+        elastic=True,
+        backoff_base_s=0.0,
+        backoff_jitter=0.0,
+    )
+    assert result == total
+    assert data_parallel_degree(acc_b.mesh) == 4
+    assert acc_b.gradient_accumulation_steps == 2
+    state_b = _final_state(acc_b, pmodel_b, popt_b)
+    ledger = get_ledger().summary()
+    assert ledger["reshard_s"] > 0  # booked as reshard badput...
+    assert ledger["restarts"] == 0  # ...NOT as a crash restart
+    from accelerate_tpu.telemetry.metrics import get_registry
+
+    snap = get_registry().snapshot()
+    assert snap['accelerate_reshard_transitions_total{direction="shrink"}'] >= 1
+    assert snap["accelerate_world_size"] == 4.0
+
+    # C: a fresh run launched at the new size from the same checkpoint
+    # (checkpoint_1, step 6 — the one B's resume picked).
+    _reset_accelerator_singletons()
+    set_active_plan(None)
+    acc_c, pmodel_c, popt_c = _build(tmp_path / "fresh")
+    acc_c.reshard(devices=jax.devices()[:4])
+    assert acc_c.gradient_accumulation_steps == 2
+    acc_c.load_state(
+        str(tmp_path / "elastic" / "checkpoints" / "checkpoint_1"), reshard=True
+    )
+    assert acc_c.step == 6
+    assert _make_train_fn(pmodel_c, popt_c, total)(acc_c) == total
+    _assert_bit_exact(state_b, _final_state(acc_c, pmodel_c, popt_c))
+
+    # Loss-equivalence vs the uninterrupted baseline: same global batch per
+    # update, so the trajectories agree up to float reassociation.
+    _assert_close(params_a, state_b[0])
+
+
+def test_grow_drill_symmetric(tmp_path):
+    """shrink:2 at step 4 then grow:2 at step 8: dp8 -> dp4 -> dp8 with
+    accumulation 1 -> 2 -> 1, final params equivalent to the uninterrupted
+    fixed-size run, both transitions in the registry."""
+    total, save_every = 12, 2
+
+    set_active_plan(None)
+    acc_a, pmodel_a, popt_a = _build(tmp_path / "baseline")
+    _make_train_fn(pmodel_a, popt_a, total, save_every)(acc_a)
+    params_a = acc_a.get_state_dict(pmodel_a)
+
+    _reset_accelerator_singletons()
+    set_active_plan(FaultPlan.parse("step:4=shrink:2;step:8=grow:2"))
+    acc_b, pmodel_b, popt_b = _build(tmp_path / "elastic")
+    result = run_resilient(
+        _make_train_fn(pmodel_b, popt_b, total, save_every),
+        acc_b,
+        elastic=True,
+        backoff_base_s=0.0,
+        backoff_jitter=0.0,
+    )
+    assert result == total
+    assert data_parallel_degree(acc_b.mesh) == 8  # grown back
+    assert acc_b.gradient_accumulation_steps == 1
+    _assert_close(params_a, acc_b.get_state_dict(pmodel_b))
+    from accelerate_tpu.telemetry.metrics import get_registry
+
+    snap = get_registry().snapshot()
+    assert snap['accelerate_reshard_transitions_total{direction="shrink"}'] >= 1
+    assert snap['accelerate_reshard_transitions_total{direction="grow"}'] >= 1
+    assert snap["accelerate_world_size"] == 8.0
+
+
+def test_in_memory_snapshot_restore_when_process_survives(tmp_path):
+    """No checkpoint anywhere: the transition restores from the health
+    subsystem's in-memory last-known-good snapshot, reshards it onto the new
+    mesh, and the replay is bit-exact vs a run that took the same transition
+    at the snapshot step directly."""
+    total = 8
+
+    set_active_plan(FaultPlan.parse("step:5=shrink:2"))
+    acc_b, pmodel_b, popt_b = _build()  # no project dir: nothing on disk
+    acc_b.configure_health(snapshot_every=2, spike_zscore=0)
+    result = run_resilient(
+        _make_train_fn(pmodel_b, popt_b, total, guard=True),
+        acc_b,
+        elastic=True,
+        max_restarts=0,  # an in-memory resize must not need a restart budget
+        backoff_base_s=0.0,
+        backoff_jitter=0.0,
+    )
+    assert result == total
+    assert data_parallel_degree(acc_b.mesh) == 4
+    snapshot_step = 4  # newest lkg capture before the step-5 fault
+    state_b = _final_state(acc_b, pmodel_b, popt_b)
+
+    # Comparator: same trajectory with the transition applied directly at the
+    # snapshot step (dp8/accum1 through step 4, then dp4/accum2 to the end).
+    _reset_accelerator_singletons()
+    set_active_plan(None)
+    acc_c, pmodel_c, popt_c = _build()
+    acc_c.configure_health(snapshot_every=2, spike_zscore=0)
+    _make_train_fn(pmodel_c, popt_c, snapshot_step, guard=True)(acc_c)
+    acc_c.reshard(devices=jax.devices()[:4])
+    _make_train_fn(pmodel_c, popt_c, total, guard=True)(acc_c)
+    _assert_bit_exact(state_b, _final_state(acc_c, pmodel_c, popt_c))
+
+
+def test_resize_is_relative_to_the_current_mesh_not_all_devices():
+    """A run already on a device subset (a prior manual reshard) must shrink
+    relative to ITS world: shrink:2 from dp4 lands at dp2, not at 'half of
+    jax.devices()' — which would be the dp4 the run already had, a silent
+    no-op resize."""
+    acc, pmodel, popt = _build()
+    acc.reshard(devices=jax.devices()[:4])  # dp4, accum 2
+    set_active_plan(FaultPlan.parse("step:2=shrink:2"))
+    result = run_resilient(
+        _make_train_fn(pmodel, popt, 4),
+        acc,
+        elastic=True,
+        resume=False,
+        backoff_base_s=0.0,
+    )
+    assert result == 4
+    assert data_parallel_degree(acc.mesh) == 2
+    assert acc.gradient_accumulation_steps == 4
+
+
+def test_grow_at_full_capacity_is_a_noop_not_a_crash():
+    """grow:2 while already on every attached device: the cap makes the
+    resize a no-op — training continues at the current size from LIVE state
+    (no checkpoint rewind), and run_resilient does not die."""
+    set_active_plan(FaultPlan.parse("step:2=grow:2"))
+    acc, pmodel, popt = _build()
+    result = run_resilient(
+        _make_train_fn(pmodel, popt, 4),
+        acc,
+        elastic=True,
+        max_restarts=0,
+        resume=False,
+        backoff_base_s=0.0,
+    )
+    assert result == 4
+    assert data_parallel_degree(acc.mesh) == 8  # unchanged
+    assert acc.gradient_accumulation_steps == 1
+
+
+def test_fresh_process_restart_at_new_size_rescales_accum(tmp_path):
+    """A REAL restart (new process, never saw a WorldSizeChange) loading a
+    checkpoint written at a different dp: reshard=True must rescale
+    accumulation from the checkpoint's absolute record — and be idempotent
+    with the in-process path, which already rescaled before loading."""
+    acc, pmodel, popt = _build(tmp_path)
+    acc.step = 1
+    acc.save_state()  # written at dp8, accum 1
+
+    # Simulate the fresh incarnation on 4 devices: mesh at dp4 but the
+    # script's default accum (1) — exactly what a relaunched process has.
+    _reset_accelerator_singletons()
+    acc2, pmodel2, popt2 = _build(tmp_path)
+    acc2.reshard(devices=jax.devices()[:4])
+    acc2.gradient_accumulation_steps = 1  # fresh process default, not rescaled
+    acc2.load_state(reshard=True)
+    assert acc2.gradient_accumulation_steps == 2  # 1 x dp8 / dp4
+    # Idempotent: loading again (accum already correct) changes nothing.
+    acc2.load_state(reshard=True)
+    assert acc2.gradient_accumulation_steps == 2
+
+
+def test_non_elastic_world_change_is_a_pointed_error():
+    set_active_plan(FaultPlan.parse("step:2=shrink:2"))
+    acc, pmodel, popt = _build()
+    with pytest.raises(RuntimeError, match="elastic=True"):
+        run_resilient(
+            _make_train_fn(pmodel, popt, 4),
+            acc,
+            elastic=False,
+            resume=False,
+            backoff_base_s=0.0,
+        )
+
+
+def test_min_data_parallel_floor_refuses_shrink():
+    set_active_plan(FaultPlan.parse("step:2=shrink:2"))
+    acc, pmodel, popt = _build()
+    with pytest.raises(ValueError, match="min_data_parallel"):
+        run_resilient(
+            _make_train_fn(pmodel, popt, 4),
+            acc,
+            elastic=True,
+            min_data_parallel=8,
+            resume=False,
+            backoff_base_s=0.0,
+        )
+
+
+def test_resize_does_not_consume_crash_loop_budget():
+    """The backoff-classification satellite: a fleet that legitimately
+    resizes twice is not one fault away from giving up — resizes consume
+    neither max_restarts nor the crash-loop window."""
+    set_active_plan(FaultPlan.parse("step:2=shrink:2;step:4=grow:2"))
+    acc, pmodel, popt = _build()
+    result = run_resilient(
+        _make_train_fn(pmodel, popt, 6),
+        acc,
+        elastic=True,
+        max_restarts=0,  # zero crash budget: both resizes must still pass
+        restart_budget=0,
+        resume=False,
+        backoff_base_s=0.0,
+    )
+    assert result == 6
+    assert get_ledger().restarts == 0
+
+
+# -------------------------------------------------- env / launcher contract
+def test_runner_reads_elastic_env_contract(monkeypatch):
+    from accelerate_tpu.resilience.elastic import (
+        elastic_from_env,
+        min_data_parallel_from_env,
+    )
+
+    monkeypatch.delenv("ACCELERATE_ELASTIC", raising=False)
+    monkeypatch.delenv("ACCELERATE_MIN_DATA_PARALLEL", raising=False)
+    assert elastic_from_env() is False
+    assert min_data_parallel_from_env() == 1
+    monkeypatch.setenv("ACCELERATE_ELASTIC", "1")
+    monkeypatch.setenv("ACCELERATE_MIN_DATA_PARALLEL", "4")
+    assert elastic_from_env() is True
+    assert min_data_parallel_from_env() == 4
+    monkeypatch.setenv("ACCELERATE_MIN_DATA_PARALLEL", "0")
+    with pytest.raises(ValueError, match="MIN_DATA_PARALLEL"):
+        min_data_parallel_from_env()
+
+
+def test_launch_env_exports_elastic_tristate(monkeypatch):
+    from accelerate_tpu.commands.config_args import ClusterConfig
+    from accelerate_tpu.commands.launch import prepare_launch_env
+
+    monkeypatch.delenv("ACCELERATE_ELASTIC", raising=False)
+    monkeypatch.delenv("ACCELERATE_MIN_DATA_PARALLEL", raising=False)
+    env = prepare_launch_env(ClusterConfig())
+    assert "ACCELERATE_ELASTIC" not in env  # unspecified: nothing exported
+    assert "ACCELERATE_MIN_DATA_PARALLEL" not in env
+    env = prepare_launch_env(ClusterConfig(elastic=True, min_data_parallel=2))
+    assert env["ACCELERATE_ELASTIC"] == "1"
+    assert env["ACCELERATE_MIN_DATA_PARALLEL"] == "2"
+    env = prepare_launch_env(ClusterConfig(elastic=False))
+    assert env["ACCELERATE_ELASTIC"] == "0"  # explicit off reaches workers
+
+
+def test_launch_validates_min_data_parallel(tmp_path):
+    from accelerate_tpu.commands.launch import launch_command, launch_command_parser
+
+    script = tmp_path / "noop.py"
+    script.write_text("print('ok')\n")
+    parser = launch_command_parser()
+    args = parser.parse_args(["--cpu", "--min_data_parallel", "-1", str(script)])
+    with pytest.raises(ValueError, match="min_data_parallel"):
+        launch_command(args)
+
+
+def test_elastic_script_two_processes_kv_agreement():
+    """The 2-process launcher drill (test_utils/elastic_script.py): the
+    --elastic/--min_data_parallel env contract reaches every worker under the
+    real launcher, and the world-size agreement exchange rides the
+    coordination-service KV fallback — device collectives are unimplemented
+    for multiprocess CPU on this rig, which is exactly the environment the
+    fallback exists for."""
+    import subprocess
+    import sys
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items() if not k.startswith("ACCELERATE_")}
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "accelerate_tpu.commands.launch", "--cpu",
+            "--num_processes", "2", "--elastic", "--min_data_parallel", "1",
+            "-m", "accelerate_tpu.test_utils.elastic_script",
+        ],
+        capture_output=True, text=True, timeout=600, env=env, cwd=repo_root,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:] + proc.stdout[-2000:]
+    assert proc.stdout.count("ELASTIC_AGREEMENT_OK") == 2
+
+
+# -------------------------------------------------- data-shard reassignment
+def test_batch_sampler_shard_reassign_preserves_stream():
+    from accelerate_tpu.data_loader import BatchSamplerShard
+
+    class _Sampler:
+        batch_size = 8
+        drop_last = False
+
+        def __iter__(self):
+            return iter([list(range(i * 8, (i + 1) * 8)) for i in range(6)])
+
+        def __len__(self):
+            return 6
+
+    shard = BatchSamplerShard(_Sampler(), num_processes=2, process_index=1)
+    before = list(shard)
+    shard.reassign(num_processes=1, process_index=0)
+    after = list(shard)
+    # One process now sees every batch, in the same underlying order.
+    assert len(after) == 6 and after[0] == list(range(8))
+    assert all(b in after for b in before)
+    with pytest.raises(ValueError, match="divisible"):
+        BatchSamplerShard(_Sampler(), split_batches=True).reassign(3, 0)
+
+
+def test_iterable_dataset_shard_reassign_guards_split_batches():
+    """split_batches floors per_process = batch_size // num_processes: a
+    non-dividing reassign must refuse (like the map-style shard) instead of
+    silently dropping the remainder of every buffer."""
+    from accelerate_tpu.data_loader import IterableDatasetShard
+
+    shard = IterableDatasetShard(
+        list(range(24)), batch_size=6, num_processes=2, process_index=0,
+        split_batches=True,
+    )
+    with pytest.raises(ValueError, match="divisible"):
+        shard.reassign(4, 0)
+    shard.reassign(3, 1)  # 6 % 3 == 0: every item still covered
+    assert shard.num_processes == 3 and shard.process_index == 1
+
+
+def test_prepared_loader_reassign_shards_keeps_sampler_state():
+    acc, pmodel, popt = _build()
+    loader = acc.prepare_data_loader([{"x": np.ones((8,), np.float32)}] * 4)
+    sd_before = loader.state_dict() if hasattr(loader, "state_dict") else None
+    loader.reassign_shards(num_processes=1, process_index=0)
+    if sd_before is not None:
+        assert loader.state_dict() == sd_before  # sampler-RNG contract intact

@@ -106,12 +106,15 @@ def test_complete_cv_example_step_checkpointing(tmp_path):
         # gives the producer no device time to hide uploads in, so a shallower
         # depth re-arms the example's h2d_blocking==0 assert as a load flake.
         ("by_feature/dispatch_amortized_training.py", ["--window", 4]),
+        ("by_feature/elastic_training.py", []),
     ],
 )
 def test_by_feature_examples(script, args, tmp_path):
     extra = []
     if "checkpointing" in script:
         extra = ["--output_dir", str(tmp_path / "ckpt")]
+    elif "elastic" in script:
+        extra = ["--project_dir", str(tmp_path / "elastic")]
     elif "tracking" in script:
         extra = ["--project_dir", str(tmp_path / "proj")]
     elif "profiler" in script:
