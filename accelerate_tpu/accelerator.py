@@ -1182,7 +1182,9 @@ class Accelerator:
 
         from .utils.environment import safe_donate_argnums
 
-        @partial(jax.jit, donate_argnums=safe_donate_argnums((0, 1, 2, 3)))
+        donate = safe_donate_argnums((0, 1, 2, 3))
+
+        @partial(jax.jit, donate_argnums=donate)
         def _step(params, opt_state, accum_grads, count, batch, rng, clip_norm):
             return step_body(params, opt_state, accum_grads, count, batch, rng, clip_norm)
 
@@ -1226,6 +1228,12 @@ class Accelerator:
             return _step.lower(*_step_args(batch, handle.rng, clip_norm))
 
         step.lower = lower
+        step._audit_meta = self._builder_audit_meta(
+            "build_train_step", handle, optimizer, donate, (0, 1, 2, 3),
+            lambda batch, clip_norm=0.0: jax.make_jaxpr(step_body)(
+                *_step_args(batch, handle.rng, clip_norm)
+            ),
+        )
         return step
 
     # --------------------------------------------------------- fused windows
@@ -1275,7 +1283,9 @@ class Accelerator:
 
         from .utils.environment import safe_donate_argnums
 
-        @partial(jax.jit, donate_argnums=safe_donate_argnums((0, 1, 2, 3)))
+        donate = safe_donate_argnums((0, 1, 2, 3))
+
+        @partial(jax.jit, donate_argnums=donate)
         def _window(params, opt_state, accum_grads, count, batches, counters,
                     base_rng, clip_norm):
             def body(carry, xs):
@@ -1320,6 +1330,22 @@ class Accelerator:
                             + hint
                         )
 
+        def _window_args(batch, clip_norm: float = 0.0):
+            """The exact argument tuple the compiled window consumes — shared
+            by step_window, lower(), and the audit jaxpr thunk so the audited
+            program can never diverge from the program that actually runs.
+            Counters derive from the CURRENT step_counter (callers advance it
+            after assembling args)."""
+            counters = jnp.arange(
+                handle.step_counter + 1, handle.step_counter + window + 1,
+                dtype=jnp.int32,
+            )
+            return (
+                handle.params, optimizer.opt_state, optimizer._accum_grads,
+                count_box[0], self._place_window_batch(batch), counters,
+                handle.rng, jnp.float32(clip_norm),
+            )
+
         def step_window(batch, clip_norm: float = 0.0):
             check_stale_accum()
             if self.train_window != window:
@@ -1330,16 +1356,9 @@ class Accelerator:
                     "to pick up the new value."
                 )
             _check_leading_axis(batch)
-            counters = jnp.arange(
-                handle.step_counter + 1, handle.step_counter + window + 1, dtype=jnp.int32
-            )
+            args = _window_args(batch, clip_norm)
             handle.step_counter += window
             telemetry = self.telemetry
-            args = (
-                handle.params, optimizer.opt_state, optimizer._accum_grads,
-                count_box[0], self._place_window_batch(batch), counters,
-                handle.rng, jnp.float32(clip_norm),
-            )
             if not telemetry.enabled:
                 (handle.params, optimizer.opt_state, optimizer._accum_grads,
                  count_box[0], losses) = _window(*args)
@@ -1354,8 +1373,75 @@ class Accelerator:
             )
             return losses
 
+        def lower(batch, clip_norm: float = 0.0):
+            """Lower (without running) the fused window for HLO inspection /
+            auditing — the window-builder analog of build_train_step's lower."""
+            _check_leading_axis(batch)
+            return _window.lower(*_window_args(batch, clip_norm))
+
         step_window.window = window
+        step_window.lower = lower
+        step_window._audit_meta = self._builder_audit_meta(
+            "build_train_window", handle, optimizer, donate, (0, 1, 2, 3),
+            lambda batch, clip_norm=0.0: jax.make_jaxpr(_window)(
+                *_window_args(batch, clip_norm)
+            ),
+        )
         return step_window
+
+    # ------------------------------------------------------------- audit
+    def _builder_audit_meta(self, builder: str, handle, optimizer,
+                            effective_donate: tuple, intended_donate: tuple,
+                            jaxpr_thunk):
+        """Audit metadata the fused builders attach to their returned step fn:
+        the donation contract (what was intended vs what safe_donate_argnums
+        left after platform gating, plus how many flat buffers the donated
+        pytrees flatten to — the count that catches PARTIAL donation
+        regressions), the mesh for collective attribution, the compute dtype
+        for upcast detection, and a jaxpr thunk for the pre-partitioning walk."""
+        try:
+            compute_dtype = np.dtype(handle.compute_dtype).name
+        except Exception:
+            compute_dtype = None
+        # Donated argnums (0,1,2,3) = params, opt_state, accum buffer, count.
+        donated_leaves = (
+            len(jax.tree_util.tree_leaves(handle.params))
+            + len(jax.tree_util.tree_leaves(optimizer.opt_state))
+            + len(jax.tree_util.tree_leaves(optimizer._accum_grads))
+            + 1  # the device-resident micro-step count scalar
+        )
+        return {
+            "builder": builder,
+            "mesh": self.mesh,
+            "compute_dtype": compute_dtype,
+            "expected_donations": tuple(intended_donate),
+            "expected_donated_leaves": donated_leaves,
+            "donation_dropped_by_policy": (
+                bool(intended_donate) and not effective_donate
+            ),
+            "jaxpr_thunk": jaxpr_thunk,
+        }
+
+    def audit(self, built, batch, clip_norm: float = 0.0,
+              intermediate_threshold_bytes: int = 64 * 1024 * 1024):
+        """Statically audit a built artifact (``build_train_step`` /
+        ``build_train_window`` output, or any jitted fn exposing ``.lower``)
+        against the framework's program-level invariants: collective inventory
+        per mesh axis (dp-axis all-gathers flagged), donation effectiveness
+        via input–output aliasing, host callbacks, dtype upcasts, and
+        oversized per-device intermediates. Returns
+        :class:`~.analysis.AuditReport`; see docs/analysis.md for the schema.
+
+        ``batch`` must be shaped as the artifact expects (window-stacked for a
+        window program). Auditing lowers and compiles but never executes — no
+        training state is touched."""
+        from .analysis import audit_built
+
+        return audit_built(
+            built, batch, clip_norm,
+            mesh=self.mesh,
+            intermediate_threshold_bytes=intermediate_threshold_bytes,
+        )
 
     def _place_window_batch(self, batch):
         """Host leaves of a K-stacked window → global mesh arrays (window axis
@@ -1414,7 +1500,9 @@ class Accelerator:
     def check_trigger(self) -> bool:
         local = self.flag_tensor if self.flag_tensor is not None else np.zeros((), dtype=np.int32)
         total = ops.reduce(local, reduction="sum")
-        if float(np.asarray(total)) >= 1:
+        from .utils.transfer import host_fetch
+
+        if float(host_fetch(total)) >= 1:
             self.flag_tensor = None
             return True
         return False
@@ -1434,7 +1522,9 @@ class Accelerator:
             params = model.params
         else:
             params = getattr(model, "params", model)
-        return jax.tree_util.tree_map(lambda p: np.asarray(jax.device_get(p)), params)
+        from .utils.transfer import host_fetch
+
+        return jax.tree_util.tree_map(host_fetch, params)
 
     def free_memory(self, *objects):
         """Release prepared references & buffers (reference :3570-3608)."""
@@ -1785,7 +1875,9 @@ def _has_object_leaves(data) -> bool:
     if isinstance(data, dict):
         return any(_has_object_leaves(v) for v in data.values())
     if ops.is_tensor_like(data):
-        dtype = np.asarray(data).dtype if not hasattr(data, "dtype") else data.dtype
+        from .utils.transfer import host_view
+
+        dtype = host_view(data).dtype if not hasattr(data, "dtype") else data.dtype
         return dtype == object or np.issubdtype(dtype, np.str_) or np.issubdtype(dtype, np.bytes_)
     return not isinstance(data, (int, float, complex, bool, np.number))
 

@@ -1,0 +1,47 @@
+"""Static analysis of the framework's own invariants — two layers.
+
+The paper's thesis is that the native-performance layer *is* the XLA program:
+regressions live in lowered programs (a stray dp-axis all-gather, lost buffer
+donation, a hidden host callback) and in Python that silently violates the
+disciplines the runtime drills enforce only at specific test sites. This
+subsystem makes both statically checkable:
+
+- **Layer 1 — program auditor** (:mod:`.audit`): given any built artifact
+  (``build_train_step``, ``build_train_window``, a jitted serving program),
+  walk its jaxpr, lowered StableHLO, and compiled HLO to produce a structured
+  :class:`~.audit.AuditReport` — collective inventory attributed to mesh
+  axes, donation effectiveness via input–output aliasing, host round-trip
+  hazards, dtype-upcast sites, and oversized per-device intermediates.
+  Surfaced as ``Accelerator.audit(...)``, ``accelerate-tpu audit``, and
+  ``detail.audit`` in every ``bench.py`` JSON line.
+- **Layer 2 — invariant linter** (:mod:`.lint`): an AST pass over
+  ``accelerate_tpu/`` encoding the repo's rules as data-driven checks
+  (counted transfers, ``jax_compat`` shims, ``safe_donate_argnums``, no host
+  impurity inside traced bodies), with per-line suppressions and a baseline
+  file for grandfathered findings. Surfaced as ``accelerate-tpu lint`` and
+  gated in tier-1 by ``tests/test_analysis.py``.
+"""
+
+from .audit import AuditReport, audit_built, audit_lowered
+from .lint import (
+    DEFAULT_BASELINE_NAME,
+    LintFinding,
+    Rule,
+    RULES,
+    lint_paths,
+    load_baseline,
+    write_baseline,
+)
+
+__all__ = [
+    "AuditReport",
+    "audit_built",
+    "audit_lowered",
+    "DEFAULT_BASELINE_NAME",
+    "LintFinding",
+    "Rule",
+    "RULES",
+    "lint_paths",
+    "load_baseline",
+    "write_baseline",
+]

@@ -1,0 +1,513 @@
+"""Invariant linter — the repo's hard-won disciplines as data-driven AST checks.
+
+Every rule here exists because a runtime drill somewhere (transfer-counting
+tests, the shard_map compat shim, the CPU-cache donation segfault, bit-exact
+resume) proved the invariant the hard way. The linter makes the discipline
+*static*: a future PR that reintroduces an uncounted host sync or un-shims a
+shard_map import fails ``accelerate-tpu lint`` (gated in tier-1 by
+tests/test_analysis.py) instead of waiting for the one drill that happens to
+exercise the path.
+
+Rules (see :data:`RULES`; ``accelerate-tpu lint --list-rules`` prints this
+table):
+
+- ``uncounted-device-get`` — ``jax.device_get(...)`` outside
+  ``utils/transfer.py``: a device→host fetch the transfer counters never see.
+  Route through ``transfer.host_fetch`` / ``transfer.host_view``.
+- ``uncounted-item`` — ``.item()`` on an array: an implicit blocking fetch.
+- ``uncounted-float-loss`` — ``float(loss)``-style scalarization of a loss
+  value: blocks dispatch on the step's result, the exact stall the retained
+  loss discipline exists to avoid.
+- ``uncounted-asarray`` — bare single-argument ``np.asarray(x)`` /
+  ``np.array(x)`` in the hot-path modules (serving, eager collectives,
+  telemetry, health, optimizer/scheduler, data loading, the accelerator):
+  on a device array this is an uncounted — possibly blocking — readback.
+  ``np.asarray(x, dtype)`` (host canonicalization) is deliberately exempt.
+- ``raw-shard-map`` — importing ``jax.shard_map`` / ``jax.experimental.
+  shard_map`` outside ``utils/jax_compat.py``: call sites must stay
+  version-agnostic through the shim (PR 4's pipeline breakage).
+- ``raw-donation`` — a ``donate_argnums=`` whose value is not
+  ``safe_donate_argnums(...)``: donation must stay gated on the platforms
+  where it is actually safe (the CPU-with-compile-cache heap corruption).
+- ``traced-host-impurity`` — ``time.time()`` / ``random.*`` / ``np.random.*``
+  inside a jit-traced function body: traces once, bakes the value in, and
+  silently stops varying.
+- ``uncounted-block-until-ready`` — ``block_until_ready`` in library code:
+  a hard dispatch stall; hot paths must retain values and drain via counted
+  fetches.
+
+Suppression: append ``# accelerate-lint: disable=<rule>[,<rule>...]`` to the
+flagged line. Grandfathered findings live in a baseline file (JSON, keyed on
+``(path, rule, stripped source line)`` so line-number drift doesn't churn
+it); ``accelerate-tpu lint --write-baseline`` regenerates it, and the tier-1
+gate fails on any finding that is neither suppressed nor baselined.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from dataclasses import dataclass, field
+
+DEFAULT_BASELINE_NAME = ".accelerate-lint-baseline.json"
+
+# Files the transfer rules treat as the counted-helper home (exempt).
+_TRANSFER_HOME = ("utils/transfer.py",)
+_SHIM_HOME = ("utils/jax_compat.py",)
+_DONATE_HOME = ("utils/environment.py",)
+
+# Hot-path modules where a bare np.asarray is likely a device readback.
+_ASARRAY_SCOPE = (
+    "serving.py",
+    "utils/operations.py",
+    "telemetry/",
+    "health/",
+    "optimizer.py",
+    "scheduler.py",
+    "data_loader.py",
+    "accelerator.py",
+    "train_steps.py",
+)
+
+# Test scaffolding ships inside the package but is not framework hot path.
+_EXCLUDED = ("test_utils/", "__pycache__/")
+
+
+@dataclass(frozen=True)
+class Rule:
+    name: str
+    summary: str
+    remedy: str
+    include: tuple = ()   # path suffix/prefix scopes; () = whole package
+    exclude: tuple = ()   # paths exempt from this rule
+
+
+RULES = (
+    Rule(
+        name="uncounted-device-get",
+        summary="jax.device_get outside the counted transfer helpers",
+        remedy="route through utils.transfer.host_fetch / host_view",
+        exclude=_TRANSFER_HOME,
+    ),
+    Rule(
+        name="uncounted-item",
+        summary=".item() — an implicit blocking device→host fetch",
+        remedy="retain the array and drain via utils.transfer.host_fetch",
+        exclude=_TRANSFER_HOME,
+    ),
+    Rule(
+        name="uncounted-float-loss",
+        summary="float(loss) — blocks dispatch on the step result",
+        remedy="retain the loss; drain via the timeline / host_fetch when ready",
+        exclude=_TRANSFER_HOME,
+    ),
+    Rule(
+        name="uncounted-asarray",
+        summary="bare np.asarray/np.array in a hot-path module "
+                "(device readback the transfer counters never see)",
+        remedy="utils.transfer.host_fetch (device) or host_view (either); "
+               "np.asarray(x, dtype) stays exempt for host canonicalization",
+        include=_ASARRAY_SCOPE,
+        exclude=_TRANSFER_HOME,
+    ),
+    Rule(
+        name="raw-shard-map",
+        summary="direct jax.shard_map / jax.experimental.shard_map use",
+        remedy="import shard_map from utils.jax_compat (version shim)",
+        exclude=_SHIM_HOME,
+    ),
+    Rule(
+        name="raw-donation",
+        summary="donate_argnums not wrapped in safe_donate_argnums",
+        remedy="donate_argnums=safe_donate_argnums((...)) — donation is "
+               "platform-gated (CPU+compile-cache heap corruption)",
+        exclude=_DONATE_HOME,
+    ),
+    Rule(
+        name="traced-host-impurity",
+        summary="time.time()/random.* inside a jit-traced function body",
+        remedy="pass times/randomness in as arguments (fold_in for RNG)",
+    ),
+    Rule(
+        name="uncounted-block-until-ready",
+        summary="block_until_ready — a hard dispatch stall",
+        remedy="retain the value; drain via counted host_fetch once is_ready",
+        exclude=_TRANSFER_HOME,
+    ),
+)
+
+_RULES_BY_NAME = {r.name: r for r in RULES}
+
+
+@dataclass
+class LintFinding:
+    path: str        # repo-relative, forward slashes
+    rule: str
+    line: int
+    col: int
+    code: str        # stripped source line (the baseline key)
+    message: str
+    suppressed: bool = False
+    baselined: bool = False
+
+    def key(self) -> tuple:
+        return (self.path, self.rule, self.code)
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] {self.message}"
+
+
+def _path_matches(entry: str, relpath: str) -> bool:
+    """Scope entries ending in "/" are directory prefixes; the rest are exact
+    package-relative paths (so "serving.py" does not match "foo_serving.py")."""
+    if entry.endswith("/"):
+        return relpath.startswith(entry)
+    return relpath == entry
+
+
+def _rule_applies(rule: Rule, relpath: str) -> bool:
+    if any(_path_matches(e, relpath) for e in rule.exclude):
+        return False
+    if rule.include:
+        return any(_path_matches(i, relpath) for i in rule.include)
+    return True
+
+
+# ------------------------------------------------------------------ AST visit
+def _dotted(node) -> str:
+    """'jax.experimental.shard_map' for nested Attribute/Name chains."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _terminal_name(node) -> str:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def _is_jit_decorator(dec) -> bool:
+    """@jax.jit / @jit / @partial(jax.jit, ...) / @functools.partial(jit, ...)."""
+    d = _dotted(dec)
+    if d in ("jax.jit", "jit"):
+        return True
+    if isinstance(dec, ast.Call):
+        f = _dotted(dec.func)
+        if f in ("jax.jit", "jit"):
+            return True
+        if f.endswith("partial") and dec.args:
+            return _dotted(dec.args[0]) in ("jax.jit", "jit")
+    return False
+
+
+_TRACING_WRAPPERS = {
+    "jax.jit", "jit",
+    "jax.lax.scan", "lax.scan", "scan",
+    "jax.lax.cond", "lax.cond",
+    "jax.lax.while_loop", "lax.while_loop",
+    "jax.lax.fori_loop", "lax.fori_loop",
+    "jax.grad", "jax.value_and_grad", "value_and_grad",
+    "jax.vmap", "vmap", "jax.pmap",
+    "shard_map", "jax.shard_map",
+    "jax.checkpoint", "jax.remat", "checkpoint", "remat",
+    "jax.pure_callback",  # the fn arg runs on host, but jit-wrapping it is a smell
+}
+
+_IMPURE_CALLS = {
+    "time.time", "time.perf_counter", "time.monotonic", "time.process_time",
+    "random.random", "random.randint", "random.uniform", "random.choice",
+    "random.shuffle", "random.gauss", "random.randrange",
+    "np.random.random", "np.random.rand", "np.random.randn",
+    "np.random.randint", "np.random.uniform", "np.random.choice",
+    "numpy.random.random", "numpy.random.rand", "numpy.random.randn",
+    "numpy.random.randint", "numpy.random.uniform", "numpy.random.choice",
+}
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, relpath: str, lines: list):
+        self.relpath = relpath
+        self.lines = lines
+        self.findings: list[LintFinding] = []
+        # Names of functions referenced as arguments to tracing wrappers
+        # anywhere in the module — their bodies count as traced.
+        self.traced_names: set[str] = set()
+        # Names assigned from safe_donate_argnums(...) — passing one as
+        # donate_argnums= is the gated spelling, not a raw donation.
+        self.safe_donation_names: set[str] = set()
+        self._func_stack: list = []
+        self._traced_depth = 0
+
+    # ---------------------------------------------------------------- helpers
+    def _emit(self, rule_name: str, node, message: str):
+        rule = _RULES_BY_NAME[rule_name]
+        if not _rule_applies(rule, self.relpath):
+            return
+        line = getattr(node, "lineno", 1)
+        code = self.lines[line - 1].strip() if 0 < line <= len(self.lines) else ""
+        self.findings.append(LintFinding(
+            path=self.relpath, rule=rule_name, line=line,
+            col=getattr(node, "col_offset", 0) + 1, code=code,
+            message=f"{message} — {rule.remedy}",
+        ))
+
+    # ---------------------------------------------------------------- imports
+    def visit_Import(self, node: ast.Import):
+        for alias in node.names:
+            if alias.name.startswith("jax.experimental.shard_map"):
+                self._emit("raw-shard-map", node,
+                           f"import {alias.name} bypasses the compat shim")
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom):
+        mod = node.module or ""
+        if mod.startswith("jax.experimental.shard_map") or (
+            mod == "jax" and any(a.name == "shard_map" for a in node.names)
+        ):
+            self._emit("raw-shard-map", node,
+                       f"from {mod} import shard_map bypasses the compat shim")
+        self.generic_visit(node)
+
+    # ------------------------------------------------------------ assignments
+    def visit_Assign(self, node: ast.Assign):
+        if isinstance(node.value, ast.Call) and _terminal_name(
+            node.value.func
+        ) == "safe_donate_argnums":
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    self.safe_donation_names.add(tgt.id)
+        self.generic_visit(node)
+
+    # -------------------------------------------------------------- functions
+    def _function(self, node):
+        traced = any(_is_jit_decorator(d) for d in node.decorator_list) or (
+            node.name in self.traced_names
+        )
+        self._func_stack.append(node.name)
+        if traced:
+            self._traced_depth += 1
+        self.generic_visit(node)
+        if traced:
+            self._traced_depth -= 1
+        self._func_stack.pop()
+
+    visit_FunctionDef = _function
+    visit_AsyncFunctionDef = _function
+
+    # ------------------------------------------------------------------ calls
+    def visit_Call(self, node: ast.Call):
+        callee = _dotted(node.func)
+        term = _terminal_name(node.func)
+
+        # Collect function names handed to tracing wrappers (pre-pass fills
+        # traced_names; see lint_source's two-pass walk).
+        if callee in _TRACING_WRAPPERS or term in ("jit", "scan", "cond",
+                                                   "while_loop", "shard_map",
+                                                   "value_and_grad", "remat",
+                                                   "checkpoint"):
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Name):
+                    self.traced_names.add(arg.id)
+
+        if term == "device_get":
+            self._emit("uncounted-device-get", node,
+                       f"{callee or 'device_get'}(...) is an uncounted fetch")
+
+        if isinstance(node.func, ast.Attribute) and node.func.attr == "item" \
+                and not node.args and not node.keywords:
+            self._emit("uncounted-item", node, ".item() blocks on the result")
+
+        if isinstance(node.func, ast.Name) and node.func.id == "float" and node.args:
+            tn = _terminal_name(node.args[0])
+            if "loss" in tn.lower():
+                self._emit("uncounted-float-loss", node,
+                           f"float({tn}) scalarizes the loss eagerly")
+
+        if callee in ("np.asarray", "numpy.asarray", "np.array", "numpy.array"):
+            has_dtype = len(node.args) > 1 or any(
+                kw.arg == "dtype" for kw in node.keywords
+            )
+            if not has_dtype and node.args:
+                self._emit("uncounted-asarray", node,
+                           f"bare {callee}(...) may be a device readback")
+
+        if callee in ("jax.shard_map", "jax.experimental.shard_map.shard_map"):
+            self._emit("raw-shard-map", node,
+                       f"{callee} call bypasses the compat shim")
+
+        for kw in node.keywords:
+            if kw.arg in ("donate_argnums", "donate_argnames"):
+                ok = (
+                    isinstance(kw.value, ast.Call)
+                    and _terminal_name(kw.value.func) == "safe_donate_argnums"
+                ) or (
+                    isinstance(kw.value, ast.Name)
+                    and kw.value.id in self.safe_donation_names
+                )
+                if not ok:
+                    self._emit("raw-donation", node,
+                               f"{kw.arg}= not gated by safe_donate_argnums")
+
+        if self._traced_depth > 0 and callee in _IMPURE_CALLS:
+            self._emit("traced-host-impurity", node,
+                       f"{callee}() inside a traced body bakes in one value")
+
+        if term == "block_until_ready":
+            self._emit("uncounted-block-until-ready", node,
+                       "block_until_ready stalls dispatch")
+
+        self.generic_visit(node)
+
+    # ---------------------------------------------------- attribute (non-call)
+    def visit_Attribute(self, node: ast.Attribute):
+        if _dotted(node) == "jax.experimental.shard_map":
+            self._emit("raw-shard-map", node,
+                       "jax.experimental.shard_map reference bypasses the shim")
+        self.generic_visit(node)
+
+
+# --------------------------------------------------------------- suppressions
+def _suppressed_rules(line_text: str) -> set:
+    marker = "accelerate-lint:"
+    idx = line_text.find(marker)
+    if idx < 0:
+        return set()
+    tail = line_text[idx + len(marker):]
+    if "disable=" not in tail:
+        return set()
+    spec = tail.split("disable=", 1)[1].split()[0]
+    return {r.strip() for r in spec.split(",") if r.strip()}
+
+
+def _file_suppressions(lines: list) -> set:
+    out = set()
+    for line in lines[:10]:
+        marker = "accelerate-lint:"
+        idx = line.find(marker)
+        if idx < 0:
+            continue
+        tail = line[idx + len(marker):]
+        if "disable-file=" in tail:
+            spec = tail.split("disable-file=", 1)[1].split()[0]
+            out |= {r.strip() for r in spec.split(",") if r.strip()}
+    return out
+
+
+# ------------------------------------------------------------------- baseline
+def load_baseline(path: str) -> set:
+    """Baseline keys {(path, rule, code)}; missing file = empty baseline."""
+    if not path or not os.path.exists(path):
+        return set()
+    with open(path) as f:
+        data = json.load(f)
+    return {(e["path"], e["rule"], e["code"]) for e in data.get("findings", [])}
+
+
+def write_baseline(path: str, findings: list):
+    """Persist current unsuppressed findings as the grandfathered set."""
+    entries = sorted(
+        {f.key() for f in findings if not f.suppressed},
+    )
+    with open(path, "w") as f:
+        json.dump(
+            {
+                "comment": (
+                    "Grandfathered accelerate-lint findings. New code must be "
+                    "clean; remove entries as files are brought up to the "
+                    "counted-transfer / shim / donation disciplines."
+                ),
+                "findings": [
+                    {"path": p, "rule": r, "code": c} for (p, r, c) in entries
+                ],
+            },
+            f,
+            indent=1,
+        )
+        f.write("\n")
+
+
+# ------------------------------------------------------------------ front end
+def lint_source(source: str, relpath: str) -> list:
+    """Lint one file's source; returns findings with suppressions applied."""
+    lines = source.splitlines()
+    try:
+        tree = ast.parse(source, filename=relpath)
+    except SyntaxError as exc:
+        return [LintFinding(
+            path=relpath, rule="parse-error", line=exc.lineno or 1, col=1,
+            code="", message=f"could not parse: {exc.msg}",
+        )]
+    # Two passes: the first collects names handed to tracing wrappers
+    # (jit(f), lax.scan(body, ...)); the second attributes traced-body
+    # findings even when the def precedes the wrapping call.
+    pre = _Visitor(relpath, lines)
+    pre.visit(tree)
+    visitor = _Visitor(relpath, lines)
+    visitor.traced_names = pre.traced_names
+    visitor.safe_donation_names = pre.safe_donation_names
+    visitor.visit(tree)
+
+    file_off = _file_suppressions(lines)
+    for f in visitor.findings:
+        if f.rule in file_off:
+            f.suppressed = True
+            continue
+        line_text = lines[f.line - 1] if 0 < f.line <= len(lines) else ""
+        if f.rule in _suppressed_rules(line_text):
+            f.suppressed = True
+    return visitor.findings
+
+
+def _iter_py_files(paths: list):
+    for p in paths:
+        p = os.path.abspath(p)
+        if os.path.isfile(p):
+            yield p
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
+def lint_paths(paths: list, baseline: set | None = None) -> list:
+    """Lint files/directories; returns ALL findings (callers filter on
+    ``suppressed`` / ``baselined``). Paths inside the ``accelerate_tpu``
+    package are keyed relative to the package root so scope rules and
+    baselines are stable no matter where the linter is invoked from."""
+    baseline = baseline or set()
+    findings: list[LintFinding] = []
+    for abspath in _iter_py_files(paths):
+        rel = _package_relpath(abspath)
+        if any(rel.startswith(e) for e in _EXCLUDED):
+            continue
+        try:
+            with open(abspath, encoding="utf-8") as f:
+                source = f.read()
+        except (OSError, UnicodeDecodeError):
+            continue
+        for finding in lint_source(source, rel):
+            if finding.key() in baseline:
+                finding.baselined = True
+            findings.append(finding)
+    return findings
+
+
+def _package_relpath(abspath: str) -> str:
+    """Path relative to the accelerate_tpu package root (or basename chain
+    when the file lives elsewhere), with forward slashes."""
+    norm = abspath.replace(os.sep, "/")
+    marker = "/accelerate_tpu/"
+    idx = norm.rfind(marker)
+    if idx >= 0:
+        return norm[idx + len(marker):]
+    return os.path.basename(norm)

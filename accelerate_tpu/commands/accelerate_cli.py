@@ -7,6 +7,7 @@ from __future__ import annotations
 
 import argparse
 
+from .analysis import audit_command_parser, lint_command_parser
 from .config import config_command_parser
 from .env import env_command_parser
 from .estimate import estimate_command_parser
@@ -29,6 +30,8 @@ def main() -> None:
     merge_command_parser(subparsers=subparsers)
     test_command_parser(subparsers=subparsers)
     tpu_command_parser(subparsers=subparsers)
+    lint_command_parser(subparsers=subparsers)
+    audit_command_parser(subparsers=subparsers)
 
     args = parser.parse_args()
     if not hasattr(args, "func"):

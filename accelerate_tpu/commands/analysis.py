@@ -1,0 +1,212 @@
+"""`accelerate-tpu lint` / `accelerate-tpu audit` — the static-analysis CLI.
+
+``lint`` runs the invariant linter (analysis/lint.py) over source paths and
+exits non-zero on any finding that is neither inline-suppressed nor
+baselined. ``audit`` builds the tiny training config on the local backend,
+lowers the fused train step (or a K-step window), and prints the program
+audit report (analysis/audit.py) as JSON — exit status reflects the
+zero-tolerance invariants (dp-axis all-gathers, host callbacks, donation
+misses). Both are pre-chip gates: they inspect programs and source, never
+run a training step.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+# --------------------------------------------------------------------- lint
+def lint_command_parser(subparsers=None) -> argparse.ArgumentParser:
+    description = (
+        "Statically lint source for violations of the framework's "
+        "zero-sync / shim / donation disciplines"
+    )
+    if subparsers is not None:
+        parser = subparsers.add_parser("lint", description=description)
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu lint", description=description)
+    parser.add_argument(
+        "paths", nargs="*", default=None,
+        help="Files or directories to lint (default: the installed accelerate_tpu package)",
+    )
+    parser.add_argument(
+        "--baseline", default=None,
+        help="Baseline JSON of grandfathered findings (default: "
+             ".accelerate-lint-baseline.json next to the scanned package or in CWD)",
+    )
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="Ignore any baseline file — report every finding",
+    )
+    parser.add_argument(
+        "--write-baseline", action="store_true",
+        help="Write the current findings to the baseline file and exit 0",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="Print the rule table and exit"
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="Machine-readable findings on stdout"
+    )
+    if subparsers is not None:
+        parser.set_defaults(func=lint_command)
+    return parser
+
+
+def _default_paths() -> list:
+    import accelerate_tpu
+
+    return [os.path.dirname(os.path.abspath(accelerate_tpu.__file__))]
+
+
+def _default_baseline(paths: list) -> str:
+    from ..analysis.lint import DEFAULT_BASELINE_NAME
+
+    candidates = [os.path.join(os.getcwd(), DEFAULT_BASELINE_NAME)]
+    for p in paths:
+        p = os.path.abspath(p)
+        root = p if os.path.isdir(p) else os.path.dirname(p)
+        candidates.append(os.path.join(os.path.dirname(root), DEFAULT_BASELINE_NAME))
+        candidates.append(os.path.join(root, DEFAULT_BASELINE_NAME))
+    for c in candidates:
+        if os.path.exists(c):
+            return c
+    return candidates[0]
+
+
+def lint_command(args) -> None:
+    from ..analysis.lint import (
+        RULES, lint_paths, load_baseline, write_baseline,
+    )
+
+    if args.list_rules:
+        for rule in RULES:
+            scope = ", ".join(rule.include) if rule.include else "whole package"
+            print(f"{rule.name}\n  what:  {rule.summary}\n  fix:   {rule.remedy}"
+                  f"\n  scope: {scope}\n")
+        return
+
+    paths = args.paths or _default_paths()
+    baseline_path = args.baseline or _default_baseline(paths)
+    baseline = set() if (args.no_baseline or args.write_baseline) else load_baseline(
+        baseline_path
+    )
+    findings = lint_paths(paths, baseline=baseline)
+    live = [f for f in findings if not f.suppressed and not f.baselined]
+    suppressed = sum(1 for f in findings if f.suppressed)
+    baselined = sum(1 for f in findings if f.baselined)
+
+    if args.write_baseline:
+        write_baseline(baseline_path, findings)
+        print(f"wrote {len({f.key() for f in findings if not f.suppressed})} "
+              f"grandfathered findings to {baseline_path}")
+        return
+
+    if args.json:
+        print(json.dumps({
+            "findings": [
+                {"path": f.path, "rule": f.rule, "line": f.line,
+                 "message": f.message}
+                for f in live
+            ],
+            "suppressed": suppressed,
+            "baselined": baselined,
+        }, indent=1))
+    else:
+        for f in live:
+            print(f.format())
+        print(
+            f"accelerate-lint: {len(live)} finding(s) "
+            f"({suppressed} suppressed, {baselined} baselined)"
+        )
+    if live:
+        raise SystemExit(1)
+
+
+# -------------------------------------------------------------------- audit
+def audit_command_parser(subparsers=None) -> argparse.ArgumentParser:
+    description = (
+        "Build the tiny train config, lower the fused step, and audit the "
+        "program: collectives per mesh axis, donation aliasing, host "
+        "callbacks, dtype upcasts"
+    )
+    if subparsers is not None:
+        parser = subparsers.add_parser("audit", description=description)
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu audit", description=description)
+    parser.add_argument(
+        "--window", type=int, default=1,
+        help="Audit a K-step fused train window instead of the per-step program",
+    )
+    parser.add_argument(
+        "--batch", type=int, default=8, help="Batch rows for the lowered program"
+    )
+    parser.add_argument(
+        "--seq", type=int, default=16, help="Sequence length for the lowered program"
+    )
+    parser.add_argument(
+        "--threshold-mb", type=float, default=64.0,
+        help="Large-intermediate report threshold (per-device MiB)",
+    )
+    parser.add_argument(
+        "--summary", action="store_true",
+        help="Print the compact summary (bench.py detail.audit form) instead "
+             "of the full report",
+    )
+    if subparsers is not None:
+        parser.set_defaults(func=audit_command)
+    return parser
+
+
+def audit_command(args) -> None:
+    if args.window < 1:
+        raise SystemExit("--window must be >= 1")
+    import numpy as np
+    import jax
+    import optax
+
+    from ..accelerator import Accelerator
+    from ..models import Llama, LlamaConfig
+
+    accelerator = Accelerator()
+    cfg = LlamaConfig.tiny()
+    model = Llama(cfg)
+    model.init_params(jax.random.key(0))
+    pmodel, popt = accelerator.prepare(model, optax.sgd(0.1))
+    ids = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (args.batch, args.seq)
+    ).astype(np.int32)
+    batch = {"input_ids": ids, "labels": ids}
+    if args.window > 1:
+        built = accelerator.build_train_window(pmodel, popt, window=args.window)
+        batch = {k: np.stack([v] * args.window) for k, v in batch.items()}
+    else:
+        built = accelerator.build_train_step(pmodel, popt)
+    report = accelerator.audit(
+        built, batch,
+        intermediate_threshold_bytes=int(args.threshold_mb * 1024 * 1024),
+    )
+    print(json.dumps(
+        report.summary_dict() if args.summary else report.to_dict(), indent=1
+    ))
+    if not report.clean:
+        raise SystemExit(1)
+
+
+def lint_main() -> None:
+    """Console-script entry (`accelerate-tpu-lint`, pyproject [project.scripts])."""
+    lint_command(lint_command_parser().parse_args())
+
+
+def audit_main() -> None:
+    """Console-script entry (`accelerate-tpu-audit`, pyproject [project.scripts])."""
+    audit_command(audit_command_parser().parse_args())
+
+
+if __name__ == "__main__":
+    # Two commands share this module; `python -m` can't pick one.
+    sys.exit("Run via `accelerate-tpu lint` / `accelerate-tpu audit` "
+             "(or the accelerate-tpu-lint / accelerate-tpu-audit scripts).")

@@ -38,6 +38,7 @@ from .parallel.sharding import make_global_batch
 from .state import AcceleratorState, GradientState, PartialState
 from .utils.dataclasses import RNGType
 from .utils.operations import broadcast, broadcast_object_list, recursively_apply
+from .utils.transfer import host_view
 from .utils.random import synchronize_rng_states
 
 logger = logging.getLogger(__name__)
@@ -543,7 +544,7 @@ class DataLoaderShard(DataLoaderStateMixin):
         """Pad a short final batch to ``target`` rows by wrapping its own rows."""
 
         def _one(x):
-            x = np.asarray(x)
+            x = host_view(x)
             if x.ndim == 0 or x.shape[0] >= target:
                 return x
             reps = math.ceil((target - x.shape[0]) / max(x.shape[0], 1))
@@ -828,7 +829,7 @@ class DataLoaderDispatcher(DataLoaderStateMixin):
             mesh = state.mesh
 
             def _slice(x):
-                x = np.asarray(x)
+                x = host_view(x)
                 if n == 1:
                     return x
                 per = x.shape[0] // n
@@ -927,7 +928,7 @@ class DeviceBatchPrefetcher:
                     import jax.numpy as jnp
 
                     return jnp.stack(xs, axis=0)
-                return np.stack([np.asarray(x) for x in xs], axis=0)
+                return np.stack([host_view(x) for x in xs], axis=0)
 
             from .parallel.sharding import make_global_window_batch
 

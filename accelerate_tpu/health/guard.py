@@ -269,7 +269,7 @@ class HealthGuard:
                 from ..utils import operations as ops
 
                 vec = np.asarray([(local_flags >> b) & 1 for b in range(_FLAG_BITS)], np.int32)
-                total = np.asarray(ops.reduce(vec, reduction="sum"))
+                total = host_fetch(ops.reduce(vec, reduction="sum"))
                 return int(sum(1 << b for b in range(_FLAG_BITS) if total[b] > 0))
             except Exception as exc:
                 logger.warning(

@@ -68,7 +68,7 @@ class NumericsSentinel:
 
 def finite_scalar(x) -> bool:
     """Host-side convenience: is this (device or host) scalar finite?"""
-    return bool(np.isfinite(np.asarray(jax.device_get(x), dtype=np.float64)))
+    return bool(np.isfinite(np.asarray(host_fetch(x), dtype=np.float64)))
 
 
 def _segment_all_finite(leaves) -> bool:

@@ -347,7 +347,9 @@ class AcceleratedOptimizer:
             for s in state:
                 hp = getattr(s, "hyperparams", None) or hp
         if hp and "learning_rate" in hp:
-            return float(np.asarray(hp["learning_rate"]))
+            from .utils.transfer import host_fetch
+
+            return float(host_fetch(hp["learning_rate"]))
         return None
 
     def set_learning_rate(self, lr: float):

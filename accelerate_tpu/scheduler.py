@@ -17,6 +17,8 @@ from __future__ import annotations
 
 import numpy as np
 
+from .utils.transfer import host_fetch
+
 
 class AcceleratedScheduler:
     def __init__(self, schedule, optimizers, step_with_optimizer: bool = True, split_batches: bool = False):
@@ -30,7 +32,7 @@ class AcceleratedScheduler:
         # step() below) so the flag has no effect.
         self.split_batches = split_batches
         self.step_count = 0
-        self._last_lr = float(np.asarray(schedule(0)))
+        self._last_lr = float(host_fetch(schedule(0)))
         from .state import GradientState
 
         self.gradient_state = GradientState()
@@ -58,7 +60,7 @@ class AcceleratedScheduler:
 
     def _advance(self, increment: int):
         self.step_count += increment
-        self._last_lr = float(np.asarray(self.schedule(self.step_count)))
+        self._last_lr = float(host_fetch(self.schedule(self.step_count)))
         for opt in self.optimizers:
             opt.set_learning_rate(self._last_lr)
 

@@ -27,6 +27,7 @@ from dataclasses import asdict, dataclass, field
 import numpy as np
 
 from ..logging import get_logger
+from ..utils.transfer import host_fetch
 
 logger = get_logger(__name__)
 
@@ -148,7 +149,7 @@ class StragglerMonitor:
 
                 vec = np.zeros((n,), np.float32)
                 vec[idx] = local
-                total = np.asarray(ops.reduce(vec, reduction="sum"))
+                total = host_fetch(ops.reduce(vec, reduction="sum"))
                 return [float(x) for x in total]
             except Exception as exc:
                 logger.warning(

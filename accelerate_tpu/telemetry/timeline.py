@@ -229,7 +229,7 @@ class StepTimeline:
                 break
             self._pending_loss.popleft()
             try:
-                self._last_loss = float(np.asarray(host_fetch(head)).reshape(-1)[-1])
+                self._last_loss = float(host_fetch(head).reshape(-1)[-1])
             except Exception:
                 self._last_loss = None
 
@@ -262,6 +262,13 @@ class StepTimeline:
             )
         self._drain_loss()
         now_stats = transfer.transfer_stats()
+        # A reset_transfer_stats() since this timeline baselined its deltas
+        # zeroed the global counters underneath the snapshot — comparing
+        # against the stale baseline would go negative. Re-anchor at the
+        # reset: deltas then cover counts since the reset, never below zero.
+        if now_stats.get("resets", 0) != self._transfer0.get("resets", 0):
+            self._transfer0 = {k: (0 if k != "resets" else now_stats["resets"])
+                               for k in now_stats}
         ledger = get_ledger()
         from ..utils.xla_flags import active_preset
 

@@ -12,6 +12,19 @@ from __future__ import annotations
 import jax
 
 
+def has_native_shard_map() -> bool:
+    """Whether this jax carries top-level ``jax.shard_map`` (partial-auto
+    manual mapping). When False, :func:`shard_map` falls back to FULL-MANUAL
+    ``jax.experimental.shard_map``: mesh axes the specs omit are treated as
+    replicated, so dp-replicated inputs are all-gathered at the region
+    boundary — the pp plan's zero-all-gather HLO property (and the program
+    auditor's dp-all-gather gate on shard_map programs) holds only on native
+    runtimes. tests/test_hlo_collectives.py keys its precise skip on this."""
+    import jax
+
+    return getattr(jax, "shard_map", None) is not None
+
+
 def axis_size(axis_name) -> int:
     """``jax.lax.axis_size`` (absent on 0.4.x): the static size of a mapped
     mesh axis. ``psum`` of the literal 1 constant-folds to the axis size on

@@ -35,6 +35,7 @@ import jax.numpy as jnp
 
 from .environment import parse_flag_from_env
 from .constants import ENV_DEBUG_MODE
+from .transfer import host_view
 
 
 def PartialState():
@@ -134,7 +135,7 @@ def send_to_device(data, device=None, non_blocking: bool = False, skip_keys=None
 
 def get_data_structure(data):
     """Shapes+dtypes pytree describing ``data`` (reference :188-210)."""
-    return recursively_apply(lambda t: jax.ShapeDtypeStruct(np.shape(t), np.asarray(t).dtype if not isinstance(t, jax.Array) else t.dtype), data)
+    return recursively_apply(lambda t: jax.ShapeDtypeStruct(np.shape(t), host_view(t).dtype if not isinstance(t, jax.Array) else t.dtype), data)
 
 
 def find_batch_size(data):
@@ -156,7 +157,7 @@ def ignorant_find_batch_size(data):
 
 def listify(data):
     """Arrays → nested Python lists (reference :277-292)."""
-    return recursively_apply(lambda t: np.asarray(t).tolist(), data)
+    return recursively_apply(lambda t: host_view(t).tolist(), data)
 
 
 def slice_tensors(data, tensor_slice, process_index=None, num_processes=None):
@@ -208,7 +209,7 @@ convert_outputs_to_fp32 = ConvertOutputsToFp32
 # -------------------------------------------------------------- debug sanitizer
 def _operation_signature(data) -> list:
     return [
-        (tuple(np.shape(l)), str(np.asarray(l).dtype) if not isinstance(l, jax.Array) else str(l.dtype))
+        (tuple(np.shape(l)), str(host_view(l).dtype) if not isinstance(l, jax.Array) else str(l.dtype))
         for l in jax.tree_util.tree_leaves(data)
         if is_tensor_like(l)
     ]
@@ -246,7 +247,7 @@ def _is_global_unaddressable(x) -> bool:
 def _host_allgather(t, tiled: bool):
     from jax.experimental import multihost_utils
 
-    return multihost_utils.process_allgather(np.asarray(t), tiled=tiled)
+    return multihost_utils.process_allgather(host_view(t), tiled=tiled)
 
 
 @verify_operation
@@ -308,7 +309,7 @@ def broadcast(tensor, from_process: int = 0):
         if _is_global_unaddressable(t):
             return t  # a global sharded array is already consistent on all hosts
         return multihost_utils.broadcast_one_to_all(
-            np.asarray(t), is_source=state.process_index == from_process
+            host_view(t), is_source=state.process_index == from_process
         )
 
     return recursively_apply(_bcast, tensor)
@@ -373,7 +374,7 @@ def pad_across_processes(tensor, dim: int = 0, pad_index: int = 0, pad_first: bo
     def _pad_one(t):
         if _is_global_unaddressable(t):
             return t  # global arrays are rectangular by construction
-        t = np.asarray(t)
+        t = host_view(t)
         if dim >= t.ndim:
             return t
         size = np.array(t.shape, dtype=np.int64)
@@ -404,7 +405,7 @@ def pad_input_tensors(tensor, batch_size: int, num_processes: int, dim: int = 0)
     to_pad = num_processes - remainder
 
     def _pad_one(t):
-        t = np.asarray(t)
+        t = host_view(t)
         if t.shape[0] != batch_size:
             return t
         pad_rows = np.repeat(t[-1:], to_pad, axis=0)

@@ -32,6 +32,11 @@ _stats = {
     "h2d_puts": 0,      # deliberate host→device batch uploads
     "h2d_blocking": 0,  # input waits: the train loop stalled on an upload
     "input_wait_s": 0.0,  # wall-clock the train loop spent in those stalls
+    # Bumped by reset_transfer_stats: consumers holding a delta baseline
+    # (StepTimeline._transfer0) compare generations and re-anchor at zero
+    # instead of producing negative deltas when someone resets the globals
+    # underneath them.
+    "resets": 0,
 }
 
 
@@ -64,6 +69,17 @@ def host_put(x, placer):
     return placer(x)
 
 
+def host_view(x):
+    """``np.asarray`` with the counting discipline: a device array routes
+    through :func:`host_fetch` (counted, blocking-aware); host data passes
+    through uncounted. The lint-clean spelling for code paths that legitimately
+    handle both (``utils/operations.py``'s eager collectives, batch
+    canonicalization)."""
+    if callable(getattr(x, "is_ready", None)):
+        return host_fetch(x)
+    return np.asarray(x)
+
+
 def record_input_wait(seconds: float):
     """The training thread waited ``seconds`` for an input batch that was not
     staged on device yet — one blocking host→device transfer from the hot
@@ -83,3 +99,4 @@ def reset_transfer_stats():
     _stats["h2d_puts"] = 0
     _stats["h2d_blocking"] = 0
     _stats["input_wait_s"] = 0.0
+    _stats["resets"] += 1

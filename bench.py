@@ -27,7 +27,19 @@ import numpy as np
 # JSON-line schema version: bump when the line's structure changes so the
 # BENCH_*.json trajectory stays machine-comparable as the detail payload
 # grows. v2 = schema_version field + detail.telemetry timeline summary.
-BENCH_SCHEMA_VERSION = 2
+# v3 = detail.audit program-audit summary (collectives per mesh axis,
+# donation aliasing, host callbacks) on every line; a dp-axis all-gather in
+# the audited program fails the config's line outright.
+BENCH_SCHEMA_VERSION = 3
+
+
+class BenchAuditFailure(RuntimeError):
+    """The audited program violates a zero-tolerance invariant; the config's
+    JSON line becomes a schema'd failure carrying the audit evidence."""
+
+    def __init__(self, message: str, audit: dict):
+        super().__init__(message)
+        self.audit = audit
 
 
 def peak_flops_per_chip() -> float:
@@ -366,6 +378,26 @@ def run_one(mode: str):
     else:
         next_batch = lambda: data  # noqa: E731
 
+    # Program audit (analysis/audit.py): lower the exact program this config
+    # will run and inspect it BEFORE spending chip time — collectives per
+    # mesh axis, donation aliasing, host callbacks. The summary rides the
+    # JSON line as detail.audit so program regressions (a stray dp-axis
+    # all-gather, lost donation) are visible in the perf trajectory; a
+    # dp-axis all-gather fails the config's line outright, like the
+    # BENCH_WINDOW validation above.
+    if bench_window > 1:
+        audit_batch = {k: np.stack([v] * bench_window) for k, v in data.items()}
+    else:
+        audit_batch = data
+    audit_summary = accelerator.audit(step, audit_batch).summary_dict()
+    if audit_summary["dp_allgathers"]:
+        raise BenchAuditFailure(
+            f"program audit: {audit_summary['dp_allgathers']} all-gather(s) on "
+            "the dp mesh axis inside the step body — dp-replicated data is "
+            "re-materialized every step (see detail.audit)",
+            audit_summary,
+        )
+
     def _sync(x):
         # Hard host sync (block_until_ready does not block through axon);
         # under windowed dispatch x is the per-step K-vector — last element
@@ -467,6 +499,7 @@ def run_one(mode: str):
                     "goodput": ledger.summary(),
                     "health": {"finite_final_loss": finite_loss},
                     "telemetry": telemetry_summary,
+                    "audit": audit_summary,
                     **(
                         {"compile_cache": os.environ["ACCELERATE_COMPILE_CACHE_DIR"]}
                         if os.environ.get("ACCELERATE_COMPILE_CACHE_DIR")
@@ -513,6 +546,9 @@ _FAIL_METRIC = {
 def _print_failure(mode: str, exc: Exception):
     # Match the success-path metric name so a 0.0 failure record lands in the
     # same series instead of looking like a gap.
+    detail = {"error": f"{type(exc).__name__}: {exc}"[:500]}
+    if isinstance(exc, BenchAuditFailure):
+        detail["audit"] = exc.audit  # the schema'd evidence for the failure
     print(
         json.dumps(
             {
@@ -521,7 +557,7 @@ def _print_failure(mode: str, exc: Exception):
                 "unit": "fraction_of_peak_bf16",
                 "vs_baseline": 0.0,
                 "schema_version": BENCH_SCHEMA_VERSION,
-                "detail": {"error": f"{type(exc).__name__}: {exc}"[:500]},
+                "detail": detail,
             }
         )
     )

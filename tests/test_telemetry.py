@@ -119,7 +119,9 @@ def test_fused_loop_timeline_zero_blocking_transfers():
     reset_transfer_stats()
     for i in range(1, 9):
         step(_batch(i))
-    assert transfer_stats() == {
+    stats = transfer_stats()
+    stats.pop("resets", None)  # reset-generation counter, not a transfer
+    assert stats == {
         "fetches": 0, "blocking": 0,  # hot loop async
         "h2d_puts": 0, "h2d_blocking": 0, "input_wait_s": 0.0,  # no prefetcher in play
     }
@@ -132,6 +134,25 @@ def test_fused_loop_timeline_zero_blocking_transfers():
     stats = transfer_stats()
     assert stats["blocking"] == 0  # ...as a copy, never a stall
     assert stats["fetches"] <= 4
+
+
+def test_timeline_baseline_survives_transfer_reset():
+    """Regression (PR 6's health+window suite-combo failure): a
+    reset_transfer_stats() AFTER a timeline captured its delta baseline used
+    to drive summary()['transfers'] negative — the timeline now detects the
+    reset generation and re-anchors at zero."""
+    from accelerate_tpu.telemetry.timeline import StepTimeline
+    from accelerate_tpu.utils import transfer
+
+    transfer._stats["fetches"] += 3
+    transfer._stats["blocking"] += 2
+    timeline = StepTimeline()  # baseline captures the non-zero globals
+    reset_transfer_stats()     # ...then someone zeroes them underneath
+    stats = timeline.summary()["transfers"]
+    assert stats["blocking"] == 0 and stats["fetches"] == 0
+    # Counts after the reset are attributed normally.
+    transfer._stats["fetches"] += 1
+    assert timeline.summary()["transfers"]["fetches"] == 1
 
 
 def test_guarded_telemetry_loop_populates_without_blocking():
@@ -421,7 +442,7 @@ def test_bench_failure_line_carries_schema_version(capsys):
     import json
 
     line = json.loads(capsys.readouterr().out.strip())
-    assert line["schema_version"] == bench.BENCH_SCHEMA_VERSION == 2
+    assert line["schema_version"] == bench.BENCH_SCHEMA_VERSION == 3
     assert line["value"] == 0.0
 
 
