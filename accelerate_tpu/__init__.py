@@ -109,6 +109,10 @@ def __getattr__(name):
         "get_registry",
         "get_telemetry",
         "span",
+        "ProfileManager",
+        "FlightRecorder",
+        "get_profile_manager",
+        "get_flight_recorder",
     ):
         from . import telemetry
 

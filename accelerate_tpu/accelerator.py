@@ -1437,11 +1437,18 @@ class Accelerator:
         training state is touched."""
         from .analysis import audit_built
 
-        return audit_built(
+        report = audit_built(
             built, batch, clip_norm,
             mesh=self.mesh,
             intermediate_threshold_bytes=intermediate_threshold_bytes,
         )
+        # Feed the trace attributor's axis join: a later profile capture can
+        # then attribute measured collective time to the NAMED mesh axes this
+        # program's inventory established (telemetry/traceview.py).
+        from .telemetry.traceview import attach_collective_axes
+
+        attach_collective_axes(report)
+        return report
 
     def _place_window_batch(self, batch):
         """Host leaves of a K-stacked window → global mesh arrays (window axis
@@ -1832,15 +1839,32 @@ class Accelerator:
     # ---------------------------------------------------------------- profile
     @contextlib.contextmanager
     def profile(self, profile_handler=None):
-        """``jax.profiler`` trace context (reference ``profile`` :3797-3856 builds
-        torch.profiler; output opens in TensorBoard/perfetto)."""
+        """Manual trace capture (reference ``profile`` :3797-3856 builds
+        torch.profiler; output opens in TensorBoard/perfetto).
+
+        Built on the same :class:`~.telemetry.profiler.ProfileManager` as the
+        triggered captures (``--profile_steps``, the slow-step z-score, POST
+        /profile), so a manual capture gets identical treatment: the covered
+        step range is recorded from the boundaries observed inside the block,
+        start/stop/parse overhead books as ``profile`` badput, the capture
+        lands in the flight recorder and the
+        ``accelerate_profile_captures_total{trigger="manual"}`` counter, and
+        the parsed attribution report surfaces in
+        ``telemetry.timeline.summary()["profile"]``. Manual captures are
+        exempt from the triggered-capture budget. Yields the trace directory;
+        yields None — and the block runs untraced — when no
+        ``output_trace_dir`` is configured (reference parity) or when a
+        triggered capture is already in flight (jax has one global trace;
+        stealing it would cut the triggered range short)."""
         handler = profile_handler or self.profile_handler or ProfileKwargs()
         trace_dir = handler.output_trace_dir
         if trace_dir is None:
             yield None
             return
-        with jax.profiler.trace(trace_dir):
-            yield None
+        from .telemetry.profiler import get_profile_manager
+
+        with get_profile_manager().manual_capture(trace_dir) as capture_dir:
+            yield capture_dir
 
     def __repr__(self):
         return f"Accelerator(state={self.state!r})"

@@ -13,6 +13,7 @@ from .env import env_command_parser
 from .estimate import estimate_command_parser
 from .launch import launch_command_parser
 from .merge import merge_command_parser
+from .profile import blackbox_command_parser, profile_command_parser
 from .test import test_command_parser
 from .tpu import tpu_command_parser
 
@@ -32,6 +33,8 @@ def main() -> None:
     tpu_command_parser(subparsers=subparsers)
     lint_command_parser(subparsers=subparsers)
     audit_command_parser(subparsers=subparsers)
+    profile_command_parser(subparsers=subparsers)
+    blackbox_command_parser(subparsers=subparsers)
 
     args = parser.parse_args()
     if not hasattr(args, "func"):

@@ -146,9 +146,10 @@ def get_user_input() -> ClusterConfig:
     # Tri-state like the health section: skipping leaves None (nothing
     # exported; telemetry defaults ON), explicit answers reach the workers.
     telemetry, metrics_port, straggler_threshold = None, 0, 0.0
+    profile_steps, profile_slow_zscore = None, None
     if _yesno(
         "Do you want to configure observability (step timeline, metrics "
-        "endpoint, straggler alerts)?", False
+        "endpoint, straggler alerts, profiling)?", False
     ):
         telemetry = _yesno(
             "  always-on telemetry (per-step timeline, spans, metrics registry)?",
@@ -161,6 +162,14 @@ def get_user_input() -> ClusterConfig:
         straggler_threshold = _ask(
             "  straggler alert ratio vs the cross-host median step time "
             "(0 = library default 1.5)", 0.0, float
+        )
+        profile_steps = _ask(
+            "  XLA trace capture step ranges (e.g. '10-12' or '10-12,50'; "
+            "'off' = none)", "off"
+        )
+        profile_slow_zscore = _ask(
+            "  slow-step trace trigger: robust z-score threshold over recent "
+            "step times (0 = disabled)", 0.0, float
         )
     # Tri-state like the health section: declining leaves both UNSPECIFIED
     # (None / '') so an inherited ACCELERATE_TRAIN_WINDOW/XLA_PRESET still
@@ -239,6 +248,8 @@ def get_user_input() -> ClusterConfig:
         straggler_threshold=straggler_threshold,
         train_window=train_window,
         xla_preset=xla_preset,
+        profile_steps=profile_steps,
+        profile_slow_zscore=profile_slow_zscore,
     )
 
 

@@ -139,6 +139,15 @@ class ClusterConfig:
     # explicitly none; utils/xla_flags.py: latency | collective_matmul).
     train_window: int | None = None
     xla_preset: str = ""
+    # Profiling (telemetry/profiler.py; docs/observability.md "Profiling"):
+    # TRI-state per the telemetry precedent. ``profile_steps`` is the
+    # explicit trace-capture range grammar ("10-12,50"; None = unspecified,
+    # an inherited ACCELERATE_PROFILE_STEPS flows through; an explicit
+    # ''/'off' scrubs it); ``profile_slow_zscore`` arms the slow-step
+    # capture trigger (None = unspecified; an explicit 0 reaches the
+    # workers as a disable; ACCELERATE_PROFILE_SLOW_ZSCORE).
+    profile_steps: str | None = None
+    profile_slow_zscore: float | None = None
     extra: dict = field(default_factory=dict)
 
     def to_dict(self) -> dict:

@@ -39,6 +39,8 @@ from ..utils.constants import (
     ENV_MIXED_PRECISION,
     ENV_NUM_PROCESSES,
     ENV_PROCESS_ID,
+    ENV_PROFILE_SLOW_ZSCORE,
+    ENV_PROFILE_STEPS,
     ENV_RESTART_ATTEMPT,
     ENV_SPIKE_ZSCORE,
     ENV_STRAGGLER_THRESHOLD,
@@ -181,6 +183,24 @@ def launch_command_parser(subparsers=None) -> argparse.ArgumentParser:
              "Echoed into telemetry snapshots.",
     )
     parser.add_argument(
+        "--profile_steps", default=None,
+        help="Capture an XLA trace over these training steps "
+             "(ACCELERATE_PROFILE_STEPS): comma-separated 1-based inclusive "
+             "ranges, e.g. '10-12' or '10-12,50'. Captures align to step "
+             "(and K-step window) boundaries; overhead books as `profile` "
+             "badput and the parsed attribution lands in telemetry summaries "
+             "(docs/observability.md 'Profiling'). 'off' scrubs an inherited "
+             "value.",
+    )
+    parser.add_argument(
+        "--profile_slow_zscore", type=float, default=None,
+        help="Slow-step trace trigger (ACCELERATE_PROFILE_SLOW_ZSCORE): when "
+             "a step's wall time lands this many robust sigmas (EMA+MAD "
+             "z-score, health/spike.py's idiom host-side) above the recent "
+             "baseline, the next steps are captured automatically. 0 "
+             "disables; captures share the max-captures-per-run budget.",
+    )
+    parser.add_argument(
         "--hang_timeout", type=float, default=None,
         help="Hang-watchdog deadline in seconds (ACCELERATE_HANG_TIMEOUT): "
              "when no training step completes within the deadline, every "
@@ -232,6 +252,8 @@ def _merge_config(args) -> ClusterConfig:
         ("straggler_threshold", "straggler_threshold"),
         ("train_window", "train_window"),
         ("xla_preset", "xla_preset"),
+        ("profile_steps", "profile_steps"),
+        ("profile_slow_zscore", "profile_slow_zscore"),
     ]:
         val = getattr(args, flag, None)
         if val is not None:
@@ -319,6 +341,18 @@ def prepare_launch_env(cfg: ClusterConfig, process_id: int | None = None, attemp
     elif cfg.xla_preset:
         # Same for an explicit --xla_preset off/none.
         env.pop(ENV_XLA_PRESET, None)
+    # Profiling (telemetry/profiler.py): tri-state per the telemetry
+    # precedent — None exports nothing (an inherited env flows through), an
+    # explicit value reaches the workers, and an explicit disable
+    # ('off'/''/0) scrubs a stale inherited value.
+    if cfg.profile_steps and cfg.profile_steps.strip().lower() not in ("off", "none", "0"):
+        env[ENV_PROFILE_STEPS] = cfg.profile_steps.strip()
+    elif cfg.profile_steps is not None:
+        env.pop(ENV_PROFILE_STEPS, None)
+    if cfg.profile_slow_zscore:
+        env[ENV_PROFILE_SLOW_ZSCORE] = str(cfg.profile_slow_zscore)
+    elif cfg.profile_slow_zscore is not None:
+        env.pop(ENV_PROFILE_SLOW_ZSCORE, None)
     # Plugins (e.g. the axon tunnel) may have pinned JAX_PLATFORMS in *this*
     # process's environ at jax-import time; children must re-discover their own
     # backend, so only forward the value we set deliberately.
@@ -456,6 +490,27 @@ def launch_command(args) -> None:
         )
     if cfg.train_window is not None and cfg.train_window < 1:
         raise ValueError(f"--train_window must be >= 1, got {cfg.train_window}")
+    if cfg.profile_steps:
+        # Fail a malformed range grammar at launch, not mid-run when the
+        # profiler first arms (the fault-plan validation precedent).
+        from ..telemetry.profiler import parse_profile_steps
+
+        parse_profile_steps(cfg.profile_steps)
+    if cfg.profile_slow_zscore and cfg.profile_slow_zscore < 0:
+        raise ValueError(
+            f"--profile_slow_zscore must be >= 0, got {cfg.profile_slow_zscore}"
+        )
+    profiling_armed = (
+        (cfg.profile_steps and cfg.profile_steps.strip().lower()
+         not in ("off", "none", "0"))
+        or (cfg.profile_slow_zscore and cfg.profile_slow_zscore > 0)
+    )
+    if profiling_armed and cfg.telemetry is False:
+        raise ValueError(
+            "--profile_steps/--profile_slow_zscore ride the telemetry step "
+            "hooks, which --no-telemetry disables: the requested captures "
+            "could never engage. Drop --no-telemetry (or the profiling flags)."
+        )
     if cfg.xla_preset:
         # Fail an unknown preset at launch, not after every worker compiled.
         from ..utils.xla_flags import XLA_PRESETS

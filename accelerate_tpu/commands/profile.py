@@ -1,0 +1,191 @@
+"""`accelerate-tpu profile` / `accelerate-tpu blackbox` — the forensics CLI.
+
+``profile report <dir>`` parses a captured XLA trace (a capture directory
+written by the ProfileManager / ``jax.profiler``, the
+``plugins/profile/<ts>`` directory itself, or a ``*.trace.json.gz`` file)
+into the per-step attribution report: device compute vs collectives (joined
+to named mesh axes when an audit inventory is supplied) vs idle vs
+host/infeed, the measured compute↔collective overlap fraction, and the top-N
+op table. Pure post-processing — no backend, no devices touched.
+
+``blackbox <dump.json>`` renders a flight-recorder dump
+(telemetry/flight.py — written on hang / guard trip / restart / crash) as a
+causal timeline: the last thing the run was doing, in order, with the
+transfer/goodput context it was dumped with.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+# ------------------------------------------------------------------ profile
+def profile_command_parser(subparsers=None) -> argparse.ArgumentParser:
+    description = "Attribute a captured XLA trace: compute vs collectives vs idle vs host"
+    if subparsers is not None:
+        parser = subparsers.add_parser("profile", description=description)
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu profile", description=description)
+    parser.add_argument(
+        "action", choices=["report"],
+        help="'report' parses a capture into the attribution schema",
+    )
+    parser.add_argument(
+        "trace_dir",
+        help="Capture directory (ProfileManager output / jax.profiler log_dir) "
+             "or a *.trace.json.gz file",
+    )
+    parser.add_argument(
+        "--audit", default=None,
+        help="Program-audit JSON (accelerate-tpu audit output) whose collective "
+             "inventory attributes measured collective time to named mesh axes",
+    )
+    parser.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="Machine-readable report on stdout (default: human summary + JSON)",
+    )
+    if subparsers is not None:
+        parser.set_defaults(func=profile_command)
+    return parser
+
+
+def profile_command(args) -> None:
+    from ..telemetry.traceview import collective_axes_from_audit, report_capture
+
+    axes = None
+    if args.audit:
+        with open(args.audit) as fh:
+            axes = collective_axes_from_audit(json.load(fh))
+    report = report_capture(args.trace_dir, collective_axes=axes)
+    if args.as_json:
+        print(json.dumps(report, indent=1))
+        return
+    fractions = report["fractions"]
+    print(f"trace: {report.get('trace_path', args.trace_dir)}")
+    if report.get("n_steps"):
+        print(f"steps analyzed: {report['n_steps']} "
+              f"(window {report['wall_s'] * 1e3:.1f}ms)")
+    else:
+        print(f"window: {report['wall_s'] * 1e3:.1f}ms (no step annotations — "
+              "whole-capture attribution)")
+    print(
+        "attribution: "
+        f"compute {fractions['compute']:.1%} | "
+        f"collective {fractions['collective']:.1%} (exposed) | "
+        f"host/infeed {fractions['host']:.1%} | "
+        f"idle {fractions['idle']:.1%}"
+    )
+    overlap = report.get("overlap_fraction")
+    if overlap is not None:
+        print(f"compute<->collective overlap: {overlap:.1%} of "
+              f"{report['collective_s'] * 1e3:.2f}ms raw collective time")
+    if report.get("by_axis"):
+        per_axis = ", ".join(
+            f"{axis}={seconds * 1e3:.2f}ms" for axis, seconds in report["by_axis"].items()
+        )
+        print(f"collective time by mesh axis: {per_axis}")
+    if report.get("top_ops"):
+        print("top ops:")
+        for op in report["top_ops"]:
+            print(
+                f"  {op['total_s'] * 1e3:9.3f}ms x{op['count']:<4d} "
+                f"[{op['kind']}] {op['name']}"
+            )
+    print(json.dumps(report, indent=1))
+
+
+# ----------------------------------------------------------------- blackbox
+def blackbox_command_parser(subparsers=None) -> argparse.ArgumentParser:
+    description = "Render a flight-recorder dump as a causal timeline"
+    if subparsers is not None:
+        parser = subparsers.add_parser("blackbox", description=description)
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu blackbox", description=description)
+    parser.add_argument("dump", help="flight_*.json dump written by the flight recorder")
+    parser.add_argument(
+        "--last", type=int, default=0,
+        help="Only render the last N events (default: all retained)",
+    )
+    if subparsers is not None:
+        parser.set_defaults(func=blackbox_command)
+    return parser
+
+
+def _event_detail(event: dict) -> str:
+    skip = ("seq", "t_s", "wall", "kind", "step")
+    parts = []
+    for key, value in event.items():
+        if key in skip or value is None:
+            continue
+        parts.append(f"{key}={value}")
+    return " ".join(parts)
+
+
+def blackbox_command(args) -> None:
+    with open(args.dump) as fh:
+        dump = json.load(fh)
+    events = dump.get("events", [])
+    if args.last > 0:
+        events = events[-args.last:]
+    import time as _time
+
+    when = _time.strftime(
+        "%Y-%m-%d %H:%M:%S", _time.localtime(dump.get("dumped_at", 0))
+    )
+    print(
+        f"flight recorder dump: reason={dump.get('reason')!r} at {when} "
+        f"(pid {dump.get('pid')}, process {dump.get('process_index')})"
+    )
+    print(
+        f"events: {len(events)} shown / {dump.get('events_retained')} retained "
+        f"/ {dump.get('events_total')} recorded"
+    )
+    transfers = dump.get("transfers")
+    if transfers:
+        print(
+            f"transfers at dump: {transfers.get('fetches', 0)} fetches "
+            f"({transfers.get('blocking', 0)} blocking), "
+            f"{transfers.get('h2d_puts', 0)} uploads "
+            f"({transfers.get('h2d_blocking', 0)} waits)"
+        )
+    goodput = dump.get("goodput")
+    if goodput:
+        print(
+            f"goodput at dump: {goodput.get('goodput_fraction', 0):.1%} of "
+            f"{goodput.get('wall_s', 0):.1f}s wall "
+            f"({goodput.get('steps', 0)} steps, {goodput.get('restarts', 0)} restarts)"
+        )
+    print("timeline (t is seconds since recorder start):")
+    for event in events:
+        step = f" step={event['step']}" if "step" in event else ""
+        detail = _event_detail(event)
+        print(
+            f"  t={event.get('t_s', 0):>10.3f}  {event.get('kind', '?'):<20}"
+            f"{step}{'  ' + detail if detail else ''}"
+        )
+    spans = dump.get("spans")
+    if spans:
+        print(f"last spans ({len(spans)}):")
+        for span in spans[-16:]:
+            print(
+                f"  {span['duration_s'] * 1e3:9.3f}ms "
+                f"{'  ' * span.get('depth', 0)}{span.get('path', span.get('name'))}"
+            )
+
+
+def main() -> None:  # pragma: no cover - thin shim
+    parser = argparse.ArgumentParser("accelerate-tpu-forensics")
+    sub = parser.add_subparsers()
+    profile_command_parser(subparsers=sub)
+    blackbox_command_parser(subparsers=sub)
+    args = parser.parse_args()
+    if not hasattr(args, "func"):
+        parser.print_help()
+        sys.exit(1)
+    args.func(args)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

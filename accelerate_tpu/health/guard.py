@@ -414,6 +414,17 @@ class HealthGuard:
             f"Health guard tripped at step {trip_step}: {describe_flags(flags)}"
             + (f" (robust z={z:.2f})" if z else "")
         )
+        # Flight recorder: the trip is a black-box moment — record it and dump
+        # the event ring so the steps leading up to the poisoned batch are on
+        # disk even if the run dies mid-recovery.
+        from ..telemetry.flight import get_flight_recorder
+
+        flight = get_flight_recorder()
+        flight.record(
+            "guard_trip", step=trip_step, verdict=describe_flags(flags),
+            zscore=round(float(z), 3) if z else None, action=self.on_trip,
+        )
+        flight.dump("guard_trip")
         # Telemetry: trips (and rollbacks, below) land in the shared metrics
         # registry so scrapers/trackers see them next to goodput and restarts.
         from ..telemetry.metrics import get_registry
@@ -449,6 +460,7 @@ class HealthGuard:
             if spike_state is not None:
                 self._spike_state = spike_state
             rolled_back = True
+            flight.record("rollback", step=trip_step, resume_step=resume_step)
             get_registry().counter(
                 "accelerate_health_rollbacks_total",
                 "Last-known-good rollbacks applied by the health guard",

@@ -73,6 +73,19 @@ def _dump_diagnostics(idle_s: float, step):
             )
         except Exception:
             pass
+        # Flight-recorder black box (telemetry/flight.py): the stacks say
+        # where the run IS; the event ring says what it was doing on the way
+        # there — dump it next to the fault for `accelerate-tpu blackbox`.
+        try:
+            from ..telemetry.flight import get_flight_recorder
+
+            recorder = get_flight_recorder()
+            recorder.record("hang", step=step, idle_s=round(idle_s, 3))
+            path = recorder.dump("hang")
+            if path:
+                sys.stderr.write(f"=== flight recorder dumped to {path} ===\n")
+        except Exception:
+            pass
         sys.stderr.flush()
     except Exception:
         pass  # diagnostics must never mask the hang handling itself

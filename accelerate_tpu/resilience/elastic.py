@@ -218,6 +218,12 @@ def reshard_accelerator(accelerator, devices=None, min_data_parallel: int = 1):
         accelerator._mesh_epoch += 1
         direction = "shrink" if new_dp < old_dp else "grow"
         _publish_transition(direction, new_mesh, new_dp)
+        from ..telemetry.flight import get_flight_recorder
+
+        get_flight_recorder().record(
+            "reshard", direction=direction, old_dp=old_dp, new_dp=new_dp,
+            devices=len(devices),
+        )
         logger.warning(
             f"Elastic reshard: dp {old_dp} -> {new_dp} over "
             f"{len(devices)} device(s); gradient accumulation "

@@ -158,6 +158,15 @@ class FaultPlan:
                 continue
             f.fired = True
             logger.warning(f"Fault injection: firing {f.action} at step {step}")
+            # Black box: an injected fault must name itself in the flight
+            # recorder so a drill's dump ends with the cause, not just the
+            # symptom (the hang-drill acceptance in tests/test_profiling.py).
+            from ..telemetry.flight import get_flight_recorder
+
+            get_flight_recorder().record(
+                "fault_injected", step=step, action=f.action,
+                arg=f.arg if f.arg else None,
+            )
             if f.action == "kill":
                 raise SimulatedFault(step)
             if f.action in _RESIZE_ACTIONS:
@@ -182,6 +191,12 @@ class FaultPlan:
         for f in self.faults:
             if not f.fired and f.step == step and f.action in _DATA_ACTIONS:
                 f.fired = True
+                from ..telemetry.flight import get_flight_recorder
+
+                get_flight_recorder().record(
+                    "fault_injected", step=step, action=f.action,
+                    arg=f.arg if f.arg else None,
+                )
                 return f
         return None
 

@@ -16,11 +16,12 @@ the same numbers with zero per-step cost.
 
 The categories follow the goodput decomposition used by large TPU trainers
 (productive step time vs program-acquisition and checkpoint overheads): one
-goodput bucket (``step``) and seven badput buckets — ``compile``, ``ckpt_save``,
+goodput bucket (``step``) and eight badput buckets — ``compile``, ``ckpt_save``,
 ``ckpt_restore``, ``restart``, the health subsystem's ``rollback``
 (last-known-good restores after a NaN/loss-spike trip, health/rollback.py) and
 ``hang`` (time a wedged run sat before the watchdog fired, health/hang.py),
-plus ``reshard`` (elastic world-size transitions, resilience/elastic.py).
+plus ``reshard`` (elastic world-size transitions, resilience/elastic.py) and
+``profile`` (trace-capture start/stop/parse overhead, telemetry/profiler.py).
 Wall-clock not attributed to any bucket is reported as ``other_s`` (data
 feeding, host-side logging, eval, idle).
 """
@@ -35,8 +36,13 @@ GOODPUT_CATEGORY = "step"
 # ``reshard`` is the elastic world-size transition (resilience/elastic.py):
 # re-forming the mesh at a new dp degree and redistributing params/opt-state
 # onto it — voluntary downtime, booked separately from crash ``restart``s.
+# ``profile`` is trace-capture overhead (telemetry/profiler.py): starting/
+# stopping an XLA trace and parsing it into the attribution report — booked so
+# a profiled run's goodput/MFU accounting stays honest about what the
+# diagnosis itself cost.
 BADPUT_CATEGORIES = (
-    "compile", "ckpt_save", "ckpt_restore", "restart", "rollback", "hang", "reshard"
+    "compile", "ckpt_save", "ckpt_restore", "restart", "rollback", "hang",
+    "reshard", "profile",
 )
 CATEGORIES = (GOODPUT_CATEGORY,) + BADPUT_CATEGORIES
 

@@ -176,6 +176,9 @@ class PreemptionWatcher:
             )
         if agreed:
             self._flag = True  # agreement is sticky on every host
+            from ..telemetry.flight import record_event
+
+            record_event("preemption_agreed")
         return agreed
 
 

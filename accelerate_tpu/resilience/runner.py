@@ -168,6 +168,12 @@ def _run_resilient_loop(
         except WorldSizeChange as exc:
             if watchdog is not None:
                 watchdog.rearm()
+            from ..telemetry.flight import get_flight_recorder
+
+            get_flight_recorder().record(
+                "world_size_change", step=exc.step,
+                direction=exc.direction, factor=exc.factor,
+            )
             if not elastic:
                 raise RuntimeError(
                     f"World-size change at step {exc.step} ({exc.direction} by "
@@ -252,6 +258,17 @@ def _run_resilient_loop(
             if watchdog is not None:
                 watchdog.rearm()  # the next attempt gets a fresh deadline
             attempt += 1
+            # Black-box dump BEFORE the restart decision: whether this attempt
+            # exhausts the budget or backs off and retries, the event ring at
+            # the moment of failure is the post-mortem either way.
+            from ..telemetry.flight import get_flight_recorder
+
+            flight = get_flight_recorder()
+            flight.record(
+                "restart", attempt=attempt,
+                error=f"{type(exc).__name__}: {exc}"[:300],
+            )
+            flight.dump("restart")
             if attempt > max_restarts:
                 logger.error(
                     f"Training failed and the restart budget is exhausted "

@@ -17,7 +17,15 @@ goodput ledger, health verdicts, transfer counters — publish into ONE stack:
   (``launch --metrics_port``) and as structured records through the tracker
   stack (``Accelerator.log_telemetry``);
 - :mod:`.straggler` — periodic cross-host step-time aggregation over the
-  one-scalar-collective/KV-agreement machinery, naming the slow host.
+  one-scalar-collective/KV-agreement machinery, naming the slow host;
+- :mod:`.profiler` — triggered XLA trace capture aligned to step/window
+  boundaries (explicit ranges, slow-step z-score, straggler trips, POST
+  /profile), budgeted and booked as ``profile`` badput;
+- :mod:`.traceview` — parses captured traces into the
+  compute/collective/idle/host attribution report (with the measured
+  compute↔collective overlap fraction);
+- :mod:`.flight` — the always-on flight-recorder black box, dumped to JSON
+  on hang/trip/restart/crash and rendered by ``accelerate-tpu blackbox``.
 
 :class:`Telemetry` binds them behind ``Accelerator.telemetry``; the per-step
 hooks loops already call (``guard_step`` / ``checkpoint_on_preemption``) and
@@ -29,6 +37,12 @@ from __future__ import annotations
 
 import os
 
+from .flight import (
+    FlightRecorder,
+    get_flight_recorder,
+    record_event,
+    reset_flight_recorder,
+)
 from .metrics import (
     Counter,
     Gauge,
@@ -39,17 +53,28 @@ from .metrics import (
     start_default_server,
     stop_default_server,
 )
+from .profiler import (
+    ProfileManager,
+    SlowStepDetector,
+    get_profile_manager,
+    parse_profile_steps,
+    reset_profile_manager,
+    set_profile_manager,
+)
 from .spans import SpanRecord, SpanRing, get_span_ring, reset_spans, span
 from .straggler import SkewReport, StragglerMonitor
 from .timeline import StepTimeline, device_memory_stats, device_peak_flops
 
 __all__ = [
     "Counter",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
     "MetricsRegistry",
     "MetricsServer",
+    "ProfileManager",
     "SkewReport",
+    "SlowStepDetector",
     "SpanRecord",
     "SpanRing",
     "StepTimeline",
@@ -57,12 +82,19 @@ __all__ = [
     "Telemetry",
     "device_memory_stats",
     "device_peak_flops",
+    "get_flight_recorder",
+    "get_profile_manager",
     "get_registry",
     "get_span_ring",
     "get_telemetry",
     "install_default_collectors",
+    "parse_profile_steps",
+    "record_event",
+    "reset_flight_recorder",
+    "reset_profile_manager",
     "reset_spans",
     "reset_telemetry",
+    "set_profile_manager",
     "set_telemetry",
     "span",
     "start_default_server",
@@ -191,10 +223,22 @@ def start_endpoint_from_env(local_rank: int | None = None) -> "MetricsServer | N
         return None
 
 
-class Telemetry:
-    """Binds timeline + straggler monitor + registry (+ optional endpoint).
+def _transfer_snapshot() -> dict:
+    from ..utils.transfer import transfer_stats
 
-    ``enabled=False`` turns every hook into a no-op (ACCELERATE_TELEMETRY=0).
+    return transfer_stats()
+
+
+class Telemetry:
+    """Binds timeline + straggler monitor + registry (+ optional endpoint),
+    plus the profiling/forensics pair: the process-wide
+    :class:`~.profiler.ProfileManager` (triggered trace capture — fed one
+    call per step/window boundary, so captures align to whole steps) and the
+    :class:`~.flight.FlightRecorder` black box (every boundary lands in its
+    event ring with the transfer-counter delta it produced).
+
+    ``enabled=False`` turns every hook into a no-op (ACCELERATE_TELEMETRY=0)
+    — including the profiler feed: trace triggers ride the telemetry hooks.
     ``metrics_port`` starts the process-wide Prometheus endpoint (0 binds an
     ephemeral port; None leaves HTTP off — the registry still feeds trackers).
     A custom ``registry`` scopes the timeline/straggler series only (tests);
@@ -211,6 +255,7 @@ class Telemetry:
         straggler_threshold: float = 1.5,
         metrics_port: int | None = None,
         registry: MetricsRegistry | None = None,
+        profiler: "ProfileManager | None" = None,
     ):
         self.enabled = bool(enabled)
         self.registry = registry if registry is not None else get_registry()
@@ -221,6 +266,19 @@ class Telemetry:
             slow_ratio=straggler_threshold,
             registry=self.registry,
         )
+        if profiler is not None:
+            set_profile_manager(profiler)
+            self.profiler = profiler
+        elif self.enabled:
+            self.profiler = get_profile_manager()
+        else:
+            # Disabled telemetry never feeds step boundaries, so creating the
+            # default manager here would also install a POST /profile trigger
+            # whose accepted requests could never engage (and would wedge the
+            # pending slot into permanent 409s). Leave it uninstalled — the
+            # endpoint then answers 503 "no profiler armed", which is true.
+            self.profiler = None
+        self.flight = get_flight_recorder()
         self.server: MetricsServer | None = None
         if metrics_port is not None:
             self.server = start_default_server(int(metrics_port), registry=self.registry)
@@ -250,14 +308,38 @@ class Telemetry:
             if self.timeline.boundaries == self._seen_timeline_n:
                 # Fallback feed (the loop's fused program didn't): a windowed
                 # boundary still covers `window` training steps.
-                self.timeline.step_end(step=step, tokens=tokens, loss=loss,
-                                       steps=window)
+                wall = self.timeline.step_end(step=step, tokens=tokens,
+                                              loss=loss, steps=window)
+                self.profiler.step_boundary(step=step, wall_s=wall, steps=window)
+                self.flight.note_step(step=step, wall_s=wall, steps=window,
+                                      transfers=_transfer_snapshot())
+            else:
+                # The fused program already marked this boundary (and fed the
+                # profiler/black box); just pin the loop's step numbering so
+                # explicit profile ranges refer to real steps.
+                self.profiler.sync_step(step)
             self._seen_timeline_n = self.timeline.boundaries
             self._last_hook_step = step
         if state is not None and self.straggler.due(step, window):
             window_s, window_steps = self.timeline.take_window()
             if window_steps:
-                self.straggler.report(state, window_s / window_steps, step=step)
+                report = self.straggler.report(
+                    state, window_s / window_steps, step=step
+                )
+                if report is not None and report.tripped:
+                    # Name the skew AND capture the evidence: a straggler trip
+                    # arms a trace of the next steps on every host (the
+                    # exchange is collective, so all hosts trip together) —
+                    # budget/rate limits live in the manager.
+                    self.flight.record(
+                        "straggler_trip", step=step,
+                        slowest_host=report.slowest_host,
+                        ratio=round(report.ratio, 3),
+                    )
+                    self.profiler.request_capture(
+                        steps=self.profiler.slow_capture_steps,
+                        trigger="straggler",
+                    )
 
     def on_fused_step(self, tokens: int | None = None, loss=None,
                       steps: int = 1) -> None:
@@ -269,7 +351,10 @@ class Telemetry:
         stay correct (see ``StepTimeline.step_end``)."""
         if not self.enabled:
             return
-        self.timeline.step_end(tokens=tokens, loss=loss, steps=steps)
+        wall = self.timeline.step_end(tokens=tokens, loss=loss, steps=steps)
+        self.profiler.step_boundary(wall_s=wall, steps=steps)
+        self.flight.note_step(wall_s=wall, steps=steps,
+                              transfers=_transfer_snapshot())
 
     # --------------------------------------------------------------- reading
     def summary(self) -> dict:

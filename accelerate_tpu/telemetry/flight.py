@@ -1,0 +1,260 @@
+"""Flight recorder — the always-on black box a dead run leaves behind.
+
+When a run hangs, trips a health guard, restarts, or dies on an unhandled
+exception, the logs say *that* it happened; the question that decides the fix
+is what the run was doing in the seconds before. The flight recorder keeps a
+bounded ring of structured events fed by the subsystems that already observe
+the interesting transitions — step/window boundaries (with transfer-counter
+deltas), span records, guard verdicts and trips, fault injections,
+reshard/restart/preemption transitions, profile-capture triggers — and dumps
+the ring to JSON at the moments a post-mortem needs it:
+
+- on a hang-watchdog trip (hooked into :func:`...health.hang._dump_diagnostics`),
+- on a health-guard trip / rollback (:meth:`...health.guard.HealthGuard._handle_trip`),
+- on every ``run_resilient`` restart,
+- on an unhandled exception (a chained ``sys.excepthook``),
+
+plus on demand via :meth:`FlightRecorder.dump`. ``accelerate-tpu blackbox
+<dump.json>`` renders a dump as a causal timeline.
+
+Recording discipline matches the rest of the telemetry stack: one event is a
+dict build plus a lock-free ``deque.append`` — no locks on the hot path, no
+device transfers, ever. Dumps are rate-limited (:data:`MAX_AUTO_DUMPS` per
+process) so a crash-looping job cannot fill a disk with black boxes.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import json
+import os
+import sys
+import threading
+import time
+
+FLIGHT_SCHEMA_VERSION = 1
+
+# Automatic (reason-driven) dumps per process; FlightRecorder.dump with an
+# explicit path is never rate-limited.
+MAX_AUTO_DUMPS = 8
+
+DEFAULT_DUMP_DIR = "flight_recorder"
+
+
+class FlightRecorder:
+    """Bounded overwrite-oldest event ring; see module docstring.
+
+    ``capacity`` bounds retained events (the sequence number keeps counting so
+    wraparound is observable in a dump). ``clock`` is injectable for
+    deterministic tests; event records carry both the relative monotonic time
+    and a wall-clock stamp so dumps from different hosts can be correlated.
+    """
+
+    def __init__(self, capacity: int = 2048, clock=time.monotonic):
+        if capacity <= 0:
+            raise ValueError(f"capacity must be positive, got {capacity}")
+        self.capacity = capacity
+        self._clock = clock
+        self._t0 = clock()
+        self._ring: collections.deque = collections.deque(maxlen=capacity)
+        self._seq = itertools.count()  # atomic under the GIL (spans.py idiom)
+        self._auto_dumps = 0
+        self._last_transfers: dict = {}
+
+    # -------------------------------------------------------------- recording
+    def record(self, kind: str, step=None, **data):
+        """Append one structured event. Safe on any thread (including signal
+        handlers and the hang watchdog's daemon thread); never raises."""
+        try:
+            event = {
+                "seq": next(self._seq),
+                "t_s": round(self._clock() - self._t0, 6),
+                "wall": time.time(),
+                "kind": str(kind),
+            }
+            if step is not None:
+                event["step"] = int(step)
+            if data:
+                event.update(data)
+            self._ring.append(event)
+        except Exception:
+            pass  # the black box must never take the plane down
+
+    def note_step(self, step=None, wall_s=None, steps: int = 1, transfers: dict | None = None):
+        """A step/window boundary completed — the per-step feed Telemetry
+        drives. ``transfers`` (a ``transfer_stats()`` snapshot) is diffed
+        against the previous boundary so each event carries the *delta* the
+        boundary produced, not the cumulative counters."""
+        data = {}
+        if wall_s is not None:
+            data["wall_s"] = round(float(wall_s), 6)
+        if steps != 1:
+            data["steps"] = int(steps)
+        if transfers:
+            prev = self._last_transfers
+            # A reset_transfer_stats() since the last boundary zeroed the
+            # globals underneath the baseline (the timeline's re-anchor
+            # problem): comparing against the stale baseline would log a
+            # large negative delta into the black box. Re-anchor at zero.
+            if transfers.get("resets", 0) != prev.get("resets", 0):
+                prev = {}
+            delta = {
+                k: round(transfers[k] - prev.get(k, 0), 6)
+                for k in ("fetches", "blocking", "h2d_puts", "h2d_blocking")
+                if k in transfers and transfers[k] != prev.get(k, 0)
+            }
+            self._last_transfers = dict(transfers)
+            if delta:
+                data["transfers"] = delta
+        self.record("step", step=step, **data)
+
+    @property
+    def total(self) -> int:
+        """Events ever recorded (keeps growing after wraparound)."""
+        ring = list(self._ring)
+        return ring[-1]["seq"] + 1 if ring else 0
+
+    def snapshot(self) -> list:
+        """Retained events, oldest first."""
+        return sorted(self._ring, key=lambda e: e["seq"])
+
+    def clear(self):
+        self._ring.clear()
+        self._seq = itertools.count()
+        self._last_transfers = {}
+
+    # ----------------------------------------------------------------- dumps
+    def dump(self, reason: str, path: str | None = None, extra: dict | None = None) -> str | None:
+        """Write the black box to JSON; returns the path (None when the
+        auto-dump budget is spent or the write failed — a dump failure must
+        never mask the fault being dumped)."""
+        try:
+            if path is None:
+                if self._auto_dumps >= MAX_AUTO_DUMPS:
+                    return None
+                self._auto_dumps += 1
+                directory = dump_dir()
+                os.makedirs(directory, exist_ok=True)
+                stamp = time.strftime("%Y%m%d_%H%M%S")
+                path = os.path.join(
+                    directory,
+                    f"flight_{stamp}_{reason}_{os.getpid()}_{self._auto_dumps}.json",
+                )
+            payload = self._payload(reason, extra)
+            tmp = f"{path}.tmp"
+            with open(tmp, "w") as fh:
+                json.dump(payload, fh, indent=1, default=str)
+            os.replace(tmp, path)  # a torn dump is worse than none
+            return path
+        except Exception:
+            return None
+
+    def _payload(self, reason: str, extra: dict | None) -> dict:
+        from ..utils.constants import ENV_PROCESS_ID
+
+        payload = {
+            "schema_version": FLIGHT_SCHEMA_VERSION,
+            "reason": reason,
+            "dumped_at": time.time(),
+            "pid": os.getpid(),
+            "process_index": int(os.environ.get(ENV_PROCESS_ID, "0") or 0),
+            "events_total": self.total,
+            "events_retained": len(self._ring),
+            "events": self.snapshot(),
+        }
+        if extra:
+            payload["extra"] = {k: v for k, v in extra.items()}
+        # Context snapshots from the sibling silos — best-effort: any of them
+        # failing must not lose the event ring.
+        try:
+            from ..utils.transfer import transfer_stats
+
+            payload["transfers"] = transfer_stats()
+        except Exception:
+            pass
+        try:
+            from ..resilience.goodput import get_ledger
+
+            payload["goodput"] = get_ledger().summary()
+        except Exception:
+            pass
+        try:
+            from .spans import get_span_ring
+
+            payload["spans"] = [
+                {
+                    "name": r.name,
+                    "path": r.path,
+                    "depth": r.depth,
+                    "duration_s": round(r.duration_s, 6),
+                }
+                for r in get_span_ring().snapshot()[-64:]
+            ]
+        except Exception:
+            pass
+        return payload
+
+
+def dump_dir() -> str:
+    """Where automatic dumps land: ACCELERATE_FLIGHT_DIR, else
+    ``./flight_recorder``."""
+    from ..utils.constants import ENV_FLIGHT_DIR
+
+    return os.environ.get(ENV_FLIGHT_DIR, "").strip() or DEFAULT_DUMP_DIR
+
+
+# ------------------------------------------------------ process-wide default
+_RECORDER: FlightRecorder | None = None
+_EXCEPTHOOK_INSTALLED = False
+_LOCK = threading.Lock()
+
+
+def get_flight_recorder() -> FlightRecorder:
+    """The process-wide black box; created (and the crash excepthook
+    installed) on first use."""
+    global _RECORDER
+    if _RECORDER is None:
+        with _LOCK:
+            if _RECORDER is None:
+                _RECORDER = FlightRecorder()
+                _install_excepthook()
+    return _RECORDER
+
+
+def record_event(kind: str, step=None, **data):
+    """Record into the default recorder IF one exists — the cheap spelling for
+    call sites that must not force recorder creation (signal handlers)."""
+    if _RECORDER is not None:
+        _RECORDER.record(kind, step=step, **data)
+
+
+def reset_flight_recorder():
+    """Drop the default recorder — tests (the excepthook stays installed; it
+    checks the live global on every crash)."""
+    global _RECORDER
+    _RECORDER = None
+
+
+def _install_excepthook():
+    """Chain a dump-on-unhandled-exception hook in front of the current
+    ``sys.excepthook`` (once per process)."""
+    global _EXCEPTHOOK_INSTALLED
+    if _EXCEPTHOOK_INSTALLED:
+        return
+    _EXCEPTHOOK_INSTALLED = True
+    previous = sys.excepthook
+
+    def hook(exc_type, exc, tb):
+        recorder = _RECORDER
+        if recorder is not None and not issubclass(
+            exc_type, (KeyboardInterrupt, SystemExit)
+        ):
+            recorder.record(
+                "unhandled_exception",
+                error=f"{exc_type.__name__}: {exc}"[:500],
+            )
+            recorder.dump("exception")
+        previous(exc_type, exc, tb)
+
+    sys.excepthook = hook

@@ -295,6 +295,19 @@ def cached_handles(factory):
 
 
 # ---------------------------------------------------------------- HTTP server
+# On-demand profile trigger (telemetry/profiler.py registers the live
+# ProfileManager's request_capture here via set_profile_trigger) — an
+# injected hook so this module keeps importing nothing from the framework.
+_PROFILE_TRIGGER = None
+
+
+def set_profile_trigger(fn):
+    """``fn(steps=N, trigger="http") -> dict`` serves POST /profile; None
+    uninstalls (503 until a profiler is armed again)."""
+    global _PROFILE_TRIGGER
+    _PROFILE_TRIGGER = fn
+
+
 class _MetricsHandler(BaseHTTPRequestHandler):
     registry: MetricsRegistry = None
 
@@ -309,6 +322,46 @@ class _MetricsHandler(BaseHTTPRequestHandler):
             return
         self.send_response(200)
         self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):  # noqa: N802 (http.server contract)
+        """POST /profile?steps=N — arm an on-demand trace capture of the next
+        N step boundaries on THIS worker (each worker serves its own port)."""
+        from urllib.parse import parse_qs, urlparse
+
+        parsed = urlparse(self.path)
+        if parsed.path not in ("/profile", "/profile/"):
+            self.send_error(404)
+            return
+        if _PROFILE_TRIGGER is None:
+            self._respond_json(
+                503, {"accepted": False, "reason": "no profiler armed in this process"}
+            )
+            return
+        try:
+            steps = int(parse_qs(parsed.query).get("steps", ["1"])[0])
+            if steps < 1:
+                raise ValueError
+        except (ValueError, TypeError):
+            self._respond_json(
+                400, {"accepted": False, "reason": "steps must be a positive integer"}
+            )
+            return
+        try:
+            result = _PROFILE_TRIGGER(steps=steps, trigger="http")
+        except Exception as exc:  # the trigger must not take the server down
+            self._respond_json(500, {"accepted": False, "reason": repr(exc)})
+            return
+        self._respond_json(200 if result.get("accepted") else 409, result)
+
+    def _respond_json(self, status: int, payload: dict):
+        import json
+
+        body = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)

@@ -1,0 +1,526 @@
+"""Triggered XLA trace capture — evidence, not guesses, about step time.
+
+The step timeline says *that* steps are slow; the program auditor says *which*
+collectives exist statically; neither attributes measured device time. This
+module captures real XLA traces (``jax.profiler.start_trace`` /
+``stop_trace``), aligned to step (and K-step window) boundaries so every
+capture covers whole steps, armed four ways:
+
+- **explicit step ranges** — ``ACCELERATE_PROFILE_STEPS="10-12"`` /
+  ``launch --profile_steps`` (comma-separated ``a-b`` or single-step ``a``
+  ranges); under windowed dispatch the capture starts at the last boundary
+  before the range and runs until the range is covered;
+- **slow-step trigger** — a host-side robust z-score over the timeline's
+  per-step wall times (the EMA + MAD-proxy idiom of ``health/spike.py``,
+  re-derived on host floats): when a step lands ``slow_zscore`` robust sigmas
+  above the recent baseline, the *next* steps are captured — the trace shows
+  the regime the outlier came from;
+- **a straggler trip** — the cross-host monitor naming a slow host arms a
+  capture on every host so the skew can be attributed;
+- **on demand** — ``POST /profile?steps=N`` on the existing metrics HTTP
+  server (the hook is registered via :func:`..telemetry.metrics.set_profile_trigger`).
+
+Every path is rate-limited by a max-captures-per-run budget, and capture
+overhead (trace start/stop plus parsing the result into the attribution
+report) is booked as the ``profile`` badput class so goodput/MFU accounting
+stays honest. Completed captures are parsed by :mod:`.traceview` into a
+compute/collective/idle/host attribution report that surfaces in
+``StepTimeline.summary()["profile"]``, on bench.py JSON lines as
+``detail.profile``, and via ``accelerate-tpu profile report <dir>``.
+
+Arming a trigger adds only host arithmetic per step boundary — no device
+transfer, blocking or otherwise, until a capture actually engages.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import time
+
+from ..logging import get_logger
+
+logger = get_logger(__name__)
+
+# health/spike.py's normal-consistency constant, reused for the host-side
+# slow-step detector so the two robust z-scores mean the same thing.
+_MAD_TO_SIGMA = 1.4826
+
+DEFAULT_MAX_CAPTURES = 3
+DEFAULT_SLOW_CAPTURE_STEPS = 2
+
+
+def parse_profile_steps(spec) -> list:
+    """``"10-12,50"`` → ``[(10, 12), (50, 50)]`` (sorted, validated).
+
+    Grammar: comma-separated ranges, each ``<start>-<end>`` or a single
+    ``<step>``; steps are 1-based and ranges inclusive. Empty/"off" → [].
+    """
+    if spec is None:
+        return []
+    if isinstance(spec, (list, tuple)):
+        ranges = [(int(a), int(b)) for a, b in spec]
+    else:
+        text = str(spec).strip()
+        if not text or text.lower() in ("off", "none", "0"):
+            return []
+        ranges = []
+        for part in text.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            a, sep, b = part.partition("-")
+            try:
+                start = int(a)
+                end = int(b) if sep else start
+            except ValueError:
+                raise ValueError(
+                    f"bad profile step range {part!r} in {text!r}: expected "
+                    "'<start>-<end>' or '<step>' (e.g. '10-12' or '10-12,50')"
+                ) from None
+            ranges.append((start, end))
+    for start, end in ranges:
+        if start < 1 or end < start:
+            raise ValueError(
+                f"bad profile step range {start}-{end}: steps are 1-based and "
+                "ranges inclusive (start <= end)"
+            )
+    return sorted(ranges)
+
+
+class SlowStepDetector:
+    """Host-side robust z-score over per-step wall times.
+
+    The device-state twin lives in ``health/spike.py``; this one runs on the
+    host floats the timeline already holds, so arming it costs a few float
+    ops per boundary and no device work. Same correctness properties: the
+    effective decay ``min(d, n/(n+1))`` makes the warmup a plain running mean,
+    and a tripped observation does NOT update the statistics — the slow step
+    must not drag the baseline toward itself (a sustained regression then
+    keeps tripping instead of being normalized away).
+    """
+
+    def __init__(self, zscore: float, warmup_steps: int = 20, ema_decay: float = 0.9):
+        if zscore <= 0:
+            raise ValueError(f"zscore must be > 0, got {zscore}")
+        if not 0.0 < ema_decay < 1.0:
+            raise ValueError(f"ema_decay must be in (0, 1), got {ema_decay}")
+        self.zscore = float(zscore)
+        self.warmup_steps = int(warmup_steps)
+        self.ema_decay = float(ema_decay)
+        self._ema = 0.0
+        self._mad = 0.0
+        self._count = 0
+
+    def observe(self, wall_s: float) -> tuple:
+        """One completed step's wall time → ``(tripped, z)``."""
+        wall_s = float(wall_s)
+        dev = abs(wall_s - self._ema)
+        sigma = _MAD_TO_SIGMA * self._mad
+        warm = self._count >= self.warmup_steps
+        z = dev / (sigma + 1e-12) if warm else 0.0
+        tripped = warm and z > self.zscore
+        if not tripped:
+            d = min(self.ema_decay, self._count / (self._count + 1.0))
+            self._ema = d * self._ema + (1 - d) * wall_s
+            self._mad = 0.0 if self._count == 0 else d * self._mad + (1 - d) * dev
+            self._count += 1
+        return tripped, z
+
+
+def _default_start_trace(trace_dir: str):
+    import jax
+
+    jax.profiler.start_trace(trace_dir)
+
+
+def _default_stop_trace():
+    import jax
+
+    jax.profiler.stop_trace()
+
+
+class ProfileManager:
+    """Step-aligned trace capture with triggers, budget, and attribution.
+
+    ``output_dir`` roots triggered captures (each gets its own subdirectory);
+    ``steps`` is the explicit-range grammar (string or ``[(a, b), ...]``);
+    ``slow_zscore`` > 0 arms the slow-step trigger (capturing
+    ``slow_capture_steps`` subsequent steps); ``max_captures`` is the
+    per-run budget every trigger path shares. ``start_trace`` / ``stop_trace``
+    / ``clock`` are injectable for deterministic tests.
+    """
+
+    def __init__(
+        self,
+        output_dir: str | None = None,
+        steps=None,
+        slow_zscore: float = 0.0,
+        slow_capture_steps: int = DEFAULT_SLOW_CAPTURE_STEPS,
+        slow_warmup_steps: int = 20,
+        max_captures: int = DEFAULT_MAX_CAPTURES,
+        registry=None,
+        start_trace=None,
+        stop_trace=None,
+    ):
+        from ..utils.constants import MITA_PROFILE_DIR
+        from .metrics import get_registry
+
+        self.output_dir = output_dir or MITA_PROFILE_DIR
+        self._ranges = parse_profile_steps(steps)
+        self._armed_steps = ",".join(
+            f"{a}-{b}" if b != a else str(a) for a, b in self._ranges
+        )
+        self.slow_zscore = float(slow_zscore or 0.0)
+        self.slow_capture_steps = max(int(slow_capture_steps), 1)
+        self._slow = (
+            SlowStepDetector(self.slow_zscore, warmup_steps=slow_warmup_steps)
+            if self.slow_zscore > 0
+            else None
+        )
+        self.max_captures = int(max_captures)
+        self._budget = self.max_captures
+        self._registry = registry if registry is not None else get_registry()
+        self._captures_total = self._registry.counter(
+            "accelerate_profile_captures_total",
+            "Trace captures engaged, by trigger",
+            labelnames=("trigger",),
+        )
+        self._start_trace = start_trace or _default_start_trace
+        self._stop_trace = stop_trace or _default_stop_trace
+        self._step = 0
+        self._pending = None   # (n_steps, trigger) requested capture
+        self._active = None    # dict while a capture is running
+        self.captures: list = []
+
+    # ------------------------------------------------------------- inspection
+    @property
+    def capturing(self) -> bool:
+        return self._active is not None
+
+    @property
+    def budget_remaining(self) -> int:
+        return self._budget
+
+    def engaged(self) -> bool:
+        """Whether any capture ran (or is running) this run — gates the
+        ``profile`` key on timeline summaries and bench lines."""
+        return bool(self.captures) or self._active is not None
+
+    def summary(self) -> dict:
+        out = {
+            "captures": [dict(c) for c in self.captures],
+            "capturing": self._active is not None,
+            "budget_remaining": self._budget,
+            "armed": {
+                "steps": self._armed_steps or None,
+                "slow_zscore": self.slow_zscore or None,
+            },
+        }
+        return out
+
+    # --------------------------------------------------------------- triggers
+    def request_capture(self, steps: int = 1, trigger: str = "http") -> dict:
+        """Arm a capture of the next ``steps`` step boundaries (the metrics
+        server's POST /profile and the straggler trip route here). Returns a
+        status dict the HTTP handler can serialize."""
+        steps = max(int(steps), 1)
+        if self._budget <= 0:
+            return {"accepted": False, "reason": "capture budget exhausted"}
+        if self._active is not None or self._pending is not None:
+            return {"accepted": False, "reason": "a capture is already engaged"}
+        self._pending = (steps, str(trigger))
+        from .flight import record_event
+
+        record_event("profile_request", step=self._step, trigger=trigger, steps=steps)
+        return {"accepted": True, "steps": steps, "trigger": str(trigger)}
+
+    def step_boundary(self, step=None, wall_s=None, steps: int = 1):
+        """One completed step (or K-step window) boundary — the per-step feed
+        Telemetry drives. ``step`` (when the loop's hooks provide it) pins the
+        numbering explicit ranges refer to; fused loops without hooks count
+        boundaries instead. Decides capture start/stop; costs a few compares
+        when nothing is armed."""
+        steps = max(int(steps), 1)
+        prev = self._step
+        s = int(step) if step is not None else prev + steps
+        self._step = s
+        just_finished = False
+        if self._active is not None:
+            until = self._active["until"]
+            if until is None or s < until:
+                return
+            self._finish_capture()
+            # Fall through: a back-to-back range (e.g. "3-4,5-6") may be due
+            # at this very boundary — returning here would silently lose the
+            # second range's first step.
+            just_finished = True
+        trigger = until = None
+        if self._ranges:
+            a, b = self._ranges[0]
+            if prev >= b or s >= b:
+                self._ranges.pop(0)
+                if a > s - steps and prev < b:
+                    # The range fell inside this very boundary's window (a
+                    # first K-step window — or the very first step — swallowed
+                    # it): those steps already ran untraced, and a capture can
+                    # only engage at a completed boundary. Capture the next
+                    # window as the closest available evidence, and say so —
+                    # a silently shrunk range reads as a wrong-step trace.
+                    logger.warning(
+                        f"profile range {a}-{b}: step(s) through {s} completed "
+                        "before the profiler could engage (captures start at "
+                        f"step boundaries); capturing {s + 1}-{s + steps} "
+                        "instead."
+                    )
+                    trigger, until = "steps", s + steps
+                else:
+                    # Wholly in the past (a resume landed beyond it) — it can
+                    # never be captured.
+                    logger.warning(
+                        f"profile range {a}-{b} dropped: the run is already at "
+                        f"step {s}."
+                    )
+            elif s >= a - steps:
+                # The next boundary (assumed to cover ~`steps` steps, like
+                # this one) reaches into [a, b]: start now so the capture is
+                # aligned to whole windows and covers the range.
+                self._ranges.pop(0)
+                trigger, until = "steps", b
+                if a <= s:
+                    # The range's head already ran (a range starting at step 1
+                    # can never be fully honored — captures engage at
+                    # completed boundaries): shrink loudly, never silently.
+                    logger.warning(
+                        f"profile range {a}-{b}: step(s) {a}-{s} completed "
+                        "before the profiler could engage (captures start at "
+                        f"step boundaries); capturing {s + 1}-{b} only."
+                    )
+        if trigger is None and self._pending is not None:
+            n, t = self._pending
+            self._pending = None
+            trigger, until = t, s + n
+        if (trigger is None and not just_finished
+                and self._slow is not None and wall_s is not None):
+            # just_finished boundaries are excluded from the slow baseline:
+            # their wall time carries the tracing overhead of the capture
+            # that just ended and would poison (or spuriously re-trip) it.
+            tripped, z = self._slow.observe(wall_s)
+            if tripped:
+                logger.warning(
+                    f"slow-step trigger: step {s} took {wall_s * 1e3:.1f}ms "
+                    f"(robust z={z:.1f} > {self.slow_zscore:g}); capturing the "
+                    f"next {self.slow_capture_steps} step(s)."
+                )
+                trigger, until = "slow_step", s + self.slow_capture_steps
+        if trigger is not None:
+            self._begin_capture(trigger, until=until)
+
+    def sync_step(self, step):
+        """Pin the loop's step numbering WITHOUT marking a boundary — the
+        per-step hooks call this when the fused program already fed the
+        boundary, so explicit ranges track real step numbers (resumes jump
+        the count) while each boundary is still counted exactly once."""
+        self._step = int(step)
+
+    # ---------------------------------------------------------------- capture
+    def _book_overhead(self, seconds: float):
+        from ..resilience.goodput import get_ledger
+
+        try:
+            get_ledger().add("profile", seconds)
+        except Exception:
+            pass  # accounting must not break capture
+
+    def _begin_capture(self, trigger: str, until, trace_dir: str | None = None,
+                       budgeted: bool = True) -> bool:
+        """Start a capture; returns whether one actually engaged. ``budgeted``
+        is False for manual captures — the user asked explicitly, so the
+        triggered-capture budget neither refuses nor pays for it."""
+        from .flight import get_flight_recorder
+
+        if self._active is not None:
+            # jax has one global trace; a second start would raise and (worse)
+            # a paired stop would cut the running capture short mid-range.
+            logger.warning(
+                f"profile trigger {trigger!r} ignored: a capture is already "
+                "engaged."
+            )
+            return False
+        if budgeted and self._budget <= 0:
+            logger.log_every_n(
+                20, logging.WARNING,
+                f"profile trigger {trigger!r} ignored: the max-captures-per-run "
+                f"budget ({self.max_captures}) is spent.",
+            )
+            return False
+        first_step = self._step + 1
+        if trace_dir is None:
+            tail = f"until{until}" if until is not None else "manual"
+            trace_dir = os.path.join(
+                self.output_dir,
+                f"capture{len(self.captures) + 1:02d}_step{first_step}_{trigger}_{tail}",
+            )
+        t0 = time.perf_counter()
+        try:
+            self._start_trace(trace_dir)
+        except Exception as exc:
+            # Budget untouched: a failed start produced no capture, and the
+            # trigger that asked already consumed itself (range popped,
+            # request cleared) — no retry storm to guard against.
+            self._book_overhead(time.perf_counter() - t0)
+            logger.error(f"profile capture ({trigger}) could not start: {exc!r}")
+            return False
+        self._book_overhead(time.perf_counter() - t0)
+        if budgeted:
+            self._budget -= 1
+        self._captures_total.inc(trigger=trigger)
+        self._active = {
+            "trigger": trigger,
+            "trace_dir": trace_dir,
+            "first_step": first_step,
+            "until": until,
+        }
+        get_flight_recorder().record(
+            "profile_start", step=self._step, trigger=trigger,
+            trace_dir=trace_dir, until=until,
+        )
+        logger.warning(
+            f"profile capture engaged ({trigger}): tracing from step "
+            f"{first_step}"
+            + (f" through {until}" if until is not None else "")
+            + f" into {trace_dir}"
+        )
+        return True
+
+    def _finish_capture(self) -> dict | None:
+        from .flight import get_flight_recorder
+
+        active, self._active = self._active, None
+        if active is None:
+            return None
+        t0 = time.perf_counter()
+        try:
+            self._stop_trace()
+        except Exception as exc:
+            logger.error(f"profile capture could not stop cleanly: {exc!r}")
+        stop_s = time.perf_counter() - t0
+        t1 = time.perf_counter()
+        report = None
+        try:
+            from .traceview import report_capture
+
+            report = report_capture(active["trace_dir"])
+        except Exception as exc:
+            logger.warning(
+                f"captured trace in {active['trace_dir']} could not be parsed "
+                f"({type(exc).__name__}: {exc}); the raw trace is kept — "
+                "`accelerate-tpu profile report` can retry."
+            )
+        parse_s = time.perf_counter() - t1
+        self._book_overhead(stop_s + parse_s)
+        record = {
+            "trigger": active["trigger"],
+            "trace_dir": active["trace_dir"],
+            "first_step": active["first_step"],
+            "last_step": self._step,
+            "overhead_s": round(stop_s + parse_s, 4),
+        }
+        if report is not None:
+            record["report"] = report
+        self.captures.append(record)
+        get_flight_recorder().record(
+            "profile_stop", step=self._step, trigger=active["trigger"],
+            trace_dir=active["trace_dir"],
+        )
+        return record
+
+    @contextlib.contextmanager
+    def manual_capture(self, trace_dir: str | None = None):
+        """Capture exactly the wrapped block (``Accelerator.profile`` builds
+        on this): same badput booking, flight events, and attribution parse
+        as triggered captures, with the covered step range recorded from the
+        boundaries observed while the block ran. Exempt from the triggered
+        budget (the user asked explicitly) but NOT from exclusivity: while a
+        triggered capture is running the block yields None and runs untraced
+        rather than hijacking the capture in flight."""
+        engaged = self._begin_capture(
+            "manual", until=None, trace_dir=trace_dir, budgeted=False
+        )
+        try:
+            yield self._active["trace_dir"] if engaged else None
+        finally:
+            if engaged:
+                self._finish_capture()
+
+
+# ------------------------------------------------------ process-wide default
+_MANAGER: ProfileManager | None = None
+
+
+def _install(manager: ProfileManager) -> ProfileManager:
+    """Make ``manager`` the default and point the metrics server's
+    POST /profile hook at it."""
+    global _MANAGER
+    _MANAGER = manager
+    from .metrics import set_profile_trigger
+
+    set_profile_trigger(manager.request_capture)
+    return manager
+
+
+def get_profile_manager() -> ProfileManager:
+    """The process-wide manager, built from the launcher's env contract on
+    first use (ACCELERATE_PROFILE_STEPS / ACCELERATE_PROFILE_SLOW_ZSCORE /
+    ACCELERATE_PROFILE_DIR / ACCELERATE_PROFILE_MAX_CAPTURES)."""
+    if _MANAGER is not None:
+        return _MANAGER
+    from ..utils.constants import (
+        ENV_PROFILE_DIR,
+        ENV_PROFILE_MAX_CAPTURES,
+        ENV_PROFILE_SLOW_ZSCORE,
+        ENV_PROFILE_STEPS,
+    )
+
+    zscore_raw = os.environ.get(ENV_PROFILE_SLOW_ZSCORE, "").strip()
+    budget_raw = os.environ.get(ENV_PROFILE_MAX_CAPTURES, "").strip()
+    return _install(ProfileManager(
+        output_dir=os.environ.get(ENV_PROFILE_DIR, "").strip() or None,
+        steps=os.environ.get(ENV_PROFILE_STEPS, ""),
+        slow_zscore=float(zscore_raw) if zscore_raw else 0.0,
+        max_captures=int(budget_raw) if budget_raw else DEFAULT_MAX_CAPTURES,
+    ))
+
+
+def set_profile_manager(manager: ProfileManager | None):
+    """Install an explicitly-built manager (tests, notebooks)."""
+    global _MANAGER
+    if manager is None:
+        _MANAGER = None
+        from .metrics import set_profile_trigger
+
+        set_profile_trigger(None)
+    else:
+        _install(manager)
+
+
+def reset_profile_manager():
+    """Drop the default manager — tests (an in-flight capture is stopped so a
+    dangling jax trace cannot leak into the next test)."""
+    global _MANAGER
+    if _MANAGER is not None and _MANAGER.capturing:
+        try:
+            _MANAGER._finish_capture()
+        except Exception:
+            pass
+    set_profile_manager(None)
+
+
+def default_manager_summary() -> dict | None:
+    """The default manager's summary IF one exists and a capture engaged —
+    what ``StepTimeline.summary()`` folds in as ``profile`` (absent when
+    profiling never ran, so un-profiled summaries don't grow a key)."""
+    if _MANAGER is not None and _MANAGER.engaged():
+        return _MANAGER.summary()
+    return None

@@ -305,6 +305,13 @@ class StepTimeline:
             "xla_preset": active_preset(),
             "memory": device_memory_stats(),
         }
+        # Profiling (telemetry/profiler.py): present only when a trace capture
+        # engaged this run — un-profiled summaries keep their schema.
+        from .profiler import default_manager_summary
+
+        profile = default_manager_summary()
+        if profile is not None:
+            out["profile"] = profile
         return out
 
     def reset(self):

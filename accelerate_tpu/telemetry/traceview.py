@@ -1,0 +1,358 @@
+"""Trace attribution — where did the captured step time actually go?
+
+Parses a captured XLA trace (the ``*.trace.json.gz`` Chrome-trace-format file
+``jax.profiler`` writes under ``<dir>/plugins/profile/<ts>/``) into a
+per-step attribution report:
+
+- **compute** — device/executor op events (rows carrying ``args.hlo_op``, or
+  rows on a ``/device:*`` process) that are not collectives;
+- **collective** — op events whose HLO op is an all-reduce / all-gather /
+  reduce-scatter / collective-permute / all-to-all (async ``-start``/``-done``
+  halves merge into one interval), attributed to named mesh axes by joining
+  the op *kind* against the program auditor's collective inventory
+  (:func:`collective_axes_from_audit` — ``Accelerator.audit`` attaches it
+  automatically);
+- **host/infeed** — infeed/outfeed/transfer events (the host feeding or
+  draining the device);
+- **idle** — window time covered by none of the above.
+
+The reported ``fractions`` are *disjoint* — overlap is resolved toward
+compute, so ``compute + collective + host + idle == 1`` by construction (the
+acceptance bar) — while ``overlap_fraction`` separately reports how much of
+the raw collective time was hidden under compute: the measured
+compute↔collective overlap the ``xla_flags.py`` latency presets exist to
+maximize. Step boundaries come from the framework's own
+``train_step``/``train_window`` trace annotations (telemetry/spans.py), so a
+multi-step capture also yields a per-step table.
+
+Surfaces: ``accelerate-tpu profile report <dir>``, the ``profile`` key in
+``StepTimeline.summary()``, and ``detail.profile`` on bench.py JSON lines.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import re
+from dataclasses import dataclass, field
+
+TRACEVIEW_SCHEMA_VERSION = 1
+
+_COLLECTIVE_RE = re.compile(
+    r"\b(all-reduce|all-gather|reduce-scatter|collective-permute|all-to-all)"
+)
+_HOST_RE = re.compile(
+    r"infeed|outfeed|transfer(?:to|from|buffer)?|h2d|d2h|copy[-_ ]?(?:start|done)",
+    re.IGNORECASE,
+)
+# Step-boundary annotations the framework's fused builders emit (spans.py
+# enters a jax.profiler.TraceAnnotation of the same name).
+STEP_SPAN_NAMES = ("train_step", "train_window")
+
+TOP_OPS = 10
+
+
+# ------------------------------------------------------------------ loading
+def find_trace_file(root: str) -> str:
+    """Newest ``*.trace.json.gz`` under ``root`` (a capture dir, the
+    ``plugins/profile/<ts>`` dir itself, or a direct file path)."""
+    if os.path.isfile(root):
+        return root
+    candidates = sorted(
+        glob.glob(os.path.join(root, "**", "*.trace.json.gz"), recursive=True),
+        key=os.path.getmtime,
+    )
+    if not candidates:
+        raise FileNotFoundError(
+            f"no *.trace.json.gz under {root!r} — is this a capture directory "
+            "written by jax.profiler (plugins/profile/<timestamp>/...)?"
+        )
+    return candidates[-1]
+
+
+def load_trace_events(path: str) -> list:
+    """The raw Chrome-trace event list from a ``.json``/``.json.gz`` file."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as fh:
+        data = json.load(fh)
+    events = data.get("traceEvents", data) if isinstance(data, dict) else data
+    if not isinstance(events, list):
+        raise ValueError(f"{path!r} is not a Chrome-trace file")
+    return events
+
+
+# ---------------------------------------------------------------- intervals
+def _merge(intervals: list) -> list:
+    """Overlapping/adjacent [start, end) intervals → disjoint sorted list."""
+    merged = []
+    for start, end in sorted(intervals):
+        if merged and start <= merged[-1][1]:
+            if end > merged[-1][1]:
+                merged[-1][1] = end
+        else:
+            merged.append([start, end])
+    return merged
+
+
+def _total(merged: list) -> float:
+    return sum(end - start for start, end in merged)
+
+
+def _intersect(a: list, b: list) -> list:
+    """Intersection of two DISJOINT-SORTED interval lists."""
+    out, i, j = [], 0, 0
+    while i < len(a) and j < len(b):
+        start = max(a[i][0], b[j][0])
+        end = min(a[i][1], b[j][1])
+        if start < end:
+            out.append([start, end])
+        if a[i][1] <= b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return out
+
+
+def _clip(merged: list, lo: float, hi: float) -> list:
+    return [
+        [max(start, lo), min(end, hi)]
+        for start, end in merged
+        if min(end, hi) > max(start, lo)
+    ]
+
+
+# ------------------------------------------------------------------- report
+@dataclass
+class AttributionReport:
+    """One analyzed window (whole capture or one step). Times in seconds."""
+
+    wall_s: float = 0.0
+    compute_s: float = 0.0
+    collective_s: float = 0.0          # raw (overlapped or not)
+    collective_exposed_s: float = 0.0  # not hidden under compute
+    overlap_s: float = 0.0             # collective ∩ compute
+    host_s: float = 0.0                # raw host/infeed time
+    host_exposed_s: float = 0.0        # not hidden under device work
+    idle_s: float = 0.0
+    steps: list = field(default_factory=list)     # per-step sub-reports
+    top_ops: list = field(default_factory=list)   # [{name, kind, total_s, count}]
+    by_axis: dict = field(default_factory=dict)   # {axis: collective seconds}
+    trace_path: str = ""
+
+    @property
+    def fractions(self) -> dict:
+        """Disjoint attribution; sums to 1 by construction (idle is the
+        remainder after compute / exposed-collective / exposed-host)."""
+        wall = self.wall_s or 1e-12
+        compute = self.compute_s / wall
+        collective = self.collective_exposed_s / wall
+        host = self.host_exposed_s / wall
+        return {
+            "compute": round(compute, 4),
+            "collective": round(collective, 4),
+            "host": round(host, 4),
+            "idle": round(max(1.0 - compute - collective - host, 0.0), 4),
+        }
+
+    @property
+    def overlap_fraction(self) -> float | None:
+        """Measured compute↔collective overlap: what fraction of raw
+        collective time was hidden under compute. None without collectives."""
+        if self.collective_s <= 0:
+            return None
+        return round(self.overlap_s / self.collective_s, 4)
+
+    def to_dict(self, with_steps: bool = True) -> dict:
+        out = {
+            "schema_version": TRACEVIEW_SCHEMA_VERSION,
+            "wall_s": round(self.wall_s, 6),
+            "fractions": self.fractions,
+            "overlap_fraction": self.overlap_fraction,
+            "compute_s": round(self.compute_s, 6),
+            "collective_s": round(self.collective_s, 6),
+            "collective_exposed_s": round(self.collective_exposed_s, 6),
+            "overlap_s": round(self.overlap_s, 6),
+            "host_s": round(self.host_s, 6),
+            "idle_s": round(self.idle_s, 6),
+            "top_ops": list(self.top_ops),
+            "by_axis": dict(self.by_axis),
+        }
+        if self.trace_path:
+            out["trace_path"] = self.trace_path
+        if with_steps and self.steps:
+            out["steps"] = [s.to_dict(with_steps=False) for s in self.steps]
+            out["n_steps"] = len(self.steps)
+        return out
+
+
+class _Classified:
+    """Events bucketed once; windows then attribute by interval arithmetic."""
+
+    def __init__(self, events: list):
+        pid_names, tid_names = {}, {}
+        for e in events:
+            if e.get("ph") == "M":
+                if e.get("name") == "process_name":
+                    pid_names[e.get("pid")] = e.get("args", {}).get("name", "")
+                elif e.get("name") == "thread_name":
+                    tid_names[(e.get("pid"), e.get("tid"))] = (
+                        e.get("args", {}).get("name", "")
+                    )
+        self.compute: list = []
+        self.collective: list = []
+        self.host: list = []
+        self.step_events: list = []
+        self.op_events: list = []  # (start, end, label, kind) — kept per-event
+        # so top_ops/by_axis can be clipped to the SAME window the headline
+        # fractions use; whole-trace aggregates next to windowed fractions
+        # would disagree with each other.
+        lo, hi = None, None
+        for e in events:
+            if e.get("ph") != "X" or "ts" not in e or "dur" not in e:
+                continue
+            start = float(e["ts"]) * 1e-6
+            end = start + float(e["dur"]) * 1e-6
+            name = str(e.get("name", ""))
+            args = e.get("args") or {}
+            lo = start if lo is None else min(lo, start)
+            hi = end if hi is None else max(hi, end)
+            base = name.split("/")[-1]
+            if base in STEP_SPAN_NAMES or name in STEP_SPAN_NAMES:
+                self.step_events.append((start, end, name))
+                continue
+            op = str(args.get("hlo_op", "")) or None
+            on_device = pid_names.get(e.get("pid"), "").startswith("/device:")
+            if on_device and "module" in tid_names.get(
+                (e.get("pid"), e.get("tid")), ""
+            ).lower():
+                # Whole-module rows span every op in the dispatch; counting
+                # them alongside the per-op rows would double the busy time.
+                continue
+            if op is not None or on_device:
+                label = op or name
+                m = _COLLECTIVE_RE.search(label) or _COLLECTIVE_RE.search(name)
+                kind = m.group(1) if m else "compute"
+                self.op_events.append((start, end, label, kind))
+                if m:
+                    self.collective.append([start, end])
+                else:
+                    self.compute.append([start, end])
+            elif _HOST_RE.search(name):
+                self.host.append([start, end])
+        self.bounds = (lo or 0.0, hi or 0.0)
+        self.compute = _merge(self.compute)
+        self.collective = _merge(self.collective)
+        self.host = _merge(self.host)
+
+    def window(self, lo: float, hi: float) -> AttributionReport:
+        wall = max(hi - lo, 1e-12)
+        compute = _clip(self.compute, lo, hi)
+        collective = _clip(self.collective, lo, hi)
+        host = _clip(self.host, lo, hi)
+        overlap = _total(_intersect(compute, collective))
+        device = _merge([list(x) for x in compute + collective])
+        host_exposed = _total(host) - _total(_intersect(host, device))
+        busy = _merge([list(x) for x in device + host])
+        report = AttributionReport(
+            wall_s=wall,
+            compute_s=_total(compute),
+            collective_s=_total(collective),
+            collective_exposed_s=_total(collective) - overlap,
+            overlap_s=overlap,
+            host_s=_total(host),
+            host_exposed_s=host_exposed,
+            idle_s=max(wall - _total(busy), 0.0),
+        )
+        return report
+
+
+def attribute_events(events: list, collective_axes: dict | None = None) -> AttributionReport:
+    """Full attribution over a raw Chrome-trace event list; see module doc."""
+    classified = _Classified(events)
+    if classified.step_events:
+        steps = sorted(classified.step_events)
+        lo, hi = steps[0][0], max(end for _, end, _ in steps)
+        report = classified.window(lo, hi)
+        report.steps = [classified.window(s, e) for s, e, _ in steps]
+    else:
+        lo, hi = classified.bounds
+        report = classified.window(lo, hi)
+    # top_ops and by_axis clip to the SAME [lo, hi] window as the fractions —
+    # a manual capture spanning pre-step work must not list ops (or axis
+    # seconds) that contributed nothing to the attributed window.
+    axes_map = collective_axes if collective_axes is not None else _ATTACHED_AXES
+    op_durations: dict = {}
+    by_axis: dict = {}
+    for start, end, label, kind in classified.op_events:
+        clipped = min(end, hi) - max(start, lo)
+        if clipped <= 0:
+            continue
+        entry = op_durations.setdefault(
+            label, {"total_s": 0.0, "count": 0, "kind": kind}
+        )
+        entry["total_s"] += clipped
+        entry["count"] += 1
+        if kind != "compute" and axes_map:
+            for axis in axes_map.get(kind, ()):  # kind-level join (audit.py)
+                by_axis[axis] = by_axis.get(axis, 0.0) + clipped
+    report.top_ops = [
+        {
+            "name": name,
+            "kind": entry["kind"],
+            "total_s": round(entry["total_s"], 6),
+            "count": entry["count"],
+        }
+        for name, entry in sorted(
+            op_durations.items(), key=lambda kv: kv[1]["total_s"], reverse=True,
+        )[:TOP_OPS]
+    ]
+    if axes_map:
+        report.by_axis = {a: round(s, 6) for a, s in sorted(by_axis.items())}
+    return report
+
+
+def report_capture(trace_dir: str, collective_axes: dict | None = None) -> dict:
+    """Locate + parse + attribute one capture directory → report dict (the
+    schema docs/observability.md documents)."""
+    path = find_trace_file(trace_dir)
+    report = attribute_events(load_trace_events(path), collective_axes)
+    report.trace_path = path
+    return report.to_dict()
+
+
+# ------------------------------------------------------------- audit join
+# Kind → mesh-axes mapping attached by the last program audit, so triggered
+# captures (which never see an AuditReport) still attribute collectives to
+# named axes. Kind-level: the trace's op instances can't be matched back to
+# individual HLO sites, so each kind maps to the union of axes its audited
+# sites vary along.
+_ATTACHED_AXES: dict = {}
+
+
+def collective_axes_from_audit(audit_report) -> dict:
+    """``AuditReport`` (or its ``to_dict()``) → {collective kind: [axes]}."""
+    sites = getattr(audit_report, "collectives", None)
+    if sites is None and isinstance(audit_report, dict):
+        sites = audit_report.get("collectives", {}).get("sites", [])
+    mapping: dict = {}
+    for site in sites or []:
+        op = site.op if hasattr(site, "op") else site.get("op")
+        axes = site.axes if hasattr(site, "axes") else site.get("axes", ())
+        mapping.setdefault(op, set()).update(axes)
+    return {op: sorted(axes) for op, axes in mapping.items()}
+
+
+def attach_collective_axes(mapping_or_audit):
+    """Install the axis join used by captures without an explicit mapping
+    (``Accelerator.audit`` calls this with every report it builds)."""
+    global _ATTACHED_AXES
+    if mapping_or_audit is None:
+        _ATTACHED_AXES = {}
+        return
+    if hasattr(mapping_or_audit, "collectives") or (
+        isinstance(mapping_or_audit, dict) and "collectives" in mapping_or_audit
+    ):
+        mapping_or_audit = collective_axes_from_audit(mapping_or_audit)
+    _ATTACHED_AXES = dict(mapping_or_audit)

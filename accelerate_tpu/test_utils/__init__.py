@@ -1,1 +1,2 @@
+from .drills import run_nonblocking_drill
 from .training import RegressionDataset, RegressionModel, regression_batches

@@ -1,0 +1,45 @@
+"""Shared test drills — the load-tolerant spelling of wall-clock-sensitive
+acceptance asserts.
+
+The hot-loop acceptance bars pin ``transfer_stats()["blocking"] == 0``: the
+dispatching thread never stalled on a device→host fetch. Whether a counted
+fetch *blocks* depends on whether the device had finished by the time the
+host asked — which is wall-clock, not logic: on a loaded CI machine a drill
+that is perfectly async in its design can still catch one in-flight array
+(the PR 5/6 ``test_guarded_telemetry_loop`` / ``test_window_retains_losses``
+flakes). Retrying distinguishes the two failure modes: load-induced stalls
+are transient and vanish on a re-run, while a genuinely regressed hot path
+(an added ``float(loss)``, a dropped retained-loss drain) blocks
+*deterministically* and fails every attempt.
+"""
+
+from __future__ import annotations
+
+DEFAULT_ATTEMPTS = 3
+
+
+def run_nonblocking_drill(drill, attempts: int = DEFAULT_ATTEMPTS,
+                          keys: tuple = ("blocking", "h2d_blocking")):
+    """Run ``drill()`` until its transfer-stats snapshot shows zero blocking
+    transfers, retrying up to ``attempts`` times.
+
+    ``drill`` must be self-contained — build its own training state, reset
+    the transfer counters, run its loop, and return the
+    ``transfer_stats()`` snapshot to judge (it may stash other objects for
+    the caller's follow-up asserts). ``keys`` are the snapshot entries that
+    must be zero. Returns the passing snapshot; raises ``AssertionError``
+    after ``attempts`` consecutive blocking runs — that is a real
+    regression, not scheduler jitter.
+    """
+    if attempts < 1:
+        raise ValueError(f"attempts must be >= 1, got {attempts}")
+    last = None
+    for _ in range(attempts):
+        last = drill()
+        if all(last.get(k, 0) == 0 for k in keys):
+            return last
+    raise AssertionError(
+        f"hot loop blocked on a device transfer in {attempts}/{attempts} "
+        f"attempts ({ {k: last.get(k, 0) for k in keys} }): deterministic — "
+        "a retained value is being fetched before it materializes"
+    )

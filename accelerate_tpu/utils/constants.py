@@ -69,6 +69,17 @@ ENV_HANG_TIMEOUT = "ACCELERATE_HANG_TIMEOUT"
 ENV_TELEMETRY = "ACCELERATE_TELEMETRY"
 ENV_METRICS_PORT = "ACCELERATE_METRICS_PORT"
 ENV_STRAGGLER_THRESHOLD = "ACCELERATE_STRAGGLER_THRESHOLD"
+# Profiling & flight recorder (telemetry/profiler.py / flight.py;
+# docs/observability.md "Profiling"): explicit capture step ranges
+# ("10-12,50" — 1-based, inclusive), the slow-step robust z-score trigger
+# (tri-state like telemetry: unset = library default off, an explicit 0
+# disables), the capture output root, the max-captures-per-run budget, and
+# where flight-recorder black-box dumps land.
+ENV_PROFILE_STEPS = "ACCELERATE_PROFILE_STEPS"
+ENV_PROFILE_SLOW_ZSCORE = "ACCELERATE_PROFILE_SLOW_ZSCORE"
+ENV_PROFILE_DIR = "ACCELERATE_PROFILE_DIR"
+ENV_PROFILE_MAX_CAPTURES = "ACCELERATE_PROFILE_MAX_CAPTURES"
+ENV_FLIGHT_DIR = "ACCELERATE_FLIGHT_DIR"
 # Dispatch amortization (docs/performance.md "Dispatch amortization"): the
 # default K for Accelerator.build_train_window (1 = one dispatch per step),
 # and the curated XLA latency-hiding flag preset installed into

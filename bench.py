@@ -30,7 +30,11 @@ import numpy as np
 # v3 = detail.audit program-audit summary (collectives per mesh axis,
 # donation aliasing, host callbacks) on every line; a dp-axis all-gather in
 # the audited program fails the config's line outright.
-BENCH_SCHEMA_VERSION = 3
+# v4 = detail.profile (telemetry/profiler.py): when a trace capture engaged
+# during a config (ACCELERATE_PROFILE_STEPS et al.), its parsed attribution
+# report — compute/collective/host/idle fractions and the measured
+# compute<->collective overlap — rides the line; absent otherwise.
+BENCH_SCHEMA_VERSION = 4
 
 
 class BenchAuditFailure(RuntimeError):
@@ -500,6 +504,15 @@ def run_one(mode: str):
                     "health": {"finite_final_loss": finite_loss},
                     "telemetry": telemetry_summary,
                     "audit": audit_summary,
+                    # Profiling (telemetry/profiler.py): present only when a
+                    # trace capture engaged during this config — the capture
+                    # list with each parsed attribution report (compute /
+                    # collective / host / idle fractions + overlap).
+                    **(
+                        {"profile": telemetry_summary["profile"]}
+                        if "profile" in telemetry_summary
+                        else {}
+                    ),
                     **(
                         {"compile_cache": os.environ["ACCELERATE_COMPILE_CACHE_DIR"]}
                         if os.environ.get("ACCELERATE_COMPILE_CACHE_DIR")

@@ -30,16 +30,29 @@ if "ACCELERATE_COMPILE_CACHE_DIR" not in os.environ:
     _owned_cache_dir = tempfile.mkdtemp(prefix="at_test_xla_cache_")
     os.environ["ACCELERATE_COMPILE_CACHE_DIR"] = _owned_cache_dir
 
+# Flight-recorder dumps (telemetry/flight.py) default to ./flight_recorder;
+# tests that trip guards / restart / hang would litter the repo — route the
+# whole session's black boxes into a disposable dir instead. Tests that
+# assert on dump contents override the var themselves.
+_owned_flight_dir = None
+if "ACCELERATE_FLIGHT_DIR" not in os.environ:
+    import tempfile
+
+    _owned_flight_dir = tempfile.mkdtemp(prefix="at_test_flight_")
+    os.environ["ACCELERATE_FLIGHT_DIR"] = _owned_flight_dir
+
 import pytest  # noqa: E402
 
 
 @pytest.fixture(scope="session", autouse=True)
 def _cleanup_session_compile_cache():
     yield
-    if _owned_cache_dir is not None:
-        import shutil
+    import shutil
 
+    if _owned_cache_dir is not None:
         shutil.rmtree(_owned_cache_dir, ignore_errors=True)
+    if _owned_flight_dir is not None:
+        shutil.rmtree(_owned_flight_dir, ignore_errors=True)
 
 
 @pytest.fixture(autouse=True)
@@ -60,6 +73,20 @@ def _reset_singletons():
 # from random.random — every test starts from the same host-RNG state so fault
 # drills are reproducible run-over-run.
 os.environ.setdefault("ACCELERATE_SEED", "0")
+
+
+@pytest.fixture(autouse=True)
+def _reset_forensics():
+    """Profiler + flight recorder are process-wide by design; an armed
+    capture or a populated event ring must never leak across tests."""
+    yield
+    from accelerate_tpu.telemetry.flight import reset_flight_recorder
+    from accelerate_tpu.telemetry.profiler import reset_profile_manager
+    from accelerate_tpu.telemetry.traceview import attach_collective_axes
+
+    reset_profile_manager()
+    reset_flight_recorder()
+    attach_collective_axes(None)  # Accelerator.audit attaches a module global
 
 
 @pytest.fixture(autouse=True)

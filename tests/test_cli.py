@@ -267,6 +267,8 @@ def test_config_wizard_roundtrips_through_launch(tmp_path):
         "yes",               # always-on telemetry
         "0",                 # metrics port (0 = no HTTP endpoint)
         "1.8",               # straggler alert ratio
+        "10-12",             # XLA trace capture step ranges
+        "5.5",               # slow-step trace trigger z-score
         "yes",               # configure dispatch amortization?
         "4",                 # train window K
         "latency",           # xla latency-hiding preset
@@ -286,6 +288,7 @@ def test_config_wizard_roundtrips_through_launch(tmp_path):
     assert cfg.guard_numerics and cfg.spike_zscore == 7.0 and cfg.hang_timeout == 240.0
     assert cfg.telemetry is True and cfg.metrics_port == 0
     assert cfg.straggler_threshold == 1.8
+    assert cfg.profile_steps == "10-12" and cfg.profile_slow_zscore == 5.5
     assert cfg.train_window == 4 and cfg.xla_preset == "latency"
     assert cfg.compile_cache_dir == str(tmp_path / "xla_cache")
     config_path = tmp_path / "cfg.yaml"

@@ -1,0 +1,788 @@
+"""Profiling & flight-recorder tests (ISSUE 8 acceptance: a triggered capture
+on the CPU rig yields an attribution report whose compute/collective/idle/host
+fractions sum to 1±0.02; a loop with profiling armed but not capturing adds
+ZERO blocking device→host transfers; the hang drill produces a flight-recorder
+dump whose last events name the injected fault, rendered by
+`accelerate-tpu blackbox`).
+
+All deterministic and CPU-fast: trigger logic runs against injected fake
+tracers, the parser against a synthetic golden trace.json.gz, and the two
+real-trace tests capture a few tiny steps each."""
+
+import glob
+import gzip
+import json
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.resilience.goodput import get_ledger
+from accelerate_tpu.telemetry import (
+    FlightRecorder,
+    MetricsRegistry,
+    MetricsServer,
+    ProfileManager,
+    SlowStepDetector,
+    Telemetry,
+    parse_profile_steps,
+    reset_telemetry,
+    set_profile_manager,
+)
+from accelerate_tpu.test_utils import RegressionModel, run_nonblocking_drill
+from accelerate_tpu.utils.transfer import reset_transfer_stats, transfer_stats
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.profiling
+
+
+@pytest.fixture(autouse=True)
+def _fresh_forensics_stack():
+    """Fresh default telemetry/profiler/flight per test — these are
+    process-wide by design, and a stale Telemetry would keep feeding a
+    previous test's manager."""
+    from accelerate_tpu.resilience import reset_active_plan
+    from accelerate_tpu.telemetry import reset_spans, stop_default_server
+    from accelerate_tpu.telemetry.flight import reset_flight_recorder
+    from accelerate_tpu.telemetry.profiler import reset_profile_manager
+
+    reset_telemetry()
+    reset_profile_manager()
+    reset_flight_recorder()
+    yield
+    reset_active_plan()
+    stop_default_server()
+    reset_telemetry()
+    reset_spans()
+
+
+def _build():
+    from accelerate_tpu.state import AcceleratorState, GradientState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    accelerator = Accelerator()
+    model = RegressionModel()
+    model.init_params(None)
+    pmodel, popt = accelerator.prepare(model, optax.adam(0.1))
+    return accelerator, pmodel, popt
+
+
+def _batch(step):
+    rng = np.random.default_rng(100 + step)
+    x = rng.normal(size=(8,)).astype(np.float32)
+    return {"x": x, "y": (2.0 * x + 3.0).astype(np.float32)}
+
+
+class _FakeTracer:
+    """Injected start/stop pair so trigger logic runs with zero jax cost."""
+
+    def __init__(self):
+        self.started = []
+        self.stopped = 0
+
+    def start(self, trace_dir):
+        self.started.append(trace_dir)
+
+    def stop(self):
+        self.stopped += 1
+
+
+def _manager(tmp_path, **kwargs):
+    tracer = _FakeTracer()
+    manager = ProfileManager(
+        output_dir=str(tmp_path), registry=MetricsRegistry(),
+        start_trace=tracer.start, stop_trace=tracer.stop, **kwargs
+    )
+    return manager, tracer
+
+
+# ----------------------------------------------------------------- grammar
+def test_parse_profile_steps_grammar():
+    assert parse_profile_steps("10-12") == [(10, 12)]
+    assert parse_profile_steps("50,10-12") == [(10, 12), (50, 50)]
+    assert parse_profile_steps("7") == [(7, 7)]
+    assert parse_profile_steps("") == []
+    assert parse_profile_steps("off") == []
+    assert parse_profile_steps(None) == []
+    assert parse_profile_steps([(3, 5)]) == [(3, 5)]
+    with pytest.raises(ValueError, match="bad profile step range"):
+        parse_profile_steps("abc")
+    with pytest.raises(ValueError, match="1-based"):
+        parse_profile_steps("0-4")
+    with pytest.raises(ValueError, match="start <= end"):
+        parse_profile_steps("9-4")
+
+
+# ------------------------------------------------------------ slow detector
+def test_slow_step_detector_trips_on_outlier_and_keeps_baseline():
+    detector = SlowStepDetector(zscore=4.0, warmup_steps=5)
+    for _ in range(10):
+        tripped, _ = detector.observe(0.1)
+        assert not tripped
+    tripped, z = detector.observe(1.0)
+    assert tripped and z > 4.0
+    # The outlier was EXCLUDED from the statistics: a healthy step is quiet
+    # and a repeat outlier still trips (the spike.py no-poisoning property).
+    assert not detector.observe(0.1)[0]
+    assert detector.observe(1.0)[0]
+
+
+# --------------------------------------------------------- trigger: ranges
+def test_explicit_range_capture_aligns_and_budgets(tmp_path):
+    ledger = get_ledger()
+    ledger.reset()
+    manager, tracer = _manager(tmp_path, steps="3-4,6,8-9", max_captures=2)
+    for s in range(1, 11):
+        manager.step_boundary(step=s, wall_s=0.1)
+    # Range 3-4 starts at boundary 2 (the step-aligned point before step 3)
+    # and stops at 4; range 6 captures step 6; range 8-9 exceeds the budget.
+    assert len(manager.captures) == 2
+    first, second = manager.captures
+    assert (first["first_step"], first["last_step"]) == (3, 4)
+    assert (second["first_step"], second["last_step"]) == (6, 6)
+    assert manager.budget_remaining == 0
+    assert tracer.stopped == 2 and len(tracer.started) == 2
+    assert manager._captures_total.value(trigger="steps") == 2
+    # Capture overhead (start/stop/parse) books as `profile` badput.
+    assert ledger.counts["profile"] >= 2
+    summary = manager.summary()
+    assert summary["armed"]["steps"] == "3-4,6,8-9"
+    assert summary["capturing"] is False
+
+
+def test_windowed_boundaries_cover_range(tmp_path):
+    manager, tracer = _manager(tmp_path, steps="10-12")
+    for boundary in (4, 8, 12, 16):
+        manager.step_boundary(step=boundary, wall_s=0.4, steps=4)
+    # K=4 windows: the capture starts at boundary 8 (the next window, 9-12,
+    # reaches into the range) and stops at boundary 12 — whole windows only.
+    assert len(manager.captures) == 1
+    capture = manager.captures[0]
+    assert (capture["first_step"], capture["last_step"]) == (9, 12)
+
+
+def test_back_to_back_ranges_do_not_lose_a_step(tmp_path):
+    """Finishing a capture at a boundary must fall through to the arming
+    check: with "3-4,5-6" the second range is due at the very boundary the
+    first one stops on."""
+    manager, tracer = _manager(tmp_path, steps="3-4,5-6", max_captures=3)
+    for s in range(1, 8):
+        manager.step_boundary(step=s, wall_s=0.1)
+    assert [(c["first_step"], c["last_step"]) for c in manager.captures] == [
+        (3, 4), (5, 6),
+    ]
+
+
+def test_failed_trace_start_does_not_consume_budget(tmp_path):
+    def broken_start(trace_dir):
+        raise RuntimeError("profiler backend unavailable")
+
+    manager = ProfileManager(
+        output_dir=str(tmp_path), registry=MetricsRegistry(), steps="2-3",
+        max_captures=3, start_trace=broken_start, stop_trace=lambda: None,
+    )
+    for s in range(1, 6):
+        manager.step_boundary(step=s, wall_s=0.1)
+    assert manager.captures == [] and not manager.capturing
+    assert manager.budget_remaining == 3  # no capture happened, nothing paid
+
+
+def test_manual_capture_neither_hijacks_nor_pays_budget(tmp_path):
+    manager, tracer = _manager(tmp_path, steps="2-3", max_captures=1)
+    manager.step_boundary(step=1, wall_s=0.1)  # triggered capture engages
+    assert manager.capturing
+    with manager.manual_capture(str(tmp_path / "man")) as capture_dir:
+        # A capture is already in flight: the block runs untraced and the
+        # triggered capture keeps running, untouched.
+        assert capture_dir is None
+        assert manager.capturing
+    manager.step_boundary(step=2, wall_s=0.1)
+    manager.step_boundary(step=3, wall_s=0.1)
+    assert [(c["trigger"], c["first_step"], c["last_step"])
+            for c in manager.captures] == [("steps", 2, 3)]
+    # Budget spent by the triggered capture; the MANUAL capture still runs —
+    # an explicit user ask is never refused on budget.
+    assert manager.budget_remaining == 0
+    with manager.manual_capture(str(tmp_path / "man2")) as capture_dir:
+        assert capture_dir is not None
+    assert manager.captures[-1]["trigger"] == "manual"
+    assert manager.budget_remaining == 0
+
+
+def test_range_wholly_in_the_past_is_dropped(tmp_path, caplog):
+    manager, tracer = _manager(tmp_path, steps="10-12")
+    with caplog.at_level("WARNING"):
+        manager.step_boundary(step=100, wall_s=0.1)  # a resume landed past it
+        manager.step_boundary(step=101, wall_s=0.1)
+    assert manager.captures == [] and not manager.capturing
+    assert tracer.started == []
+    assert any("dropped" in r.message for r in caplog.records)  # loudly
+
+
+def test_range_at_step_one_truncates_loudly(tmp_path, caplog):
+    """A range starting at step 1 cannot be fully honored (captures engage at
+    completed boundaries): the shrink happens, but with a WARNING naming what
+    was actually captured — never a silent wrong-step trace."""
+    manager, tracer = _manager(tmp_path, steps="1-2")
+    with caplog.at_level("WARNING"):
+        for s in range(1, 4):
+            manager.step_boundary(step=s, wall_s=0.1)
+    assert len(manager.captures) == 1
+    assert any("before the profiler could engage" in r.message
+               for r in caplog.records)
+
+
+# ------------------------------------------------------ trigger: slow steps
+def test_slow_step_trigger_fake_clock_drill(tmp_path):
+    manager, tracer = _manager(
+        tmp_path, slow_zscore=4.0, slow_capture_steps=2, slow_warmup_steps=5,
+    )
+    for s in range(1, 11):
+        manager.step_boundary(step=s, wall_s=0.1)
+    assert manager.captures == []  # steady state: armed, never captures
+    manager.step_boundary(step=11, wall_s=1.0)  # the outlier trips...
+    assert manager.capturing
+    manager.step_boundary(step=12, wall_s=0.1)
+    manager.step_boundary(step=13, wall_s=0.1)  # ...capture of the NEXT 2 steps
+    assert not manager.capturing
+    assert len(manager.captures) == 1
+    capture = manager.captures[0]
+    assert capture["trigger"] == "slow_step"
+    assert (capture["first_step"], capture["last_step"]) == (12, 13)
+    assert manager._captures_total.value(trigger="slow_step") == 1
+
+
+# ------------------------------------------------------- trigger: HTTP POST
+def test_metrics_endpoint_post_profile_drill(tmp_path):
+    manager, tracer = _manager(tmp_path)
+    set_profile_manager(manager)  # registers the POST /profile hook
+    registry = MetricsRegistry()
+    server = MetricsServer(0, registry=registry, host="127.0.0.1")
+    port = server.start()
+    try:
+        def post(path):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}{path}", method="POST"
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    return resp.status, json.loads(resp.read().decode())
+            except urllib.error.HTTPError as err:
+                return err.code, json.loads(err.read().decode())
+
+        status, body = post("/profile?steps=2")
+        assert status == 200 and body["accepted"] and body["trigger"] == "http"
+        status, body = post("/profile")  # second request while one is pending
+        assert status == 409 and not body["accepted"]
+        status, body = post("/profile?steps=junk")
+        assert status == 400
+        # The pending request engages at the next step boundary and captures
+        # the requested number of steps.
+        for s in range(1, 5):
+            manager.step_boundary(step=s, wall_s=0.1)
+        assert len(manager.captures) == 1
+        capture = manager.captures[0]
+        assert capture["trigger"] == "http"
+        assert (capture["first_step"], capture["last_step"]) == (2, 3)
+        # With no profiler installed the endpoint degrades, not 500s.
+        set_profile_manager(None)
+        status, body = post("/profile?steps=1")
+        assert status == 503
+    finally:
+        server.stop()
+
+
+# -------------------------------------------------------- trigger: straggler
+def test_straggler_trip_arms_capture(tmp_path):
+    manager, tracer = _manager(tmp_path)
+    telemetry = Telemetry(registry=MetricsRegistry(), profiler=manager,
+                          straggler_every=2, straggler_threshold=1.5)
+    # Synthetic skew: this host is 5x the other's step time (2-host median is
+    # the mean, so ratio = 2*5/(5+1) ≈ 1.67 > the 1.5 threshold).
+    telemetry.straggler._exchange = lambda local, state: [local, local / 5.0]
+
+    class _State:
+        num_processes, process_index = 2, 0
+
+    telemetry.on_step(1, state=_State())
+    telemetry.on_step(2, state=_State())
+    assert manager._pending is not None and manager._pending[1] == "straggler"
+    telemetry.on_step(3, state=_State())
+    assert manager.capturing
+    assert any(e["kind"] == "straggler_trip"
+               for e in telemetry.flight.snapshot())
+
+
+# -------------------------------------------------------- traceview: golden
+def _golden_events():
+    """Two annotated 50ms steps; per step: 30ms compute, 20ms collective
+    overlapping compute by 10ms, 2ms host transfer, rest idle. Aggregate
+    fractions: compute .6, exposed collective .2, host .04, idle .16."""
+    ms = 1000.0  # chrome trace ts/dur are microseconds
+    events = [
+        {"ph": "M", "pid": 100, "name": "process_name", "args": {"name": "/host:CPU"}},
+        {"ph": "M", "pid": 100, "tid": 1, "name": "thread_name", "args": {"name": "python"}},
+        {"ph": "M", "pid": 100, "tid": 2, "name": "thread_name", "args": {"name": "tf_XLATfrtCpuClient/1"}},
+        {"ph": "M", "pid": 200, "name": "process_name", "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "pid": 200, "tid": 10, "name": "thread_name", "args": {"name": "XLA Ops"}},
+        {"ph": "M", "pid": 200, "tid": 11, "name": "thread_name", "args": {"name": "XLA Modules"}},
+        # Whole-module row: must be EXCLUDED (it spans every op and would
+        # double the busy time).
+        {"ph": "X", "pid": 200, "tid": 11, "ts": 0, "dur": 100 * ms, "name": "jit_train_step"},
+        # Host-side python noise: ignored.
+        {"ph": "X", "pid": 100, "tid": 1, "ts": 10 * ms, "dur": 5 * ms, "name": "$builtins isinstance"},
+    ]
+    for step, base in enumerate((0.0, 50.0)):
+        events += [
+            {"ph": "X", "pid": 100, "tid": 1, "ts": base * ms, "dur": 50 * ms,
+             "name": "train_step"},
+            {"ph": "X", "pid": 200, "tid": 10, "ts": (base + 5) * ms, "dur": 30 * ms,
+             "name": "fusion.1", "args": {"hlo_op": "fusion.1", "hlo_module": "jit_train_step"}},
+            {"ph": "X", "pid": 200, "tid": 10, "ts": (base + 25) * ms, "dur": 20 * ms,
+             "name": "all-reduce.1", "args": {"hlo_op": "all-reduce.1", "hlo_module": "jit_train_step"}},
+            {"ph": "X", "pid": 100, "tid": 2, "ts": (base + 46) * ms, "dur": 2 * ms,
+             "name": "TransferToDeviceStream"},
+        ]
+    return events
+
+
+def _write_golden(tmp_path):
+    trace_dir = tmp_path / "plugins" / "profile" / "2026_01_01"
+    trace_dir.mkdir(parents=True)
+    path = trace_dir / "host.trace.json.gz"
+    with gzip.open(path, "wt") as fh:
+        json.dump({"displayTimeUnit": "ms", "traceEvents": _golden_events()}, fh)
+    return path
+
+
+def test_golden_trace_attribution(tmp_path):
+    from accelerate_tpu.telemetry.traceview import report_capture
+
+    _write_golden(tmp_path)
+    report = report_capture(str(tmp_path), collective_axes={"all-reduce": ["dp"]})
+    fractions = report["fractions"]
+    assert sum(fractions.values()) == pytest.approx(1.0, abs=0.02)
+    assert fractions["compute"] == pytest.approx(0.6, abs=1e-3)
+    assert fractions["collective"] == pytest.approx(0.2, abs=1e-3)
+    assert fractions["host"] == pytest.approx(0.04, abs=1e-3)
+    assert fractions["idle"] == pytest.approx(0.16, abs=1e-3)
+    # Measured compute<->collective overlap: 20ms of 40ms raw collective time.
+    assert report["overlap_fraction"] == pytest.approx(0.5, abs=1e-3)
+    assert report["collective_s"] == pytest.approx(0.040, abs=1e-6)
+    # Step annotations found: per-step table, each summing to 1.
+    assert report["n_steps"] == 2
+    for step in report["steps"]:
+        assert sum(step["fractions"].values()) == pytest.approx(1.0, abs=0.02)
+        assert step["fractions"]["compute"] == pytest.approx(0.6, abs=1e-3)
+    # Axis join (audit inventory): collective seconds land on dp.
+    assert report["by_axis"] == {"dp": pytest.approx(0.040, abs=1e-6)}
+    # Top-op table: compute + collective ops, module row excluded.
+    names = {op["name"]: op for op in report["top_ops"]}
+    assert names["fusion.1"]["kind"] == "compute"
+    assert names["fusion.1"]["count"] == 2
+    assert names["all-reduce.1"]["kind"] == "all-reduce"
+    assert "jit_train_step" not in names
+
+
+def test_top_ops_and_by_axis_clip_to_the_attributed_window():
+    """Ops outside the step-annotated window must not leak into top_ops or
+    by_axis — both halves of the report describe the SAME window."""
+    from accelerate_tpu.telemetry.traceview import attribute_events
+
+    ms = 1000.0
+    events = _golden_events() + [
+        # Pre-step work: a 500ms collective entirely before the first
+        # train_step annotation (ts in [-600ms, -100ms)).
+        {"ph": "X", "pid": 200, "tid": 10, "ts": -600 * ms, "dur": 500 * ms,
+         "name": "all-gather.9", "args": {"hlo_op": "all-gather.9"}},
+    ]
+    report = attribute_events(events, collective_axes={
+        "all-reduce": ["dp"], "all-gather": ["fsdp"],
+    })
+    names = {op["name"] for op in report.top_ops}
+    assert "all-gather.9" not in names
+    assert report.by_axis == {"dp": pytest.approx(0.040, abs=1e-6)}
+    assert report.collective_s == pytest.approx(0.040, abs=1e-6)
+
+
+def test_attribution_without_step_annotations(tmp_path):
+    from accelerate_tpu.telemetry.traceview import attribute_events
+
+    events = [e for e in _golden_events() if e.get("name") != "train_step"]
+    report = attribute_events(events)
+    assert not report.steps
+    assert sum(report.fractions.values()) == pytest.approx(1.0, abs=0.02)
+    assert report.compute_s == pytest.approx(0.060, abs=1e-6)
+
+
+def test_collective_axes_from_audit_dict():
+    from accelerate_tpu.telemetry.traceview import collective_axes_from_audit
+
+    audit = {
+        "collectives": {"sites": [
+            {"op": "all-reduce", "axes": ["dp"], "shape": "f32[4]", "nbytes": 16},
+            {"op": "all-reduce", "axes": ["fsdp"], "shape": "f32[4]", "nbytes": 16},
+            {"op": "all-gather", "axes": ["tp"], "shape": "f32[8]", "nbytes": 32},
+        ]}
+    }
+    assert collective_axes_from_audit(audit) == {
+        "all-reduce": ["dp", "fsdp"], "all-gather": ["tp"],
+    }
+
+
+def test_find_trace_file_errors_clearly(tmp_path):
+    from accelerate_tpu.telemetry.traceview import find_trace_file
+
+    with pytest.raises(FileNotFoundError, match="trace.json.gz"):
+        find_trace_file(str(tmp_path))
+
+
+# ------------------------------------------------- real capture (acceptance)
+def test_triggered_capture_real_trace_attribution(tmp_path):
+    """The acceptance drill: an env-style step-range trigger on the CPU rig
+    captures a real jax trace; the parsed report's fractions sum to 1±0.02
+    and surface in timeline.summary()['profile']; the loop (armed AND
+    capturing) adds zero blocking device→host transfers."""
+    manager = ProfileManager(output_dir=str(tmp_path), steps="3-4")
+    set_profile_manager(manager)
+    accelerator, pmodel, popt = _build()
+    step = accelerator.build_train_step(pmodel, popt)
+    reset_transfer_stats()
+    for s in range(1, 7):
+        step(_batch(s))
+    assert transfer_stats()["blocking"] == 0
+    assert len(manager.captures) == 1
+    capture = manager.captures[0]
+    assert capture["trigger"] == "steps"
+    assert os.path.isdir(capture["trace_dir"])
+    report = capture.get("report")
+    assert report is not None, "captured trace did not parse"
+    assert sum(report["fractions"].values()) == pytest.approx(1.0, abs=0.02)
+    assert report["top_ops"], "no op events attributed"
+    # The same report rides the timeline summary (and, through it, bench.py's
+    # detail.profile when a capture engaged during a bench config).
+    summary = accelerator.telemetry.timeline.summary()
+    assert summary["profile"]["captures"][0]["trigger"] == "steps"
+    assert summary["profile"]["captures"][0]["report"]["fractions"] == report["fractions"]
+
+
+def test_armed_profiler_adds_no_blocking_transfers(tmp_path):
+    """Armed-but-idle is free of device traffic: ranges far in the future and
+    a high slow-step threshold watch every boundary without capturing."""
+    def drill():
+        reset_telemetry()
+        set_profile_manager(ProfileManager(
+            output_dir=str(tmp_path), steps="1000-1001", slow_zscore=50.0,
+        ))
+        accelerator, pmodel, popt = _build()
+        step = accelerator.build_train_step(pmodel, popt)
+        reset_transfer_stats()
+        for s in range(1, 9):
+            step(_batch(s))
+        return transfer_stats()
+
+    stats = run_nonblocking_drill(drill)
+    assert stats["blocking"] == 0 and stats["fetches"] == 0
+
+
+def test_accelerator_profile_context_rides_profile_manager(tmp_path):
+    """Satellite: the manual Accelerator.profile context books `profile`
+    badput, lands in the capture list/counter/flight ring exactly like a
+    triggered capture, and records the step range it covered."""
+    from accelerate_tpu.telemetry.flight import get_flight_recorder
+    from accelerate_tpu.telemetry.profiler import get_profile_manager
+    from accelerate_tpu.utils.dataclasses import ProfileKwargs
+
+    ledger = get_ledger()
+    ledger.reset()
+    accelerator, pmodel, popt = _build()
+    step = accelerator.build_train_step(pmodel, popt)
+    step(_batch(1))  # compile outside the capture
+    with accelerator.profile(ProfileKwargs(output_trace_dir=str(tmp_path / "man"))) as d:
+        assert d is not None
+        step(_batch(2))
+        step(_batch(3))
+    manager = get_profile_manager()
+    assert len(manager.captures) == 1
+    capture = manager.captures[0]
+    assert capture["trigger"] == "manual"
+    assert capture["last_step"] - capture["first_step"] == 1  # two boundaries
+    assert manager._captures_total.value(trigger="manual") == 1
+    assert ledger.counts["profile"] >= 1
+    kinds = [e["kind"] for e in get_flight_recorder().snapshot()]
+    assert "profile_start" in kinds and "profile_stop" in kinds
+    # No output_trace_dir configured -> untraced no-op (reference parity).
+    with accelerator.profile() as d:
+        assert d is None
+    assert len(manager.captures) == 1
+
+
+def test_disabled_telemetry_does_not_install_profile_trigger():
+    """ACCELERATE_TELEMETRY=0 never feeds step boundaries, so it must not
+    register a POST /profile trigger whose accepted requests could never
+    engage — the endpoint answers 503 instead."""
+    from accelerate_tpu.telemetry import metrics as metrics_mod
+
+    assert metrics_mod._PROFILE_TRIGGER is None  # fixture reset the manager
+    telemetry = Telemetry(enabled=False, registry=MetricsRegistry())
+    assert telemetry.profiler is None
+    assert metrics_mod._PROFILE_TRIGGER is None
+
+
+def test_flight_step_deltas_survive_transfer_reset():
+    """A reset_transfer_stats() between boundaries must re-anchor the delta
+    baseline (the timeline's regression), not log negative transfer counts
+    into the black box."""
+    recorder = FlightRecorder()
+    recorder.note_step(step=1, transfers={"fetches": 100, "blocking": 2,
+                                          "h2d_puts": 0, "h2d_blocking": 0,
+                                          "resets": 0})
+    recorder.note_step(step=2, transfers={"fetches": 3, "blocking": 0,
+                                          "h2d_puts": 0, "h2d_blocking": 0,
+                                          "resets": 1})
+    events = recorder.snapshot()
+    assert events[-1]["transfers"] == {"fetches": 3}  # since the reset, not -97
+
+
+# ------------------------------------------------------------ flight recorder
+def test_flight_recorder_ring_and_dump(tmp_path, monkeypatch):
+    monkeypatch.setenv("ACCELERATE_FLIGHT_DIR", str(tmp_path))
+    recorder = FlightRecorder(capacity=4)
+    for s in range(1, 9):
+        recorder.note_step(step=s, wall_s=0.01,
+                           transfers={"fetches": s, "blocking": 0,
+                                      "h2d_puts": 0, "h2d_blocking": 0})
+    assert recorder.total == 8
+    events = recorder.snapshot()
+    assert len(events) == 4  # bounded ring keeps the newest
+    assert [e["step"] for e in events] == [5, 6, 7, 8]
+    assert events[-1]["transfers"] == {"fetches": 1}  # per-boundary DELTA
+    path = recorder.dump("unit_test")
+    assert path and os.path.isfile(path)
+    dump = json.load(open(path))
+    assert dump["reason"] == "unit_test"
+    assert dump["events_total"] == 8 and dump["events_retained"] == 4
+    assert "transfers" in dump and "goodput" in dump
+
+
+def test_blackbox_cli_renders_dump(tmp_path, capsys):
+    from accelerate_tpu.commands.profile import (
+        blackbox_command,
+        blackbox_command_parser,
+    )
+
+    recorder = FlightRecorder()
+    recorder.note_step(step=7, wall_s=0.02)
+    recorder.record("fault_injected", step=8, action="hang", arg="5")
+    recorder.record("hang", step=8, idle_s=1.2)
+    path = str(tmp_path / "dump.json")
+    assert recorder.dump("hang", path=path) == path
+    blackbox_command(blackbox_command_parser().parse_args([path]))
+    out = capsys.readouterr().out
+    assert "reason='hang'" in out
+    assert "fault_injected" in out and "action=hang" in out
+    assert "step=7" in out
+
+
+def test_hang_drill_dump_names_injected_fault(tmp_path, monkeypatch, capfd):
+    """Acceptance: a hang fault wedges the loop, the watchdog trips, and the
+    black box on disk ends with the injected fault — parsed back by the
+    blackbox CLI."""
+    import threading
+
+    from accelerate_tpu.commands.profile import (
+        blackbox_command,
+        blackbox_command_parser,
+    )
+    from accelerate_tpu.health.hang import HangWatchdog
+    from accelerate_tpu.resilience.faults import FaultPlan
+    from accelerate_tpu.telemetry.flight import get_flight_recorder
+
+    monkeypatch.setenv("ACCELERATE_FLIGHT_DIR", str(tmp_path))
+    recorder = get_flight_recorder()
+    for s in (1, 2):
+        recorder.note_step(step=s, wall_s=0.01)
+    fired = threading.Event()
+    watchdog = HangWatchdog(timeout_s=0.3, on_hang=fired.set)
+    watchdog.start()
+    try:
+        watchdog.beat(2)
+        FaultPlan.parse("step:3=hang:1.5").maybe_fire(3)  # wedges ~1.5s
+        assert fired.wait(timeout=10), "watchdog never fired during the hang"
+    finally:
+        watchdog.stop()
+    capfd.readouterr()  # drain the stack dump
+    dumps = glob.glob(str(tmp_path / "flight_*hang*.json"))
+    assert dumps, "hang trip left no flight-recorder dump"
+    events = json.load(open(dumps[0]))["events"]
+    kinds = [e["kind"] for e in events]
+    assert kinds[-2:] == ["fault_injected", "hang"]
+    assert events[-2]["action"] == "hang" and events[-2]["step"] == 3
+    blackbox_command(blackbox_command_parser().parse_args([dumps[0]]))
+    out = capfd.readouterr().out
+    assert "fault_injected" in out and "action=hang" in out
+
+
+def test_guard_trip_dumps_black_box(tmp_path, monkeypatch):
+    """A health-guard trip writes the black box (and the rollback lands in
+    the ring) without being asked."""
+    from accelerate_tpu.resilience import FaultPlan, set_active_plan
+
+    monkeypatch.setenv("ACCELERATE_FLIGHT_DIR", str(tmp_path))
+    set_active_plan(FaultPlan.parse("step:4=nan"))
+    accelerator, pmodel, popt = _build()
+    accelerator.configure_health(spike_warmup=50, snapshot_every=2)
+    tripped = False
+    while accelerator.step < 6:
+        s = accelerator.step + 1
+        if accelerator.health_guard.should_skip(s):
+            accelerator.step = s
+            continue
+        out = pmodel(**_batch(s))
+        accelerator.backward(out.loss)
+        popt.step()
+        popt.zero_grad()
+        accelerator.step = s
+        tripped = accelerator.guard_step(out.loss).tripped or tripped
+    assert tripped
+    dumps = glob.glob(str(tmp_path / "flight_*guard_trip*.json"))
+    assert dumps, "guard trip left no flight-recorder dump"
+    kinds = [e["kind"] for e in json.load(open(dumps[0]))["events"]]
+    assert "fault_injected" in kinds and "guard_trip" in kinds
+
+
+# ------------------------------------------------------------- nonblocking
+def test_run_nonblocking_drill_retries_load_not_regressions():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        return {"blocking": 0 if len(calls) >= 3 else 1, "h2d_blocking": 0}
+
+    stats = run_nonblocking_drill(flaky, attempts=3)
+    assert stats["blocking"] == 0 and len(calls) == 3
+    with pytest.raises(AssertionError, match="deterministic"):
+        run_nonblocking_drill(lambda: {"blocking": 1, "h2d_blocking": 0},
+                              attempts=2)
+
+
+# ------------------------------------------------------- launch / env / CLI
+def test_launch_flags_export_profile_env(monkeypatch):
+    from accelerate_tpu.commands.launch import (
+        _merge_config,
+        launch_command_parser,
+        prepare_launch_env,
+    )
+
+    args = launch_command_parser().parse_args(
+        ["--cpu", "--profile_steps", "10-12,50",
+         "--profile_slow_zscore", "6.0", "x.py"]
+    )
+    env = prepare_launch_env(_merge_config(args))
+    assert env["ACCELERATE_PROFILE_STEPS"] == "10-12,50"
+    assert env["ACCELERATE_PROFILE_SLOW_ZSCORE"] == "6.0"
+    # Tri-state: unconfigured exports nothing...
+    bare = prepare_launch_env(
+        _merge_config(launch_command_parser().parse_args(["--cpu", "x.py"]))
+    )
+    assert "ACCELERATE_PROFILE_STEPS" not in bare
+    assert "ACCELERATE_PROFILE_SLOW_ZSCORE" not in bare
+    # ...while an explicit 'off'/0 scrubs a stale inherited value.
+    monkeypatch.setenv("ACCELERATE_PROFILE_STEPS", "1-2")
+    monkeypatch.setenv("ACCELERATE_PROFILE_SLOW_ZSCORE", "4")
+    off = prepare_launch_env(_merge_config(launch_command_parser().parse_args(
+        ["--cpu", "--profile_steps", "off", "--profile_slow_zscore", "0", "x.py"]
+    )))
+    assert "ACCELERATE_PROFILE_STEPS" not in off
+    assert "ACCELERATE_PROFILE_SLOW_ZSCORE" not in off
+
+
+def test_launch_validates_profile_steps_grammar():
+    from accelerate_tpu.commands.launch import launch_command, launch_command_parser
+
+    with pytest.raises(ValueError, match="profile step range"):
+        launch_command(launch_command_parser().parse_args(
+            ["--cpu", "--profile_steps", "12-10", "x.py"]
+        ))
+    with pytest.raises(ValueError, match="profile_slow_zscore"):
+        launch_command(launch_command_parser().parse_args(
+            ["--cpu", "--profile_slow_zscore", "-1", "x.py"]
+        ))
+    # Profiling rides the telemetry hooks: asking for captures while
+    # explicitly disabling telemetry is a conflict, failed at launch rather
+    # than silently producing zero captures.
+    with pytest.raises(ValueError, match="no-telemetry"):
+        launch_command(launch_command_parser().parse_args(
+            ["--cpu", "--no-telemetry", "--profile_steps", "10-12", "x.py"]
+        ))
+
+
+def test_new_telemetry_modules_are_lint_hot_path_scoped():
+    """Satellite: the invariant linter's hot-path scope covers the new
+    telemetry modules (uncounted-asarray applies to them), and none of them
+    needed a baseline entry — the modules ship counted-transfer clean."""
+    from accelerate_tpu.analysis.lint import _RULES_BY_NAME, _rule_applies, lint_paths
+
+    rule = _RULES_BY_NAME["uncounted-asarray"]
+    for module in ("telemetry/profiler.py", "telemetry/traceview.py",
+                   "telemetry/flight.py"):
+        assert _rule_applies(rule, module), module
+    import accelerate_tpu.telemetry as pkg
+
+    telemetry_dir = os.path.dirname(os.path.abspath(pkg.__file__))
+    findings = lint_paths([os.path.join(telemetry_dir, f) for f in
+                           ("profiler.py", "traceview.py", "flight.py")],
+                          baseline=set())
+    assert findings == [], [f.format() for f in findings]
+
+
+def test_profile_manager_env_contract(monkeypatch, tmp_path):
+    from accelerate_tpu.telemetry.profiler import (
+        get_profile_manager,
+        reset_profile_manager,
+    )
+
+    monkeypatch.setenv("ACCELERATE_PROFILE_STEPS", "5-6")
+    monkeypatch.setenv("ACCELERATE_PROFILE_SLOW_ZSCORE", "3.5")
+    monkeypatch.setenv("ACCELERATE_PROFILE_MAX_CAPTURES", "1")
+    monkeypatch.setenv("ACCELERATE_PROFILE_DIR", str(tmp_path))
+    reset_profile_manager()
+    manager = get_profile_manager()
+    assert manager._ranges == [(5, 6)]
+    assert manager.slow_zscore == 3.5
+    assert manager.max_captures == 1
+    assert manager.output_dir == str(tmp_path)
+
+
+def test_profile_report_cli_on_golden_trace(tmp_path, capsys):
+    from accelerate_tpu.commands.profile import (
+        profile_command,
+        profile_command_parser,
+    )
+
+    _write_golden(tmp_path)
+    audit_path = tmp_path / "audit.json"
+    audit_path.write_text(json.dumps({
+        "collectives": {"sites": [
+            {"op": "all-reduce", "axes": ["dp"], "shape": "f32[4]", "nbytes": 16},
+        ]}
+    }))
+    profile_command(profile_command_parser().parse_args(
+        ["report", str(tmp_path), "--audit", str(audit_path)]
+    ))
+    out = capsys.readouterr().out
+    assert "compute 60.0%" in out
+    assert "overlap: 50.0%" in out
+    assert "dp=" in out
+    # --json emits exactly the machine-readable schema.
+    profile_command(profile_command_parser().parse_args(
+        ["report", str(tmp_path), "--json"]
+    ))
+    report = json.loads(capsys.readouterr().out)
+    assert report["fractions"]["compute"] == pytest.approx(0.6, abs=1e-3)

@@ -157,29 +157,41 @@ def test_timeline_baseline_survives_transfer_reset():
 
 def test_guarded_telemetry_loop_populates_without_blocking():
     """The guarded-loop acceptance drill: guard + telemetry together, zero
-    blocking transfers, timeline populated, trip surfaces in the registry."""
+    blocking transfers, timeline populated, trip surfaces in the registry.
+    The blocking==0 assert is wall-clock-sensitive under machine load, so the
+    drill runs through the shared load-tolerant helper — a deterministic
+    regression still fails every attempt."""
     from accelerate_tpu.resilience import FaultPlan, set_active_plan
+    from accelerate_tpu.test_utils import run_nonblocking_drill
 
-    set_active_plan(FaultPlan.parse("step:8=nan"))
-    accelerator, pmodel, popt = _build()
-    accelerator.configure_health(spike_warmup=50, snapshot_every=3)
-    guard = accelerator.health_guard
-    reset_transfer_stats()
-    trips = []
-    while accelerator.step < 12:
-        step = accelerator.step + 1
-        if guard.should_skip(step):
+    box = {}
+
+    def drill():
+        set_active_plan(FaultPlan.parse("step:8=nan"))
+        accelerator, pmodel, popt = _build()
+        accelerator.configure_health(spike_warmup=50, snapshot_every=3)
+        guard = accelerator.health_guard
+        reset_transfer_stats()
+        trips = []
+        while accelerator.step < 12:
+            step = accelerator.step + 1
+            if guard.should_skip(step):
+                accelerator.step = step
+                continue
+            out = pmodel(**_batch(step))
+            accelerator.backward(out.loss)
+            popt.step()
+            popt.zero_grad()
             accelerator.step = step
-            continue
-        out = pmodel(**_batch(step))
-        accelerator.backward(out.loss)
-        popt.step()
-        popt.zero_grad()
-        accelerator.step = step
-        verdict = accelerator.guard_step(out.loss)
-        if verdict.tripped:
-            trips.append(verdict)
-    assert transfer_stats()["blocking"] == 0
+            verdict = accelerator.guard_step(out.loss)
+            if verdict.tripped:
+                trips.append(verdict)
+        box.update(accelerator=accelerator, trips=trips)
+        return transfer_stats()
+
+    stats = run_nonblocking_drill(drill)
+    assert stats["blocking"] == 0
+    accelerator, trips = box["accelerator"], box["trips"]
     assert len(trips) == 1
     timeline = accelerator.telemetry.timeline
     assert timeline.count >= 10  # one sample per hooked step
@@ -442,7 +454,7 @@ def test_bench_failure_line_carries_schema_version(capsys):
     import json
 
     line = json.loads(capsys.readouterr().out.strip())
-    assert line["schema_version"] == bench.BENCH_SCHEMA_VERSION == 3
+    assert line["schema_version"] == bench.BENCH_SCHEMA_VERSION == 4
     assert line["value"] == 0.0
 
 

@@ -108,16 +108,28 @@ def test_window_1_and_8_bit_exact_vs_unwindowed(accum):
 def test_window_retains_losses_and_feeds_timeline_per_step():
     """One window dispatch = one timeline boundary but K per-step samples;
     the K losses stay retained (no fetch, no stall) until summary() drains
-    them, and `dispatches` counts programs, not steps."""
-    acc, pm, po = _build()
-    timeline = acc.telemetry.timeline
-    timeline.reset()
-    w = acc.build_train_window(pm, po, window=4)
-    reset_transfer_stats()
-    for chunk in range(3):
-        w(_window_batch(range(1 + 4 * chunk, 5 + 4 * chunk)))
-    assert transfer_stats()["blocking"] == 0
-    summary = timeline.summary()
+    them, and `dispatches` counts programs, not steps. Runs through the
+    shared load-tolerant helper: blocking==0 is wall-clock-sensitive under
+    machine load (the PR 5/6 flake), while a real retained-loss regression
+    fails every attempt."""
+    from accelerate_tpu.test_utils import run_nonblocking_drill
+
+    box = {}
+
+    def drill():
+        acc, pm, po = _build()
+        timeline = acc.telemetry.timeline
+        timeline.reset()
+        w = acc.build_train_window(pm, po, window=4)
+        reset_transfer_stats()
+        for chunk in range(3):
+            w(_window_batch(range(1 + 4 * chunk, 5 + 4 * chunk)))
+        box["timeline"] = timeline
+        return transfer_stats()
+
+    stats = run_nonblocking_drill(drill)
+    assert stats["blocking"] == 0
+    summary = box["timeline"].summary()
     assert summary["dispatches"] == 3
     assert summary["steps"] == 8  # first boundary is baseline-only
     assert summary["last_loss"] is not None
