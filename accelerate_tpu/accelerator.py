@@ -1386,19 +1386,25 @@ class Accelerator:
             lambda batch, clip_norm=0.0: jax.make_jaxpr(_window)(
                 *_window_args(batch, clip_norm)
             ),
+            window=window,
         )
         return step_window
 
     # ------------------------------------------------------------- audit
     def _builder_audit_meta(self, builder: str, handle, optimizer,
                             effective_donate: tuple, intended_donate: tuple,
-                            jaxpr_thunk):
+                            jaxpr_thunk, window: int = 1):
         """Audit metadata the fused builders attach to their returned step fn:
         the donation contract (what was intended vs what safe_donate_argnums
         left after platform gating, plus how many flat buffers the donated
         pytrees flatten to — the count that catches PARTIAL donation
         regressions), the mesh for collective attribution, the compute dtype
-        for upcast detection, and a jaxpr thunk for the pre-partitioning walk."""
+        for upcast detection, a jaxpr thunk for the pre-partitioning walk, and
+        the donated-pytree class join (``memory_classes``) the static memory
+        auditor (analysis/memory.py) uses to attribute flat input buffers to
+        param / opt-state / accum classes with their shardings. The class
+        thunks read the LIVE handle/optimizer state so an audit after steps
+        (donated buffers replaced) still sees current shapes."""
         try:
             compute_dtype = np.dtype(handle.compute_dtype).name
         except Exception:
@@ -1420,10 +1426,22 @@ class Accelerator:
                 bool(intended_donate) and not effective_donate
             ),
             "jaxpr_thunk": jaxpr_thunk,
+            "window": int(window),
+            "memory_classes": {
+                "params": (lambda: handle.params,
+                           lambda: handle.param_shardings),
+                "opt_state": (lambda: optimizer.opt_state,
+                              lambda: optimizer.opt_shardings),
+                # The accumulation buffer is zeros_like(params): same
+                # structure, same shardings.
+                "accum": (lambda: optimizer._accum_grads,
+                          lambda: handle.param_shardings),
+            },
         }
 
     def audit(self, built, batch, clip_norm: float = 0.0,
-              intermediate_threshold_bytes: int = 64 * 1024 * 1024):
+              intermediate_threshold_bytes: int = 64 * 1024 * 1024,
+              memory: bool = True):
         """Statically audit a built artifact (``build_train_step`` /
         ``build_train_window`` output, or any jitted fn exposing ``.lower``)
         against the framework's program-level invariants: collective inventory
@@ -1431,6 +1449,13 @@ class Accelerator:
         via input–output aliasing, host callbacks, dtype upcasts, and
         oversized per-device intermediates. Returns
         :class:`~.analysis.AuditReport`; see docs/analysis.md for the schema.
+
+        For the fused builders the report additionally carries the static
+        memory audit as ``report.memory`` (a
+        :class:`~.analysis.MemoryReport`): per-device HBM bytes by class
+        (param / opt-state / accum / batch / activation-workspace), the
+        sharded-vs-replicated split per named mesh axis, implicit resharding
+        copies, and the OOM-before-launch verdict. ``memory=False`` skips it.
 
         ``batch`` must be shaped as the artifact expects (window-stacked for a
         window program). Auditing lowers and compiles but never executes — no
@@ -1441,6 +1466,7 @@ class Accelerator:
             built, batch, clip_norm,
             mesh=self.mesh,
             intermediate_threshold_bytes=intermediate_threshold_bytes,
+            memory=memory,
         )
         # Feed the trace attributor's axis join: a later profile capture can
         # then attribute measured collective time to the NAMED mesh axes this
@@ -1448,6 +1474,28 @@ class Accelerator:
         from .telemetry.traceview import attach_collective_axes
 
         attach_collective_axes(report)
+        if report.memory is not None:
+            # Arm the timeline's predicted-vs-observed peak cross-check: the
+            # next summary() compares this static prediction to the live
+            # memory_stats() peak on backends that report one.
+            self.telemetry.timeline.set_predicted_peak(
+                report.memory.predicted_peak_bytes
+            )
+        return report
+
+    def memory_report(self, built, batch, clip_norm: float = 0.0,
+                      budget_bytes: int | None = None):
+        """Static HBM audit of a built artifact without the full program
+        audit: returns the :class:`~.analysis.MemoryReport` directly (see
+        :meth:`audit` for what it contains). ``budget_bytes`` overrides the
+        per-generation HBM × headroom budget the OOM verdict gates on —
+        the ``accelerate-tpu memcheck --budget-gib`` path."""
+        from .analysis import memory_report_from_built
+
+        report = memory_report_from_built(
+            built, batch, clip_norm, mesh=self.mesh, budget_bytes=budget_bytes,
+        )
+        self.telemetry.timeline.set_predicted_peak(report.predicted_peak_bytes)
         return report
 
     def _place_window_batch(self, batch):

@@ -17,12 +17,25 @@ subsystem makes both statically checkable:
 - **Layer 2 — invariant linter** (:mod:`.lint`): an AST pass over
   ``accelerate_tpu/`` encoding the repo's rules as data-driven checks
   (counted transfers, ``jax_compat`` shims, ``safe_donate_argnums``, no host
-  impurity inside traced bodies), with per-line suppressions and a baseline
-  file for grandfathered findings. Surfaced as ``accelerate-tpu lint`` and
-  gated in tier-1 by ``tests/test_analysis.py``.
+  impurity inside traced bodies, raw device-list baselines, fully-replicated
+  sharding constraints), with per-line suppressions and a baseline file for
+  grandfathered findings. Surfaced as ``accelerate-tpu lint`` and gated in
+  tier-1 by ``tests/test_analysis.py``.
+- **Layer 3 — memory & layout auditor** (:mod:`.memory` + :mod:`.layout`):
+  per-device HBM bytes attributed to param / opt-state / accum / batch /
+  activation-workspace classes by joining the compiled executable's
+  ``memory_analysis()`` to the builders' donated-pytree metadata, each class
+  split into sharded-vs-replicated bytes per named mesh axis (``opt_state
+  replicated on dp`` is a first-class finding — the ROADMAP item 2 target),
+  implicit-resharding-copy detection from StableHLO sharding annotations,
+  and an OOM-before-launch verdict against the per-generation HBM table.
+  Surfaced as ``Accelerator.audit(...).memory`` / ``memory_report``,
+  ``accelerate-tpu memcheck``, and ``detail.memory`` on every ``bench.py``
+  JSON line (schema v5).
 """
 
 from .audit import AuditReport, audit_built, audit_lowered
+from .layout import ReshardSite, find_implicit_reshards
 from .lint import (
     DEFAULT_BASELINE_NAME,
     LintFinding,
@@ -32,11 +45,25 @@ from .lint import (
     load_baseline,
     write_baseline,
 )
+from .memory import (
+    ClassMemory,
+    MemoryReport,
+    ReplicationFinding,
+    memory_report_from_built,
+    memory_report_from_lowered,
+)
 
 __all__ = [
     "AuditReport",
     "audit_built",
     "audit_lowered",
+    "ClassMemory",
+    "MemoryReport",
+    "ReplicationFinding",
+    "ReshardSite",
+    "find_implicit_reshards",
+    "memory_report_from_built",
+    "memory_report_from_lowered",
     "DEFAULT_BASELINE_NAME",
     "LintFinding",
     "Rule",

@@ -130,6 +130,10 @@ class AuditReport:
     dot_dtypes: dict = field(default_factory=dict)        # {"f32xf32": n, ...}
     large_intermediates: list = field(default_factory=list)  # [dict]
     intermediate_threshold_bytes: int = 0
+    # Static memory audit (analysis/memory.py MemoryReport) when the builder's
+    # meta carries the donated-pytree class join; None for foreign artifacts.
+    # Inventory, not a gate: `clean` stays a program-invariant property.
+    memory: object = None
 
     # ------------------------------------------------------------ inventories
     def collective_counts(self, axis: str | None = None) -> dict:
@@ -192,6 +196,7 @@ class AuditReport:
             "dot_dtypes": dict(self.dot_dtypes),
             "large_intermediates": list(self.large_intermediates),
             "intermediate_threshold_bytes": self.intermediate_threshold_bytes,
+            "memory": self.memory.to_dict() if self.memory is not None else None,
         }
 
     def summary_dict(self) -> dict:
@@ -535,18 +540,26 @@ def audit_lowered(
     report.large_intermediates = _parse_large_intermediates(
         hlo_text, intermediate_threshold_bytes
     )
+    # Stashed (non-field) so audit_built's memory pass reuses this executable
+    # instead of paying a second XLA compile; audit_built pops it so the
+    # report does not pin the executable alive for its own lifetime.
+    report._compiled = compiled
     return report
 
 
 def audit_built(built, *args, intermediate_threshold_bytes: int = 64 * 1024 * 1024,
-                mesh=None, **kwargs) -> AuditReport:
+                mesh=None, memory: bool = True, memory_budget_bytes: int | None = None,
+                **kwargs) -> AuditReport:
     """Audit a built artifact — anything exposing ``.lower(*args, **kwargs)``
     (the fused builders attach one; a raw jitted function has jax's own).
 
     Builder metadata (``_audit_meta`` set by ``build_train_step`` /
     ``build_train_window``) supplies the mesh, the donation contract, the
     compute dtype, and a jaxpr thunk; for foreign artifacts the audit runs on
-    the textual forms alone.
+    the textual forms alone. When the meta also carries the donated-pytree
+    class join (``memory_classes``) and ``memory`` is left on, the report's
+    ``memory`` field is the static HBM audit (analysis/memory.py) computed
+    from the SAME lowering and executable — no second compile.
     """
     lower = getattr(built, "lower", None)
     if lower is None:
@@ -563,7 +576,7 @@ def audit_built(built, *args, intermediate_threshold_bytes: int = 64 * 1024 * 10
             jaxpr = jaxpr_thunk(*args, **kwargs)
         except Exception:
             jaxpr = None
-    return audit_lowered(
+    report = audit_lowered(
         lowered,
         mesh=meta.get("mesh", mesh),
         expected_donations=meta.get("expected_donations"),
@@ -574,3 +587,12 @@ def audit_built(built, *args, intermediate_threshold_bytes: int = 64 * 1024 * 10
         builder=meta.get("builder", getattr(built, "__name__", "unknown")),
         intermediate_threshold_bytes=intermediate_threshold_bytes,
     )
+    compiled = report.__dict__.pop("_compiled", None)
+    if memory and meta.get("memory_classes"):
+        from .memory import memory_report_from_lowered
+
+        report.memory = memory_report_from_lowered(
+            lowered, meta=meta, mesh=meta.get("mesh", mesh),
+            compiled=compiled, budget_bytes=memory_budget_bytes,
+        )
+    return report

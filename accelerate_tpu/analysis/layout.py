@@ -1,0 +1,205 @@
+"""Sharding-layout auditor — implicit resharding copies, statically.
+
+GSPMD propagates sharding from annotated anchors (entry parameters,
+``with_sharding_constraint`` sites); wherever a producer's annotated layout
+disagrees with the layout a consumer pins, the partitioner inserts a
+resharding copy — an all-gather when the constraint widens to replicated, a
+dynamic-slice/scatter when it narrows, a collective-permute/all-to-all
+otherwise. None of that is visible in the Python source: the cost appears
+only in the lowered program. This module reads it back out of the textual
+StableHLO (``lowered.as_text()``), **before partitioning**, where the
+annotations still exist:
+
+- entry parameters carry ``mhlo.sharding = "{devices=[8,1]<=[8]}"``-style
+  attributes;
+- every ``with_sharding_constraint`` lowers to
+  ``stablehlo.custom_call @Sharding`` with the pinned layout as the same
+  attribute.
+
+:func:`find_implicit_reshards` threads values through the module and emits a
+:class:`ReshardSite` wherever a value with a known annotated layout is
+re-pinned to a *different* one. A ``sharded → replicated`` transition is the
+memory-relevant degenerate case (:class:`ReshardSite.kind` ``"gather"``): it
+re-materializes the tensor at full global size on every device — exactly the
+hidden-copy class the ``replicated-constraint`` lint rule blocks at the
+source level and the memory auditor (:mod:`.memory`) prices in bytes.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+
+_DTYPE_BYTES = {
+    "i1": 0.125, "i8": 1, "ui8": 1, "f8E4M3FN": 1, "f8E5M2": 1,
+    "i16": 2, "ui16": 2, "f16": 2, "bf16": 2,
+    "i32": 4, "ui32": 4, "f32": 4,
+    "i64": 8, "ui64": 8, "f64": 8,
+}
+
+
+def _tensor_nbytes(tensor_text: str) -> int:
+    """Bytes of a StableHLO tensor type body like ``16x8xf32`` (global, i.e.
+    pre-partitioning, shape)."""
+    parts = tensor_text.split("x")
+    dtype = parts[-1]
+    n = 1
+    for p in parts[:-1]:
+        if p.isdigit():
+            n *= int(p)
+    return int(n * _DTYPE_BYTES.get(dtype, 4))
+
+
+def _normalize(sharding: str) -> str:
+    """Canonical comparison form of an ``mhlo.sharding`` attribute value.
+
+    ``{replicated}``, a tile assignment of all-1 real dims
+    (``{devices=[1,1]<=[1]}``), and the ``last_tile_dim_replicate`` spelling
+    whose every REAL dim is 1 (``{devices=[1,1,8]<=[8]
+    last_tile_dim_replicate}`` — the last dim is the replication group, not a
+    tensor dim) all mean "one full copy per participant"; whitespace is
+    insignificant everywhere.
+    """
+    s = re.sub(r"\s+", "", sharding)
+    m = re.match(r"\{devices=\[([0-9,]+)\]", s)
+    if m:
+        dims = [int(d) for d in m.group(1).split(",")]
+        if "last_tile_dim_replicate" in s:
+            dims = dims[:-1]
+        if all(d == 1 for d in dims):
+            return "{replicated}"
+    return s
+
+
+def _is_replicated(sharding: str) -> bool:
+    s = _normalize(sharding)
+    # last_tile_dim_replicate with every real dim 1 also normalizes above;
+    # a plain {replicated} is the canonical spelling.
+    return s == "{replicated}"
+
+
+@dataclass
+class ReshardSite:
+    """One implicit resharding copy: a value annotated with one layout,
+    re-pinned to a different one."""
+
+    value: str          # SSA name of the re-pinned value
+    shape: str          # tensor type body, e.g. "16x8xf32" (GLOBAL shape)
+    nbytes: int         # global bytes of the tensor being resharded
+    from_sharding: str
+    to_sharding: str
+    # "gather"  — sharded → replicated: full-size re-materialization/device
+    # "scatter" — replicated → sharded: cheap (a local slice), inventoried
+    # "reshard" — sharded → differently-sharded: collective traffic
+    kind: str
+    source: str = ""    # loc()/op metadata when present
+
+    def to_dict(self) -> dict:
+        return {
+            "value": self.value,
+            "shape": self.shape,
+            "nbytes": self.nbytes,
+            "from": self.from_sharding,
+            "to": self.to_sharding,
+            "kind": self.kind,
+            "source": self.source,
+        }
+
+    def format(self) -> str:
+        return (
+            f"{self.kind}: {self.shape} ({self.nbytes} B global) "
+            f"{self.from_sharding} -> {self.to_sharding}"
+        )
+
+
+_ARG_ATTR = re.compile(
+    r"%arg(\d+):\s*tensor<([^>]*)>\s*\{[^}]*mhlo\.sharding\s*=\s*\"([^\"]*)\""
+)
+_SHARDING_CALL = re.compile(
+    r"(%[\w.#]+)\s*=\s*stablehlo\.custom_call\s+@Sharding\((%[\w.#]+)\)\s*"
+    r"\{[^\n]*?mhlo\.sharding\s*=\s*\"([^\"]*)\"[^\n]*?\}\s*:\s*"
+    r"\(tensor<([^>]*)>\)"
+)
+# Any single-result StableHLO op: result name, operand names, operand types,
+# result type. Used for SHAPE-PRESERVING propagation — a result keeps a known
+# operand's annotation only when their tensor types match exactly (elementwise
+# chains, converts of same-shape layouts stay attributed; anything that
+# reshapes/reduces/contracts drops out, so the detector never guesses).
+_GENERIC_OP = re.compile(
+    r"^\s*(%[\w.#]+)\s*=\s*\"?stablehlo\.[\w.]+\"?[^(%]*\(([^)]*)\)"
+    r".*?:\s*\(([^)]*)\)\s*->\s*tensor<([^>]*)>"
+)
+# The compact elementwise form: `%1 = stablehlo.multiply %arg0, %0 :
+# tensor<16x8xf32>` — operands and result share one type by construction.
+_COMPACT_OP = re.compile(
+    r"^\s*(%[\w.#]+)\s*=\s*stablehlo\.[\w.]+\s+"
+    r"((?:%[\w.#]+(?:,\s*)?)+).*?:\s*tensor<([^>]*)>\s*$"
+)
+_OPERAND_NAME = re.compile(r"%[\w.#]+")
+_OPERAND_TYPE = re.compile(r"tensor<([^>]*)>")
+
+
+def find_implicit_reshards(stablehlo_text: str) -> list:
+    """Walk the lowered module's sharding annotations; return every
+    :class:`ReshardSite` where a value with a KNOWN annotated layout is pinned
+    to a different one. Annotations flow from the anchors (entry parameters,
+    prior ``@Sharding`` pins) through shape-preserving ops only; values the
+    conservative walk can't attribute are skipped — provable mismatches,
+    never guessed propagation."""
+    known: dict[str, str] = {}
+    # Entry-parameter anchors.
+    header = re.search(r"func\.func public @main\((.*?)\)\s*->", stablehlo_text, re.DOTALL)
+    if header:
+        for m in _ARG_ATTR.finditer(header.group(1)):
+            known[f"%arg{m.group(1)}"] = m.group(3)
+    sites: list[ReshardSite] = []
+    for line in stablehlo_text.splitlines():
+        m = _SHARDING_CALL.search(line)
+        if not m:
+            if "custom_call" in line:
+                continue
+            gm = _GENERIC_OP.match(line)
+            if gm:
+                result, operands_text, types_text, result_type = gm.groups()
+                operands = _OPERAND_NAME.findall(operands_text)
+                types = _OPERAND_TYPE.findall(types_text)
+            else:
+                cm = _COMPACT_OP.match(line)
+                if not cm:
+                    continue
+                result, operands_text, result_type = cm.groups()
+                operands = _OPERAND_NAME.findall(operands_text)
+                types = [result_type] * len(operands)
+            carried = {
+                _normalize(known[op])
+                for op, t in zip(operands, types)
+                if op in known and t == result_type
+            }
+            if len(carried) == 1:
+                known[result] = carried.pop()
+            continue
+        result, operand, sharding, tensor = m.groups()
+        prev = known.get(operand)
+        if prev is not None and _normalize(prev) != _normalize(sharding):
+            if _is_replicated(sharding):
+                kind = "gather"
+            elif _is_replicated(prev):
+                kind = "scatter"
+            else:
+                kind = "reshard"
+            src = ""
+            loc = re.search(r'loc\("([^"]*)"', line)
+            if loc:
+                src = loc.group(1)[:120]
+            sites.append(ReshardSite(
+                value=result, shape=tensor, nbytes=_tensor_nbytes(tensor),
+                from_sharding=_normalize(prev), to_sharding=_normalize(sharding),
+                kind=kind, source=src,
+            ))
+        known[result] = sharding
+    return sites
+
+
+def gather_reshards(sites: list) -> list:
+    """The memory-relevant subset: sharded → replicated re-materializations."""
+    return [s for s in sites if s.kind == "gather"]

@@ -35,6 +35,18 @@ table):
 - ``uncounted-block-until-ready`` — ``block_until_ready`` in library code:
   a hard dispatch stall; hot paths must retain values and drain via counted
   fetches.
+- ``raw-device-baseline`` — ``jax.devices()`` / ``jax.local_devices()`` used
+  as a mesh or world-size baseline outside ``parallel/mesh.py`` and
+  ``state.py`` (the mesh owners): an elastic reshard re-forms the mesh from
+  the SURVIVING device set, so a raw device list silently desyncs from the
+  layout every compiled program actually uses — the exact bug class the
+  elastic-runner review caught. Legitimate capacity/telemetry readers
+  (timeline memory stats, the HBM table, placement planning) are baselined,
+  not rule-exempt, so NEW readers must justify themselves.
+- ``replicated-constraint`` — ``with_sharding_constraint(..., P())`` (or any
+  fully-unspecified spec) on a hot-path module: pins a possibly-large
+  intermediate fully replicated on every device — the memory auditor's
+  ``gather`` reshard, blocked here at the source level.
 
 Suppression: append ``# accelerate-lint: disable=<rule>[,<rule>...]`` to the
 flagged line. Grandfathered findings live in a baseline file (JSON, keyed on
@@ -68,6 +80,27 @@ _ASARRAY_SCOPE = (
     "data_loader.py",
     "accelerator.py",
     "train_steps.py",
+)
+
+# The two modules that legitimately OWN the device list: mesh construction
+# and process-state bootstrap. Everyone else derives layout from the mesh.
+_MESH_HOME = ("parallel/mesh.py", "state.py")
+
+# The sharding-helper home: `replicated(mesh)` et al. are definitionally
+# empty-spec constructors.
+_SHARDING_HOME = ("parallel/sharding.py",)
+
+# Hot-path modules where an empty-spec constraint replicates a live
+# intermediate (vs host-side planning code, where P() is just a default).
+_CONSTRAINT_SCOPE = (
+    "accelerator.py",
+    "serving.py",
+    "optimizer.py",
+    "train_steps.py",
+    "local_sgd.py",
+    "ops/",
+    "parallel/",
+    "models/",
 )
 
 # Test scaffolding ships inside the package but is not framework hot path.
@@ -135,6 +168,25 @@ RULES = (
         remedy="retain the value; drain via counted host_fetch once is_ready",
         exclude=_TRANSFER_HOME,
     ),
+    Rule(
+        name="raw-device-baseline",
+        summary="jax.devices()/jax.local_devices() as a mesh or world-size "
+                "baseline outside the mesh owners (parallel/mesh.py, state.py)",
+        remedy="derive layout from the live mesh (accelerator.mesh / "
+               "state.device_mesh) — raw device lists desync after an "
+               "elastic reshard; capacity/telemetry readers are baselined",
+        exclude=_MESH_HOME,
+    ),
+    Rule(
+        name="replicated-constraint",
+        summary="with_sharding_constraint to a fully-unspecified spec (P()) "
+                "on a hot-path module — replicates the intermediate at full "
+                "size on every device",
+        remedy="name the axes you mean (P('dp', ...), the param's sharding) "
+               "or drop the constraint and let GSPMD propagate",
+        include=_CONSTRAINT_SCOPE,
+        exclude=_SHARDING_HOME,
+    ),
 )
 
 _RULES_BY_NAME = {r.name: r for r in RULES}
@@ -192,6 +244,25 @@ def _terminal_name(node) -> str:
     if isinstance(node, ast.Name):
         return node.id
     return ""
+
+
+def _is_replicated_spec(node) -> bool:
+    """Literal fully-unspecified sharding spec expressions: ``P()`` /
+    ``PartitionSpec()`` with no entries, ``NamedSharding(mesh, P())``
+    wrapping one, or the ``replicated(mesh)`` helper."""
+    if not isinstance(node, ast.Call):
+        return False
+    name = _terminal_name(node.func)
+    if name in ("P", "PartitionSpec") and not node.args and not node.keywords:
+        return True
+    if name == "replicated":
+        return True
+    if name == "NamedSharding":
+        spec_args = list(node.args[1:]) + [
+            kw.value for kw in node.keywords if kw.arg in (None, "spec")
+        ]
+        return any(_is_replicated_spec(a) for a in spec_args)
+    return False
 
 
 def _is_jit_decorator(dec) -> bool:
@@ -320,6 +391,17 @@ class _Visitor(ast.NodeVisitor):
         if term == "device_get":
             self._emit("uncounted-device-get", node,
                        f"{callee or 'device_get'}(...) is an uncounted fetch")
+
+        if callee in ("jax.devices", "jax.local_devices") and not node.args \
+                and not node.keywords:
+            self._emit("raw-device-baseline", node,
+                       f"{callee}() is a raw device-list baseline")
+
+        if term == "with_sharding_constraint":
+            spec_args = list(node.args[1:]) + [kw.value for kw in node.keywords]
+            if any(_is_replicated_spec(a) for a in spec_args):
+                self._emit("replicated-constraint", node,
+                           "constraint pins a fully-replicated layout")
 
         if isinstance(node.func, ast.Attribute) and node.func.attr == "item" \
                 and not node.args and not node.keywords:

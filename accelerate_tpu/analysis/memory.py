@@ -1,0 +1,444 @@
+"""Static HBM auditor — per-device memory budgets, OOM-before-launch.
+
+A config that does not fit in HBM fails minutes into compile (or worse, one
+allocation into step 1) after real chip-time was spent. Everything needed to
+know that *before* launch is statically available: the builders know exactly
+which flat input buffers are params / optimizer state / accumulation buffer
+(the donated pytrees behind ``donate_argnums=(0, 1, 2, 3)``), each leaf's
+:class:`~jax.sharding.NamedSharding` says which named mesh axes shard it —
+and therefore where bytes are *replicated* — and the compiled executable's
+``memory_analysis()`` prices the activation workspace and scratch the
+partitioned program will actually allocate per device.
+
+:func:`memory_report_from_built` joins the three into a
+:class:`MemoryReport`:
+
+- **per-device bytes by class** — ``params`` / ``opt_state`` / ``accum``
+  from the builders' donated-pytree metadata (``_audit_meta`` — the same
+  surface :mod:`.audit` consumes), plus ``batch`` (argument bytes the donated
+  classes don't own), ``activation_workspace`` (XLA temp allocation), and
+  unaliased ``temp_output``;
+- **sharded vs replicated split per named mesh axis** — a leaf whose spec
+  does not name an axis holds one full copy per coordinate of that axis, so
+  ``opt_state replicated on dp: 2.1 GiB/chip`` is a first-class
+  :class:`ReplicationFinding` with the exact 1/dp savings cross-replica
+  sharding (ROADMAP item 2, arxiv 2004.13336) would recover;
+- **implicit resharding copies** — producer/consumer sharding-annotation
+  mismatches from :mod:`.layout`;
+- **an OOM verdict** — predicted per-device peak (arguments + workspace +
+  outputs, donation-aliased bytes counted ONCE via the compiled module's
+  alias table) against the per-generation HBM table in
+  ``utils/modeling.py`` under the same ``HBM_HEADROOM`` (90%) contract
+  ``get_max_memory`` applies.
+
+Surfaced as ``Accelerator.audit(...).memory`` / ``Accelerator.
+memory_report(...)``, the ``accelerate-tpu memcheck`` CLI (exit 1 on a
+predicted OOM), ``detail.memory`` on every ``bench.py`` line (schema v5),
+and the step timeline's predicted-vs-observed peak cross-check.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from .layout import find_implicit_reshards, gather_reshards
+
+
+def _leaf_name(path) -> str:
+    from ..parallel.sharding import path_str
+
+    return path_str(path)
+
+
+def _spec_axes(sharding) -> tuple:
+    """Mesh axis names a NamedSharding's spec shards over (flattened; () for
+    replicated / non-named shardings)."""
+    spec = getattr(sharding, "spec", None)
+    if spec is None:
+        return ()
+    axes = []
+    for entry in spec:
+        if entry is None:
+            continue
+        if isinstance(entry, (tuple, list)):
+            axes.extend(a for a in entry if a is not None)
+        else:
+            axes.append(entry)
+    return tuple(axes)
+
+
+@dataclass
+class LeafMemory:
+    """One flat buffer of a donated pytree class."""
+
+    name: str
+    shape: tuple
+    dtype: str
+    global_nbytes: int
+    per_device_nbytes: int
+    sharded_axes: tuple   # mesh axes named in this leaf's partition spec
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "shape": list(self.shape),
+            "dtype": self.dtype,
+            "global_nbytes": self.global_nbytes,
+            "per_device_nbytes": self.per_device_nbytes,
+            "sharded_axes": list(self.sharded_axes),
+        }
+
+
+@dataclass
+class ClassMemory:
+    """Per-device memory of one buffer class (params / opt_state / accum)."""
+
+    name: str
+    donated: bool
+    leaves: list = field(default_factory=list)    # [LeafMemory]
+
+    @property
+    def global_bytes(self) -> int:
+        return sum(l.global_nbytes for l in self.leaves)
+
+    @property
+    def per_device_bytes(self) -> int:
+        return sum(l.per_device_nbytes for l in self.leaves)
+
+    def sharded_bytes(self, axis: str) -> int:
+        """Per-device bytes of leaves this axis actually shards."""
+        return sum(l.per_device_nbytes for l in self.leaves if axis in l.sharded_axes)
+
+    def replicated_bytes(self, axis: str) -> int:
+        """Per-device bytes held as a FULL copy along ``axis`` — every
+        coordinate of the axis stores these bytes again."""
+        return sum(
+            l.per_device_nbytes for l in self.leaves if axis not in l.sharded_axes
+        )
+
+    def by_axis(self, mesh_axes: dict) -> dict:
+        """{axis: {"sharded": bytes, "replicated": bytes}} per device, over
+        mesh axes of size > 1 (a size-1 axis replicates nothing)."""
+        return {
+            axis: {
+                "sharded": self.sharded_bytes(axis),
+                "replicated": self.replicated_bytes(axis),
+            }
+            for axis, size in mesh_axes.items()
+            if size > 1
+        }
+
+    def to_dict(self, mesh_axes: dict) -> dict:
+        return {
+            "donated": self.donated,
+            "global_bytes": self.global_bytes,
+            "per_device_bytes": self.per_device_bytes,
+            "by_axis": self.by_axis(mesh_axes),
+            "leaves": len(self.leaves),
+        }
+
+
+@dataclass
+class ReplicationFinding:
+    """Bytes a class holds replicated along a named mesh axis — the savings
+    target of cross-replica (ZeRO-style) sharding."""
+
+    cls: str
+    axis: str
+    axis_size: int
+    per_device_bytes: int
+
+    @property
+    def savings_bytes(self) -> int:
+        """Per-device bytes sharding this class over the axis would free."""
+        return int(self.per_device_bytes * (1 - 1 / self.axis_size))
+
+    def format(self) -> str:
+        gib = self.per_device_bytes / (1 << 30)
+        save = self.savings_bytes / (1 << 30)
+        return (
+            f"{self.cls} replicated on {self.axis}: {gib:.3f} GiB/chip "
+            f"(sharding over {self.axis}={self.axis_size} would free "
+            f"{save:.3f} GiB/chip)"
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "class": self.cls,
+            "axis": self.axis,
+            "axis_size": self.axis_size,
+            "per_device_bytes": self.per_device_bytes,
+            "savings_bytes": self.savings_bytes,
+        }
+
+
+def classify_pytree(name: str, values, shardings, mesh_axes: dict,
+                    donated: bool) -> ClassMemory:
+    """Flatten one donated pytree into sized, sharding-attributed leaves.
+
+    Per-device bytes divide the global leaf size by the product of the sizes
+    of the mesh axes its spec names — the GSPMD contract that a named axis
+    partitions the corresponding dim. Leaves whose spec names no axis hold
+    one full copy per device."""
+    import jax
+
+    cls = ClassMemory(name=name, donated=donated)
+    paths, _ = jax.tree_util.tree_flatten_with_path(values)
+    shard_leaves = jax.tree_util.tree_leaves(
+        shardings, is_leaf=lambda s: hasattr(s, "spec") or s is None
+    )
+    if len(shard_leaves) != len(paths):
+        shard_leaves = [None] * len(paths)
+    for (path, leaf), sharding in zip(paths, shard_leaves):
+        shape = tuple(getattr(leaf, "shape", ()))
+        dtype = getattr(leaf, "dtype", None)
+        itemsize = np.dtype(dtype).itemsize if dtype is not None else 4
+        global_nbytes = int(np.prod(shape, dtype=np.int64)) * itemsize if shape else itemsize
+        axes = tuple(a for a in _spec_axes(sharding) if a in mesh_axes)
+        divisor = 1
+        for a in axes:
+            divisor *= int(mesh_axes[a])
+        per_device = int(-(-global_nbytes // divisor))  # ceil: XLA pads shards
+        cls.leaves.append(LeafMemory(
+            name=_leaf_name(path), shape=shape,
+            dtype=str(np.dtype(dtype)) if dtype is not None else "?",
+            global_nbytes=global_nbytes, per_device_nbytes=per_device,
+            sharded_axes=axes,
+        ))
+    return cls
+
+
+@dataclass
+class MemoryReport:
+    """Structured result of one static memory audit (see module docstring;
+    schema documented in docs/analysis.md)."""
+
+    builder: str = "unknown"
+    mesh_axes: dict = field(default_factory=dict)
+    window: int = 1
+    classes: dict = field(default_factory=dict)        # {name: ClassMemory}
+    donation_dropped_by_policy: bool = False
+    memory_analysis_available: bool = False
+    # Per-device bytes from compiled.memory_analysis() (0 when unavailable).
+    argument_bytes: int = 0
+    output_bytes: int = 0
+    temp_bytes: int = 0            # activation workspace + scratch
+    aliased_bytes: int = 0         # donation-aliased output bytes (counted once)
+    generated_code_bytes: int = 0
+    batch_bytes: int = 0           # argument bytes the donated classes don't own
+    predicted_peak_bytes: int = 0  # per device
+    hbm_bytes_per_device: int = 0
+    headroom: float = 0.9
+    budget_bytes: int = 0
+    replication_findings: list = field(default_factory=list)
+    reshards: list = field(default_factory=list)       # [layout.ReshardSite]
+
+    @property
+    def fits(self) -> bool:
+        """The OOM-before-launch verdict: predicted per-device peak within
+        the headroomed HBM budget."""
+        return self.predicted_peak_bytes <= self.budget_bytes
+
+    def replicated_bytes(self, cls: str, axis: str) -> int:
+        """Per-device bytes of ``cls`` replicated along ``axis`` — 0 when the
+        mesh has no such axis (or it has size 1): nothing is replicated over
+        an axis that doesn't partition anything, so a tp/fsdp-only mesh never
+        reports a phantom dp footprint (nor trips the memcheck gate on one)."""
+        if self.mesh_axes.get(axis, 1) <= 1:
+            return 0
+        c = self.classes.get(cls)
+        return c.replicated_bytes(axis) if c is not None else 0
+
+    @property
+    def gather_reshards(self) -> list:
+        """The memory-relevant reshard subset: sharded → replicated."""
+        return gather_reshards(self.reshards)
+
+    def findings(self) -> list:
+        """Human-readable findings, largest first."""
+        out = [
+            f.format()
+            for f in sorted(
+                self.replication_findings, key=lambda f: -f.per_device_bytes
+            )
+        ]
+        out.extend(s.format() for s in self.reshards)
+        if not self.fits:
+            out.append(
+                f"predicted OOM: peak {self.predicted_peak_bytes / (1 << 30):.3f} "
+                f"GiB/chip exceeds budget {self.budget_bytes / (1 << 30):.3f} GiB "
+                f"({self.headroom:.0%} of {self.hbm_bytes_per_device / (1 << 30):.0f} GiB HBM)"
+            )
+        return out
+
+    def per_device_by_class(self) -> dict:
+        """The five-class per-device byte attribution."""
+        out = {name: c.per_device_bytes for name, c in self.classes.items()}
+        out["batch"] = self.batch_bytes
+        out["activation_workspace"] = self.temp_bytes
+        out["temp_output"] = max(0, self.output_bytes - self.aliased_bytes)
+        return out
+
+    def to_dict(self) -> dict:
+        return {
+            "builder": self.builder,
+            "mesh_axes": dict(self.mesh_axes),
+            "window": self.window,
+            "fits": self.fits,
+            "predicted_peak_bytes": self.predicted_peak_bytes,
+            "budget_bytes": self.budget_bytes,
+            "hbm_bytes_per_device": self.hbm_bytes_per_device,
+            "headroom": self.headroom,
+            "memory_analysis_available": self.memory_analysis_available,
+            "per_device_bytes": self.per_device_by_class(),
+            "classes": {
+                name: c.to_dict(self.mesh_axes) for name, c in self.classes.items()
+            },
+            "donation_dropped_by_policy": self.donation_dropped_by_policy,
+            "aliased_bytes": self.aliased_bytes,
+            "argument_bytes": self.argument_bytes,
+            "output_bytes": self.output_bytes,
+            "replication_findings": [
+                f.to_dict()
+                for f in sorted(
+                    self.replication_findings, key=lambda f: -f.per_device_bytes
+                )
+            ],
+            "reshards": [s.to_dict() for s in self.reshards],
+            "findings": self.findings(),
+        }
+
+    def summary_dict(self) -> dict:
+        """Compact form for bench.py's ``detail.memory`` — byte totals and
+        the headline findings, not per-leaf inventory."""
+        return {
+            "fits": self.fits,
+            "predicted_peak_bytes": self.predicted_peak_bytes,
+            "budget_bytes": self.budget_bytes,
+            "window": self.window,
+            "per_device_bytes": self.per_device_by_class(),
+            "opt_state_replicated_dp_bytes": self.replicated_bytes("opt_state", "dp"),
+            "reshards": len(self.reshards),
+            "gather_reshards": len(self.gather_reshards),
+            "memory_analysis_available": self.memory_analysis_available,
+        }
+
+
+# ------------------------------------------------------------------ builders
+def memory_report_from_lowered(
+    lowered,
+    meta: dict | None = None,
+    mesh=None,
+    compiled=None,
+    headroom: float | None = None,
+    budget_bytes: int | None = None,
+    device=None,
+    builder: str | None = None,
+) -> MemoryReport:
+    """Build a :class:`MemoryReport` from an existing ``jax.stages.Lowered``
+    (and optionally its already-compiled executable, so an audit that just
+    compiled doesn't pay twice).
+
+    ``meta`` is the builders' ``_audit_meta``: its ``memory_classes`` thunks
+    supply the donated pytrees and their shardings; without it the report
+    carries executable-level totals only (classes empty)."""
+    from ..utils.modeling import HBM_HEADROOM, device_hbm_bytes
+
+    meta = meta or {}
+    mesh = meta.get("mesh", mesh)
+    mesh_axes: dict = {}
+    if mesh is not None and getattr(mesh, "axis_names", None):
+        mesh_axes = dict(zip(tuple(mesh.axis_names), tuple(mesh.devices.shape)))
+
+    headroom = HBM_HEADROOM if headroom is None else float(headroom)
+    hbm = device_hbm_bytes(device)
+    report = MemoryReport(
+        builder=builder or meta.get("builder", "unknown"),
+        mesh_axes=mesh_axes,
+        window=int(meta.get("window", 1)),
+        donation_dropped_by_policy=bool(meta.get("donation_dropped_by_policy", False)),
+        headroom=headroom,
+        hbm_bytes_per_device=int(hbm),
+        budget_bytes=int(budget_bytes) if budget_bytes is not None else int(hbm * headroom),
+    )
+
+    donated = bool(meta.get("expected_donations")) and not report.donation_dropped_by_policy
+    for name, (values_fn, shardings_fn) in (meta.get("memory_classes") or {}).items():
+        try:
+            values, shardings = values_fn(), shardings_fn()
+        except Exception:
+            continue
+        report.classes[name] = classify_pytree(
+            name, values, shardings, mesh_axes, donated=donated
+        )
+
+    report.reshards = find_implicit_reshards(lowered.as_text())
+
+    if compiled is None:
+        compiled = lowered.compile()
+    analysis = None
+    try:
+        analysis = compiled.memory_analysis()
+    except Exception:
+        analysis = None
+    class_total = sum(c.per_device_bytes for c in report.classes.values())
+    if analysis is not None:
+        report.memory_analysis_available = True
+        report.argument_bytes = int(analysis.argument_size_in_bytes)
+        report.output_bytes = int(analysis.output_size_in_bytes)
+        report.temp_bytes = int(analysis.temp_size_in_bytes)
+        report.aliased_bytes = int(analysis.alias_size_in_bytes)
+        report.generated_code_bytes = int(analysis.generated_code_size_in_bytes)
+        report.batch_bytes = max(0, report.argument_bytes - class_total)
+        # Live-through-execution arguments + workspace + outputs, with
+        # donation-aliased output bytes counted ONCE (they reuse argument
+        # memory in place — the double-count the alias table exists to kill).
+        report.predicted_peak_bytes = (
+            report.argument_bytes
+            + report.temp_bytes
+            + report.output_bytes
+            - report.aliased_bytes
+        )
+    else:
+        # Backend without memory_analysis(): class bytes (one copy; outputs
+        # alias donated inputs on every backend that keeps donation) is the
+        # honest floor — flagged as such via memory_analysis_available.
+        report.predicted_peak_bytes = class_total
+
+    for name, cls in report.classes.items():
+        for axis, size in mesh_axes.items():
+            if size <= 1:
+                continue
+            rep = cls.replicated_bytes(axis)
+            if rep > 0:
+                report.replication_findings.append(ReplicationFinding(
+                    cls=name, axis=axis, axis_size=int(size), per_device_bytes=rep,
+                ))
+    return report
+
+
+def memory_report_from_built(
+    built, *args,
+    mesh=None,
+    headroom: float | None = None,
+    budget_bytes: int | None = None,
+    device=None,
+    **kwargs,
+) -> MemoryReport:
+    """Memory-audit a built artifact — anything exposing ``.lower(...)``;
+    the fused builders' ``_audit_meta`` supplies the class join."""
+    lower = getattr(built, "lower", None)
+    if lower is None:
+        raise TypeError(
+            f"{built!r} has no .lower(...); pass a built train step/window or "
+            "a jitted function, or lower it yourself and call "
+            "memory_report_from_lowered."
+        )
+    meta = getattr(built, "_audit_meta", None) or {}
+    lowered = lower(*args, **kwargs)
+    return memory_report_from_lowered(
+        lowered, meta=meta, mesh=meta.get("mesh", mesh),
+        headroom=headroom, budget_bytes=budget_bytes, device=device,
+    )

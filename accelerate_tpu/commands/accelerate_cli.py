@@ -7,7 +7,11 @@ from __future__ import annotations
 
 import argparse
 
-from .analysis import audit_command_parser, lint_command_parser
+from .analysis import (
+    audit_command_parser,
+    lint_command_parser,
+    memcheck_command_parser,
+)
 from .config import config_command_parser
 from .env import env_command_parser
 from .estimate import estimate_command_parser
@@ -33,6 +37,7 @@ def main() -> None:
     tpu_command_parser(subparsers=subparsers)
     lint_command_parser(subparsers=subparsers)
     audit_command_parser(subparsers=subparsers)
+    memcheck_command_parser(subparsers=subparsers)
     profile_command_parser(subparsers=subparsers)
     blackbox_command_parser(subparsers=subparsers)
 

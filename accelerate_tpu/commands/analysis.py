@@ -1,4 +1,4 @@
-"""`accelerate-tpu lint` / `accelerate-tpu audit` — the static-analysis CLI.
+"""`accelerate-tpu lint` / `audit` / `memcheck` — the static-analysis CLI.
 
 ``lint`` runs the invariant linter (analysis/lint.py) over source paths and
 exits non-zero on any finding that is neither inline-suppressed nor
@@ -6,8 +6,14 @@ baselined. ``audit`` builds the tiny training config on the local backend,
 lowers the fused train step (or a K-step window), and prints the program
 audit report (analysis/audit.py) as JSON — exit status reflects the
 zero-tolerance invariants (dp-axis all-gathers, host callbacks, donation
-misses). Both are pre-chip gates: they inspect programs and source, never
-run a training step.
+misses). ``memcheck`` lowers the same artifact through the static memory
+auditor (analysis/memory.py) and prints the per-device HBM attribution —
+param / opt-state / accum / batch / activation-workspace bytes, the
+sharded-vs-replicated split per mesh axis, implicit resharding copies, and
+the OOM verdict — exiting 1 on a predicted OOM (``--budget-gib`` overrides
+the generation-table budget) or an over-threshold dp-replicated opt-state
+footprint (``--replicated-opt-gib``). All three are pre-chip gates: they
+inspect programs and source, never run a training step.
 """
 
 from __future__ import annotations
@@ -161,9 +167,11 @@ def audit_command_parser(subparsers=None) -> argparse.ArgumentParser:
     return parser
 
 
-def audit_command(args) -> None:
-    if args.window < 1:
-        raise SystemExit("--window must be >= 1")
+def _build_tiny_artifact(window: int, batch_rows: int, seq: int,
+                         optimizer: str = "sgd"):
+    """The shared audit/memcheck fixture: the tiny training config built on
+    the local backend, as a (accelerator, built_artifact, batch) triple —
+    window-stacked when ``window > 1``."""
     import numpy as np
     import jax
     import optax
@@ -175,16 +183,28 @@ def audit_command(args) -> None:
     cfg = LlamaConfig.tiny()
     model = Llama(cfg)
     model.init_params(jax.random.key(0))
-    pmodel, popt = accelerator.prepare(model, optax.sgd(0.1))
+    tx = {
+        "sgd": lambda: optax.sgd(0.1),
+        "adamw": lambda: optax.adamw(3e-4),
+        "adafactor": lambda: optax.adafactor(3e-4),
+    }[optimizer]()
+    pmodel, popt = accelerator.prepare(model, tx)
     ids = np.random.default_rng(0).integers(
-        0, cfg.vocab_size, (args.batch, args.seq)
+        0, cfg.vocab_size, (batch_rows, seq)
     ).astype(np.int32)
     batch = {"input_ids": ids, "labels": ids}
-    if args.window > 1:
-        built = accelerator.build_train_window(pmodel, popt, window=args.window)
-        batch = {k: np.stack([v] * args.window) for k, v in batch.items()}
+    if window > 1:
+        built = accelerator.build_train_window(pmodel, popt, window=window)
+        batch = {k: np.stack([v] * window) for k, v in batch.items()}
     else:
         built = accelerator.build_train_step(pmodel, popt)
+    return accelerator, built, batch
+
+
+def audit_command(args) -> None:
+    if args.window < 1:
+        raise SystemExit("--window must be >= 1")
+    accelerator, built, batch = _build_tiny_artifact(args.window, args.batch, args.seq)
     report = accelerator.audit(
         built, batch,
         intermediate_threshold_bytes=int(args.threshold_mb * 1024 * 1024),
@@ -193,6 +213,88 @@ def audit_command(args) -> None:
         report.summary_dict() if args.summary else report.to_dict(), indent=1
     ))
     if not report.clean:
+        raise SystemExit(1)
+
+
+# ----------------------------------------------------------------- memcheck
+def memcheck_command_parser(subparsers=None) -> argparse.ArgumentParser:
+    description = (
+        "Static HBM audit of the tiny train config: per-device bytes by "
+        "class (param/opt-state/accum/batch/activation-workspace), "
+        "sharded-vs-replicated split per mesh axis, implicit resharding "
+        "copies, and an OOM-before-launch verdict"
+    )
+    if subparsers is not None:
+        parser = subparsers.add_parser("memcheck", description=description)
+    else:
+        parser = argparse.ArgumentParser(
+            "accelerate-tpu memcheck", description=description
+        )
+    parser.add_argument(
+        "--window", type=int, default=1,
+        help="Audit a K-step fused train window instead of the per-step program",
+    )
+    parser.add_argument(
+        "--batch", type=int, default=8, help="Batch rows for the lowered program"
+    )
+    parser.add_argument(
+        "--seq", type=int, default=16, help="Sequence length for the lowered program"
+    )
+    parser.add_argument(
+        "--optimizer", choices=("adamw", "sgd", "adafactor"), default="adamw",
+        help="Optimizer whose state is audited (default adamw: the "
+             "2-moments-per-param worst case the replication findings target)",
+    )
+    parser.add_argument(
+        "--budget-gib", type=float, default=None,
+        help="Per-device HBM budget override (GiB); default is the chip "
+             "generation's HBM x the 90%% headroom contract. Exit 1 when the "
+             "predicted peak exceeds it.",
+    )
+    parser.add_argument(
+        "--replicated-opt-gib", type=float, default=None,
+        help="Exit 1 when opt-state bytes replicated on the dp axis exceed "
+             "this many GiB per chip (the ZeRO-sharding acceptance gate; "
+             "default: report only)",
+    )
+    parser.add_argument(
+        "--summary", action="store_true",
+        help="Print the compact summary (bench.py detail.memory form) instead "
+             "of the full report",
+    )
+    if subparsers is not None:
+        parser.set_defaults(func=memcheck_command)
+    return parser
+
+
+def memcheck_command(args) -> None:
+    if args.window < 1:
+        raise SystemExit("--window must be >= 1")
+    accelerator, built, batch = _build_tiny_artifact(
+        args.window, args.batch, args.seq, optimizer=args.optimizer
+    )
+    budget = int(args.budget_gib * (1 << 30)) if args.budget_gib is not None else None
+    report = accelerator.memory_report(built, batch, budget_bytes=budget)
+    print(json.dumps(
+        report.summary_dict() if args.summary else report.to_dict(), indent=1
+    ))
+    failures = []
+    if not report.fits:
+        failures.append(
+            f"predicted OOM: peak {report.predicted_peak_bytes} B/device "
+            f"exceeds budget {report.budget_bytes} B"
+        )
+    if args.replicated_opt_gib is not None:
+        rep = report.replicated_bytes("opt_state", "dp")
+        limit = int(args.replicated_opt_gib * (1 << 30))
+        if rep > limit:
+            failures.append(
+                f"opt_state replicated on dp: {rep} B/chip exceeds "
+                f"--replicated-opt-gib {args.replicated_opt_gib}"
+            )
+    for f in failures:
+        print(f"memcheck: {f}", file=sys.stderr)
+    if failures:
         raise SystemExit(1)
 
 
@@ -206,7 +308,12 @@ def audit_main() -> None:
     audit_command(audit_command_parser().parse_args())
 
 
+def memcheck_main() -> None:
+    """Console-script entry (`accelerate-tpu-memcheck`, pyproject [project.scripts])."""
+    memcheck_command(memcheck_command_parser().parse_args())
+
+
 if __name__ == "__main__":
-    # Two commands share this module; `python -m` can't pick one.
-    sys.exit("Run via `accelerate-tpu lint` / `accelerate-tpu audit` "
-             "(or the accelerate-tpu-lint / accelerate-tpu-audit scripts).")
+    # Three commands share this module; `python -m` can't pick one.
+    sys.exit("Run via `accelerate-tpu lint` / `audit` / `memcheck` "
+             "(or the accelerate-tpu-lint / -audit / -memcheck scripts).")

@@ -21,6 +21,13 @@ from ..utils.other import convert_bytes
 # Zoo presets: name → (family, config kwargs). Sizes follow the public LLaMA /
 # BERT architecture tables.
 PRESETS = {
+    # LlamaConfig.tiny()'s exact shape: the cross-validation anchor pinning
+    # this abstract-init estimate to the static memory auditor's param-class
+    # bytes (analysis/memory.py; tests/test_memory_analysis.py) — the two
+    # surfaces must not drift.
+    "tiny": ("llama", dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                           num_hidden_layers=2, num_attention_heads=4,
+                           num_key_value_heads=2, max_position_embeddings=128)),
     "llama-7b": ("llama", dict(vocab_size=32000, hidden_size=4096, intermediate_size=11008,
                                num_hidden_layers=32, num_attention_heads=32, num_key_value_heads=32)),
     "llama-13b": ("llama", dict(vocab_size=32000, hidden_size=5120, intermediate_size=13824,
@@ -164,6 +171,17 @@ def create_empty_model(model_name: str):
     else:
         model = _model_from_hf_config(_hub_config(model_name))
     return jax.eval_shape(lambda: model.init_params(jax.random.key(0)))
+
+
+def abstract_param_bytes(model_name: str) -> int:
+    """fp32 parameter bytes of a preset/config/Hub id from the abstract
+    (eval_shape) init — the number ``estimate-memory``'s table is built on.
+    The static memory auditor's ``params`` class must agree with this within
+    tolerance for the same config (the cross-validation test pins it), so the
+    planning-time estimate and the compile-time audit can't silently drift."""
+    params = create_empty_model(model_name)
+    total, _ = calculate_maximum_sizes(params)
+    return int(total)
 
 
 def estimate_command_parser(subparsers=None) -> argparse.ArgumentParser:

@@ -125,6 +125,7 @@ class StepTimeline:
         self._last_end = None
         self._last_step = None
         self._flops_per_token = None
+        self._predicted_peak = None
         # Retained (NOT fetched) device loss scalars; drained when materialized.
         self._pending_loss: collections.deque = collections.deque(maxlen=4)
         self._last_loss = None
@@ -148,6 +149,14 @@ class StepTimeline:
     def set_model_flops(self, flops_per_token: float):
         """Forward+backward FLOPs per token — enables the MFU estimate."""
         self._flops_per_token = float(flops_per_token) if flops_per_token else None
+
+    def set_predicted_peak(self, nbytes: int | None):
+        """Static per-device peak-HBM prediction (analysis/memory.py, fed by
+        ``Accelerator.audit``/``memory_report``) — ``summary()`` then carries
+        it next to the observed ``memory_stats()`` peak so a prediction that
+        drifts from reality is visible in every bench line and Prometheus
+        scrape, not just at memcheck time."""
+        self._predicted_peak = int(nbytes) if nbytes else None
 
     @property
     def count(self) -> int:
@@ -303,7 +312,7 @@ class StepTimeline:
                 ),
             },
             "xla_preset": active_preset(),
-            "memory": device_memory_stats(),
+            "memory": self._memory_summary(),
         }
         # Profiling (telemetry/profiler.py): present only when a trace capture
         # engaged this run — un-profiled summaries keep their schema.
@@ -312,6 +321,24 @@ class StepTimeline:
         profile = default_manager_summary()
         if profile is not None:
             out["profile"] = profile
+        return out
+
+    def _memory_summary(self) -> dict:
+        """Live ``memory_stats()`` plus, once a static audit armed it, the
+        predicted per-device peak — and the predicted/observed ratio when the
+        backend reports a peak (TPU/GPU; CPU devices have no memory_stats, so
+        the prediction stands alone there). memory_stats() sums are TOTALS
+        over local devices; the prediction is per device, so the ratio
+        normalizes by the local device count."""
+        out = device_memory_stats()
+        if self._predicted_peak is not None:
+            out["predicted_peak_bytes"] = self._predicted_peak
+            observed = out.get("peak_bytes_in_use", 0)
+            n_local = max(len(jax.local_devices()), 1)  # accelerate-lint: disable=raw-device-baseline
+            if observed > 0:
+                out["predicted_vs_observed"] = round(
+                    self._predicted_peak / (observed / n_local), 3
+                )
         return out
 
     def reset(self):
@@ -325,5 +352,6 @@ class StepTimeline:
         self._last_step = None
         self._pending_loss.clear()
         self._last_loss = None
+        self._predicted_peak = None
         self._window_s, self._window_steps = 0.0, 0
         self._transfer0 = transfer.transfer_stats()

@@ -140,6 +140,13 @@ def find_tied_parameters(params) -> list[list[str]]:
 
 
 # ------------------------------------------------------------------ device memory
+# Shared headroom contract: planners budget 90% of capacity (the reference's
+# ``get_max_memory`` scaling) — the same fraction the static memory auditor
+# (analysis/memory.py) and ``accelerate-tpu memcheck`` gate their OOM verdict
+# on, so "fits" means the same thing at plan time and at audit time.
+HBM_HEADROOM = 0.9
+
+
 def _device_hbm_bytes(device) -> int:
     stats_fn = getattr(device, "memory_stats", None)
     if stats_fn is not None:
@@ -164,6 +171,17 @@ def _device_hbm_bytes(device) -> int:
     return 16 << 30  # conservative default; CPU "devices" in tests hit this too
 
 
+def device_hbm_bytes(device=None) -> int:
+    """Per-chip memory capacity in bytes: live ``memory_stats()['bytes_limit']``
+    when the backend reports one, else the per-generation HBM table (v4 32G /
+    v5e 16G / v5p 95G / v6e 32G; conservative 16G default). The denominator of
+    both the placement planner's budgets and the static memory auditor's
+    OOM verdict (analysis/memory.py)."""
+    if device is None:
+        device = jax.local_devices()[0]  # accelerate-lint: disable=raw-device-baseline
+    return _device_hbm_bytes(device)
+
+
 def get_max_memory(max_memory: Mapping | None = None) -> dict:
     """Available memory per placement target (reference ``get_max_memory``
     :774-857): all addressable chips (90% of HBM, like the reference's headroom
@@ -175,12 +193,12 @@ def get_max_memory(max_memory: Mapping | None = None) -> dict:
         return out
     out = {}
     for i, dev in enumerate(jax.local_devices()):
-        out[f"{dev.platform}:{i}"] = int(_device_hbm_bytes(dev) * 0.9)
+        out[f"{dev.platform}:{i}"] = int(device_hbm_bytes(dev) * HBM_HEADROOM)
     try:
         host_bytes = os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_PHYS_PAGES")
     except (ValueError, OSError):  # pragma: no cover
         host_bytes = 64 << 30
-    out["cpu"] = int(host_bytes * 0.9)
+    out["cpu"] = int(host_bytes * HBM_HEADROOM)
     return out
 
 

@@ -34,7 +34,13 @@ import numpy as np
 # during a config (ACCELERATE_PROFILE_STEPS et al.), its parsed attribution
 # report — compute/collective/host/idle fractions and the measured
 # compute<->collective overlap — rides the line; absent otherwise.
-BENCH_SCHEMA_VERSION = 4
+# v5 = detail.memory (analysis/memory.py): the static HBM audit of the exact
+# program each config runs — per-device bytes by class (param/opt-state/
+# accum/batch/activation-workspace), dp-replicated opt-state bytes (the
+# ROADMAP item 2 ZeRO target), reshard count, and the OOM verdict; the
+# telemetry memory section gains predicted_peak_bytes (+ predicted_vs_
+# observed where memory_stats() reports a peak).
+BENCH_SCHEMA_VERSION = 5
 
 
 class BenchAuditFailure(RuntimeError):
@@ -393,7 +399,15 @@ def run_one(mode: str):
         audit_batch = {k: np.stack([v] * bench_window) for k, v in data.items()}
     else:
         audit_batch = data
-    audit_summary = accelerator.audit(step, audit_batch).summary_dict()
+    audit_report = accelerator.audit(step, audit_batch)
+    audit_summary = audit_report.summary_dict()
+    # Static HBM audit of the same lowering (schema v5 detail.memory): class
+    # byte attribution, dp-replicated opt-state, and the OOM verdict travel
+    # with every line; the audit also armed the timeline's predicted-peak
+    # cross-check, so detail.telemetry.memory carries predicted_peak_bytes.
+    memory_summary = (
+        audit_report.memory.summary_dict() if audit_report.memory is not None else None
+    )
     if audit_summary["dp_allgathers"]:
         raise BenchAuditFailure(
             f"program audit: {audit_summary['dp_allgathers']} all-gather(s) on "
@@ -504,6 +518,7 @@ def run_one(mode: str):
                     "health": {"finite_final_loss": finite_loss},
                     "telemetry": telemetry_summary,
                     "audit": audit_summary,
+                    "memory": memory_summary,
                     # Profiling (telemetry/profiler.py): present only when a
                     # trace capture engaged during this config — the capture
                     # list with each parsed attribution report (compute /

@@ -275,7 +275,7 @@ def test_bench_audit_failure_line_is_schemad(capsys):
     )
     bench._print_failure("tiny", exc)
     line = json.loads(capsys.readouterr().out.strip())
-    assert line["schema_version"] == bench.BENCH_SCHEMA_VERSION == 4
+    assert line["schema_version"] == bench.BENCH_SCHEMA_VERSION == 5
     assert line["value"] == 0.0
     assert line["detail"]["audit"]["dp_allgathers"] == 2
     assert "dp mesh axis" in line["detail"]["error"]
@@ -322,6 +322,19 @@ def test_lint_satellite_files_clean_without_baseline():
          "import jax, time\n@jax.jit\ndef f(x):\n    return x + time.time()\n"),
         ("uncounted-block-until-ready", "anywhere.py",
          "x.block_until_ready()\n"),
+        # jax.devices()/local_devices() as a baseline outside the mesh owners
+        # — the elastic-runner bug class (PR 6 review).
+        ("raw-device-baseline", "anywhere.py",
+         "import jax\nworld = len(jax.devices())\n"),
+        ("raw-device-baseline", "telemetry/foo.py",
+         "import jax\ndev = jax.local_devices()[0]\n"),
+        # Fully-unspecified constraint replicates the intermediate.
+        ("replicated-constraint", "ops/foo.py",
+         "import jax\ny = jax.lax.with_sharding_constraint(x, P())\n"),
+        ("replicated-constraint", "accelerator.py",
+         "y = jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P()))\n"),
+        ("replicated-constraint", "models/foo.py",
+         "y = jax.lax.with_sharding_constraint(x, replicated(mesh))\n"),
     ],
 )
 def test_lint_rule_fires(rule, relpath, source):
@@ -349,6 +362,21 @@ def test_lint_rule_fires(rule, relpath, source):
         # The shim home is exempt.
         ("raw-shard-map", "utils/jax_compat.py",
          "from jax.experimental.shard_map import shard_map\n"),
+        # The mesh owners legitimately enumerate devices.
+        ("raw-device-baseline", "parallel/mesh.py",
+         "import jax\ndevices = jax.devices()\n"),
+        ("raw-device-baseline", "state.py",
+         "import jax\nself.device = jax.local_devices()[0]\n"),
+        # A named-axis constraint is the intended spelling.
+        ("replicated-constraint", "ops/foo.py",
+         "y = jax.lax.with_sharding_constraint(x, P('dp'))\n"),
+        ("replicated-constraint", "accelerator.py",
+         "y = jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P('fsdp', None)))\n"),
+        # Out of the hot-path scope; and the sharding-helper home is exempt.
+        ("replicated-constraint", "utils/offload.py",
+         "y = jax.lax.with_sharding_constraint(x, P())\n"),
+        ("replicated-constraint", "parallel/sharding.py",
+         "y = jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P()))\n"),
     ],
 )
 def test_lint_rule_stays_quiet(rule, relpath, source):
