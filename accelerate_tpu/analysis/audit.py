@@ -77,6 +77,11 @@ class CollectiveSite:
     shape: str                 # HLO result shape text, e.g. "f32[16,64]"
     nbytes: int                # per-device result bytes
     source: str = ""           # op_name metadata when present
+    # True when this collective is the ZeRO update's deliberate cross-replica
+    # traffic (reduce-scatter of grads / all-gather of new params on dp) —
+    # attributed by the zero_update/zero_gather_params named scopes riding in
+    # op_name, or by an all-gather landing exactly on a param's base shape.
+    zero: bool = False
 
     def to_dict(self) -> dict:
         return {
@@ -85,6 +90,7 @@ class CollectiveSite:
             "shape": self.shape,
             "nbytes": self.nbytes,
             "source": self.source,
+            "zero": self.zero,
         }
 
 
@@ -125,6 +131,9 @@ class AuditReport:
     aliased_buffers: int = 0
     donation_misses: list = field(default_factory=list)   # [DonationMiss]
     donation_dropped_by_policy: bool = False
+    # Whether a ZeRO (cross-replica weight-update sharding) contract was
+    # declared for this program — sites it claims carry ``zero=True``.
+    zero_sharding: bool = False
     host_callbacks: list = field(default_factory=list)    # [str] descriptions
     dtype_upcasts: list = field(default_factory=list)     # [str] dot signatures
     dot_dtypes: dict = field(default_factory=dict)        # {"f32xf32": n, ...}
@@ -156,14 +165,33 @@ class AuditReport:
                 out[axis][site.op] = out[axis].get(site.op, 0) + 1
         return out
 
+    def zero_collective_counts(self) -> dict:
+        """{op: count} over the ZeRO update's claimed dp traffic."""
+        counts: dict = {}
+        for site in self.zero_collectives:
+            counts[site.op] = counts.get(site.op, 0) + 1
+        return counts
+
+    @property
+    def zero_collectives(self) -> list:
+        """The ZeRO update's deliberate cross-replica traffic: the dp
+        collectives the declared contract claimed (reduce-scatter of grads,
+        all-gather of new params, the decomposed all-reduce forms). Inventory,
+        not violations — the 1/dp opt-state savings are bought with exactly
+        this traffic, and the bench carries it per JSON line so the added
+        bytes are visible round-over-round."""
+        return [s for s in self.collectives if s.zero]
+
     @property
     def dp_allgathers(self) -> list:
         """All-gathers whose replica groups vary along the ``dp`` axis — the
         flagged zero-sync violation: dp-replicated data re-materialized inside
-        the step body every step."""
+        the step body every step. The ZeRO update's declared post-update
+        param gather is deliberate traffic (``zero_collectives``), not a
+        violation — forward/backward must still be dp-allgather-free."""
         return [
             s for s in self.collectives
-            if s.op == "all-gather" and "dp" in s.axes
+            if s.op == "all-gather" and "dp" in s.axes and not s.zero
         ]
 
     @property
@@ -185,6 +213,8 @@ class AuditReport:
                 "sites": [s.to_dict() for s in self.collectives],
             },
             "dp_allgathers": len(self.dp_allgathers),
+            "zero_sharding": self.zero_sharding,
+            "zero_collectives": self.zero_collective_counts(),
             "donation": {
                 "donated_buffers": self.donated_buffers,
                 "aliased_buffers": self.aliased_buffers,
@@ -204,6 +234,8 @@ class AuditReport:
         return {
             "clean": self.clean,
             "dp_allgathers": len(self.dp_allgathers),
+            "zero_sharding": self.zero_sharding,
+            "zero_collectives": self.zero_collective_counts(),
             "host_callbacks": len(self.host_callbacks),
             "donation_misses": len(self.donation_misses),
             "donation_dropped_by_policy": self.donation_dropped_by_policy,
@@ -326,6 +358,74 @@ def _parse_collectives(hlo_text: str, mesh_shape: tuple, axis_names: tuple) -> l
     return sites
 
 
+# Named scopes the builders wrap the ZeRO update region in; GSPMD-inserted
+# collectives inherit the scope path in their op_name metadata.
+_ZERO_SCOPE = re.compile(r"(?:^|/)zero_(?:update|gather_params|scatter_grads)\b")
+
+# numpy dtype name -> HLO shape-text dtype, mirroring the parse direction in
+# _DTYPE_BYTES/_shape_nbytes above. Produced and consumed in THIS module so
+# the shape-text convention cannot drift between the two.
+_NP_TO_HLO_DTYPE = {
+    "float32": "f32", "bfloat16": "bf16", "float16": "f16",
+    "float64": "f64", "int32": "s32", "int64": "s64", "int8": "s8",
+    "uint32": "u32", "uint8": "u8", "bool": "pred",
+}
+
+
+def zero_gather_shapes(params, shardings, mesh) -> list:
+    """Per-device HLO result-shape texts of a ZeRO update's dp all-gathers:
+    each param at its BASE layout (global dims divided by whatever non-dp
+    axes the base spec shards), rendered in the same ``f32[16,64]`` form
+    :func:`_parse_collectives` records for ``CollectiveSite.shape``. The
+    builders put these in their audit meta as the shape-match fallback for
+    attributing ZeRO traffic on backends that strip op_name metadata."""
+    import jax
+
+    mesh_axes = dict(zip(tuple(mesh.axis_names), tuple(mesh.devices.shape)))
+    shapes = set()
+    shard_leaves = jax.tree_util.tree_leaves(
+        shardings, is_leaf=lambda s: hasattr(s, "spec")
+    )
+    for leaf, sharding in zip(jax.tree_util.tree_leaves(params), shard_leaves):
+        shape = tuple(np.shape(leaf))
+        if not shape:
+            continue
+        spec = tuple(getattr(sharding, "spec", ()) or ())
+        dims = []
+        for dim, axes in zip(shape, spec + (None,) * (len(shape) - len(spec))):
+            div = 1
+            for ax in (axes if isinstance(axes, (tuple, list)) else (axes,)):
+                if ax is not None and ax != "dp":
+                    div *= int(mesh_axes.get(ax, 1))
+            dims.append(-(-dim // div))
+        dtype = _NP_TO_HLO_DTYPE.get(str(np.dtype(leaf.dtype)))
+        if dtype is not None:
+            shapes.add(f"{dtype}[{','.join(str(d) for d in dims)}]")
+    return sorted(shapes)
+
+
+def _classify_zero_collectives(sites: list, zero_meta: dict) -> None:
+    """Mark the ZeRO update's deliberate cross-replica traffic.
+
+    Primary signal: the ``zero_update``/``zero_gather_params`` named scopes
+    riding in op_name metadata. Fallback — ONLY for sites with no op_name at
+    all (backends that strip metadata): an all-gather on the declared axis
+    whose per-device result shape is exactly a param's base layout. A site
+    that HAS metadata but no zero scope is never claimed: a genuine forward
+    re-materialization of params lands on exactly these shapes too, and
+    claiming it would mask the very violation the dp-allgather gate exists
+    to catch."""
+    axis = zero_meta.get("axis", "dp")
+    shapes = set(zero_meta.get("param_shapes") or ())
+    for site in sites:
+        if axis not in site.axes:
+            continue
+        if _ZERO_SCOPE.search(site.source):
+            site.zero = True
+        elif not site.source and site.op == "all-gather" and site.shape in shapes:
+            site.zero = True
+
+
 def _parse_donors(stablehlo_text: str) -> tuple:
     """(donor_indices, prealised_indices, {index: (shape, nbytes)}) from the
     StableHLO entry signature: ``jax.buffer_donor = true`` marks a donated
@@ -336,8 +436,15 @@ def _parse_donors(stablehlo_text: str) -> tuple:
         return set(), set(), {}
     donors, prealiased, sizes = set(), set(), {}
     # Arguments look like: %arg0: tensor<64x64xf32> {jax.buffer_donor = true, ...}
+    # The attr dict may hold quoted strings containing braces — single-device
+    # lowerings spell donation as {mhlo.sharding = "{replicated}",
+    # tf.aliasing_output = N : i32}, where a naive [^}]* match stops at the
+    # quoted "}" and silently drops the aliasing mark after it (the
+    # under-marked false positive on 1-device backends).
     for am in re.finditer(
-        r"%arg(\d+):\s*tensor<([^>]*)>\s*(\{[^}]*\})?", m.group(1)
+        r"%arg(\d+):\s*tensor<([^>]*)>\s*"
+        r"(\{(?:[^{}\"]|\"[^\"]*\"|\{[^{}]*\})*\})?",
+        m.group(1),
     ):
         idx = int(am.group(1))
         tensor = am.group(2)
@@ -466,6 +573,7 @@ def audit_lowered(
     jaxpr=None,
     builder: str = "unknown",
     intermediate_threshold_bytes: int = 64 * 1024 * 1024,
+    zero_sharding: dict | None = None,
 ) -> AuditReport:
     """Audit any ``jax.stages.Lowered``.
 
@@ -497,8 +605,11 @@ def audit_lowered(
         mesh_axes=dict(zip(axis_names, mesh_shape)),
         intermediate_threshold_bytes=int(intermediate_threshold_bytes),
         donation_dropped_by_policy=bool(donation_dropped_by_policy),
+        zero_sharding=bool(zero_sharding),
     )
     report.collectives = _parse_collectives(hlo_text, mesh_shape, axis_names)
+    if zero_sharding:
+        _classify_zero_collectives(report.collectives, zero_sharding)
 
     donors, prealiased, sizes = _parse_donors(stablehlo_text)
     aliased = _parse_aliased_params(hlo_text)
@@ -586,6 +697,7 @@ def audit_built(built, *args, intermediate_threshold_bytes: int = 64 * 1024 * 10
         jaxpr=jaxpr,
         builder=meta.get("builder", getattr(built, "__name__", "unknown")),
         intermediate_threshold_bytes=intermediate_threshold_bytes,
+        zero_sharding=meta.get("zero_sharding"),
     )
     compiled = report.__dict__.pop("_compiled", None)
     if memory and meta.get("memory_classes"):

@@ -320,6 +320,16 @@ class MemoryReport:
             "window": self.window,
             "per_device_bytes": self.per_device_by_class(),
             "opt_state_replicated_dp_bytes": self.replicated_bytes("opt_state", "dp"),
+            # The full per-class/per-axis replication inventory, largest
+            # first — on every bench JSON line so the ZeRO lever's 1/dp
+            # opt-state drop is measurable round-over-round, not just the
+            # single dp/opt_state headline above.
+            "replication_findings": [
+                f.to_dict()
+                for f in sorted(
+                    self.replication_findings, key=lambda f: -f.per_device_bytes
+                )
+            ],
             "reshards": len(self.reshards),
             "gather_reshards": len(self.gather_reshards),
             "memory_analysis_available": self.memory_analysis_available,

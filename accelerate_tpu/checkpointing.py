@@ -266,6 +266,13 @@ def _mesh_record(accelerator) -> dict:
         # this absolute record (save-time accum x save-time dp is the
         # samples-per-update invariant).
         "gradient_accumulation_steps": int(accelerator.gradient_accumulation_steps),
+        # Informational (not part of the compatibility comparison): restore
+        # is layout-agnostic either way — each array lands host-sharded on
+        # the LIVE optimizer plan, so a ZeRO-on checkpoint restores into a
+        # ZeRO-off process and vice versa without resharding ceremony.
+        "zero_sharding": bool(
+            any(getattr(o, "zero_active", False) for o in accelerator._optimizers)
+        ),
     }
 
 

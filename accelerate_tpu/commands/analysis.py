@@ -254,8 +254,16 @@ def memcheck_command_parser(subparsers=None) -> argparse.ArgumentParser:
     parser.add_argument(
         "--replicated-opt-gib", type=float, default=None,
         help="Exit 1 when opt-state bytes replicated on the dp axis exceed "
-             "this many GiB per chip (the ZeRO-sharding acceptance gate; "
+             "this many GiB per chip (the ZeRO-sharding acceptance gate — "
+             "pair with ACCELERATE_ZERO_SHARDING=1 to prove the fix; "
              "default: report only)",
+    )
+    parser.add_argument(
+        "--cpu-virtual-devices", type=int, default=0,
+        help="Pin an N-device virtual CPU mesh before building (launcher "
+             "flag's analog): dp-axis findings — the --replicated-opt-gib "
+             "gate above — are vacuous on a 1-device backend, so single-"
+             "host rigs need this to make the gate enforceable.",
     )
     parser.add_argument(
         "--summary", action="store_true",
@@ -270,6 +278,14 @@ def memcheck_command_parser(subparsers=None) -> argparse.ArgumentParser:
 def memcheck_command(args) -> None:
     if args.window < 1:
         raise SystemExit("--window must be >= 1")
+    if getattr(args, "cpu_virtual_devices", 0):
+        if args.cpu_virtual_devices < 1:
+            raise SystemExit("--cpu-virtual-devices must be >= 1")
+        from ..utils.environment import pin_cpu_platform
+
+        # Must precede the first backend touch (_build_tiny_artifact's
+        # Accelerator() below); pin_cpu_platform documents the contract.
+        pin_cpu_platform(args.cpu_virtual_devices)
     accelerator, built, batch = _build_tiny_artifact(
         args.window, args.batch, args.seq, optimizer=args.optimizer
     )

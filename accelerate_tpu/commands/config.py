@@ -175,10 +175,10 @@ def get_user_input() -> ClusterConfig:
     # (None / '') so an inherited ACCELERATE_TRAIN_WINDOW/XLA_PRESET still
     # flows through at launch; answering — even with the defaults 1/'off' —
     # is an explicit choice that scrubs stale inherited values.
-    train_window, xla_preset = None, ""
+    train_window, xla_preset, zero_sharding = None, "", None
     if _yesno(
         "Do you want to configure dispatch amortization (fused train windows, "
-        "XLA latency-hiding presets)?", False
+        "XLA latency-hiding presets, ZeRO optimizer sharding)?", False
     ):
         train_window = _ask(
             "  train window K (steps fused into one XLA program per dispatch; "
@@ -187,6 +187,10 @@ def get_user_input() -> ClusterConfig:
         xla_preset = _ask(
             "  XLA latency-hiding preset (off/latency/collective_matmul)",
             "off", str, ["off", "latency", "collective_matmul"],
+        )
+        zero_sharding = _yesno(
+            "  ZeRO cross-replica sharding (optimizer state + weight update "
+            "sharded over the dp axis; ~1/dp opt-state HBM per chip)?", False
         )
     log_with = ""
     if _yesno("Do you want to configure experiment tracking?", False):
@@ -248,6 +252,7 @@ def get_user_input() -> ClusterConfig:
         straggler_threshold=straggler_threshold,
         train_window=train_window,
         xla_preset=xla_preset,
+        zero_sharding=zero_sharding,
         profile_steps=profile_steps,
         profile_slow_zscore=profile_slow_zscore,
     )

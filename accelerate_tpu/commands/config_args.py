@@ -139,6 +139,11 @@ class ClusterConfig:
     # explicitly none; utils/xla_flags.py: latency | collective_matmul).
     train_window: int | None = None
     xla_preset: str = ""
+    # Cross-replica (ZeRO-style) optimizer-state + weight-update sharding on
+    # the dp axis (tri-state like telemetry/elastic: None = unspecified, an
+    # inherited ACCELERATE_ZERO_SHARDING flows; an explicit False reaches the
+    # workers as a disable).
+    zero_sharding: bool | None = None
     # Profiling (telemetry/profiler.py; docs/observability.md "Profiling"):
     # TRI-state per the telemetry precedent. ``profile_steps`` is the
     # explicit trace-capture range grammar ("10-12,50"; None = unspecified,

@@ -47,6 +47,7 @@ from ..utils.constants import (
     ENV_TELEMETRY,
     ENV_TRAIN_WINDOW,
     ENV_XLA_PRESET,
+    ENV_ZERO_SHARDING,
 )
 from .config_args import ClusterConfig, load_config_from_file
 
@@ -183,6 +184,16 @@ def launch_command_parser(subparsers=None) -> argparse.ArgumentParser:
              "Echoed into telemetry snapshots.",
     )
     parser.add_argument(
+        "--zero_sharding", action=argparse.BooleanOptionalAction, default=None,
+        help="Cross-replica (ZeRO-style) sharding of optimizer state and the "
+             "weight update along the dp axis (ACCELERATE_ZERO_SHARDING): "
+             "opt-state HBM drops to ~1/dp and the fused update lowers as "
+             "reduce-scatter(grads) -> sharded clip+update -> all-gather(new "
+             "params), overlapped by the --xla_preset latency schedules. "
+             "Gate the win with `accelerate-tpu memcheck "
+             "--replicated-opt-gib` (docs/performance.md).",
+    )
+    parser.add_argument(
         "--profile_steps", default=None,
         help="Capture an XLA trace over these training steps "
              "(ACCELERATE_PROFILE_STEPS): comma-separated 1-based inclusive "
@@ -252,6 +263,7 @@ def _merge_config(args) -> ClusterConfig:
         ("straggler_threshold", "straggler_threshold"),
         ("train_window", "train_window"),
         ("xla_preset", "xla_preset"),
+        ("zero_sharding", "zero_sharding"),
         ("profile_steps", "profile_steps"),
         ("profile_slow_zscore", "profile_slow_zscore"),
     ]:
@@ -341,6 +353,11 @@ def prepare_launch_env(cfg: ClusterConfig, process_id: int | None = None, attemp
     elif cfg.xla_preset:
         # Same for an explicit --xla_preset off/none.
         env.pop(ENV_XLA_PRESET, None)
+    # ZeRO sharding is tri-state like telemetry/elastic: None exports nothing
+    # (an inherited env flows; library default off), and an explicit
+    # --no-zero_sharding reaches the workers as a disable.
+    if cfg.zero_sharding is not None:
+        env[ENV_ZERO_SHARDING] = "1" if cfg.zero_sharding else "0"
     # Profiling (telemetry/profiler.py): tri-state per the telemetry
     # precedent — None exports nothing (an inherited env flows through), an
     # explicit value reaches the workers, and an explicit disable

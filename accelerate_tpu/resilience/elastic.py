@@ -163,6 +163,7 @@ def reshard_accelerator(accelerator, devices=None, min_data_parallel: int = 1):
 
     from ..parallel.mesh import build_elastic_mesh
     from ..parallel.sharding import (
+        apply_shardings,
         data_parallel_degree,
         respec_shardings,
         transfer_to_mesh,
@@ -201,10 +202,22 @@ def reshard_accelerator(accelerator, devices=None, min_data_parallel: int = 1):
             model._eval_call = None
         for opt in accelerator._optimizers:
             # The cached plan anchored to the old mesh; replanned lazily from
-            # the (already re-anchored) param shardings on next use.
+            # the (already re-anchored) param shardings on next use. The
+            # imperative update fn closes over the old plan too.
             opt.opt_shardings = None
+            opt.zero_param_shardings = None
+            opt._update_fn = None
             if opt.opt_state is not None:
-                opt.opt_state = transfer_to_mesh(opt.opt_state, new_mesh)
+                if opt.zero_sharding and opt.handle is not None:
+                    # ZeRO state is dp-partitioned: a spec-preserving transfer
+                    # could fail on GROW (a dim the old dp divided need not
+                    # divide the new degree). Replan against the new mesh and
+                    # move shard-to-shard onto the new plan — still the
+                    # portable-redistribution property, no host gather.
+                    opt.opt_shardings = opt._plan_opt_shardings()
+                    opt.opt_state = apply_shardings(opt.opt_state, opt.opt_shardings)
+                else:
+                    opt.opt_state = transfer_to_mesh(opt.opt_state, new_mesh)
             if opt._accum_grads is not None:
                 opt._accum_grads = transfer_to_mesh(opt._accum_grads, new_mesh)
         # Health-guard snapshots hold device arrays laid out on the OLD mesh:

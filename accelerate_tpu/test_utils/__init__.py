@@ -1,2 +1,7 @@
 from .drills import run_nonblocking_drill
-from .training import RegressionDataset, RegressionModel, regression_batches
+from .training import (
+    MatrixRegressionModel,
+    RegressionDataset,
+    RegressionModel,
+    regression_batches,
+)

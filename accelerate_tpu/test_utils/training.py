@@ -49,6 +49,30 @@ class RegressionModel(Module):
         return out
 
 
+class MatrixRegressionModel(Module):
+    """``y_hat = x @ W + b`` with matrix params large enough for the ZeRO
+    planner (``plan_zero_shardings`` skips leaves below its minimum shard
+    size) — the fixture for cross-replica optimizer-sharding tests, where
+    ``RegressionModel``'s scalar params give the dp partitioner nothing to
+    split. Deterministic init: no RNG, so drills stay reproducible."""
+
+    def __init__(self, dim: int = 64):
+        self.dim = dim
+        self.params = None
+
+    def init(self, rng, *example_inputs, **kwargs):
+        d = self.dim
+        w = ((np.arange(d * d, dtype=np.float32).reshape(d, d) % 7) - 3.0) / d
+        return {"w": jnp.asarray(w), "b": jnp.zeros((d,), jnp.float32)}
+
+    def apply(self, params, x=None, y=None, train: bool = False, rngs=None, **kwargs):
+        pred = x @ params["w"] + params["b"]
+        out = ModelOutput(prediction=pred)
+        if y is not None:
+            out["loss"] = jnp.mean((pred - y) ** 2)
+        return out
+
+
 def regression_batches(dataset: RegressionDataset, batch_size: int, drop_last: bool = True):
     """Plain-python iterable of numpy batches (a non-torch dataloader)."""
     batches = []

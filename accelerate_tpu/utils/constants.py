@@ -88,6 +88,13 @@ ENV_FLIGHT_DIR = "ACCELERATE_FLIGHT_DIR"
 ENV_TRAIN_WINDOW = "ACCELERATE_TRAIN_WINDOW"
 ENV_XLA_PRESET = "ACCELERATE_XLA_PRESET"
 
+# Cross-replica (ZeRO-style) sharding of optimizer state + the weight update
+# along the dp axis (arxiv 2004.13336): opt-state HBM drops to ~1/dp and the
+# fused update lowers as reduce-scatter(grads) → sharded clip+update →
+# all-gather(new params). Launcher contract: ``--zero_sharding`` /
+# ``--no-zero_sharding`` (tri-state; an explicit off scrubs an inherited env).
+ENV_ZERO_SHARDING = "ACCELERATE_ZERO_SHARDING"
+
 # ``dcn`` is the slice axis of a multi-slice pod: replicas connected by
 # data-center network rather than ICI. It is outermost so only the axes meant
 # to cross slices (data parallelism / LocalSGD replicas) ever ride DCN; all

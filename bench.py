@@ -40,7 +40,14 @@ import numpy as np
 # ROADMAP item 2 ZeRO target), reshard count, and the OOM verdict; the
 # telemetry memory section gains predicted_peak_bytes (+ predicted_vs_
 # observed where memory_stats() reports a peak).
-BENCH_SCHEMA_VERSION = 5
+# v6 = ZeRO lever (BENCH_ZERO=1 shards optimizer state + the weight update
+# over dp): detail.zero_sharding on every line, detail.memory gains the full
+# replication_findings inventory (per class x axis, savings bytes), and
+# detail.audit gains zero_collectives — the update's deliberate dp
+# reduce-scatter/all-gather traffic, attributed separately from violations —
+# so the 1/dp opt-state drop AND the traffic that buys it are both visible
+# round-over-round.
+BENCH_SCHEMA_VERSION = 6
 
 
 class BenchAuditFailure(RuntimeError):
@@ -87,9 +94,12 @@ def resolve_backend() -> str:
     if backend not in ("tpu", "gpu"):
         # TPU probe failed or hung: pin CPU before this process's first
         # backend touch (jax.config wins over the plugin's env override).
+        # BENCH_CPU_DEVICES > 1 simulates a multi-chip mesh (default 1 keeps
+        # CPU rounds comparable to the historical trajectory) — the knob
+        # dp-dependent levers like BENCH_ZERO need to engage off-chip.
         from accelerate_tpu.utils.environment import pin_cpu_platform
 
-        pin_cpu_platform(1)
+        pin_cpu_platform(max(1, int(os.environ.get("BENCH_CPU_DEVICES", "1") or 1)))
         backend = "cpu"
     return backend
 
@@ -344,7 +354,14 @@ def run_one(mode: str):
     else:
         warmup_disp, meas_disp = warmup, steps
 
+    # ZeRO lever (ROADMAP item 2): BENCH_ZERO=1 shards optimizer state and
+    # the weight update over dp (sweep it off/on round-over-round; the 1/dp
+    # opt-state drop lands in detail.memory.replication_findings and the
+    # added update traffic in detail.audit.zero_collectives).
+    bench_zero = bool(int(os.environ.get("BENCH_ZERO", "0") or 0))
+
     accelerator = Accelerator(mixed_precision="bf16")
+    accelerator.zero_sharding = bench_zero or accelerator.zero_sharding
     accelerator.telemetry.timeline.reset()  # fresh step-timeline window too
     if mode == "moe":
         from accelerate_tpu.models import MoELlama
@@ -504,6 +521,11 @@ def run_one(mode: str):
                     # BENCH_WINDOW / BENCH_PREFETCH levers exist to shrink.
                     "dispatches": telemetry_summary["dispatches"],
                     "input_wait_s": telemetry_summary["transfers"]["input_wait_s"],
+                    # Whether the ZeRO plan actually engaged for this config
+                    # (requested AND dp > 1 AND something partitionable).
+                    "zero_sharding": bool(
+                        getattr(popt, "zero_active", False)
+                    ),
                     **(
                         {"train_window": bench_window, "prefetch": bench_prefetch}
                         if amortized

@@ -275,7 +275,7 @@ def test_bench_audit_failure_line_is_schemad(capsys):
     )
     bench._print_failure("tiny", exc)
     line = json.loads(capsys.readouterr().out.strip())
-    assert line["schema_version"] == bench.BENCH_SCHEMA_VERSION == 5
+    assert line["schema_version"] == bench.BENCH_SCHEMA_VERSION == 6
     assert line["value"] == 0.0
     assert line["detail"]["audit"]["dp_allgathers"] == 2
     assert "dp mesh axis" in line["detail"]["error"]
@@ -451,3 +451,28 @@ def test_shipped_baseline_has_no_satellite_entries():
     offenders = {p for (p, _, _) in baseline}
     assert "serving.py" not in offenders
     assert "utils/operations.py" not in offenders
+
+def test_parse_donors_survives_quoted_sharding_attrs():
+    """Single-device lowerings spell donation as ``tf.aliasing_output`` AFTER
+    an ``mhlo.sharding`` attr whose value is a QUOTED string containing
+    braces. A naive ``{[^}]*}`` attr match stops at the quoted ``}`` and
+    drops every aliasing mark behind it — the regression that made all the
+    shipped builders read as 'under-marked' (1/N donated leaves, clean=False)
+    on 1-device backends (the PR 9 known-issue, now fixed by a
+    brace/quote-aware match)."""
+    from accelerate_tpu.analysis.audit import _parse_donors
+
+    text = (
+        'func.func public @main('
+        '%arg0: tensor<128x64xf32> {mhlo.sharding = "{replicated}", '
+        'tf.aliasing_output = 0 : i32}, '
+        '%arg1: tensor<64xf32> {mhlo.sharding = "{replicated}", '
+        'tf.aliasing_output = 1 : i32}, '
+        '%arg2: tensor<4xf32> {jax.buffer_donor = true, '
+        'mhlo.sharding = "{replicated}"}, '
+        '%arg3: tensor<8xf32>) -> (tensor<128x64xf32> {mhlo.sharding = "{replicated}"}) {'
+    )
+    donors, prealiased, sizes = _parse_donors(text)
+    assert prealiased == {0, 1}
+    assert donors == {2}
+    assert sizes[0][1] == 128 * 64 * 4

@@ -272,6 +272,7 @@ def test_config_wizard_roundtrips_through_launch(tmp_path):
         "yes",               # configure dispatch amortization?
         "4",                 # train window K
         "latency",           # xla latency-hiding preset
+        "yes",               # ZeRO cross-replica sharding
         "yes",               # configure tracking?
         "json",              # trackers
         "yes",               # persistent compilation cache?
@@ -290,6 +291,7 @@ def test_config_wizard_roundtrips_through_launch(tmp_path):
     assert cfg.straggler_threshold == 1.8
     assert cfg.profile_steps == "10-12" and cfg.profile_slow_zscore == 5.5
     assert cfg.train_window == 4 and cfg.xla_preset == "latency"
+    assert cfg.zero_sharding is True
     assert cfg.compile_cache_dir == str(tmp_path / "xla_cache")
     config_path = tmp_path / "cfg.yaml"
     cfg.to_yaml_file(str(config_path))
@@ -331,6 +333,8 @@ def test_config_wizard_roundtrips_through_launch(tmp_path):
         "from accelerate_tpu.health.hang import get_default_watchdog\n"
         "assert get_default_watchdog() is not None\n"
         "assert get_default_watchdog().timeout_s == 240.0\n"
+        "assert os.environ.get('ACCELERATE_ZERO_SHARDING') == '1'\n"
+        "assert acc.zero_sharding is True\n"
         "import jax\n"
         "assert jax.config.jax_compilation_cache_dir.endswith('xla_cache')\n"
         "print('ROUNDTRIP_OK')\n"

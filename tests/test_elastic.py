@@ -658,3 +658,150 @@ def test_prepared_loader_reassign_shards_keeps_sampler_state():
     loader.reassign_shards(num_processes=1, process_index=0)
     if sd_before is not None:
         assert loader.state_dict() == sd_before  # sampler-RNG contract intact
+
+
+# ------------------------------------------------- ZeRO x elastic interplay
+# Satellite of ISSUE 10: the dp-partitioned optimizer plan must survive
+# resizes in BOTH directions. Shrink preserves divisibility trivially; GROW
+# is the hard case — a dim the old dp divided need not divide the new
+# degree, so reshard_accelerator REPLANS the zero shardings against the new
+# mesh and moves the state shard-to-shard onto the new plan.
+
+ZDIM = 64
+
+
+def _zbuild(project_dir=None, zero=True):
+    from accelerate_tpu.test_utils import MatrixRegressionModel
+
+    cfg = ProjectConfiguration(
+        project_dir=str(project_dir), automatic_checkpoint_naming=True
+    ) if project_dir is not None else ProjectConfiguration()
+    accelerator = Accelerator(project_config=cfg)
+    accelerator.zero_sharding = zero
+    model = MatrixRegressionModel(ZDIM)
+    model.init_params(None)
+    pmodel, popt = accelerator.prepare(model, optax.adam(0.05))
+    return accelerator, pmodel, popt
+
+
+def _zmicrobatch(update, micro, accum):
+    rng = np.random.default_rng(500 + update)
+    x = rng.normal(size=(GLOBAL_BATCH, ZDIM)).astype(np.float32)
+    y = (0.5 * x).astype(np.float32)
+    per = GLOBAL_BATCH // accum
+    sl = slice(micro * per, (micro + 1) * per)
+    return {"x": x[sl], "y": y[sl]}
+
+
+def _ztrain(acc, pmodel, popt, updates):
+    step_fn = acc.build_train_step(pmodel, popt)
+    accum = acc.gradient_accumulation_steps
+    for u in updates:
+        for m in range(accum):
+            step_fn(_zmicrobatch(u, m, accum))
+
+
+def _opt_plan_axes(popt):
+    axes = set()
+    for s in jax.tree_util.tree_leaves(
+        popt.opt_shardings, is_leaf=lambda x: hasattr(x, "spec")
+    ):
+        for entry in tuple(s.spec):
+            if entry is None:
+                continue
+            axes.update(entry if isinstance(entry, tuple) else (entry,))
+    return axes
+
+
+def test_zero_resize_drill_dp4_dp2_dp4():
+    """The ISSUE 10 elastic drill: dp4 -> dp2 -> dp4 with ZeRO on. Every
+    transition moves params AND the dp-sharded opt-state bit-exactly, the
+    plan is re-derived against each new mesh (the grow leg exercises the
+    replan-not-respec path), and the finished run lands loss-equivalent to
+    an uninterrupted fixed-size run on the same global batches."""
+    devices = list(jax.devices())
+
+    def state_of(pmodel, popt):
+        return (
+            [np.asarray(l) for l in jax.tree_util.tree_leaves(pmodel.handle.params)],
+            [np.asarray(jax.device_get(l))
+             for l in jax.tree_util.tree_leaves(popt.opt_state)],
+        )
+
+    acc, pmodel, popt = _zbuild()
+    acc.reshard(devices=devices[:4])  # dp4, accum 2
+    assert data_parallel_degree(acc.mesh) == 4
+    _ztrain(acc, pmodel, popt, range(1, 3))
+    assert popt.zero_active and "dp" in _opt_plan_axes(popt)
+    before = state_of(pmodel, popt)
+
+    acc.reshard(devices=devices[:2])  # dp2, accum 4 — shrink leg
+    assert acc.gradient_accumulation_steps == 4
+    after = state_of(pmodel, popt)
+    for a, b in zip(before[0] + before[1], after[0] + after[1]):
+        assert np.array_equal(a, b)  # the move changes layout, never values
+    assert "dp" in _opt_plan_axes(popt)
+    for s in jax.tree_util.tree_leaves(
+        popt.opt_shardings, is_leaf=lambda x: hasattr(x, "spec")
+    ):
+        assert s.mesh == acc.mesh  # replanned against the NEW mesh
+    _ztrain(acc, pmodel, popt, range(3, 5))
+
+    before = state_of(pmodel, popt)
+    acc.reshard(devices=devices[:4])  # back to dp4 — the GROW replan leg
+    assert acc.gradient_accumulation_steps == 2
+    after = state_of(pmodel, popt)
+    for a, b in zip(before[0] + before[1], after[0] + after[1]):
+        assert np.array_equal(a, b)
+    assert "dp" in _opt_plan_axes(popt)
+    _ztrain(acc, pmodel, popt, range(5, 7))
+    final = acc.get_state_dict(pmodel)
+
+    # Uninterrupted fixed-size baseline (dp4 throughout, same global batches).
+    _reset_accelerator_singletons()
+    acc_ref, pm_ref, po_ref = _zbuild()
+    acc_ref.reshard(devices=devices[:4])
+    _ztrain(acc_ref, pm_ref, po_ref, range(1, 7))
+    _assert_close(acc_ref.get_state_dict(pm_ref), final)
+
+
+def test_zero_cross_mesh_checkpoint_restore_bit_exact(tmp_path):
+    """Cross-mesh restore with ZeRO enabled: a dp4-written checkpoint (dp-
+    sharded opt state) restores bit-exact onto dp2 and back onto dp4 — each
+    array lands host-sharded directly on the live mesh's replanned zero
+    layout."""
+    acc, pmodel, popt = _zbuild(tmp_path)
+    acc.reshard(devices=jax.devices()[:4])  # dp4
+    _ztrain(acc, pmodel, popt, range(1, 3))
+    acc.step = 2
+    acc.save_state()  # checkpoint_0 under dp4
+    state_dp4 = _final_state(acc, pmodel, popt)
+
+    acc.reshard(devices=jax.devices()[:2])  # dp2
+    with pytest.raises(RuntimeError, match="resharding is required"):
+        acc.load_state()
+    acc.load_state(reshard=True)
+    _assert_bit_exact(state_dp4, _final_state(acc, pmodel, popt))
+    assert popt.zero_active and "dp" in _opt_plan_axes(popt)
+
+    _ztrain(acc, pmodel, popt, range(3, 5))
+    acc.step = 4
+    acc.save_state()  # checkpoint_1 under dp2
+    state_dp2 = _final_state(acc, pmodel, popt)
+
+    acc.reshard(devices=jax.devices()[:4])  # grow back to dp4
+    acc.load_state(reshard=True)
+    _assert_bit_exact(state_dp2, _final_state(acc, pmodel, popt))
+    assert "dp" in _opt_plan_axes(popt)
+
+
+def test_zero_manifest_records_flag(tmp_path):
+    import json
+
+    acc, pmodel, popt = _zbuild(tmp_path)
+    popt._ensure_initialized()
+    acc.save_state()
+    manifest = json.loads(
+        (tmp_path / "checkpoints" / "checkpoint_0" / "manifest.json").read_text()
+    )
+    assert manifest["mesh"]["zero_sharding"] is True

@@ -31,10 +31,11 @@ from accelerate_tpu.state import AcceleratorState, GradientState
 from accelerate_tpu.utils.jax_compat import has_native_shard_map
 
 
-def _audit(parallelism, attention_impl="auto", seq=16):
+def _audit(parallelism, attention_impl="auto", seq=16, zero=False):
     AcceleratorState._reset_state(reset_partial_state=True)
     GradientState._reset_state()
     acc = Accelerator(parallelism_config=parallelism)
+    acc.zero_sharding = zero
     cfg = LlamaConfig.tiny(
         vocab_size=128, hidden_size=64, intermediate_size=128,
         num_attention_heads=2, num_key_value_heads=2, num_hidden_layers=2,
@@ -115,3 +116,36 @@ def test_ring_plan_emits_collective_permute():
         ParallelismConfig(sp_size=4, dp_size=2), attention_impl="ring", seq=32
     )
     assert report.collective_counts()["collective-permute"] > 0
+
+
+def test_zero_plan_update_signature(dp_report):
+    """ISSUE 10: ZeRO on dp8 adds exactly the update's cross-replica traffic
+    — grads enter the sharded update by reduce-scatter (or its
+    all-reduce + slice decomposition on this backend) and the new params
+    all-gather back out, ALL attributed as ZeRO inventory; the
+    forward/backward keep the pure-dp plan's communication (gradient
+    all-reduce only, zero dp-allgather violations anywhere)."""
+    report = _audit(ParallelismConfig(), zero=True)
+    assert report.zero_sharding
+    assert report.dp_allgathers == []  # violations: none
+    zero_counts = report.zero_collective_counts()
+    assert zero_counts.get("all-gather", 0) > 0, zero_counts
+    # The grad side of the schedule: a true reduce-scatter when the backend
+    # fuses all-reduce+slice, otherwise the all-reduce half stays visible
+    # inside the attributed update region.
+    assert (
+        zero_counts.get("reduce-scatter", 0) + zero_counts.get("all-reduce", 0)
+    ) > 0, zero_counts
+
+    # Outside the attributed update, the inventory is EXACTLY the replicated
+    # dp plan's: the same gradient all-reduces, nothing else.
+    unclaimed = {}
+    for site in report.collectives:
+        if "dp" in site.axes and not site.zero:
+            unclaimed[site.op] = unclaimed.get(site.op, 0) + 1
+    baseline = {
+        op: count
+        for op, count in dp_report.collective_counts("dp").items()
+        if count
+    }
+    assert unclaimed == baseline, (unclaimed, baseline)
