@@ -20,6 +20,7 @@ from .merge import merge_command_parser
 from .profile import blackbox_command_parser, profile_command_parser
 from .test import test_command_parser
 from .tpu import tpu_command_parser
+from .tune import tune_command_parser
 
 
 def main() -> None:
@@ -40,6 +41,7 @@ def main() -> None:
     memcheck_command_parser(subparsers=subparsers)
     profile_command_parser(subparsers=subparsers)
     blackbox_command_parser(subparsers=subparsers)
+    tune_command_parser(subparsers=subparsers)
 
     args = parser.parse_args()
     if not hasattr(args, "func"):

@@ -162,9 +162,32 @@ def audit_command_parser(subparsers=None) -> argparse.ArgumentParser:
         help="Print the compact summary (bench.py detail.audit form) instead "
              "of the full report",
     )
+    parser.add_argument(
+        "--json", action="store_true",
+        help="Machine-readable output: a schema'd verdict document "
+             "({verdict, failures, report}) instead of the bare report, so "
+             "the autotuner and CI consume the result without scraping "
+             "stdout. Exit codes are unchanged.",
+    )
     if subparsers is not None:
         parser.set_defaults(func=audit_command)
     return parser
+
+
+# Schema of the ``--json`` verdict document shared by ``audit`` and
+# ``memcheck``: bump when its structure changes so machine consumers (the
+# autotuner, CI) can gate on compatibility.
+VERDICT_SCHEMA_VERSION = 1
+
+
+def _verdict_doc(command: str, failures: list, report: dict) -> dict:
+    return {
+        "schema_version": VERDICT_SCHEMA_VERSION,
+        "command": command,
+        "verdict": "fail" if failures else "pass",
+        "failures": list(failures),
+        "report": report,
+    }
 
 
 def _build_tiny_artifact(window: int, batch_rows: int, seq: int,
@@ -209,9 +232,14 @@ def audit_command(args) -> None:
         built, batch,
         intermediate_threshold_bytes=int(args.threshold_mb * 1024 * 1024),
     )
-    print(json.dumps(
-        report.summary_dict() if args.summary else report.to_dict(), indent=1
-    ))
+    payload = report.summary_dict() if args.summary else report.to_dict()
+    if getattr(args, "json", False):
+        failures = [] if report.clean else [
+            "program audit: zero-tolerance invariant violated "
+            "(dp all-gathers / host callbacks / donation misses — see report)"
+        ]
+        payload = _verdict_doc("audit", failures, payload)
+    print(json.dumps(payload, indent=1))
     if not report.clean:
         raise SystemExit(1)
 
@@ -270,6 +298,14 @@ def memcheck_command_parser(subparsers=None) -> argparse.ArgumentParser:
         help="Print the compact summary (bench.py detail.memory form) instead "
              "of the full report",
     )
+    parser.add_argument(
+        "--json", action="store_true",
+        help="Machine-readable output: a schema'd verdict document "
+             "({verdict, failures, report}) instead of the bare report — the "
+             "failures stdout-vs-stderr split stays for humans, but machine "
+             "consumers get everything in one parseable doc. Exit codes are "
+             "unchanged.",
+    )
     if subparsers is not None:
         parser.set_defaults(func=memcheck_command)
     return parser
@@ -291,9 +327,6 @@ def memcheck_command(args) -> None:
     )
     budget = int(args.budget_gib * (1 << 30)) if args.budget_gib is not None else None
     report = accelerator.memory_report(built, batch, budget_bytes=budget)
-    print(json.dumps(
-        report.summary_dict() if args.summary else report.to_dict(), indent=1
-    ))
     failures = []
     if not report.fits:
         failures.append(
@@ -308,8 +341,13 @@ def memcheck_command(args) -> None:
                 f"opt_state replicated on dp: {rep} B/chip exceeds "
                 f"--replicated-opt-gib {args.replicated_opt_gib}"
             )
-    for f in failures:
-        print(f"memcheck: {f}", file=sys.stderr)
+    payload = report.summary_dict() if args.summary else report.to_dict()
+    if getattr(args, "json", False):
+        payload = _verdict_doc("memcheck", failures, payload)
+    print(json.dumps(payload, indent=1))
+    if not getattr(args, "json", False):
+        for f in failures:
+            print(f"memcheck: {f}", file=sys.stderr)
     if failures:
         raise SystemExit(1)
 

@@ -175,10 +175,10 @@ def get_user_input() -> ClusterConfig:
     # (None / '') so an inherited ACCELERATE_TRAIN_WINDOW/XLA_PRESET still
     # flows through at launch; answering — even with the defaults 1/'off' —
     # is an explicit choice that scrubs stale inherited values.
-    train_window, xla_preset, zero_sharding = None, "", None
+    train_window, xla_preset, zero_sharding, tune_budget = None, "", None, None
     if _yesno(
         "Do you want to configure dispatch amortization (fused train windows, "
-        "XLA latency-hiding presets, ZeRO optimizer sharding)?", False
+        "XLA latency-hiding presets, ZeRO optimizer sharding, autotuner)?", False
     ):
         train_window = _ask(
             "  train window K (steps fused into one XLA program per dispatch; "
@@ -191,6 +191,10 @@ def get_user_input() -> ClusterConfig:
         zero_sharding = _yesno(
             "  ZeRO cross-replica sharding (optimizer state + weight update "
             "sharded over the dp axis; ~1/dp opt-state HBM per chip)?", False
+        )
+        tune_budget = _ask(
+            "  autotuner trial budget (max short-bench trials an "
+            "`accelerate-tpu tune` run may spend; 0 = library default)", 0, int
         )
     log_with = ""
     if _yesno("Do you want to configure experiment tracking?", False):
@@ -253,6 +257,7 @@ def get_user_input() -> ClusterConfig:
         train_window=train_window,
         xla_preset=xla_preset,
         zero_sharding=zero_sharding,
+        tune_budget=tune_budget,
         profile_steps=profile_steps,
         profile_slow_zscore=profile_slow_zscore,
     )

@@ -153,6 +153,12 @@ class ClusterConfig:
     # workers as a disable; ACCELERATE_PROFILE_SLOW_ZSCORE).
     profile_steps: str | None = None
     profile_slow_zscore: float | None = None
+    # Profile-guided autotuner (tune/; docs/tuning.md): the short-bench trial
+    # budget one `accelerate-tpu tune` run may spend. TRI-state per the
+    # train_window precedent — None = unspecified (nothing exported, an
+    # inherited ACCELERATE_TUNE_BUDGET flows through), > 0 exported, an
+    # EXPLICIT 0 = "library default" and scrubs a stale inherited value.
+    tune_budget: int | None = None
     extra: dict = field(default_factory=dict)
 
     def to_dict(self) -> dict:

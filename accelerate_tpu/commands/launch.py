@@ -46,6 +46,7 @@ from ..utils.constants import (
     ENV_STRAGGLER_THRESHOLD,
     ENV_TELEMETRY,
     ENV_TRAIN_WINDOW,
+    ENV_TUNE_BUDGET,
     ENV_XLA_PRESET,
     ENV_ZERO_SHARDING,
 )
@@ -204,6 +205,14 @@ def launch_command_parser(subparsers=None) -> argparse.ArgumentParser:
              "value.",
     )
     parser.add_argument(
+        "--tune_budget", type=int, default=None,
+        help="Short-bench trial budget for `accelerate-tpu tune` runs in the "
+             "launched job's environment (ACCELERATE_TUNE_BUDGET): tri-state "
+             "— unset inherits, > 0 caps the trials, an explicit 0 scrubs a "
+             "stale inherited value (library default applies). See "
+             "docs/tuning.md.",
+    )
+    parser.add_argument(
         "--profile_slow_zscore", type=float, default=None,
         help="Slow-step trace trigger (ACCELERATE_PROFILE_SLOW_ZSCORE): when "
              "a step's wall time lands this many robust sigmas (EMA+MAD "
@@ -266,6 +275,7 @@ def _merge_config(args) -> ClusterConfig:
         ("zero_sharding", "zero_sharding"),
         ("profile_steps", "profile_steps"),
         ("profile_slow_zscore", "profile_slow_zscore"),
+        ("tune_budget", "tune_budget"),
     ]:
         val = getattr(args, flag, None)
         if val is not None:
@@ -370,6 +380,12 @@ def prepare_launch_env(cfg: ClusterConfig, process_id: int | None = None, attemp
         env[ENV_PROFILE_SLOW_ZSCORE] = str(cfg.profile_slow_zscore)
     elif cfg.profile_slow_zscore is not None:
         env.pop(ENV_PROFILE_SLOW_ZSCORE, None)
+    # Autotuner trial budget: tri-state like train_window — an explicit 0
+    # ("library default") must scrub a stale inherited value, not forward it.
+    if cfg.tune_budget and cfg.tune_budget > 0:
+        env[ENV_TUNE_BUDGET] = str(int(cfg.tune_budget))
+    elif cfg.tune_budget is not None:
+        env.pop(ENV_TUNE_BUDGET, None)
     # Plugins (e.g. the axon tunnel) may have pinned JAX_PLATFORMS in *this*
     # process's environ at jax-import time; children must re-discover their own
     # backend, so only forward the value we set deliberately.
@@ -507,6 +523,11 @@ def launch_command(args) -> None:
         )
     if cfg.train_window is not None and cfg.train_window < 1:
         raise ValueError(f"--train_window must be >= 1, got {cfg.train_window}")
+    if cfg.tune_budget is not None and cfg.tune_budget < 0:
+        raise ValueError(
+            f"--tune_budget must be >= 0 (0 = library default), got "
+            f"{cfg.tune_budget}"
+        )
     if cfg.profile_steps:
         # Fail a malformed range grammar at launch, not mid-run when the
         # profiler first arms (the fault-plan validation precedent).
@@ -529,14 +550,12 @@ def launch_command(args) -> None:
             "could never engage. Drop --no-telemetry (or the profiling flags)."
         )
     if cfg.xla_preset:
-        # Fail an unknown preset at launch, not after every worker compiled.
-        from ..utils.xla_flags import XLA_PRESETS
+        # Fail an unknown preset at launch, not after every worker compiled —
+        # normalize_preset_name's error enumerates the valid names (the same
+        # message install_xla_preset raises inside a worker).
+        from ..utils.xla_flags import normalize_preset_name
 
-        if cfg.xla_preset not in XLA_PRESETS and cfg.xla_preset != "none":
-            raise ValueError(
-                f"--xla_preset must be one of {sorted(XLA_PRESETS)}, got "
-                f"{cfg.xla_preset!r}"
-            )
+        normalize_preset_name(cfg.xla_preset)
     if cfg.max_restarts > 0 and cfg.num_machines > 1:
         raise ValueError(
             "--max_restarts only applies to single-machine jobs: on a pod, a "

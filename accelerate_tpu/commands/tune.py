@@ -1,0 +1,305 @@
+"""`accelerate-tpu tune` — the profile-guided autotuner CLI.
+
+Lowers a candidate grid over the framework's perf levers (train window × XLA
+preset × vocab chunk × remat policy × ZeRO sharding × prefetch), statically
+prunes predicted-OOM / invariant-violating candidates via the HBM and program
+auditors WITHOUT launching them, short-benches the survivors with trace
+capture armed, lets the attribution report steer a successive-halving search,
+and emits a ranked evidence report plus a ready-to-use winner ClusterConfig
+(docs/tuning.md). Trial wall-clock books as the goodput ledger's ``tune``
+badput class; ``bench.py`` replays the winner via ``BENCH_FROM_TUNE``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _csv_ints(raw: str) -> tuple:
+    return tuple(int(v.strip()) for v in raw.split(",") if v.strip())
+
+
+def _csv_strs(raw: str) -> tuple:
+    # An explicit empty entry selects the model default (e.g. --remats ",x").
+    return tuple(v.strip() for v in raw.split(","))
+
+
+def tune_command_parser(subparsers=None) -> argparse.ArgumentParser:
+    description = (
+        "Profile-guided autotuner: statically prune a candidate config grid "
+        "(HBM + program audits, no launches), short-bench the survivors with "
+        "trace capture armed, steer by the attribution report, and emit a "
+        "ranked evidence report + winner ClusterConfig"
+    )
+    if subparsers is not None:
+        parser = subparsers.add_parser("tune", description=description)
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu tune", description=description)
+    parser.add_argument(
+        "--budget", type=int, default=None,
+        help="Max short-bench trials (default: ACCELERATE_TUNE_BUDGET, then "
+             "16). Static prunes are free — only measured trials spend it.",
+    )
+    parser.add_argument(
+        "--trial_steps", type=int, default=None,
+        help="Measured steps per rung-0 trial (default 8); later rungs double "
+             "it (successive halving).",
+    )
+    parser.add_argument(
+        "--warmup", type=int, default=2, help="Warmup steps per trial (default 2)",
+    )
+    parser.add_argument(
+        "--rounds", type=int, default=4,
+        help="Max search rounds (rungs) before reporting (default 4)",
+    )
+    parser.add_argument(
+        "--batch", type=int, default=8, help="Batch rows for the trial fixture"
+    )
+    parser.add_argument(
+        "--seq", type=int, default=16, help="Sequence length for the trial fixture"
+    )
+    parser.add_argument(
+        "--optimizer", choices=("adamw", "sgd", "adafactor"), default="adamw",
+        help="Optimizer whose state the candidates carry (default adamw — the "
+             "2-moments-per-param case the ZeRO/memory levers target)",
+    )
+    parser.add_argument(
+        "--budget-gib", type=float, default=None,
+        help="Per-device HBM budget for the static prune's OOM verdict (GiB); "
+             "default is the chip generation's HBM x the 90%% headroom "
+             "contract — memcheck's budget.",
+    )
+    parser.add_argument(
+        "--cpu_virtual_devices", type=int, default=0,
+        help="Pin an N-device virtual CPU mesh before building (the memcheck "
+             "flag's analog): dp levers — ZeRO, replication verdicts — are "
+             "vacuous on a 1-device backend.",
+    )
+    parser.add_argument(
+        "--windows", type=_csv_ints, default=None,
+        help="Comma-separated train-window axis (default 1,2,4,8)",
+    )
+    parser.add_argument(
+        "--presets", type=_csv_strs, default=None,
+        help="Comma-separated xla-preset axis (default off,latency,"
+             "collective_matmul). NOTE: presets are backend-init env flags — "
+             "in one tune process they are recorded as recommendations "
+             "(preset_applied=false once the backend is live), not A/B-measured.",
+    )
+    parser.add_argument(
+        "--chunks", type=_csv_ints, default=None,
+        help="Comma-separated fused-loss vocab-chunk axis, 0 = model default "
+             "head (default 0). Order = toward less live-logits memory.",
+    )
+    parser.add_argument(
+        "--remats", type=_csv_strs, default=None,
+        help="Comma-separated remat-policy axis; empty entry = model default "
+             "(default ''). Order = toward more rematerialization.",
+    )
+    parser.add_argument(
+        "--prefetches", type=_csv_ints, default=None,
+        help="Comma-separated device-batch prefetch axis (default 0,2)",
+    )
+    parser.add_argument(
+        "--no-zero", action="store_true",
+        help="Exclude ZeRO cross-replica sharding from the space",
+    )
+    parser.add_argument(
+        "--no-capture", action="store_true",
+        help="Skip per-trial trace capture (the search then steers by the "
+             "memory verdict and step time only — attribution fractions are "
+             "absent from the evidence)",
+    )
+    parser.add_argument(
+        "--profile-dir", default=None,
+        help="Root for per-trial trace captures (default: "
+             "$TMPDIR/accelerate_tune_traces)",
+    )
+    parser.add_argument(
+        "--config_file", default=None,
+        help="ClusterConfig yaml to seed the base candidate from (and the "
+             "winner config inherits everything else from it)",
+    )
+    parser.add_argument(
+        "--output", default="tune_report.json",
+        help="Where to write the ranked evidence report JSON",
+    )
+    parser.add_argument(
+        "--winner-config", default="tune_winner.yaml",
+        help="Where to write the winner's ready-to-use ClusterConfig yaml",
+    )
+    parser.add_argument(
+        "--json", action="store_true",
+        help="Print the full report JSON on stdout instead of the summary table",
+    )
+    if subparsers is not None:
+        parser.set_defaults(func=tune_command)
+    return parser
+
+
+def _resolve_budget(flag_value) -> int:
+    from ..tune.space import DEFAULT_TUNE_BUDGET
+    from ..utils.constants import ENV_TUNE_BUDGET
+
+    if flag_value is not None:
+        return int(flag_value)
+    raw = os.environ.get(ENV_TUNE_BUDGET, "").strip()
+    if raw:
+        value = int(raw)
+        if value > 0:
+            return value
+    return DEFAULT_TUNE_BUDGET
+
+
+def tune_command(args) -> None:
+    budget = _resolve_budget(args.budget)
+    if budget < 1:
+        raise SystemExit("--budget must be >= 1")
+    if getattr(args, "cpu_virtual_devices", 0):
+        if args.cpu_virtual_devices < 1:
+            raise SystemExit("--cpu_virtual_devices must be >= 1")
+        from ..utils.environment import pin_cpu_platform
+
+        # Must precede the first backend touch (the rig's Accelerator()).
+        pin_cpu_platform(args.cpu_virtual_devices)
+
+    from ..tune.prune import static_prune
+    from ..tune.report import (
+        build_report,
+        format_summary,
+        write_report,
+        write_winner_yaml,
+    )
+    from ..tune.search import run_search
+    from ..tune.space import CandidateSpace
+    from ..tune.trials import DEFAULT_MEASURED_STEPS, TrialRig
+    from .config_args import load_config_from_file
+
+    base_cfg = None
+    if args.config_file is not None:
+        base_cfg = load_config_from_file(args.config_file)
+    overrides = {}
+    if args.windows is not None:
+        overrides["windows"] = args.windows
+    if args.presets is not None:
+        overrides["presets"] = args.presets
+    if args.chunks is not None:
+        overrides["vocab_chunks"] = args.chunks
+    if args.remats is not None:
+        overrides["remat_policies"] = args.remats
+    if args.prefetches is not None:
+        overrides["prefetches"] = args.prefetches
+    if args.no_zero:
+        overrides["zero_sharding"] = (False,)
+    space = CandidateSpace.from_cluster_config(base_cfg, **overrides)
+
+    rig = TrialRig(
+        batch_rows=args.batch,
+        seq=args.seq,
+        optimizer=args.optimizer,
+        budget_bytes=(
+            int(args.budget_gib * (1 << 30)) if args.budget_gib is not None else None
+        ),
+        profile_dir=args.profile_dir,
+    )
+
+    def prune_fn(candidates):
+        return static_prune(candidates, rig.audit_candidate)
+
+    def trial_fn(candidate, evidence, steps):
+        try:
+            result = rig.run_trial(
+                candidate,
+                evidence=evidence,
+                measured_steps=steps,
+                warmup_steps=args.warmup,
+                capture=not args.no_capture,
+            )
+        except Exception as exc:
+            print(
+                f"tune: trial {candidate.key()} failed "
+                f"({type(exc).__name__}: {exc}); skipping",
+                file=sys.stderr,
+            )
+            return None
+        return result.to_dict()
+
+    ranked, dropped, trail = run_search(
+        space,
+        prune_fn=prune_fn,
+        trial_fn=trial_fn,
+        trial_budget=budget,
+        base_steps=args.trial_steps or DEFAULT_MEASURED_STEPS,
+        max_rounds=args.rounds,
+    )
+    trials_run = sum(len(r["trialed"]) + len(r["failed"]) for r in trail)
+
+    backend = device = None
+    try:
+        import jax
+
+        backend = jax.default_backend()
+        # Report metadata only (a capacity/telemetry-style reader, like the
+        # baselined ones): which chip generation produced these numbers.
+        device = str(jax.devices()[0].device_kind)  # accelerate-lint: disable=raw-device-baseline
+    except Exception:
+        pass
+    report = build_report(
+        ranked=ranked,
+        dropped=dropped,
+        trail=trail,
+        space=space,
+        trial_budget=budget,
+        trials_run=trials_run,
+        backend=backend,
+        device=device,
+    )
+    if args.output:
+        write_report(args.output, report)
+    if report["winner"] is None:
+        print(json.dumps(report, indent=1) if args.json else format_summary(report))
+        failed = sum(len(r["failed"]) for r in trail)
+        if failed:
+            diagnosis = (
+                f"every short-bench trial failed ({failed} of {trials_run} "
+                "spent; see the per-trial stderr above)"
+            )
+        else:
+            diagnosis = (
+                f"no candidate survived the static prune ({len(dropped)} "
+                "dropped)"
+            )
+        print(
+            f"tune: {diagnosis} — nothing to rank; see "
+            f"{args.output or 'the report'}",
+            file=sys.stderr,
+        )
+        raise SystemExit(1)
+    if args.winner_config:
+        write_winner_yaml(
+            args.winner_config, report["winner"]["candidate"], base_cfg=base_cfg
+        )
+    if args.json:
+        print(json.dumps(report, indent=1))
+    else:
+        print(format_summary(report))
+        if args.output:
+            print(f"report: {args.output}")
+        if args.winner_config:
+            print(
+                f"winner config: {args.winner_config} "
+                "(launch --config_file it, or replay via BENCH_FROM_TUNE="
+                f"{args.output})"
+            )
+
+
+def tune_main() -> None:
+    """Console-script entry (`accelerate-tpu-tune`, pyproject [project.scripts])."""
+    tune_command(tune_command_parser().parse_args())
+
+
+if __name__ == "__main__":
+    tune_main()

@@ -16,14 +16,16 @@ the same numbers with zero per-step cost.
 
 The categories follow the goodput decomposition used by large TPU trainers
 (productive step time vs program-acquisition and checkpoint overheads): one
-goodput bucket (``step``) and eight badput buckets — ``compile``, ``ckpt_save``,
+goodput bucket (``step``) and nine badput buckets — ``compile``, ``ckpt_save``,
 ``ckpt_restore``, ``restart``, the health subsystem's ``rollback``
 (last-known-good restores after a NaN/loss-spike trip, health/rollback.py) and
 ``hang`` (time a wedged run sat before the watchdog fired, health/hang.py),
-plus ``reshard`` (elastic world-size transitions, resilience/elastic.py) and
-``profile`` (trace-capture start/stop/parse overhead, telemetry/profiler.py).
-Wall-clock not attributed to any bucket is reported as ``other_s`` (data
-feeding, host-side logging, eval, idle).
+plus ``reshard`` (elastic world-size transitions, resilience/elastic.py),
+``profile`` (trace-capture start/stop/parse overhead, telemetry/profiler.py),
+and ``tune`` (the autotuner's short-bench trials, tune/trials.py — reserved
+chip time spent measuring candidate configs, not training).  Wall-clock not
+attributed to any bucket is reported as ``other_s`` (data feeding, host-side
+logging, eval, idle).
 """
 
 from __future__ import annotations
@@ -40,9 +42,12 @@ GOODPUT_CATEGORY = "step"
 # stopping an XLA trace and parsing it into the attribution report — booked so
 # a profiled run's goodput/MFU accounting stays honest about what the
 # diagnosis itself cost.
+# ``tune`` is autotuner trial time (tune/trials.py): the whole wall-clock of a
+# candidate's short-bench — build, compile, warmup, and measured steps — so
+# trial steps never count as productive training and can't inflate MFU/goodput.
 BADPUT_CATEGORIES = (
     "compile", "ckpt_save", "ckpt_restore", "restart", "rollback", "hang",
-    "reshard", "profile",
+    "reshard", "profile", "tune",
 )
 CATEGORIES = (GOODPUT_CATEGORY,) + BADPUT_CATEGORIES
 

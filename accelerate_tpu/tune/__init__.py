@@ -1,0 +1,83 @@
+"""Profile-guided autotuner — close the loop from attribution to config.
+
+The framework measures everything (per-step compute/collective/host/idle
+attribution, the OOM-before-launch memcheck, the goodput ledger) and exposes
+every lever as a flag; this package connects them so a new topology self-tunes
+in minutes instead of a human sweeping flags:
+
+1. :mod:`.space` — the declarative candidate grid over train_window ×
+   xla_preset × vocab_chunk × remat_policy × zero_sharding × prefetch, seeded
+   from ClusterConfig;
+2. :mod:`.prune` — every candidate is lowered WITHOUT launching and the
+   static HBM + program auditors discard predicted-OOM / invariant-violating
+   points, booking why;
+3. :mod:`.trials` — survivors run a warmup+N short bench with trace capture
+   armed, booked as ``tune`` badput;
+4. :mod:`.search` — the traceview attribution steers a successive-halving
+   loop (idle → window/latency-preset, collective-bound →
+   collective_matmul/ZeRO, memory-bound → remat/vocab-chunk);
+5. :mod:`.report` — the ranked evidence report plus a ready-to-use winner
+   ClusterConfig (``bench.py`` replays it via ``BENCH_FROM_TUNE``).
+
+Surface: ``accelerate-tpu tune`` (commands/tune.py); docs/tuning.md.
+"""
+
+from .prune import (
+    REASON_AUDIT_VIOLATION,
+    REASON_BUILD_FAILED,
+    REASON_PREDICTED_OOM,
+    audit_failures,
+    static_prune,
+)
+from .report import (
+    TUNE_SCHEMA_VERSION,
+    build_report,
+    format_summary,
+    load_report,
+    load_winner,
+    winner_cluster_config,
+    write_report,
+    write_winner_yaml,
+)
+from .search import (
+    classify_bottleneck,
+    propose_moves,
+    run_search,
+)
+from .space import (
+    DEFAULT_TUNE_BUDGET,
+    Candidate,
+    CandidateSpace,
+)
+from .trials import (
+    DEFAULT_MEASURED_STEPS,
+    DEFAULT_WARMUP_STEPS,
+    TrialResult,
+    TrialRig,
+)
+
+__all__ = [
+    "Candidate",
+    "CandidateSpace",
+    "DEFAULT_MEASURED_STEPS",
+    "DEFAULT_TUNE_BUDGET",
+    "DEFAULT_WARMUP_STEPS",
+    "REASON_AUDIT_VIOLATION",
+    "REASON_BUILD_FAILED",
+    "REASON_PREDICTED_OOM",
+    "TUNE_SCHEMA_VERSION",
+    "TrialResult",
+    "TrialRig",
+    "audit_failures",
+    "build_report",
+    "classify_bottleneck",
+    "format_summary",
+    "load_report",
+    "load_winner",
+    "propose_moves",
+    "run_search",
+    "static_prune",
+    "winner_cluster_config",
+    "write_report",
+    "write_winner_yaml",
+]

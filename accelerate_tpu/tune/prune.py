@@ -1,0 +1,109 @@
+"""Static prune — discard candidates before they cost a single chip second.
+
+Every candidate is LOWERED, never launched: the existing static analyzers run
+on the lowered artifact — the program auditor (analysis/audit.py: collective
+inventory per mesh axis, donation aliasing, host callbacks) and the static HBM
+auditor (analysis/memory.py: per-device byte attribution and the
+OOM-before-launch verdict). A candidate is dropped when
+
+- the memory auditor predicts OOM (``predicted_peak_bytes`` over the budget —
+  the per-generation HBM × headroom default, or the tuner's ``--budget-gib``
+  override), reason ``predicted_oom``; or
+- the program audit is not clean (a dp-axis all-gather, host callback, or
+  donation miss — the same zero-tolerance set ``accelerate-tpu audit`` exits 1
+  on), reason ``audit_violation``.
+
+Each drop is booked with the failure detail and the audit/memory evidence, so
+the tune report can show WHY a point in the space was never trialed.
+
+The audit callable is injected (``audit_fn(candidate) -> (evidence,
+failures)``) — trials.py provides the real lower-and-audit adapter (cached per
+:meth:`~.space.Candidate.lowering_key`); tests drive the prune logic with
+synthetic verdicts.
+"""
+
+from __future__ import annotations
+
+# Machine-readable drop reasons (the report's ``dropped[].reason`` values).
+REASON_PREDICTED_OOM = "predicted_oom"
+REASON_AUDIT_VIOLATION = "audit_violation"
+REASON_BUILD_FAILED = "build_failed"
+
+
+def audit_failures(audit_summary: dict | None, memory_summary: dict | None,
+                   budget_bytes: int | None = None) -> list:
+    """The prune verdicts for one lowered candidate, from the analyzers'
+    summary dicts (``AuditReport.summary_dict()`` / ``MemoryReport
+    .summary_dict()`` — also exactly what ``audit --json`` / ``memcheck
+    --json`` put under ``report``). ``budget_bytes`` overrides the memory
+    report's own budget for the OOM verdict."""
+    failures = []
+    if memory_summary is not None:
+        peak = int(memory_summary.get("predicted_peak_bytes", 0))
+        budget = int(
+            budget_bytes if budget_bytes is not None
+            else memory_summary.get("budget_bytes", 0)
+        )
+        if budget and peak > budget:
+            failures.append({
+                "reason": REASON_PREDICTED_OOM,
+                "detail": (
+                    f"predicted OOM: peak {peak} B/device exceeds budget "
+                    f"{budget} B"
+                ),
+            })
+    if audit_summary is not None and not audit_summary.get("clean", True):
+        failures.append({
+            "reason": REASON_AUDIT_VIOLATION,
+            "detail": (
+                "program audit not clean: "
+                f"dp_allgathers={audit_summary.get('dp_allgathers')}, "
+                f"host_callbacks={audit_summary.get('host_callbacks')}, "
+                f"donation_misses={audit_summary.get('donation_misses')}"
+            ),
+        })
+    return failures
+
+
+def static_prune(candidates, audit_fn):
+    """Lower-and-audit each candidate via ``audit_fn`` and split the list into
+    survivors and booked drops.
+
+    ``audit_fn(candidate)`` returns ``(evidence, failures)`` where
+    ``evidence`` is ``{"audit": summary|None, "memory": summary|None}`` and
+    ``failures`` is a possibly-empty list of ``{"reason", "detail"}`` dicts
+    (:func:`audit_failures` builds them from real reports). An ``audit_fn``
+    that raises books the candidate as ``build_failed`` — a candidate whose
+    program cannot even be built must not kill the sweep.
+
+    Returns ``(kept, dropped)``: ``kept`` is ``[(candidate, evidence), ...]``
+    in input order; ``dropped`` entries carry the candidate, reasons, details,
+    and evidence."""
+    kept, dropped = [], []
+    for candidate in candidates:
+        try:
+            evidence, failures = audit_fn(candidate)
+        except Exception as exc:
+            dropped.append({
+                "candidate": candidate.to_dict(),
+                "key": candidate.key(),
+                "reason": REASON_BUILD_FAILED,
+                "failures": [{
+                    "reason": REASON_BUILD_FAILED,
+                    "detail": f"{type(exc).__name__}: {exc}"[:300],
+                }],
+                "evidence": None,
+            })
+            continue
+        if failures:
+            dropped.append({
+                "candidate": candidate.to_dict(),
+                "key": candidate.key(),
+                # Headline reason = the first failure; the full list rides.
+                "reason": failures[0]["reason"],
+                "failures": list(failures),
+                "evidence": evidence,
+            })
+        else:
+            kept.append((candidate, evidence))
+    return kept, dropped

@@ -1,0 +1,224 @@
+"""Attribution-driven search — let the traceview report steer, not a sweep.
+
+The measured attribution of a trial (telemetry/traceview.py fractions:
+compute / collective / host / idle, disjoint by construction) plus the static
+memory audit classify the current best candidate's bottleneck, and each
+bottleneck names its moves on the lever space:
+
+- **memory** (predicted peak within ``MEMORY_PRESSURE`` of the budget) →
+  stronger remat policy, smaller/enabled vocab chunk, ZeRO sharding on (the
+  1/dp opt-state drop);
+- **collective** (exposed-collective fraction dominates) → the
+  ``collective_matmul`` preset (windowed einsum overlaps the tp/sp gathers),
+  ZeRO sharding (reduce-scatter + all-gather instead of a fat all-reduce);
+- **idle** (idle + host fraction dominates: the device is starved, the
+  dispatch RTT and input feeding are the tax) → raise the train window, the
+  ``latency`` preset, deeper prefetch;
+- **compute** — the device is busy doing math: the config is at its roofline,
+  no move is proposed.
+
+:func:`run_search` wraps the policy in a successive-halving loop: rung 0
+short-benches every statically-pruned seed at ``base_steps`` measured steps;
+each later rung keeps the top ``keep_fraction`` (re-trialed at doubled steps —
+the halving refinement) plus the bottleneck-proposed neighbors of the current
+best (statically pruned before they cost a trial). The loop stops when the
+trial budget is spent, nothing new is proposed and every keeper is refined, or
+``max_rounds`` is hit. Every decision is booked in the returned trail so the
+report can show the search's reasoning, not just its ranking.
+
+This module is deliberately engine-free: candidates go in, ``(candidate,
+result-dict)`` pairs come out, and the prune/trial callables are injected —
+the real adapters live in trials.py / commands/tune.py, deterministic
+synthetic fixtures drive the policy tests.
+"""
+
+from __future__ import annotations
+
+import math
+
+# Classification outcomes.
+BOTTLENECK_MEMORY = "memory"
+BOTTLENECK_COLLECTIVE = "collective"
+BOTTLENECK_IDLE = "idle"
+BOTTLENECK_COMPUTE = "compute"
+BOTTLENECK_UNKNOWN = "unknown"
+
+# Predicted peak at/above this fraction of the HBM budget = memory-bound:
+# headroom this thin turns into a compile-time OOM on the next shape/batch
+# bump, so the search spends moves buying memory back before chasing speed.
+MEMORY_PRESSURE = 0.8
+# An exposed fraction at/above this is worth spending a move on.
+DOMINANCE = 0.25
+
+
+def classify_bottleneck(
+    fractions: dict | None,
+    predicted_peak_bytes: int = 0,
+    budget_bytes: int = 0,
+    memory_pressure: float = MEMORY_PRESSURE,
+    dominance: float = DOMINANCE,
+) -> str:
+    """One bottleneck label for a trial's evidence. ``fractions`` is the
+    traceview disjoint attribution (may be None when the trial ran without a
+    parseable capture — then only the memory verdict can classify)."""
+    if budget_bytes and predicted_peak_bytes >= memory_pressure * budget_bytes:
+        return BOTTLENECK_MEMORY
+    if not fractions:
+        return BOTTLENECK_UNKNOWN
+    idle = float(fractions.get("idle", 0.0)) + float(fractions.get("host", 0.0))
+    collective = float(fractions.get("collective", 0.0))
+    if collective >= dominance and collective >= idle:
+        return BOTTLENECK_COLLECTIVE
+    if idle >= dominance:
+        return BOTTLENECK_IDLE
+    return BOTTLENECK_COMPUTE
+
+
+def propose_moves(candidate, bottleneck: str, space) -> list:
+    """The ordered, deduped neighbor candidates the bottleneck names (empty
+    for compute-bound/unknown: nothing to fix, or nothing to steer by)."""
+    moves = []
+    if bottleneck == BOTTLENECK_MEMORY:
+        moves = [
+            space.strengthen_remat(candidate),
+            space.shrink_chunk(candidate),
+            space.enable_zero(candidate),
+        ]
+    elif bottleneck == BOTTLENECK_COLLECTIVE:
+        moves = [
+            space.raise_preset(candidate, to="collective_matmul"),
+            space.enable_zero(candidate),
+        ]
+    elif bottleneck == BOTTLENECK_IDLE:
+        moves = [
+            space.raise_window(candidate),
+            space.raise_preset(candidate, to="latency"),
+            space.raise_prefetch(candidate),
+        ]
+    out, seen = [], set()
+    for m in moves:
+        if m is not None and m.key() not in seen:
+            seen.add(m.key())
+            out.append(m)
+    return out
+
+
+def run_search(
+    space,
+    *,
+    prune_fn,
+    trial_fn,
+    trial_budget: int,
+    seeds=None,
+    base_steps: int = 4,
+    max_rounds: int = 4,
+    keep_fraction: float = 0.5,
+):
+    """The successive-halving loop (see module docstring).
+
+    ``prune_fn(candidates) -> (kept, dropped)`` is :func:`~.prune
+    .static_prune` bound to an audit adapter; ``trial_fn(candidate, evidence,
+    steps) -> dict | None`` short-benches one candidate for ``steps`` measured
+    steps and returns its result dict (``step_time_s`` required; ``fractions``
+    / ``predicted_peak_bytes`` / ``budget_bytes`` steer the policy; None =
+    trial failed, candidate is skipped).
+
+    Returns ``(ranked, dropped, trail)``: ``ranked`` is ``[(candidate,
+    result), ...]`` best-first by ``step_time_s`` (each candidate's
+    longest-rung result), ``dropped`` the booked static prunes, ``trail`` the
+    per-round decision log."""
+    seeds = space.seeds() if seeds is None else list(seeds)
+    rung, dropped = prune_fn(seeds)
+    evidence = {cand.key(): ev for cand, ev in rung}
+    best = {}     # key -> (candidate, result, steps_ran): the longest rung's
+    # Keys that must never be (re-)proposed: trial failures persist across
+    # rounds (a deterministically-failing candidate must not re-spend budget
+    # every time the same bottleneck re-proposes it), and already-pruned keys
+    # must not re-prune into duplicate `dropped` bookings.
+    failed_ever = set()
+    dropped_keys = {d["key"] for d in dropped}
+    trail = []
+    budget = int(trial_budget)
+    steps = max(int(base_steps), 1)
+    for round_idx in range(max(int(max_rounds), 1)):
+        if not rung or budget <= 0:
+            break
+        trialed, failed = [], []
+        for cand, ev in rung:
+            if budget <= 0:
+                break
+            prev = best.get(cand.key())
+            if prev is not None and prev[2] >= steps:
+                continue  # already measured at this rung or a longer one
+            result = trial_fn(cand, ev, steps)
+            budget -= 1
+            if result is None:
+                failed.append(cand.key())
+                failed_ever.add(cand.key())
+                continue
+            best[cand.key()] = (cand, result, steps)
+            trialed.append(cand.key())
+        # Rank the CURRENT rung (the global best is always a member: keepers
+        # are the top of the previous rung's ranking).
+        rung_ranked = sorted(
+            (best[c.key()] for c, _ in rung if c.key() in best),
+            key=lambda t: t[1]["step_time_s"],
+        )
+        if not rung_ranked:
+            # Every trial this round failed (or budget ran dry before one
+            # succeeded): book the round so the spent budget stays visible in
+            # the trail — an empty trail would misread as "never trialed".
+            trail.append({
+                "round": round_idx,
+                "measured_steps": steps,
+                "trialed": trialed,
+                "failed": failed,
+                "best": None,
+                "best_step_time_s": None,
+                "bottleneck": None,
+                "proposed": [],
+                "pruned": [],
+            })
+            break
+        top_cand, top_result, _ = rung_ranked[0]
+        bottleneck = classify_bottleneck(
+            top_result.get("fractions"),
+            int(top_result.get("predicted_peak_bytes", 0) or 0),
+            int(top_result.get("budget_bytes", 0) or 0),
+        )
+        proposals = [
+            c for c in propose_moves(top_cand, bottleneck, space)
+            if c.key() not in best and c.key() not in failed_ever
+            and c.key() not in dropped_keys
+        ]
+        fresh, newly_dropped = prune_fn(proposals) if proposals else ([], [])
+        dropped += newly_dropped
+        dropped_keys.update(d["key"] for d in newly_dropped)
+        evidence.update({cand.key(): ev for cand, ev in fresh})
+        # Halving: the rung's top keep_fraction graduate to the next rung and
+        # are re-trialed at doubled measured steps (the refinement), alongside
+        # the bottleneck-proposed fresh candidates.
+        n_keep = max(1, math.ceil(len(rung_ranked) * keep_fraction))
+        keepers = [
+            (cand, evidence.get(cand.key()))
+            for cand, _result, _steps in rung_ranked[:n_keep]
+        ]
+        trail.append({
+            "round": round_idx,
+            "measured_steps": steps,
+            "trialed": trialed,
+            "failed": failed,
+            "best": top_cand.key(),
+            "best_step_time_s": top_result["step_time_s"],
+            "bottleneck": bottleneck,
+            "proposed": [c.key() for c in proposals],
+            "pruned": [d["key"] for d in newly_dropped],
+        })
+        steps *= 2
+        rung = fresh + keepers
+        if not fresh and len(keepers) <= 1:
+            # Nothing new to explore and the rung has halved to the winner —
+            # further rounds would only re-measure it.
+            break
+    ranked = sorted(best.values(), key=lambda t: t[1]["step_time_s"])
+    return [(cand, result) for cand, result, _steps in ranked], dropped, trail

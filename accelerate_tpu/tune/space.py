@@ -1,0 +1,245 @@
+"""Candidate space — the declarative grid `accelerate-tpu tune` searches.
+
+A :class:`Candidate` is one point in the lever space the framework already
+exposes flag-by-flag: the K-step train window (``--train_window``), the XLA
+latency-hiding preset (``--xla_preset``), the fused-loss vocab chunk
+(``BENCH_VOCAB_CHUNK`` / ``LlamaConfig.fused_loss_chunk``), the remat policy,
+ZeRO cross-replica optimizer sharding (``--zero_sharding``), and the device
+batch prefetch depth (``BENCH_PREFETCH`` / ``DeviceBatchPrefetcher``).
+
+:class:`CandidateSpace` holds the per-axis value lists (each ordered so the
+search's "raise this lever" moves are well-defined), seeds from a
+:class:`~..commands.config_args.ClusterConfig`, and enumerates the initial
+one-change-at-a-time grid around the base point. Two candidates that differ
+only in ``xla_preset`` or ``prefetch`` lower to the SAME program in one
+process (presets are backend-init env flags, prefetch is host-side), which is
+what lets prune.py audit one lowering per :meth:`Candidate.lowering_key` and
+serve every candidate that shares it — the GSPMD one-program-many-configs
+idiom (arxiv 2105.04663) applied to the tuner.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+
+# Library-default short-bench trial budget (overridden by `tune --budget` /
+# ACCELERATE_TUNE_BUDGET / ClusterConfig.tune_budget).
+DEFAULT_TUNE_BUDGET = 16
+
+
+@dataclass(frozen=True)
+class Candidate:
+    """One point in the lever space. Field defaults are the library defaults
+    (per-step dispatch, no preset, model-default loss/remat, ZeRO off)."""
+
+    train_window: int = 1
+    xla_preset: str = "off"
+    vocab_chunk: int = 0     # 0 = model default head (dense or its own chunk)
+    remat_policy: str = ""   # '' = model default policy
+    zero_sharding: bool = False
+    prefetch: int = 0
+
+    def key(self) -> str:
+        """Stable identity used for dedup, result joins, and the report."""
+        return (
+            f"w{self.train_window}"
+            f".x{self.xla_preset}"
+            f".c{self.vocab_chunk}"
+            f".r{self.remat_policy or 'default'}"
+            f".z{int(self.zero_sharding)}"
+            f".p{self.prefetch}"
+        )
+
+    def lowering_key(self) -> str:
+        """Identity of the LOWERED PROGRAM this candidate runs: excludes
+        ``xla_preset`` (process-level env flags, fixed once the backend
+        initialized) and ``prefetch`` (host-side feeding) — candidates sharing
+        this key share one static audit."""
+        return (
+            f"w{self.train_window}"
+            f".c{self.vocab_chunk}"
+            f".r{self.remat_policy or 'default'}"
+            f".z{int(self.zero_sharding)}"
+        )
+
+    def replace(self, **kw) -> "Candidate":
+        return dataclasses.replace(self, **kw)
+
+    def to_dict(self) -> dict:
+        return {
+            "train_window": self.train_window,
+            "xla_preset": self.xla_preset,
+            "vocab_chunk": self.vocab_chunk,
+            "remat_policy": self.remat_policy,
+            "zero_sharding": self.zero_sharding,
+            "prefetch": self.prefetch,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Candidate":
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+
+@dataclass
+class CandidateSpace:
+    """Axis value lists, each in "raise the lever" order:
+
+    - ``windows`` / ``prefetches``: ascending (more dispatch amortization);
+    - ``presets``: more overlap to the right (off → latency →
+      collective_matmul);
+    - ``vocab_chunks``: toward LESS live-logits memory to the right (0 = model
+      default first, then descending chunk sizes);
+    - ``remat_policies``: toward MORE rematerialization (less activation
+      memory) to the right, starting at '' (model default).
+    """
+
+    windows: tuple = (1, 2, 4, 8)
+    presets: tuple = ("off", "latency", "collective_matmul")
+    vocab_chunks: tuple = (0,)
+    remat_policies: tuple = ("",)
+    zero_sharding: tuple = (False, True)
+    prefetches: tuple = (0, 2)
+    base: Candidate = field(default_factory=Candidate)
+
+    def __post_init__(self):
+        from ..utils.xla_flags import normalize_preset_name
+
+        self.windows = tuple(sorted({int(w) for w in self.windows if int(w) >= 1}))
+        self.presets = tuple(
+            dict.fromkeys(normalize_preset_name(p) for p in self.presets)
+        )
+        self.vocab_chunks = tuple(dict.fromkeys(int(c) for c in self.vocab_chunks))
+        self.remat_policies = tuple(dict.fromkeys(str(r) for r in self.remat_policies))
+        self.zero_sharding = tuple(dict.fromkeys(bool(z) for z in self.zero_sharding))
+        self.prefetches = tuple(
+            sorted({int(p) for p in self.prefetches if int(p) >= 0})
+        )
+        # The base point must sit ON the grid — but it is the user's CURRENT
+        # config, so the axes absorb it rather than the base being snapped to
+        # the axes: a report claiming "winner vs current config" must have
+        # trialed the actual current config, not a nearest grid point.
+        self._absorb_base()
+
+    def _absorb_base(self):
+        base = self.base
+        if base.train_window not in self.windows:
+            self.windows = tuple(sorted(set(self.windows) | {base.train_window}))
+        if base.xla_preset not in self.presets:
+            # Keep the canonical overlap ordering (XLA_PRESETS declaration
+            # order: off -> latency -> collective_matmul).
+            from ..utils.xla_flags import XLA_PRESETS
+
+            rank = {name: i for i, name in enumerate(XLA_PRESETS)}
+            self.presets = tuple(sorted(
+                set(self.presets) | {base.xla_preset}, key=lambda p: rank[p]
+            ))
+        if base.vocab_chunk not in self.vocab_chunks:
+            # Prepend: the axis is ordered toward LESS live-logits memory, and
+            # the current config is the least-aggressive point by definition.
+            self.vocab_chunks = (base.vocab_chunk,) + self.vocab_chunks
+        if base.remat_policy not in self.remat_policies:
+            self.remat_policies = (base.remat_policy,) + self.remat_policies
+        if base.zero_sharding not in self.zero_sharding:
+            self.zero_sharding = tuple(sorted(
+                set(self.zero_sharding) | {base.zero_sharding}
+            ))
+        if base.prefetch not in self.prefetches:
+            self.prefetches = tuple(sorted(set(self.prefetches) | {base.prefetch}))
+
+    @classmethod
+    def from_cluster_config(cls, cfg=None, **overrides) -> "CandidateSpace":
+        """Seed the base point from a ClusterConfig's already-chosen levers
+        (``train_window`` / ``xla_preset`` / ``zero_sharding``); axis
+        overrides come from the CLI."""
+        from ..utils.xla_flags import normalize_preset_name
+
+        base = Candidate(
+            train_window=int(getattr(cfg, "train_window", None) or 1),
+            xla_preset=normalize_preset_name(getattr(cfg, "xla_preset", "") or "off"),
+            zero_sharding=bool(getattr(cfg, "zero_sharding", None) or False),
+        )
+        return cls(base=base, **overrides)
+
+    # ------------------------------------------------------------------ moves
+    def _next(self, axis: tuple, value):
+        """The value one step to the right of ``value`` on ``axis`` (None at
+        the end or off-axis)."""
+        try:
+            i = axis.index(value)
+        except ValueError:
+            return None
+        return axis[i + 1] if i + 1 < len(axis) else None
+
+    def raise_window(self, c: Candidate) -> Candidate | None:
+        nxt = self._next(self.windows, c.train_window)
+        return c.replace(train_window=nxt) if nxt is not None else None
+
+    def raise_prefetch(self, c: Candidate) -> Candidate | None:
+        nxt = self._next(self.prefetches, c.prefetch)
+        return c.replace(prefetch=nxt) if nxt is not None else None
+
+    def raise_preset(self, c: Candidate, to: str | None = None) -> Candidate | None:
+        """Move the preset right — to ``to`` when given (and actually to the
+        right of the current), else one step."""
+        if to is not None:
+            if to not in self.presets:
+                return None
+            if self.presets.index(to) <= self.presets.index(c.xla_preset):
+                return None
+            return c.replace(xla_preset=to)
+        nxt = self._next(self.presets, c.xla_preset)
+        return c.replace(xla_preset=nxt) if nxt is not None else None
+
+    def shrink_chunk(self, c: Candidate) -> Candidate | None:
+        nxt = self._next(self.vocab_chunks, c.vocab_chunk)
+        return c.replace(vocab_chunk=nxt) if nxt is not None else None
+
+    def strengthen_remat(self, c: Candidate) -> Candidate | None:
+        nxt = self._next(self.remat_policies, c.remat_policy)
+        return c.replace(remat_policy=nxt) if nxt is not None else None
+
+    def enable_zero(self, c: Candidate) -> Candidate | None:
+        if c.zero_sharding or True not in self.zero_sharding:
+            return None
+        return c.replace(zero_sharding=True)
+
+    # ------------------------------------------------------------------ seeds
+    def seeds(self, limit: int | None = None) -> list:
+        """The initial rung: the base point first (it is always trialed, so
+        the report can state winner-vs-default), then every one-axis mutation
+        of it, in deterministic axis order, deduped, optionally truncated."""
+        out = [self.base]
+        seen = {self.base.key()}
+        mutations = []
+        for w in self.windows:
+            mutations.append(self.base.replace(train_window=w))
+        for p in self.presets:
+            mutations.append(self.base.replace(xla_preset=p))
+        for chunk in self.vocab_chunks:
+            mutations.append(self.base.replace(vocab_chunk=chunk))
+        for r in self.remat_policies:
+            mutations.append(self.base.replace(remat_policy=r))
+        for z in self.zero_sharding:
+            mutations.append(self.base.replace(zero_sharding=z))
+        for pf in self.prefetches:
+            mutations.append(self.base.replace(prefetch=pf))
+        for m in mutations:
+            if m.key() not in seen:
+                seen.add(m.key())
+                out.append(m)
+        if limit is not None:
+            out = out[: max(int(limit), 1)]
+        return out
+
+    def to_dict(self) -> dict:
+        return {
+            "windows": list(self.windows),
+            "presets": list(self.presets),
+            "vocab_chunks": list(self.vocab_chunks),
+            "remat_policies": list(self.remat_policies),
+            "zero_sharding": list(self.zero_sharding),
+            "prefetches": list(self.prefetches),
+            "base": self.base.to_dict(),
+        }

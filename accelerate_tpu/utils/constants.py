@@ -88,6 +88,13 @@ ENV_FLIGHT_DIR = "ACCELERATE_FLIGHT_DIR"
 ENV_TRAIN_WINDOW = "ACCELERATE_TRAIN_WINDOW"
 ENV_XLA_PRESET = "ACCELERATE_XLA_PRESET"
 
+# Profile-guided autotuner (tune/; docs/tuning.md): the max short-bench trials
+# one `accelerate-tpu tune` run may spend. Tri-state per the train-window
+# precedent — unset = library default (tune/space.DEFAULT_TUNE_BUDGET), a
+# positive value caps the trials, and the launcher scrubs an explicit 0 so a
+# stale inherited value never leaks into a child run.
+ENV_TUNE_BUDGET = "ACCELERATE_TUNE_BUDGET"
+
 # Cross-replica (ZeRO-style) sharding of optimizer state + the weight update
 # along the dp axis (arxiv 2004.13336): opt-state HBM drops to ~1/dp and the
 # fused update lowers as reduce-scatter(grads) → sharded clip+update →

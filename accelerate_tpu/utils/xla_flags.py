@@ -59,6 +59,7 @@ XLA_PRESETS: dict[str, tuple[str, ...]] = {
 }
 
 _active_preset: str | None = None
+_active_flags: tuple = ()
 
 
 def active_preset() -> str | None:
@@ -66,32 +67,61 @@ def active_preset() -> str | None:
     return _active_preset
 
 
+def active_preset_flags() -> tuple:
+    """The AS-RESOLVED flag tokens of the installed preset: each preset token,
+    with an operator's explicit ``LIBTPU_INIT_ARGS`` value winning over the
+    preset's where both name the same flag. Empty when no preset is installed.
+    The autotuner's evidence report attaches this so a ranked candidate records
+    the exact flags its trial ran under, not just the preset name."""
+    return _active_flags
+
+
 def _reset_active_preset():
     """Test hook: forget the install record (env flags are left as-is)."""
-    global _active_preset
+    global _active_preset, _active_flags
     _active_preset = None
+    _active_flags = ()
+
+
+def normalize_preset_name(name: str | None) -> str:
+    """Canonical preset key for ``name`` (''/'none' → 'off'), or raise a
+    ValueError that ENUMERATES the valid preset names. The single validation
+    home: ``launch --xla_preset``, ``install_xla_preset``, and the tuner's
+    candidate space all route here so every surface fails with the same
+    name-listing message."""
+    key = (name or "").strip().lower()
+    if key in ("", "none"):
+        key = "off"
+    if key not in XLA_PRESETS:
+        raise ValueError(
+            f"unknown xla preset {name!r}: valid presets are "
+            f"{', '.join(sorted(XLA_PRESETS))} (utils/xla_flags.XLA_PRESETS)"
+        )
+    return key
+
+
+def preset_flags(name: str | None) -> tuple:
+    """The canonical flag-token tuple of a (validated) preset name — () for
+    'off'. Raises the enumerating ValueError on an unknown name."""
+    return tuple(XLA_PRESETS[normalize_preset_name(name)])
 
 
 def install_xla_preset(name: str) -> str | None:
     """Merge the named preset's tokens into ``LIBTPU_INIT_ARGS`` (idempotent:
     tokens already present — from an operator's own env or a previous install —
     are kept, not duplicated, and an operator's explicit ``--flag=`` setting
-    wins over the preset's). Returns the installed name, or None for 'off'.
+    wins over the preset's). Returns the installed name, or None for 'off';
+    :func:`active_preset_flags` then reports the resolved token list.
 
     Must run before the first TPU backend touch in the process; installing
     after is recorded (telemetry echoes the ask) but warned about, since
     libtpu reads the variable once at init.
     """
-    global _active_preset
-    key = (name or "").strip().lower()
-    if key in ("", "none"):
-        key = "off"
-    if key not in XLA_PRESETS:
-        raise ValueError(
-            f"unknown xla preset {name!r}; choose from {sorted(XLA_PRESETS)}"
-        )
+    global _active_preset, _active_flags
+    key = normalize_preset_name(name)
     if key == "off":
         _active_preset = None
+        _active_flags = ()
         return None
     existing = os.environ.get("LIBTPU_INIT_ARGS", "")
     tokens = existing.split()
@@ -109,6 +139,15 @@ def install_xla_preset(name: str) -> str | None:
             key,
         )
     _active_preset = key
+    # Resolve each preset token against the merged env: the value actually in
+    # LIBTPU_INIT_ARGS wins (an operator override stays visible as-overridden).
+    resolved = dict(
+        t.split("=", 1) for t in os.environ["LIBTPU_INIT_ARGS"].split() if "=" in t
+    )
+    _active_flags = tuple(
+        f"{flag}={resolved.get(flag, value)}"
+        for flag, value in (t.split("=", 1) for t in XLA_PRESETS[key])
+    )
     return key
 
 

@@ -47,7 +47,11 @@ import numpy as np
 # reduce-scatter/all-gather traffic, attributed separately from violations —
 # so the 1/dp opt-state drop AND the traffic that buys it are both visible
 # round-over-round.
-BENCH_SCHEMA_VERSION = 6
+# v7 = autotuner replay (tune/; docs/tuning.md): BENCH_FROM_TUNE=<report.json>
+# maps the tune winner's candidate onto this script's env levers (explicit env
+# wins) and stamps detail.from_tune with the report path + winner, so a
+# replayed row is distinguishable from a hand-swept one.
+BENCH_SCHEMA_VERSION = 7
 
 
 class BenchAuditFailure(RuntimeError):
@@ -104,7 +108,74 @@ def resolve_backend() -> str:
     return backend
 
 
+def apply_tune_winner(report_path: str):
+    """BENCH_FROM_TUNE=<tune_report.json>: replay the autotuner's winner by
+    mapping its candidate onto this script's env levers (docs/tuning.md).
+    Explicitly-set env vars win — the replay fills gaps, it never overrides an
+    operator's own sweep knobs. Returns the winner dict for the JSON line."""
+    from accelerate_tpu.tune.report import load_winner
+
+    winner = load_winner(report_path)
+    # Every lever the winner defines maps to an env knob — including the
+    # DISABLED/default settings: BENCH_ZERO=0 and BENCH_PREFETCH=0 are
+    # expressible, so a winner that measured them off really replays them off.
+    # Engaging BENCH_WINDOW even at window 1 keeps every replayed row on the
+    # fixed 8+64 discipline, comparable regardless of the window.
+    mapping = {
+        "BENCH_WINDOW": str(int(winner.get("train_window", 1))),
+        "BENCH_PREFETCH": str(int(winner.get("prefetch", 0))),
+        "BENCH_ZERO": "1" if winner.get("zero_sharding") else "0",
+    }
+    if winner.get("remat_policy"):
+        mapping["BENCH_REMAT_POLICY"] = str(winner["remat_policy"])
+    if int(winner.get("vocab_chunk", 0)) > 0:
+        mapping["BENCH_VOCAB_CHUNK"] = str(int(winner["vocab_chunk"]))
+    preset = str(winner.get("xla_preset", "") or "")
+    if preset and preset != "off":
+        # PartialState installs it into LIBTPU_INIT_ARGS before backend init.
+        mapping["ACCELERATE_XLA_PRESET"] = preset
+    # Levers the winner leaves at the MODEL/library default have no value to
+    # export — but an inherited env var would silently contradict the winner,
+    # so name the conflict instead of letting the row claim a clean replay.
+    winner_defaults = []
+    if not winner.get("remat_policy"):
+        winner_defaults.append("BENCH_REMAT_POLICY")
+    if int(winner.get("vocab_chunk", 0)) <= 0:
+        winner_defaults.append("BENCH_VOCAB_CHUNK")
+    if not preset or preset == "off":
+        winner_defaults.append("ACCELERATE_XLA_PRESET")
+    applied = {}
+    for key, value in mapping.items():
+        if key in os.environ and os.environ[key] != value:
+            print(
+                f"# BENCH_FROM_TUNE: {key} already set "
+                f"({os.environ[key]!r}); keeping it over the winner's "
+                f"{value!r} — this row does NOT replay the winner exactly.",
+                file=sys.stderr,
+            )
+        elif key not in os.environ:
+            os.environ[key] = value
+            applied[key] = value
+    for key in winner_defaults:
+        if key in os.environ:
+            print(
+                f"# BENCH_FROM_TUNE: {key} inherited as "
+                f"({os.environ[key]!r}) but the winner measured the default; "
+                "keeping the env — this row does NOT replay the winner "
+                "exactly.",
+                file=sys.stderr,
+            )
+    print(
+        f"# BENCH_FROM_TUNE: replaying {report_path} winner "
+        f"{winner} -> {applied}",
+        file=sys.stderr,
+    )
+    return winner
+
+
 def main():
+    if os.environ.get("BENCH_FROM_TUNE"):
+        apply_tune_winner(os.environ["BENCH_FROM_TUNE"])
     on_tpu = resolve_backend() == "tpu"
     modes = [
         m.strip()
@@ -553,6 +624,11 @@ def run_one(mode: str):
                     **(
                         {"compile_cache": os.environ["ACCELERATE_COMPILE_CACHE_DIR"]}
                         if os.environ.get("ACCELERATE_COMPILE_CACHE_DIR")
+                        else {}
+                    ),
+                    **(
+                        {"from_tune": os.environ["BENCH_FROM_TUNE"]}
+                        if os.environ.get("BENCH_FROM_TUNE")
                         else {}
                     ),
                     **(

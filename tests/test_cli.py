@@ -84,6 +84,26 @@ def test_prepare_launch_env_contract():
     assert any("accelerate_tpu" in os.listdir(p) for p in env["PYTHONPATH"].split(os.pathsep) if os.path.isdir(p))
 
 
+def test_tune_budget_tristate_launch_contract(monkeypatch):
+    """ACCELERATE_TUNE_BUDGET rides the launcher tri-state contract: None =
+    unspecified (an inherited env flows through), > 0 exported, an explicit 0
+    scrubs a stale inherited value."""
+    monkeypatch.setenv("ACCELERATE_TUNE_BUDGET", "99")
+    env = prepare_launch_env(ClusterConfig())  # unspecified → inherited flows
+    assert env["ACCELERATE_TUNE_BUDGET"] == "99"
+    env = prepare_launch_env(ClusterConfig(tune_budget=7))
+    assert env["ACCELERATE_TUNE_BUDGET"] == "7"
+    env = prepare_launch_env(ClusterConfig(tune_budget=0))  # explicit default
+    assert "ACCELERATE_TUNE_BUDGET" not in env
+    # The flag reaches the merge like every other launcher knob.
+    from accelerate_tpu.commands.launch import _merge_config, launch_command_parser
+
+    args = launch_command_parser().parse_args(
+        ["--cpu", "--tune_budget", "5", "script.py"]
+    )
+    assert _merge_config(args).tune_budget == 5
+
+
 def test_ep_size_flag_reaches_mesh_env():
     """--ep_size must survive the flag→ClusterConfig merge and land in the
     serialized mesh (regression: the merge list once dropped it silently)."""
@@ -273,6 +293,7 @@ def test_config_wizard_roundtrips_through_launch(tmp_path):
         "4",                 # train window K
         "latency",           # xla latency-hiding preset
         "yes",               # ZeRO cross-replica sharding
+        "6",                 # autotuner trial budget (accelerate-tpu tune)
         "yes",               # configure tracking?
         "json",              # trackers
         "yes",               # persistent compilation cache?
@@ -292,6 +313,7 @@ def test_config_wizard_roundtrips_through_launch(tmp_path):
     assert cfg.profile_steps == "10-12" and cfg.profile_slow_zscore == 5.5
     assert cfg.train_window == 4 and cfg.xla_preset == "latency"
     assert cfg.zero_sharding is True
+    assert cfg.tune_budget == 6
     assert cfg.compile_cache_dir == str(tmp_path / "xla_cache")
     config_path = tmp_path / "cfg.yaml"
     cfg.to_yaml_file(str(config_path))
@@ -335,6 +357,7 @@ def test_config_wizard_roundtrips_through_launch(tmp_path):
         "assert get_default_watchdog().timeout_s == 240.0\n"
         "assert os.environ.get('ACCELERATE_ZERO_SHARDING') == '1'\n"
         "assert acc.zero_sharding is True\n"
+        "assert os.environ.get('ACCELERATE_TUNE_BUDGET') == '6'\n"
         "import jax\n"
         "assert jax.config.jax_compilation_cache_dir.endswith('xla_cache')\n"
         "print('ROUNDTRIP_OK')\n"

@@ -1,0 +1,465 @@
+"""Profile-guided autotuner (accelerate_tpu/tune/; marker `tune`).
+
+Three layers, matching the subsystem's own decomposition:
+
+- **policy** — classify/propose/run_search on DETERMINISTIC synthetic
+  attribution fixtures: idle-dominated evidence must raise the window (and
+  reach for the latency preset), collective-bound must reach for
+  collective_matmul/ZeRO, memory-bound (predicted peak near budget) must
+  reach for remat/vocab-chunk; the successive-halving loop must respect the
+  trial budget and rank best-first;
+- **prune** — static_prune must drop a predicted-OOM candidate with a booked
+  ``predicted_oom`` reason (and an audit violation with ``audit_violation``)
+  without ever calling the trial path;
+- **end-to-end** — one real `accelerate-tpu tune` run on the 8-virtual-device
+  CPU rig (subprocess, tiny fixture): the ranked report must carry the
+  documented schema, the winner ClusterConfig yaml must round-trip through
+  config_args, and a budget chosen between two candidates' predicted peaks
+  must statically prune the bigger one via the memcheck verdict.
+
+Satellites ride along: the goodput ledger's ``tune`` badput class, the
+audit/memcheck ``--json`` verdict documents, and the xla_flags resolved-flag
+surface.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from accelerate_tpu.tune import (
+    Candidate,
+    CandidateSpace,
+    REASON_AUDIT_VIOLATION,
+    REASON_PREDICTED_OOM,
+    classify_bottleneck,
+    propose_moves,
+    run_search,
+    static_prune,
+)
+
+pytestmark = pytest.mark.tune
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Deterministic synthetic attribution fixtures (traceview `fractions` shape:
+# disjoint, sums to 1).
+IDLE_DOMINATED = {"compute": 0.20, "collective": 0.05, "host": 0.10, "idle": 0.65}
+COLLECTIVE_BOUND = {"compute": 0.45, "collective": 0.40, "host": 0.0, "idle": 0.15}
+COMPUTE_BOUND = {"compute": 0.90, "collective": 0.04, "host": 0.01, "idle": 0.05}
+
+
+def _space(**kw):
+    defaults = dict(
+        windows=(1, 2, 4, 8),
+        presets=("off", "latency", "collective_matmul"),
+        vocab_chunks=(0, 64),
+        remat_policies=("", "nothing_saveable"),
+        zero_sharding=(False, True),
+        prefetches=(0, 2),
+    )
+    defaults.update(kw)
+    return CandidateSpace(**defaults)
+
+
+# ==================================================================== policy
+def test_idle_dominated_raises_window_and_latency_preset():
+    space = _space()
+    assert classify_bottleneck(IDLE_DOMINATED) == "idle"
+    moves = propose_moves(Candidate(), "idle", space)
+    assert any(m.train_window == 2 for m in moves), moves
+    assert any(m.xla_preset == "latency" for m in moves), moves
+    assert any(m.prefetch == 2 for m in moves), moves
+
+
+def test_collective_bound_chooses_collective_matmul_and_zero():
+    space = _space()
+    assert classify_bottleneck(COLLECTIVE_BOUND) == "collective"
+    moves = propose_moves(Candidate(), "collective", space)
+    assert any(m.xla_preset == "collective_matmul" for m in moves), moves
+    assert any(m.zero_sharding for m in moves), moves
+    # Already-zero candidates don't re-propose zero.
+    again = propose_moves(Candidate(zero_sharding=True), "collective", space)
+    assert all(
+        m.zero_sharding for m in again
+    ) or any(m.xla_preset == "collective_matmul" for m in again)
+
+
+def test_memory_bound_chooses_remat_and_chunk():
+    space = _space()
+    # Predicted peak at 90% of the budget = memory-bound, regardless of a
+    # compute-looking trace.
+    assert classify_bottleneck(COMPUTE_BOUND, 900, 1000) == "memory"
+    moves = propose_moves(Candidate(), "memory", space)
+    assert any(m.remat_policy == "nothing_saveable" for m in moves), moves
+    assert any(m.vocab_chunk == 64 for m in moves), moves
+
+
+def test_compute_bound_proposes_nothing():
+    space = _space()
+    assert classify_bottleneck(COMPUTE_BOUND) == "compute"
+    assert propose_moves(Candidate(), "compute", space) == []
+    # No capture parsed and no memory pressure → unknown → nothing to steer.
+    assert classify_bottleneck(None) == "unknown"
+    assert propose_moves(Candidate(), "unknown", space) == []
+
+
+def test_search_steers_by_attribution_and_respects_budget():
+    """Idle-dominated best → round 1 trials the raised-window proposal; the
+    trial budget is a hard cap; ranking is best-first by step time."""
+    space = _space(prefetches=(0,), presets=("off",))  # keep moves = window only
+    step_times = {
+        "w1.xoff.c0.rdefault.z0.p0": 10.0,
+        "w2.xoff.c0.rdefault.z0.p0": 5.0,
+        "w4.xoff.c0.rdefault.z0.p0": 3.0,
+    }
+    trialed = []
+
+    def prune_fn(cands):
+        return [(c, {"audit": None, "memory": None}) for c in cands], []
+
+    def trial_fn(cand, _evidence, steps):
+        trialed.append((cand.key(), steps))
+        return {
+            "step_time_s": step_times.get(cand.key(), 20.0),
+            "fractions": IDLE_DOMINATED,
+            "predicted_peak_bytes": 0,
+            "budget_bytes": 0,
+        }
+
+    seeds = [Candidate(), Candidate(train_window=2)]
+    ranked, dropped, trail = run_search(
+        space, prune_fn=prune_fn, trial_fn=trial_fn, trial_budget=4,
+        seeds=seeds, base_steps=4, max_rounds=4,
+    )
+    assert len(trialed) <= 4  # budget is a hard cap
+    # Round 0's best (w2) is idle-dominated → w4 proposed and trialed.
+    assert any(key.startswith("w4.") for key, _ in trialed), trialed
+    assert trail[0]["bottleneck"] == "idle"
+    assert any("w4." in p for p in trail[0]["proposed"]), trail[0]
+    # Best-first ranking by measured step time.
+    keys = [c.key() for c, _ in ranked]
+    assert keys[0].startswith("w4."), keys
+    times = [r["step_time_s"] for _, r in ranked]
+    assert times == sorted(times)
+    assert dropped == []
+
+
+def test_search_halving_doubles_steps_for_keepers():
+    """Compute-bound (no proposals) → later rounds re-measure the rung's top
+    half at doubled steps — the successive-halving refinement."""
+    space = _space(presets=("off",), prefetches=(0,))
+    calls = []
+
+    def prune_fn(cands):
+        return [(c, {}) for c in cands], []
+
+    def trial_fn(cand, _evidence, steps):
+        calls.append((cand.key(), steps))
+        base = {"w1.xoff.c0.rdefault.z0.p0": 2.0}.get(cand.key(), 4.0)
+        return {"step_time_s": base, "fractions": COMPUTE_BOUND}
+
+    seeds = [Candidate(), Candidate(train_window=2), Candidate(train_window=4)]
+    ranked, _dropped, trail = run_search(
+        space, prune_fn=prune_fn, trial_fn=trial_fn, trial_budget=10,
+        seeds=seeds, base_steps=4, max_rounds=3,
+    )
+    # Rung 0: all three at 4 steps; rung 1: top 2 re-measured at 8 steps.
+    assert (("w1.xoff.c0.rdefault.z0.p0", 4) in calls
+            and ("w1.xoff.c0.rdefault.z0.p0", 8) in calls), calls
+    assert not any(steps == 8 and key.startswith("w4.") for key, steps in calls)
+    assert [c.key() for c, _ in ranked][0].startswith("w1.")
+
+
+def test_space_absorbs_base_instead_of_snapping_it():
+    """Axis overrides must not move the base candidate off the user's actual
+    current config — the axes absorb the base value, so the report's
+    "winner vs current config" baseline is the config the user really runs."""
+    space = CandidateSpace(windows=(4, 8), presets=("collective_matmul",))
+    assert space.base.train_window == 1 and space.base.xla_preset == "off"
+    assert space.windows == (1, 4, 8)
+    assert space.presets == ("off", "collective_matmul")  # canonical order kept
+    assert space.seeds()[0] == space.base
+
+
+def test_search_never_retrials_a_failed_candidate():
+    """A deterministically-failing candidate must not re-spend budget every
+    round the same bottleneck re-proposes it."""
+    space = _space(presets=("off",), prefetches=(0,))
+    calls = []
+
+    def prune_fn(cands):
+        return [(c, {}) for c in cands], []
+
+    def trial_fn(cand, _evidence, steps):
+        calls.append((cand.key(), steps))
+        if cand.train_window == 4:
+            return None  # w4's trial always fails
+        return {"step_time_s": 2.0, "fractions": IDLE_DOMINATED}
+
+    # Rung 0: w2 ok (idle) -> proposes w4; rung 1: w4 fails; later rounds
+    # re-propose from w2 but w4 is in the failed set — never re-trialed.
+    _ranked, _dropped, _trail = run_search(
+        space, prune_fn=prune_fn, trial_fn=trial_fn, trial_budget=12,
+        seeds=[Candidate(train_window=2)], base_steps=4, max_rounds=4,
+    )
+    w4_trials = [key for key, _ in calls if key.startswith("w4.")]
+    assert len(w4_trials) == 1, calls  # failed once, never re-proposed
+
+
+def test_search_never_rebooks_a_pruned_proposal():
+    """A statically-pruned proposal re-proposed by a later round must not
+    append duplicate entries to the report's dropped list."""
+    space = _space(presets=("off",), prefetches=(0, 2))
+
+    def prune_fn(cands):
+        kept, dropped = [], []
+        for c in cands:
+            if c.prefetch > 0:  # every prefetch proposal prunes
+                dropped.append({"candidate": c.to_dict(), "key": c.key(),
+                                "reason": REASON_PREDICTED_OOM,
+                                "failures": [], "evidence": None})
+            else:
+                kept.append((c, {}))
+        return kept, dropped
+
+    def trial_fn(cand, _evidence, steps):
+        return {"step_time_s": 2.0, "fractions": IDLE_DOMINATED}
+
+    # Every round's best is idle-dominated and re-proposes its prefetch
+    # neighbor; the pruned key must be booked exactly once.
+    _ranked, dropped, _trail = run_search(
+        space, prune_fn=prune_fn, trial_fn=trial_fn, trial_budget=10,
+        seeds=[Candidate()], base_steps=4, max_rounds=4,
+    )
+    pruned_keys = [d["key"] for d in dropped]
+    assert len(pruned_keys) == len(set(pruned_keys)), pruned_keys
+
+
+def test_search_books_all_failed_round_in_trail():
+    space = _space(presets=("off",), prefetches=(0,))
+
+    def prune_fn(cands):
+        return [(c, {}) for c in cands], []
+
+    ranked, _dropped, trail = run_search(
+        space, prune_fn=prune_fn, trial_fn=lambda *_a: None, trial_budget=5,
+        seeds=[Candidate(), Candidate(train_window=2)], base_steps=4,
+    )
+    assert ranked == []
+    # The spent budget stays visible: the failed round is booked.
+    assert len(trail) == 1 and len(trail[0]["failed"]) == 2
+    assert trail[0]["best"] is None and trail[0]["bottleneck"] is None
+
+
+# ===================================================================== prune
+def test_prune_drops_predicted_oom_candidate():
+    space = _space()
+    big = Candidate(train_window=8)
+
+    def audit_fn(candidate):
+        peak = 2_000_000 if candidate.train_window > 1 else 1_000_000
+        memory = {"predicted_peak_bytes": peak, "budget_bytes": 1_500_000}
+        audit = {"clean": True, "dp_allgathers": 0,
+                 "host_callbacks": 0, "donation_misses": 0}
+        from accelerate_tpu.tune import audit_failures
+
+        failures = audit_failures(audit, memory)
+        return {"audit": audit, "memory": memory}, failures
+
+    kept, dropped = static_prune([space.base, big], audit_fn)
+    assert [c.key() for c, _ in kept] == [space.base.key()]
+    assert len(dropped) == 1
+    assert dropped[0]["reason"] == REASON_PREDICTED_OOM
+    assert dropped[0]["key"] == big.key()
+    assert "predicted OOM" in dropped[0]["failures"][0]["detail"]
+
+
+def test_prune_drops_audit_violation_and_books_build_failure():
+    def audit_fn(candidate):
+        if candidate.zero_sharding:
+            raise RuntimeError("boom")
+        audit = {"clean": False, "dp_allgathers": 2,
+                 "host_callbacks": 0, "donation_misses": 0}
+        from accelerate_tpu.tune import audit_failures
+
+        return {"audit": audit, "memory": None}, audit_failures(audit, None)
+
+    kept, dropped = static_prune(
+        [Candidate(), Candidate(zero_sharding=True)], audit_fn
+    )
+    assert kept == []
+    reasons = {d["key"]: d["reason"] for d in dropped}
+    assert reasons[Candidate().key()] == REASON_AUDIT_VIOLATION
+    assert reasons[Candidate(zero_sharding=True).key()] == "build_failed"
+
+
+# ================================================================ satellites
+def test_tune_badput_class_in_ledger_and_prometheus():
+    from accelerate_tpu.resilience.goodput import (
+        BADPUT_CATEGORIES, GoodputLedger, get_ledger,
+    )
+    from accelerate_tpu.telemetry import install_default_collectors
+    from accelerate_tpu.telemetry.metrics import get_registry
+
+    assert "tune" in BADPUT_CATEGORIES
+    ledger = GoodputLedger()
+    ledger.record_step(2.0, steps=2)
+    ledger.add("tune", 1.5)
+    s = ledger.summary()
+    assert s["tune_s"] == 1.5
+    assert s["badput_s"] == 1.5  # trial time is badput, not productive steps
+    # The scrape-time collector exports the class with zero per-step cost.
+    try:
+        get_ledger().reset()
+        get_ledger().add("tune", 0.7)
+        install_default_collectors()
+        snapshot = get_registry().snapshot()
+        assert snapshot['accelerate_badput_seconds{category="tune"}'] >= 0.7
+    finally:
+        get_ledger().reset()
+
+
+def test_xla_preset_resolved_flags_and_enumerating_error(monkeypatch):
+    from accelerate_tpu.utils import xla_flags
+
+    # preset_flags: validated canonical token list.
+    assert xla_flags.preset_flags("latency") == xla_flags.XLA_PRESETS["latency"]
+    assert xla_flags.preset_flags("off") == ()
+    with pytest.raises(ValueError) as err:
+        xla_flags.preset_flags("warp_speed")
+    # The error names every valid preset (the launch-time surface reuses it).
+    for name in xla_flags.XLA_PRESETS:
+        assert name in str(err.value)
+    # install exposes the AS-RESOLVED list: an operator override wins.
+    monkeypatch.setenv(
+        "LIBTPU_INIT_ARGS", "--xla_tpu_enable_latency_hiding_scheduler=false"
+    )
+    xla_flags._reset_active_preset()
+    xla_flags.install_xla_preset("latency")
+    flags = xla_flags.active_preset_flags()
+    assert "--xla_tpu_enable_latency_hiding_scheduler=false" in flags
+    assert "--xla_enable_async_all_gather=true" in flags
+    assert len(flags) == len(xla_flags.XLA_PRESETS["latency"])
+    xla_flags._reset_active_preset()
+    assert xla_flags.active_preset_flags() == ()
+
+
+def test_launch_rejects_unknown_preset_with_name_list(tmp_path):
+    from accelerate_tpu.commands.launch import launch_command, launch_command_parser
+
+    script = tmp_path / "noop.py"
+    script.write_text("print('nope')\n")
+    args = launch_command_parser().parse_args(
+        ["--cpu", "--xla_preset", "warp_speed", str(script)]
+    )
+    with pytest.raises(ValueError) as err:
+        launch_command(args)
+    assert "latency" in str(err.value) and "collective_matmul" in str(err.value)
+
+
+def test_memcheck_and_audit_json_verdict_documents():
+    """--json wraps the report in a schema'd verdict doc; exit codes and the
+    non-json stdout/stderr contract are unchanged."""
+    env = {**os.environ, "PYTHONPATH": REPO}
+    base = [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli"]
+    ok = subprocess.run(
+        base + ["memcheck", "--summary", "--json", "--batch", "4", "--seq", "8"],
+        capture_output=True, text=True, env=env,
+    )
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    doc = json.loads(ok.stdout)
+    assert doc["schema_version"] == 1 and doc["command"] == "memcheck"
+    assert doc["verdict"] == "pass" and doc["failures"] == []
+    assert doc["report"]["fits"] is True
+    starved = subprocess.run(
+        base + ["memcheck", "--summary", "--json", "--batch", "4", "--seq", "8",
+                "--budget-gib", "0.0000001"],
+        capture_output=True, text=True, env=env,
+    )
+    assert starved.returncode == 1, starved.stdout + starved.stderr
+    doc = json.loads(starved.stdout)  # failures ride the doc, not stderr
+    assert doc["verdict"] == "fail"
+    assert any("predicted OOM" in f for f in doc["failures"])
+    audited = subprocess.run(
+        base + ["audit", "--summary", "--json", "--batch", "4", "--seq", "8"],
+        capture_output=True, text=True, env=env,
+    )
+    assert audited.returncode == 0, audited.stdout + audited.stderr
+    doc = json.loads(audited.stdout)
+    assert doc["command"] == "audit" and doc["verdict"] == "pass"
+    assert doc["report"]["clean"] is True
+
+
+# ================================================================ end-to-end
+def test_tune_end_to_end_on_cpu_rig(tmp_path):
+    """One real tune run through the CLI on the 8-virtual-device CPU mesh:
+    a budget chosen between the window-1 and window-8 predicted peaks must
+    statically prune the window-8 candidate via the memcheck verdict (never
+    launching it), the survivors are short-benched, and the ranked report +
+    winner ClusterConfig carry the documented schema."""
+    from accelerate_tpu.tune import TrialRig
+
+    # Derive the split budget from the SAME auditor the prune uses, so the
+    # test is robust to XLA memory-analysis drift across versions.
+    rig = TrialRig(batch_rows=8, seq=16)
+    peak_w1 = rig.audit_candidate(Candidate())[0]["memory"]["predicted_peak_bytes"]
+    peak_w8 = rig.audit_candidate(Candidate(train_window=8))[0]["memory"][
+        "predicted_peak_bytes"
+    ]
+    assert peak_w8 > peak_w1, (peak_w1, peak_w8)
+    budget_gib = ((peak_w1 + peak_w8) / 2) / (1 << 30)
+
+    report_path = tmp_path / "report.json"
+    winner_path = tmp_path / "winner.yaml"
+    result = subprocess.run(
+        [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli", "tune",
+         "--cpu_virtual_devices", "8", "--budget", "3", "--trial_steps", "2",
+         "--warmup", "1", "--rounds", "1", "--no-capture",
+         "--windows", "1,8", "--presets", "off", "--prefetches", "0",
+         "--no-zero", "--budget-gib", f"{budget_gib:.9f}",
+         "--output", str(report_path), "--winner-config", str(winner_path)],
+        capture_output=True, text=True, env={**os.environ, "PYTHONPATH": REPO},
+        cwd=REPO, timeout=480,
+    )
+    assert result.returncode == 0, result.stdout[-2000:] + result.stderr[-2000:]
+
+    report = json.loads(report_path.read_text())
+    # Schema: the documented top-level contract (docs/tuning.md).
+    assert report["schema_version"] == 1
+    for key in ("space", "base", "ranked", "dropped", "search_trail",
+                "winner", "baseline", "goodput", "trial_budget", "trials_run"):
+        assert key in report, key
+    # The window-8 candidate was pruned by the memcheck verdict, unlaunched.
+    dropped = {d["key"]: d for d in report["dropped"]}
+    w8_key = Candidate(train_window=8).key()
+    assert w8_key in dropped, report["dropped"]
+    assert dropped[w8_key]["reason"] == REASON_PREDICTED_OOM
+    assert not any(e["key"] == w8_key for e in report["ranked"])
+    # Survivors were short-benched with full evidence attached.
+    assert report["ranked"], report
+    for entry in report["ranked"]:
+        assert entry["step_time_s"] > 0
+        assert entry["predicted_peak_bytes"] > 0
+        assert entry["audit"] is not None and entry["audit"]["clean"] is True
+        assert entry["memory"] is not None
+        assert "mfu_est" in entry and "fractions" in entry
+    times = [e["step_time_s"] for e in report["ranked"]]
+    assert times == sorted(times)
+    # Winner = rank 1; the baseline (base candidate) was trialed, so the
+    # winner's short-bench step time is <= the default config's.
+    assert report["winner"]["rank"] == 1
+    assert report["baseline"] is not None
+    assert report["winner"]["step_time_s"] <= report["baseline"]["step_time_s"]
+    # Trial wall-clock booked as `tune` badput in the run's ledger summary.
+    assert report["goodput"]["tune_s"] > 0
+    assert report["goodput"]["steps"] == 0  # trials never book productive steps
+    # The winner ClusterConfig round-trips through config_args.
+    from accelerate_tpu.commands.config_args import load_config_from_file
+
+    cfg = load_config_from_file(str(winner_path))
+    assert cfg.train_window == report["winner"]["candidate"]["train_window"]
+    assert cfg.xla_preset == report["winner"]["candidate"]["xla_preset"]
+    assert cfg.extra.get("tuned_by") == "accelerate-tpu tune"
