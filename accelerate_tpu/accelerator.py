@@ -1592,6 +1592,21 @@ class Accelerator:
             )
         return report
 
+    def fingerprint(self, built, batch, clip_norm: float = 0.0,
+                    config: str = "unknown", report=None):
+        """Canonical :class:`~.analysis.fingerprint.ProgramFingerprint` of a
+        built artifact — the drift-gate identity (per-axis collective
+        inventory with ZeRO attribution, donation contract + misses,
+        per-class replication split, dtype-flow census/flags). ``report``
+        reuses an :meth:`audit` already run on the SAME program so only a
+        fresh lowering is paid; without it the program is lowered, compiled,
+        and audited here. Never executes a step."""
+        from .analysis.fingerprint import fingerprint_built
+
+        return fingerprint_built(
+            built, batch, clip_norm, config=config, mesh=self.mesh, report=report,
+        )
+
     def memory_report(self, built, batch, clip_norm: float = 0.0,
                       budget_bytes: int | None = None):
         """Static HBM audit of a built artifact without the full program

@@ -35,6 +35,19 @@ subsystem makes both statically checkable:
 """
 
 from .audit import AuditReport, audit_built, audit_lowered
+from .fingerprint import (
+    DriftEntry,
+    ProgramFingerprint,
+    canonical_json,
+    classify_drift,
+    drift_verdict,
+    dtype_flow,
+    fingerprint_built,
+    fingerprint_from_audit,
+    fingerprint_hash,
+    load_golden,
+    write_golden,
+)
 from .layout import ReshardSite, find_implicit_reshards
 from .lint import (
     DEFAULT_BASELINE_NAME,
@@ -57,6 +70,17 @@ __all__ = [
     "AuditReport",
     "audit_built",
     "audit_lowered",
+    "DriftEntry",
+    "ProgramFingerprint",
+    "canonical_json",
+    "classify_drift",
+    "drift_verdict",
+    "dtype_flow",
+    "fingerprint_built",
+    "fingerprint_from_audit",
+    "fingerprint_hash",
+    "load_golden",
+    "write_golden",
     "ClassMemory",
     "MemoryReport",
     "ReplicationFinding",

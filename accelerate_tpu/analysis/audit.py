@@ -655,6 +655,10 @@ def audit_lowered(
     # instead of paying a second XLA compile; audit_built pops it so the
     # report does not pin the executable alive for its own lifetime.
     report._compiled = compiled
+    # Also stashed (non-field, plain string): the lowered StableHLO, so a
+    # fingerprint extraction handed this report (bench, the tune rig) runs
+    # its dtype-flow pass without re-tracing and re-lowering the program.
+    report._stablehlo_text = stablehlo_text
     return report
 
 

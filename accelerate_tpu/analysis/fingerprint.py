@@ -1,0 +1,694 @@
+"""Program-contract fingerprints — the compiled program as a committed golden.
+
+The paper's thesis makes XLA/GSPMD itself the native layer (PAPERS.md
+2105.04663): the artifact whose properties we ship is the *compiled program*,
+not the Python that lowers it. The auditors (analysis/audit.py, memory.py)
+inspect those properties, but only when a live build invokes them — a PR that
+silently adds a dp all-gather, drops a donor mark, regrows dp-replicated
+opt-state (undoing the 2004.13336 ZeRO win), or downgrades a loss
+accumulation to bf16 changes no Python test and sails through tier-1. This
+module pins the contract as data:
+
+- :func:`fingerprint_from_audit` distills an :class:`~.audit.AuditReport`
+  plus the lowered StableHLO into a canonical, deterministic
+  :class:`ProgramFingerprint`: the per-named-axis collective inventory
+  (ZeRO-claimed sites attributed separately), the donation contract with
+  per-reason miss counts, the per-class sharded-vs-replicated byte
+  attribution, and a NEW **dtype-flow** pass recording the accumulation
+  precision of every ``dot_general`` / ``reduce`` — low-precision
+  loss/grad-norm-style accumulations under a higher-precision compute dtype
+  are first-class flags.
+- :func:`canonical_json` serializes a fingerprint to byte-stable JSON
+  (sorted keys, sorted inventories, no floats, trailing newline) so goldens
+  under ``tests/goldens/`` are diffable and byte-identical across processes;
+  :func:`fingerprint_hash` is the short content hash bench/tune lines carry.
+- :func:`classify_drift` diffs a current fingerprint against its golden and
+  classifies every divergence as **violation** (a gated regression: new
+  dp all-gather or host callback, donation contract narrowed or missed,
+  replicated bytes grown, a new low-precision accumulation, declared ZeRO
+  traffic vanished), **improvement** (the same fields moving the other way),
+  or **benign-shape** (census/byte changes with no invariant direction).
+
+Policy independence: the donation section records the *contract*
+(expected argnums, expected flat-leaf count) and the audit's per-reason miss
+counts — never the raw donor-mark totals, which differ between rigs where
+``safe_donate_argnums`` platform-gates donation (CPU + persistent compile
+cache) and rigs where donation is live. A healthy program fingerprints
+byte-identically on both; a genuinely dropped donor mark books misses on any
+rig where donation engages (the ``accelerate-tpu fingerprint`` CLI scrubs
+the compile cache by default precisely to keep that detector armed).
+
+Surfaced as ``accelerate-tpu fingerprint [--check|--update|--json]``
+(commands/fingerprint.py), ``Accelerator.fingerprint``,
+``ContinuousBatcher.fingerprint_decode``, ``detail.fingerprint`` on every
+bench.py JSON line (schema v8), and the tune evidence report.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+from dataclasses import dataclass, field
+
+FINGERPRINT_SCHEMA_VERSION = 1
+
+# Default goldens home, relative to the repo root (the directory holding the
+# accelerate_tpu package).
+GOLDENS_DIRNAME = os.path.join("tests", "goldens")
+
+# Drift kinds (DriftEntry.kind / the report's classification vocabulary).
+VIOLATION = "violation"
+IMPROVEMENT = "improvement"
+BENIGN = "benign-shape"
+
+# HLO element types considered low-precision accumulators.
+_LOW_PRECISION = ("bf16", "f16", "f8e4m3fn", "f8e5m2")
+
+# Rank ordering for "higher-precision compute dtype" comparisons.
+_PRECISION_RANK = {
+    "f8e4m3fn": 0, "f8e5m2": 0, "f16": 1, "bf16": 1, "f32": 2, "f64": 3,
+}
+
+# numpy dtype name -> HLO element type (the compute_dtype meta arrives as a
+# numpy name; dtype-flow compares in HLO vocabulary).
+_NP_TO_HLO = {
+    "float64": "f64", "float32": "f32", "bfloat16": "bf16", "float16": "f16",
+}
+
+
+# ------------------------------------------------------------------ dtype flow
+_DOT_RE = re.compile(
+    r"stablehlo\.dot_general[^\n]*?:\s*"
+    r"\(tensor<([^>]*)>,\s*tensor<([^>]*)>\)\s*->\s*tensor<([^>]*)>"
+)
+
+# Compact reduce form: `stablehlo.reduce(%x init: %c) applies stablehlo.add
+# across dimensions = [0, 1] : (tensor<8x4xf32>, tensor<f32>) -> tensor<f32>`
+_REDUCE_RE = re.compile(
+    r"stablehlo\.reduce\([^)]*\)\s+applies\s+stablehlo\.(\w+)\s+"
+    r"across dimensions[^:]*:\s*\(([^)]*)\)\s*->\s*(.+)"
+)
+
+# Region form: `"stablehlo.reduce"(...) ({ ... }) ... : (...) -> tensor<...>`
+_REDUCE_REGION_RE = re.compile(
+    r'"stablehlo\.reduce"\(.*->\s*(tensor<[^>]*>)'
+)
+
+# Scalar upcast: `stablehlo.convert %x : (tensor<bf16>) -> tensor<f32>` — a
+# rank-0 value that EXISTED in low precision being widened. jax's AD rewrites
+# generic `lax.reduce` accumulations into slice-add trees (no reduce op
+# survives to the lowering), and `jnp.sum` upcasts f16/bf16 inputs before
+# reducing — in both cases the one stable signature of a loss/grad-norm
+# accumulated in low precision is the scalar low->high convert at its end.
+# Rank-0 only: dims start with a digit, element types with a letter, so
+# `[a-z][a-z0-9]*` matches `tensor<bf16>` but never `tensor<8x4xbf16>`.
+_SCALAR_CONVERT_RE = re.compile(
+    r"stablehlo\.convert\s+%\S+\s*:\s*\(tensor<([a-z][a-z0-9]*)>\)\s*->\s*"
+    r"tensor<([a-z][a-z0-9]*)>"
+)
+
+
+def _elem(tensor_text: str) -> str:
+    """Element type of a `tensor<8x4xf32>` / `8x4xf32` / `f32` spelling."""
+    t = tensor_text.strip().rstrip(",")
+    m = re.search(r"tensor<([^>]*)>", t)
+    if m:
+        t = m.group(1)
+    return t.split("x")[-1]
+
+def _rank(tensor_text: str) -> int:
+    t = tensor_text.strip().rstrip(",")
+    m = re.search(r"tensor<([^>]*)>", t)
+    if m:
+        t = m.group(1)
+    return sum(1 for p in t.split("x")[:-1] if p and p[0].isdigit())
+
+
+def dtype_flow(stablehlo_text: str, compute_dtype: str | None = None) -> dict:
+    """The dtype-flow pass: accumulation-precision census + flags.
+
+    Walks the lowered StableHLO text recording every ``dot_general``
+    (operand × operand → accumulation dtype) and every ``reduce`` (reduction
+    op, operand dtype → accumulation dtype, result rank). A ``reduce``-add
+    accumulating in a low-precision type is **flagged** when either
+
+    - the result is a SCALAR (the loss / grad-norm / moment-total shape —
+      the accumulations whose error compounds over every element), or
+    - the declared compute dtype is strictly higher precision than the
+      accumulation (a reduction downgraded below the precision the model
+      computes in).
+
+    Order statistics (max/min) are precision-safe and never flagged.
+    ``compute_dtype`` takes the numpy name from the builders' audit meta
+    (``float32`` / ``bfloat16``) or an HLO name; None disables the
+    higher-compute comparison (scalar flags still apply).
+    """
+    compute = _NP_TO_HLO.get(str(compute_dtype), str(compute_dtype) or "")
+    compute_rank = _PRECISION_RANK.get(compute)
+
+    dots: dict = {}
+    for m in _DOT_RE.finditer(stablehlo_text):
+        lhs, rhs, out = (t.split("x")[-1] for t in m.groups())
+        sig = f"{lhs}x{rhs}->{out}"
+        dots[sig] = dots.get(sig, 0) + 1
+
+    reduces: dict = {}
+    flags = set()
+    for line in stablehlo_text.splitlines():
+        m = _REDUCE_RE.search(line)
+        if m:
+            op, operands, result = m.groups()
+            in_dtype = _elem(operands.split(",")[0])
+            out_dtype = _elem(result)
+            rank = _rank(result)
+        else:
+            r = _REDUCE_REGION_RE.search(line)
+            if not r:
+                continue
+            op = "region"
+            out_dtype = _elem(r.group(1))
+            in_dtype = out_dtype
+            rank = _rank(r.group(1))
+        sig = f"{op}:{in_dtype}->{out_dtype}"
+        reduces[sig] = reduces.get(sig, 0) + 1
+        # Only definite add-reductions flag: the region form's body op is not
+        # recovered (op == "region"), and a variadic low-precision max/argmax
+        # is a precision-safe order statistic, not an accumulation.
+        if op != "add" or out_dtype not in _LOW_PRECISION:
+            continue
+        acc_rank = _PRECISION_RANK.get(out_dtype, 0)
+        if rank == 0:
+            flags.add(
+                f"low-precision accumulation: scalar reduce-{op} in "
+                f"{out_dtype} (loss/grad-norm shape)"
+            )
+        elif compute_rank is not None and compute_rank > acc_rank:
+            flags.add(
+                f"low-precision accumulation: reduce-{op} in {out_dtype} "
+                f"under {compute} compute"
+            )
+    for m in _SCALAR_CONVERT_RE.finditer(stablehlo_text):
+        src, dst = m.groups()
+        if src in _LOW_PRECISION and _PRECISION_RANK.get(dst, 0) > _PRECISION_RANK.get(src, 0):
+            flags.add(
+                f"low-precision accumulation: scalar materialized in {src} "
+                f"then upcast to {dst} (loss/grad-norm shape)"
+            )
+    return {"dots": dots, "reduces": reduces, "flags": sorted(flags)}
+
+
+# ----------------------------------------------------------------- extraction
+@dataclass
+class ProgramFingerprint:
+    """Canonical program identity — every field is derived deterministically
+    from the lowered/compiled artifact and the builder's declared contract;
+    see the module docstring for what each section pins."""
+
+    config: str = "unknown"
+    builder: str = "unknown"
+    mesh_axes: dict = field(default_factory=dict)
+    compute_dtype: str | None = None
+    collectives: list = field(default_factory=list)   # [{op,axes,shape,zero,count}]
+    zero: dict = field(default_factory=dict)          # {declared, collectives}
+    donation: dict = field(default_factory=dict)      # {expected_argnums, expected_leaves, misses}
+    host_callbacks: dict = field(default_factory=dict)  # {count, kinds}
+    dtype_flow: dict = field(default_factory=dict)    # {dots, reduces, flags}
+    memory: dict = field(default_factory=dict)        # {class: byte attribution}
+
+    def to_dict(self) -> dict:
+        return {
+            "schema_version": FINGERPRINT_SCHEMA_VERSION,
+            "config": self.config,
+            "builder": self.builder,
+            "mesh_axes": dict(self.mesh_axes),
+            "compute_dtype": self.compute_dtype,
+            "collectives": list(self.collectives),
+            "zero": dict(self.zero),
+            "donation": dict(self.donation),
+            "host_callbacks": dict(self.host_callbacks),
+            "dtype_flow": dict(self.dtype_flow),
+            "memory": dict(self.memory),
+        }
+
+
+def _aggregate_collectives(sites) -> list:
+    """CollectiveSite list → sorted [{op, axes, shape, zero, count}].
+
+    op_name source metadata is deliberately EXCLUDED: scope paths drift with
+    refactors that do not change the program contract; (op, axes, shape,
+    zero-attribution) is the stable identity of a collective."""
+    counts: dict = {}
+    for s in sites:
+        key = (s.op, tuple(s.axes), s.shape, bool(s.zero))
+        counts[key] = counts.get(key, 0) + 1
+    return [
+        {"op": op, "axes": list(axes), "shape": shape, "zero": zero,
+         "count": counts[(op, axes, shape, zero)]}
+        for (op, axes, shape, zero) in sorted(
+            counts, key=lambda k: (k[0], k[1], k[2], k[3])
+        )
+    ]
+
+
+def _memory_section(meta: dict, mesh_axes: dict) -> dict:
+    """Per-class byte attribution from the builders' donated-pytree meta —
+    classify_pytree's static math only (no executable memory_analysis, which
+    is compiler-version noise a golden must not carry)."""
+    from .memory import classify_pytree
+
+    out = {}
+    for name, (values_fn, shardings_fn) in (meta.get("memory_classes") or {}).items():
+        try:
+            values, shardings = values_fn(), shardings_fn()
+        except Exception:
+            continue
+        cls = classify_pytree(name, values, shardings, mesh_axes, donated=True)
+        out[name] = {
+            "leaves": len(cls.leaves),
+            "global_bytes": cls.global_bytes,
+            "per_device_bytes": cls.per_device_bytes,
+            "by_axis": cls.by_axis(mesh_axes),
+        }
+    return out
+
+
+def fingerprint_from_audit(report, stablehlo_text: str, meta: dict | None = None,
+                           config: str = "unknown") -> ProgramFingerprint:
+    """Distill an :class:`~.audit.AuditReport` (+ the lowered StableHLO for
+    the dtype-flow pass) into a :class:`ProgramFingerprint`. ``meta`` is the
+    builder's ``_audit_meta``; without it the donation contract and memory
+    sections are empty (foreign artifacts still fingerprint collectives,
+    callbacks, and dtype flow)."""
+    meta = meta or {}
+    misses: dict = {"never-marked": 0, "under-marked": 0, "unaliased": 0}
+    for m in report.donation_misses:
+        misses[m.reason] = misses.get(m.reason, 0) + 1
+    return ProgramFingerprint(
+        config=config,
+        builder=report.builder,
+        mesh_axes=dict(report.mesh_axes),
+        compute_dtype=meta.get("compute_dtype"),
+        collectives=_aggregate_collectives(report.collectives),
+        zero={
+            "declared": bool(report.zero_sharding),
+            "collectives": report.zero_collective_counts(),
+        },
+        donation={
+            "expected_argnums": sorted(
+                int(i) for i in (meta.get("expected_donations") or ())
+            ),
+            "expected_leaves": int(meta.get("expected_donated_leaves") or 0),
+            "misses": misses,
+        },
+        host_callbacks={
+            "count": len(report.host_callbacks),
+            "kinds": sorted(set(report.host_callbacks)),
+        },
+        dtype_flow=dtype_flow(stablehlo_text, meta.get("compute_dtype")),
+        memory=_memory_section(meta, dict(report.mesh_axes)),
+    )
+
+
+def fingerprint_built(built, *args, config: str = "unknown", mesh=None,
+                      report=None, **kwargs) -> ProgramFingerprint:
+    """Fingerprint a built artifact — anything exposing ``.lower(...)``.
+
+    ``report`` short-circuits everything the audit already did on the SAME
+    program (bench.py, the tune rig): its stashed StableHLO text feeds the
+    dtype-flow pass, so no re-trace, re-lower, or re-compile is paid at all.
+    Without it, the program is lowered, compiled, and audited here
+    (audit_lowered — the full collective/donation/callback detection; the
+    MemoryReport is skipped, fingerprints carry their own static byte
+    attribution)."""
+    from .audit import audit_lowered
+
+    lower = getattr(built, "lower", None)
+    if lower is None:
+        raise TypeError(
+            f"{built!r} has no .lower(...); pass a built train step/window, a "
+            "serving decode program, or a jitted function."
+        )
+    meta = getattr(built, "_audit_meta", None) or {}
+    # Consume (pop) the audit's stashed lowering text: once the dtype-flow
+    # pass has it, nothing else needs the multi-MB string pinned for the
+    # report's lifetime (the _compiled-pop discipline, applied to text).
+    stablehlo_text = (
+        report.__dict__.pop("_stablehlo_text", None) if report is not None else None
+    )
+    if stablehlo_text is None:
+        lowered = lower(*args, **kwargs)
+        stablehlo_text = lowered.as_text()
+    if report is None:
+        jaxpr = None
+        jaxpr_thunk = meta.get("jaxpr_thunk")
+        if jaxpr_thunk is not None:
+            try:
+                jaxpr = jaxpr_thunk(*args, **kwargs)
+            except Exception:
+                jaxpr = None
+        report = audit_lowered(
+            lowered,
+            mesh=meta.get("mesh", mesh),
+            expected_donations=meta.get("expected_donations"),
+            expected_donated_leaves=meta.get("expected_donated_leaves"),
+            donation_dropped_by_policy=meta.get("donation_dropped_by_policy", False),
+            compute_dtype=meta.get("compute_dtype"),
+            jaxpr=jaxpr,
+            builder=meta.get("builder", getattr(built, "__name__", "unknown")),
+            zero_sharding=meta.get("zero_sharding"),
+        )
+        report.__dict__.pop("_compiled", None)  # don't pin the executable
+    return fingerprint_from_audit(report, stablehlo_text, meta, config=config)
+
+
+# -------------------------------------------------------------- serialization
+def canonical_json(fp) -> str:
+    """Byte-stable JSON of a fingerprint (or its dict): sorted keys, sorted
+    inventories (sorted at extraction), 1-space indent, trailing newline.
+    Two extractions of the same program in different processes must produce
+    identical bytes — this is the property the goldens gate rides on."""
+    doc = fp.to_dict() if hasattr(fp, "to_dict") else fp
+    return json.dumps(doc, indent=1, sort_keys=True) + "\n"
+
+
+def fingerprint_hash(fp) -> str:
+    """Short content hash (12 hex chars of sha256) — the program identity
+    bench lines and tune rankings carry. The free-form ``config`` LABEL is
+    excluded from the hashed bytes: a golden named ``step``, a bench row
+    stamped ``bench_tiny``, and a tune candidate all hash identically when
+    they lowered the byte-identical program — which is the whole point of
+    joining rounds on program identity rather than flag settings."""
+    doc = dict(fp.to_dict() if hasattr(fp, "to_dict") else fp)
+    doc.pop("config", None)
+    return hashlib.sha256(canonical_json(doc).encode("utf-8")).hexdigest()[:12]
+
+
+def golden_path(goldens_dir: str, config: str) -> str:
+    return os.path.join(goldens_dir, f"fingerprint_{config}.json")
+
+
+def write_golden(goldens_dir: str, fp) -> str:
+    os.makedirs(goldens_dir, exist_ok=True)
+    doc = fp.to_dict() if hasattr(fp, "to_dict") else fp
+    path = golden_path(goldens_dir, doc["config"])
+    with open(path, "w") as f:
+        f.write(canonical_json(doc))
+    return path
+
+
+def load_golden(goldens_dir: str, config: str) -> dict | None:
+    path = golden_path(goldens_dir, config)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def default_goldens_dir() -> str:
+    """``tests/goldens`` next to the accelerate_tpu package (the repo
+    layout); falls back to CWD-relative for installed-package invocations."""
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    candidate = os.path.join(pkg_root, GOLDENS_DIRNAME)
+    if os.path.isdir(candidate):
+        return candidate
+    return os.path.join(os.getcwd(), GOLDENS_DIRNAME)
+
+
+# ------------------------------------------------------------ drift detection
+@dataclass
+class DriftEntry:
+    """One classified divergence between a golden and the current program."""
+
+    field: str
+    kind: str          # violation / improvement / benign-shape
+    golden: object
+    current: object
+    detail: str
+
+    def to_dict(self) -> dict:
+        return {
+            "field": self.field,
+            "kind": self.kind,
+            "golden": self.golden,
+            "current": self.current,
+            "detail": self.detail,
+        }
+
+    def format(self) -> str:
+        return f"[{self.kind}] {self.field}: {self.detail}"
+
+
+def _dp_allgather_count(fp: dict) -> int:
+    return sum(
+        c["count"] for c in fp.get("collectives", ())
+        if c["op"] == "all-gather" and "dp" in c.get("axes", ()) and not c.get("zero")
+    )
+
+
+def _collective_keys(fp: dict) -> dict:
+    return {
+        (c["op"], tuple(c.get("axes", ())), c["shape"], bool(c.get("zero"))):
+            c["count"]
+        for c in fp.get("collectives", ())
+    }
+
+
+def _directional(entries: list, fieldname: str, golden_v: int, current_v: int,
+                 worse_detail: str, better_detail: str,
+                 golden_doc=None, current_doc=None):
+    """Book a drift entry for a monotone gate: growth is a violation, shrink
+    an improvement."""
+    if current_v == golden_v:
+        return
+    kind = VIOLATION if current_v > golden_v else IMPROVEMENT
+    detail = worse_detail if current_v > golden_v else better_detail
+    entries.append(DriftEntry(
+        field=fieldname, kind=kind,
+        golden=golden_doc if golden_doc is not None else golden_v,
+        current=current_doc if current_doc is not None else current_v,
+        detail=f"{detail} ({golden_v} -> {current_v})",
+    ))
+
+
+def classify_drift(golden: dict, current: dict) -> list:
+    """Diff two fingerprint dicts into classified :class:`DriftEntry` rows.
+
+    Violations are the regressions the gate exists for; improvements are the
+    same fields moving the right way (the check passes, but the golden is
+    stale — regenerate with ``--update`` to bank the win); benign-shape
+    covers census/byte movement with no invariant direction (model-shape
+    changes, reduction-count churn). An empty list means exact agreement."""
+    entries: list = []
+
+    for key in ("config", "builder"):
+        if golden.get(key) != current.get(key):
+            entries.append(DriftEntry(
+                field=key, kind=VIOLATION,
+                golden=golden.get(key), current=current.get(key),
+                detail=f"fingerprint identity mismatch on {key!r}: these are "
+                       "different programs — fix the config matrix or "
+                       "regenerate goldens (--update)",
+            ))
+            return entries
+    if golden.get("mesh_axes") != current.get("mesh_axes"):
+        entries.append(DriftEntry(
+            field="mesh_axes", kind=VIOLATION,
+            golden=golden.get("mesh_axes"), current=current.get("mesh_axes"),
+            detail="mesh shape changed — the fingerprint rig must pin the "
+                   "same virtual mesh the golden was extracted on",
+        ))
+        return entries
+
+    # --- zero-tolerance program invariants -------------------------------
+    _directional(
+        entries, "collectives.dp_allgathers",
+        _dp_allgather_count(golden), _dp_allgather_count(current),
+        "unclaimed all-gather(s) on the dp axis appeared — dp-replicated "
+        "data re-materialized inside the step body",
+        "dp-axis all-gather(s) removed",
+    )
+    _directional(
+        entries, "host_callbacks",
+        int(golden.get("host_callbacks", {}).get("count", 0)),
+        int(current.get("host_callbacks", {}).get("count", 0)),
+        "host callback(s) appeared — the device stream now serializes "
+        "against the Python runtime",
+        "host callback(s) removed",
+    )
+
+    # --- donation contract ------------------------------------------------
+    g_don = golden.get("donation", {})
+    c_don = current.get("donation", {})
+    g_args = set(g_don.get("expected_argnums", ()))
+    c_args = set(c_don.get("expected_argnums", ()))
+    if c_args != g_args:
+        kind = VIOLATION if (g_args - c_args) else IMPROVEMENT
+        entries.append(DriftEntry(
+            field="donation.expected_argnums", kind=kind,
+            golden=sorted(g_args), current=sorted(c_args),
+            detail=(
+                "donation contract narrowed — buffers the step used to "
+                "reuse in place are now copied every step"
+                if kind == VIOLATION else "donation contract widened"
+            ),
+        ))
+    g_miss = g_don.get("misses", {})
+    c_miss = c_don.get("misses", {})
+    for reason in sorted(set(g_miss) | set(c_miss)):
+        _directional(
+            entries, f"donation.misses.{reason}",
+            int(g_miss.get(reason, 0)), int(c_miss.get(reason, 0)),
+            f"donation miss ({reason}) appeared — a marked/contracted donor "
+            "is no longer aliased",
+            f"donation miss ({reason}) fixed",
+        )
+    if g_don.get("expected_leaves") != c_don.get("expected_leaves"):
+        entries.append(DriftEntry(
+            field="donation.expected_leaves", kind=BENIGN,
+            golden=g_don.get("expected_leaves"),
+            current=c_don.get("expected_leaves"),
+            detail="donated pytrees flatten to a different leaf count "
+                   "(model/optimizer shape change)",
+        ))
+
+    # --- dtype flow -------------------------------------------------------
+    g_flags = set(golden.get("dtype_flow", {}).get("flags", ()))
+    c_flags = set(current.get("dtype_flow", {}).get("flags", ()))
+    for flag in sorted(c_flags - g_flags):
+        entries.append(DriftEntry(
+            field="dtype_flow.flags", kind=VIOLATION,
+            golden=None, current=flag,
+            detail=f"new numerics flag: {flag}",
+        ))
+    for flag in sorted(g_flags - c_flags):
+        entries.append(DriftEntry(
+            field="dtype_flow.flags", kind=IMPROVEMENT,
+            golden=flag, current=None,
+            detail=f"numerics flag resolved: {flag}",
+        ))
+    for census in ("dots", "reduces"):
+        g_census = golden.get("dtype_flow", {}).get(census, {})
+        c_census = current.get("dtype_flow", {}).get(census, {})
+        if g_census != c_census:
+            changed = sorted(
+                k for k in set(g_census) | set(c_census)
+                if g_census.get(k) != c_census.get(k)
+            )
+            entries.append(DriftEntry(
+                field=f"dtype_flow.{census}", kind=BENIGN,
+                golden={k: g_census.get(k, 0) for k in changed},
+                current={k: c_census.get(k, 0) for k in changed},
+                detail=f"{census} census changed: {', '.join(changed)}",
+            ))
+    if golden.get("compute_dtype") != current.get("compute_dtype"):
+        entries.append(DriftEntry(
+            field="compute_dtype", kind=BENIGN,
+            golden=golden.get("compute_dtype"),
+            current=current.get("compute_dtype"),
+            detail="declared compute dtype changed (deliberate precision "
+                   "change — regenerate goldens if intended)",
+        ))
+
+    # --- replication (the ZeRO win) --------------------------------------
+    g_mem = golden.get("memory", {})
+    c_mem = current.get("memory", {})
+    for cls in sorted(set(g_mem) | set(c_mem)):
+        if cls in g_mem and cls not in c_mem:
+            # Attribution LOSS is not the savings it numerically mimics: a
+            # broken memory_classes thunk or dropped builder meta would
+            # otherwise read as "replicated bytes shrank to 0" and disarm
+            # the very gate this section carries.
+            entries.append(DriftEntry(
+                field=f"memory.{cls}", kind=VIOLATION,
+                golden=g_mem[cls], current=None,
+                detail=f"memory attribution for class {cls!r} vanished — "
+                       "the builder meta no longer classifies these bytes "
+                       "(broken memory_classes thunk?)",
+            ))
+            continue
+        g_axes = g_mem.get(cls, {}).get("by_axis", {})
+        c_axes = c_mem.get(cls, {}).get("by_axis", {})
+        for axis in sorted(set(g_axes) | set(c_axes)):
+            _directional(
+                entries, f"memory.{cls}.replicated.{axis}",
+                int(g_axes.get(axis, {}).get("replicated", 0)),
+                int(c_axes.get(axis, {}).get("replicated", 0)),
+                f"{cls} bytes replicated along {axis} GREW — a sharding "
+                "plan stopped partitioning this class",
+                f"{cls} bytes replicated along {axis} shrank",
+            )
+        g_totals = {
+            k: g_mem.get(cls, {}).get(k) for k in ("global_bytes", "leaves")
+        }
+        c_totals = {
+            k: c_mem.get(cls, {}).get(k) for k in ("global_bytes", "leaves")
+        }
+        if g_totals != c_totals:
+            entries.append(DriftEntry(
+                field=f"memory.{cls}.size", kind=BENIGN,
+                golden=g_totals, current=c_totals,
+                detail=f"{cls} class size changed (model/optimizer shape)",
+            ))
+
+    # --- ZeRO contract ----------------------------------------------------
+    g_zero = golden.get("zero", {})
+    c_zero = current.get("zero", {})
+    if g_zero.get("declared") != c_zero.get("declared"):
+        entries.append(DriftEntry(
+            field="zero.declared", kind=VIOLATION,
+            golden=g_zero.get("declared"), current=c_zero.get("declared"),
+            detail="ZeRO sharding contract flipped — the config no longer "
+                   "builds the program the golden pinned",
+        ))
+    elif g_zero.get("declared") and g_zero.get("collectives") and not c_zero.get("collectives"):
+        entries.append(DriftEntry(
+            field="zero.collectives", kind=VIOLATION,
+            golden=g_zero.get("collectives"), current={},
+            detail="declared ZeRO traffic vanished — the cross-replica "
+                   "update plan disengaged (opt-state is replicated again)",
+        ))
+    elif g_zero.get("collectives") != c_zero.get("collectives"):
+        entries.append(DriftEntry(
+            field="zero.collectives", kind=BENIGN,
+            golden=g_zero.get("collectives"), current=c_zero.get("collectives"),
+            detail="ZeRO update traffic census changed",
+        ))
+
+    # --- everything else in the collective inventory ----------------------
+    # The dp-allgather gate above compares only the summed COUNT, so covered
+    # keys stay in this census too: a shape-for-shape swap at equal count is
+    # a different program and must surface (as benign-shape) rather than
+    # read as exact agreement against a now-stale golden.
+    g_keys = _collective_keys(golden)
+    c_keys = _collective_keys(current)
+    residual = {
+        k for k in set(g_keys) | set(c_keys)
+        if g_keys.get(k) != c_keys.get(k)
+    }
+    if residual:
+        fmt = lambda k: f"{k[0]}@{','.join(k[1]) or '-'} {k[2]}{' [zero]' if k[3] else ''}"  # noqa: E731
+        entries.append(DriftEntry(
+            field="collectives", kind=BENIGN,
+            golden={fmt(k): g_keys.get(k, 0) for k in sorted(residual)},
+            current={fmt(k): c_keys.get(k, 0) for k in sorted(residual)},
+            detail="collective census changed (no gated axis direction)",
+        ))
+
+    return entries
+
+
+def drift_verdict(entries: list) -> str:
+    """Collapse classified entries to one verdict: ``match`` (no drift),
+    ``violation`` (any gated regression — the exit-1 condition),
+    ``improvement`` (gated fields moved the right way; golden is stale), or
+    ``benign-shape`` (only undirected census/byte movement)."""
+    kinds = {e.kind for e in entries}
+    if VIOLATION in kinds:
+        return VIOLATION
+    if IMPROVEMENT in kinds:
+        return IMPROVEMENT
+    if kinds:
+        return BENIGN
+    return "match"

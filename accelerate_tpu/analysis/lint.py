@@ -47,6 +47,12 @@ table):
   fully-unspecified spec) on a hot-path module: pins a possibly-large
   intermediate fully replicated on every device — the memory auditor's
   ``gather`` reshard, blocked here at the source level.
+- ``rank-divergent-collective`` — a collective or KV-agreement call issued
+  under a ``process_index`` / ``local_process_index`` (or the derived
+  ``is_main_process`` family) host branch: ranks that skip the branch never
+  enter the collective, so the ranks that do wait forever — the classic
+  distributed deadlock. Make every rank reach the call and branch on the
+  RESULT instead.
 
 Suppression: append ``# accelerate-lint: disable=<rule>[,<rule>...]`` to the
 flagged line. Grandfathered findings live in a baseline file (JSON, keyed on
@@ -187,6 +193,14 @@ RULES = (
         include=_CONSTRAINT_SCOPE,
         exclude=_SHARDING_HOME,
     ),
+    Rule(
+        name="rank-divergent-collective",
+        summary="collective / KV-agreement call under a process_index-"
+                "dependent host branch — ranks that skip the branch never "
+                "enter the collective (distributed deadlock hazard)",
+        remedy="issue the collective on EVERY rank and branch on its result "
+               "(rank-0 work rides a broadcast; see utils/agreement.py)",
+    ),
 )
 
 _RULES_BY_NAME = {r.name: r for r in RULES}
@@ -292,6 +306,44 @@ _TRACING_WRAPPERS = {
     "jax.pure_callback",  # the fn arg runs on host, but jit-wrapping it is a smell
 }
 
+# Names whose truth value differs across hosts: the raw rank accessors and
+# the PartialState properties derived from them. A branch tested on any of
+# these takes different arms on different ranks.
+_RANK_NAMES = {
+    "process_index", "local_process_index",
+    "is_main_process", "is_local_main_process", "is_last_process",
+}
+
+# Calls that block until every rank arrives (eager collectives, barriers, and
+# the coordination-service KV agreement helpers): issued under a
+# rank-divergent branch they deadlock the ranks that DID enter.
+_DIVERGENT_COLLECTIVE_CALLS = {
+    "wait_for_everyone", "barrier", "wait_at_barrier",
+    "blocking_key_value_get", "kv_all_gather", "kv_or_exchange",
+    "broadcast_one_to_all", "process_allgather", "sync_global_devices",
+    "psum", "pmean", "pmax", "pmin",
+    "all_gather", "all_reduce", "reduce_scatter", "all_to_all",
+    "gather", "gather_object", "gather_for_metrics",
+    "broadcast", "broadcast_object_list", "reduce",
+}
+
+# Dotted spellings that share a terminal name with a collective but are
+# host-local (the functools fold, not a cross-process reduce).
+_DIVERGENT_EXEMPT_DOTTED = {"functools.reduce"}
+
+
+def _rank_divergent_test(test_node) -> bool:
+    """Whether a branch condition reads a per-rank identity (process_index /
+    is_main_process et al.) — as a bare name, an attribute (state.
+    process_index), or a call (jax.process_index())."""
+    for sub in ast.walk(test_node):
+        if isinstance(sub, ast.Name) and sub.id in _RANK_NAMES:
+            return True
+        if isinstance(sub, ast.Attribute) and sub.attr in _RANK_NAMES:
+            return True
+    return False
+
+
 _IMPURE_CALLS = {
     "time.time", "time.perf_counter", "time.monotonic", "time.process_time",
     "random.random", "random.randint", "random.uniform", "random.choice",
@@ -316,6 +368,7 @@ class _Visitor(ast.NodeVisitor):
         self.safe_donation_names: set[str] = set()
         self._func_stack: list = []
         self._traced_depth = 0
+        self._divergent_depth = 0
 
     # ---------------------------------------------------------------- helpers
     def _emit(self, rule_name: str, node, message: str):
@@ -365,13 +418,84 @@ class _Visitor(ast.NodeVisitor):
         self._func_stack.append(node.name)
         if traced:
             self._traced_depth += 1
-        self.generic_visit(node)
+        for dec in node.decorator_list:
+            self.visit(dec)
+        self.visit(node.args)  # default values / annotations carry rules too
+        if node.returns is not None:
+            self.visit(node.returns)
+        self._visit_block(node.body)
         if traced:
             self._traced_depth -= 1
         self._func_stack.pop()
 
     visit_FunctionDef = _function
     visit_AsyncFunctionDef = _function
+
+    def _visit_block(self, stmts):
+        """Visit a statement list tracking rank-guarded early exits: after
+        ``if <rank-test>: ... return/raise`` the REMAINDER of the block runs
+        only on the complementary ranks — the guard-return spelling of the
+        same divergence the branch form carries."""
+        bumped = 0
+        for stmt in stmts:
+            self.visit(stmt)
+            if (
+                isinstance(stmt, ast.If)
+                and not stmt.orelse
+                and stmt.body
+                and isinstance(stmt.body[-1], (ast.Return, ast.Raise))
+                and _rank_divergent_test(stmt.test)
+            ):
+                self._divergent_depth += 1
+                bumped += 1
+        self._divergent_depth -= bumped
+
+    def visit_Module(self, node):
+        self._visit_block(node.body)
+
+    # Compound statements route their bodies through _visit_block so a rank
+    # guard-return nested under try/with/for still poisons the remainder of
+    # its block (a plain generic_visit would lose the early-exit tracking).
+    def visit_Try(self, node):
+        self._visit_block(node.body)
+        for handler in node.handlers:
+            if handler.type is not None:
+                self.visit(handler.type)
+            self._visit_block(handler.body)
+        self._visit_block(node.orelse)
+        self._visit_block(node.finalbody)
+
+    def _with(self, node):
+        for item in node.items:
+            self.visit(item)
+        self._visit_block(node.body)
+
+    visit_With = _with
+    visit_AsyncWith = _with
+
+    def _for(self, node):
+        self.visit(node.target)
+        self.visit(node.iter)
+        self._visit_block(node.body)
+        self._visit_block(node.orelse)
+
+    visit_For = _for
+    visit_AsyncFor = _for
+
+    # ---------------------------------------------------------------- branches
+    def _divergent_branch(self, node):
+        """If/While whose condition reads a per-rank identity: BOTH arms are
+        rank-divergent (the else side runs on exactly the complementary
+        ranks), so the whole statement visits at elevated depth."""
+        bump = 1 if _rank_divergent_test(node.test) else 0
+        self._divergent_depth += bump
+        self.visit(node.test)
+        self._visit_block(node.body)
+        self._visit_block(node.orelse)
+        self._divergent_depth -= bump
+
+    visit_If = _divergent_branch
+    visit_While = _divergent_branch
 
     # ------------------------------------------------------------------ calls
     def visit_Call(self, node: ast.Call):
@@ -445,6 +569,15 @@ class _Visitor(ast.NodeVisitor):
         if term == "block_until_ready":
             self._emit("uncounted-block-until-ready", node,
                        "block_until_ready stalls dispatch")
+
+        if (
+            self._divergent_depth > 0
+            and term in _DIVERGENT_COLLECTIVE_CALLS
+            and callee not in _DIVERGENT_EXEMPT_DOTTED
+        ):
+            self._emit("rank-divergent-collective", node,
+                       f"{callee or term}(...) under a rank-dependent branch "
+                       "can deadlock the ranks that entered it")
 
         self.generic_visit(node)
 

@@ -509,11 +509,14 @@ def save_model(accelerator, model, save_directory, max_shard_size="10GB", safe_s
     (reference ``save_model`` :3117-3227)."""
     params = accelerator.get_state_dict(model)  # host numpy tree
     if not accelerator.is_main_process:
-        accelerator.wait_for_everyone()
+        # Symmetric with the main-rank barrier below: every rank reaches
+        # wait_for_everyone exactly once, on complementary arms.
+        accelerator.wait_for_everyone()  # accelerate-lint: disable=rank-divergent-collective
         return
     export_full_weights(params, save_directory, max_shard_size=max_shard_size,
                         safe_serialization=safe_serialization)
-    accelerator.wait_for_everyone()
+    # The main-rank half of the same symmetric fence (see the guard above).
+    accelerator.wait_for_everyone()  # accelerate-lint: disable=rank-divergent-collective
 
 
 def export_full_weights(params, save_directory, max_shard_size="10GB", safe_serialization=True):

@@ -15,6 +15,7 @@ from .analysis import (
 from .config import config_command_parser
 from .env import env_command_parser
 from .estimate import estimate_command_parser
+from .fingerprint import fingerprint_command_parser
 from .launch import launch_command_parser
 from .merge import merge_command_parser
 from .profile import blackbox_command_parser, profile_command_parser
@@ -39,6 +40,7 @@ def main() -> None:
     lint_command_parser(subparsers=subparsers)
     audit_command_parser(subparsers=subparsers)
     memcheck_command_parser(subparsers=subparsers)
+    fingerprint_command_parser(subparsers=subparsers)
     profile_command_parser(subparsers=subparsers)
     blackbox_command_parser(subparsers=subparsers)
     tune_command_parser(subparsers=subparsers)

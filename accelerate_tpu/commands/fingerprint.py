@@ -1,0 +1,325 @@
+"""`accelerate-tpu fingerprint` — the compiled-program drift gate.
+
+Re-lowers the shipped builder matrix (train step / K-step window × ZeRO
+sharding × fsdp/tp plans × the ContinuousBatcher decode window) on a pinned
+virtual CPU mesh, extracts each program's canonical
+:class:`~..analysis.fingerprint.ProgramFingerprint`, and diffs it against the
+committed goldens under ``tests/goldens/``:
+
+- ``--check`` (default): exit 1 when any config's drift classifies as a
+  **violation** (new dp all-gather, host callback, narrowed/missed donation,
+  grown replicated bytes, new low-precision accumulation, vanished ZeRO
+  traffic) or a golden is missing. Benign-shape and improvement drifts
+  report but pass — an improvement is a prompt to re-bank the golden.
+- ``--update``: regenerate the goldens from HEAD — the deliberate-change
+  path. Commit the diff; the golden diff IS the review surface for a
+  program-contract change.
+- ``--json``: one machine-readable verdict document (the audit/memcheck
+  ``{verdict, failures, ...}`` shape) for CI and the autotuner.
+
+Determinism contract: the command pins an N-virtual-device CPU mesh
+(default 8 — the same rig tier-1 runs on) and scrubs the persistent compile
+cache before the first backend touch, so donation is LIVE (the
+``safe_donate_argnums`` CPU+cache policy would otherwise waive donor marks
+and disarm the dropped-donation detector) and extraction is byte-identical
+across processes and rigs. ``--keep-compile-cache`` opts out for in-process
+callers that must not disturb a session cache.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# The shipped builder matrix. Tiny shapes keep the whole matrix' lower+compile
+# under a minute on a CPU rig; the CONTRACT (collectives, donation, dtype
+# flow, replication split) is shape-independent, so tiny pins it as well as
+# large would.
+_TRAIN_CONFIGS = {
+    # name: (window, optimizer, zero_sharding, parallelism kwargs)
+    "step": (1, "sgd", False, None),
+    "step_zero": (1, "adamw", True, None),
+    "window4": (4, "sgd", False, None),
+    "window4_zero": (4, "adamw", True, None),
+    "step_fsdp8": (1, "sgd", False, {"fsdp_size": 8}),
+    "step_tp2_fsdp4": (1, "sgd", False, {"tp_size": 2, "fsdp_size": 4}),
+}
+
+CONFIG_NAMES = tuple(_TRAIN_CONFIGS) + ("decode",)
+
+
+def _reset_singletons():
+    from ..state import AcceleratorState, GradientState
+
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+
+
+def _tiny_config():
+    from ..models import LlamaConfig
+
+    return LlamaConfig.tiny(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_attention_heads=2, num_key_value_heads=2, num_hidden_layers=2,
+    )
+
+
+def _train_fingerprint(name: str):
+    import numpy as np
+    import jax
+    import optax
+
+    from ..accelerator import Accelerator
+    from ..models import Llama
+
+    window, optimizer, zero, parallelism = _TRAIN_CONFIGS[name]
+    _reset_singletons()
+    kwargs = {}
+    if parallelism:
+        from ..parallel.mesh import ParallelismConfig
+
+        kwargs["parallelism_config"] = ParallelismConfig(**parallelism)
+    accelerator = Accelerator(**kwargs)
+    if zero:
+        accelerator.zero_sharding = True
+    cfg = _tiny_config()
+    model = Llama(cfg)
+    model.init_params(jax.random.key(0))
+    tx = {
+        "sgd": lambda: optax.sgd(0.1),
+        "adamw": lambda: optax.adamw(3e-4),
+    }[optimizer]()
+    pmodel, popt = accelerator.prepare(model, tx)
+    ids = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (8, 16)
+    ).astype(np.int32)
+    batch = {"input_ids": ids, "labels": ids}
+    if window > 1:
+        built = accelerator.build_train_window(pmodel, popt, window=window)
+        batch = {k: np.stack([v] * window) for k, v in batch.items()}
+    else:
+        built = accelerator.build_train_step(pmodel, popt)
+    try:
+        return accelerator.fingerprint(built, batch, config=name)
+    finally:
+        _reset_singletons()
+
+
+def _decode_fingerprint(name: str = "decode"):
+    import jax
+
+    from ..models import Llama, LlamaConfig
+    from ..serving import ContinuousBatcher
+
+    _reset_singletons()
+    cfg = LlamaConfig.tiny(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_attention_heads=2, num_key_value_heads=2, num_hidden_layers=1,
+    )
+    model = Llama(cfg)
+    model.init_params(jax.random.key(0))
+    engine = ContinuousBatcher(
+        model, batch_slots=2, max_new_tokens=4, max_cache_len=64,
+        bucket_sizes=(8,), sync_every=2,
+    )
+    try:
+        return engine.fingerprint_decode(config=name)
+    finally:
+        _reset_singletons()
+
+
+def extract_config(name: str):
+    """Build one matrix config and extract its fingerprint."""
+    if name == "decode":
+        return _decode_fingerprint(name)
+    if name not in _TRAIN_CONFIGS:
+        raise SystemExit(
+            f"unknown fingerprint config {name!r}; choose from "
+            f"{', '.join(CONFIG_NAMES)}"
+        )
+    return _train_fingerprint(name)
+
+
+def run_fingerprints(configs, goldens_dir: str, update: bool = False):
+    """Extract + compare (or rewrite) each config's golden.
+
+    Returns ``(results, failures)``: ``results`` is ``{config: {hash,
+    verdict, drift:[...]}}`` (verdict ``updated`` in update mode, else
+    ``match`` / ``benign-shape`` / ``improvement`` / ``violation`` /
+    ``missing-golden``); ``failures`` is the exit-1 list for check mode."""
+    from ..analysis.fingerprint import (
+        classify_drift,
+        drift_verdict,
+        fingerprint_hash,
+        load_golden,
+        write_golden,
+    )
+
+    results: dict = {}
+    failures: list = []
+    for name in configs:
+        doc = extract_config(name).to_dict()
+        digest = fingerprint_hash(doc)
+        if update:
+            path = write_golden(goldens_dir, doc)
+            results[name] = {"hash": digest, "verdict": "updated", "golden": path,
+                             "drift": []}
+            continue
+        golden = load_golden(goldens_dir, name)
+        if golden is None:
+            results[name] = {"hash": digest, "verdict": "missing-golden",
+                             "drift": []}
+            failures.append(
+                f"{name}: no golden at {goldens_dir} — run "
+                f"`accelerate-tpu fingerprint --update --configs {name}` and "
+                "commit the file"
+            )
+            continue
+        drifts = classify_drift(golden, doc)
+        verdict = drift_verdict(drifts)
+        results[name] = {
+            "hash": digest,
+            "verdict": verdict,
+            "drift": [d.to_dict() for d in drifts],
+        }
+        if verdict == "violation":
+            details = "; ".join(
+                d.detail for d in drifts if d.kind == "violation"
+            )
+            failures.append(f"{name}: program-contract violation — {details}")
+    return results, failures
+
+
+# ------------------------------------------------------------------ front end
+def fingerprint_command_parser(subparsers=None) -> argparse.ArgumentParser:
+    description = (
+        "Re-lower the shipped builder matrix, extract canonical program "
+        "fingerprints (collectives per mesh axis, donation contract, dtype "
+        "flow, replication split), and diff against the committed goldens — "
+        "exit 1 on classified violations"
+    )
+    if subparsers is not None:
+        parser = subparsers.add_parser("fingerprint", description=description)
+    else:
+        parser = argparse.ArgumentParser(
+            "accelerate-tpu fingerprint", description=description
+        )
+    parser.add_argument(
+        "--check", action="store_true",
+        help="Diff HEAD's fingerprints against the goldens (the default)",
+    )
+    parser.add_argument(
+        "--update", action="store_true",
+        help="Regenerate the goldens from HEAD — the deliberate-change path; "
+             "commit the diff",
+    )
+    parser.add_argument(
+        "--configs", default=None,
+        help=f"Comma-separated subset of the matrix (default: all of "
+             f"{','.join(CONFIG_NAMES)})",
+    )
+    parser.add_argument(
+        "--goldens-dir", default=None,
+        help="Golden directory (default: tests/goldens next to the package)",
+    )
+    parser.add_argument(
+        "--cpu-virtual-devices", type=int, default=8,
+        help="Pin an N-device virtual CPU mesh before building (default 8 — "
+             "the tier-1 rig; 0 skips pinning and fingerprints the live "
+             "backend, which will NOT match the committed goldens)",
+    )
+    parser.add_argument(
+        "--keep-compile-cache", action="store_true",
+        help="Do not scrub ACCELERATE_COMPILE_CACHE_DIR: donation stays "
+             "platform-waived on CPU (fingerprints are policy-independent "
+             "either way, but the dropped-donor detector is disarmed)",
+    )
+    parser.add_argument(
+        "--json", action="store_true",
+        help="Machine-readable verdict document ({verdict, failures, "
+             "configs}) instead of the human report; exit codes unchanged",
+    )
+    parser.add_argument(
+        "--list-configs", action="store_true",
+        help="Print the config matrix and exit",
+    )
+    if subparsers is not None:
+        parser.set_defaults(func=fingerprint_command)
+    return parser
+
+
+def fingerprint_command(args) -> None:
+    from ..analysis.fingerprint import default_goldens_dir
+
+    if args.list_configs:
+        for name in CONFIG_NAMES:
+            if name == "decode":
+                print(f"{name}: ContinuousBatcher sync_every-token decode window")
+                continue
+            window, optimizer, zero, parallelism = _TRAIN_CONFIGS[name]
+            plan = ",".join(f"{k}={v}" for k, v in (parallelism or {}).items()) or "dp8"
+            print(f"{name}: window={window} optimizer={optimizer} "
+                  f"zero={'on' if zero else 'off'} mesh={plan}")
+        return
+    if args.update and args.check:
+        raise SystemExit("--check and --update are mutually exclusive")
+
+    if args.cpu_virtual_devices:
+        from ..utils.environment import pin_cpu_platform
+
+        # Must precede the first backend touch; the goldens are extracted on
+        # exactly this mesh.
+        pin_cpu_platform(args.cpu_virtual_devices)
+    if not args.keep_compile_cache:
+        # Donation must be LIVE for the dropped-donor detector: the CPU +
+        # persistent-cache policy (safe_donate_argnums) would waive every
+        # donor mark. Scrub before the first Accelerator touches the env.
+        os.environ.pop("ACCELERATE_COMPILE_CACHE_DIR", None)
+
+    configs = [c.strip() for c in (args.configs or "").split(",") if c.strip()] \
+        or list(CONFIG_NAMES)
+    unknown = [c for c in configs if c not in CONFIG_NAMES]
+    if unknown:
+        raise SystemExit(
+            f"unknown config(s) {', '.join(unknown)}; choose from "
+            f"{', '.join(CONFIG_NAMES)}"
+        )
+    goldens_dir = args.goldens_dir or default_goldens_dir()
+    results, failures = run_fingerprints(configs, goldens_dir, update=args.update)
+
+    if args.json:
+        print(json.dumps({
+            "schema_version": 1,
+            "command": "fingerprint",
+            "verdict": "fail" if failures else "pass",
+            "failures": failures,
+            "goldens_dir": goldens_dir,
+            "configs": results,
+        }, indent=1))
+    else:
+        for name, res in results.items():
+            print(f"{name}: {res['verdict']} (hash {res['hash']})")
+            for entry in res["drift"]:
+                print(f"  [{entry['kind']}] {entry['field']}: {entry['detail']}")
+        if args.update:
+            print(f"wrote {len(results)} golden(s) to {goldens_dir}")
+        else:
+            for f in failures:
+                print(f"fingerprint: {f}", file=sys.stderr)
+            print(
+                f"fingerprint: {len(configs)} config(s), "
+                f"{len(failures)} violation(s)"
+            )
+    if failures and not args.update:
+        raise SystemExit(1)
+
+
+def fingerprint_main() -> None:
+    """Console-script entry (`accelerate-tpu-fingerprint`)."""
+    fingerprint_command(fingerprint_command_parser().parse_args())
+
+
+if __name__ == "__main__":
+    fingerprint_main()

@@ -13,12 +13,16 @@ OOM-before-launch verdict). A candidate is dropped when
   donation miss — the same zero-tolerance set ``accelerate-tpu audit`` exits 1
   on), reason ``audit_violation``.
 
-Each drop is booked with the failure detail and the audit/memory evidence, so
+Each drop is booked with the failure detail and the audit/memory evidence —
+including the candidate's short program-fingerprint hash
+(analysis/fingerprint.py), so trial rankings and drop bookings alike name the
+EXACT program they judged, not just the flag tuple that requested it — and
 the tune report can show WHY a point in the space was never trialed.
 
 The audit callable is injected (``audit_fn(candidate) -> (evidence,
 failures)``) — trials.py provides the real lower-and-audit adapter (cached per
-:meth:`~.space.Candidate.lowering_key`); tests drive the prune logic with
+:meth:`~.space.Candidate.lowering_key`), whose evidence dict carries
+``{"audit", "memory", "fingerprint"}``; tests drive the prune logic with
 synthetic verdicts.
 """
 

@@ -75,6 +75,7 @@ class TrialResult:
     budget_bytes: int = 0
     audit: dict | None = None
     memory: dict | None = None
+    fingerprint: str | None = None   # short program-identity hash
     xla_preset_flags: tuple = ()
     preset_applied: bool = True
 
@@ -98,6 +99,7 @@ class TrialResult:
             "budget_bytes": self.budget_bytes,
             "audit": self.audit,
             "memory": self.memory,
+            "fingerprint": self.fingerprint,
             "xla_preset_flags": list(self.xla_preset_flags),
             "preset_applied": self.preset_applied,
         }
@@ -228,7 +230,20 @@ class TrialRig:
         memory_summary = (
             report.memory.summary_dict() if report.memory is not None else None
         )
-        evidence = {"audit": audit_summary, "memory": memory_summary}
+        # Program identity: the short fingerprint hash names the exact
+        # program this candidate lowers (and, if kept, measures) — rides the
+        # evidence into both the pruned-drop bookings and the trial rankings.
+        from ..analysis.fingerprint import fingerprint_built, fingerprint_hash
+
+        fp = fingerprint_built(
+            built.built, audit_batch,
+            config=f"tune_{candidate.key()}", report=report,
+        )
+        evidence = {
+            "audit": audit_summary,
+            "memory": memory_summary,
+            "fingerprint": fingerprint_hash(fp),
+        }
         failures = audit_failures(
             audit_summary, memory_summary, budget_bytes=self.budget_bytes
         )
@@ -385,6 +400,7 @@ class TrialRig:
                 ),
                 audit=ev.get("audit"),
                 memory=ev.get("memory") or None,
+                fingerprint=ev.get("fingerprint"),
                 xla_preset_flags=preset_flags_resolved,
                 preset_applied=preset_applied,
             )

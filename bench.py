@@ -51,7 +51,13 @@ import numpy as np
 # maps the tune winner's candidate onto this script's env levers (explicit env
 # wins) and stamps detail.from_tune with the report path + winner, so a
 # replayed row is distinguishable from a hand-swept one.
-BENCH_SCHEMA_VERSION = 7
+# v8 = program identity (analysis/fingerprint.py): detail.fingerprint on
+# every line — the short content hash of the exact program this config ran
+# (canonical collective/donation/dtype-flow/replication contract) plus the
+# drift verdict against a committed golden when one exists for this config
+# ("no-golden" otherwise) — so bench rounds are joinable to exact program
+# identity, not just to flag settings.
+BENCH_SCHEMA_VERSION = 8
 
 
 class BenchAuditFailure(RuntimeError):
@@ -503,6 +509,29 @@ def run_one(mode: str):
             "re-materialized every step (see detail.audit)",
             audit_summary,
         )
+    # Program identity (schema v8 detail.fingerprint): the canonical contract
+    # of the exact program this config runs, extracted from the audit above
+    # (its stashed StableHLO — no second lowering). The drift verdict engages
+    # when a committed golden exists for this bench config (none are shipped
+    # by default — the gated matrix lives in `accelerate-tpu fingerprint`);
+    # the hash excludes the config label, so it joins bench rounds to the
+    # goldens and tune rankings that lowered the identical program.
+    from accelerate_tpu.analysis.fingerprint import (
+        classify_drift, default_goldens_dir, drift_verdict, fingerprint_hash,
+        load_golden,
+    )
+
+    fp_doc = accelerator.fingerprint(
+        step, audit_batch, config=f"bench_{mode}", report=audit_report
+    ).to_dict()
+    golden = load_golden(default_goldens_dir(), fp_doc["config"])
+    fingerprint_summary = {
+        "hash": fingerprint_hash(fp_doc),
+        "drift": (
+            drift_verdict(classify_drift(golden, fp_doc))
+            if golden is not None else "no-golden"
+        ),
+    }
 
     def _sync(x):
         # Hard host sync (block_until_ready does not block through axon);
@@ -612,6 +641,7 @@ def run_one(mode: str):
                     "telemetry": telemetry_summary,
                     "audit": audit_summary,
                     "memory": memory_summary,
+                    "fingerprint": fingerprint_summary,
                     # Profiling (telemetry/profiler.py): present only when a
                     # trace capture engaged during this config — the capture
                     # list with each parsed attribution report (compute /

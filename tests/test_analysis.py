@@ -275,7 +275,7 @@ def test_bench_audit_failure_line_is_schemad(capsys):
     )
     bench._print_failure("tiny", exc)
     line = json.loads(capsys.readouterr().out.strip())
-    assert line["schema_version"] == bench.BENCH_SCHEMA_VERSION == 7
+    assert line["schema_version"] == bench.BENCH_SCHEMA_VERSION == 8
     assert line["value"] == 0.0
     assert line["detail"]["audit"]["dp_allgathers"] == 2
     assert "dp mesh axis" in line["detail"]["error"]
@@ -335,6 +335,38 @@ def test_lint_satellite_files_clean_without_baseline():
          "y = jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P()))\n"),
         ("replicated-constraint", "models/foo.py",
          "y = jax.lax.with_sharding_constraint(x, replicated(mesh))\n"),
+        # Collective under a rank-dependent branch — the deadlock hazard.
+        ("rank-divergent-collective", "anywhere.py",
+         "if state.process_index == 0:\n    accelerator.wait_for_everyone()\n"),
+        ("rank-divergent-collective", "anywhere.py",
+         "import jax\nif jax.process_index() == 0:\n    out = gather(metrics)\n"),
+        # The derived main-process properties are process_index-dependent too.
+        ("rank-divergent-collective", "anywhere.py",
+         "if accelerator.is_main_process:\n    blob = kv_all_gather(v, n, r, ns)\n"),
+        # The ELSE arm runs on the complementary ranks — equally divergent.
+        ("rank-divergent-collective", "anywhere.py",
+         "if local_process_index != 0:\n    pass\nelse:\n    broadcast_one_to_all(x)\n"),
+        # Guard-return spelling: the rest of the function runs on the
+        # complementary ranks only — the classic deadlock shape.
+        ("rank-divergent-collective", "anywhere.py",
+         "def save(acc, metrics):\n"
+         "    if not acc.is_main_process:\n        return\n"
+         "    out = gather(metrics)\n"),
+        ("rank-divergent-collective", "anywhere.py",
+         "def f(state):\n"
+         "    if state.process_index != 0:\n        raise RuntimeError\n"
+         "    state.wait_for_everyone()\n"),
+        # Guard-return nested under try/finally (the real save/export shape).
+        ("rank-divergent-collective", "anywhere.py",
+         "def f(acc, x):\n"
+         "    try:\n"
+         "        if not acc.is_main_process:\n            return\n"
+         "        out = gather(x)\n"
+         "    finally:\n        pass\n"),
+        # Existing rules must keep firing inside default-argument expressions
+        # (the _visit_block function-body rewrite must not skip node.args).
+        ("raw-device-baseline", "anywhere.py",
+         "import jax\ndef f(n=len(jax.devices())):\n    return n\n"),
     ],
 )
 def test_lint_rule_fires(rule, relpath, source):
@@ -377,6 +409,30 @@ def test_lint_rule_fires(rule, relpath, source):
          "y = jax.lax.with_sharding_constraint(x, P())\n"),
         ("replicated-constraint", "parallel/sharding.py",
          "y = jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P()))\n"),
+        # Collective on EVERY rank, branch on the result — the safe spelling.
+        ("rank-divergent-collective", "anywhere.py",
+         "flags = kv_or_exchange(local, n, rank, ns)\n"
+         "if state.process_index == 0:\n    log(flags)\n"),
+        # Host-local work under a rank branch is fine (no collective).
+        ("rank-divergent-collective", "anywhere.py",
+         "if state.is_main_process:\n    buf[:] = payload\n"),
+        # functools.reduce shares the terminal name, not the semantics.
+        ("rank-divergent-collective", "anywhere.py",
+         "import functools\nif process_index == 0:\n"
+         "    total = functools.reduce(f, xs)\n"),
+        # A branch on something else entirely stays out of scope.
+        ("rank-divergent-collective", "anywhere.py",
+         "if step % 10 == 0:\n    accelerator.wait_for_everyone()\n"),
+        # A rank guard followed by host-local work only is fine.
+        ("rank-divergent-collective", "anywhere.py",
+         "def save(acc, blob, path):\n"
+         "    if not acc.is_main_process:\n        return\n"
+         "    write(path, blob)\n"),
+        # A NON-exiting rank branch does not poison the rest of the block.
+        ("rank-divergent-collective", "anywhere.py",
+         "def f(acc):\n"
+         "    if acc.is_main_process:\n        log('hi')\n"
+         "    acc.wait_for_everyone()\n"),
     ],
 )
 def test_lint_rule_stays_quiet(rule, relpath, source):

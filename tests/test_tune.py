@@ -438,6 +438,8 @@ def test_tune_end_to_end_on_cpu_rig(tmp_path):
     assert w8_key in dropped, report["dropped"]
     assert dropped[w8_key]["reason"] == REASON_PREDICTED_OOM
     assert not any(e["key"] == w8_key for e in report["ranked"])
+    # Even a never-launched drop names the program the verdict judged.
+    assert len(dropped[w8_key]["evidence"]["fingerprint"]) == 12
     # Survivors were short-benched with full evidence attached.
     assert report["ranked"], report
     for entry in report["ranked"]:
@@ -446,6 +448,10 @@ def test_tune_end_to_end_on_cpu_rig(tmp_path):
         assert entry["audit"] is not None and entry["audit"]["clean"] is True
         assert entry["memory"] is not None
         assert "mfu_est" in entry and "fractions" in entry
+        # Program identity: every ranked entry names the exact program it
+        # measured (analysis/fingerprint.py short hash via the evidence).
+        assert isinstance(entry["fingerprint"], str)
+        assert len(entry["fingerprint"]) == 12
     times = [e["step_time_s"] for e in report["ranked"]]
     assert times == sorted(times)
     # Winner = rank 1; the baseline (base candidate) was trialed, so the
