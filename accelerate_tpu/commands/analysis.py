@@ -250,7 +250,9 @@ def memcheck_command_parser(subparsers=None) -> argparse.ArgumentParser:
         "Static HBM audit of the tiny train config: per-device bytes by "
         "class (param/opt-state/accum/batch/activation-workspace), "
         "sharded-vs-replicated split per mesh axis, implicit resharding "
-        "copies, and an OOM-before-launch verdict"
+        "copies, and an OOM-before-launch verdict. --serving audits the "
+        "paged serving decode window instead (per-device KV-pool bytes "
+        "against the HBM budget)."
     )
     if subparsers is not None:
         parser = subparsers.add_parser("memcheck", description=description)
@@ -294,6 +296,28 @@ def memcheck_command_parser(subparsers=None) -> argparse.ArgumentParser:
              "host rigs need this to make the gate enforceable.",
     )
     parser.add_argument(
+        "--serving", action="store_true",
+        help="Audit the paged ContinuousBatcher decode window instead of the "
+             "train step: predicted per-device KV-pool bytes (plus params "
+             "and the gather-view workspace) gate against the HBM budget "
+             "BEFORE a serving launch — the OOM-before-launch discipline for "
+             "the decode path (docs/serving.md).",
+    )
+    parser.add_argument(
+        "--serving-slots", type=int, default=4,
+        help="Serving mode: engine batch slots (decode rows)",
+    )
+    parser.add_argument(
+        "--serving-blocks", type=int, default=64,
+        help="Serving mode: KV-pool blocks (per-device pool capacity = "
+             "blocks x block size)",
+    )
+    parser.add_argument(
+        "--serving-block-size", type=int, default=16,
+        help="Serving mode: tokens per pool block (16 = the bf16 sublane "
+             "multiple the future Pallas kernel wants)",
+    )
+    parser.add_argument(
         "--summary", action="store_true",
         help="Print the compact summary (bench.py detail.memory form) instead "
              "of the full report",
@@ -311,6 +335,26 @@ def memcheck_command_parser(subparsers=None) -> argparse.ArgumentParser:
     return parser
 
 
+def _build_serving_artifact(slots: int, blocks: int, block_size: int):
+    """The serving analog of ``_build_tiny_artifact``: a tiny paged
+    ContinuousBatcher whose compiled decode window is the audited program.
+    Returns ``(engine, built, args)`` — the pool rides the program's
+    ``_audit_meta.memory_classes`` join as the ``kv_pool`` class."""
+    import jax
+
+    from ..models import Llama, LlamaConfig
+    from ..serving import ContinuousBatcher
+
+    model = Llama(LlamaConfig.tiny())
+    model.init_params(jax.random.key(0))
+    engine = ContinuousBatcher(
+        model, batch_slots=slots, max_new_tokens=32,
+        max_cache_len=blocks * block_size, bucket_sizes=(16, 32, 64),
+        sync_every=4, paged=True, block_size=block_size, num_blocks=blocks,
+    )
+    return engine, engine._decode(), engine._decode_args()
+
+
 def memcheck_command(args) -> None:
     if args.window < 1:
         raise SystemExit("--window must be >= 1")
@@ -322,10 +366,41 @@ def memcheck_command(args) -> None:
         # Must precede the first backend touch (_build_tiny_artifact's
         # Accelerator() below); pin_cpu_platform documents the contract.
         pin_cpu_platform(args.cpu_virtual_devices)
+    budget = int(args.budget_gib * (1 << 30)) if args.budget_gib is not None else None
+    if getattr(args, "serving", False):
+        from ..analysis.memory import memory_report_from_built
+
+        engine, built, built_args = _build_serving_artifact(
+            args.serving_slots, args.serving_blocks, args.serving_block_size
+        )
+        report = memory_report_from_built(built, *built_args, budget_bytes=budget)
+        failures = []
+        pool_bytes = (
+            report.classes["kv_pool"].per_device_bytes
+            if "kv_pool" in report.classes else 0
+        )
+        if not report.fits:
+            failures.append(
+                f"predicted serving OOM: decode-window peak "
+                f"{report.predicted_peak_bytes} B/device (KV pool {pool_bytes} B) "
+                f"exceeds budget {report.budget_bytes} B — shrink "
+                "--serving-blocks/--serving-slots or raise the budget"
+            )
+        payload = report.summary_dict() if args.summary else report.to_dict()
+        payload["kv_pool_bytes_per_device"] = pool_bytes
+        payload["pool"] = engine.pool_stats()
+        if getattr(args, "json", False):
+            payload = _verdict_doc("memcheck", failures, payload)
+        print(json.dumps(payload, indent=1))
+        if not getattr(args, "json", False):
+            for f in failures:
+                print(f"memcheck: {f}", file=sys.stderr)
+        if failures:
+            raise SystemExit(1)
+        return
     accelerator, built, batch = _build_tiny_artifact(
         args.window, args.batch, args.seq, optimizer=args.optimizer
     )
-    budget = int(args.budget_gib * (1 << 30)) if args.budget_gib is not None else None
     report = accelerator.memory_report(built, batch, budget_bytes=budget)
     failures = []
     if not report.fits:

@@ -47,7 +47,7 @@ _TRAIN_CONFIGS = {
     "step_tp2_fsdp4": (1, "sgd", False, {"tp_size": 2, "fsdp_size": 4}),
 }
 
-CONFIG_NAMES = tuple(_TRAIN_CONFIGS) + ("decode",)
+CONFIG_NAMES = tuple(_TRAIN_CONFIGS) + ("decode", "decode_paged")
 
 
 def _reset_singletons():
@@ -120,9 +120,16 @@ def _decode_fingerprint(name: str = "decode"):
     )
     model = Llama(cfg)
     model.init_params(jax.random.key(0))
+    kwargs = {}
+    if name == "decode_paged":
+        # The paged decode window: its committed golden pins the block-table
+        # gather inventory and the pool+state donation contract, so the
+        # ROADMAP item 3 kernel swap (or any regression in the gather
+        # lowering) classifies as deliberate drift, not silence.
+        kwargs = dict(paged=True, block_size=4)
     engine = ContinuousBatcher(
         model, batch_slots=2, max_new_tokens=4, max_cache_len=64,
-        bucket_sizes=(8,), sync_every=2,
+        bucket_sizes=(8,), sync_every=2, **kwargs,
     )
     try:
         return engine.fingerprint_decode(config=name)
@@ -132,7 +139,7 @@ def _decode_fingerprint(name: str = "decode"):
 
 def extract_config(name: str):
     """Build one matrix config and extract its fingerprint."""
-    if name == "decode":
+    if name in ("decode", "decode_paged"):
         return _decode_fingerprint(name)
     if name not in _TRAIN_CONFIGS:
         raise SystemExit(
@@ -257,6 +264,10 @@ def fingerprint_command(args) -> None:
         for name in CONFIG_NAMES:
             if name == "decode":
                 print(f"{name}: ContinuousBatcher sync_every-token decode window")
+                continue
+            if name == "decode_paged":
+                print(f"{name}: paged ContinuousBatcher decode window "
+                      "(block-table gather + pool scatter)")
                 continue
             window, optimizer, zero, parallelism = _TRAIN_CONFIGS[name]
             plan = ",".join(f"{k}={v}" for k, v in (parallelism or {}).items()) or "dp8"

@@ -1,0 +1,116 @@
+"""Paged (block-table) KV-cache attention — reference lowering + pool helpers.
+
+The serving engine's paged mode (``serving.ContinuousBatcher(paged=True)``)
+keeps each layer's KV cache as a **block pool**: a device-resident
+``(num_blocks, block_size, kv_heads, head_dim)`` array per layer plus per-slot
+**block tables** mapping a request's logical token chain onto pool blocks
+(vLLM's layout, shaped for XLA's static-compilation model — every shape here
+is fixed at engine construction, so nothing recompiles as traffic changes).
+Allocation and free are host-side free-list surgery; cross-request prefix
+sharing is refcounted aliasing of full blocks.
+
+This module is the op-level seam:
+
+- :func:`init_kv_pool` / :func:`gather_block_view` / :func:`gather_block_mask`
+  are the pool primitives the engine's compiled programs are built from. The
+  gather is the **reference lowering** — an XLA gather over the block axis
+  that materializes each slot's chain as a contiguous per-slot view, which
+  the model's ordinary ``cached_attention`` path then consumes unchanged (so
+  every model family — rope, learned wpe, sliding windows, softcap — stays
+  bit-exact with zero model changes).
+- :func:`paged_attention` is the fused op face: one call from query chunk +
+  pools + block tables to attention output. Today it composes the reference
+  gather with :func:`~.attention.cached_attention`; ROADMAP item 3's Pallas
+  splash/ragged kernel slots in behind this exact signature (the gather over
+  block tables is the slow path the kernel exists to kill — see
+  ``benchmarks/serving_decode_profile.py`` for the op-level attribution
+  harness that will measure the swap).
+
+Block-size note for that kernel: TPU VMEM tiles are (sublane × 128-lane) with
+an 8/16/32-row sublane minimum by dtype, so ``block_size`` should stay a
+multiple of 16 (the bf16 sublane) for the eventual kernel to stream blocks
+without repacking — the engine's default is 16.
+
+Pool invariants (shared with serving.py):
+
+- Block 0 is the **trash block**: never allocated, never referenced by a
+  committed table entry, and its mask rows stay zero — so unassigned table
+  entries (0) gather as masked garbage that attention provably ignores.
+- ``pool["mask"]`` is per-token validity (1 = real token), the paged analog
+  of the contiguous cache's ``kv_mask``: bucket-padding holes and
+  inactive-step decode writes are masked out, and sliding windows measure
+  VALID-slot distance (``cached_attention``), so holes never stretch a
+  window.
+- Rope/wpe rotations are baked into K at write time from the *token position
+  channel*, not the chain slot — which is what makes a full block's K/V a
+  pure function of (params, token prefix) and therefore shareable across any
+  requests whose prompts start with the same tokens.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .attention import cached_attention
+
+
+def init_kv_pool(module, num_blocks: int, block_size: int, dtype=jnp.bfloat16):
+    """Allocate the per-layer block pool for ``module``'s cache layout.
+
+    Returns ``{"k": (L, N, bs, Hkv, D), "v": same, "mask": (N, bs) int32}``
+    with ``N = num_blocks + 1`` — block 0 is the reserved trash block (see
+    module docstring). The layer/head/dim axes are probed from the module's
+    own ``init_cache`` so every cached decoder family (Llama/GPT-2/GPT-X)
+    gets its exact layout without a second cache contract."""
+    probe = module.init_cache(1, block_size, dtype=dtype)
+    L, _, _, hkv, hd = probe["k"].shape
+    n = num_blocks + 1
+    return {
+        "k": jnp.zeros((L, n, block_size, hkv, hd), dtype),
+        "v": jnp.zeros((L, n, block_size, hkv, hd), dtype),
+        "mask": jnp.zeros((n, block_size), jnp.int32),
+    }
+
+
+def gather_block_view(pool_kv, block_tables):
+    """Materialize per-slot contiguous KV views from the pool.
+
+    ``pool_kv``: ``(..., N, bs, H, D)`` (a single layer or the L-stacked
+    pool); ``block_tables``: ``(B, M)`` int32 block ids. Returns
+    ``(..., B, M*bs, H, D)`` — slot ``b``'s chain left-packed in table order.
+    This is the reference XLA-gather lowering of paged attention."""
+    m = block_tables.shape[-1]
+    view = jnp.take(pool_kv, block_tables, axis=-4)  # (..., B, M, bs, H, D)
+    return view.reshape(view.shape[:-4] + (m * view.shape[-3],) + view.shape[-2:])
+
+
+def gather_block_mask(pool_mask, block_tables):
+    """Per-slot validity view: ``(N, bs)`` pool mask + ``(B, M)`` tables →
+    ``(B, M*bs)`` — the paged analog of the contiguous cache's ``kv_mask``."""
+    b, m = block_tables.shape
+    return jnp.take(pool_mask, block_tables, axis=0).reshape(b, m * pool_mask.shape[1])
+
+
+def paged_attention(q, k_pool, v_pool, block_tables, *, q_positions,
+                    pool_mask=None, window=None, softcap=None, scale=None):
+    """Attention of a query chunk against block-table-addressed KV pools.
+
+    q: ``(B, S, H, D)``; k_pool/v_pool: ``(N, bs, Hkv, D)`` (one layer);
+    block_tables: ``(B, M)``; q_positions: ``(S,)`` or ``(B, S)`` positions in
+    each slot's *chain-slot* index space (chain slot ``j`` of slot ``b`` is
+    view column ``j``); pool_mask: ``(N, bs)`` per-token validity.
+
+    Reference lowering: gather each slot's chain to a contiguous view, then
+    run the hole-tolerant :func:`~.attention.cached_attention` (causality on
+    chain-slot order, validity from the gathered mask, sliding windows in
+    valid-slot distance). A Pallas kernel replacing this signature must match
+    it bit-for-bit on the test vectors in tests/test_paged_attention.py."""
+    k_view = gather_block_view(k_pool, block_tables)
+    v_view = gather_block_view(v_pool, block_tables)
+    kv_mask = (
+        gather_block_mask(pool_mask, block_tables) if pool_mask is not None else None
+    )
+    return cached_attention(
+        q, k_view, v_view, q_positions=q_positions, kv_mask=kv_mask,
+        window=window, softcap=softcap, scale=scale,
+    )

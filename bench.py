@@ -57,7 +57,13 @@ import numpy as np
 # drift verdict against a committed golden when one exists for this config
 # ("no-golden" otherwise) — so bench rounds are joinable to exact program
 # identity, not just to flag settings.
-BENCH_SCHEMA_VERSION = 8
+# v9 = serving lever (BENCH_SERVING=1): detail.serving on every line — the
+# serving decode wave's attribution (benchmarks/serving_decode_profile.py):
+# paged-vs-contiguous effective batch capacity (admitted tokens per KV slot)
+# at verified-identical outputs, chunked-vs-monolithic prefill max decode
+# stall, per-request TTFT/TPOT, and the op-level paged-gather overhead the
+# ROADMAP item 3 Pallas kernel will be measured against. Absent otherwise.
+BENCH_SCHEMA_VERSION = 9
 
 
 class BenchAuditFailure(RuntimeError):
@@ -588,6 +594,30 @@ def run_one(mode: str):
     # counts, and memory travel with the MFU headline.
     telemetry_summary = accelerator.telemetry.timeline.summary()
 
+    # Serving lever (schema v9): BENCH_SERVING=1 runs the serving decode
+    # attribution wave (its own fixed shapes — benchmarks/
+    # serving_decode_profile.py; BENCH_PROFILE_SMALL shrinks it) and embeds
+    # the summary, so the paged-capacity and chunked-stall ratios travel in
+    # the same trajectory as the training MFU headline.
+    serving_summary = None
+    if os.environ.get("BENCH_SERVING", "0") == "1":
+        bench_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "benchmarks")
+        sys.path.insert(0, bench_dir)
+        try:
+            import serving_decode_profile
+
+            serving_summary = serving_decode_profile.summarize()
+        except Exception as exc:  # the lever must never take the row down
+            serving_summary = {"error": f"{type(exc).__name__}: {exc}"[:300]}
+        finally:
+            # Remove by value: the imported module prepends the repo root to
+            # sys.path itself, so pop(0) would evict the wrong entry.
+            try:
+                sys.path.remove(bench_dir)
+            except ValueError:
+                pass
+
     print(
         json.dumps(
             {
@@ -642,6 +672,7 @@ def run_one(mode: str):
                     "audit": audit_summary,
                     "memory": memory_summary,
                     "fingerprint": fingerprint_summary,
+                    **({"serving": serving_summary} if serving_summary else {}),
                     # Profiling (telemetry/profiler.py): present only when a
                     # trace capture engaged during this config — the capture
                     # list with each parsed attribution report (compute /

@@ -260,6 +260,34 @@ def test_serving_decode_audits_without_callbacks():
     assert report.dp_allgathers == []
 
 
+def test_paged_serving_decode_audits_clean_with_pool_memory():
+    """The PAGED decode window audits clean too (no host callbacks, no
+    unclaimed dp collectives), its pool+state donation contract is visible,
+    and its _audit_meta memory join attributes the persistent KV pool —
+    the class `accelerate-tpu memcheck --serving` gates on."""
+    from accelerate_tpu.serving import ContinuousBatcher
+
+    AcceleratorState._reset_state(reset_partial_state=True)
+    GradientState._reset_state()
+    cfg = LlamaConfig.tiny(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_attention_heads=2, num_key_value_heads=2, num_hidden_layers=1,
+    )
+    model = Llama(cfg)
+    model.init_params(jax.random.key(0))
+    engine = ContinuousBatcher(
+        model, batch_slots=2, max_new_tokens=4, max_cache_len=64,
+        bucket_sizes=(8,), sync_every=2, paged=True, block_size=4,
+    )
+    report = engine.audit_decode()
+    assert report.builder == "serving_decode_paged"
+    assert report.host_callbacks == []
+    assert report.dp_allgathers == []
+    assert report.memory is not None
+    pool_bytes = report.memory.classes["kv_pool"].per_device_bytes
+    assert pool_bytes == engine.kv_cache_bytes + engine._pool["mask"].nbytes
+
+
 def test_bench_audit_failure_line_is_schemad(capsys):
     """bench.py fails a config's JSON line — schema'd, with the audit
     evidence attached — when the audited program has a dp-axis all-gather."""
@@ -275,7 +303,7 @@ def test_bench_audit_failure_line_is_schemad(capsys):
     )
     bench._print_failure("tiny", exc)
     line = json.loads(capsys.readouterr().out.strip())
-    assert line["schema_version"] == bench.BENCH_SCHEMA_VERSION == 8
+    assert line["schema_version"] == bench.BENCH_SCHEMA_VERSION == 9
     assert line["value"] == 0.0
     assert line["detail"]["audit"]["dp_allgathers"] == 2
     assert "dp mesh axis" in line["detail"]["error"]

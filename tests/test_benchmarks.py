@@ -75,3 +75,34 @@ def test_plan_step_time_benchmark_pp_not_slower_than_fsdp():
     rows = {r["plan"]: r["step_ms"]
             for r in map(json.loads, proc.stdout.strip().splitlines())}
     assert rows["pp2_dp4"] <= 1.6 * rows["fsdp2_dp4"], rows
+
+
+def test_serving_decode_profile_smoke():
+    """The serving attribution harness (paged vs contiguous wave, chunked vs
+    monolithic prefill, op-level gather seam) runs end-to-end in small mode,
+    emits parseable probe lines, and its parity join really verified
+    identical outputs across cache modes. Ratios are recorded, not asserted —
+    small-mode wall times are dispatch/compile-dominated; the numbers mean
+    something on a real chip (BENCH_SERVING=1)."""
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO_ROOT, "benchmarks", "serving_decode_profile.py")],
+        capture_output=True,
+        text=True,
+        timeout=420,
+        env={**os.environ, "BENCH_PROFILE_SMALL": "1"},
+        cwd=REPO_ROOT,
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    records = [json.loads(line) for line in proc.stdout.strip().splitlines()]
+    by_probe = {r["probe"]: r for r in records}
+    assert by_probe["headline"]["outputs_identical"] is True
+    assert by_probe["headline"]["effective_capacity_x"] >= 1.3
+    assert by_probe["wave_paged"]["consumed_kv_slots_peak"] < \
+        by_probe["wave_contiguous"]["consumed_kv_slots_peak"]
+    assert by_probe["prefill_chunked"]["prefill_dispatches"] > \
+        by_probe["prefill_monolithic"]["prefill_dispatches"]
+    assert by_probe["prefill_no_admit"]["prefill_dispatches"] == 1  # short only
+    assert len(by_probe["wave_paged"]["ttft_s"]) == 6
+    assert "max_decode_step_stall_s" in by_probe["prefill_chunked"]
+    assert "stall_ratio_chunked_vs_no_admit" in by_probe["headline"]

@@ -107,6 +107,7 @@ def test_complete_cv_example_step_checkpointing(tmp_path):
         # depth re-arms the example's h2d_blocking==0 assert as a load flake.
         ("by_feature/dispatch_amortized_training.py", ["--window", 4]),
         ("by_feature/elastic_training.py", []),
+        ("by_feature/paged_serving.py", ["--requests", 6]),
     ],
 )
 def test_by_feature_examples(script, args, tmp_path):

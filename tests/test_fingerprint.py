@@ -430,6 +430,10 @@ def test_goldens_committed_for_full_matrix():
     assert _golden("window4")["builder"] == "build_train_window"
     assert _golden("step_fsdp8")["mesh_axes"]["fsdp"] == 8
     assert _golden("decode")["builder"] == "serving_decode"
+    # The paged decode window is drift-gated separately: its golden pins the
+    # block-table gather program and the pool+state donation contract.
+    assert _golden("decode_paged")["builder"] == "serving_decode_paged"
+    assert _golden("decode_paged")["donation"]["expected_argnums"] == [1, 6]
 
 
 @pytest.mark.slow

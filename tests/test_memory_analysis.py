@@ -366,6 +366,31 @@ def test_memcheck_cli_exit_codes(tmp_path):
     assert "opt_state replicated on dp" in starved.stderr
 
 
+def test_memcheck_cli_serving_mode(tmp_path):
+    """`accelerate-tpu memcheck --serving` prices the paged decode window —
+    KV pool as a first-class class, gather-view workspace from the compiled
+    program — and gates it against the HBM budget: exit 0 on the shipped
+    tiny rig, exit 1 under a starved budget naming the pool bytes (the
+    OOM-before-launch discipline for the serving path, ROADMAP item 2)."""
+    env = {**os.environ, "PYTHONPATH": REPO}
+    base = [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli",
+            "memcheck", "--serving", "--summary"]
+    ok = subprocess.run(base, capture_output=True, text=True, env=env)
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    payload = json.loads(ok.stdout)
+    assert payload["fits"] is True
+    assert payload["kv_pool_bytes_per_device"] > 0
+    assert payload["per_device_bytes"]["kv_pool"] == payload["kv_pool_bytes_per_device"]
+    assert payload["pool"]["paged"] is True
+    assert payload["pool"]["num_blocks"] == 64
+    starved = subprocess.run(
+        base + ["--budget-gib", "0.0005"], capture_output=True, text=True, env=env,
+    )
+    assert starved.returncode == 1, starved.stdout + starved.stderr
+    assert "predicted serving OOM" in starved.stderr
+    assert "KV pool" in starved.stderr
+
+
 # ================================================================ lint gate
 def test_new_rules_hold_shipped_tree_at_zero_unbaselined():
     """The tier-1 gate for the two new rules: every raw-device-baseline

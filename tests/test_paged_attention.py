@@ -1,0 +1,103 @@
+"""Paged (block-table) attention op (``ops/paged_attention.py``): the
+reference gather lowering must be bit-identical to ``cached_attention`` over
+the equivalent contiguous layout — this parity IS the drop-in contract a
+future Pallas kernel must match (ROADMAP item 3), pinned here at the op level
+so the serving engine's end-to-end parity tests never have to localize an
+op-level drift."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from accelerate_tpu.ops.attention import cached_attention
+from accelerate_tpu.ops.paged_attention import (
+    gather_block_mask,
+    gather_block_view,
+    init_kv_pool,
+    paged_attention,
+)
+
+
+def _random_pool_and_contiguous(rng, *, b=3, m=4, bs=4, hkv=2, d=8, h=4):
+    """A pool whose chains, gathered, equal a dense contiguous cache: chain j
+    of slot s holds arbitrary K/V with a ragged valid length per slot."""
+    n = b * m + 1  # distinct blocks per slot + trash
+    k_pool = jnp.asarray(rng.standard_normal((n, bs, hkv, d)), jnp.float32)
+    v_pool = jnp.asarray(rng.standard_normal((n, bs, hkv, d)), jnp.float32)
+    # trash block 0 must never matter: poison it with huge values
+    k_pool = k_pool.at[0].set(1e6)
+    v_pool = v_pool.at[0].set(1e6)
+    tables = jnp.asarray(
+        1 + np.arange(b * m, dtype=np.int32).reshape(b, m)
+    )  # slot s owns blocks [1 + s*m, 1 + (s+1)*m)
+    lens = np.asarray([m * bs, m * bs - 3, 2 * bs - 1])[:b]
+    mask_np = np.zeros((n, bs), np.int32)
+    for s in range(b):
+        for j in range(int(lens[s])):
+            mask_np[int(tables[s, j // bs]), j % bs] = 1
+    pool_mask = jnp.asarray(mask_np)
+    q = jnp.asarray(rng.standard_normal((b, 1, h, d)), jnp.float32)
+    return k_pool, v_pool, tables, pool_mask, lens, q
+
+
+def test_gather_block_view_roundtrip():
+    """The gather materializes each slot's chain in table order, for both a
+    single layer and an L-stacked pool (the serving engine's layout)."""
+    rng = np.random.default_rng(0)
+    k_pool, _, tables, pool_mask, _, _ = _random_pool_and_contiguous(rng)
+    view = gather_block_view(k_pool, tables)
+    b, m, bs = tables.shape[0], tables.shape[1], k_pool.shape[1]
+    assert view.shape == (b, m * bs, k_pool.shape[2], k_pool.shape[3])
+    for s in range(b):
+        for j in range(m):
+            np.testing.assert_array_equal(
+                view[s, j * bs:(j + 1) * bs], k_pool[int(tables[s, j])]
+            )
+    stacked = jnp.stack([k_pool, 2 * k_pool])  # fake 2-layer pool
+    view2 = gather_block_view(stacked, tables)
+    np.testing.assert_array_equal(view2[0], view)
+    np.testing.assert_array_equal(view2[1], 2 * view)
+    vmask = gather_block_mask(pool_mask, tables)
+    assert vmask.shape == (b, m * bs)
+
+
+@pytest.mark.parametrize("window", [None, 3])
+def test_paged_attention_matches_cached_attention(window):
+    """paged_attention == cached_attention on the gathered-equivalent dense
+    layout, bit-for-bit — including sliding windows measured in valid-slot
+    distance across ragged chains. The trash block is poisoned, so equality
+    also proves masked garbage never leaks into the softmax."""
+    rng = np.random.default_rng(1)
+    k_pool, v_pool, tables, pool_mask, lens, q = _random_pool_and_contiguous(rng)
+    q_positions = jnp.asarray(lens, jnp.int32)[:, None]  # next slot per chain
+    out = paged_attention(
+        q, k_pool, v_pool, tables, q_positions=q_positions,
+        pool_mask=pool_mask, window=window,
+    )
+    dense_k = gather_block_view(k_pool, tables)
+    dense_v = gather_block_view(v_pool, tables)
+    kv_mask = gather_block_mask(pool_mask, tables)
+    ref = cached_attention(
+        q, dense_k, dense_v, q_positions=q_positions, kv_mask=kv_mask,
+        window=window,
+    )
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_init_kv_pool_probes_model_layout():
+    """The pool adopts the module's own cache layout (layers/kv-heads/dim)
+    and reserves block 0 as the all-invalid trash block."""
+    from accelerate_tpu.models import Llama, LlamaConfig
+
+    model = Llama(LlamaConfig.tiny(num_hidden_layers=2, num_attention_heads=4,
+                                   num_key_value_heads=2))
+    model.init_params(jax.random.key(0))
+    pool = init_kv_pool(model, num_blocks=6, block_size=4, dtype=jnp.float32)
+    cfg = model.config
+    assert pool["k"].shape == (2, 7, 4, cfg.num_key_value_heads, cfg.head_dim)
+    assert pool["v"].shape == pool["k"].shape
+    assert pool["mask"].shape == (7, 4)
+    assert int(np.asarray(pool["mask"]).sum()) == 0
