@@ -451,6 +451,7 @@ class Accelerator:
         self.flag_tensor = None
         self._train_window = None  # lazy: ACCELERATE_TRAIN_WINDOW, then 1
         self._zero_sharding = None  # lazy: ACCELERATE_ZERO_SHARDING, then off
+        self._kernels = None  # lazy: ACCELERATE_KERNELS, then reference
         self._resilience_step = 0
         # Bumped by every elastic reshard (resilience/elastic.py): fused
         # programs built before a transition compiled for a mesh that no
@@ -602,6 +603,35 @@ class Accelerator:
                 opt.zero_sharding = self._zero_sharding
 
     @property
+    def kernels(self) -> str:
+        """The Pallas kernel-layer backend spec (docs/kernels.md): a bare
+        token (``pallas`` / ``interpret`` / ``reference``) or a per-op map
+        (``paged_decode=pallas,int8_matmul=off``) resolved per op by
+        ``ops/registry.py`` at build/trace time. Default comes from the
+        launcher contract (``--kernels`` → ACCELERATE_KERNELS), else the
+        reference lowerings. Set before building — compiled programs bake
+        the resolved backend in (rebuild to switch, like train_window)."""
+        if self._kernels is None:
+            from .utils.constants import ENV_KERNELS
+
+            self._kernels = os.environ.get(ENV_KERNELS, "") or ""
+        return self._kernels
+
+    @kernels.setter
+    def kernels(self, value):
+        from .ops.registry import parse_kernel_spec
+
+        value = "" if value is None else str(value)
+        parse_kernel_spec(value)  # validate eagerly: a typo dies here
+        self._kernels = value
+        # Propagate to optimizers prepared BEFORE the flip whose imperative
+        # update hasn't been built yet (the zero_sharding precedent): once
+        # _update_fn exists, the resolved backend is compiled in.
+        for opt in self._optimizers:
+            if opt._update_fn is None:
+                opt.kernels = value
+
+    @property
     def fp8_backend(self):
         """Which low-precision backend serves ``mixed_precision='fp8'`` (reference
         ``fp8_backend`` property :3939-3952): "INT8" (QAT matmuls) or "BF16"
@@ -708,7 +738,7 @@ class Accelerator:
             elif kind == "optimizer":
                 prepared = AcceleratedOptimizer(
                     obj, scaler=self.scaler, host_offload=self._offload_opt_state,
-                    zero_sharding=self.zero_sharding,
+                    zero_sharding=self.zero_sharding, kernels=self.kernels,
                 )
                 prepared_opts.append(prepared)
                 self._optimizers.append(prepared)
@@ -916,7 +946,7 @@ class Accelerator:
     def prepare_optimizer(self, optimizer, device_placement=None):
         prepared = AcceleratedOptimizer(
             optimizer, scaler=self.scaler, host_offload=self._offload_opt_state,
-            zero_sharding=self.zero_sharding,
+            zero_sharding=self.zero_sharding, kernels=self.kernels,
         )
         if self._models:
             prepared.handle = self._models[-1].handle
@@ -1125,6 +1155,24 @@ class Accelerator:
         # auditor attributes the deliberate dp all-gather as ZeRO traffic.
         zero_specs = optimizer.zero_param_shardings
         base_specs = model.handle.param_shardings if zero_specs is not None else None
+        # Pallas fused-update kernel (ops/pallas/fused_update.py): when the
+        # registry resolves the `fused_update` op away from reference AND the
+        # optimizer matches a supported optax family (adam/adamw/sgd — the
+        # closure-introspected plan), the update region's per-leaf chain
+        # (clip-scale + moments + apply + cast + buffer zero) runs as ONE
+        # pallas pass per leaf. With ZeRO on it executes inside the
+        # zero_update-constrained region, i.e. on the 1/dp shard between the
+        # reduce-scatter and the param all-gather. An unsupported optimizer
+        # falls back to the reference chain silently — per-instance, the
+        # registry's clean-fallback contract.
+        from .ops.registry import resolve_backend
+
+        kernel_backend = resolve_backend("fused_update", self.kernels)
+        fused_plan = None
+        if kernel_backend != "reference":
+            from .ops.pallas.fused_update import plan_fused_update
+
+            fused_plan = plan_fused_update(tx)
 
         def step_body(params, opt_state, accum_grads, count, batch, rng, clip_norm):
             if zero_specs is not None:
@@ -1195,6 +1243,17 @@ class Accelerator:
                     (clip_norm > 0) & (gnorm > clip_norm),
                     clip_norm / (gnorm + 1e-6), 1.0,
                 )
+                if fused_plan is not None:
+                    from .ops.pallas.fused_update import fused_update_apply
+
+                    return fused_update_apply(
+                        params, opt_state, grads, plan=fused_plan,
+                        clip_factor=factor,
+                        interpret=(kernel_backend == "interpret"),
+                        # Under ZeRO the kernel covers the 1/dp shard: the
+                        # plan sizes its shard-local tile grid.
+                        shardings=zero_specs,
+                    )
                 grads = jax.tree_util.tree_map(lambda g: g * factor, grads)
                 updates, new_opt = tx.update(grads, opt_state, params)
                 new_params = optax.apply_updates(params, updates)
@@ -1519,10 +1578,24 @@ class Accelerator:
                     handle.params, handle.param_shardings, self.mesh
                 ),
             }
+        from .ops.registry import resolved_backends
+
+        kernels_meta = {"spec": self.kernels,
+                        "backends": resolved_backends(self.kernels)}
+        try:
+            from .ops.pallas.fused_update import plan_fused_update
+
+            plan = (plan_fused_update(optimizer.tx)
+                    if kernels_meta["backends"].get("fused_update") != "reference"
+                    else None)
+            kernels_meta["fused_update_plan"] = plan.describe() if plan else None
+        except Exception:
+            kernels_meta["fused_update_plan"] = None
         return {
             "builder": builder,
             "mesh": self.mesh,
             "compute_dtype": compute_dtype,
+            "kernels": kernels_meta,
             "expected_donations": tuple(intended_donate),
             "expected_donated_leaves": donated_leaves,
             "donation_dropped_by_policy": (
@@ -1580,9 +1653,12 @@ class Accelerator:
         # Feed the trace attributor's axis join: a later profile capture can
         # then attribute measured collective time to the NAMED mesh axes this
         # program's inventory established (telemetry/traceview.py).
-        from .telemetry.traceview import attach_collective_axes
+        from .telemetry.traceview import attach_collective_axes, attach_kernel_names
 
         attach_collective_axes(report)
+        # Same join for named Pallas kernels: captured custom-call time then
+        # attributes to the kernels this program's inventory established.
+        attach_kernel_names(report)
         if report.memory is not None:
             # Arm the timeline's predicted-vs-observed peak cross-check: the
             # next summary() compares this static prediction to the live

@@ -95,6 +95,30 @@ class CollectiveSite:
 
 
 @dataclass
+class KernelSite:
+    """One named custom kernel in the program — a ``pallas_call`` eqn in the
+    jaxpr (pre-partitioning; present in interpret and compiled modes alike)
+    and/or its compiled custom-call instruction (``tpu_custom_call`` on TPU —
+    interpret-mode lowerings inline to plain HLO, so ``compiled`` stays
+    False there). Named inventory is what keeps kernel-backed programs
+    inside the zero-sync/fingerprint discipline instead of becoming opaque
+    blobs (ROADMAP item 3)."""
+
+    name: str
+    count: int = 0            # pallas_call eqns in the jaxpr
+    compiled_calls: int = 0   # custom-call instructions in the compiled HLO
+    interpret: bool = False   # any eqn lowering via the Pallas interpreter
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "count": self.count,
+            "compiled_calls": self.compiled_calls,
+            "interpret": self.interpret,
+        }
+
+
+@dataclass
 class DonationMiss:
     """A buffer marked for donation that the compiled program does not alias
     (or that an expected-donation contract says should have been donated)."""
@@ -135,6 +159,8 @@ class AuditReport:
     # declared for this program — sites it claims carry ``zero=True``.
     zero_sharding: bool = False
     host_callbacks: list = field(default_factory=list)    # [str] descriptions
+    # Named custom kernels (Pallas): [KernelSite] — inventory, not a gate.
+    kernels: list = field(default_factory=list)
     dtype_upcasts: list = field(default_factory=list)     # [str] dot signatures
     dot_dtypes: dict = field(default_factory=dict)        # {"f32xf32": n, ...}
     large_intermediates: list = field(default_factory=list)  # [dict]
@@ -164,6 +190,10 @@ class AuditReport:
                 out.setdefault(axis, {})
                 out[axis][site.op] = out[axis].get(site.op, 0) + 1
         return out
+
+    def kernel_counts(self) -> dict:
+        """{kernel name: pallas_call count} — the named-kernel inventory."""
+        return {k.name: k.count for k in self.kernels}
 
     def zero_collective_counts(self) -> dict:
         """{op: count} over the ZeRO update's claimed dp traffic."""
@@ -222,6 +252,7 @@ class AuditReport:
                 "dropped_by_policy": self.donation_dropped_by_policy,
             },
             "host_callbacks": list(self.host_callbacks),
+            "kernels": [k.to_dict() for k in self.kernels],
             "dtype_upcasts": list(self.dtype_upcasts),
             "dot_dtypes": dict(self.dot_dtypes),
             "large_intermediates": list(self.large_intermediates),
@@ -240,6 +271,7 @@ class AuditReport:
             "donation_misses": len(self.donation_misses),
             "donation_dropped_by_policy": self.donation_dropped_by_policy,
             "collectives_by_axis": self.collectives_by_axis(),
+            "kernels": self.kernel_counts(),
             "dtype_upcasts": len(self.dtype_upcasts),
         }
 
@@ -493,6 +525,79 @@ def _parse_callbacks(hlo_text: str, stablehlo_text: str) -> list:
     return found
 
 
+# Compiled custom-call targets that are Mosaic/Pallas kernel invocations, not
+# host callbacks (the _CALLBACK_TARGETS regex requires a python_*_callback
+# spelling, so these never misclassify — this is the positive match).
+_KERNEL_TARGETS = re.compile(r"tpu_custom_call|mosaic|__gpu\$xla\.gpu\.triton")
+
+
+def _kernel_name_of_eqn(eqn) -> str:
+    """The kernel function's bare name from a pallas_call eqn's
+    name_and_src_info param (src location stripped — fingerprints must not
+    carry file:line churn)."""
+    info = eqn.params.get("name_and_src_info")
+    name = getattr(info, "name", None)
+    if not name:
+        name = str(info).split(" at ")[0] if info is not None else "pallas_kernel"
+    return name
+
+
+def _walk_jaxpr_kernels(jaxpr) -> list:
+    """Recursive jaxpr walk for ``pallas_call`` eqns → [(name, interpret)].
+    The jaxpr-level walk is the backend-independent inventory: interpret-mode
+    lowerings inline to plain HLO (no custom-call survives), but the eqn —
+    and with it the kernel's NAME — is present in every mode."""
+    found = []
+
+    def visit(jx):
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "pallas_call":
+                found.append(
+                    (_kernel_name_of_eqn(eqn), bool(eqn.params.get("interpret")))
+                )
+            for val in eqn.params.values():
+                for sub in _sub_jaxprs(val):
+                    visit(sub)
+
+    visit(jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr)
+    return found
+
+
+def _parse_kernel_custom_calls(hlo_text: str) -> list:
+    """Kernel custom-call instructions in the compiled module → [name]:
+    the op_name metadata carries the kernel's scope path when present, else
+    the raw custom-call target. Empty for interpret-mode lowerings."""
+    found = []
+    for line in hlo_text.splitlines():
+        if "custom-call" not in line:
+            continue
+        tgt = re.search(r'custom_call_target="([^"]+)"', line)
+        if not tgt or not _KERNEL_TARGETS.search(tgt.group(1)):
+            continue
+        src = re.search(r'op_name="([^"]*)"', line)
+        label = src.group(1) if src else tgt.group(1)
+        # op_name scope paths end in the kernel wrapper's name; keep the tail.
+        found.append(label.split("/")[-1])
+    return found
+
+
+def _kernel_inventory(jaxpr, hlo_text: str) -> list:
+    """Join the jaxpr pallas_call walk with the compiled custom-call census
+    into named :class:`KernelSite` rows."""
+    sites: dict = {}
+    if jaxpr is not None:
+        for name, interpret in _walk_jaxpr_kernels(jaxpr):
+            site = sites.setdefault(name, KernelSite(name=name))
+            site.count += 1
+            site.interpret = site.interpret or interpret
+    for label in _parse_kernel_custom_calls(hlo_text):
+        match = next((s for n, s in sites.items() if n in label), None)
+        if match is None:
+            match = sites.setdefault(label, KernelSite(name=label))
+        match.compiled_calls += 1
+    return [sites[n] for n in sorted(sites)]
+
+
 def _walk_jaxpr_callbacks(jaxpr) -> list:
     """Recursive jaxpr walk for callback primitives — catches host round-trips
     before partitioning (and independently of custom-call target spellings)."""
@@ -646,6 +751,7 @@ def audit_lowered(
             entry = f"jaxpr:{name}"
             if entry not in report.host_callbacks:
                 report.host_callbacks.append(entry)
+    report.kernels = _kernel_inventory(jaxpr, hlo_text)
 
     report.dot_dtypes, report.dtype_upcasts = _parse_dots(stablehlo_text, compute_dtype)
     report.large_intermediates = _parse_large_intermediates(

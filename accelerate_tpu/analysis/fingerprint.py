@@ -52,7 +52,9 @@ import os
 import re
 from dataclasses import dataclass, field
 
-FINGERPRINT_SCHEMA_VERSION = 1
+# v2: + kernels section (named pallas_call inventory + declared per-op
+# backends) — every golden regenerated with --update when it landed.
+FINGERPRINT_SCHEMA_VERSION = 2
 
 # Default goldens home, relative to the repo root (the directory holding the
 # accelerate_tpu package).
@@ -214,6 +216,12 @@ class ProgramFingerprint:
     zero: dict = field(default_factory=dict)          # {declared, collectives}
     donation: dict = field(default_factory=dict)      # {expected_argnums, expected_leaves, misses}
     host_callbacks: dict = field(default_factory=dict)  # {count, kinds}
+    # Named custom-kernel inventory: {"counts": {name: pallas_call count},
+    # "declared": {op: backend}} — the contract that a kernel-backed config's
+    # custom calls stay PRESENT (classify_drift books a silently vanished
+    # kernel as a violation: the program would have regressed to a reference
+    # lowering without any Python test noticing).
+    kernels: dict = field(default_factory=dict)
     dtype_flow: dict = field(default_factory=dict)    # {dots, reduces, flags}
     memory: dict = field(default_factory=dict)        # {class: byte attribution}
 
@@ -228,6 +236,7 @@ class ProgramFingerprint:
             "zero": dict(self.zero),
             "donation": dict(self.donation),
             "host_callbacks": dict(self.host_callbacks),
+            "kernels": dict(self.kernels),
             "dtype_flow": dict(self.dtype_flow),
             "memory": dict(self.memory),
         }
@@ -305,6 +314,13 @@ def fingerprint_from_audit(report, stablehlo_text: str, meta: dict | None = None
         host_callbacks={
             "count": len(report.host_callbacks),
             "kinds": sorted(set(report.host_callbacks)),
+        },
+        kernels={
+            "counts": dict(sorted(report.kernel_counts().items()))
+            if hasattr(report, "kernel_counts") else {},
+            "declared": dict(sorted(
+                ((meta.get("kernels") or {}).get("backends") or {}).items()
+            )),
         },
         dtype_flow=dtype_flow(stablehlo_text, meta.get("compute_dtype")),
         memory=_memory_section(meta, dict(report.mesh_axes)),
@@ -631,6 +647,43 @@ def classify_drift(golden: dict, current: dict) -> list:
                 golden=g_totals, current=c_totals,
                 detail=f"{cls} class size changed (model/optimizer shape)",
             ))
+
+    # --- named-kernel inventory -------------------------------------------
+    g_kern = golden.get("kernels", {}).get("counts", {})
+    c_kern = current.get("kernels", {}).get("counts", {})
+    for name in sorted(set(g_kern) - set(c_kern)):
+        # A kernel the golden pinned that no longer lowers: the program
+        # silently regressed to a reference lowering (or the kernel was
+        # renamed — either way the contract changed and must be reviewed).
+        entries.append(DriftEntry(
+            field=f"kernels.{name}", kind=VIOLATION,
+            golden=g_kern[name], current=None,
+            detail=f"named kernel custom-call {name!r} vanished — the "
+                   "kernel-backed program silently regressed to a reference "
+                   "lowering (regenerate with --update only if deliberate)",
+        ))
+    new_kernels = sorted(set(c_kern) - set(g_kern))
+    changed_counts = sorted(
+        n for n in set(g_kern) & set(c_kern) if g_kern[n] != c_kern[n]
+    )
+    if new_kernels or changed_counts:
+        keys = new_kernels + changed_counts
+        entries.append(DriftEntry(
+            field="kernels", kind=BENIGN,
+            golden={n: g_kern.get(n) for n in keys},
+            current={n: c_kern.get(n) for n in keys},
+            detail="kernel inventory changed (new kernels / call-count "
+                   "churn): " + ", ".join(keys),
+        ))
+    g_decl = golden.get("kernels", {}).get("declared", {})
+    c_decl = current.get("kernels", {}).get("declared", {})
+    if g_decl != c_decl:
+        entries.append(DriftEntry(
+            field="kernels.declared", kind=BENIGN,
+            golden=g_decl, current=c_decl,
+            detail="declared per-op kernel backends changed (config-level "
+                   "resolution, not program structure)",
+        ))
 
     # --- ZeRO contract ----------------------------------------------------
     g_zero = golden.get("zero", {})

@@ -176,9 +176,11 @@ def get_user_input() -> ClusterConfig:
     # flows through at launch; answering — even with the defaults 1/'off' —
     # is an explicit choice that scrubs stale inherited values.
     train_window, xla_preset, zero_sharding, tune_budget = None, "", None, None
+    kernels = None
     if _yesno(
         "Do you want to configure dispatch amortization (fused train windows, "
-        "XLA latency-hiding presets, ZeRO optimizer sharding, autotuner)?", False
+        "XLA latency-hiding presets, ZeRO optimizer sharding, Pallas kernels, "
+        "autotuner)?", False
     ):
         train_window = _ask(
             "  train window K (steps fused into one XLA program per dispatch; "
@@ -191,6 +193,12 @@ def get_user_input() -> ClusterConfig:
         zero_sharding = _yesno(
             "  ZeRO cross-replica sharding (optimizer state + weight update "
             "sharded over the dp axis; ~1/dp opt-state HBM per chip)?", False
+        )
+        kernels = _ask(
+            "  Pallas kernel layer (off = reference lowerings; pallas = "
+            "custom kernels for paged decode / fused optimizer update / "
+            "int8 matmul — Mosaic on TPU, interpreter elsewhere)",
+            "off", str, ["off", "pallas", "interpret", "reference"],
         )
         tune_budget = _ask(
             "  autotuner trial budget (max short-bench trials an "
@@ -257,6 +265,7 @@ def get_user_input() -> ClusterConfig:
         train_window=train_window,
         xla_preset=xla_preset,
         zero_sharding=zero_sharding,
+        kernels=kernels,
         tune_budget=tune_budget,
         profile_steps=profile_steps,
         profile_slow_zscore=profile_slow_zscore,

@@ -144,6 +144,12 @@ class ClusterConfig:
     # inherited ACCELERATE_ZERO_SHARDING flows; an explicit False reaches the
     # workers as a disable).
     zero_sharding: bool | None = None
+    # Pallas kernel layer (ops/registry.py; docs/kernels.md): the per-op
+    # backend spec exported as ACCELERATE_KERNELS. TRI-state per the
+    # xla_preset precedent — None = unspecified (an inherited env flows
+    # through), 'pallas'/'interpret'/a per-op map = explicit spec, an
+    # explicit 'off' scrubs a stale inherited value (reference lowerings).
+    kernels: str | None = None
     # Profiling (telemetry/profiler.py; docs/observability.md "Profiling"):
     # TRI-state per the telemetry precedent. ``profile_steps`` is the
     # explicit trace-capture range grammar ("10-12,50"; None = unspecified,

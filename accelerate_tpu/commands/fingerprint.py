@@ -45,9 +45,21 @@ _TRAIN_CONFIGS = {
     "window4_zero": (4, "adamw", True, None),
     "step_fsdp8": (1, "sgd", False, {"fsdp_size": 8}),
     "step_tp2_fsdp4": (1, "sgd", False, {"tp_size": 2, "fsdp_size": 4}),
+    # Kernel-backed ZeRO step (ops/pallas/fused_update.py engaged via
+    # ACCELERATE_KERNELS=interpret — the deterministic CPU-rig resolution of
+    # the pallas token): its golden pins the fused-update pallas_call
+    # inventory + the unchanged donation contract, so a silently vanished
+    # kernel classifies as a violation.
+    "step_zero_kernel": (1, "adamw", True, None),
 }
 
-CONFIG_NAMES = tuple(_TRAIN_CONFIGS) + ("decode", "decode_paged")
+# Configs extracted with the Pallas kernel layer pinned to interpret mode
+# (byte-stable on the CPU fingerprint rig; the compiled-Mosaic program is a
+# TPU-rig artifact the CPU goldens deliberately do not cover).
+_KERNEL_CONFIGS = ("step_zero_kernel", "decode_paged_kernel")
+
+CONFIG_NAMES = tuple(_TRAIN_CONFIGS) + ("decode", "decode_paged",
+                                        "decode_paged_kernel")
 
 
 def _reset_singletons():
@@ -121,11 +133,13 @@ def _decode_fingerprint(name: str = "decode"):
     model = Llama(cfg)
     model.init_params(jax.random.key(0))
     kwargs = {}
-    if name == "decode_paged":
+    if name in ("decode_paged", "decode_paged_kernel"):
         # The paged decode window: its committed golden pins the block-table
         # gather inventory and the pool+state donation contract, so the
         # ROADMAP item 3 kernel swap (or any regression in the gather
-        # lowering) classifies as deliberate drift, not silence.
+        # lowering) classifies as deliberate drift, not silence. The
+        # `_kernel` variant runs the Pallas chain-walk assembly
+        # (op `paged_gather`) and pins its pallas_call inventory instead.
         kwargs = dict(paged=True, block_size=4)
     engine = ContinuousBatcher(
         model, batch_slots=2, max_new_tokens=4, max_cache_len=64,
@@ -138,15 +152,35 @@ def _decode_fingerprint(name: str = "decode"):
 
 
 def extract_config(name: str):
-    """Build one matrix config and extract its fingerprint."""
-    if name in ("decode", "decode_paged"):
-        return _decode_fingerprint(name)
-    if name not in _TRAIN_CONFIGS:
-        raise SystemExit(
-            f"unknown fingerprint config {name!r}; choose from "
-            f"{', '.join(CONFIG_NAMES)}"
-        )
-    return _train_fingerprint(name)
+    """Build one matrix config and extract its fingerprint. The kernel layer
+    is pinned SYMMETRICALLY for every config (restored after): kernel-backed
+    configs build under ACCELERATE_KERNELS=interpret (the deterministic
+    CPU-rig resolution, so their goldens carry a stable pallas_call
+    inventory), and every other config builds with the env SCRUBBED — an
+    inherited fleet-wide kernel spec must not leak kernel-backed programs
+    into the reference goldens (an `--update` run under such an env would
+    otherwise corrupt 8/10 goldens and fail every clean-env `--check`)."""
+    from ..utils.constants import ENV_KERNELS
+
+    prev = os.environ.get(ENV_KERNELS)
+    if name in _KERNEL_CONFIGS:
+        os.environ[ENV_KERNELS] = "interpret"
+    else:
+        os.environ.pop(ENV_KERNELS, None)
+    try:
+        if name in ("decode", "decode_paged", "decode_paged_kernel"):
+            return _decode_fingerprint(name)
+        if name not in _TRAIN_CONFIGS:
+            raise SystemExit(
+                f"unknown fingerprint config {name!r}; choose from "
+                f"{', '.join(CONFIG_NAMES)}"
+            )
+        return _train_fingerprint(name)
+    finally:
+        if prev is None:
+            os.environ.pop(ENV_KERNELS, None)
+        else:
+            os.environ[ENV_KERNELS] = prev
 
 
 def run_fingerprints(configs, goldens_dir: str, update: bool = False):
@@ -268,6 +302,16 @@ def fingerprint_command(args) -> None:
             if name == "decode_paged":
                 print(f"{name}: paged ContinuousBatcher decode window "
                       "(block-table gather + pool scatter)")
+                continue
+            if name == "decode_paged_kernel":
+                print(f"{name}: paged decode window with the Pallas "
+                      "chain-walk kernels engaged (ACCELERATE_KERNELS="
+                      "interpret; pins the pallas_call inventory)")
+                continue
+            if name == "step_zero_kernel":
+                print(f"{name}: window=1 optimizer=adamw zero=on mesh=dp8 "
+                      "with the fused-update Pallas kernel engaged "
+                      "(ACCELERATE_KERNELS=interpret)")
                 continue
             window, optimizer, zero, parallelism = _TRAIN_CONFIGS[name]
             plan = ",".join(f"{k}={v}" for k, v in (parallelism or {}).items()) or "dp8"

@@ -37,6 +37,7 @@ from ..utils.constants import (
     ENV_METRICS_PORT,
     ENV_MIN_DATA_PARALLEL,
     ENV_MIXED_PRECISION,
+    ENV_KERNELS,
     ENV_NUM_PROCESSES,
     ENV_PROCESS_ID,
     ENV_PROFILE_SLOW_ZSCORE,
@@ -195,6 +196,17 @@ def launch_command_parser(subparsers=None) -> argparse.ArgumentParser:
              "--replicated-opt-gib` (docs/performance.md).",
     )
     parser.add_argument(
+        "--kernels", default=None,
+        help="Pallas kernel-layer backend spec (ACCELERATE_KERNELS; "
+             "docs/kernels.md): 'pallas' (compiled Mosaic on TPU, "
+             "interpreter elsewhere), 'interpret' (force the interpreter — "
+             "CPU parity testing), 'reference'/'off' (the always-available "
+             "reference lowerings; an explicit off scrubs an inherited "
+             "value), or a per-op map like "
+             "'paged_decode=pallas,int8_matmul=off'. Resolved per op at "
+             "build time by ops/registry.py.",
+    )
+    parser.add_argument(
         "--profile_steps", default=None,
         help="Capture an XLA trace over these training steps "
              "(ACCELERATE_PROFILE_STEPS): comma-separated 1-based inclusive "
@@ -273,6 +285,7 @@ def _merge_config(args) -> ClusterConfig:
         ("train_window", "train_window"),
         ("xla_preset", "xla_preset"),
         ("zero_sharding", "zero_sharding"),
+        ("kernels", "kernels"),
         ("profile_steps", "profile_steps"),
         ("profile_slow_zscore", "profile_slow_zscore"),
         ("tune_budget", "tune_budget"),
@@ -368,6 +381,15 @@ def prepare_launch_env(cfg: ClusterConfig, process_id: int | None = None, attemp
     # --no-zero_sharding reaches the workers as a disable.
     if cfg.zero_sharding is not None:
         env[ENV_ZERO_SHARDING] = "1" if cfg.zero_sharding else "0"
+    # Pallas kernel layer: tri-state per the xla_preset precedent — None =
+    # unspecified (an inherited ACCELERATE_KERNELS flows through), an
+    # explicit spec reaches the workers, and an explicit 'off'/'reference'
+    # scrubs a stale inherited value (workers then run the reference
+    # lowerings, the library default).
+    if cfg.kernels and cfg.kernels.strip().lower() not in ("off", "none", "reference"):
+        env[ENV_KERNELS] = cfg.kernels.strip()
+    elif cfg.kernels is not None:
+        env.pop(ENV_KERNELS, None)
     # Profiling (telemetry/profiler.py): tri-state per the telemetry
     # precedent — None exports nothing (an inherited env flows through), an
     # explicit value reaches the workers, and an explicit disable
@@ -556,6 +578,14 @@ def launch_command(args) -> None:
         from ..utils.xla_flags import normalize_preset_name
 
         normalize_preset_name(cfg.xla_preset)
+    if cfg.kernels:
+        # Same discipline for the kernel spec: parse_kernel_spec's error
+        # enumerates the valid backend tokens (the message the registry
+        # would raise at first build inside a worker).
+        from ..ops.registry import parse_kernel_spec
+
+        if cfg.kernels.strip().lower() not in ("off", "none", "reference"):
+            parse_kernel_spec(cfg.kernels)
     if cfg.max_restarts > 0 and cfg.num_machines > 1:
         raise ValueError(
             "--max_restarts only applies to single-machine jobs: on a pod, a "

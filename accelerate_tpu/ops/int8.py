@@ -46,12 +46,17 @@ def int8_matmul(x, w):
 
     x: (..., K); w: (K, N). Forward runs int8×int8→int32 on the MXU with
     per-row (x) / per-column (w) rescale; backward is straight-through in the
-    original precision.
-    """
-    return _int8_matmul_fwd_value(x, w)
+    original precision. The forward dispatches through the kernel registry
+    (op ``int8_matmul``): the fused Pallas quantize+contract+rescale kernel
+    (``ops/pallas/int8_mm.py``) when ``ACCELERATE_KERNELS`` selects it, the
+    reference lowering below otherwise — bit-identical either way
+    (tests/test_kernels.py pins the parity)."""
+    return _dispatch_fwd_value(x, w)
 
 
 def _int8_matmul_fwd_value(x, w):
+    """The committed reference lowering — the parity seam the Pallas kernel
+    must match bit-for-bit."""
     qx, sx = quantize_rowwise(x, axis=-1)  # per-row of x
     qw, sw = quantize_rowwise(w, axis=0)  # per-column of w
     acc = jax.lax.dot_general(
@@ -63,8 +68,16 @@ def _int8_matmul_fwd_value(x, w):
     return out.astype(x.dtype)
 
 
+def _dispatch_fwd_value(x, w):
+    from .registry import dispatch, resolve_backend
+
+    if resolve_backend("int8_matmul") == "reference":
+        return _int8_matmul_fwd_value(x, w)
+    return dispatch("int8_matmul", x, w)
+
+
 def _int8_matmul_fwd(x, w):
-    return _int8_matmul_fwd_value(x, w), (x, w)
+    return _dispatch_fwd_value(x, w), (x, w)
 
 
 def _int8_matmul_bwd(res, g):

@@ -19,12 +19,16 @@ This module is the op-level seam:
   every model family — rope, learned wpe, sliding windows, softcap — stays
   bit-exact with zero model changes).
 - :func:`paged_attention` is the fused op face: one call from query chunk +
-  pools + block tables to attention output. Today it composes the reference
-  gather with :func:`~.attention.cached_attention`; ROADMAP item 3's Pallas
-  splash/ragged kernel slots in behind this exact signature (the gather over
-  block tables is the slow path the kernel exists to kill — see
-  ``benchmarks/serving_decode_profile.py`` for the op-level attribution
-  harness that will measure the swap).
+  pools + block tables to attention output. The **reference lowering**
+  (:func:`paged_attention_reference`) composes the gather with
+  :func:`~.attention.cached_attention`; the ROADMAP item 3 Pallas
+  ragged-decode kernel (``ops/pallas/paged_decode.py``) sits behind this
+  exact signature via the kernel registry (``ops/registry.py``,
+  ``ACCELERATE_KERNELS``) — it walks each slot's block chain in-kernel with
+  no materialized gather view and skips padded slots, matching the
+  reference bit-for-bit on active slots (tests/test_kernels.py pins it; see
+  ``benchmarks/kernel_profile.py`` for the op-level attribution harness
+  that measures the swap).
 
 Block-size note for that kernel: TPU VMEM tiles are (sublane × 128-lane) with
 an 8/16/32-row sublane minimum by dtype, so ``block_size`` should stay a
@@ -72,16 +76,37 @@ def init_kv_pool(module, num_blocks: int, block_size: int, dtype=jnp.bfloat16):
     }
 
 
-def gather_block_view(pool_kv, block_tables):
+def gather_block_view(pool_kv, block_tables, *, active=None):
     """Materialize per-slot contiguous KV views from the pool.
 
     ``pool_kv``: ``(..., N, bs, H, D)`` (a single layer or the L-stacked
     pool); ``block_tables``: ``(B, M)`` int32 block ids. Returns
     ``(..., B, M*bs, H, D)`` — slot ``b``'s chain left-packed in table order.
-    This is the reference XLA-gather lowering of paged attention."""
+    This is the reference XLA-gather lowering of paged attention.
+
+    ``active`` (per-slot flags) is accepted for signature parity with the
+    chain-walk kernel (``ops/pallas/paged_decode.gather_block_view_kernel``,
+    which skips inactive slots); the reference gathers every slot — inactive
+    rows are masked garbage either way, and only the kernel bothers to skip
+    them. Use :func:`gather_view` for registry-dispatched assembly."""
+    del active  # reference computes all slots; masks make the garbage inert
     m = block_tables.shape[-1]
     view = jnp.take(pool_kv, block_tables, axis=-4)  # (..., B, M, bs, H, D)
     return view.reshape(view.shape[:-4] + (m * view.shape[-3],) + view.shape[-2:])
+
+
+def gather_view(pool_kv, block_tables, *, active=None, backend=None):
+    """Registry-dispatched view assembly (op ``paged_gather``): the Pallas
+    chain-walk kernel when ``ACCELERATE_KERNELS`` (or ``backend``) selects
+    it, the XLA-gather reference otherwise. Bit-identical for active slots
+    (pure data movement); the kernel skips ``active == 0`` slots."""
+    from .registry import dispatch, resolve_backend
+
+    if resolve_backend("paged_gather", backend) == "reference":
+        return gather_block_view(pool_kv, block_tables, active=active)
+    return dispatch(
+        "paged_gather", pool_kv, block_tables, active=active, backend=backend
+    )
 
 
 def gather_block_mask(pool_mask, block_tables):
@@ -91,20 +116,18 @@ def gather_block_mask(pool_mask, block_tables):
     return jnp.take(pool_mask, block_tables, axis=0).reshape(b, m * pool_mask.shape[1])
 
 
-def paged_attention(q, k_pool, v_pool, block_tables, *, q_positions,
-                    pool_mask=None, window=None, softcap=None, scale=None):
-    """Attention of a query chunk against block-table-addressed KV pools.
-
-    q: ``(B, S, H, D)``; k_pool/v_pool: ``(N, bs, Hkv, D)`` (one layer);
-    block_tables: ``(B, M)``; q_positions: ``(S,)`` or ``(B, S)`` positions in
-    each slot's *chain-slot* index space (chain slot ``j`` of slot ``b`` is
-    view column ``j``); pool_mask: ``(N, bs)`` per-token validity.
-
-    Reference lowering: gather each slot's chain to a contiguous view, then
-    run the hole-tolerant :func:`~.attention.cached_attention` (causality on
-    chain-slot order, validity from the gathered mask, sliding windows in
-    valid-slot distance). A Pallas kernel replacing this signature must match
-    it bit-for-bit on the test vectors in tests/test_paged_attention.py."""
+def paged_attention_reference(q, k_pool, v_pool, block_tables, *, q_positions,
+                              pool_mask=None, window=None, softcap=None,
+                              scale=None, active=None):
+    """The reference lowering: gather each slot's chain to a contiguous view,
+    then run the hole-tolerant :func:`~.attention.cached_attention`
+    (causality on chain-slot order, validity from the gathered mask, sliding
+    windows in valid-slot distance). This is the committed parity seam — the
+    Pallas kernel must match it bit-for-bit on active slots on the test
+    vectors in tests/test_paged_attention.py and tests/test_kernels.py.
+    ``active`` is accepted for kernel-signature parity and ignored (the
+    reference computes masked garbage for inactive slots)."""
+    del active
     k_view = gather_block_view(k_pool, block_tables)
     v_view = gather_block_view(v_pool, block_tables)
     kv_mask = (
@@ -113,4 +136,35 @@ def paged_attention(q, k_pool, v_pool, block_tables, *, q_positions,
     return cached_attention(
         q, k_view, v_view, q_positions=q_positions, kv_mask=kv_mask,
         window=window, softcap=softcap, scale=scale,
+    )
+
+
+def paged_attention(q, k_pool, v_pool, block_tables, *, q_positions,
+                    pool_mask=None, window=None, softcap=None, scale=None,
+                    active=None, backend=None):
+    """Attention of a query chunk against block-table-addressed KV pools.
+
+    q: ``(B, S, H, D)``; k_pool/v_pool: ``(N, bs, Hkv, D)`` (one layer);
+    block_tables: ``(B, M)``; q_positions: ``(S,)`` or ``(B, S)`` positions in
+    each slot's *chain-slot* index space (chain slot ``j`` of slot ``b`` is
+    view column ``j``); pool_mask: ``(N, bs)`` per-token validity;
+    ``active``: optional per-slot flags — the Pallas backend skips inactive
+    (bucket-padded) slots entirely and returns zeros for them.
+
+    Dispatches through the kernel registry (op ``paged_decode``): the Pallas
+    ragged kernel walks each slot's block chain in VMEM with no materialized
+    gather view when ``ACCELERATE_KERNELS`` (or ``backend``) selects it; the
+    reference gather+``cached_attention`` composition otherwise."""
+    from .registry import dispatch, resolve_backend
+
+    if resolve_backend("paged_decode", backend) == "reference":
+        return paged_attention_reference(
+            q, k_pool, v_pool, block_tables, q_positions=q_positions,
+            pool_mask=pool_mask, window=window, softcap=softcap, scale=scale,
+            active=active,
+        )
+    return dispatch(
+        "paged_decode", q, k_pool, v_pool, block_tables,
+        q_positions=q_positions, pool_mask=pool_mask, window=window,
+        softcap=softcap, scale=scale, active=active, backend=backend,
     )

@@ -1,0 +1,24 @@
+"""Pallas TPU kernels for the remaining hot ops (ROADMAP item 3).
+
+Each module implements one kernel behind the exact signature of its committed
+reference seam and self-registers with :mod:`..registry` on import:
+
+- :mod:`.paged_decode` — ``paged_decode`` (ragged decode attention walking
+  each slot's block chain in-kernel, no materialized gather view) and
+  ``paged_gather`` (the chain-walk view assembly the serving engine's
+  uniform-write-window design consumes);
+- :mod:`.fused_update` — ``fused_update`` (grad-clip scale + optax
+  adam/adamw/sgd moment math + param apply + dtype cast in ONE pass over
+  each leaf, the 1/dp ZeRO-shard body of ``_fused_step_body``);
+- :mod:`.int8_mm` — ``int8_matmul`` (absmax-symmetric dynamic quantization +
+  int8×int8→int32 MXU contraction + rescale, backing ``ops/int8.py``).
+
+Bit-exactness is the contract: every kernel matches its reference lowering
+bit-for-bit in interpret mode on the committed test vectors
+(tests/test_kernels.py) — which is what lets ``ACCELERATE_KERNELS=pallas``
+ship without a numerics review per model family. See docs/kernels.md.
+"""
+
+from . import paged_decode  # noqa: F401  (self-registers paged_decode/paged_gather)
+from . import fused_update  # noqa: F401  (self-registers fused_update)
+from . import int8_mm  # noqa: F401  (self-registers int8_matmul)

@@ -1,0 +1,236 @@
+"""Paged/ragged decode-attention Pallas kernels — block chains, no gather view.
+
+The reference lowering (``ops/paged_attention.paged_attention_reference``)
+materializes every slot's chain as a contiguous HBM view via an XLA gather
+over the block tables, then runs ``cached_attention`` on the view — the
+explicitly-named slow path (ROADMAP item 3): the gather re-materializes the
+whole chain's KV every decode window, and bucket-padded slots pay full price
+for garbage.
+
+Two kernels kill it:
+
+- :func:`paged_attention_kernel` — the fused op seam. Grid over batch slots;
+  each program walks ITS slot's block chain with per-block async DMA
+  (HBM → VMEM scratch), assembles the chain in VMEM only, and computes the
+  attention math there. No (B, T, H, D) gather view ever exists in HBM.
+  Padded slots (``active == 0``) skip both the DMA walk and the compute.
+- :func:`gather_block_view_kernel` — the chain-walk *assembly* kernel behind
+  ``gather_block_view``: per-(layer, slot) DMA of pool blocks straight into
+  the output view, skipping dead slots. This is the swap the serving
+  engine's uniform-write-window design consumes today (the view feeds the
+  unmodified model forward); the fused kernel above is the no-view seam the
+  model-side paged-cache integration targets.
+
+Bit-exactness: inside the attention kernel the assembled chain is fed to the
+SAME ``cached_attention`` math the reference composes (a pure-jnp function —
+Pallas traces it into the kernel body), so active-slot outputs are
+bit-identical to the reference by construction, not by maintenance. Padded
+slots return zeros (the reference computes masked garbage there; the engine
+never reads either). Sliding windows, softcap, and GQA ride through
+unchanged because the math is shared.
+
+TPU layout note (module docstring of ops/paged_attention.py): ``block_size``
+should stay a multiple of 16 (bf16 sublane) so block DMAs stream without
+repacking; the engine default is 16. Compiled-Mosaic lowering of the
+windowed (valid-slot cumsum) path gathers along the chain axis in-kernel —
+validated in interpret mode everywhere, on-chip validation rides the
+BENCH_KERNELS round.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..attention import cached_attention
+from ..registry import register_op
+
+
+def _norm_positions(q_positions, batch: int):
+    pos = jnp.asarray(q_positions)
+    if pos.ndim == 1:
+        pos = jnp.broadcast_to(pos[None], (batch, pos.shape[0]))
+    return pos
+
+
+def _norm_active(active, batch: int):
+    if active is None:
+        return jnp.ones((batch,), jnp.int32)
+    return jnp.asarray(active).astype(jnp.int32).reshape(batch)
+
+
+def paged_attention_kernel(q, k_pool, v_pool, block_tables, *, q_positions,
+                           pool_mask=None, window=None, softcap=None,
+                           scale=None, active=None, interpret: bool = False):
+    """Fused paged decode attention: q + pools + block tables → attention out.
+
+    Signature-compatible with ``paged_attention_reference`` plus ``active``:
+    a per-slot int/bool vector — slots with ``active == 0`` (bucket padding,
+    drained slots) skip the chain walk entirely and return zeros. Shapes:
+    q ``(B, S, H, D)``; pools ``(N, bs, Hkv, D)``; tables ``(B, M)``;
+    q_positions ``(S,)`` or ``(B, S)``; pool_mask ``(N, bs)``.
+    """
+    B, S, H, D = q.shape
+    N, bs, Hkv, _ = k_pool.shape
+    M = block_tables.shape[-1]
+    T = M * bs
+    pos = _norm_positions(q_positions, B)
+    act = _norm_active(active, B)
+    tables = jnp.asarray(block_tables).astype(jnp.int32)
+    has_mask = pool_mask is not None
+    out_dtype = jnp.result_type(q.dtype, v_pool.dtype)
+
+    def body(tbl_ref, act_ref, q_ref, pos_ref, k_ref, v_ref, *rest):
+        if has_mask:
+            m_ref, o_ref, k_scr, v_scr, m_scr, sems = rest
+        else:
+            o_ref, k_scr, v_scr, sems = rest
+            m_ref = m_scr = None
+        b = pl.program_id(0)
+
+        @pl.when(act_ref[b] != 0)
+        def _():
+            # Walk the slot's chain: per-block DMA from the HBM pools into
+            # VMEM scratch. Copies for one chain slot start together (k, v,
+            # mask overlap each other); the chain itself is short (M blocks).
+            for j in range(M):
+                idx = tbl_ref[b, j]
+                copies = [
+                    pltpu.make_async_copy(k_ref.at[idx], k_scr.at[j], sems.at[0]),
+                    pltpu.make_async_copy(v_ref.at[idx], v_scr.at[j], sems.at[1]),
+                ]
+                if has_mask:
+                    copies.append(
+                        pltpu.make_async_copy(m_ref.at[idx], m_scr.at[j], sems.at[2])
+                    )
+                for c in copies:
+                    c.start()
+                for c in copies:
+                    c.wait()
+            k_view = k_scr[:].reshape(T, Hkv, D)
+            v_view = v_scr[:].reshape(T, Hkv, D)
+            kv_mask = m_scr[:].reshape(1, T) if has_mask else None
+            # The reference's exact math on the assembled chain: per-slot
+            # attention is independent across B, so the single-slot call is
+            # bit-identical to the batched reference row.
+            out = cached_attention(
+                q_ref[:], k_view[None], v_view[None],
+                q_positions=pos_ref[:], kv_mask=kv_mask,
+                window=window, softcap=softcap, scale=scale,
+            )
+            o_ref[:] = out.astype(o_ref.dtype)
+
+        @pl.when(act_ref[b] == 0)
+        def _():
+            o_ref[:] = jnp.zeros_like(o_ref)
+
+    in_specs = [
+        pl.BlockSpec((1, S, H, D), lambda b, tbl, act: (b, 0, 0, 0)),
+        pl.BlockSpec((1, S), lambda b, tbl, act: (b, 0)),
+        pl.BlockSpec(memory_space=pltpu.ANY),
+        pl.BlockSpec(memory_space=pltpu.ANY),
+    ]
+    scratch = [
+        pltpu.VMEM((M, bs, Hkv, D), k_pool.dtype),
+        pltpu.VMEM((M, bs, Hkv, D), v_pool.dtype),
+    ]
+    operands = [q, pos]
+    n_sems = 2
+    if has_mask:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.ANY))
+        scratch.append(pltpu.VMEM((M, bs), jnp.asarray(pool_mask).dtype))
+        n_sems = 3
+        operands = [q, pos, k_pool, v_pool, jnp.asarray(pool_mask)]
+    else:
+        operands = [q, pos, k_pool, v_pool]
+    scratch.append(pltpu.SemaphoreType.DMA((n_sems,)))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, S, H, D), lambda b, tbl, act: (b, 0, 0, 0)),
+        scratch_shapes=scratch,
+    )
+    return pl.pallas_call(
+        body,
+        out_shape=jax.ShapeDtypeStruct((B, S, H, D), out_dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+        name="paged_decode_kernel",
+    )(tables, act, *operands)
+
+
+def gather_block_view_kernel(pool_kv, block_tables, *, active=None,
+                             interpret: bool = False):
+    """Chain-walk view assembly: pool + tables → per-slot contiguous views.
+
+    Bit-identical to ``gather_block_view``'s XLA gather for every slot whose
+    ``active`` flag is set (pure data movement), zeros for skipped slots.
+    ``pool_kv`` is ``(L, N, bs, H, D)`` (the engine's L-stacked pool) or
+    ``(N, bs, H, D)`` (a single layer); output matches the reference shape
+    ``(..., B, M*bs, H, D)``."""
+    squeeze = pool_kv.ndim == 4
+    if squeeze:
+        pool_kv = pool_kv[None]
+    L, N, bs, Hkv, D = pool_kv.shape
+    B, M = block_tables.shape
+    T = M * bs
+    act = _norm_active(active, B)
+    tables = jnp.asarray(block_tables).astype(jnp.int32)
+
+    def body(tbl_ref, act_ref, pool_ref, o_ref, sem):
+        l = pl.program_id(0)
+        b = pl.program_id(1)
+
+        @pl.when(act_ref[b] != 0)
+        def _():
+            for j in range(M):
+                idx = tbl_ref[b, j]
+                dma = pltpu.make_async_copy(
+                    pool_ref.at[l, idx],
+                    o_ref.at[0, 0, pl.ds(j * bs, bs)],
+                    sem,
+                )
+                dma.start()
+                dma.wait()
+
+        @pl.when(act_ref[b] == 0)
+        def _():
+            o_ref[:] = jnp.zeros_like(o_ref)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(L, B),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec(
+            (1, 1, T, Hkv, D), lambda l, b, tbl, act: (l, b, 0, 0, 0)
+        ),
+        scratch_shapes=[pltpu.SemaphoreType.DMA],
+    )
+    out = pl.pallas_call(
+        body,
+        out_shape=jax.ShapeDtypeStruct((L, B, T, Hkv, D), pool_kv.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+        name="paged_gather_kernel",
+    )(tables, act, pool_kv)
+    return out[0] if squeeze else out
+
+
+def _register():
+    from ..paged_attention import gather_block_view, paged_attention_reference
+
+    register_op(
+        "paged_decode", paged_attention_reference, paged_attention_kernel,
+        doc="ragged decode attention over block-table chains (no gather view)",
+    )
+    register_op(
+        "paged_gather", gather_block_view, gather_block_view_kernel,
+        doc="chain-walk assembly of per-slot KV views (skips padded slots)",
+    )
+
+
+_register()

@@ -114,7 +114,7 @@ class AcceleratedOptimizer:
 
     def __init__(self, tx, handle=None, scaler: GradScalerState | None = None,
                  host_offload: bool = False, zero_sharding: bool = False,
-                 zero_rules=None):
+                 zero_rules=None, kernels: str | None = None):
         import optax
 
         if not isinstance(tx, optax.GradientTransformation):
@@ -134,6 +134,10 @@ class AcceleratedOptimizer:
         # GSPMD inserts (and the xla_flags presets overlap) the collectives.
         self.zero_sharding = bool(zero_sharding)
         self._zero_rules = zero_rules
+        # Pallas kernel-layer spec for the imperative update path (None = the
+        # ACCELERATE_KERNELS env contract, resolved at _build_update_fn time;
+        # Accelerator.prepare passes its own spec through).
+        self.kernels = kernels
         # The per-param update-path shardings (pytree congruent with params);
         # None while inactive (zero off, dp==1, or nothing partitionable).
         self.zero_param_shardings = None
@@ -253,6 +257,17 @@ class AcceleratedOptimizer:
         # deliberate dp all-gather as ZeRO traffic, not a zero-sync violation.
         zero_specs = self.zero_param_shardings
         gather_specs = self.handle.param_shardings if zero_specs is not None else None
+        # Pallas fused-update kernel (ops/pallas/fused_update.py) on the
+        # imperative path too: same registry resolution + optax-family plan
+        # as the fused builders (_fused_step_body), same reference fallback.
+        from .ops.registry import resolve_backend
+
+        kernel_backend = resolve_backend("fused_update", self.kernels)
+        fused_plan = None
+        if kernel_backend != "reference":
+            from .ops.pallas.fused_update import plan_fused_update
+
+            fused_plan = plan_fused_update(tx)
 
         @partial(jax.jit, donate_argnums=safe_donate_argnums((0, 1, 2)))
         def _update(params, opt_state, grads, max_clip_norm, inv_scale):
@@ -278,8 +293,25 @@ class AcceleratedOptimizer:
             finite = jnp.isfinite(gnorm)
 
             def do_step(_):
-                updates, new_opt = tx.update(grads, opt_state, params_u)
-                new_params = optax.apply_updates(params_u, updates)
+                if fused_plan is not None:
+                    from .ops.pallas.fused_update import fused_update_apply
+
+                    # The clip factor is already applied to `grads` above (the
+                    # imperative path scales before the cond so gnorm reads
+                    # the scaled values); factor 1.0 keeps the kernel's fused
+                    # pre-scale a no-op — same chain, same order. No
+                    # zero_buffer: this path has no accumulation buffer to
+                    # reset, and an unused pallas output would still cost a
+                    # grads-sized HBM write on the compiled path.
+                    new_params, new_opt, _ = fused_update_apply(
+                        params_u, opt_state, grads, plan=fused_plan,
+                        clip_factor=jnp.float32(1.0),
+                        interpret=(kernel_backend == "interpret"),
+                        shardings=zero_specs, zero_buffer=False,
+                    )
+                else:
+                    updates, new_opt = tx.update(grads, opt_state, params_u)
+                    new_params = optax.apply_updates(params_u, updates)
                 if gather_specs is not None:
                     with jax.named_scope("zero_gather_params"):
                         new_params = jax.lax.with_sharding_constraint(

@@ -384,6 +384,29 @@ def transfer_to_mesh(tree, mesh: Mesh):
     return jax.tree_util.tree_map(_one, tree)
 
 
+def local_leaf_shape(shape, sharding) -> tuple:
+    """Per-device shape of a global array under ``sharding``: each dim is
+    divided by the product of the mesh-axis sizes its spec entry names
+    (replicated/None dims pass through; uneven dims round up, matching
+    GSPMD's padded-shard convention). The kernel layer sizes its shard-local
+    tile grids from this — under the ZeRO plan, the fused-update kernel's
+    per-leaf pass covers the 1/dp shard, not the global leaf
+    (ops/pallas/fused_update.py; docs/kernels.md)."""
+    spec = tuple(getattr(sharding, "spec", None) or ())
+    mesh = getattr(sharding, "mesh", None)
+    if mesh is None or not spec:
+        return tuple(shape)
+    sizes = dict(zip(tuple(mesh.axis_names), tuple(mesh.devices.shape)))
+    dims = []
+    for dim, axes in zip(shape, spec + (None,) * (len(shape) - len(spec))):
+        div = 1
+        for ax in (axes if isinstance(axes, (tuple, list)) else (axes,)):
+            if ax is not None:
+                div *= int(sizes.get(ax, 1))
+        dims.append(-(-int(dim) // div))
+    return tuple(dims)
+
+
 def data_parallel_degree(mesh: Mesh) -> int:
     """How many ways the batch axis is split: the product of the data axes.
     One definition — batch sharding, window sharding, and per-process batch

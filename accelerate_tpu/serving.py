@@ -108,7 +108,7 @@ import jax
 import jax.numpy as jnp
 
 from .generation import _unwrap, left_align, mask_positions
-from .ops.paged_attention import gather_block_mask, gather_block_view, init_kv_pool
+from .ops.paged_attention import gather_block_mask, gather_view, init_kv_pool
 from .utils.environment import safe_donate_argnums
 from .utils.transfer import host_fetch
 
@@ -262,6 +262,7 @@ class ContinuousBatcher:
         prefill_chunk: int | None = None,
         max_tokens_per_request: int | None = None,
         slo: SLOTargets | None = None,
+        kernels: str | None = None,
     ):
         module, mparams = _unwrap(model)
         self.module = module
@@ -345,6 +346,19 @@ class ContinuousBatcher:
                                 ("max_tokens_per_request", max_tokens_per_request)):
                 if value is not None:
                     raise ValueError(f"{name} requires paged=True")
+        # Pallas kernel-layer spec for the engine's compiled programs
+        # (ops/registry.py; docs/kernels.md): None = the launcher contract
+        # (ACCELERATE_KERNELS) resolved at trace time; an explicit string
+        # (e.g. "pallas" / "paged_gather=off") pins the engine regardless of
+        # env. The paged mode's chain-view assembly dispatches through op
+        # ``paged_gather`` — the Pallas chain-walk skips bucket-padded slots
+        # and never materializes the intermediate (B, M, bs, ...) gather;
+        # token output is bit-identical either way (tests/test_kernels.py).
+        if kernels is not None:
+            from .ops.registry import parse_kernel_spec
+
+            parse_kernel_spec(kernels)  # validate eagerly
+        self.kernels = kernels
         self._rng = rng if rng is not None else jax.random.key(0)
         self._queue: deque[_Request] = deque()
         self._next_rid = 0
@@ -857,8 +871,15 @@ class ContinuousBatcher:
         scrubbing."""
         bs = self.block_size
         t = self.max_blocks_per_slot * bs
-        view_k = gather_block_view(pool["k"], tables)   # (L, B, T, Hkv, D)
-        view_v = gather_block_view(pool["v"], tables)
+        # Registry-dispatched assembly (op `paged_gather`): the Pallas
+        # chain-walk kernel skips slots with an empty chain (bucket padding /
+        # drained slots — their view rows are masked garbage on the reference
+        # path and zeros on the kernel path; attention provably ignores both).
+        active = lens > 0
+        view_k = gather_view(pool["k"], tables, active=active,
+                             backend=self.kernels)      # (L, B, T, Hkv, D)
+        view_v = gather_view(pool["v"], tables, active=active,
+                             backend=self.kernels)
         vmask = gather_block_mask(pool["mask"], tables)  # (B, T)
         b = vmask.shape[0]
         vmask = jnp.where(jnp.arange(t)[None] < lens[:, None], vmask, 0)
@@ -1026,12 +1047,22 @@ class ContinuousBatcher:
         compute_dtype = (
             str(np.dtype(param_leaves[0].dtype).name) if param_leaves else None
         )
+        from .ops.registry import resolved_backends
+
         self._decode_fn._audit_meta = {
             "builder": "serving_decode_paged",
             "compute_dtype": compute_dtype,
             "expected_donations": (1, 6),
             "expected_donated_leaves": donated_leaves,
             "donation_dropped_by_policy": not effective_donate,
+            # Which kernel backend each registered op resolved to at build
+            # time, so audits/fingerprints record the engine's kernel config
+            # (the paged path dispatches `paged_gather`), plus a jaxpr thunk
+            # so the auditor's pallas_call inventory sees the kernel eqns
+            # pre-partitioning.
+            "kernels": {"spec": self.kernels,
+                        "backends": resolved_backends(self.kernels)},
+            "jaxpr_thunk": lambda *a, **k: jax.make_jaxpr(run)(*a, **k),
             # The static-memory join for `accelerate-tpu memcheck --serving`:
             # the persistent pool is the class the per-device KV budget gate
             # prices (the gathered view + write window land in XLA's temp

@@ -139,6 +139,10 @@ class AttributionReport:
     steps: list = field(default_factory=list)     # per-step sub-reports
     top_ops: list = field(default_factory=list)   # [{name, kind, total_s, count}]
     by_axis: dict = field(default_factory=dict)   # {axis: collective seconds}
+    # {kernel name: seconds} — custom-call time attributed to the NAMED
+    # Pallas kernels the program auditor inventoried (attach_kernel_names);
+    # unmatched kernel-shaped events book under "unattributed-custom-call".
+    kernels: dict = field(default_factory=dict)
     trace_path: str = ""
 
     @property
@@ -178,6 +182,7 @@ class AttributionReport:
             "idle_s": round(self.idle_s, 6),
             "top_ops": list(self.top_ops),
             "by_axis": dict(self.by_axis),
+            "kernels": dict(self.kernels),
         }
         if self.trace_path:
             out["trace_path"] = self.trace_path
@@ -234,7 +239,10 @@ class _Classified:
                 label = op or name
                 m = _COLLECTIVE_RE.search(label) or _COLLECTIVE_RE.search(name)
                 kind = m.group(1) if m else "compute"
-                self.op_events.append((start, end, label, kind))
+                # Carry the raw event name alongside the hlo_op label: kernel
+                # attribution joins on whichever carries the kernel's name
+                # (op_name scope paths ride the event name, not the hlo_op).
+                self.op_events.append((start, end, label, kind, name))
                 if m:
                     self.collective.append([start, end])
                 else:
@@ -285,7 +293,8 @@ def attribute_events(events: list, collective_axes: dict | None = None) -> Attri
     axes_map = collective_axes if collective_axes is not None else _ATTACHED_AXES
     op_durations: dict = {}
     by_axis: dict = {}
-    for start, end, label, kind in classified.op_events:
+    kernels: dict = {}
+    for start, end, label, kind, name in classified.op_events:
         clipped = min(end, hi) - max(start, lo)
         if clipped <= 0:
             continue
@@ -297,6 +306,14 @@ def attribute_events(events: list, collective_axes: dict | None = None) -> Attri
         if kind != "compute" and axes_map:
             for axis in axes_map.get(kind, ()):  # kind-level join (audit.py)
                 by_axis[axis] = by_axis.get(axis, 0.0) + clipped
+        # Custom-kernel attribution: join the event (hlo_op label AND raw
+        # name — op_name scope paths ride the name) against the auditor's
+        # named-kernel inventory (name-level — per-instance HLO sites can't
+        # be recovered from trace rows, same as the axis join).
+        if kind == "compute":
+            kname = _kernel_name_for_label(f"{label} {name}")
+            if kname is not None:
+                kernels[kname] = kernels.get(kname, 0.0) + clipped
     report.top_ops = [
         {
             "name": name,
@@ -310,6 +327,8 @@ def attribute_events(events: list, collective_axes: dict | None = None) -> Attri
     ]
     if axes_map:
         report.by_axis = {a: round(s, 6) for a, s in sorted(by_axis.items())}
+    if kernels:
+        report.kernels = {k: round(s, 6) for k, s in sorted(kernels.items())}
     return report
 
 
@@ -322,6 +341,23 @@ def report_capture(trace_dir: str, collective_axes: dict | None = None) -> dict:
     return report.to_dict()
 
 
+# Trace-event spellings of a compiled custom-kernel invocation (Mosaic on
+# TPU; the generic custom-call row some backends emit instead).
+_KERNEL_EVENT_RE = re.compile(r"tpu_custom_call|mosaic|custom-call", re.IGNORECASE)
+
+
+def _kernel_name_for_label(label: str):
+    """The audited kernel name an op-event label belongs to, or
+    'unattributed-custom-call' for kernel-shaped events outside the attached
+    inventory, or None for ordinary compute."""
+    for name in _ATTACHED_KERNELS:
+        if name and name in label:
+            return name
+    if _KERNEL_EVENT_RE.search(label):
+        return "unattributed-custom-call"
+    return None
+
+
 # ------------------------------------------------------------- audit join
 # Kind → mesh-axes mapping attached by the last program audit, so triggered
 # captures (which never see an AuditReport) still attribute collectives to
@@ -329,6 +365,10 @@ def report_capture(trace_dir: str, collective_axes: dict | None = None) -> dict:
 # individual HLO sites, so each kind maps to the union of axes its audited
 # sites vary along.
 _ATTACHED_AXES: dict = {}
+# Named-kernel inventory attached the same way (Accelerator.audit feeds the
+# last report's kernel_counts): trace rows whose label carries a kernel's
+# name attribute their time to it in AttributionReport.kernels.
+_ATTACHED_KERNELS: tuple = ()
 
 
 def collective_axes_from_audit(audit_report) -> dict:
@@ -356,3 +396,22 @@ def attach_collective_axes(mapping_or_audit):
     ):
         mapping_or_audit = collective_axes_from_audit(mapping_or_audit)
     _ATTACHED_AXES = dict(mapping_or_audit)
+
+
+def attach_kernel_names(names_or_audit):
+    """Install the named-kernel join for later captures: an AuditReport (its
+    ``kernel_counts()`` keys), a report dict, or an iterable of names.
+    ``Accelerator.audit`` calls this with every report it builds — longest
+    names first so the most specific kernel wins a substring match."""
+    global _ATTACHED_KERNELS
+    if names_or_audit is None:
+        _ATTACHED_KERNELS = ()
+        return
+    if hasattr(names_or_audit, "kernel_counts"):
+        names = names_or_audit.kernel_counts().keys()
+    elif isinstance(names_or_audit, dict) and "kernels" in names_or_audit:
+        entries = names_or_audit["kernels"]
+        names = [e["name"] if isinstance(e, dict) else e for e in entries]
+    else:
+        names = names_or_audit
+    _ATTACHED_KERNELS = tuple(sorted(map(str, names), key=len, reverse=True))

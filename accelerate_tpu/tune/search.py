@@ -75,10 +75,14 @@ def classify_bottleneck(
 
 
 def propose_moves(candidate, bottleneck: str, space) -> list:
-    """The ordered, deduped neighbor candidates the bottleneck names (empty
-    for compute-bound/unknown: nothing to fix, or nothing to steer by)."""
+    """The ordered, deduped neighbor candidates the bottleneck names.
+    Compute-bound steps have one lever: the Pallas kernel layer — hot ops
+    leave their reference lowerings (``raise_kernels``). Unknown stays
+    empty: nothing to steer by."""
     moves = []
-    if bottleneck == BOTTLENECK_MEMORY:
+    if bottleneck == BOTTLENECK_COMPUTE:
+        moves = [space.raise_kernels(candidate)]
+    elif bottleneck == BOTTLENECK_MEMORY:
         moves = [
             space.strengthen_remat(candidate),
             space.shrink_chunk(candidate),

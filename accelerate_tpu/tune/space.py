@@ -39,6 +39,11 @@ class Candidate:
     remat_policy: str = ""   # '' = model default policy
     zero_sharding: bool = False
     prefetch: int = 0
+    # Pallas kernel-layer spec (ops/registry.py; 'off' = reference lowerings,
+    # 'pallas' = kernels where registered — compiled Mosaic on TPU, interpret
+    # elsewhere). A compiled-in lever like train_window: different spec,
+    # different lowered program.
+    kernels: str = "off"
 
     def key(self) -> str:
         """Stable identity used for dedup, result joins, and the report."""
@@ -49,6 +54,7 @@ class Candidate:
             f".r{self.remat_policy or 'default'}"
             f".z{int(self.zero_sharding)}"
             f".p{self.prefetch}"
+            f".k{self.kernels or 'off'}"
         )
 
     def lowering_key(self) -> str:
@@ -61,6 +67,7 @@ class Candidate:
             f".c{self.vocab_chunk}"
             f".r{self.remat_policy or 'default'}"
             f".z{int(self.zero_sharding)}"
+            f".k{self.kernels or 'off'}"
         )
 
     def replace(self, **kw) -> "Candidate":
@@ -74,6 +81,7 @@ class Candidate:
             "remat_policy": self.remat_policy,
             "zero_sharding": self.zero_sharding,
             "prefetch": self.prefetch,
+            "kernels": self.kernels,
         }
 
     @classmethod
@@ -101,6 +109,10 @@ class CandidateSpace:
     remat_policies: tuple = ("",)
     zero_sharding: tuple = (False, True)
     prefetches: tuple = (0, 2)
+    # Kernel axis in "raise the lever" order: reference lowerings first, the
+    # Pallas kernel layer to the right (the swap the autotuner measures
+    # kernel-vs-reference, like any other compiled-in lever).
+    kernels: tuple = ("off", "pallas")
     base: Candidate = field(default_factory=Candidate)
 
     def __post_init__(self):
@@ -116,6 +128,11 @@ class CandidateSpace:
         self.prefetches = tuple(
             sorted({int(p) for p in self.prefetches if int(p) >= 0})
         )
+        from ..ops.registry import parse_kernel_spec
+
+        for spec in self.kernels:
+            parse_kernel_spec(spec if spec != "off" else "")  # validate
+        self.kernels = tuple(dict.fromkeys(str(k) for k in self.kernels))
         # The base point must sit ON the grid — but it is the user's CURRENT
         # config, so the axes absorb it rather than the base being snapped to
         # the axes: a report claiming "winner vs current config" must have
@@ -147,6 +164,9 @@ class CandidateSpace:
             ))
         if base.prefetch not in self.prefetches:
             self.prefetches = tuple(sorted(set(self.prefetches) | {base.prefetch}))
+        if base.kernels not in self.kernels:
+            # Prepend: the current config is the least-aggressive point.
+            self.kernels = (base.kernels,) + self.kernels
 
     @classmethod
     def from_cluster_config(cls, cfg=None, **overrides) -> "CandidateSpace":
@@ -205,6 +225,12 @@ class CandidateSpace:
             return None
         return c.replace(zero_sharding=True)
 
+    def raise_kernels(self, c: Candidate) -> Candidate | None:
+        """Move the kernel lever right (off → pallas): the compute-bound
+        move — hot ops leave their reference lowerings for the kernel layer."""
+        nxt = self._next(self.kernels, c.kernels)
+        return c.replace(kernels=nxt) if nxt is not None else None
+
     # ------------------------------------------------------------------ seeds
     def seeds(self, limit: int | None = None) -> list:
         """The initial rung: the base point first (it is always trialed, so
@@ -225,6 +251,8 @@ class CandidateSpace:
             mutations.append(self.base.replace(zero_sharding=z))
         for pf in self.prefetches:
             mutations.append(self.base.replace(prefetch=pf))
+        for k in self.kernels:
+            mutations.append(self.base.replace(kernels=k))
         for m in mutations:
             if m.key() not in seen:
                 seen.add(m.key())
@@ -241,5 +269,6 @@ class CandidateSpace:
             "remat_policies": list(self.remat_policies),
             "zero_sharding": list(self.zero_sharding),
             "prefetches": list(self.prefetches),
+            "kernels": list(self.kernels),
             "base": self.base.to_dict(),
         }

@@ -171,6 +171,9 @@ class TrialRig:
         cfg = self._model_config(candidate)
         accelerator = Accelerator()
         accelerator.zero_sharding = candidate.zero_sharding
+        # Kernel lever: 'off' = reference lowerings ('' spec), anything else
+        # is the registry spec verbatim (resolved per op at build/trace time).
+        accelerator.kernels = "" if candidate.kernels == "off" else candidate.kernels
         model = Llama(cfg)
         model.init_params(jax.random.key(0))
         tx = {

@@ -102,6 +102,15 @@ ENV_TUNE_BUDGET = "ACCELERATE_TUNE_BUDGET"
 # ``--no-zero_sharding`` (tri-state; an explicit off scrubs an inherited env).
 ENV_ZERO_SHARDING = "ACCELERATE_ZERO_SHARDING"
 
+# Pallas kernel layer (ops/pallas/, ops/registry.py; docs/kernels.md): the
+# per-op backend spec. A bare token applies to every registered op
+# (``pallas`` — compiled Mosaic on TPU, interpret-mode elsewhere;
+# ``interpret`` — force the interpreter (CPU parity testing); ``reference`` /
+# ``off`` — the always-available reference lowerings), or a comma-separated
+# per-op map like ``paged_decode=pallas,int8_matmul=off``. Launcher contract:
+# ``--kernels`` (tri-state; an explicit off scrubs an inherited env).
+ENV_KERNELS = "ACCELERATE_KERNELS"
+
 # ``dcn`` is the slice axis of a multi-slice pod: replicas connected by
 # data-center network rather than ICI. It is outermost so only the axes meant
 # to cross slices (data parallelism / LocalSGD replicas) ever ride DCN; all

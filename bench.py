@@ -63,7 +63,14 @@ import numpy as np
 # at verified-identical outputs, chunked-vs-monolithic prefill max decode
 # stall, per-request TTFT/TPOT, and the op-level paged-gather overhead the
 # ROADMAP item 3 Pallas kernel will be measured against. Absent otherwise.
-BENCH_SCHEMA_VERSION = 9
+# v10 = Pallas kernel lever (ROADMAP item 3 shipped): BENCH_KERNELS sets the
+# registry spec (ACCELERATE_KERNELS — pallas | interpret | reference, or a
+# per-op map) for the config's programs, and detail.kernels on every line
+# records (a) the per-op resolved backend and (b) the audited pallas_call
+# inventory of the program that actually ran, so a kernel-vs-reference sweep
+# is attributed op-by-op (benchmarks/kernel_profile.py is the op-level
+# harness behind it).
+BENCH_SCHEMA_VERSION = 10
 
 
 class BenchAuditFailure(RuntimeError):
@@ -73,6 +80,17 @@ class BenchAuditFailure(RuntimeError):
     def __init__(self, message: str, audit: dict):
         super().__init__(message)
         self.audit = audit
+
+
+def _resolved_kernel_backends(accelerator) -> dict:
+    """{op: backend} the registry resolves for this run's spec; never raises
+    (the lever must not take a row down on a registry import problem)."""
+    try:
+        from accelerate_tpu.ops.registry import resolved_backends
+
+        return resolved_backends(accelerator.kernels)
+    except Exception as exc:
+        return {"error": f"{type(exc).__name__}: {exc}"[:200]}
 
 
 def peak_flops_per_chip() -> float:
@@ -443,6 +461,15 @@ def run_one(mode: str):
     # added update traffic in detail.audit.zero_collectives).
     bench_zero = bool(int(os.environ.get("BENCH_ZERO", "0") or 0))
 
+    # Pallas kernel lever (schema v10, ROADMAP item 3): BENCH_KERNELS sets
+    # the registry spec for everything this config builds (the fused-update
+    # kernel in the train step; paged_gather/paged_decode in a BENCH_SERVING
+    # wave). Exported via ACCELERATE_KERNELS so subprocesses and the serving
+    # profile harness resolve identically.
+    bench_kernels = os.environ.get("BENCH_KERNELS", "").strip()
+    if bench_kernels:
+        os.environ["ACCELERATE_KERNELS"] = bench_kernels
+
     accelerator = Accelerator(mixed_precision="bf16")
     accelerator.zero_sharding = bench_zero or accelerator.zero_sharding
     accelerator.telemetry.timeline.reset()  # fresh step-timeline window too
@@ -656,6 +683,13 @@ def run_one(mode: str):
                     "zero_sharding": bool(
                         getattr(popt, "zero_active", False)
                     ),
+                    # Kernel layer (schema v10): per-op resolved backend +
+                    # the audited program's named pallas_call inventory.
+                    "kernels": {
+                        "spec": accelerator.kernels,
+                        "backends": _resolved_kernel_backends(accelerator),
+                        "inventory": audit_summary.get("kernels", {}),
+                    },
                     **(
                         {"train_window": bench_window, "prefetch": bench_prefetch}
                         if amortized

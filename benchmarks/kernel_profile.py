@@ -1,0 +1,248 @@
+"""Op-level kernel-vs-reference grid for the Pallas kernel layer.
+
+ROADMAP item 3's acceptance is attributed, not guessed: every registered
+kernel op (ops/registry.py) is measured against its committed reference
+lowering probe-by-probe, the way ``vocab128k_profile.py`` attributes the
+fused-loss sweep and ``serving_decode_profile.py`` the serving wave:
+
+- ``paged_decode``: the fused ragged decode-attention kernel (in-kernel
+  block-chain walk) vs the reference gather + ``cached_attention``
+  composition, across chain lengths and padded-slot fractions (the kernel
+  skips dead slots; the reference pays full price for garbage).
+- ``paged_gather``: the chain-walk view assembly vs the XLA block-table
+  gather — the serving engine's per-window cost.
+- ``fused_update``: the one-pass clip+moments+apply+cast kernel vs the optax
+  reference chain on an adamw leaf set (parity is float-equivalent across
+  the two modules — see docs/kernels.md; the value probe reports max ulp-
+  scale deviation alongside the timing).
+- ``int8_matmul``: the fused quantize+contract+rescale kernel vs the
+  reference three-pass lowering (bit-exact).
+
+One JSON line per (op, backend) cell: ``{op, backend, shape, mean_ms,
+speedup_vs_reference, match}``. On CPU the kernel backend is the Pallas
+interpreter — correctness evidence, not a perf claim (interpret mode trades
+speed for exactness); the perf columns become meaningful on a TPU rig where
+``pallas`` resolves to compiled Mosaic (BENCH_KERNELS=pallas in a bench
+round embeds the train-step side as ``detail.kernels``).
+
+``BENCH_PROFILE_SMALL=1`` shrinks shapes for CPU smoke runs (the test
+suite's path). ``summarize()`` returns {op: {backend: cell}}.
+
+Usage: python benchmarks/kernel_profile.py
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+SMALL = os.environ.get("BENCH_PROFILE_SMALL", "0") == "1"
+REPS = 3 if SMALL else 10
+
+
+def _shapes():
+    if SMALL:
+        return dict(slots=4, blocks=24, block=4, chain=4, kv=2, heads=4,
+                    head_dim=8, layers=2, leaf=(64, 64), mm=(32, 64, 48))
+    return dict(slots=16, blocks=512, block=16, chain=24, kv=8, heads=16,
+                head_dim=128, layers=8, leaf=(2048, 2048), mm=(512, 2048, 2048))
+
+
+def _backends():
+    """reference always; the kernel cell is pallas on TPU, interpret off-TPU
+    (the registry's own degradation — recorded per cell)."""
+    from accelerate_tpu.ops.registry import pallas_supported
+
+    return ["reference", "pallas" if pallas_supported() else "interpret"]
+
+
+def _timeit(fn, *args):
+    import jax
+
+    out = fn(*args)
+    jax.block_until_ready(out)
+    times = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    return out, float(np.mean(times)) * 1e3
+
+
+def _cell(op, backend, shape, mean_ms, ref_ms, match):
+    cell = {
+        "op": op,
+        "backend": backend,
+        "shape": shape,
+        "mean_ms": round(mean_ms, 3),
+        "speedup_vs_reference": round(ref_ms / mean_ms, 3) if mean_ms else None,
+        "match": match,
+    }
+    print(json.dumps(cell))
+    return cell
+
+
+def probe_paged_decode(s):
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu.ops.paged_attention import paged_attention
+
+    rng = np.random.default_rng(0)
+    N, bs, Hkv, D = s["blocks"], s["block"], s["kv"], s["head_dim"]
+    B, M, H = s["slots"], s["chain"], s["heads"]
+    kp = jnp.asarray(rng.normal(size=(N + 1, bs, Hkv, D)), jnp.float32)
+    vp = jnp.asarray(rng.normal(size=(N + 1, bs, Hkv, D)), jnp.float32)
+    mask = jnp.asarray(rng.integers(0, 2, (N + 1, bs)), jnp.int32).at[0].set(0)
+    tables = jnp.asarray(rng.integers(1, N + 1, (B, M)), jnp.int32)
+    q = jnp.asarray(rng.normal(size=(B, 1, H, D)), jnp.float32)
+    pos = jnp.asarray(rng.integers(0, M * bs, (B, 1)), jnp.int32)
+    active = jnp.asarray([1] * (B // 2) + [0] * (B - B // 2), jnp.int32)
+    shape = f"B{B}xM{M}xbs{bs}xH{H}xD{D}"
+
+    cells = {}
+    ref = None
+    ref_ms = None
+    for backend in _backends():
+        fn = jax.jit(lambda *a, _b=backend: paged_attention(
+            *a, q_positions=pos, pool_mask=mask, active=active, backend=_b))
+        out, ms = _timeit(fn, q, kp, vp, tables)
+        if backend == "reference":
+            ref, ref_ms = out, ms
+            match = True
+        else:
+            # Active slots must agree bit-for-bit; the kernel skips the rest.
+            na = int(np.sum(np.asarray(active)))
+            match = bool(
+                (np.asarray(out)[:na] == np.asarray(ref)[:na]).all()
+            )
+        cells[backend] = _cell("paged_decode", backend, shape, ms, ref_ms, match)
+    return cells
+
+
+def probe_paged_gather(s):
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu.ops.paged_attention import gather_view
+
+    rng = np.random.default_rng(1)
+    N, bs, Hkv, D, L = s["blocks"], s["block"], s["kv"], s["head_dim"], s["layers"]
+    B, M = s["slots"], s["chain"]
+    pool = jnp.asarray(rng.normal(size=(L, N + 1, bs, Hkv, D)), jnp.float32)
+    tables = jnp.asarray(rng.integers(1, N + 1, (B, M)), jnp.int32)
+    shape = f"L{L}xB{B}xM{M}xbs{bs}"
+
+    cells = {}
+    ref = None
+    ref_ms = None
+    for backend in _backends():
+        fn = jax.jit(lambda p, t, _b=backend: gather_view(p, t, backend=_b))
+        out, ms = _timeit(fn, pool, tables)
+        if backend == "reference":
+            ref, ref_ms = out, ms
+            match = True
+        else:
+            match = bool((np.asarray(out) == np.asarray(ref)).all())
+        cells[backend] = _cell("paged_gather", backend, shape, ms, ref_ms, match)
+    return cells
+
+
+def probe_fused_update(s):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from accelerate_tpu.ops.pallas.fused_update import (
+        fused_update_apply,
+        plan_fused_update,
+        reference_update_apply,
+    )
+
+    rng = np.random.default_rng(2)
+    tx = optax.adamw(3e-4)
+    plan = plan_fused_update(tx)
+    params = {f"w{i}": jnp.asarray(rng.normal(size=s["leaf"]), jnp.float32)
+              for i in range(2 if SMALL else 4)}
+    grads = {k: jnp.asarray(rng.normal(size=v.shape), jnp.float32)
+             for k, v in params.items()}
+    state = tx.init(params)
+    factor = jnp.float32(1.0)
+    shape = f"{len(params)}x{s['leaf'][0]}x{s['leaf'][1]}"
+
+    cells = {}
+    ref = None
+    ref_ms = None
+    for backend in _backends():
+        if backend == "reference":
+            fn = jax.jit(lambda p, st, g: reference_update_apply(
+                p, st, g, tx=tx, clip_factor=factor))
+        else:
+            fn = jax.jit(lambda p, st, g, _i=(backend == "interpret"):
+                         fused_update_apply(p, st, g, plan=plan,
+                                            clip_factor=factor, interpret=_i))
+        out, ms = _timeit(fn, params, state, grads)
+        if backend == "reference":
+            ref, ref_ms = out, ms
+            match = True
+        else:
+            # Two different XLA modules: float-equivalent, not bitwise
+            # (docs/kernels.md); record the max deviation on params.
+            dev = max(
+                float(np.abs(np.asarray(a, np.float64) - np.asarray(b, np.float64)).max())
+                for a, b in zip(ref[0].values(), out[0].values())
+            )
+            match = {"max_param_dev": dev, "close": bool(dev < 1e-5)}
+        cells[backend] = _cell("fused_update", backend, shape, ms, ref_ms, match)
+    return cells
+
+
+def probe_int8_matmul(s):
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu.ops.int8 import _int8_matmul_fwd_value
+    from accelerate_tpu.ops.pallas.int8_mm import int8_matmul_kernel
+
+    rng = np.random.default_rng(3)
+    M, K, N = s["mm"]
+    x = jnp.asarray(rng.normal(size=(M, K)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(K, N)), jnp.float32)
+    shape = f"{M}x{K}x{N}"
+
+    cells = {}
+    ref = None
+    ref_ms = None
+    for backend in _backends():
+        if backend == "reference":
+            fn = jax.jit(_int8_matmul_fwd_value)
+        else:
+            fn = jax.jit(lambda x, w, _i=(backend == "interpret"):
+                         int8_matmul_kernel(x, w, interpret=_i))
+        out, ms = _timeit(fn, x, w)
+        if backend == "reference":
+            ref, ref_ms = out, ms
+            match = True
+        else:
+            match = bool((np.asarray(out) == np.asarray(ref)).all())
+        cells[backend] = _cell("int8_matmul", backend, shape, ms, ref_ms, match)
+    return cells
+
+
+def summarize() -> dict:
+    s = _shapes()
+    return {
+        "paged_decode": probe_paged_decode(s),
+        "paged_gather": probe_paged_gather(s),
+        "fused_update": probe_fused_update(s),
+        "int8_matmul": probe_int8_matmul(s),
+    }
+
+
+if __name__ == "__main__":
+    summarize()

@@ -293,6 +293,7 @@ def test_config_wizard_roundtrips_through_launch(tmp_path):
         "4",                 # train window K
         "latency",           # xla latency-hiding preset
         "yes",               # ZeRO cross-replica sharding
+        "pallas",            # Pallas kernel layer
         "6",                 # autotuner trial budget (accelerate-tpu tune)
         "yes",               # configure tracking?
         "json",              # trackers
@@ -313,6 +314,7 @@ def test_config_wizard_roundtrips_through_launch(tmp_path):
     assert cfg.profile_steps == "10-12" and cfg.profile_slow_zscore == 5.5
     assert cfg.train_window == 4 and cfg.xla_preset == "latency"
     assert cfg.zero_sharding is True
+    assert cfg.kernels == "pallas"
     assert cfg.tune_budget == 6
     assert cfg.compile_cache_dir == str(tmp_path / "xla_cache")
     config_path = tmp_path / "cfg.yaml"
@@ -357,6 +359,10 @@ def test_config_wizard_roundtrips_through_launch(tmp_path):
         "assert get_default_watchdog().timeout_s == 240.0\n"
         "assert os.environ.get('ACCELERATE_ZERO_SHARDING') == '1'\n"
         "assert acc.zero_sharding is True\n"
+        "assert os.environ.get('ACCELERATE_KERNELS') == 'pallas'\n"
+        "assert acc.kernels == 'pallas'\n"
+        "from accelerate_tpu.ops.registry import resolve_backend\n"
+        "assert resolve_backend('fused_update', acc.kernels) == 'interpret'\n"
         "assert os.environ.get('ACCELERATE_TUNE_BUDGET') == '6'\n"
         "import jax\n"
         "assert jax.config.jax_compilation_cache_dir.endswith('xla_cache')\n"

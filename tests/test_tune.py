@@ -97,10 +97,15 @@ def test_memory_bound_chooses_remat_and_chunk():
     assert any(m.vocab_chunk == 64 for m in moves), moves
 
 
-def test_compute_bound_proposes_nothing():
+def test_compute_bound_proposes_kernel_lever_only():
+    """Compute-bound has exactly one lever: the Pallas kernel layer (hot ops
+    leave their reference lowerings). With the kernel axis pinned off the
+    proposal set is empty again; unknown still steers nothing."""
     space = _space()
     assert classify_bottleneck(COMPUTE_BOUND) == "compute"
-    assert propose_moves(Candidate(), "compute", space) == []
+    moves = propose_moves(Candidate(), "compute", space)
+    assert [m.kernels for m in moves] == ["pallas"]
+    assert propose_moves(Candidate(), "compute", _space(kernels=("off",))) == []
     # No capture parsed and no memory pressure → unknown → nothing to steer.
     assert classify_bottleneck(None) == "unknown"
     assert propose_moves(Candidate(), "unknown", space) == []
@@ -109,11 +114,11 @@ def test_compute_bound_proposes_nothing():
 def test_search_steers_by_attribution_and_respects_budget():
     """Idle-dominated best → round 1 trials the raised-window proposal; the
     trial budget is a hard cap; ranking is best-first by step time."""
-    space = _space(prefetches=(0,), presets=("off",))  # keep moves = window only
+    space = _space(prefetches=(0,), presets=("off",), kernels=("off",))  # moves = window only
     step_times = {
-        "w1.xoff.c0.rdefault.z0.p0": 10.0,
-        "w2.xoff.c0.rdefault.z0.p0": 5.0,
-        "w4.xoff.c0.rdefault.z0.p0": 3.0,
+        "w1.xoff.c0.rdefault.z0.p0.koff": 10.0,
+        "w2.xoff.c0.rdefault.z0.p0.koff": 5.0,
+        "w4.xoff.c0.rdefault.z0.p0.koff": 3.0,
     }
     trialed = []
 
@@ -150,7 +155,7 @@ def test_search_steers_by_attribution_and_respects_budget():
 def test_search_halving_doubles_steps_for_keepers():
     """Compute-bound (no proposals) → later rounds re-measure the rung's top
     half at doubled steps — the successive-halving refinement."""
-    space = _space(presets=("off",), prefetches=(0,))
+    space = _space(presets=("off",), prefetches=(0,), kernels=("off",))
     calls = []
 
     def prune_fn(cands):
@@ -158,7 +163,7 @@ def test_search_halving_doubles_steps_for_keepers():
 
     def trial_fn(cand, _evidence, steps):
         calls.append((cand.key(), steps))
-        base = {"w1.xoff.c0.rdefault.z0.p0": 2.0}.get(cand.key(), 4.0)
+        base = {"w1.xoff.c0.rdefault.z0.p0.koff": 2.0}.get(cand.key(), 4.0)
         return {"step_time_s": base, "fractions": COMPUTE_BOUND}
 
     seeds = [Candidate(), Candidate(train_window=2), Candidate(train_window=4)]
@@ -167,8 +172,8 @@ def test_search_halving_doubles_steps_for_keepers():
         seeds=seeds, base_steps=4, max_rounds=3,
     )
     # Rung 0: all three at 4 steps; rung 1: top 2 re-measured at 8 steps.
-    assert (("w1.xoff.c0.rdefault.z0.p0", 4) in calls
-            and ("w1.xoff.c0.rdefault.z0.p0", 8) in calls), calls
+    assert (("w1.xoff.c0.rdefault.z0.p0.koff", 4) in calls
+            and ("w1.xoff.c0.rdefault.z0.p0.koff", 8) in calls), calls
     assert not any(steps == 8 and key.startswith("w4.") for key, steps in calls)
     assert [c.key() for c, _ in ranked][0].startswith("w1.")
 
