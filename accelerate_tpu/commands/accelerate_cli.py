@@ -20,6 +20,7 @@ from .launch import launch_command_parser
 from .merge import merge_command_parser
 from .profile import blackbox_command_parser, profile_command_parser
 from .test import test_command_parser
+from .top import top_command_parser
 from .tpu import tpu_command_parser
 from .tune import tune_command_parser
 
@@ -44,6 +45,7 @@ def main() -> None:
     profile_command_parser(subparsers=subparsers)
     blackbox_command_parser(subparsers=subparsers)
     tune_command_parser(subparsers=subparsers)
+    top_command_parser(subparsers=subparsers)
 
     args = parser.parse_args()
     if not hasattr(args, "func"):

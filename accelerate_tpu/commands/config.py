@@ -147,9 +147,11 @@ def get_user_input() -> ClusterConfig:
     # exported; telemetry defaults ON), explicit answers reach the workers.
     telemetry, metrics_port, straggler_threshold = None, 0, 0.0
     profile_steps, profile_slow_zscore = None, None
+    fleet_metrics, slo_step_time, slo_ttft, slo_tpot = None, None, None, None
     if _yesno(
         "Do you want to configure observability (step timeline, metrics "
-        "endpoint, straggler alerts, profiling)?", False
+        "endpoint, straggler alerts, profiling, fleet aggregation, SLOs)?",
+        False,
     ):
         telemetry = _yesno(
             "  always-on telemetry (per-step timeline, spans, metrics registry)?",
@@ -170,6 +172,23 @@ def get_user_input() -> ClusterConfig:
         profile_slow_zscore = _ask(
             "  slow-step trace trigger: robust z-score threshold over recent "
             "step times (0 = disabled)", 0.0, float
+        )
+        fleet_metrics = _yesno(
+            "  fleet metric aggregation (the lead host scrapes every "
+            "worker's registered endpoint into /fleet; `accelerate-tpu top` "
+            "is the console)?", False
+        )
+        slo_step_time = _ask(
+            "  SLO target: per-step wall time in seconds (0 = no target)",
+            0.0, float,
+        )
+        slo_ttft = _ask(
+            "  SLO target: serving time-to-first-token in seconds "
+            "(0 = no target)", 0.0, float,
+        )
+        slo_tpot = _ask(
+            "  SLO target: serving time-per-output-token in seconds "
+            "(0 = no target)", 0.0, float,
         )
     # Tri-state like the health section: declining leaves both UNSPECIFIED
     # (None / '') so an inherited ACCELERATE_TRAIN_WINDOW/XLA_PRESET still
@@ -262,6 +281,10 @@ def get_user_input() -> ClusterConfig:
         telemetry=telemetry,
         metrics_port=metrics_port,
         straggler_threshold=straggler_threshold,
+        fleet_metrics=fleet_metrics,
+        slo_step_time=slo_step_time,
+        slo_ttft=slo_ttft,
+        slo_tpot=slo_tpot,
         train_window=train_window,
         xla_preset=xla_preset,
         zero_sharding=zero_sharding,

@@ -129,6 +129,17 @@ class ClusterConfig:
     telemetry: bool | None = None
     metrics_port: int = 0
     straggler_threshold: float = 0.0
+    # Fleet observability plane (telemetry/fleet.py / slo.py;
+    # docs/observability.md "Fleet aggregation" / "SLO sentinel").
+    # ``fleet_metrics`` is TRI-state like telemetry (None = unspecified, an
+    # explicit False reaches workers as ACCELERATE_FLEET_METRICS=0); the SLO
+    # targets are TRI-state floats per the profile_slow_zscore precedent
+    # (None = unspecified, inherited env flows; an explicit 0 scrubs it and
+    # disables the dimension; > 0 exported in seconds).
+    fleet_metrics: bool | None = None
+    slo_step_time: float | None = None
+    slo_ttft: float | None = None
+    slo_tpot: float | None = None
     # Dispatch amortization (docs/performance.md): ``train_window`` is the K
     # Accelerator.build_train_window fuses per dispatch (tri-state like
     # ``telemetry``: None = unspecified, an inherited ACCELERATE_TRAIN_WINDOW

@@ -30,6 +30,7 @@ from ..utils.constants import (
     ENV_DEBUG_MODE,
     ENV_ELASTIC,
     ENV_FAULT_PLAN,
+    ENV_FLEET_METRICS,
     ENV_GUARD_NUMERICS,
     ENV_HANDLE_PREEMPTION,
     ENV_HANG_TIMEOUT,
@@ -43,6 +44,9 @@ from ..utils.constants import (
     ENV_PROFILE_SLOW_ZSCORE,
     ENV_PROFILE_STEPS,
     ENV_RESTART_ATTEMPT,
+    ENV_SLO_STEP_TIME,
+    ENV_SLO_TPOT,
+    ENV_SLO_TTFT,
     ENV_SPIKE_ZSCORE,
     ENV_STRAGGLER_THRESHOLD,
     ENV_TELEMETRY,
@@ -160,6 +164,38 @@ def launch_command_parser(subparsers=None) -> argparse.ArgumentParser:
              "registry — step time, tokens/s, MFU, goodput/badput classes, "
              "health trips, restarts, straggler skew. Co-located workers "
              "(CPU-sim gangs) serve on port + local_process_index.",
+    )
+    parser.add_argument(
+        "--fleet_metrics", action=argparse.BooleanOptionalAction, default=None,
+        help="Fleet metric aggregation (ACCELERATE_FLEET_METRICS): every "
+             "worker registers its bound metrics endpoint in the "
+             "coordination-service KV registry and the lead host scrapes "
+             "them all into per-host-labeled series + fleet rollups at "
+             "/fleet on its own endpoint — `accelerate-tpu top` is the "
+             "console. Requires --metrics_port. --no-fleet_metrics pins it "
+             "off explicitly.",
+    )
+    parser.add_argument(
+        "--slo_step_time", type=float, default=None,
+        help="SLO sentinel: target per-step wall time in seconds "
+             "(ACCELERATE_SLO_STEP_TIME). Every breach books "
+             "accelerate_slo_breaches_total{target=\"step_time\"}, a "
+             "flight-recorder event, and a rate-limited warning. 0 scrubs an "
+             "inherited value (dimension off).",
+    )
+    parser.add_argument(
+        "--slo_ttft", type=float, default=None,
+        help="SLO sentinel: serving time-to-first-token target in seconds "
+             "(ACCELERATE_SLO_TTFT). Reaches ContinuousBatcher as its "
+             "SLOTargets default (admission escalates at-risk prefills) and "
+             "arms per-request breach booking in the request tracer. 0 "
+             "scrubs an inherited value.",
+    )
+    parser.add_argument(
+        "--slo_tpot", type=float, default=None,
+        help="SLO sentinel: serving time-per-output-token target in seconds "
+             "(ACCELERATE_SLO_TPOT; the decode-pacing twin of --slo_ttft). "
+             "0 scrubs an inherited value.",
     )
     parser.add_argument(
         "--straggler_threshold", type=float, default=None,
@@ -282,6 +318,10 @@ def _merge_config(args) -> ClusterConfig:
         ("telemetry", "telemetry"),
         ("metrics_port", "metrics_port"),
         ("straggler_threshold", "straggler_threshold"),
+        ("fleet_metrics", "fleet_metrics"),
+        ("slo_step_time", "slo_step_time"),
+        ("slo_ttft", "slo_ttft"),
+        ("slo_tpot", "slo_tpot"),
         ("train_window", "train_window"),
         ("xla_preset", "xla_preset"),
         ("zero_sharding", "zero_sharding"),
@@ -362,6 +402,19 @@ def prepare_launch_env(cfg: ClusterConfig, process_id: int | None = None, attemp
         env[ENV_METRICS_PORT] = str(int(cfg.metrics_port))
     if cfg.straggler_threshold:
         env[ENV_STRAGGLER_THRESHOLD] = str(cfg.straggler_threshold)
+    # Fleet aggregation is tri-state like telemetry: None exports nothing,
+    # an explicit --no-fleet_metrics reaches the workers as a disable.
+    if cfg.fleet_metrics is not None:
+        env[ENV_FLEET_METRICS] = "1" if cfg.fleet_metrics else "0"
+    # SLO targets are tri-state per the profile_slow_zscore precedent: an
+    # explicit 0 must SCRUB a stale inherited value, not forward it.
+    for value, env_name in ((cfg.slo_step_time, ENV_SLO_STEP_TIME),
+                            (cfg.slo_ttft, ENV_SLO_TTFT),
+                            (cfg.slo_tpot, ENV_SLO_TPOT)):
+        if value:
+            env[env_name] = str(value)
+        elif value is not None:
+            env.pop(env_name, None)
     # Dispatch amortization: the window K reaches Accelerator.train_window;
     # the XLA preset is installed by PartialState BEFORE backend creation in
     # the worker (libtpu reads LIBTPU_INIT_ARGS once at init).
@@ -542,6 +595,22 @@ def launch_command(args) -> None:
         raise ValueError(
             f"--straggler_threshold must be >= 1.0 (a ratio to the cross-host "
             f"median step time), got {cfg.straggler_threshold}"
+        )
+    for name, value in (("--slo_step_time", cfg.slo_step_time),
+                        ("--slo_ttft", cfg.slo_ttft),
+                        ("--slo_tpot", cfg.slo_tpot)):
+        if value is not None and value < 0:
+            raise ValueError(f"{name} must be >= 0 seconds (0 = off), got {value}")
+    from ..telemetry import metrics_port_from_env
+
+    # An inherited ACCELERATE_METRICS_PORT of "0" means "no endpoint"
+    # (the shared env-contract parser) — it must not satisfy the fleet
+    # requirement just by being a non-empty string.
+    if cfg.fleet_metrics and not cfg.metrics_port and metrics_port_from_env() <= 0:
+        raise ValueError(
+            "--fleet_metrics aggregates the workers' Prometheus endpoints, "
+            "which --metrics_port starts: pass --metrics_port too (the lead "
+            "host serves /fleet on its own endpoint)."
         )
     if cfg.train_window is not None and cfg.train_window < 1:
         raise ValueError(f"--train_window must be >= 1, got {cfg.train_window}")

@@ -157,6 +157,22 @@ def blackbox_command(args) -> None:
             f"{goodput.get('wall_s', 0):.1f}s wall "
             f"({goodput.get('steps', 0)} steps, {goodput.get('restarts', 0)} restarts)"
         )
+    # Serving/SLO forensics (telemetry/slo.py + requests.py): breaches and
+    # per-request admission decisions land in the ring as first-class events;
+    # summarize them up front so the slow-request story doesn't have to be
+    # reassembled from the raw timeline below.
+    breaches = [e for e in events if e.get("kind") == "slo_breach"]
+    admissions = [e for e in events if e.get("kind") == "admission"]
+    if breaches or admissions:
+        per_target: dict = {}
+        for e in breaches:
+            per_target[e.get("target", "?")] = per_target.get(e.get("target", "?"), 0) + 1
+        decisions: dict = {}
+        for e in admissions:
+            decisions[e.get("decision", "?")] = decisions.get(e.get("decision", "?"), 0) + 1
+        breach_txt = " ".join(f"{k}={v}" for k, v in sorted(per_target.items())) or "none"
+        decision_txt = " ".join(f"{k}={v}" for k, v in sorted(decisions.items())) or "none"
+        print(f"slo breaches in window: {breach_txt}; admission decisions: {decision_txt}")
     print("timeline (t is seconds since recorder start):")
     for event in events:
         step = f" step={event['step']}" if "step" in event else ""

@@ -1,0 +1,177 @@
+"""`accelerate-tpu top` — the live fleet console.
+
+Polls the lead host's ``/fleet`` endpoint (telemetry/fleet.py: the joined
+per-host series + fleet rollups the FleetAggregator builds from every
+worker's KV-registered metrics endpoint) and renders a control-room view:
+fleet rollups (MFU, tokens/s, goodput split, step-time skew, SLO breaches),
+then one row per host. ``--once`` prints a single frame and exits;
+``--once --json`` prints the raw snapshot for CI consumption. Against a
+worker with no aggregator installed, the snapshot is aggregated client-side
+from that one endpoint's ``/metrics`` — a bare worker is still inspectable.
+
+Pure HTTP post-processing: no backend, no devices, safe to run anywhere that
+can reach the endpoint.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def default_endpoint() -> str:
+    """Where to look when ``--endpoint`` is omitted: the local worker's env
+    contract (ACCELERATE_METRICS_PORT) on loopback. Unset/0 means no
+    endpoint is configured (the shared env-contract parser) — a pointed
+    error beats probing a port nothing serves."""
+    from ..telemetry import metrics_port_from_env
+
+    port = metrics_port_from_env()
+    if port <= 0:
+        raise SystemExit(
+            "accelerate-tpu top: no --endpoint given and ACCELERATE_METRICS_PORT "
+            "is unset/0 (no metrics endpoint configured) — pass --endpoint "
+            "host:port of the lead worker's metrics server (launch "
+            "--metrics_port N starts one)."
+        )
+    return f"127.0.0.1:{port}"
+
+
+def top_command_parser(subparsers=None) -> argparse.ArgumentParser:
+    description = "Live fleet console over the /fleet aggregation endpoint"
+    if subparsers is not None:
+        parser = subparsers.add_parser("top", description=description)
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu top", description=description)
+    parser.add_argument(
+        "--endpoint", default=None,
+        help="Lead host's metrics endpoint (host:port or URL; default "
+             "127.0.0.1:$ACCELERATE_METRICS_PORT). /fleet is fetched from it; "
+             "a worker without an aggregator is rendered as a one-host fleet "
+             "from its /metrics.",
+    )
+    parser.add_argument(
+        "--interval", type=float, default=2.0,
+        help="Refresh interval in seconds for the live view (default 2.0)",
+    )
+    parser.add_argument(
+        "--once", action="store_true",
+        help="Render one frame and exit (with --json: machine-readable)",
+    )
+    parser.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="Print the raw fleet snapshot JSON instead of the console view",
+    )
+    if subparsers is not None:
+        parser.set_defaults(func=top_command)
+    return parser
+
+
+def _fmt(value, spec: str = "", none: str = "-") -> str:
+    if value is None:
+        return none
+    return format(value, spec)
+
+
+def render_snapshot(snapshot: dict) -> str:
+    """One console frame from a fleet snapshot — pure, for tests."""
+    fleet = snapshot.get("fleet", {})
+    hosts = snapshot.get("hosts", {})
+    lines = []
+    when = time.strftime(
+        "%H:%M:%S", time.localtime(snapshot.get("generated_at", time.time()))
+    )
+    lines.append(
+        f"fleet @ {when}  hosts {fleet.get('hosts_up', 0)}/"
+        f"{fleet.get('hosts_total', 0)} up  "
+        f"restarts {fleet.get('restarts', 0)}  "
+        f"reshards {fleet.get('reshard_transitions', 0)}  "
+        f"health trips {fleet.get('health_trips', 0)}"
+    )
+    step = fleet.get("step_s") or {}
+    lines.append(
+        f"  mfu {_fmt(fleet.get('mfu'), '.4f')}  "
+        f"tokens/s {_fmt(fleet.get('tokens_per_s'), ',.1f')}  "
+        f"step s min/med/max {_fmt(step.get('min'), '.4f')}/"
+        f"{_fmt(step.get('median'), '.4f')}/{_fmt(step.get('max'), '.4f')}  "
+        f"skew {_fmt(step.get('skew'), '.2f')}x"
+    )
+    goodput = fleet.get("goodput") or {}
+    badput = goodput.get("badput_s") or {}
+    badput_txt = " ".join(
+        f"{k}={v:.1f}s" for k, v in sorted(badput.items()) if v
+    ) or "none"
+    lines.append(
+        f"  goodput {_fmt(goodput.get('fraction'), '.1%')}  badput: {badput_txt}"
+    )
+    breaches = fleet.get("slo_breaches") or {}
+    lines.append(
+        "  slo breaches: "
+        + (" ".join(f"{k}={v}" for k, v in sorted(breaches.items())) or "none")
+        + f"  kv pool {_fmt(fleet.get('kv_pool_utilization'), '.1%')}"
+    )
+    lines.append(
+        f"  {'host':<6}{'endpoint':<24}{'up':<4}{'steps':>8}{'step_s':>10}"
+        f"{'tok/s':>12}{'mfu':>8}{'goodput':>9}{'restarts':>9}  slo"
+    )
+    for host in sorted(hosts, key=lambda h: int(h) if h.isdigit() else 0):
+        row = hosts[host]
+        slo_txt = " ".join(
+            f"{k}={v}" for k, v in sorted((row.get("slo_breaches") or {}).items())
+        ) or "-"
+        lines.append(
+            f"  {host:<6}{(row.get('endpoint') or '-'):<24}"
+            f"{'up' if row.get('up') else 'DOWN':<4}"
+            f"{_fmt(row.get('steps'), 'd'):>8}"
+            f"{_fmt(row.get('step_s_mean'), '.4f'):>10}"
+            f"{_fmt(row.get('tokens_per_s'), ',.1f'):>12}"
+            f"{_fmt(row.get('mfu'), '.3f'):>8}"
+            f"{_fmt(row.get('goodput_fraction'), '.1%'):>9}"
+            f"{_fmt(row.get('restarts'), '.0f'):>9}  {slo_txt}"
+        )
+        if not row.get("up") and row.get("error"):
+            lines.append(f"         {row['error']}")
+    return "\n".join(lines)
+
+
+def top_command(args) -> None:
+    from ..telemetry.fleet import fetch_fleet_snapshot
+
+    endpoint = args.endpoint or default_endpoint()
+    if args.once:
+        snapshot = fetch_fleet_snapshot(endpoint)
+        print(json.dumps(snapshot, indent=1) if args.as_json
+              else render_snapshot(snapshot))
+        return
+    if args.interval <= 0:
+        raise ValueError(f"--interval must be > 0, got {args.interval}")
+    try:
+        while True:
+            try:
+                snapshot = fetch_fleet_snapshot(endpoint)
+                # --json streams one machine-readable snapshot per interval
+                # (no screen clearing — built for pipes, not terminals).
+                frame = (json.dumps(snapshot) if args.as_json
+                         else render_snapshot(snapshot))
+            except Exception as exc:
+                frame = (json.dumps({"error": repr(exc), "endpoint": endpoint})
+                         if args.as_json
+                         else f"fleet endpoint {endpoint} unreachable: {exc!r}")
+            # Clear + home, then the frame (plain stdout when not a TTY).
+            if not args.as_json and sys.stdout.isatty():
+                sys.stdout.write("\x1b[2J\x1b[H")
+            print(frame, flush=True)
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+
+
+def main() -> None:  # pragma: no cover - thin shim
+    parser = top_command_parser()
+    top_command(parser.parse_args())
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

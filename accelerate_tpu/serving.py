@@ -263,6 +263,7 @@ class ContinuousBatcher:
         max_tokens_per_request: int | None = None,
         slo: SLOTargets | None = None,
         kernels: str | None = None,
+        trace_requests: bool = True,
     ):
         module, mparams = _unwrap(model)
         self.module = module
@@ -299,6 +300,13 @@ class ContinuousBatcher:
         # prompts interleave with decode instead of stalling it.
         self.paged = bool(paged)
         self.block_size = int(block_size)
+        if slo is None:
+            # The launcher's SLO env contract reaches a serving tier with
+            # zero code: ACCELERATE_SLO_TTFT/TPOT resolve here unless the
+            # caller pinned targets (or their absence) explicitly.
+            from .telemetry.slo import serving_slo_from_env
+
+            slo = serving_slo_from_env()
         self.slo = slo
         if self.paged:
             if self.block_size < 1:
@@ -391,6 +399,17 @@ class ContinuousBatcher:
         # sustained backpressure from re-gathering the cache every window.
         self._retired_since_compact = False
         self._prefix_tokens: np.ndarray | None = None
+        # Per-request lifecycle tracing (telemetry/requests.py): every hook
+        # fires from host bookkeeping the loop performs anyway, so tracing
+        # adds zero device transfers (pinned by tests/test_fleet.py). A TTFT
+        # breach books accelerate_slo_breaches_total + a flight event and can
+        # arm a trace capture via the installed profile trigger.
+        if trace_requests:
+            from .telemetry.requests import RequestTracer
+
+            self.tracer: RequestTracer | None = RequestTracer(slo=self.slo)
+        else:
+            self.tracer = None
         self.reset()
 
     # ------------------------------------------------------------- lifecycle
@@ -402,6 +421,12 @@ class ContinuousBatcher:
         re-prefilled automatically so the retry flow stays exact; pass
         ``keep_prefix=False`` to drop it."""
         B = self.B
+        if self.tracer is not None:
+            # In-flight slots are about to be wiped: their lifecycle records
+            # close as cancelled (queued requests survive and stay queued).
+            for req in getattr(self, "_slot_req", []):
+                if req is not None:
+                    self.tracer.cancel(req.rid)
         if self.paged:
             self._reset_paged(keep_prefix)
             return
@@ -770,10 +795,11 @@ class ContinuousBatcher:
                 raise ValueError("empty stop sequence")
         rid = self._next_rid
         self._next_rid += 1
-        self._queue.append(
-            _Request(rid, prompt, max_new, temp, eos, stop, time.monotonic())
-        )
-        self._req_times[rid] = {"submit": time.monotonic()}
+        now = time.monotonic()
+        self._queue.append(_Request(rid, prompt, max_new, temp, eos, stop, now))
+        self._req_times[rid] = {"submit": now}
+        if self.tracer is not None:
+            self.tracer.submit(rid, int(prompt.size), submit_t=now)
         while len(self._req_times) > _SLO_HISTORY:
             # Insertion-ordered: evict the oldest sample (a still-in-flight
             # old rid just loses its latency SAMPLE, never its result).
@@ -1220,6 +1246,12 @@ class ContinuousBatcher:
         _, completed, tokens = _serving_counters()
         completed.inc()
         tokens.inc(int(row.size))
+        if self.tracer is not None:
+            self.tracer.finish(
+                req.rid, int(row.size),
+                tpot_s=(times or {}).get("tpot"),
+                at=(times or {}).get("finish"),
+            )
 
     def _collect(self, s: int, active_np):
         req = self._slot_req[s]
@@ -1379,6 +1411,11 @@ class ContinuousBatcher:
                 self._slo_decisions["chunked_prefills"] += 1
             if escalated:
                 self._slo_decisions["escalated_monolithic"] += 1
+            if self.tracer is not None:
+                self.tracer.admit(
+                    req.rid, "escalate" if escalated else "admit",
+                    aliased_blocks=k, chunks=len(chunks),
+                )
             self._peak_consumed_slots = max(
                 self._peak_consumed_slots, self.blocks_in_use * bs
             )
@@ -1409,6 +1446,8 @@ class ContinuousBatcher:
             )
             if not ttft_risk:
                 self._slo_decisions["deferred_prefills"] += 1
+                if self.tracer is not None:
+                    self.tracer.defer(self._slot_req[s].rid)
                 return None
         return s
 
@@ -1440,6 +1479,8 @@ class ContinuousBatcher:
         )
         self._sync(state)  # instance fields track the LIVE (post-donation) buffers
         self._log_dispatch(f"chunk:{p}")
+        if self.tracer is not None:
+            self.tracer.prefill_chunk(req.rid, p, final)
         if not final:
             self._register_shared(s, c0, p)
         self._slot_len[s] += p
@@ -1471,6 +1512,10 @@ class ContinuousBatcher:
             else None
             for s in range(self.B)
         ]
+        if self.tracer is not None:
+            for rid in req_map:
+                if rid is not None:
+                    self.tracer.decode_window(rid)
         return state, (report, req_map)
 
     def _process_report(self, report, force_stop: np.ndarray):
@@ -1497,6 +1542,8 @@ class ContinuousBatcher:
             times = self._req_times.get(req.rid)
             if times is not None and "first_token" not in times and n_np[s] >= 1:
                 times["first_token"] = now
+                if self.tracer is not None:
+                    self.tracer.first_token(req.rid, at=now)
             if active_np[s] and req.stop:
                 if out_np is None:
                     out_np = host_fetch(report[2])
@@ -1649,9 +1696,15 @@ class ContinuousBatcher:
                 # Host-side wall clock in the HOST engine loop (the linter's
                 # traced_names heuristic collides on the jitted bodies all
                 # being named `run` too).
+                admit_t = time.monotonic()  # accelerate-lint: disable=traced-host-impurity
                 self._req_times.setdefault(req.rid, {"submit": req.submit_t})[
                     "first_token"
-                ] = time.monotonic()  # accelerate-lint: disable=traced-host-impurity
+                ] = admit_t
+                if self.tracer is not None:
+                    # Contiguous admits prefill AND sample the first token in
+                    # one dispatch: admission and first-token coincide.
+                    self.tracer.admit(req.rid)
+                    self.tracer.first_token(req.rid, at=admit_t)
                 self._peak_consumed_slots = max(
                     self._peak_consumed_slots, self.B * self._host_pos
                 )

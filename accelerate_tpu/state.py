@@ -46,6 +46,7 @@ from .utils.constants import (
     ENV_COORDINATOR,
     ENV_CPU,
     ENV_DEBUG_MODE,
+    ENV_FLEET_METRICS,
     ENV_HANDLE_PREEMPTION,
     ENV_HANG_TIMEOUT,
     ENV_METRICS_PORT,
@@ -128,6 +129,7 @@ class PartialState:
         "process_index",
         "_mesh",
         "_parallelism_config",
+        "_metrics_endpoint",
     ]
 
     def __init__(self, cpu: bool = False, **kwargs):
@@ -219,10 +221,32 @@ class PartialState:
         # After process discovery so co-located workers (the CPU-sim gang)
         # offset the port by their local rank instead of fighting for one
         # bind; the shared helper degrades a bind failure to a warning.
+        self._metrics_endpoint = None
         if os.environ.get(ENV_METRICS_PORT, "").strip():
             from .telemetry import start_endpoint_from_env
 
-            start_endpoint_from_env(self.local_process_index)
+            server = start_endpoint_from_env(self.local_process_index)
+            if server is not None:
+                # Publish the ACTUALLY bound host:port (the local-rank port
+                # offset and ephemeral binds included) into the fleet KV
+                # registry, so the aggregator, straggler warnings, and
+                # operators read the real address instead of guessing it
+                # from the env contract (telemetry/fleet.py).
+                from .telemetry.fleet import publish_metrics_endpoint
+
+                self._metrics_endpoint = publish_metrics_endpoint(
+                    process_index=self.process_index, server=server
+                )
+                # Fleet aggregation plane (ACCELERATE_FLEET_METRICS): the
+                # lead host scrapes every registered endpoint and serves the
+                # joined series + rollups at /fleet on this same server.
+                if parse_flag_from_env(ENV_FLEET_METRICS) and self.process_index == 0:
+                    from .telemetry.fleet import (
+                        FleetAggregator,
+                        install_fleet_provider,
+                    )
+
+                    install_fleet_provider(FleetAggregator(state=self))
 
     def __repr__(self) -> str:
         return (
@@ -258,6 +282,14 @@ class PartialState:
     @property
     def local_device_count(self) -> int:
         return jax.local_device_count()
+
+    @property
+    def metrics_endpoint(self) -> str | None:
+        """The metrics endpoint this worker ACTUALLY serves (``host:port``,
+        bound port — ephemeral binds and the co-located-worker port offset
+        included), published into the fleet KV registry at init; None when no
+        endpoint is configured (telemetry/fleet.py)."""
+        return self.__dict__.get("_metrics_endpoint")
 
     @property
     def is_main_process(self) -> bool:

@@ -25,7 +25,17 @@ goodput ledger, health verdicts, transfer counters — publish into ONE stack:
   compute/collective/idle/host attribution report (with the measured
   compute↔collective overlap fraction);
 - :mod:`.flight` — the always-on flight-recorder black box, dumped to JSON
-  on hang/trip/restart/crash and rendered by ``accelerate-tpu blackbox``.
+  on hang/trip/restart/crash and rendered by ``accelerate-tpu blackbox``;
+- :mod:`.fleet` — the fleet plane: every worker registers its bound metrics
+  endpoint in the coordination-service KV registry, and the lead host's
+  ``FleetAggregator`` scrapes them all into per-host-labeled series + fleet
+  rollups at ``/fleet`` (``accelerate-tpu top`` is the console);
+- :mod:`.requests` — per-request serving lifecycle traces (submit →
+  admission decision → prefill chunks → first token → decode windows →
+  finish/cancel) in a bounded ring, fed by ``ContinuousBatcher``;
+- :mod:`.slo` — the continuous SLO sentinel: step-time/MFU/TTFT/TPOT targets
+  (explicit or EMA+MAD self-baselined), every breach booked as
+  ``accelerate_slo_breaches_total{target}`` + a flight-recorder event.
 
 :class:`Telemetry` binds them behind ``Accelerator.telemetry``; the per-step
 hooks loops already call (``guard_step`` / ``checkpoint_on_preemption``) and
@@ -37,6 +47,14 @@ from __future__ import annotations
 
 import os
 
+from .fleet import (
+    FleetAggregator,
+    discover_endpoints,
+    install_fleet_provider,
+    metrics_endpoint,
+    publish_metrics_endpoint,
+    reset_fleet,
+)
 from .flight import (
     FlightRecorder,
     get_flight_recorder,
@@ -53,6 +71,8 @@ from .metrics import (
     start_default_server,
     stop_default_server,
 )
+from .requests import RequestTracer
+from .slo import SLOSentinel, breach_counts, record_breach, slo_targets_from_env
 from .profiler import (
     ProfileManager,
     SlowStepDetector,
@@ -67,12 +87,15 @@ from .timeline import StepTimeline, device_memory_stats, device_peak_flops
 
 __all__ = [
     "Counter",
+    "FleetAggregator",
     "FlightRecorder",
     "Gauge",
     "Histogram",
     "MetricsRegistry",
     "MetricsServer",
     "ProfileManager",
+    "RequestTracer",
+    "SLOSentinel",
     "SkewReport",
     "SlowStepDetector",
     "SpanRecord",
@@ -80,22 +103,31 @@ __all__ = [
     "StepTimeline",
     "StragglerMonitor",
     "Telemetry",
+    "breach_counts",
     "device_memory_stats",
     "device_peak_flops",
+    "discover_endpoints",
     "get_flight_recorder",
     "get_profile_manager",
     "get_registry",
     "get_span_ring",
     "get_telemetry",
     "install_default_collectors",
+    "install_fleet_provider",
+    "metrics_endpoint",
+    "metrics_port_from_env",
     "parse_profile_steps",
+    "publish_metrics_endpoint",
+    "record_breach",
     "record_event",
+    "reset_fleet",
     "reset_flight_recorder",
     "reset_profile_manager",
     "reset_spans",
     "reset_telemetry",
     "set_profile_manager",
     "set_telemetry",
+    "slo_targets_from_env",
     "span",
     "start_default_server",
     "start_endpoint_from_env",
@@ -180,6 +212,25 @@ def install_default_collectors(registry: MetricsRegistry | None = None):
     registry.register_collector(_memory)
 
 
+def metrics_port_from_env() -> int:
+    """The ACCELERATE_METRICS_PORT contract, parsed in ONE place (the worker
+    install, `launch --fleet_metrics` validation, and `accelerate-tpu top`'s
+    default endpoint all call this, so the contract cannot drift): 0 means
+    no endpoint is configured (unset/empty/explicit 0), garbage raises the
+    same enumerating error everywhere."""
+    from ..utils.constants import ENV_METRICS_PORT
+
+    port_raw = os.environ.get(ENV_METRICS_PORT, "").strip()
+    if not port_raw:
+        return 0
+    try:
+        return int(port_raw)
+    except ValueError:
+        raise ValueError(
+            f"{ENV_METRICS_PORT}={port_raw!r} must be an integer port"
+        ) from None
+
+
 def start_endpoint_from_env(local_rank: int | None = None) -> "MetricsServer | None":
     """Start the env-contract Prometheus endpoint (ACCELERATE_METRICS_PORT),
     shared by PartialState's init install and ``get_telemetry``'s fallback so
@@ -189,17 +240,7 @@ def start_endpoint_from_env(local_rank: int | None = None) -> "MetricsServer | N
     never a training failure. Returns the running server, or None."""
     import logging
 
-    from ..utils.constants import ENV_METRICS_PORT
-
-    port_raw = os.environ.get(ENV_METRICS_PORT, "").strip()
-    if not port_raw:
-        return None
-    try:
-        port = int(port_raw)
-    except ValueError:
-        raise ValueError(
-            f"{ENV_METRICS_PORT}={port_raw!r} must be an integer port"
-        ) from None
+    port = metrics_port_from_env()
     if port <= 0:
         # Env contract: 0 = no HTTP endpoint (the registry still feeds
         # trackers). Ephemeral-port binding is the explicit-API path
@@ -256,6 +297,7 @@ class Telemetry:
         metrics_port: int | None = None,
         registry: MetricsRegistry | None = None,
         profiler: "ProfileManager | None" = None,
+        slo: "SLOSentinel | None" = None,
     ):
         self.enabled = bool(enabled)
         self.registry = registry if registry is not None else get_registry()
@@ -279,6 +321,18 @@ class Telemetry:
             # endpoint then answers 503 "no profiler armed", which is true.
             self.profiler = None
         self.flight = get_flight_recorder()
+        # SLO sentinel (telemetry/slo.py): explicit instance wins; otherwise
+        # the launcher's env contract (ACCELERATE_SLO_STEP_TIME/TTFT/TPOT)
+        # arms one, or no target is configured and the sentinel stays off.
+        # Disabled telemetry never feeds step boundaries, so no sentinel.
+        if slo is not None:
+            self.slo = slo
+        elif self.enabled:
+            from .slo import sentinel_from_env
+
+            self.slo = sentinel_from_env()
+        else:
+            self.slo = None
         self.server: MetricsServer | None = None
         if metrics_port is not None:
             self.server = start_default_server(int(metrics_port), registry=self.registry)
@@ -313,6 +367,9 @@ class Telemetry:
                 self.profiler.step_boundary(step=step, wall_s=wall, steps=window)
                 self.flight.note_step(step=step, wall_s=wall, steps=window,
                                       transfers=_transfer_snapshot())
+                if self.slo is not None and wall is not None:
+                    self.slo.observe_step(wall, steps=window, step=step,
+                                          mfu=self.timeline.last_mfu)
             else:
                 # The fused program already marked this boundary (and fed the
                 # profiler/black box); just pin the loop's step numbering so
@@ -355,12 +412,17 @@ class Telemetry:
         self.profiler.step_boundary(wall_s=wall, steps=steps)
         self.flight.note_step(wall_s=wall, steps=steps,
                               transfers=_transfer_snapshot())
+        if self.slo is not None and wall is not None:
+            self.slo.observe_step(wall, steps=steps,
+                                  mfu=self.timeline.last_mfu)
 
     # --------------------------------------------------------------- reading
     def summary(self) -> dict:
         out = {"timeline": self.timeline.summary()}
         if self.straggler.last_report is not None:
             out["straggler"] = self.straggler.last_report.to_dict()
+        if self.slo is not None and self.slo.active:
+            out["slo"] = self.slo.summary()
         return out
 
     def close(self):

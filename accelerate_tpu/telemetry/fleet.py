@@ -1,0 +1,569 @@
+"""Cross-host metric aggregation — one pane for an N-host job.
+
+Every observability surface below this module is per-host: each worker runs
+its own Prometheus endpoint and an operator of an N-host job has N scrape
+targets and no single pane. This module joins them:
+
+- **Registration** (:func:`publish_metrics_endpoint`): each worker publishes
+  its *actually bound* ``host:port`` (ephemeral ports included — the bound
+  port is read off the live server, never guessed from the env contract)
+  into the JAX coordination-service KV namespace ``at_fleet/metrics`` — the
+  same transport the ``utils/agreement`` fallbacks ride, so discovery works
+  on collective-less rigs too. Single-process runs register in-module.
+- **Discovery** (:func:`discover_endpoints`): the lead host blocks on every
+  rank's key, so no operator-supplied address list exists anywhere.
+- **Aggregation** (:class:`FleetAggregator`): scrape every registered
+  endpoint, relabel every series with ``host="<process_index>"``, and fold
+  the per-host series into fleet rollups — fleet MFU, tokens/s, the goodput
+  split, step-time min/median/max/skew, KV-pool utilization, restart /
+  reshard / health-trip / SLO-breach totals. Re-exported two ways on the
+  existing HTTP server (``telemetry/metrics.py`` routes ``/fleet`` to the
+  installed provider): ``GET /fleet`` returns the JSON snapshot
+  (``accelerate-tpu top`` consumes it) and ``GET /fleet/metrics`` the joined
+  per-host-labeled Prometheus exposition (one target for an external
+  scraper).
+
+Scrapes happen on demand (a ``/fleet`` request or ``snapshot()`` call) with a
+short cache — no background thread, no per-step cost, and nothing here ever
+touches a device.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import socket
+import statistics
+import threading
+import time
+import urllib.error
+import urllib.request
+
+FLEET_SCHEMA_VERSION = 1
+
+# Coordination-service KV namespace for endpoint registration. Deliberately
+# NOT the agreement module's single-use-namespace contract: registrations are
+# persistent facts (one key per rank for the life of the job), not a barrier
+# exchange.
+KV_NAMESPACE = "at_fleet/metrics"
+
+_LOCK = threading.Lock()
+_LOCAL_ENDPOINT: str | None = None
+_KNOWN_ENDPOINTS: dict[int, str] = {}  # rank -> host:port (local + discovered)
+
+
+def local_host_address() -> str:
+    """The address other hosts can reach this worker's endpoint on: the
+    interface that routes to the JAX coordinator when one is configured
+    (a UDP connect pays no traffic), else loopback (single host / CPU-sim
+    gangs share a machine)."""
+    import os
+
+    from ..utils.constants import ENV_COORDINATOR
+
+    coordinator = os.environ.get(ENV_COORDINATOR, "").strip()
+    if coordinator:
+        host = coordinator.rsplit(":", 1)[0]
+        try:
+            probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            try:
+                probe.connect((host, 1))
+                return probe.getsockname()[0]
+            finally:
+                probe.close()
+        except OSError:
+            pass
+    return "127.0.0.1"
+
+
+def _kv_client():
+    try:
+        from jax._src.distributed import global_state as dist_state
+
+        return dist_state.client
+    except Exception:
+        return None
+
+
+def publish_metrics_endpoint(process_index: int = 0, server=None,
+                             host: str | None = None) -> str | None:
+    """Publish this worker's bound metrics endpoint into the fleet registry.
+
+    ``server`` defaults to the running process-wide endpoint; the published
+    port is the server's ACTUALLY bound port (``server.port`` — so a port-0
+    ephemeral bind and the co-located-worker local-rank offset both publish
+    the truth instead of the requested number). Returns the published
+    ``host:port``, or None when no endpoint is serving. Registration is
+    idempotent per process; re-publishing (an elastic restart re-binding the
+    same port) overwrites the rank's key where the coordination service
+    allows it and is best-effort otherwise — aggregation, not correctness,
+    depends on it."""
+    global _LOCAL_ENDPOINT
+    if server is None:
+        from .metrics import default_server
+
+        server = default_server()
+    if server is None or server.port is None:
+        return None
+    endpoint = f"{host or local_host_address()}:{server.port}"
+    with _LOCK:
+        _LOCAL_ENDPOINT = endpoint
+        _KNOWN_ENDPOINTS[int(process_index)] = endpoint
+    client = _kv_client()
+    if client is not None:
+        key = f"{KV_NAMESPACE}/{int(process_index)}"
+        try:
+            client.key_value_set(key, endpoint)
+        except Exception:
+            # A stale key from a prior incarnation: replace it.
+            try:
+                client.key_value_delete(key)
+                client.key_value_set(key, endpoint)
+            except Exception:
+                pass
+    return endpoint
+
+
+def metrics_endpoint() -> str | None:
+    """This process's published ``host:port`` (None before any publish) —
+    surfaced as ``PartialState.metrics_endpoint``."""
+    return _LOCAL_ENDPOINT
+
+
+def cached_endpoint(process_index: int) -> str | None:
+    """A rank's endpoint IF already known locally (published here or
+    discovered by an aggregator) — non-blocking, for best-effort surfaces
+    like the straggler warning naming the slow host's scrape address."""
+    with _LOCK:
+        return _KNOWN_ENDPOINTS.get(int(process_index))
+
+
+def discover_endpoints(num_processes: int, timeout_ms: int = 60_000) -> dict:
+    """``{rank: "host:port"}`` for every rank that HAS registered, read from
+    the KV registry. ``timeout_ms`` is a TOTAL budget shared across the
+    blocking reads (registered keys answer instantly), so N absent workers
+    cost one window, not N stacked ones. A rank that never registered — its
+    metrics bind failed, which ``start_endpoint_from_env`` deliberately
+    degrades to a warning — is simply absent from the result, never an
+    exception: the aggregator renders it as a down row instead of blanking
+    the pane. Without a distributed client (single process) returns the
+    local registration only."""
+    client = _kv_client()
+    if client is None or num_processes <= 1:
+        with _LOCK:
+            return dict(_KNOWN_ENDPOINTS)
+    endpoints = {}
+    ranks = list(range(int(num_processes)))
+    deadline = time.monotonic() + timeout_ms / 1000.0
+    for i, rank in enumerate(ranks):
+        remaining_ms = int((deadline - time.monotonic()) * 1000)
+        if remaining_ms <= 0:
+            # Budget exhausted: stop reading. Already-cached ranks keep
+            # their addresses (the caller merges), unread ranks stay absent
+            # until the next refresh's budget.
+            break
+        # Fair slice of the remaining budget per still-unread rank, so a
+        # missing LOW rank cannot starve the reads of registered higher
+        # ranks (registered keys answer instantly and return their slice).
+        slice_ms = max(50, remaining_ms // (len(ranks) - i))
+        try:
+            endpoints[rank] = client.blocking_key_value_get(
+                f"{KV_NAMESPACE}/{rank}", slice_ms
+            )
+        except Exception:
+            continue  # not registered (yet) — degradation, not failure
+    with _LOCK:
+        _KNOWN_ENDPOINTS.update(endpoints)
+    return endpoints
+
+
+def reset_fleet():
+    """Drop registration/discovery state and any installed provider — tests."""
+    global _LOCAL_ENDPOINT
+    with _LOCK:
+        _LOCAL_ENDPOINT = None
+        _KNOWN_ENDPOINTS.clear()
+    from .metrics import set_fleet_provider
+
+    set_fleet_provider(None)
+
+
+# ------------------------------------------------------------------- parsing
+_SERIES_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(?P<labels>.*)\})?\s+(?P<value>\S+)$"
+)
+
+
+def parse_prometheus_text(text: str) -> dict:
+    """Prometheus text exposition → ``{family: {"kind": t, "series":
+    {labels_str: value}}}`` (histogram ``_bucket``/``_sum``/``_count`` series
+    keep their suffixed names inside the base family's series dict, so the
+    join loses nothing)."""
+    families: dict = {}
+    kinds: dict = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            if len(parts) >= 4:
+                kinds[parts[2]] = parts[3]
+            continue
+        if line.startswith("#"):
+            continue
+        match = _SERIES_RE.match(line)
+        if not match:
+            continue
+        name = match.group("name")
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[: -len(suffix)] in kinds:
+                base = name[: -len(suffix)]
+                break
+        try:
+            value = float(match.group("value"))
+        except ValueError:
+            continue
+        family = families.setdefault(
+            base, {"kind": kinds.get(base, "untyped"), "series": {}}
+        )
+        labels = match.group("labels") or ""
+        family["series"][f"{name}{{{labels}}}" if labels else name] = value
+    return families
+
+
+def _series_value(families: dict, family: str, labels: str | None = None):
+    fam = families.get(family)
+    if not fam:
+        return None
+    key = f"{family}{{{labels}}}" if labels else family
+    return fam["series"].get(key)
+
+
+_HOST_LABEL_RE = re.compile(r'(^|,)host="')
+
+
+def _relabel_host(labels: str) -> str:
+    """A series that already carries a ``host`` label (the straggler's
+    ``accelerate_host_step_seconds{host=}`` gauges) must not gain a duplicate
+    — duplicate label names are an invalid exposition an external Prometheus
+    rejects wholesale. The pre-existing label renames to ``exported_host``
+    (the Prometheus honor_labels=false convention) before the scraped-rank
+    ``host`` is injected."""
+    return _HOST_LABEL_RE.sub(r'\1exported_host="', labels) if labels else labels
+
+
+def _inject_host_label(line: str, host: str) -> str:
+    """Rewrite one exposition series line with ``host="<h>"`` as the first
+    label (comment lines pass through; a pre-existing ``host`` label renames
+    to ``exported_host``)."""
+    if not line or line.startswith("#"):
+        return line
+    match = _SERIES_RE.match(line.strip())
+    if not match:
+        return line
+    name, labels, value = match.group("name"), match.group("labels"), match.group("value")
+    labels = _relabel_host(labels)
+    inner = f'host="{host}"' + (f",{labels}" if labels else "")
+    return f"{name}{{{inner}}} {value}"
+
+
+class FleetAggregator:
+    """Scrape every registered worker endpoint and join the series; see
+    module docstring.
+
+    ``state`` (a ``PartialState``-like object) supplies ``num_processes`` for
+    KV discovery; ``endpoints`` (``{rank: "host:port"}`` or a plain list)
+    overrides discovery for tests and ad-hoc operator use. ``cache_s`` bounds
+    scrape frequency under polling consoles; ``timeout_s`` bounds one
+    endpoint's scrape so a dead host marks down instead of wedging the pane.
+    """
+
+    #: Total re-discovery budget on refreshes AFTER the first (registered
+    #: keys answer instantly; permanently absent ranks SHARE this much per
+    #: refresh, bounded by cache_s).
+    REDISCOVER_TIMEOUT_MS = 2_000
+
+    def __init__(self, state=None, endpoints=None, timeout_s: float = 3.0,
+                 cache_s: float = 1.0, discover_timeout_ms: int = 60_000):
+        self._state = state
+        if isinstance(endpoints, (list, tuple)):
+            endpoints = {i: ep for i, ep in enumerate(endpoints)}
+        # An explicit endpoint map pins the fleet (tests, ad-hoc operator
+        # use); otherwise discovery re-reads the KV registry on every
+        # refresh so a worker that re-publishes after an elastic restart
+        # (new bind, same rank) is picked up without restarting the lead.
+        self._static = endpoints is not None
+        self._endpoints = dict(endpoints) if endpoints else None
+        self.timeout_s = float(timeout_s)
+        self.cache_s = float(cache_s)
+        self.discover_timeout_ms = int(discover_timeout_ms)
+        self._lock = threading.Lock()
+        # Serializes whole refreshes: the aggregator serves from a
+        # ThreadingHTTPServer, and two concurrent cache misses (an external
+        # scraper + a polling console) must coalesce into ONE fleet scrape,
+        # not two — the cache_s bound is a promise to the workers.
+        self._refresh_lock = threading.Lock()
+        self._cached: dict | None = None
+        self._cached_at = 0.0
+        self._raw: dict = {}  # rank -> exposition text of the last scrape
+
+    # ------------------------------------------------------------- discovery
+    def _num_ranks(self) -> int:
+        if self._static:
+            return len(self._endpoints)
+        n = int(getattr(self._state, "num_processes", 1) or 1) if self._state else 1
+        return max(n, 1)
+
+    def endpoints(self) -> dict:
+        """``{rank: "host:port"}`` for every rank currently known. The first
+        call blocks up to ``discover_timeout_ms`` TOTAL (workers register at
+        init — normally instant); later calls re-read the registry inside a
+        short shared budget so re-publications land and a still-missing rank
+        degrades to a down row instead of wedging the pane."""
+        if self._static:
+            return self._endpoints
+        n = self._num_ranks()
+        with self._lock:
+            known = dict(self._endpoints) if self._endpoints is not None else None
+        timeout = (self.discover_timeout_ms if known is None
+                   else self.REDISCOVER_TIMEOUT_MS)
+        discovered = discover_endpoints(n, timeout_ms=timeout)
+        merged = dict(known or {})
+        merged.update(discovered)  # re-publication wins; a read miss keeps the cached address
+        with self._lock:
+            self._endpoints = merged
+        return merged
+
+    # --------------------------------------------------------------- scraping
+    def _scrape(self, endpoint: str) -> str:
+        with urllib.request.urlopen(
+            f"http://{endpoint}/metrics", timeout=self.timeout_s
+        ) as response:
+            return response.read().decode("utf-8", "replace")
+
+    def refresh(self) -> dict:
+        """Scrape every endpoint now; returns the fresh snapshot. Down hosts
+        degrade to ``up: false`` rows — one dead worker must not blank the
+        pane for the rest of the fleet."""
+        hosts: dict = {}
+        raw: dict = {}
+        series: dict = {}
+        per_host: dict = {}
+        endpoints = self.endpoints()
+        # Every EXPECTED rank gets a row: one whose endpoint never registered
+        # (its metrics bind failed at init) renders as down, same as a dead
+        # scrape — never an exception, never a blank pane.
+        ranks = sorted(set(range(self._num_ranks())) | set(endpoints))
+        # Scrapes run concurrently so refresh wall time is bounded by ONE
+        # timeout_s, not the sum over down hosts — otherwise two black-holed
+        # workers push every /fleet response past the console's transport
+        # timeout and the pane dies exactly when it matters.
+        scraped: dict = {}
+        to_scrape = [r for r in ranks if endpoints.get(r) is not None]
+        if to_scrape:
+            from concurrent.futures import ThreadPoolExecutor
+
+            # One thread per endpoint (idle HTTP I/O, count == fleet size):
+            # refresh wall time really is bounded by one timeout_s even when
+            # most of the fleet is black-holed.
+            with ThreadPoolExecutor(max_workers=len(to_scrape)) as pool:
+                futures = {r: pool.submit(self._scrape, endpoints[r])
+                           for r in to_scrape}
+                for r, future in futures.items():
+                    try:
+                        scraped[r] = future.result()
+                    except Exception as exc:
+                        scraped[r] = exc
+        for rank in ranks:
+            endpoint = endpoints.get(rank)
+            row: dict = {"endpoint": endpoint, "up": False}
+            if endpoint is None:
+                row["error"] = "no metrics endpoint registered for this rank"
+                hosts[str(rank)] = row
+                continue
+            text = scraped[rank]
+            if isinstance(text, Exception):
+                row["error"] = f"{type(text).__name__}: {text}"[:200]
+                hosts[str(rank)] = row
+                continue
+            raw[rank] = text
+            families = parse_prometheus_text(text)
+            per_host[rank] = families
+            row["up"] = True
+            hist = families.get("accelerate_step_seconds", {}).get("series", {})
+            s_sum = hist.get("accelerate_step_seconds_sum", 0.0)
+            s_count = hist.get("accelerate_step_seconds_count", 0.0)
+            row["steps"] = int(s_count)
+            row["step_s_mean"] = round(s_sum / s_count, 6) if s_count else None
+            row["tokens_per_s"] = _series_value(
+                families, "accelerate_tokens_per_second")
+            row["mfu"] = _series_value(families, "accelerate_mfu_estimate")
+            row["goodput_fraction"] = _series_value(
+                families, "accelerate_goodput_fraction")
+            row["restarts"] = _series_value(families, "accelerate_restarts")
+            row["kv_pool_utilization"] = _series_value(
+                families, "accelerate_serving_kv_pool_utilization")
+            breaches = {}
+            for key, value in families.get(
+                "accelerate_slo_breaches_total", {}
+            ).get("series", {}).items():
+                m = re.search(r'target="([^"]*)"', key)
+                if m:
+                    breaches[m.group(1)] = int(value)
+            row["slo_breaches"] = breaches
+            hosts[str(rank)] = row
+            for family, payload in families.items():
+                for key, value in payload["series"].items():
+                    name, _, labels = key.partition("{")
+                    labels = _relabel_host(labels[:-1] if labels else "")
+                    inner = f'host="{rank}"' + (f",{labels}" if labels else "")
+                    series[f"{name}{{{inner}}}"] = value
+        snapshot = {
+            "schema_version": FLEET_SCHEMA_VERSION,
+            "generated_at": time.time(),
+            "hosts": hosts,
+            "fleet": self._rollups(hosts, per_host),
+            "series": series,
+        }
+        with self._lock:
+            self._raw = raw
+            self._cached = snapshot
+            self._cached_at = time.monotonic()
+        return snapshot
+
+    def _rollups(self, hosts: dict, per_host: dict) -> dict:
+        """Fold per-host rows into the fleet view the control room reads."""
+        up = [row for row in hosts.values() if row["up"]]
+        step_means = [row["step_s_mean"] for row in up
+                      if row.get("step_s_mean") is not None]
+        mfus = [row["mfu"] for row in up if row.get("mfu") is not None]
+        toks = [row["tokens_per_s"] for row in up
+                if row.get("tokens_per_s") is not None]
+        goodput = [row["goodput_fraction"] for row in up
+                   if row.get("goodput_fraction") is not None]
+        pools = [row["kv_pool_utilization"] for row in up
+                 if row.get("kv_pool_utilization") is not None]
+        badput: dict = {}
+        trips = resharded = restarts = 0.0
+        breaches: dict = {}
+        for rank, families in per_host.items():
+            for key, value in families.get(
+                "accelerate_badput_seconds", {}
+            ).get("series", {}).items():
+                m = re.search(r'category="([^"]*)"', key)
+                if m:
+                    badput[m.group(1)] = round(
+                        badput.get(m.group(1), 0.0) + value, 3
+                    )
+            for key, value in families.get(
+                "accelerate_health_trips_total", {}
+            ).get("series", {}).items():
+                trips += value
+            for key, value in families.get(
+                "accelerate_reshard_transitions_total", {}
+            ).get("series", {}).items():
+                resharded += value
+            restarts += _series_value(families, "accelerate_restarts") or 0.0
+        for row in up:
+            for target, count in row.get("slo_breaches", {}).items():
+                breaches[target] = breaches.get(target, 0) + count
+        step = {}
+        if step_means:
+            med = statistics.median(step_means)
+            step = {
+                "min": round(min(step_means), 6),
+                "median": round(med, 6),
+                "max": round(max(step_means), 6),
+                "skew": round(max(step_means) / med, 4) if med > 0 else 1.0,
+            }
+        return {
+            "hosts_total": len(hosts),
+            "hosts_up": len(up),
+            "mfu": round(sum(mfus) / len(mfus), 6) if mfus else None,
+            "tokens_per_s": round(sum(toks), 3) if toks else None,
+            "goodput": {
+                "fraction": round(sum(goodput) / len(goodput), 6)
+                if goodput else None,
+                "badput_s": badput,
+            },
+            "step_s": step,
+            "kv_pool_utilization": round(sum(pools) / len(pools), 6)
+            if pools else None,
+            "restarts": int(restarts),
+            "reshard_transitions": int(resharded),
+            "health_trips": int(trips),
+            "slo_breaches": breaches,
+        }
+
+    # ---------------------------------------------------------------- exports
+    def snapshot(self) -> dict:
+        """The fleet snapshot (cached up to ``cache_s`` under polling) — the
+        ``GET /fleet`` body and the ``accelerate-tpu top`` feed. Concurrent
+        cache misses coalesce: one thread scrapes, the rest serve its
+        result."""
+        with self._lock:
+            cached, at = self._cached, self._cached_at
+        if cached is not None and time.monotonic() - at < self.cache_s:
+            return cached
+        with self._refresh_lock:
+            with self._lock:  # another thread may have refreshed while we waited
+                cached, at = self._cached, self._cached_at
+            if cached is not None and time.monotonic() - at < self.cache_s:
+                return cached
+            return self.refresh()
+
+    def prometheus_text(self) -> str:
+        """The joined per-host-labeled exposition (``GET /fleet/metrics``):
+        every scraped series re-emitted with ``host="<rank>"`` injected, one
+        ``# TYPE`` header per family."""
+        self.snapshot()  # ensure a scrape happened recently
+        with self._lock:
+            raw = dict(self._raw)
+        lines: list[str] = []
+        seen_types: set = set()
+        for rank in sorted(raw):
+            for line in raw[rank].splitlines():
+                stripped = line.strip()
+                if stripped.startswith("# TYPE "):
+                    if stripped not in seen_types:
+                        seen_types.add(stripped)
+                        lines.append(stripped)
+                    continue
+                if not stripped or stripped.startswith("#"):
+                    continue
+                lines.append(_inject_host_label(stripped, str(rank)))
+        return "\n".join(lines) + "\n"
+
+
+def install_fleet_provider(aggregator: FleetAggregator) -> FleetAggregator:
+    """Route the HTTP server's ``/fleet`` + ``/fleet/metrics`` to this
+    aggregator (the lead-host install ``ACCELERATE_FLEET_METRICS=1`` performs
+    at PartialState init)."""
+    from .metrics import set_fleet_provider
+
+    set_fleet_provider(aggregator)
+    return aggregator
+
+
+def fetch_fleet_snapshot(endpoint: str, timeout_s: float = 10.0) -> dict:
+    """GET ``http://<endpoint>/fleet`` → snapshot dict (the ``top`` console's
+    transport). Falls back to aggregating the single endpoint client-side
+    when the server has no fleet provider (404/503) — a bare worker is then
+    still inspectable as a one-host fleet."""
+    endpoint = endpoint.strip()
+    if endpoint.startswith("http://") or endpoint.startswith("https://"):
+        endpoint = endpoint.split("://", 1)[1]
+    endpoint = endpoint.rstrip("/")
+    try:
+        with urllib.request.urlopen(
+            f"http://{endpoint}/fleet", timeout=timeout_s
+        ) as response:
+            return json.loads(response.read().decode("utf-8", "replace"))
+    except urllib.error.HTTPError as exc:
+        if exc.code not in (404, 503):
+            raise
+        return FleetAggregator(
+            endpoints={0: endpoint}, timeout_s=timeout_s, cache_s=0.0
+        ).refresh()

@@ -93,6 +93,13 @@ class _Metric:
                 for key, v in self._series.items()
             }
 
+    def series_values(self) -> dict:
+        """``{label-values tuple: value}`` for every series — the public
+        read face for consumers that aggregate by label (the SLO breach
+        table), so nothing outside this module touches the storage layout."""
+        with self._lock:
+            return {key: float(v) for key, v in self._series.items()}
+
 
 class Counter(_Metric):
     kind = "counter"
@@ -308,15 +315,63 @@ def set_profile_trigger(fn):
     _PROFILE_TRIGGER = fn
 
 
+def profile_trigger():
+    """The installed capture trigger, if any — the hook a serving-side SLO
+    breach uses to arm a trace of the windows right after the breach
+    (telemetry/requests.py), without importing the profiler."""
+    return _PROFILE_TRIGGER
+
+
+# Fleet aggregation provider (telemetry/fleet.py installs the lead host's
+# FleetAggregator here) — the same injected-hook pattern as the profile
+# trigger, so this module keeps importing nothing from the framework. The
+# provider answers GET /fleet (JSON snapshot) and GET /fleet/metrics (joined
+# per-host-labeled Prometheus exposition).
+_FLEET_PROVIDER = None
+
+
+def set_fleet_provider(provider):
+    """``provider.snapshot() -> dict`` / ``provider.prometheus_text() -> str``
+    serve /fleet; None uninstalls (503 until an aggregator is installed)."""
+    global _FLEET_PROVIDER
+    _FLEET_PROVIDER = provider
+
+
+def fleet_provider():
+    return _FLEET_PROVIDER
+
+
 class _MetricsHandler(BaseHTTPRequestHandler):
     registry: MetricsRegistry = None
 
     def do_GET(self):  # noqa: N802 (http.server contract)
-        if self.path.split("?")[0] in ("/metrics", "/metrics/"):
+        path = self.path.split("?")[0].rstrip("/") or "/"
+        if path == "/metrics":
             body = self.registry.prometheus_text().encode()
             ctype = "text/plain; version=0.0.4; charset=utf-8"
-        elif self.path.split("?")[0] in ("/", "/healthz"):
+        elif path in ("/", "/healthz"):
             body, ctype = b"ok\n", "text/plain"
+        elif path in ("/fleet", "/fleet/metrics"):
+            provider = _FLEET_PROVIDER
+            if provider is None:
+                self._respond_json(
+                    503,
+                    {"error": "no fleet aggregator installed in this process "
+                              "(lead host with ACCELERATE_FLEET_METRICS=1)"},
+                )
+                return
+            try:
+                if path == "/fleet":
+                    import json
+
+                    body = json.dumps(provider.snapshot()).encode()
+                    ctype = "application/json"
+                else:
+                    body = provider.prometheus_text().encode()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+            except Exception as exc:  # a bad scrape must not kill the server
+                self._respond_json(500, {"error": repr(exc)})
+                return
         else:
             self.send_error(404)
             return

@@ -113,6 +113,15 @@ class SlowStepDetector:
         self._mad = 0.0
         self._count = 0
 
+    @property
+    def trip_threshold(self) -> float:
+        """The wall time a slow observation must exceed to trip
+        (``EMA + zscore·σ̂``, σ̂ the MAD-proxy sigma) — the budget the SLO
+        sentinel's auto-baseline records as breach evidence. Meaningful once
+        warm; a tripped observation never updates the statistics, so reading
+        this after a trip reports the threshold that was actually enforced."""
+        return self._ema + self.zscore * _MAD_TO_SIGMA * self._mad
+
     def observe(self, wall_s: float) -> tuple:
         """One completed step's wall time → ``(tripped, z)``."""
         wall_s = float(wall_s)

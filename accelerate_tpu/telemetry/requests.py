@@ -1,0 +1,257 @@
+"""Per-request serving traces — the lifecycle record behind every TTFT.
+
+The serving engine's histograms say *that* TTFT regressed; the question that
+decides the fix is what happened to the slow requests: were they deferred at
+admission, did their prefill chunk behind a long neighbor, did decode windows
+stall? :class:`RequestTracer` keeps one structured record per request in a
+bounded overwrite-oldest ring, fed by the host-side points the
+``ContinuousBatcher`` loop already passes through:
+
+``submit`` → admission decision (``admit``/``defer``/``escalate``, with queue
+wait and aliased-block count) → prefill chunks (sizes, in dispatch order) →
+first token (TTFT) → decode windows → ``finish``/``cancel`` (tokens out,
+TPOT).
+
+Recording discipline matches the engine's one-window-lookahead sync: every
+hook fires from host bookkeeping the loop performs anyway (admission surgery,
+the report processed one window AFTER it was dispatched), so tracing drains
+only through the existing counted no-blocking-fetch discipline and adds ZERO
+device transfers — the steady-state pin tests/test_fleet.py holds against
+the transfer counters.
+
+SLO coupling: when the engine carries :class:`~..serving.SLOTargets`, a
+first-token observation over the TTFT budget (or a finish over the TPOT
+budget) books through :func:`..telemetry.slo.record_breach` — counter +
+flight-recorder event + rate-limited warning — and a TTFT breach arms an XLA
+trace capture of the next decode windows via the profile trigger the metrics
+server installs (:func:`..telemetry.metrics.set_profile_trigger`), so the
+evidence for the breach is captured while the regression is still live.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+
+# Ring bound on retained request records (the serving engine's _SLO_HISTORY
+# idiom): a long-lived engine serves unbounded requests; the Prometheus
+# histograms keep the full distributions, the ring keeps the recent evidence.
+DEFAULT_CAPACITY = 1024
+
+# Decode windows a TTFT-breach-armed capture traces.
+BREACH_CAPTURE_STEPS = 2
+
+
+# The step timeline's nearest-rank quantile — one implementation, so serving
+# request quantiles can never diverge from step-time quantiles.
+from .timeline import _quantile
+
+
+class RequestTracer:
+    """Bounded per-request lifecycle ring; see module docstring.
+
+    ``slo`` is the engine's :class:`~..serving.SLOTargets` (None = no breach
+    evaluation); ``arm_profile_on_breach`` lets a TTFT breach arm a trace
+    capture through the installed profile trigger; ``clock`` is injectable
+    for deterministic tests.
+    """
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY, slo=None,
+                 arm_profile_on_breach: bool = True, clock=time.monotonic):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self.slo = slo
+        self.arm_profile_on_breach = bool(arm_profile_on_breach)
+        self._clock = clock
+        self._ring: OrderedDict[int, dict] = OrderedDict()
+        self.total = 0       # records ever started (keeps counting past evictions)
+        self.breaches = 0    # breaches this tracer booked
+
+    # ------------------------------------------------------------- recording
+    def _get(self, rid: int) -> dict | None:
+        return self._ring.get(rid)
+
+    def submit(self, rid: int, prompt_tokens: int, submit_t: float | None = None):
+        record = {
+            "rid": int(rid),
+            "state": "queued",
+            "prompt_tokens": int(prompt_tokens),
+            "submit_t": float(submit_t if submit_t is not None else self._clock()),
+            "decision": None,
+            "queue_wait_s": None,
+            "defers": 0,
+            "aliased_blocks": 0,
+            "planned_chunks": None,
+            "chunks": [],
+            "ttft_s": None,
+            "decode_windows": 0,
+            "tokens_out": None,
+            "tpot_s": None,
+            "total_s": None,
+            "breached": [],
+        }
+        self._ring[rid] = record
+        self.total += 1
+        while len(self._ring) > self.capacity:
+            self._ring.popitem(last=False)  # overwrite-oldest
+
+    def admit(self, rid: int, decision: str = "admit", aliased_blocks: int = 0,
+              chunks: int = 1):
+        """The admission verdict (``admit`` or ``escalate``) — also a
+        flight-recorder ``admission`` event, so a black-box dump shows the
+        scheduling decisions around a fault."""
+        record = self._get(rid)
+        if record is None:
+            return
+        now = self._clock()
+        record["state"] = "prefill"
+        record["decision"] = str(decision)
+        record["queue_wait_s"] = round(now - record["submit_t"], 6)
+        record["aliased_blocks"] = int(aliased_blocks)
+        record["planned_chunks"] = int(chunks)
+        # get_flight_recorder (not record_event): admission decisions must
+        # land in the black box even when nothing else created it yet.
+        from .flight import get_flight_recorder
+
+        get_flight_recorder().record(
+            "admission", rid=int(rid), decision=str(decision),
+            queue_wait_s=record["queue_wait_s"],
+        )
+
+    def defer(self, rid: int):
+        """A prefill chunk deferred in favor of decode (TPOT pacing). Counted
+        per request; only the FIRST defer lands a flight event — a long
+        deferral would otherwise flood the ring with one event per engine
+        iteration."""
+        record = self._get(rid)
+        if record is None:
+            return
+        record["defers"] += 1
+        if record["defers"] == 1:
+            from .flight import get_flight_recorder
+
+            get_flight_recorder().record("admission", rid=int(rid),
+                                         decision="defer")
+
+    def prefill_chunk(self, rid: int, tokens: int, final: bool):
+        record = self._get(rid)
+        if record is None:
+            return
+        record["chunks"].append(int(tokens))
+        if final:
+            record["state"] = "decode"
+
+    def first_token(self, rid: int, at: float | None = None):
+        """First sampled token observed for ``rid`` (the engine calls this
+        from the host points it already pays — the admit return or the
+        lookahead report). Evaluates the TTFT target and, on breach, arms a
+        profile capture of the next decode windows."""
+        record = self._get(rid)
+        if record is None or record["ttft_s"] is not None:
+            return
+        now = float(at if at is not None else self._clock())
+        record["ttft_s"] = round(max(0.0, now - record["submit_t"]), 6)
+        target = getattr(self.slo, "ttft_s", None) if self.slo is not None else None
+        if target is not None and record["ttft_s"] > target:
+            record["breached"].append("ttft")
+            self.breaches += 1
+            from .slo import record_breach
+
+            record_breach("ttft", record["ttft_s"], target, rid=rid)
+            if self.arm_profile_on_breach:
+                self._arm_profile(rid)
+
+    def decode_window(self, rid: int):
+        record = self._get(rid)
+        if record is not None:
+            record["decode_windows"] += 1
+
+    def finish(self, rid: int, tokens_out: int, tpot_s: float | None = None,
+               at: float | None = None):
+        record = self._get(rid)
+        if record is None:
+            return
+        now = float(at if at is not None else self._clock())
+        record["state"] = "finished"
+        record["tokens_out"] = int(tokens_out)
+        record["total_s"] = round(max(0.0, now - record["submit_t"]), 6)
+        if tpot_s is None and record["ttft_s"] is not None and tokens_out > 1:
+            tpot_s = (now - record["submit_t"] - record["ttft_s"]) / (tokens_out - 1)
+        if tpot_s is not None:
+            record["tpot_s"] = round(max(0.0, float(tpot_s)), 6)
+            target = getattr(self.slo, "tpot_s", None) if self.slo is not None else None
+            if target is not None and record["tpot_s"] > target:
+                record["breached"].append("tpot")
+                self.breaches += 1
+                from .slo import record_breach
+
+                record_breach("tpot", record["tpot_s"], target, rid=rid)
+
+    def cancel(self, rid: int):
+        """The request's engine state was wiped before it finished
+        (``reset()`` mid-wave) — the record survives, marked cancelled."""
+        record = self._get(rid)
+        if record is not None and record["state"] not in ("finished", "cancelled"):
+            record["state"] = "cancelled"
+
+    def _arm_profile(self, rid: int):
+        """Arm a trace capture through the trigger the profiler installed on
+        the metrics server (set_profile_trigger) — best-effort: no profiler
+        armed (or one already engaged) must never affect serving."""
+        from .metrics import profile_trigger
+
+        trigger = profile_trigger()
+        if trigger is None:
+            return
+        try:
+            trigger(steps=BREACH_CAPTURE_STEPS, trigger="slo")
+        except Exception:
+            pass
+
+    # --------------------------------------------------------------- reading
+    def records(self) -> list:
+        """Retained records, oldest first (copies — the ring stays private)."""
+        return [dict(r) for r in self._ring.values()]
+
+    def slowest(self, n: int = 5) -> list:
+        """Top-``n`` retained requests by TTFT (requests still waiting on
+        their first token rank by their live wait) — the operator's
+        where-did-the-latency-go table."""
+        now = self._clock()
+
+        def ttft_key(record):
+            if record["ttft_s"] is not None:
+                return record["ttft_s"]
+            if record["state"] in ("queued", "prefill"):
+                return now - record["submit_t"]
+            return 0.0
+
+        ranked = sorted(self._ring.values(), key=ttft_key, reverse=True)
+        return [dict(r) for r in ranked[: max(int(n), 0)]]
+
+    def summary(self, slowest_n: int = 3) -> dict:
+        """TTFT/TPOT p50/p90/max over retained records plus the slowest-N
+        table — ``detail.serving.requests`` on BENCH_SERVING bench lines."""
+        records = list(self._ring.values())
+        ttft = sorted(r["ttft_s"] for r in records if r["ttft_s"] is not None)
+        tpot = sorted(r["tpot_s"] for r in records if r["tpot_s"] is not None)
+        states: dict = {}
+        for r in records:
+            states[r["state"]] = states.get(r["state"], 0) + 1
+        return {
+            "total": self.total,
+            "retained": len(records),
+            "states": states,
+            "breaches": self.breaches,
+            "ttft_s": {"p50": _quantile(ttft, 0.5), "p90": _quantile(ttft, 0.9),
+                       "max": ttft[-1] if ttft else 0.0},
+            "tpot_s": {"p50": _quantile(tpot, 0.5), "p90": _quantile(tpot, 0.9),
+                       "max": tpot[-1] if tpot else 0.0},
+            "slowest": [
+                {k: r.get(k) for k in ("rid", "state", "decision", "defers",
+                                       "queue_wait_s", "ttft_s", "tpot_s",
+                                       "tokens_out", "breached")}
+                for r in self.slowest(slowest_n)
+            ],
+        }

@@ -1,0 +1,222 @@
+"""Continuous SLO sentinel — watch the targets, not the logs.
+
+The observability stack so far *records* everything (step timeline, serving
+TTFT/TPOT histograms, goodput ledger) but nothing *watches* it: an operator
+learns about a step-time or TTFT regression from a user, not a gauge. The
+sentinel closes that loop with per-target evaluation on observations the
+loops already produce:
+
+- **step time / MFU** — fed one call per step (or K-step window) boundary by
+  :class:`..telemetry.Telemetry`; an explicit ``step_time_s`` target trips on
+  any per-step wall time over budget, and with no explicit target the
+  ``health/spike.py`` EMA+MAD baseline idiom (re-derived host-side in
+  :class:`..telemetry.profiler.SlowStepDetector`) trips on a robust-z
+  outlier instead — a regression is caught relative to the run's own recent
+  history. ``mfu_min`` trips when the timeline's achieved-MFU estimate
+  drops below the floor.
+- **TTFT / TPOT** — fed per request by the serving engine's
+  :class:`..telemetry.requests.RequestTracer` (docs/serving.md).
+
+Every breach books ONE place: :func:`record_breach` increments
+``accelerate_slo_breaches_total{target=...}``, lands a ``slo_breach`` event in
+the flight recorder (so a dump shows the breach next to what the run was
+doing), and raises a rate-limited warning. Evaluation is pure host
+arithmetic — no device work, no transfers, blocking or otherwise.
+
+Launcher contract (tri-state, the profile_slow_zscore precedent):
+``--slo_step_time`` / ``--slo_ttft`` / ``--slo_tpot`` export
+``ACCELERATE_SLO_STEP_TIME/TTFT/TPOT`` (seconds); an explicit 0 scrubs an
+inherited value and disables the dimension.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+from ..logging import get_logger
+
+logger = get_logger(__name__)
+
+# Breach targets are a closed vocabulary so dashboards and the fleet
+# aggregator can enumerate the label values.
+BREACH_TARGETS = ("step_time", "mfu", "ttft", "tpot")
+
+_BREACH_HANDLES = None  # metrics.cached_handles accessor
+
+
+def _breach_counter():
+    global _BREACH_HANDLES
+    if _BREACH_HANDLES is None:
+        from .metrics import cached_handles
+
+        _BREACH_HANDLES = cached_handles(lambda registry: registry.counter(
+            "accelerate_slo_breaches_total",
+            "SLO breaches observed by the sentinel, by target",
+            labelnames=("target",),
+        ))
+    return _BREACH_HANDLES()
+
+
+def record_breach(target: str, value: float, threshold: float,
+                  step=None, rid=None) -> None:
+    """Book one SLO breach everywhere it must land: the
+    ``accelerate_slo_breaches_total{target}`` counter, a ``slo_breach``
+    flight-recorder event, and a rate-limited warning. The single spelling
+    the sentinel AND the serving request tracer share."""
+    if target not in BREACH_TARGETS:
+        raise ValueError(
+            f"unknown SLO target {target!r}; expected one of {BREACH_TARGETS}"
+        )
+    _breach_counter().inc(target=target)
+    # get_flight_recorder (not record_event): a breach must land in the black
+    # box even when nothing else created the recorder yet.
+    from .flight import get_flight_recorder
+
+    data = {"target": target, "value": round(float(value), 6),
+            "threshold": round(float(threshold), 6)}
+    if rid is not None:
+        data["rid"] = int(rid)
+    get_flight_recorder().record("slo_breach", step=step, **data)
+    extra = f" (request {rid})" if rid is not None else ""
+    comparator = ">=" if target == "mfu" else "<="
+    logger.log_every_n(
+        10, logging.WARNING,
+        f"SLO breach: {target}={value:.6g} vs target {comparator} "
+        f"{threshold:.6g}{extra}"
+        + (f" at step {step}" if step is not None else ""),
+    )
+
+
+def breach_counts(registry=None) -> dict:
+    """``{target: count}`` from the registry's breach counter — what bench.py
+    snapshots around its measured window (``detail.slo``) and the fleet
+    aggregator rolls up."""
+    from .metrics import get_registry
+
+    registry = registry if registry is not None else get_registry()
+    counter = registry.counter(
+        "accelerate_slo_breaches_total",
+        "SLO breaches observed by the sentinel, by target",
+        labelnames=("target",),
+    )
+    return {key[0]: int(v) for key, v in counter.series_values().items()}
+
+
+def slo_targets_from_env() -> dict:
+    """The launcher's SLO env contract as floats (``None`` = dimension off):
+    ``{"step_time_s": ..., "ttft_s": ..., "tpot_s": ...}``. 0/empty = off."""
+    from ..utils.constants import ENV_SLO_STEP_TIME, ENV_SLO_TPOT, ENV_SLO_TTFT
+
+    out = {}
+    for key, env in (("step_time_s", ENV_SLO_STEP_TIME),
+                     ("ttft_s", ENV_SLO_TTFT), ("tpot_s", ENV_SLO_TPOT)):
+        raw = os.environ.get(env, "").strip()
+        try:
+            val = float(raw) if raw else 0.0
+        except ValueError:
+            raise ValueError(f"{env}={raw!r} must be a number of seconds") from None
+        out[key] = val if val > 0 else None
+    return out
+
+
+def serving_slo_from_env():
+    """An :class:`~..serving.SLOTargets` built from the env contract, or None
+    when neither serving dimension is configured — what ``ContinuousBatcher``
+    resolves when the caller passes ``slo=None``, so ``launch --slo_ttft``
+    reaches a serving tier with zero code."""
+    targets = slo_targets_from_env()
+    if targets["ttft_s"] is None and targets["tpot_s"] is None:
+        return None
+    from ..serving import SLOTargets
+
+    return SLOTargets(ttft_s=targets["ttft_s"], tpot_s=targets["tpot_s"])
+
+
+class SLOSentinel:
+    """Continuous target evaluation over the per-step feed; see module
+    docstring. ``step_time_s``/``mfu_min`` are explicit targets;
+    ``auto_zscore`` > 0 arms the EMA+MAD baseline fallback for step time when
+    no explicit target is set (``health/spike.py`` idiom — a tripped
+    observation never updates the baseline). ``ttft_s``/``tpot_s`` are
+    carried for ``summary()``/serving construction; the request tracer books
+    those breaches per request."""
+
+    def __init__(self, step_time_s: float | None = None,
+                 mfu_min: float | None = None,
+                 ttft_s: float | None = None, tpot_s: float | None = None,
+                 auto_zscore: float = 0.0, warmup_steps: int = 20):
+        for name, val in (("step_time_s", step_time_s), ("mfu_min", mfu_min),
+                          ("ttft_s", ttft_s), ("tpot_s", tpot_s)):
+            if val is not None and val <= 0:
+                raise ValueError(f"{name} must be > 0 (None disables), got {val}")
+        self.step_time_s = step_time_s
+        self.mfu_min = mfu_min
+        self.ttft_s = ttft_s
+        self.tpot_s = tpot_s
+        self._detector = None
+        if step_time_s is None and auto_zscore > 0:
+            from .profiler import SlowStepDetector
+
+            self._detector = SlowStepDetector(auto_zscore,
+                                              warmup_steps=warmup_steps)
+        self._breaches = 0
+
+    @property
+    def active(self) -> bool:
+        return (self.step_time_s is not None or self.mfu_min is not None
+                or self.ttft_s is not None or self.tpot_s is not None
+                or self._detector is not None)
+
+    # ---------------------------------------------------------------- feeding
+    def observe_step(self, wall_s: float, steps: int = 1, step=None,
+                     mfu: float | None = None) -> bool:
+        """One step (or K-step window) boundary's per-step wall time; returns
+        whether anything breached. Pure host arithmetic."""
+        breached = False
+        wall_s = float(wall_s)
+        if self.step_time_s is not None:
+            if wall_s > self.step_time_s:
+                record_breach("step_time", wall_s, self.step_time_s, step=step)
+                breached = True
+        elif self._detector is not None:
+            # No explicit target: the run's own recent history is the budget
+            # (EMA + MAD-proxy robust z — the spike detector's idiom).
+            tripped, z = self._detector.observe(wall_s)
+            if tripped:
+                # The budget actually enforced (EMA + z·σ̂), not the bare EMA
+                # — a tripped observation never updates the statistics, so
+                # the post-trip read reports the threshold this value beat.
+                record_breach("step_time", wall_s,
+                              self._detector.trip_threshold, step=step)
+                breached = True
+        if self.mfu_min is not None and mfu is not None and mfu < self.mfu_min:
+            record_breach("mfu", float(mfu), self.mfu_min, step=step)
+            breached = True
+        if breached:
+            self._breaches += 1
+        return breached
+
+    # --------------------------------------------------------------- reading
+    def summary(self) -> dict:
+        return {
+            "targets": {
+                "step_time_s": self.step_time_s,
+                "mfu_min": self.mfu_min,
+                "ttft_s": self.ttft_s,
+                "tpot_s": self.tpot_s,
+                "auto_baseline": self._detector is not None,
+            },
+            "breaches": breach_counts(),
+        }
+
+
+def sentinel_from_env() -> SLOSentinel | None:
+    """A sentinel built from the launcher's SLO env contract, or None when no
+    target is configured — what :class:`..telemetry.Telemetry` binds by
+    default (its per-step hooks then feed ``observe_step``)."""
+    targets = slo_targets_from_env()
+    if all(v is None for v in targets.values()):
+        return None
+    return SLOSentinel(step_time_s=targets["step_time_s"],
+                       ttft_s=targets["ttft_s"], tpot_s=targets["tpot_s"])

@@ -123,10 +123,17 @@ class StragglerMonitor:
         for host, v in enumerate(values):
             self._host_gauge.set(v, host=host)
         if report.tripped:
+            # Name the slow host's scrape address too when the fleet registry
+            # knows it (telemetry/fleet.py) — operators then go straight to
+            # the evidence instead of guessing which port rank N bound.
+            from .fleet import cached_endpoint
+
+            endpoint = cached_endpoint(slowest)
+            where = f" (metrics: http://{endpoint}/metrics)" if endpoint else ""
             logger.log_every_n(
                 10,
                 logging.WARNING,
-                f"straggler: host {slowest} mean step time "
+                f"straggler: host {slowest}{where} mean step time "
                 f"{values[slowest] * 1e3:.1f}ms is {ratio:.2f}x the median "
                 f"{median * 1e3:.1f}ms (threshold {self.slow_ratio:.2f}x) at "
                 f"step {step}",

@@ -126,6 +126,7 @@ class StepTimeline:
         self._last_step = None
         self._flops_per_token = None
         self._predicted_peak = None
+        self._last_mfu = None
         # Retained (NOT fetched) device loss scalars; drained when materialized.
         self._pending_loss: collections.deque = collections.deque(maxlen=4)
         self._last_loss = None
@@ -179,6 +180,12 @@ class StepTimeline:
     def last_wall_s(self) -> float | None:
         return self._ring[-1].wall_s if self._ring else None
 
+    @property
+    def last_mfu(self) -> float | None:
+        """Most recent per-boundary achieved-MFU estimate (None until tokens
+        and a model flop count are both known) — the SLO sentinel's MFU feed."""
+        return self._last_mfu
+
     # ------------------------------------------------------------- recording
     def step_end(self, step: int | None = None, tokens: int | None = None,
                  loss=None, steps: int = 1) -> float | None:
@@ -215,10 +222,11 @@ class StepTimeline:
                 tps = per_tokens / wall
                 self._tokens_gauge.set(tps)
                 if self._flops_per_token:
-                    self._mfu_gauge.set(
+                    self._last_mfu = (
                         tps * self._flops_per_token
                         / (device_peak_flops() * jax.device_count())
                     )
+                    self._mfu_gauge.set(self._last_mfu)
         self._last_end = now
         self._last_step = step if step is not None else self._last_step
         if loss is not None:
@@ -353,5 +361,6 @@ class StepTimeline:
         self._pending_loss.clear()
         self._last_loss = None
         self._predicted_peak = None
+        self._last_mfu = None
         self._window_s, self._window_steps = 0.0, 0
         self._transfer0 = transfer.transfer_stats()

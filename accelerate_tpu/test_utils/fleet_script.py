@@ -1,0 +1,110 @@
+"""Multi-host fleet-aggregation drill, run under the real 2-process launcher::
+
+    accelerate-tpu launch --cpu --num_processes 2 -m \
+        accelerate_tpu.test_utils.fleet_script
+
+Proves the tentpole property ``tests/test_fleet.py`` pins: each rank starts
+its own metrics endpoint (EPHEMERAL port — nobody knows the address up
+front), registers the actually-bound ``host:port`` in the coordination-
+service KV registry, and the lead host's :class:`FleetAggregator` discovers
+BOTH endpoints with no operator-supplied address list, scrapes them, and
+joins the series under distinct ``host`` labels with fleet rollups (MFU
+mean, step-time skew). ``accelerate-tpu top --once --json`` is then run as a
+real subprocess against the lead host's endpoint and must return the same
+two-host snapshot — the CI-consumable console contract.
+
+Per-host series are synthetic (rank 1 publishes a deterministically 3x
+slower step time) so every assertion is exact; the registration, discovery,
+scrape, and join are all real.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+
+from accelerate_tpu import PartialState
+from accelerate_tpu.telemetry import get_registry, start_default_server
+from accelerate_tpu.telemetry.fleet import (
+    FleetAggregator,
+    install_fleet_provider,
+    publish_metrics_endpoint,
+)
+from accelerate_tpu.utils.agreement import kv_all_gather
+
+STEP_S = {0: 0.010, 1: 0.030}
+MFU = {0: 0.40, 1: 0.30}
+
+
+def main():
+    state = PartialState()
+    assert state.num_processes >= 2, "run under `launch --num_processes 2`"
+    rank = state.process_index
+
+    registry = get_registry()
+    hist = registry.histogram("accelerate_step_seconds", "Wall-clock per training step")
+    for _ in range(4):
+        hist.observe(STEP_S[rank])
+    registry.gauge("accelerate_mfu_estimate", "MFU estimate").set(MFU[rank])
+    registry.gauge("accelerate_goodput_fraction", "Goodput").set(0.9)
+
+    server = start_default_server(0)  # ephemeral: the address CANNOT be guessed
+    endpoint = publish_metrics_endpoint(process_index=rank, server=server)
+    assert endpoint is not None and endpoint.endswith(f":{server.port}"), endpoint
+
+    # Everyone registered — and ranks != 0 must keep serving until the lead
+    # host has scraped them, so the drill brackets the aggregation between
+    # two KV barriers.
+    kv_all_gather("ready", state.num_processes, rank, namespace="at_fleet_drill/ready")
+
+    if rank == 0:
+        aggregator = install_fleet_provider(FleetAggregator(state=state))
+        snap = aggregator.snapshot()
+        hosts = snap["hosts"]
+        assert hosts["0"]["up"] and hosts["1"]["up"], hosts
+        assert abs(hosts["0"]["step_s_mean"] - STEP_S[0]) < 1e-9, hosts
+        assert abs(hosts["1"]["step_s_mean"] - STEP_S[1]) < 1e-9, hosts
+        fleet = snap["fleet"]
+        assert fleet["hosts_up"] == 2 and fleet["hosts_total"] == 2, fleet
+        assert abs(fleet["mfu"] - 0.35) < 1e-9, fleet
+        assert abs(fleet["step_s"]["skew"] - STEP_S[1] / (0.5 * (STEP_S[0] + STEP_S[1]))) < 1e-6, fleet
+        # Joined per-host-labeled series: BOTH hosts' step-time series exist
+        # under distinct host labels.
+        for host in ("0", "1"):
+            assert f'accelerate_step_seconds_sum{{host="{host}"}}' in snap["series"], (
+                sorted(snap["series"])[:20]
+            )
+        text = aggregator.prometheus_text()
+        assert 'accelerate_mfu_estimate{host="0"} 0.4' in text, text[:800]
+        assert 'accelerate_mfu_estimate{host="1"} 0.3' in text, text[:800]
+
+        # The operator console, end to end: a real `accelerate-tpu top
+        # --once --json` subprocess against the lead host's endpoint.
+        result = subprocess.run(
+            [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli",
+             "top", "--once", "--json", "--endpoint", endpoint],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert result.returncode == 0, result.stdout[-800:] + result.stderr[-800:]
+        got = json.loads(result.stdout)
+        assert got["fleet"]["hosts_up"] == 2, got["fleet"]
+        assert set(got["hosts"]) == {"0", "1"}, got["hosts"]
+        assert got["hosts"]["1"]["step_s_mean"] == hosts["1"]["step_s_mean"]
+        assert f'accelerate_step_seconds_sum{{host="1"}}' in got["series"]
+
+        # And the human frame renders both hosts.
+        frame = subprocess.run(
+            [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli",
+             "top", "--once", "--endpoint", endpoint],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert frame.returncode == 0, frame.stderr[-800:]
+        assert "hosts 2/2 up" in frame.stdout and "skew" in frame.stdout, frame.stdout
+
+    kv_all_gather("done", state.num_processes, rank, namespace="at_fleet_drill/done")
+    print(f"FLEET_OK rank={rank} endpoint={endpoint}")
+
+
+if __name__ == "__main__":
+    main()

@@ -80,6 +80,17 @@ ENV_PROFILE_SLOW_ZSCORE = "ACCELERATE_PROFILE_SLOW_ZSCORE"
 ENV_PROFILE_DIR = "ACCELERATE_PROFILE_DIR"
 ENV_PROFILE_MAX_CAPTURES = "ACCELERATE_PROFILE_MAX_CAPTURES"
 ENV_FLIGHT_DIR = "ACCELERATE_FLIGHT_DIR"
+# Fleet observability plane (telemetry/fleet.py / slo.py;
+# docs/observability.md "Fleet aggregation" / "SLO sentinel"): opt the lead
+# host into aggregating every worker's registered metrics endpoint at /fleet
+# (tri-state like telemetry — an explicit 0 reaches workers as a disable),
+# and the continuous SLO targets the sentinel evaluates (seconds; tri-state
+# per the profile_slow_zscore precedent — an explicit 0 scrubs an inherited
+# value and disables that dimension).
+ENV_FLEET_METRICS = "ACCELERATE_FLEET_METRICS"
+ENV_SLO_STEP_TIME = "ACCELERATE_SLO_STEP_TIME"
+ENV_SLO_TTFT = "ACCELERATE_SLO_TTFT"
+ENV_SLO_TPOT = "ACCELERATE_SLO_TPOT"
 # Dispatch amortization (docs/performance.md "Dispatch amortization"): the
 # default K for Accelerator.build_train_window (1 = one dispatch per step),
 # and the curated XLA latency-hiding flag preset installed into

@@ -70,7 +70,14 @@ import numpy as np
 # inventory of the program that actually ran, so a kernel-vs-reference sweep
 # is attributed op-by-op (benchmarks/kernel_profile.py is the op-level
 # harness behind it).
-BENCH_SCHEMA_VERSION = 10
+# v11 = SLO sentinel + request traces (telemetry/slo.py / requests.py):
+# detail.slo on every line — the configured targets and the
+# accelerate_slo_breaches_total deltas per target accrued DURING the measured
+# window (zero counts mean the window ran inside budget, absent targets mean
+# nothing was armed); BENCH_SERVING=1 lines additionally gain
+# detail.serving.requests — TTFT/TPOT p50/p90/max and the slowest-request
+# table from the serving engine's per-request lifecycle tracer.
+BENCH_SCHEMA_VERSION = 11
 
 
 class BenchAuditFailure(RuntimeError):
@@ -582,11 +589,30 @@ def run_one(mode: str):
     for _ in range(warmup_disp - 1):
         loss = step(next_batch())
     _sync(loss)
+    # SLO accounting (schema v11): breach counters are cumulative; snapshot
+    # around the measured window so detail.slo reports the breaches THIS
+    # window accrued, not the whole process's.
+    from accelerate_tpu.telemetry.slo import breach_counts, slo_targets_from_env
+
+    slo_before = breach_counts()
     t0 = time.perf_counter()
     for _ in range(meas_disp):
         loss = step(next_batch())
     final_loss = _sync(loss)  # sync end of timed region
     dt = time.perf_counter() - t0
+    slo_targets = slo_targets_from_env()
+    slo_breaches = {
+        target: count - slo_before.get(target, 0)
+        for target, count in breach_counts().items()
+        if count - slo_before.get(target, 0)
+    }
+    # Schema contract: an ARMED target reports its delta even at zero (the
+    # window ran inside budget) — only never-armed targets are absent.
+    for target, key in (("step_time", "step_time_s"), ("ttft", "ttft_s"),
+                        ("tpot", "tpot_s")):
+        if slo_targets.get(key) is not None:
+            slo_breaches.setdefault(target, 0)
+    slo_summary = {"targets": slo_targets, "breaches": slo_breaches}
     steps = meas_disp * bench_window  # measured steps this config actually ran
     ledger.record_step(dt, steps=steps)
 
@@ -702,6 +728,7 @@ def run_one(mode: str):
                     # other_s by design.
                     "goodput": ledger.summary(),
                     "health": {"finite_final_loss": finite_loss},
+                    "slo": slo_summary,
                     "telemetry": telemetry_summary,
                     "audit": audit_summary,
                     "memory": memory_summary,

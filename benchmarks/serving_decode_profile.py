@@ -173,6 +173,10 @@ def probe_wave(model, s, paged: bool):
         "kv_cache_bytes": engine.kv_cache_bytes,
         "ttft_s": [round(x, 5) for x in report["ttft_s"]],
         "tpot_s": [round(x, 6) for x in report["tpot_s"]],
+        # Per-request lifecycle summary (telemetry/requests.py): TTFT/TPOT
+        # quantiles + the slowest-request table — summarize() hoists the
+        # paged wave's copy to detail.serving.requests (schema v11).
+        "requests": engine.tracer.summary() if engine.tracer is not None else None,
     }, [outs[r] for r in rids]
 
 
@@ -229,6 +233,10 @@ def summarize(model=None):
     wave_c, outs_c = probe_wave(model, s, paged=False)
     wave_p, outs_p = probe_wave(model, s, paged=True)
     identical = all(np.array_equal(a, b) for a, b in zip(outs_c, outs_p))
+    # The request-trace summary rides once at the top level (schema v11
+    # detail.serving.requests) — the paged wave is the production shape.
+    wave_c.pop("requests", None)
+    out["requests"] = wave_p.pop("requests", None)
     out["wave_contiguous"] = wave_c
     out["wave_paged"] = wave_p
     out["outputs_identical"] = bool(identical)

@@ -289,6 +289,10 @@ def test_config_wizard_roundtrips_through_launch(tmp_path):
         "1.8",               # straggler alert ratio
         "10-12",             # XLA trace capture step ranges
         "5.5",               # slow-step trace trigger z-score
+        "no",                # fleet metric aggregation (needs a metrics port)
+        "0.3",               # SLO target: per-step wall time (s)
+        "0.5",               # SLO target: serving TTFT (s)
+        "0",                 # SLO target: serving TPOT (0 = no target)
         "yes",               # configure dispatch amortization?
         "4",                 # train window K
         "latency",           # xla latency-hiding preset
@@ -312,6 +316,8 @@ def test_config_wizard_roundtrips_through_launch(tmp_path):
     assert cfg.telemetry is True and cfg.metrics_port == 0
     assert cfg.straggler_threshold == 1.8
     assert cfg.profile_steps == "10-12" and cfg.profile_slow_zscore == 5.5
+    assert cfg.fleet_metrics is False  # explicit decline, not unspecified
+    assert cfg.slo_step_time == 0.3 and cfg.slo_ttft == 0.5 and cfg.slo_tpot == 0.0
     assert cfg.train_window == 4 and cfg.xla_preset == "latency"
     assert cfg.zero_sharding is True
     assert cfg.kernels == "pallas"
@@ -347,6 +353,15 @@ def test_config_wizard_roundtrips_through_launch(tmp_path):
         "assert acc.telemetry.straggler.slow_ratio == 1.8\n"
         "assert os.environ.get('ACCELERATE_SPIKE_ZSCORE') == '7.0'\n"
         "assert acc.health_guard.spike.zscore == 7.0\n"
+        "assert os.environ.get('ACCELERATE_FLEET_METRICS') == '0'\n"
+        "assert os.environ.get('ACCELERATE_SLO_STEP_TIME') == '0.3'\n"
+        "assert os.environ.get('ACCELERATE_SLO_TTFT') == '0.5'\n"
+        "assert 'ACCELERATE_SLO_TPOT' not in os.environ\n"
+        "assert acc.telemetry.slo is not None\n"
+        "assert acc.telemetry.slo.step_time_s == 0.3\n"
+        "assert acc.telemetry.slo.ttft_s == 0.5\n"
+        "from accelerate_tpu.telemetry.slo import serving_slo_from_env\n"
+        "assert serving_slo_from_env().ttft_s == 0.5\n"
         "assert os.environ.get('ACCELERATE_TRAIN_WINDOW') == '4'\n"
         "assert acc.train_window == 4\n"
         "assert os.environ.get('ACCELERATE_XLA_PRESET') == 'latency'\n"
