@@ -318,6 +318,19 @@ def memcheck_command_parser(subparsers=None) -> argparse.ArgumentParser:
              "multiple the future Pallas kernel wants)",
     )
     parser.add_argument(
+        "--serving-role", choices=("unified", "prefill", "decode"),
+        default="unified",
+        help="Serving mode: size the pool for this disaggregated tier "
+             "(docs/serving.md 'Disaggregated serving'). prefill audits the "
+             "chunked-prefill program instead of the decode window (a "
+             "prefill host never compiles decode, so its peak excludes the "
+             "decode lookahead buffers); decode audits the decode window "
+             "AND gates on import headroom — the pool must hold a full "
+             "complement of imported chains (slots x max_blocks_per_slot "
+             "+ trash block) or chain imports from the prefill tier will "
+             "be refused at runtime.",
+    )
+    parser.add_argument(
         "--summary", action="store_true",
         help="Print the compact summary (bench.py detail.memory form) instead "
              "of the full report",
@@ -335,11 +348,15 @@ def memcheck_command_parser(subparsers=None) -> argparse.ArgumentParser:
     return parser
 
 
-def _build_serving_artifact(slots: int, blocks: int, block_size: int):
+def _build_serving_artifact(slots: int, blocks: int, block_size: int,
+                            role: str = "unified"):
     """The serving analog of ``_build_tiny_artifact``: a tiny paged
     ContinuousBatcher whose compiled decode window is the audited program.
     Returns ``(engine, built, args)`` — the pool rides the program's
-    ``_audit_meta.memory_classes`` join as the ``kv_pool`` class."""
+    ``_audit_meta.memory_classes`` join as the ``kv_pool`` class. A
+    ``prefill`` role audits the chunked-prefill program instead: that is
+    the ONLY program a disaggregated prefill host compiles, so its peak
+    deliberately excludes the decode window's lookahead buffers."""
     import jax
 
     from ..models import Llama, LlamaConfig
@@ -352,6 +369,9 @@ def _build_serving_artifact(slots: int, blocks: int, block_size: int):
         max_cache_len=blocks * block_size, bucket_sizes=(16, 32, 64),
         sync_every=4, paged=True, block_size=block_size, num_blocks=blocks,
     )
+    if role == "prefill":
+        P = engine.prefill_chunk
+        return engine, engine._chunk_fn(P), engine._chunk_args(P)
     return engine, engine._decode(), engine._decode_args()
 
 
@@ -370,8 +390,10 @@ def memcheck_command(args) -> None:
     if getattr(args, "serving", False):
         from ..analysis.memory import memory_report_from_built
 
+        role = getattr(args, "serving_role", "unified")
         engine, built, built_args = _build_serving_artifact(
-            args.serving_slots, args.serving_blocks, args.serving_block_size
+            args.serving_slots, args.serving_blocks, args.serving_block_size,
+            role=role,
         )
         report = memory_report_from_built(built, *built_args, budget_bytes=budget)
         failures = []
@@ -379,9 +401,10 @@ def memcheck_command(args) -> None:
             report.classes["kv_pool"].per_device_bytes
             if "kv_pool" in report.classes else 0
         )
+        program = "chunked-prefill" if role == "prefill" else "decode-window"
         if not report.fits:
             failures.append(
-                f"predicted serving OOM: decode-window peak "
+                f"predicted serving OOM: {program} peak "
                 f"{report.predicted_peak_bytes} B/device (KV pool {pool_bytes} B) "
                 f"exceeds budget {report.budget_bytes} B — shrink "
                 "--serving-blocks/--serving-slots or raise the budget"
@@ -389,6 +412,28 @@ def memcheck_command(args) -> None:
         payload = report.summary_dict() if args.summary else report.to_dict()
         payload["kv_pool_bytes_per_device"] = pool_bytes
         payload["pool"] = engine.pool_stats()
+        payload["serving_role"] = role
+        if role == "decode":
+            # Import headroom: a decode tier refuses a chain import
+            # (serving_net/handoff.py) when the free list cannot cover the
+            # exporter's reservation — worst case max_blocks_per_slot blocks
+            # per slot, plus the pinned trash block. Gate it at audit time,
+            # not at the first mid-traffic refusal.
+            required = args.serving_slots * engine.max_blocks_per_slot + 1
+            payload["import_headroom"] = {
+                "pool_blocks": engine.num_blocks,
+                "required_blocks": required,
+                "max_blocks_per_slot": engine.max_blocks_per_slot,
+            }
+            if engine.num_blocks < required:
+                failures.append(
+                    f"decode tier lacks import headroom: pool has "
+                    f"{engine.num_blocks} blocks but a full complement of "
+                    f"imported chains needs {required} "
+                    f"({args.serving_slots} slots x "
+                    f"{engine.max_blocks_per_slot} blocks + trash) — raise "
+                    "--serving-blocks or shrink --serving-slots"
+                )
         if getattr(args, "json", False):
             payload = _verdict_doc("memcheck", failures, payload)
         print(json.dumps(payload, indent=1))

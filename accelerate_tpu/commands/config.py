@@ -190,6 +190,24 @@ def get_user_input() -> ClusterConfig:
             "  SLO target: serving time-per-output-token in seconds "
             "(0 = no target)", 0.0, float,
         )
+    # Disaggregated serving (serving_net/): declining leaves both None —
+    # nothing exported, an inherited ACCELERATE_SERVING_ROLE /
+    # ACCELERATE_ROUTER_ENDPOINT still flows through at launch. Answering
+    # (even 'unified' / '') is an explicit choice that scrubs stale values.
+    serving_role, router_endpoint = None, None
+    if _yesno(
+        "Do you want to configure disaggregated serving tiers (prefill/"
+        "decode hosts with KV-chain handoff behind an affinity router)?",
+        False,
+    ):
+        serving_role = _ask(
+            "  serving role for the launched workers "
+            "(unified/prefill/decode/router)",
+            "unified", str, ["unified", "prefill", "decode", "router"],
+        )
+        router_endpoint = _ask(
+            "  router endpoint host:port ('' = none)", ""
+        )
     # Tri-state like the health section: declining leaves both UNSPECIFIED
     # (None / '') so an inherited ACCELERATE_TRAIN_WINDOW/XLA_PRESET still
     # flows through at launch; answering — even with the defaults 1/'off' —
@@ -285,6 +303,8 @@ def get_user_input() -> ClusterConfig:
         slo_step_time=slo_step_time,
         slo_ttft=slo_ttft,
         slo_tpot=slo_tpot,
+        serving_role=serving_role,
+        router_endpoint=router_endpoint,
         train_window=train_window,
         xla_preset=xla_preset,
         zero_sharding=zero_sharding,

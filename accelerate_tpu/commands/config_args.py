@@ -140,6 +140,15 @@ class ClusterConfig:
     slo_step_time: float | None = None
     slo_ttft: float | None = None
     slo_tpot: float | None = None
+    # Disaggregated serving (serving_net/; docs/serving.md "Disaggregated
+    # serving"): ``serving_role`` names the tier the launched workers join
+    # (unified | prefill | decode | router). TRI-state per the xla_preset
+    # precedent — None = unspecified (an inherited ACCELERATE_SERVING_ROLE
+    # flows through), an explicit 'unified' scrubs a stale inherited role.
+    # ``router_endpoint`` is the router tier's host:port
+    # (ACCELERATE_ROUTER_ENDPOINT; None = unspecified, '' scrubs).
+    serving_role: str | None = None
+    router_endpoint: str | None = None
     # Dispatch amortization (docs/performance.md): ``train_window`` is the K
     # Accelerator.build_train_window fuses per dispatch (tri-state like
     # ``telemetry``: None = unspecified, an inherited ACCELERATE_TRAIN_WINDOW

@@ -59,7 +59,7 @@ _TRAIN_CONFIGS = {
 _KERNEL_CONFIGS = ("step_zero_kernel", "decode_paged_kernel")
 
 CONFIG_NAMES = tuple(_TRAIN_CONFIGS) + ("decode", "decode_paged",
-                                        "decode_paged_kernel")
+                                        "decode_paged_kernel", "prefill_paged")
 
 
 def _reset_singletons():
@@ -133,7 +133,7 @@ def _decode_fingerprint(name: str = "decode"):
     model = Llama(cfg)
     model.init_params(jax.random.key(0))
     kwargs = {}
-    if name in ("decode_paged", "decode_paged_kernel"):
+    if name in ("decode_paged", "decode_paged_kernel", "prefill_paged"):
         # The paged decode window: its committed golden pins the block-table
         # gather inventory and the pool+state donation contract, so the
         # ROADMAP item 3 kernel swap (or any regression in the gather
@@ -146,6 +146,12 @@ def _decode_fingerprint(name: str = "decode"):
         bucket_sizes=(8,), sync_every=2, **kwargs,
     )
     try:
+        if name == "prefill_paged":
+            # The prefill-ONLY tier's program (serving_net disaggregation):
+            # a prefill host never compiles the decode window, so its
+            # contract — chunked prefill writing the paged pool through the
+            # block table, first-token sampling — needs its own golden.
+            return engine.fingerprint_prefill(config=name)
         return engine.fingerprint_decode(config=name)
     finally:
         _reset_singletons()
@@ -168,7 +174,8 @@ def extract_config(name: str):
     else:
         os.environ.pop(ENV_KERNELS, None)
     try:
-        if name in ("decode", "decode_paged", "decode_paged_kernel"):
+        if name in ("decode", "decode_paged", "decode_paged_kernel",
+                    "prefill_paged"):
             return _decode_fingerprint(name)
         if name not in _TRAIN_CONFIGS:
             raise SystemExit(
@@ -307,6 +314,11 @@ def fingerprint_command(args) -> None:
                 print(f"{name}: paged decode window with the Pallas "
                       "chain-walk kernels engaged (ACCELERATE_KERNELS="
                       "interpret; pins the pallas_call inventory)")
+                continue
+            if name == "prefill_paged":
+                print(f"{name}: chunked-prefill program of a prefill-only "
+                      "serving tier (paged pool writes through the block "
+                      "table + first-token sampling; no decode window)")
                 continue
             if name == "step_zero_kernel":
                 print(f"{name}: window=1 optimizer=adamw zero=on mesh=dp8 "

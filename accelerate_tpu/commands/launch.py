@@ -44,6 +44,8 @@ from ..utils.constants import (
     ENV_PROFILE_SLOW_ZSCORE,
     ENV_PROFILE_STEPS,
     ENV_RESTART_ATTEMPT,
+    ENV_ROUTER_ENDPOINT,
+    ENV_SERVING_ROLE,
     ENV_SLO_STEP_TIME,
     ENV_SLO_TPOT,
     ENV_SLO_TTFT,
@@ -198,6 +200,23 @@ def launch_command_parser(subparsers=None) -> argparse.ArgumentParser:
              "0 scrubs an inherited value.",
     )
     parser.add_argument(
+        "--serving_role", default=None,
+        help="Disaggregated-serving tier for the launched workers "
+             "(ACCELERATE_SERVING_ROLE; docs/serving.md 'Disaggregated "
+             "serving'): unified (default — each host prefills AND decodes), "
+             "prefill (chunked prefill only, finished KV chains ship to a "
+             "decode host), decode (decodes imported chains + short local "
+             "prompts), router (no engine; admits requests and routes by "
+             "prefix-cache affinity). Tri-state: unset inherits; an explicit "
+             "'unified' scrubs a stale inherited role.",
+    )
+    parser.add_argument(
+        "--router_endpoint", default=None,
+        help="host:port of the serving router tier workers should announce "
+             "to / clients should target (ACCELERATE_ROUTER_ENDPOINT). "
+             "Tri-state: unset inherits, '' scrubs an inherited value.",
+    )
+    parser.add_argument(
         "--straggler_threshold", type=float, default=None,
         help="Cross-host slowness ratio that raises a straggler alert "
              "(ACCELERATE_STRAGGLER_THRESHOLD; library default 1.5): a host "
@@ -322,6 +341,8 @@ def _merge_config(args) -> ClusterConfig:
         ("slo_step_time", "slo_step_time"),
         ("slo_ttft", "slo_ttft"),
         ("slo_tpot", "slo_tpot"),
+        ("serving_role", "serving_role"),
+        ("router_endpoint", "router_endpoint"),
         ("train_window", "train_window"),
         ("xla_preset", "xla_preset"),
         ("zero_sharding", "zero_sharding"),
@@ -415,6 +436,17 @@ def prepare_launch_env(cfg: ClusterConfig, process_id: int | None = None, attemp
             env[env_name] = str(value)
         elif value is not None:
             env.pop(env_name, None)
+    # Disaggregated-serving tier (serving_net/roles.py): tri-state per the
+    # xla_preset precedent — an explicit 'unified' (the library default)
+    # scrubs a stale inherited role instead of forwarding it.
+    if cfg.serving_role and cfg.serving_role.strip().lower() != "unified":
+        env[ENV_SERVING_ROLE] = cfg.serving_role.strip().lower()
+    elif cfg.serving_role is not None:
+        env.pop(ENV_SERVING_ROLE, None)
+    if cfg.router_endpoint and cfg.router_endpoint.strip():
+        env[ENV_ROUTER_ENDPOINT] = cfg.router_endpoint.strip()
+    elif cfg.router_endpoint is not None:
+        env.pop(ENV_ROUTER_ENDPOINT, None)
     # Dispatch amortization: the window K reaches Accelerator.train_window;
     # the XLA preset is installed by PartialState BEFORE backend creation in
     # the worker (libtpu reads LIBTPU_INIT_ARGS once at init).
@@ -601,6 +633,14 @@ def launch_command(args) -> None:
                         ("--slo_tpot", cfg.slo_tpot)):
         if value is not None and value < 0:
             raise ValueError(f"{name} must be >= 0 seconds (0 = off), got {value}")
+    if cfg.serving_role:
+        from ..serving_net.roles import SERVING_ROLES
+
+        if cfg.serving_role.strip().lower() not in SERVING_ROLES:
+            raise ValueError(
+                f"--serving_role must be one of {'/'.join(SERVING_ROLES)}, "
+                f"got {cfg.serving_role!r}"
+            )
     from ..telemetry import metrics_port_from_env
 
     # An inherited ACCELERATE_METRICS_PORT of "0" means "no endpoint"

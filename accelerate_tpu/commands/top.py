@@ -112,6 +112,32 @@ def render_snapshot(snapshot: dict) -> str:
         + (" ".join(f"{k}={v}" for k, v in sorted(breaches.items())) or "none")
         + f"  kv pool {_fmt(fleet.get('kv_pool_utilization'), '.1%')}"
     )
+    # Disaggregated-serving tiers (telemetry/fleet.py _serving_tiers): one
+    # line per role so prefill and decode read side by side; the router line
+    # swaps latency columns for its routing split + affinity hit rate.
+    for role, tier in sorted((fleet.get("serving_tiers") or {}).items()):
+        if "routed" in tier:
+            routed_txt = " ".join(
+                f"{k}={v}" for k, v in sorted(tier["routed"].items())
+            ) or "none"
+            lines.append(
+                f"  serving[{role}] hosts {tier.get('hosts', 0)}  "
+                f"routed: {routed_txt}  affinity "
+                f"{_fmt(tier.get('affinity_hit_rate'), '.1%')}"
+            )
+            continue
+        handoff = tier.get("handoff") or {}
+        handoff_txt = " ".join(
+            f"{direction}={leg.get('chains', 0)}ch/{leg.get('bytes', 0)}B"
+            for direction, leg in sorted(handoff.items())
+        ) or "none"
+        lines.append(
+            f"  serving[{role}] hosts {tier.get('hosts', 0)}  "
+            f"req {tier.get('requests', 0)}/{tier.get('completed', 0)} done  "
+            f"ttft {_fmt(tier.get('ttft_s_mean'), '.3f')}s  "
+            f"tpot {_fmt(tier.get('tpot_s_mean'), '.4f')}s  "
+            f"handoff: {handoff_txt}"
+        )
     lines.append(
         f"  {'host':<6}{'endpoint':<24}{'up':<4}{'steps':>8}{'step_s':>10}"
         f"{'tok/s':>12}{'mfu':>8}{'goodput':>9}{'restarts':>9}  slo"
@@ -121,6 +147,8 @@ def render_snapshot(snapshot: dict) -> str:
         slo_txt = " ".join(
             f"{k}={v}" for k, v in sorted((row.get("slo_breaches") or {}).items())
         ) or "-"
+        if row.get("serving_role"):
+            slo_txt += f"  [{row['serving_role']}]"
         lines.append(
             f"  {host:<6}{(row.get('endpoint') or '-'):<24}"
             f"{'up' if row.get('up') else 'DOWN':<4}"

@@ -18,6 +18,11 @@ This module is the op-level seam:
   the model's ordinary ``cached_attention`` path then consumes unchanged (so
   every model family — rope, learned wpe, sliding windows, softcap — stays
   bit-exact with zero model changes).
+- :func:`export_chain_blocks` / :func:`import_chain_blocks` are the KV-chain
+  handoff faces: a finished prefill's block chain leaves one host's pool and
+  splices into another's (serving_net/handoff.py) as a bounded per-chain
+  transfer — pool blocks are the unit of ownership, so disaggregated
+  prefill/decode never copies a whole cache.
 - :func:`paged_attention` is the fused op face: one call from query chunk +
   pools + block tables to attention output. The **reference lowering**
   (:func:`paged_attention_reference`) composes the gather with
@@ -73,6 +78,39 @@ def init_kv_pool(module, num_blocks: int, block_size: int, dtype=jnp.bfloat16):
         "k": jnp.zeros((L, n, block_size, hkv, hd), dtype),
         "v": jnp.zeros((L, n, block_size, hkv, hd), dtype),
         "mask": jnp.zeros((n, block_size), jnp.int32),
+    }
+
+
+def export_chain_blocks(pool, block_ids):
+    """Extract one chain's K/V/mask block contents from the pool: the device
+    face of the prefill→decode KV handoff (serving_net/handoff.py).
+
+    ``block_ids``: ``(n,)`` int32 pool block indices in chain order. Returns
+    ``{"k": (L, n, bs, Hkv, D), "v": same, "mask": (n, bs)}`` — a bounded
+    per-chain payload (n blocks, never the pool), which is the whole point
+    of the paged layout: ownership moves block-by-block without copying the
+    cache. Pure gather; safe to jit or call eagerly."""
+    ids = jnp.asarray(block_ids, jnp.int32)
+    return {
+        "k": jnp.take(pool["k"], ids, axis=1),
+        "v": jnp.take(pool["v"], ids, axis=1),
+        "mask": jnp.take(pool["mask"], ids, axis=0),
+    }
+
+
+def import_chain_blocks(pool, block_ids, chain):
+    """Splice an exported chain's block contents into ``pool`` at freshly
+    allocated ``block_ids`` — the decode-host half of the handoff. The
+    caller (host free-list surgery in serving_net/handoff.py) guarantees the
+    ids are allocated and disjoint from every live chain; the mask is written
+    verbatim, so bucket-padding holes stay holes and stale bits of the
+    reused blocks are overwritten rather than frontier-masked. Returns the
+    updated pool (donation-friendly: one scatter per array)."""
+    ids = jnp.asarray(block_ids, jnp.int32)
+    return {
+        "k": pool["k"].at[:, ids].set(chain["k"]),
+        "v": pool["v"].at[:, ids].set(chain["v"]),
+        "mask": pool["mask"].at[ids].set(chain["mask"]),
     }
 
 

@@ -410,6 +410,13 @@ class ContinuousBatcher:
             self.tracer: RequestTracer | None = RequestTracer(slo=self.slo)
         else:
             self.tracer = None
+        # Token-streaming sink (serving_net/frontend.py installs one):
+        # ``stream(rid, tokens, final)`` — per-window deltas from the report
+        # the loop already reads, then ONE final call carrying the
+        # authoritative (eos/stop-truncated) output. None = no streaming and
+        # no extra report fetches.
+        self.stream = None
+        self._streamed: dict[int, int] = {}
         self.reset()
 
     # ------------------------------------------------------------- lifecycle
@@ -421,6 +428,7 @@ class ContinuousBatcher:
         re-prefilled automatically so the retry flow stays exact; pass
         ``keep_prefix=False`` to drop it."""
         B = self.B
+        self._streamed.clear()
         if self.tracer is not None:
             # In-flight slots are about to be wiped: their lifecycle records
             # close as cancelled (queued requests survive and stay queued).
@@ -743,6 +751,8 @@ class ContinuousBatcher:
         temperature: float | None = None,
         eos_token_id: int | None = None,
         stop_sequences=None,
+        request_id: int | None = None,
+        tier: str = "unified",
     ) -> int:
         """Queue one prompt (1-D array of token ids). Returns a request id.
 
@@ -754,7 +764,16 @@ class ContinuousBatcher:
         occurrence, which is INCLUDED in the returned ids (like eos). Stop
         detection runs host-side at the sync cadence, but the returned output
         is truncated at the exact first occurrence, so results are
-        cadence-independent."""
+        cadence-independent.
+
+        ``request_id`` threads an EXTERNAL id (the serving_net router assigns
+        one per fleet request) through this engine instead of the local
+        counter, so the request's lifecycle records carry the SAME rid on
+        every tier it crosses (router admission → prefill chunks → chain
+        handoff → decode) and /fleet rollups join them into one trace;
+        ``tier`` labels this engine's tracer record with the serving role
+        that made it. The local counter jumps past any external id, so
+        auto-assigned and router-assigned ids never collide."""
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
@@ -793,13 +812,25 @@ class ContinuousBatcher:
             stop = tuple(np.asarray(s, np.int32).reshape(-1) for s in stop_sequences)
             if any(s.size == 0 for s in stop):
                 raise ValueError("empty stop sequence")
-        rid = self._next_rid
-        self._next_rid += 1
+        if request_id is None:
+            rid = self._next_rid
+            self._next_rid += 1
+        else:
+            rid = int(request_id)
+            if rid < 0:
+                raise ValueError(f"request_id must be >= 0, got {request_id}")
+            if (
+                rid in self._results
+                or any(q.rid == rid for q in self._queue)
+                or any(r is not None and r.rid == rid for r in self._slot_req)
+            ):
+                raise ValueError(f"request_id {rid} is already in use")
+            self._next_rid = max(self._next_rid, rid + 1)
         now = time.monotonic()
         self._queue.append(_Request(rid, prompt, max_new, temp, eos, stop, now))
         self._req_times[rid] = {"submit": now}
         if self.tracer is not None:
-            self.tracer.submit(rid, int(prompt.size), submit_t=now)
+            self.tracer.submit(rid, int(prompt.size), submit_t=now, tier=tier)
         while len(self._req_times) > _SLO_HISTORY:
             # Insertion-ordered: evict the oldest sample (a still-in-flight
             # old rid just loses its latency SAMPLE, never its result).
@@ -980,7 +1011,32 @@ class ContinuousBatcher:
                      slot_max, slot_temp, slot_eos)
             return pool, state
 
-        fn = jax.jit(run, donate_argnums=safe_donate_argnums((1, 2)))
+        effective_donate = safe_donate_argnums((1, 2))
+        fn = jax.jit(run, donate_argnums=effective_donate)
+        param_leaves = jax.tree_util.tree_leaves(self.params)
+        from .ops.registry import resolved_backends
+
+        # The prefill-tier analog of the decode window's audit metadata: a
+        # prefill-ONLY host (serving_net roles) never builds the decode
+        # program, so memcheck --serving --serving-role prefill and the
+        # `prefill_paged` fingerprint golden price/pin THIS program instead.
+        fn._audit_meta = {
+            "builder": "serving_prefill_chunk",
+            "compute_dtype": (
+                str(np.dtype(param_leaves[0].dtype).name) if param_leaves else None
+            ),
+            "expected_donations": (1, 2),
+            "expected_donated_leaves": len(jax.tree_util.tree_leaves(self._pool))
+            + len(jax.tree_util.tree_leaves(self._state_tuple())),
+            "donation_dropped_by_policy": not effective_donate,
+            "kernels": {"spec": self.kernels,
+                        "backends": resolved_backends(self.kernels)},
+            "jaxpr_thunk": lambda *a, **k: jax.make_jaxpr(run)(*a, **k),
+            "memory_classes": {
+                "kv_pool": (lambda: self._pool, lambda: None),
+                "params": (lambda: self.params, lambda: None),
+            },
+        }
         self._chunk_fns[P] = fn
         return fn
 
@@ -1213,6 +1269,34 @@ class ContinuousBatcher:
             self._decode(), *self._decode_args(), config=config, **kwargs
         )
 
+    def _chunk_args(self, P: int):
+        """The ``P``-token chunk program's full argument tuple against the
+        engine's current pool/state — what the prefill-tier audit/fingerprint
+        lower with (value-independent, like ``_decode_args``)."""
+        if not self.paged:
+            raise ValueError("the chunk program exists only in paged mode")
+        return (
+            self.params, self._pool, self._state_tuple(),
+            jnp.asarray(self._tables_np),
+            jnp.asarray(self._slot_len, dtype=jnp.int32), jnp.int32(0),
+            jnp.zeros((P,), jnp.int32), jnp.ones((P,), jnp.int32),
+            jnp.int32(0), jnp.asarray(True), jnp.int32(0), self._rng,
+            jnp.int32(self.max_new), jnp.float32(0.0), jnp.int32(self.eos),
+        )
+
+    def fingerprint_prefill(self, config: str = "prefill_paged", **kwargs):
+        """Canonical fingerprint of the compiled ``prefill_chunk``-token
+        prefill program — the prefill-ONLY tier's entry in the drift-gate
+        matrix (a disaggregated prefill host never runs the decode window,
+        so the decode golden cannot cover its program contract). Lowers and
+        compiles but never prefills a token."""
+        from .analysis.fingerprint import fingerprint_built
+
+        P = self.prefill_chunk
+        return fingerprint_built(
+            self._chunk_fn(P), *self._chunk_args(P), config=config, **kwargs
+        )
+
     # ----------------------------------------------------------------- loop
     def _bucket(self, n: int) -> int:
         for b in self.buckets:
@@ -1252,6 +1336,17 @@ class ContinuousBatcher:
                 tpot_s=(times or {}).get("tpot"),
                 at=(times or {}).get("finish"),
             )
+        if self.stream is not None:
+            self._streamed.pop(req.rid, None)
+            self._emit_stream(req.rid, row, True)
+
+    def _emit_stream(self, rid: int, tokens: np.ndarray, final: bool):
+        """Deliver one streaming event best-effort: a broken sink (a client
+        that hung up mid-stream) must never take the engine loop down."""
+        try:
+            self.stream(rid, tokens, final)
+        except Exception:
+            pass
 
     def _collect(self, s: int, active_np):
         req = self._slot_req[s]
@@ -1281,6 +1376,25 @@ class ContinuousBatcher:
                 break
             blocks.append(blk)
         return blocks
+
+    def prefix_match_tokens(self, prompt_ids) -> int:
+        """How many leading tokens of ``prompt_ids`` are already resident in
+        this engine's shared-block index — the prefix-cache affinity answer
+        behind GET /v1/prefixes (serving_net: the router sends each worker a
+        prompt's chain prefix and routes to the longest match, so cache-hit
+        routing is a host-side lookup, never a device touch). A configured
+        shared prefix counts exactly as submit() would prepend it."""
+        if not self.paged:
+            return 0
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        if self._prefix_tokens is not None:
+            prompt = np.concatenate([self._prefix_tokens, prompt])
+        return len(self._alias_lookup(prompt)) * self.block_size
+
+    def in_flight(self) -> int:
+        """Requests queued or occupying a slot — the least-loaded routing
+        signal GET /v1/stats publishes (host bookkeeping only)."""
+        return len(self._queue) + sum(r is not None for r in self._slot_req)
 
     def _plan_chunks(self, remainder: np.ndarray, chunk_size: int) -> list:
         """Split the un-aliased prompt tail into prefill chunks: exact
@@ -1544,6 +1658,20 @@ class ContinuousBatcher:
                 times["first_token"] = now
                 if self.tracer is not None:
                     self.tracer.first_token(req.rid, at=now)
+            if self.stream is not None and active_np[s]:
+                # Per-window token deltas for the SSE front end, read off the
+                # SAME one-window-late report the stop scan and collection
+                # already fetch — streaming adds no sync point. Deltas are
+                # pre-truncation (a multi-token stop lands one window late,
+                # the cadence caveat submit() documents); the FINAL event
+                # from _finish carries the authoritative output.
+                if out_np is None:
+                    out_np = host_fetch(report[2])
+                done = self._streamed.get(req.rid, 0)
+                n = int(n_np[s])
+                if n > done:
+                    self._emit_stream(req.rid, out_np[s][done:n].copy(), False)
+                    self._streamed[req.rid] = n
             if active_np[s] and req.stop:
                 if out_np is None:
                     out_np = host_fetch(report[2])

@@ -1,0 +1,49 @@
+"""Disaggregated serving tier — the network front end over the paged engine.
+
+The serving engine (``serving.ContinuousBatcher``) is a single-process
+library; this package is what spreads one serving workload across hosts
+(ROADMAP item 1, the "millions of users" half of the north star), split
+along the who-runs-what-where vs how-it-lowers seam:
+
+- :mod:`.roles` — which role a process plays (``unified`` / ``prefill`` /
+  ``decode`` / ``router``), resolved from the launcher env contract.
+- :mod:`.frontend` — the streaming HTTP/SSE endpoint colocated with the
+  metrics server: POST /v1/generate feeds ``ContinuousBatcher.submit`` and
+  streams tokens per request as SSE events, TTFT/TPOT in the final event.
+- :mod:`.router` — the front door: discovers workers through the fleet KV
+  namespace, routes by prefix-cache affinity (each worker's /v1/prefixes is
+  a host-side lookup into its refcounted share index), falls back to
+  least-loaded, and lets the SLO sentinel arbitrate which tier a request
+  enters.
+- :mod:`.handoff` — prefill/decode disaggregation: a dedicated prefill host
+  runs chunked prefill and ships the finished KV block chain to a decode
+  host via block-table surgery plus a bounded chain transfer
+  (``ops.paged_attention.export_chain_blocks`` / ``import_chain_blocks``).
+
+See docs/serving.md "Disaggregated serving" for roles, the handoff
+contract, affinity routing, and the SSE wire format.
+"""
+
+from __future__ import annotations
+
+from .frontend import ServingFrontend
+from .handoff import export_chain, import_chain, run_prefill_only
+from .roles import (
+    SERVING_ROLES,
+    ServingRole,
+    resolve_serving_role,
+    router_endpoint_from_env,
+)
+from .router import Router
+
+__all__ = [
+    "Router",
+    "SERVING_ROLES",
+    "ServingFrontend",
+    "ServingRole",
+    "export_chain",
+    "import_chain",
+    "resolve_serving_role",
+    "router_endpoint_from_env",
+    "run_prefill_only",
+]

@@ -1,0 +1,402 @@
+"""Streaming HTTP front end over one serving engine — the /v1/* worker API.
+
+One :class:`ServingFrontend` wraps one ``ContinuousBatcher`` and installs
+itself as the serving provider on the SAME HTTP server the process already
+runs for ``/metrics`` (telemetry/metrics.py routes ``/v1/*`` here), so a
+serving worker exposes generation, prefix-affinity answers, and load stats
+on the one port the fleet registry already publishes:
+
+- ``POST /v1/generate`` — submit a prompt, stream its tokens back as SSE
+  events (``tokens`` deltas at the engine's sync cadence, then ONE ``done``
+  event carrying the authoritative output plus the request's tracer record —
+  TTFT/TPOT ride every stream's final event). On a ``prefill`` worker this
+  instead runs prefill to completion, ships the chain to the request's
+  decode host (:mod:`.handoff`), and RELAYS that host's stream, prepending
+  its own tier record to the final event's trace.
+- ``POST /v1/import`` — decode tier: splice a shipped chain in and stream
+  the request's decode exactly as if it had prefilled locally.
+- ``POST /v1/prefixes`` / ``GET /v1/stats`` — the router's affinity and
+  least-loaded routing feeds (both pure host lookups; a routing decision
+  never touches a device).
+
+Threading: HTTP handler threads only QUEUE work (``submit`` appends to the
+engine's deque; imports land in a staging queue) and then block on per-rid
+subscriber queues; one background loop thread owns every engine dispatch —
+it drains staged imports between waves and calls ``engine.run()`` whenever
+work is in flight. The engine's one-window-lookahead loop keeps its
+zero-blocking-transfer discipline; streaming rides the report it already
+fetches (serving.py ``_process_report``).
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import time
+import urllib.request
+
+import numpy as np
+
+from ..logging import get_logger
+from .handoff import export_chain, import_chain, run_prefill_only
+from .roles import ServingRole, resolve_serving_role
+
+logger = get_logger(__name__)
+
+# How long a subscriber waits for the next stream event before the stream
+# closes with an error event — a wedged engine must not hold client
+# connections (and their handler threads) forever.
+STREAM_TIMEOUT_S = 300.0
+
+
+def sse_event(kind: str, data: dict) -> str:
+    """One Server-Sent Event frame (the wire contract docs/serving.md pins):
+    ``event:`` names the kind, ``data:`` carries one JSON object."""
+    return f"event: {kind}\ndata: {json.dumps(data)}\n\n"
+
+
+def iter_sse(fp):
+    """Parse an SSE byte stream into ``(kind, data_str)`` frames — the relay
+    tiers' client side (router ← worker, prefill ← decode)."""
+    kind, data_lines = None, []
+    for raw in fp:
+        line = raw.decode("utf-8", "replace").rstrip("\r\n")
+        if not line:
+            if data_lines:
+                yield (kind or "message", "\n".join(data_lines))
+            kind, data_lines = None, []
+        elif line.startswith("event:"):
+            kind = line[len("event:"):].strip()
+        elif line.startswith("data:"):
+            data_lines.append(line[len("data:"):].strip())
+    if data_lines:
+        yield (kind or "message", "\n".join(data_lines))
+
+
+class ServingFrontend:
+    """The /v1/* provider for one engine + role; see module docstring.
+
+    ``engine`` is a paged-or-contiguous ``ContinuousBatcher`` (paged required
+    for ``prefill``/``decode`` roles — disaggregation is chain surgery);
+    ``role`` defaults to the launcher env contract
+    (:func:`~.roles.resolve_serving_role`)."""
+
+    def __init__(self, engine, role: str | ServingRole | None = None,
+                 stream_timeout_s: float = STREAM_TIMEOUT_S):
+        if isinstance(role, ServingRole):
+            self.role = role
+        else:
+            self.role = resolve_serving_role(role)
+        if not self.role.runs_engine:
+            raise ValueError(
+                "the router role runs no engine; use serving_net.Router"
+            )
+        if self.role.name in ("prefill", "decode") and not engine.paged:
+            raise ValueError(
+                f"serving role {self.role.name!r} requires a paged engine "
+                "(disaggregation is block-chain surgery)"
+            )
+        self.engine = engine
+        self.stream_timeout_s = float(stream_timeout_s)
+        self._lock = threading.Lock()          # engine submission/surgery
+        self._streams: dict[int, queue.Queue] = {}
+        self._imports: queue.Queue = queue.Queue()
+        self._wake = threading.Condition()
+        self._shutdown = threading.Event()
+        self._thread: threading.Thread | None = None
+        engine.stream = self._on_stream
+
+    # ------------------------------------------------------------ lifecycle
+    def install(self, process_index: int = 0, start_loop: bool | None = None,
+                server=None, endpoint: str | None = None):
+        """Become the process's serving provider: route ``/v1/*`` here,
+        publish the role gauge (``accelerate_serving_role{role=}`` — what
+        /fleet tier rollups group hosts by) and the worker's role+endpoint
+        into the serving KV namespace (what the router discovers), and start
+        the engine loop thread (decoding roles; a prefill worker dispatches
+        synchronously per request, so it needs no loop). ``server`` attaches
+        to one specific :class:`~..telemetry.metrics.MetricsServer` instead
+        of the process-global route (multi-role single-process rigs)."""
+        from ..telemetry.metrics import get_registry, set_serving_provider
+
+        if server is not None:
+            server.set_serving(self)
+            if endpoint is None and server.port is not None:
+                endpoint = f"127.0.0.1:{server.port}"
+        else:
+            set_serving_provider(self)
+        get_registry().gauge(
+            "accelerate_serving_role",
+            "Serving tier this process runs (1 = the labeled role)",
+            labelnames=("role",),
+        ).set(1, role=self.role.name)
+        from .router import publish_serving_endpoint
+
+        publish_serving_endpoint(self.role.name, process_index=process_index,
+                                 endpoint=endpoint)
+        if start_loop is None:
+            start_loop = self.role.decodes
+        if start_loop and self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="at-serving-loop", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def uninstall(self):
+        from ..telemetry.metrics import set_serving_provider
+
+        set_serving_provider(None)
+        self._shutdown.set()
+        with self._wake:
+            self._wake.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    # ---------------------------------------------------------- engine loop
+    def _loop(self):
+        """The one thread that dispatches engine work: drain staged imports
+        (chain surgery must not race a live wave's donated state tuple),
+        then run the wave whenever anything is in flight."""
+        while not self._shutdown.is_set():
+            did_work = False
+            while True:
+                try:
+                    payload, endpoint = self._imports.get_nowait()
+                except queue.Empty:
+                    break
+                did_work = True
+                try:
+                    import_chain(self.engine, payload, endpoint=endpoint)
+                except Exception as exc:
+                    logger.warning(f"chain import failed: {exc!r}")
+                    self._push(int(payload.get("rid", -1)),
+                               ("error", f"import failed: {exc}"))
+            if self.engine.in_flight() > 0:
+                did_work = True
+                try:
+                    self.engine.run()
+                except Exception as exc:
+                    logger.warning(f"serving engine wave failed: {exc!r}")
+                    for rid in list(self._streams):
+                        self._push(rid, ("error", f"engine error: {exc}"))
+            if not did_work:
+                with self._wake:
+                    self._wake.wait(timeout=0.05)
+
+    def _notify(self):
+        with self._wake:
+            self._wake.notify_all()
+
+    # ------------------------------------------------------------- streaming
+    def _on_stream(self, rid: int, tokens: np.ndarray, final: bool):
+        """The engine's streaming sink (runs on the loop thread, fed from
+        the report the loop already fetches)."""
+        kind = "final" if final else "tokens"
+        self._push(rid, (kind, [int(t) for t in np.asarray(tokens).reshape(-1)]))
+
+    def _push(self, rid: int, item):
+        subscriber = self._streams.get(rid)
+        if subscriber is not None:
+            subscriber.put(item)
+
+    def _trace_record(self, rid: int) -> dict | None:
+        """This tier's tracer record for ``rid`` — what rides the final SSE
+        event so the client (and each relay tier) assembles the cross-tier
+        trace without scraping anything."""
+        tracer = self.engine.tracer
+        if tracer is None:
+            return None
+        for record in tracer.records():
+            if record["rid"] == rid:
+                return record
+        return None
+
+    def _stream_response(self, rid: int):
+        """The SSE generator behind a local (non-relayed) request: token
+        deltas as they land, then the ``done`` frame with the authoritative
+        output + this tier's trace record (TTFT/TPOT inside)."""
+        subscriber = self._streams[rid]
+        try:
+            while True:
+                try:
+                    kind, payload = subscriber.get(timeout=self.stream_timeout_s)
+                except queue.Empty:
+                    yield sse_event("error", {
+                        "rid": rid,
+                        "error": f"stream timed out after {self.stream_timeout_s}s",
+                    })
+                    return
+                if kind == "error":
+                    yield sse_event("error", {"rid": rid, "error": payload})
+                    return
+                if kind == "final":
+                    record = self._trace_record(rid)
+                    yield sse_event("done", {
+                        "rid": rid,
+                        "tokens": payload,
+                        "ttft_s": (record or {}).get("ttft_s"),
+                        "tpot_s": (record or {}).get("tpot_s"),
+                        "trace": [record] if record else [],
+                    })
+                    return
+                yield sse_event("tokens", {"rid": rid, "tokens": payload})
+        finally:
+            self._streams.pop(rid, None)
+
+    # ------------------------------------------------------------- handlers
+    def handle_get(self, path: str, query: dict):
+        if path == "/v1/stats":
+            body = json.dumps(self.stats()).encode()
+            return (200, "application/json", body)
+        return None
+
+    def handle_post(self, path: str, query: dict, body: bytes):
+        if path == "/v1/prefixes":
+            request = json.loads(body or b"{}")
+            prompt = np.asarray(request.get("prompt", []), np.int32)
+            return ("json", 200, {
+                "match_tokens": self.engine.prefix_match_tokens(prompt),
+                "in_flight": self.engine.in_flight(),
+                "role": self.role.name,
+            })
+        if path == "/v1/generate":
+            return self._handle_generate(json.loads(body or b"{}"))
+        if path == "/v1/import":
+            if not self.role.decodes:
+                return ("json", 409, {
+                    "error": f"role {self.role.name!r} does not decode"
+                })
+            payload = json.loads(body or b"{}")
+            rid = int(payload["rid"])
+            self._streams[rid] = queue.Queue()
+            self._imports.put((payload, None))
+            self._notify()
+            return ("sse", self._stream_response(rid))
+        return None
+
+    def stats(self) -> dict:
+        """The least-loaded routing feed (host bookkeeping only)."""
+        return {
+            "role": self.role.name,
+            "in_flight": self.engine.in_flight(),
+            "prefill_chunk": getattr(self.engine, "prefill_chunk", None),
+            "pool": self.engine.pool_stats(),
+        }
+
+    def _handle_generate(self, request: dict):
+        prompt = np.asarray(request.get("prompt", []), np.int32).reshape(-1)
+        if prompt.size == 0:
+            return ("json", 400, {"error": "empty or missing 'prompt'"})
+        kwargs = {}
+        for key in ("max_new_tokens", "eos_token_id"):
+            if request.get(key) is not None:
+                kwargs[key] = int(request[key])
+        if request.get("temperature") is not None:
+            kwargs["temperature"] = float(request["temperature"])
+        if request.get("stop_sequences"):
+            kwargs["stop_sequences"] = [
+                np.asarray(s, np.int32) for s in request["stop_sequences"]
+            ]
+        with self._lock:
+            # The rid is reserved BEFORE submit so the subscriber queue
+            # exists when the loop thread emits the first delta — a
+            # router-assigned request_id threads through unchanged (one rid
+            # across every tier it crosses).
+            rid = (int(request["request_id"])
+                   if request.get("request_id") is not None
+                   else self.engine._next_rid)
+            if self.role.name == "prefill":
+                decode_endpoint = request.get("decode_endpoint")
+                if not decode_endpoint:
+                    return ("json", 400, {
+                        "error": "prefill tier needs 'decode_endpoint' "
+                                 "(where the finished chain ships)"
+                    })
+                self.engine.submit(prompt, request_id=rid,
+                                   tier=self.role.name, **kwargs)
+                return ("sse", self._relay_prefill(rid, decode_endpoint))
+            self._streams[rid] = queue.Queue()
+            self.engine.submit(prompt, request_id=rid, tier=self.role.name,
+                               **kwargs)
+        self._notify()
+        return ("sse", self._stream_response(rid))
+
+    # ---------------------------------------------------------------- relay
+    def _relay_prefill(self, rid: int, decode_endpoint: str):
+        """The prefill tier's generate path: run this request's chunked
+        prefill to completion (no decode window ever dispatches here), ship
+        the chain, then relay the decode host's stream — prepending this
+        tier's record to the final event's trace, so the client's one trace
+        spans prefill chunks AND the handoff leg."""
+        try:
+            with self._lock:
+                run_prefill_only(self.engine, rid)
+                payload = export_chain(self.engine, rid,
+                                       endpoint=decode_endpoint)
+        except Exception as exc:
+            logger.warning(f"prefill for request {rid} failed: {exc!r}")
+            yield sse_event("error", {"rid": rid, "error": str(exc)})
+            return
+
+        def finalize(done: dict) -> dict:
+            record = self._trace_record(rid)
+            if record is not None:
+                done["trace"] = [record] + done.get("trace", [])
+            return done
+
+        yield from relay_generate(
+            f"http://{decode_endpoint}/v1/import", payload, finalize=finalize
+        )
+
+
+def relay_generate(url: str, request: dict, finalize=None,
+                   timeout_s: float = STREAM_TIMEOUT_S):
+    """POST ``request`` to a downstream tier's SSE endpoint and re-yield its
+    stream. ``finalize(done_payload) -> done_payload`` rewrites the final
+    event as it passes through — each relay tier prepends its own tracer
+    record to the ``trace`` list there, which is how the client's one trace
+    comes to span router admission → prefill chunks → chain handoff → decode
+    — the one relay primitive the prefill tier and the router share."""
+    req = urllib.request.Request(
+        url, data=json.dumps(request).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        response = urllib.request.urlopen(req, timeout=timeout_s)
+    except Exception as exc:
+        yield sse_event("error", {
+            "error": f"downstream tier {url} unreachable: {exc}"
+        })
+        return
+    with response:
+        for kind, data in iter_sse(response):
+            if kind == "done" and finalize is not None:
+                try:
+                    payload = finalize(json.loads(data))
+                    yield sse_event("done", payload)
+                    continue
+                except (ValueError, TypeError):
+                    pass
+            yield f"event: {kind}\ndata: {data}\n\n"
+
+
+def read_sse_response(fp) -> dict:
+    """Drain one generate stream client-side: returns ``{"tokens": [...],
+    "deltas": [...], "done": {...}}`` (raises on an ``error`` frame) — the
+    drill's and the tests' client helper, so they consume the REAL wire
+    format, not a shortcut."""
+    deltas, done = [], None
+    for kind, data in iter_sse(fp):
+        payload = json.loads(data)
+        if kind == "error":
+            raise RuntimeError(f"serving stream error: {payload.get('error')}")
+        if kind == "tokens":
+            deltas.append(payload["tokens"])
+        elif kind == "done":
+            done = payload
+    if done is None:
+        raise RuntimeError("serving stream closed without a done event")
+    return {"tokens": done["tokens"], "deltas": deltas, "done": done}

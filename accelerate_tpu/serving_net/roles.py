@@ -1,0 +1,80 @@
+"""Serving roles — who runs what where in a disaggregated serving fleet.
+
+One launch flag (``--serving_role`` → ``ACCELERATE_SERVING_ROLE``) decides
+which piece of the serving pipeline a process runs:
+
+- ``unified`` (default): the single-host shape — one engine does chunked
+  prefill AND decode; the front end streams straight from it.
+- ``prefill``: chunked prefill only. Finished KV block chains ship to a
+  decode host (:mod:`.handoff`); this host never builds the decode program,
+  which is exactly why memcheck prices its pool differently per role.
+- ``decode``: imports chains and decodes; also serves direct (short-prompt)
+  requests the router's SLO arbitration keeps out of the prefill tier.
+- ``router``: the front door — no engine, no pool, no model; discovers
+  workers through the fleet KV namespace and proxies token streams.
+
+The resolution is deliberately a plain env read (no backend touch): the
+launcher exports the contract, ``PartialState`` publishes the resolved role
+into the fleet registry, and every serving_net module asks this one place.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+from ..utils.constants import ENV_ROUTER_ENDPOINT, ENV_SERVING_ROLE
+
+SERVING_ROLES = ("unified", "prefill", "decode", "router")
+
+
+@dataclass(frozen=True)
+class ServingRole:
+    """A validated role value with the capability predicates the rest of
+    serving_net branches on — so role logic reads as ``role.prefills``
+    instead of string comparisons scattered over four modules."""
+
+    name: str
+
+    def __post_init__(self):
+        if self.name not in SERVING_ROLES:
+            raise ValueError(
+                f"unknown serving role {self.name!r}; expected one of "
+                f"{SERVING_ROLES} ({ENV_SERVING_ROLE})"
+            )
+
+    @property
+    def prefills(self) -> bool:
+        return self.name in ("unified", "prefill")
+
+    @property
+    def decodes(self) -> bool:
+        return self.name in ("unified", "decode")
+
+    @property
+    def runs_engine(self) -> bool:
+        return self.name != "router"
+
+    def __str__(self) -> str:
+        return self.name
+
+
+def resolve_serving_role(explicit: str | None = None) -> ServingRole:
+    """The process's serving role: an explicit value wins, else the launcher
+    env contract (``ACCELERATE_SERVING_ROLE``), else ``unified`` — unset
+    means the single-host default, per the tri-state launch precedent (an
+    explicit ``--serving_role unified`` scrubs an inherited value rather
+    than exporting one)."""
+    value = explicit if explicit is not None else os.environ.get(ENV_SERVING_ROLE)
+    value = (value or "unified").strip().lower() or "unified"
+    return ServingRole(value)
+
+
+def router_endpoint_from_env(explicit: str | None = None) -> str | None:
+    """The fleet's router endpoint (``host:port``), if one is configured
+    (``ACCELERATE_ROUTER_ENDPOINT`` / ``launch --router_endpoint``) — where
+    clients point and where non-router workers name their front door. None
+    when unset/empty (a scrubbed value is an explicit "no router")."""
+    value = explicit if explicit is not None else os.environ.get(ENV_ROUTER_ENDPOINT)
+    value = (value or "").strip()
+    return value or None

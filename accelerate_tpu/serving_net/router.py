@@ -1,0 +1,364 @@
+"""The serving fleet's front door — prefix-affinity routing over /v1 workers.
+
+The router tier runs no engine, no pool, no model: it discovers decode and
+prefill workers through the fleet KV namespace (the registration transport
+``telemetry/fleet.py`` already rides), assigns each request its fleet-wide
+rid, decides which tier the request ENTERS (the SLO sentinel's
+:func:`~..telemetry.slo.arbitrate_serving_tier`), and relays the chosen
+worker's SSE stream back to the client — prepending its own tracer record to
+the final event's trace, so one rid spans router admission → prefill chunks
+→ chain handoff → first decode token.
+
+Routing policy (per request, all host-side lookups):
+
+- **Prefix-cache affinity first**: every decode-capable worker answers
+  ``POST /v1/prefixes`` with how many leading prompt tokens its refcounted
+  share index already holds resident (a dict lookup against the engine's
+  ``_share_index`` — never a device touch). The longest match wins: decoding
+  where the prefix lives aliases those blocks instead of re-prefilling them.
+- **Least-loaded fallback**: on a tie (including the common all-zero case),
+  the worker with the fewest in-flight requests wins — the prefixes answer
+  carries the load signal, so routing costs one round per worker.
+- **Tier arbitration**: multi-chunk prompts enter the prefill tier when one
+  exists (the decode tier's TPOT is protected from long prefills); the
+  chosen decode worker rides along as the chain's handoff target, so
+  affinity still decides where the request ultimately DECODES.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+
+from ..logging import get_logger
+from ..telemetry.fleet import _kv_client, metrics_endpoint
+from ..telemetry.slo import arbitrate_serving_tier
+from .frontend import relay_generate, sse_event
+
+logger = get_logger(__name__)
+
+# Coordination-service KV namespace for serving-role registration — one key
+# per rank holding "role|host:port", the same persistent-fact discipline as
+# the metrics registry (telemetry/fleet.py KV_NAMESPACE).
+SERVING_KV_NAMESPACE = "at_fleet/serving"
+
+# How long one worker gets to answer an affinity/stats probe before routing
+# falls back without it — a dead worker must not stall admission.
+PROBE_TIMEOUT_S = 3.0
+
+_LOCK = threading.Lock()
+_LOCAL_WORKERS: dict[int, dict] = {}  # rank -> {"role", "endpoint"} (in-process)
+
+_ROUTER_COUNTERS = None  # telemetry.metrics.cached_handles accessor
+
+
+def _router_counters():
+    """(routed{tier=}, affinity_hits) — the routing decisions /fleet and the
+    BENCH_SERVING_DISAGG lever read back as the affinity hit rate."""
+    global _ROUTER_COUNTERS
+    if _ROUTER_COUNTERS is None:
+        from ..telemetry.metrics import cached_handles
+
+        _ROUTER_COUNTERS = cached_handles(lambda registry: (
+            registry.counter(
+                "accelerate_serving_router_requests_total",
+                "Requests admitted by the router, by entry tier",
+                labelnames=("tier",),
+            ),
+            registry.counter(
+                "accelerate_serving_router_affinity_hits_total",
+                "Requests routed to a worker holding a resident prompt prefix",
+            ),
+        ))
+    return _ROUTER_COUNTERS()
+
+
+def publish_serving_endpoint(role: str, process_index: int = 0,
+                             endpoint: str | None = None) -> str | None:
+    """Register this worker's serving role + endpoint in the fleet KV
+    namespace (``ServingFrontend.install`` calls this). ``endpoint``
+    defaults to the already-published metrics endpoint — the /v1 API lives
+    on the same port. Returns the published ``role|host:port``."""
+    endpoint = endpoint or metrics_endpoint()
+    if endpoint is None:
+        return None
+    value = f"{role}|{endpoint}"
+    with _LOCK:
+        _LOCAL_WORKERS[int(process_index)] = {"role": role, "endpoint": endpoint}
+    client = _kv_client()
+    if client is not None:
+        key = f"{SERVING_KV_NAMESPACE}/{int(process_index)}"
+        try:
+            client.key_value_set(key, value)
+        except Exception:
+            try:  # a stale key from a prior incarnation: replace it
+                client.key_value_delete(key)
+                client.key_value_set(key, value)
+            except Exception:
+                pass
+    return value
+
+
+def discover_serving_workers(num_processes: int,
+                             timeout_ms: int = 10_000) -> list[dict]:
+    """``[{"rank", "role", "endpoint"}]`` for every rank that has registered
+    a serving role — the fair-total-budget read discipline of
+    :func:`~..telemetry.fleet.discover_endpoints`; an unregistered rank is
+    absent, never an exception. Without a distributed client returns the
+    in-process registrations."""
+    client = _kv_client()
+    if client is None or num_processes <= 1:
+        with _LOCK:
+            return [
+                {"rank": rank, **spec}
+                for rank, spec in sorted(_LOCAL_WORKERS.items())
+            ]
+    workers = []
+    ranks = list(range(int(num_processes)))
+    deadline = time.monotonic() + timeout_ms / 1000.0
+    for i, rank in enumerate(ranks):
+        remaining_ms = int((deadline - time.monotonic()) * 1000)
+        if remaining_ms <= 0:
+            break
+        slice_ms = max(50, remaining_ms // (len(ranks) - i))
+        try:
+            value = client.blocking_key_value_get(
+                f"{SERVING_KV_NAMESPACE}/{rank}", slice_ms
+            )
+        except Exception:
+            continue  # not registered (yet) — degradation, not failure
+        role, _, endpoint = value.partition("|")
+        if endpoint:
+            workers.append({"rank": rank, "role": role, "endpoint": endpoint})
+    return workers
+
+
+def reset_serving_registry():
+    """Drop in-process serving registrations — tests."""
+    with _LOCK:
+        _LOCAL_WORKERS.clear()
+
+
+def _post_json(url: str, payload: dict, timeout_s: float = PROBE_TIMEOUT_S) -> dict:
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout_s) as response:
+        return json.loads(response.read().decode("utf-8", "replace"))
+
+
+def _get_json(url: str, timeout_s: float = PROBE_TIMEOUT_S) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout_s) as response:
+        return json.loads(response.read().decode("utf-8", "replace"))
+
+
+class Router:
+    """The /v1 provider for the router role; see module docstring.
+
+    ``workers`` pins the fleet explicitly (``[{"role", "endpoint"}]`` —
+    tests, ad-hoc operator use); otherwise every routing decision re-reads
+    the KV registry through a short cache, so workers that register late (or
+    re-register after an elastic restart) are picked up live. ``slo`` is the
+    fleet's :class:`~..serving.SLOTargets` for tier arbitration."""
+
+    def __init__(self, workers=None, num_processes: int = 1, slo=None,
+                 cache_s: float = 2.0, trace_requests: bool = True):
+        self._static = workers is not None
+        self._workers = [dict(w) for w in workers] if workers else []
+        self.num_processes = int(num_processes)
+        if slo is None:
+            from ..telemetry.slo import serving_slo_from_env
+
+            slo = serving_slo_from_env()
+        self.slo = slo
+        self.cache_s = float(cache_s)
+        self._cached_at = 0.0
+        self._prefill_chunk: int | None = None
+        self._next_rid = 0
+        self._lock = threading.Lock()
+        if trace_requests:
+            from ..telemetry.requests import RequestTracer
+
+            self.tracer = RequestTracer(slo=slo)
+        else:
+            self.tracer = None
+
+    def install(self, process_index: int = 0, server=None,
+                endpoint: str | None = None):
+        """Become this process's serving provider and register the router
+        role in the fleet KV namespace (clients discover the front door the
+        same way the router discovers workers). ``server`` attaches to one
+        specific MetricsServer instead of the process-global route."""
+        from ..telemetry.metrics import get_registry, set_serving_provider
+
+        if server is not None:
+            server.set_serving(self)
+            if endpoint is None and server.port is not None:
+                endpoint = f"127.0.0.1:{server.port}"
+        else:
+            set_serving_provider(self)
+        get_registry().gauge(
+            "accelerate_serving_role",
+            "Serving tier this process runs (1 = the labeled role)",
+            labelnames=("role",),
+        ).set(1, role="router")
+        publish_serving_endpoint("router", process_index=process_index,
+                                 endpoint=endpoint)
+        return self
+
+    # ------------------------------------------------------------- discovery
+    def workers(self) -> list[dict]:
+        if self._static:
+            return self._workers
+        now = time.monotonic()
+        with self._lock:
+            if self._workers and now - self._cached_at < self.cache_s:
+                return self._workers
+        found = discover_serving_workers(self.num_processes)
+        with self._lock:
+            if found:
+                self._workers = found
+                self._cached_at = now
+            return self._workers
+
+    def _prefill_chunk_of(self, endpoint: str) -> int:
+        """The prefill tier's chunk size (what tier arbitration counts
+        chunks with) — fetched once from the worker's /v1/stats and cached;
+        0 (unknown) degrades arbitration to single-chunk behavior."""
+        if self._prefill_chunk is None:
+            try:
+                stats = _get_json(f"http://{endpoint}/v1/stats")
+                self._prefill_chunk = int(stats.get("prefill_chunk") or 0)
+            except Exception:
+                return 0
+        return self._prefill_chunk
+
+    # --------------------------------------------------------------- routing
+    def _pick_decode(self, prompt: list, candidates: list[dict]):
+        """Affinity first, least-loaded on ties; a worker that fails its
+        probe drops out of this decision, not out of the fleet."""
+        probed = []
+        for worker in candidates:
+            try:
+                answer = _post_json(
+                    f"http://{worker['endpoint']}/v1/prefixes",
+                    {"prompt": prompt},
+                )
+                probed.append((worker, int(answer.get("match_tokens", 0)),
+                               int(answer.get("in_flight", 0))))
+            except Exception as exc:
+                logger.warning(
+                    f"serving worker {worker['endpoint']} failed its affinity "
+                    f"probe ({exc!r}); routing around it"
+                )
+        if not probed:
+            return None, 0
+        best_match = max(match for _, match, _ in probed)
+        tied = [(w, m, load) for w, m, load in probed if m == best_match]
+        worker = min(tied, key=lambda t: t[2])[0]
+        return worker, best_match
+
+    def route(self, request: dict):
+        """One admission decision: assign the fleet rid, arbitrate the entry
+        tier, pick workers, and return ``(rid, url, outbound_request)`` —
+        the relay target. Raises RuntimeError when no worker can serve."""
+        prompt = list(request.get("prompt") or [])
+        if not prompt:
+            raise ValueError("empty or missing 'prompt'")
+        workers = self.workers()
+        decode_candidates = [w for w in workers
+                             if w["role"] in ("decode", "unified")]
+        prefill_candidates = [w for w in workers if w["role"] == "prefill"]
+        if not decode_candidates:
+            raise RuntimeError(
+                "no decode-capable serving worker registered "
+                f"({len(workers)} workers known)"
+            )
+        decode_worker, match = self._pick_decode(prompt, decode_candidates)
+        if decode_worker is None:
+            raise RuntimeError("every decode-capable worker failed its probe")
+        prefill_chunk = (
+            self._prefill_chunk_of(prefill_candidates[0]["endpoint"])
+            if prefill_candidates else 0
+        )
+        tier = arbitrate_serving_tier(
+            len(prompt), self.slo, prefill_chunk=prefill_chunk,
+            have_prefill_tier=bool(prefill_candidates),
+        )
+        with self._lock:
+            rid = self._next_rid
+            self._next_rid += 1
+        if self.tracer is not None:
+            self.tracer.submit(rid, len(prompt), tier="router")
+            self.tracer.admit(rid, decision=f"route_{tier}",
+                              aliased_blocks=0, chunks=1)
+        routed, affinity_hits = _router_counters()
+        routed.inc(tier=tier)
+        if match > 0:
+            affinity_hits.inc()
+        outbound = {key: value for key, value in request.items()
+                    if key != "request_id"}
+        outbound["request_id"] = rid
+        if tier == "prefill":
+            prefill_worker = min(
+                prefill_candidates,
+                key=lambda w: self._in_flight_of(w["endpoint"]),
+            )
+            outbound["decode_endpoint"] = decode_worker["endpoint"]
+            return rid, f"http://{prefill_worker['endpoint']}/v1/generate", outbound
+        return rid, f"http://{decode_worker['endpoint']}/v1/generate", outbound
+
+    def _in_flight_of(self, endpoint: str) -> int:
+        try:
+            return int(_get_json(f"http://{endpoint}/v1/stats")["in_flight"])
+        except Exception:
+            return 1 << 30  # unprobeable: route around it when possible
+
+    # ------------------------------------------------------------- provider
+    def handle_get(self, path: str, query: dict):
+        if path == "/v1/stats":
+            body = json.dumps(self.stats()).encode()
+            return (200, "application/json", body)
+        return None
+
+    def handle_post(self, path: str, query: dict, body: bytes):
+        if path != "/v1/generate":
+            return None
+        request = json.loads(body or b"{}")
+        try:
+            rid, url, outbound = self.route(request)
+        except ValueError as exc:
+            return ("json", 400, {"error": str(exc)})
+        except RuntimeError as exc:
+            return ("json", 503, {"error": str(exc)})
+
+        def finalize(done: dict) -> dict:
+            if self.tracer is not None:
+                self.tracer.finish(rid, len(done.get("tokens", [])),
+                                   tpot_s=done.get("tpot_s"))
+                record = next(
+                    (r for r in self.tracer.records() if r["rid"] == rid),
+                    None,
+                )
+                if record is not None:
+                    done["trace"] = [record] + done.get("trace", [])
+            return done
+
+        return ("sse", relay_generate(url, outbound, finalize=finalize))
+
+    def stats(self) -> dict:
+        routed, affinity_hits = _router_counters()
+        by_tier = {key[0]: int(v)
+                   for key, v in routed.series_values().items()}
+        total = sum(by_tier.values())
+        hits = int(affinity_hits.value())
+        return {
+            "role": "router",
+            "workers": self.workers(),
+            "routed": by_tier,
+            "affinity_hits": hits,
+            "affinity_hit_rate": round(hits / total, 6) if total else None,
+        }

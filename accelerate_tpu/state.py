@@ -222,6 +222,14 @@ class PartialState:
         # offset the port by their local rank instead of fighting for one
         # bind; the shared helper degrades a bind failure to a warning.
         self._metrics_endpoint = None
+        # Disaggregated-serving tier membership (serving_net/roles.py): the
+        # role is a launch-time property of the HOST — resolved here once so
+        # commands, the serving frontend, and the fleet plane all agree —
+        # and published as a labeled gauge so /fleet rows carry the tier
+        # before any engine or frontend exists (warmup is visible per tier).
+        from .serving_net.roles import resolve_serving_role
+
+        self.serving_role = resolve_serving_role()
         if os.environ.get(ENV_METRICS_PORT, "").strip():
             from .telemetry import start_endpoint_from_env
 
@@ -237,6 +245,14 @@ class PartialState:
                 self._metrics_endpoint = publish_metrics_endpoint(
                     process_index=self.process_index, server=server
                 )
+                if self.serving_role.name != "unified":
+                    from .telemetry.metrics import get_registry
+
+                    get_registry().gauge(
+                        "accelerate_serving_role",
+                        "Serving tier this process runs (1 = the labeled role)",
+                        labelnames=("role",),
+                    ).set(1, role=self.serving_role.name)
                 # Fleet aggregation plane (ACCELERATE_FLEET_METRICS): the
                 # lead host scrapes every registered endpoint and serves the
                 # joined series + rollups at /fleet on this same server.

@@ -405,6 +405,17 @@ class FleetAggregator:
             row["restarts"] = _series_value(families, "accelerate_restarts")
             row["kv_pool_utilization"] = _series_value(
                 families, "accelerate_serving_kv_pool_utilization")
+            # Disaggregated-serving tier (serving_net/): the role gauge is a
+            # labeled constant-1, so the label IS the datum — the row carries
+            # it for the per-tier rollup join and the `top` tier column.
+            row["serving_role"] = None
+            for key in families.get(
+                "accelerate_serving_role", {}
+            ).get("series", {}):
+                m = re.search(r'role="([^"]*)"', key)
+                if m:
+                    row["serving_role"] = m.group(1)
+                    break
             breaches = {}
             for key, value in families.get(
                 "accelerate_slo_breaches_total", {}
@@ -495,7 +506,82 @@ class FleetAggregator:
             "reshard_transitions": int(resharded),
             "health_trips": int(trips),
             "slo_breaches": breaches,
+            "serving_tiers": self._serving_tiers(hosts, per_host),
         }
+
+    @staticmethod
+    def _serving_tiers(hosts: dict, per_host: dict) -> dict:
+        """Fold per-host serving series into per-TIER rollups keyed by the
+        ``serving_role`` each row carries — the single pane where a
+        disaggregated deployment's prefill and decode sides read side by
+        side (requests, TTFT/TPOT means off the histogram sums, KV-chain
+        handoff volume) and the router tier reports its routing split and
+        prefix-affinity hit rate. Hosts with no role gauge (training jobs,
+        pre-serving warmup) simply contribute nothing."""
+        tiers: dict = {}
+        for rank, families in per_host.items():
+            role = hosts.get(str(rank), {}).get("serving_role")
+            if role is None:
+                continue
+            tier = tiers.setdefault(role, {
+                "hosts": 0, "requests": 0, "completed": 0,
+                "ttft_sum": 0.0, "ttft_count": 0.0,
+                "tpot_sum": 0.0, "tpot_count": 0.0,
+                "handoff": {},
+            })
+            tier["hosts"] += 1
+            tier["requests"] += int(_series_value(
+                families, "accelerate_serving_requests_total") or 0)
+            tier["completed"] += int(_series_value(
+                families, "accelerate_serving_requests_completed_total") or 0)
+            for metric, prefix in (("accelerate_serving_ttft_seconds", "ttft"),
+                                   ("accelerate_serving_tpot_seconds", "tpot")):
+                for key, value in families.get(metric, {}).get(
+                        "series", {}).items():
+                    if key.startswith(f"{metric}_sum"):
+                        tier[f"{prefix}_sum"] += value
+                    elif key.startswith(f"{metric}_count"):
+                        tier[f"{prefix}_count"] += value
+            for metric, field in (
+                ("accelerate_serving_handoff_bytes_total", "bytes"),
+                ("accelerate_serving_handoff_chains_total", "chains"),
+                ("accelerate_serving_handoff_blocks_total", "blocks"),
+            ):
+                for key, value in families.get(metric, {}).get(
+                        "series", {}).items():
+                    m = re.search(r'direction="([^"]*)"', key)
+                    direction = m.group(1) if m else "out"
+                    leg = tier["handoff"].setdefault(
+                        direction, {"bytes": 0, "chains": 0, "blocks": 0})
+                    leg[field] += int(value)
+            routed: dict = {}
+            for key, value in families.get(
+                "accelerate_serving_router_requests_total", {}
+            ).get("series", {}).items():
+                m = re.search(r'tier="([^"]*)"', key)
+                if m:
+                    routed[m.group(1)] = routed.get(m.group(1), 0) + int(value)
+            if routed:
+                prior = tier.get("routed", {})
+                for k, v in routed.items():
+                    prior[k] = prior.get(k, 0) + v
+                tier["routed"] = prior
+                hits = _series_value(
+                    families, "accelerate_serving_router_affinity_hits_total")
+                tier["affinity_hits"] = (
+                    tier.get("affinity_hits", 0) + int(hits or 0))
+        for tier in tiers.values():
+            for prefix in ("ttft", "tpot"):
+                count = tier.pop(f"{prefix}_count")
+                total = tier.pop(f"{prefix}_sum")
+                tier[f"{prefix}_s_mean"] = (
+                    round(total / count, 6) if count else None)
+            if "routed" in tier:
+                total = sum(tier["routed"].values())
+                tier["affinity_hit_rate"] = (
+                    round(tier.get("affinity_hits", 0) / total, 4)
+                    if total else None)
+        return tiers
 
     # ---------------------------------------------------------------- exports
     def snapshot(self) -> dict:

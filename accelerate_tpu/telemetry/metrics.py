@@ -341,6 +341,33 @@ def fleet_provider():
     return _FLEET_PROVIDER
 
 
+# Serving front-end provider (serving_net/frontend.py installs the worker's
+# ServingFrontend here; serving_net/router.py installs the router tier's) —
+# the third injected hook, so the one HTTP server every worker already runs
+# for /metrics also serves the /v1/* serving API (generate/prefixes/stats/
+# import) with this module still importing nothing from the framework.
+_SERVING_PROVIDER = None
+
+
+def set_serving_provider(provider):
+    """Route ``/v1/*`` to ``provider``; None uninstalls (503 until a serving
+    front end is installed). The provider contract:
+
+    - ``handle_get(path, query) -> (status, content_type, bytes) | None``
+      (None = 404) serves GET /v1/... (prefix membership, load stats);
+    - ``handle_post(path, query, body) -> ("json", status, dict) |
+      ("sse", iterator_of_event_strings) | None`` serves POST /v1/...;
+      an ``sse`` result streams each yielded string as one
+      ``text/event-stream`` chunk (flushed per event — the streaming-token
+      wire contract, docs/serving.md)."""
+    global _SERVING_PROVIDER
+    _SERVING_PROVIDER = provider
+
+
+def serving_provider():
+    return _SERVING_PROVIDER
+
+
 class _MetricsHandler(BaseHTTPRequestHandler):
     registry: MetricsRegistry = None
 
@@ -351,6 +378,9 @@ class _MetricsHandler(BaseHTTPRequestHandler):
             ctype = "text/plain; version=0.0.4; charset=utf-8"
         elif path in ("/", "/healthz"):
             body, ctype = b"ok\n", "text/plain"
+        elif path.startswith("/v1/"):
+            self._serve_v1_get(path)
+            return
         elif path in ("/fleet", "/fleet/metrics"):
             provider = _FLEET_PROVIDER
             if provider is None:
@@ -381,12 +411,91 @@ class _MetricsHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    # ------------------------------------------------------- serving (/v1/*)
+    def _local_serving_provider(self):
+        """THIS server's provider override when one is attached
+        (``MetricsServer.set_serving`` — multi-role single-process rigs and
+        tests), else the process-global install."""
+        return getattr(self.server, "at_serving", None) or _SERVING_PROVIDER
+
+    def _serve_v1_get(self, path: str):
+        from urllib.parse import parse_qs, urlparse
+
+        provider = self._local_serving_provider()
+        if provider is None:
+            self._respond_json(
+                503, {"error": "no serving front end installed in this process "
+                               "(serving_net.ServingFrontend.install())"},
+            )
+            return
+        query = parse_qs(urlparse(self.path).query)
+        try:
+            result = provider.handle_get(path, query)
+        except Exception as exc:  # the provider must not take the server down
+            self._respond_json(500, {"error": repr(exc)})
+            return
+        if result is None:
+            self.send_error(404)
+            return
+        status, ctype, body = result
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _serve_v1_post(self, path: str, query: dict):
+        provider = self._local_serving_provider()
+        if provider is None:
+            self._respond_json(
+                503, {"error": "no serving front end installed in this process "
+                               "(serving_net.ServingFrontend.install())"},
+            )
+            return
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length) if length else b""
+        try:
+            result = provider.handle_post(path, query, body)
+        except Exception as exc:
+            self._respond_json(500, {"error": repr(exc)})
+            return
+        if result is None:
+            self.send_error(404)
+            return
+        if result[0] == "json":
+            _, status, payload = result
+            self._respond_json(status, payload)
+            return
+        # ("sse", iterator): stream each yielded event string as one flushed
+        # chunk — chunked transfer, no Content-Length, connection closes when
+        # the iterator drains (the SSE wire contract, docs/serving.md).
+        _, events = result
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("Connection", "close")
+        self.end_headers()
+        try:
+            for event in events:
+                self.wfile.write(event.encode())
+                self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError):
+            close = getattr(events, "close", None)
+            if close is not None:
+                close()  # unsubscribe: the client hung up mid-stream
+
     def do_POST(self):  # noqa: N802 (http.server contract)
         """POST /profile?steps=N — arm an on-demand trace capture of the next
-        N step boundaries on THIS worker (each worker serves its own port)."""
+        N step boundaries on THIS worker (each worker serves its own port).
+        POST /v1/* routes to the installed serving provider (generate /
+        import — the streaming front end)."""
         from urllib.parse import parse_qs, urlparse
 
         parsed = urlparse(self.path)
+        if parsed.path.startswith("/v1/"):
+            self._serve_v1_post(parsed.path.rstrip("/"),
+                                parse_qs(parsed.query))
+            return
         if parsed.path not in ("/profile", "/profile/"):
             self.send_error(404)
             return
@@ -452,6 +561,15 @@ class MetricsServer:
         )
         self._thread.start()
         return self.port
+
+    def set_serving(self, provider):
+        """Route THIS server's ``/v1/*`` to ``provider``, overriding the
+        process-global :func:`set_serving_provider` install — what lets one
+        process host several serving roles on several ports (in-process
+        tests; a colocated router + worker rig)."""
+        if self._httpd is None:
+            raise RuntimeError("start() the server before attaching a provider")
+        self._httpd.at_serving = provider
 
     def stop(self):
         if self._httpd is not None:

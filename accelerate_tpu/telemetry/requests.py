@@ -72,9 +72,17 @@ class RequestTracer:
     def _get(self, rid: int) -> dict | None:
         return self._ring.get(rid)
 
-    def submit(self, rid: int, prompt_tokens: int, submit_t: float | None = None):
+    def submit(self, rid: int, prompt_tokens: int, submit_t: float | None = None,
+               tier: str = "unified"):
+        """``tier`` names which serving tier this record was made on
+        (``unified`` / ``router`` / ``prefill`` / ``decode`` — serving_net
+        roles): a disaggregated request keeps ONE rid across tiers (the
+        router assigns it and threads it through every ``submit``), so the
+        per-tier records join into one cross-host trace by rid, each tier
+        attributing its own queue_wait/chunks/ttft share."""
         record = {
             "rid": int(rid),
+            "tier": str(tier),
             "state": "queued",
             "prompt_tokens": int(prompt_tokens),
             "submit_t": float(submit_t if submit_t is not None else self._clock()),
@@ -89,6 +97,7 @@ class RequestTracer:
             "tokens_out": None,
             "tpot_s": None,
             "total_s": None,
+            "handoff": None,
             "breached": [],
         }
         self._ring[rid] = record
@@ -188,6 +197,35 @@ class RequestTracer:
 
                 record_breach("tpot", record["tpot_s"], target, rid=rid)
 
+    def handoff(self, rid: int, direction: str, bytes: int = 0, blocks: int = 0,
+                endpoint: str | None = None):
+        """Book a KV-chain handoff leg on the record (``direction``:
+        ``out`` — this tier exported the chain, its record closes as
+        ``handed_off``; ``in`` — this tier imported it and will decode).
+        Also a flight-recorder event, so a black-box dump shows chain
+        movement around a fault. The rid is router-assigned and shared
+        across tiers, so /fleet consumers join the ``out`` and ``in`` legs
+        into one trace."""
+        record = self._get(rid)
+        if record is None:
+            return
+        record["handoff"] = {
+            "direction": str(direction), "bytes": int(bytes),
+            "blocks": int(blocks), "endpoint": endpoint,
+        }
+        if direction == "out":
+            record["state"] = "handed_off"
+        elif direction == "in":
+            # The imported chain arrives armed for decode: prefill happened
+            # on another tier, so this record skips queued/prefill states.
+            record["state"] = "decode"
+        from .flight import get_flight_recorder
+
+        get_flight_recorder().record(
+            "handoff", rid=int(rid), direction=str(direction),
+            bytes=int(bytes), blocks=int(blocks),
+        )
+
     def cancel(self, rid: int):
         """The request's engine state was wiped before it finished
         (``reset()`` mid-wave) — the record survives, marked cancelled."""
@@ -249,9 +287,9 @@ class RequestTracer:
             "tpot_s": {"p50": _quantile(tpot, 0.5), "p90": _quantile(tpot, 0.9),
                        "max": tpot[-1] if tpot else 0.0},
             "slowest": [
-                {k: r.get(k) for k in ("rid", "state", "decision", "defers",
-                                       "queue_wait_s", "ttft_s", "tpot_s",
-                                       "tokens_out", "breached")}
+                {k: r.get(k) for k in ("rid", "tier", "state", "decision",
+                                       "defers", "queue_wait_s", "ttft_s",
+                                       "tpot_s", "tokens_out", "breached")}
                 for r in self.slowest(slowest_n)
             ],
         }

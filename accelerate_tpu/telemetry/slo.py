@@ -88,6 +88,37 @@ def record_breach(target: str, value: float, threshold: float,
     )
 
 
+def arbitrate_serving_tier(prompt_tokens: int, slo=None, *,
+                           prefill_chunk: int = 0,
+                           have_prefill_tier: bool = False) -> str:
+    """Which tier a request should ENTER in a disaggregated serving fleet —
+    the SLO sentinel's admission arbitration (serving_net/router.py calls
+    this per request; docs/serving.md "Disaggregated serving").
+
+    The trade the policy encodes: shipping a finished KV chain costs one
+    handoff RTT (pure TTFT tax), while prefilling on the decode host stalls
+    every in-flight decoder by the prompt's chunk count (pure TPOT tax).
+    So a prompt that fits ONE prefill chunk decodes where it lands
+    (``"decode"`` — its single chunk stalls decode no worse than an import
+    would), and a multi-chunk prompt routes to the prefill tier when one
+    exists (``"prefill"`` — the decode tier's TPOT is protected from the
+    long prefill; TTFT pays the bounded transfer instead of an unbounded
+    queue behind other prompts). An explicit ``slo.tpot_s`` target tightens
+    nothing further — multi-chunk prompts already route away — and
+    ``slo.ttft_s`` alone (no TPOT target, nothing to protect) keeps even
+    long prompts on the decode host, where TTFT skips the handoff RTT.
+    Without a prefill tier everything is ``"decode"``."""
+    if not have_prefill_tier:
+        return "decode"
+    chunks = 1 if prefill_chunk <= 0 else -(-int(prompt_tokens) // int(prefill_chunk))
+    if chunks <= 1:
+        return "decode"
+    ttft_only = (slo is not None
+                 and getattr(slo, "ttft_s", None) is not None
+                 and getattr(slo, "tpot_s", None) is None)
+    return "decode" if ttft_only else "prefill"
+
+
 def breach_counts(registry=None) -> dict:
     """``{target: count}`` from the registry's breach counter — what bench.py
     snapshots around its measured window (``detail.slo``) and the fleet

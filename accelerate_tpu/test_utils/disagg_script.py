@@ -1,0 +1,235 @@
+"""Disaggregated-serving drill, run under the real 2-process launcher::
+
+    accelerate-tpu launch --cpu --num_processes 2 -m \
+        accelerate_tpu.test_utils.disagg_script
+
+Proves the tentpole property ``tests/test_serving_net.py`` pins: prefill and
+decode run on disjoint "hosts" (rank 0 = decode, rank 1 = prefill — separate
+processes, separate pools, separate metrics endpoints registered in the
+coordination-service KV namespace), a router on the decode host discovers
+BOTH workers through that registry, and a client driving the router over
+real HTTP/SSE gets:
+
+- token output **bit-identical** to one unified single-host paged engine
+  running the same prompts (handoff is state surgery, never a recompute);
+- one ``done``-event trace per request spanning router admission → prefill
+  chunks → chain handoff → first decode token, with TTFT/TPOT and
+  queue-wait attribution on the records;
+- ``accelerate-tpu top`` (JSON and human frames, real subprocesses against
+  the lead host's endpoint) showing BOTH tiers' fleet rollups.
+
+The model is tiny and seeded identically on both ranks, so every parity
+assertion is exact; the registration, discovery, routing, chunked prefill,
+chain transfer, import surgery, and streaming are all real.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import threading
+import urllib.request
+
+import numpy as np
+
+from accelerate_tpu import PartialState
+from accelerate_tpu.telemetry import start_default_server
+from accelerate_tpu.telemetry.fleet import (
+    FleetAggregator,
+    install_fleet_provider,
+    publish_metrics_endpoint,
+)
+from accelerate_tpu.utils.agreement import kv_all_gather
+
+# chunk=8 with these prompt lengths pins the routing split: 3/5 fit one
+# chunk (decode entry), 14/21 are multi-chunk (prefill entry + handoff).
+PROMPT_LENS = (5, 14, 3, 21)
+CHUNK = 8
+MAX_NEW = 8
+
+
+def _model():
+    import jax
+
+    from accelerate_tpu.models import Llama, LlamaConfig
+
+    cfg = LlamaConfig.tiny(vocab_size=256, hidden_size=64,
+                           intermediate_size=128, num_hidden_layers=2,
+                           num_attention_heads=4, num_key_value_heads=2)
+    model = Llama(cfg)
+    model.init_params(jax.random.key(0))  # same key both ranks: exact parity
+    return model
+
+
+def _engine(model):
+    import jax.numpy as jnp
+
+    from accelerate_tpu.serving import ContinuousBatcher
+
+    return ContinuousBatcher(
+        model, batch_slots=2, max_new_tokens=MAX_NEW, max_cache_len=1024,
+        cache_dtype=jnp.float32, bucket_sizes=(8, 16), sync_every=2,
+        paged=True, block_size=4, prefill_chunk=CHUNK,
+        max_tokens_per_request=48,
+    )
+
+
+def _prompts():
+    rng = np.random.default_rng(7)
+    return [rng.integers(1, 256, (n,)).astype(np.int32) for n in PROMPT_LENS]
+
+
+def _generate(endpoint: str, prompt) -> dict:
+    from accelerate_tpu.serving_net.frontend import read_sse_response
+
+    req = urllib.request.Request(
+        f"http://{endpoint}/v1/generate",
+        data=json.dumps({"prompt": [int(t) for t in prompt],
+                         "max_new_tokens": MAX_NEW}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=300.0) as response:
+        return read_sse_response(response)
+
+
+def main():
+    state = PartialState()
+    assert state.num_processes >= 2, "run under `launch --num_processes 2`"
+    rank = state.process_index
+    role = "decode" if rank == 0 else "prefill"
+
+    from accelerate_tpu.serving_net import Router, ServingFrontend
+
+    model = _model()
+    server = start_default_server(0)  # ephemeral: nobody knows the port
+    endpoint = publish_metrics_endpoint(process_index=rank, server=server)
+    assert endpoint is not None, "metrics endpoint registration failed"
+
+    engine = _engine(model)
+    frontend = ServingFrontend(engine, role=role)
+    # Global-provider install: this rank's ONE metrics server now serves
+    # /v1/* for its tier, and the role+endpoint lands in the serving KV
+    # namespace (what the router discovers — no address list anywhere).
+    frontend.install(process_index=rank, endpoint=endpoint)
+
+    kv_all_gather("ready", state.num_processes, rank,
+                  namespace="at_disagg_drill/ready")
+
+    if rank == 0:
+        # The single-host truth: one unified engine, same model, same
+        # kwargs, same prompts — greedy output the routed path must match
+        # bit for bit.
+        prompts = _prompts()
+        baseline_engine = _engine(model)
+        rids = [baseline_engine.submit(p) for p in prompts]
+        baseline = baseline_engine.run()
+        expected = [[int(t) for t in baseline[r]] for r in rids]
+
+        # The router rides its own loopback server (multi-role host): its
+        # /v1 provider is attached per-server, so the default server keeps
+        # serving the decode tier.
+        from accelerate_tpu.telemetry.metrics import MetricsServer
+
+        router_server = MetricsServer(0, host="127.0.0.1")
+        router_port = router_server.start()
+        router = Router(num_processes=state.num_processes)
+        router_server.set_serving(router)
+        router_ep = f"127.0.0.1:{router_port}"
+        workers = {w["role"]: w for w in router.workers()}
+        assert set(workers) == {"decode", "prefill"}, workers
+        assert workers["decode"]["endpoint"] == endpoint, workers
+
+        results = [None] * len(prompts)
+        errors = []
+
+        def client(i, prompt):
+            try:
+                results[i] = _generate(router_ep, prompt)
+            except Exception as exc:
+                errors.append(f"request {i}: {exc!r}")
+
+        threads = [threading.Thread(target=client, args=(i, p))
+                   for i, p in enumerate(prompts)]
+        for thread in threads:
+            thread.start()
+        for thread in threads:
+            thread.join()
+        assert not errors, errors
+
+        # Bit-identical parity, every request.
+        for i, result in enumerate(results):
+            assert result["tokens"] == expected[i], (
+                f"request {i}: disagg {result['tokens']} != unified {expected[i]}"
+            )
+
+        # One trace per request spanning every tier it crossed, TTFT/TPOT +
+        # queue-wait attribution on the records.
+        for i, result in enumerate(results):
+            done = result["done"]
+            trace = done["trace"]
+            tiers = [r.get("tier") for r in trace]
+            multi_chunk = PROMPT_LENS[i] > CHUNK
+            want = (["router", "prefill", "decode"] if multi_chunk
+                    else ["router", "decode"])
+            assert tiers == want, (i, tiers)
+            assert done["ttft_s"] is not None and done["tpot_s"] is not None, done
+            router_rec, decode_rec = trace[0], trace[-1]
+            assert router_rec["decision"] == (
+                "route_prefill" if multi_chunk else "route_decode"
+            ), router_rec
+            # Queue wait is attributed on the tier the request ENTERED —
+            # the prefill record for handed-off requests, the decode record
+            # for requests that decoded where they landed.
+            entered = trace[1] if multi_chunk else decode_rec
+            assert entered["queue_wait_s"] is not None, entered
+            assert decode_rec["state"] == "finished", decode_rec
+            if multi_chunk:
+                prefill_rec = trace[1]
+                assert prefill_rec["state"] == "handed_off", prefill_rec
+                leg = prefill_rec["handoff"]
+                assert leg["direction"] == "out" and leg["bytes"] > 0, leg
+                assert len(prefill_rec["chunks"]) >= 2, prefill_rec
+                assert decode_rec["handoff"]["direction"] == "in", decode_rec
+            # One rid spans every tier it crossed.
+            assert len({r["rid"] for r in trace}) == 1, trace
+
+        # The operator console: both tiers' rollups through the real
+        # aggregate-and-render path.
+        install_fleet_provider(FleetAggregator(state=state))
+        snap = subprocess.run(
+            [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli",
+             "top", "--once", "--json", "--endpoint", endpoint],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert snap.returncode == 0, snap.stdout[-800:] + snap.stderr[-800:]
+        got = json.loads(snap.stdout)
+        assert got["hosts"]["0"]["serving_role"] == "decode", got["hosts"]
+        assert got["hosts"]["1"]["serving_role"] == "prefill", got["hosts"]
+        tiers = got["fleet"]["serving_tiers"]
+        assert set(tiers) >= {"decode", "prefill"}, tiers
+        assert tiers["decode"]["hosts"] == 1 and tiers["prefill"]["hosts"] == 1
+        assert tiers["decode"]["requests"] >= len(prompts), tiers["decode"]
+        assert tiers["prefill"]["handoff"]["out"]["chains"] == 2, tiers["prefill"]
+        assert tiers["decode"]["handoff"]["in"]["chains"] == 2, tiers["decode"]
+        assert tiers["decode"]["ttft_s_mean"] is not None, tiers["decode"]
+
+        frame = subprocess.run(
+            [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli",
+             "top", "--once", "--endpoint", endpoint],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert frame.returncode == 0, frame.stderr[-800:]
+        assert "serving[decode]" in frame.stdout, frame.stdout
+        assert "serving[prefill]" in frame.stdout, frame.stdout
+
+        router_server.stop()
+
+    kv_all_gather("done", state.num_processes, rank,
+                  namespace="at_disagg_drill/done")
+    frontend.uninstall()
+    print(f"DISAGG_OK rank={rank} role={role} endpoint={endpoint}")
+
+
+if __name__ == "__main__":
+    main()

@@ -91,6 +91,17 @@ ENV_FLEET_METRICS = "ACCELERATE_FLEET_METRICS"
 ENV_SLO_STEP_TIME = "ACCELERATE_SLO_STEP_TIME"
 ENV_SLO_TTFT = "ACCELERATE_SLO_TTFT"
 ENV_SLO_TPOT = "ACCELERATE_SLO_TPOT"
+# Disaggregated serving tier (serving_net/; docs/serving.md "Disaggregated
+# serving"): which role this process plays in a multi-host serving fleet —
+# ``unified`` (the single-host default: prefill + decode in one engine),
+# ``prefill`` (chunked prefill only; finished KV chains ship to a decode
+# host), ``decode`` (imports chains and decodes), or ``router`` (the
+# prefix-affinity front door). Tri-state per the kernels precedent: unset =
+# unified, an explicit ``unified`` scrubs an inherited value. The router
+# endpoint is where non-router workers report for rollup joins (and where
+# clients point at the fleet); tri-state like profile_steps ('' scrubs).
+ENV_SERVING_ROLE = "ACCELERATE_SERVING_ROLE"
+ENV_ROUTER_ENDPOINT = "ACCELERATE_ROUTER_ENDPOINT"
 # Dispatch amortization (docs/performance.md "Dispatch amortization"): the
 # default K for Accelerator.build_train_window (1 = one dispatch per step),
 # and the curated XLA latency-hiding flag preset installed into

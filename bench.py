@@ -77,7 +77,14 @@ import numpy as np
 # nothing was armed); BENCH_SERVING=1 lines additionally gain
 # detail.serving.requests — TTFT/TPOT p50/p90/max and the slowest-request
 # table from the serving engine's per-request lifecycle tracer.
-BENCH_SCHEMA_VERSION = 11
+# v12 = disaggregated serving lever (serving_net/): BENCH_SERVING_DISAGG=1
+# drives the full 3-tier rig (router + prefill + decode workers over real
+# loopback HTTP/SSE — benchmarks/serving_disagg_profile.py) and embeds
+# detail.serving.routing — the tier routing split and affinity hit rate,
+# handoff chains/blocks/bytes shipped prefill → decode, per-tier TTFT/TPOT,
+# and the bit-identical-output parity verdict vs one unified engine. Absent
+# otherwise; composes with BENCH_SERVING (both land under detail.serving).
+BENCH_SCHEMA_VERSION = 12
 
 
 class BenchAuditFailure(RuntimeError):
@@ -670,6 +677,29 @@ def run_one(mode: str):
                 sys.path.remove(bench_dir)
             except ValueError:
                 pass
+
+    # Disaggregated serving lever (schema v12): BENCH_SERVING_DISAGG=1 runs
+    # the 3-tier router/prefill/decode rig over real loopback HTTP
+    # (benchmarks/serving_disagg_profile.py) and embeds the routing payload
+    # under detail.serving.routing — composing with BENCH_SERVING when both
+    # levers are armed.
+    if os.environ.get("BENCH_SERVING_DISAGG", "0") == "1":
+        bench_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "benchmarks")
+        sys.path.insert(0, bench_dir)
+        try:
+            import serving_disagg_profile
+
+            routing_summary = serving_disagg_profile.summarize()
+        except Exception as exc:  # the lever must never take the row down
+            routing_summary = {"error": f"{type(exc).__name__}: {exc}"[:300]}
+        finally:
+            try:
+                sys.path.remove(bench_dir)
+            except ValueError:
+                pass
+        serving_summary = dict(serving_summary or {})
+        serving_summary["routing"] = routing_summary
 
     print(
         json.dumps(

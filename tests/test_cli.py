@@ -293,6 +293,9 @@ def test_config_wizard_roundtrips_through_launch(tmp_path):
         "0.3",               # SLO target: per-step wall time (s)
         "0.5",               # SLO target: serving TTFT (s)
         "0",                 # SLO target: serving TPOT (0 = no target)
+        "yes",               # configure disaggregated serving tiers?
+        "prefill",           # serving role for the launched workers
+        "127.0.0.1:9876",    # router endpoint
         "yes",               # configure dispatch amortization?
         "4",                 # train window K
         "latency",           # xla latency-hiding preset
@@ -318,6 +321,8 @@ def test_config_wizard_roundtrips_through_launch(tmp_path):
     assert cfg.profile_steps == "10-12" and cfg.profile_slow_zscore == 5.5
     assert cfg.fleet_metrics is False  # explicit decline, not unspecified
     assert cfg.slo_step_time == 0.3 and cfg.slo_ttft == 0.5 and cfg.slo_tpot == 0.0
+    assert cfg.serving_role == "prefill"
+    assert cfg.router_endpoint == "127.0.0.1:9876"
     assert cfg.train_window == 4 and cfg.xla_preset == "latency"
     assert cfg.zero_sharding is True
     assert cfg.kernels == "pallas"
@@ -362,6 +367,13 @@ def test_config_wizard_roundtrips_through_launch(tmp_path):
         "assert acc.telemetry.slo.ttft_s == 0.5\n"
         "from accelerate_tpu.telemetry.slo import serving_slo_from_env\n"
         "assert serving_slo_from_env().ttft_s == 0.5\n"
+        "assert os.environ.get('ACCELERATE_SERVING_ROLE') == 'prefill'\n"
+        "assert os.environ.get('ACCELERATE_ROUTER_ENDPOINT') == '127.0.0.1:9876'\n"
+        "from accelerate_tpu.serving_net.roles import resolve_serving_role, "
+        "router_endpoint_from_env\n"
+        "assert resolve_serving_role().name == 'prefill'\n"
+        "assert acc.state.serving_role.name == 'prefill'\n"
+        "assert router_endpoint_from_env() == '127.0.0.1:9876'\n"
         "assert os.environ.get('ACCELERATE_TRAIN_WINDOW') == '4'\n"
         "assert acc.train_window == 4\n"
         "assert os.environ.get('ACCELERATE_XLA_PRESET') == 'latency'\n"
