@@ -195,6 +195,7 @@ def get_user_input() -> ClusterConfig:
     # ACCELERATE_ROUTER_ENDPOINT still flows through at launch. Answering
     # (even 'unified' / '') is an explicit choice that scrubs stale values.
     serving_role, router_endpoint = None, None
+    serving_retry_budget, serving_lease_ttl, drain_grace_s = None, None, None
     if _yesno(
         "Do you want to configure disaggregated serving tiers (prefill/"
         "decode hosts with KV-chain handoff behind an affinity router)?",
@@ -207,6 +208,18 @@ def get_user_input() -> ClusterConfig:
         )
         router_endpoint = _ask(
             "  router endpoint host:port ('' = none)", ""
+        )
+        serving_retry_budget = _ask(
+            "  router retry budget: re-dispatches per failed request "
+            "(0 = library default 2)", 0.0, float,
+        )
+        serving_lease_ttl = _ask(
+            "  worker discovery lease TTL in seconds "
+            "(0 = library default 15)", 0.0, float,
+        )
+        drain_grace_s = _ask(
+            "  SIGTERM drain grace in seconds "
+            "(0 = library default 30)", 0.0, float,
         )
     # Tri-state like the health section: declining leaves both UNSPECIFIED
     # (None / '') so an inherited ACCELERATE_TRAIN_WINDOW/XLA_PRESET still
@@ -305,6 +318,9 @@ def get_user_input() -> ClusterConfig:
         slo_tpot=slo_tpot,
         serving_role=serving_role,
         router_endpoint=router_endpoint,
+        serving_retry_budget=serving_retry_budget,
+        serving_lease_ttl=serving_lease_ttl,
+        drain_grace_s=drain_grace_s,
         train_window=train_window,
         xla_preset=xla_preset,
         zero_sharding=zero_sharding,

@@ -149,6 +149,14 @@ class ClusterConfig:
     # (ACCELERATE_ROUTER_ENDPOINT; None = unspecified, '' scrubs).
     serving_role: str | None = None
     router_endpoint: str | None = None
+    # Serving fault tolerance (serving_net/lease.py; docs/serving.md
+    # "Failure semantics"): router retry budget per request, worker lease
+    # TTL seconds, and SIGTERM drain grace seconds. TRI-state floats per the
+    # SLO precedent — None = unspecified (inherited env flows), an explicit
+    # 0 scrubs a stale inherited value back to the library default.
+    serving_retry_budget: float | None = None
+    serving_lease_ttl: float | None = None
+    drain_grace_s: float | None = None
     # Dispatch amortization (docs/performance.md): ``train_window`` is the K
     # Accelerator.build_train_window fuses per dispatch (tri-state like
     # ``telemetry``: None = unspecified, an inherited ACCELERATE_TRAIN_WINDOW

@@ -43,8 +43,11 @@ from ..utils.constants import (
     ENV_PROCESS_ID,
     ENV_PROFILE_SLOW_ZSCORE,
     ENV_PROFILE_STEPS,
+    ENV_DRAIN_GRACE_S,
     ENV_RESTART_ATTEMPT,
     ENV_ROUTER_ENDPOINT,
+    ENV_SERVING_LEASE_TTL,
+    ENV_SERVING_RETRY_BUDGET,
     ENV_SERVING_ROLE,
     ENV_SLO_STEP_TIME,
     ENV_SLO_TPOT,
@@ -217,6 +220,32 @@ def launch_command_parser(subparsers=None) -> argparse.ArgumentParser:
              "Tri-state: unset inherits, '' scrubs an inherited value.",
     )
     parser.add_argument(
+        "--serving_retry_budget", type=float, default=None,
+        help="Serving fault tolerance: how many times the router re-dispatches "
+             "a failed request on a surviving worker under the SAME rid "
+             "before surfacing the error (ACCELERATE_SERVING_RETRY_BUDGET; "
+             "library default 2; docs/serving.md 'Failure semantics'). "
+             "Tri-state per the SLO precedent: unset inherits, an explicit 0 "
+             "scrubs an inherited value back to the default.",
+    )
+    parser.add_argument(
+        "--serving_lease_ttl", type=float, default=None,
+        help="Serving fault tolerance: seconds a worker's heartbeat-refreshed "
+             "discovery lease stays valid without a refresh — an expired "
+             "lease is an eviction (ACCELERATE_SERVING_LEASE_TTL; library "
+             "default 15). Tri-state: unset inherits, an explicit 0 scrubs "
+             "an inherited value back to the default.",
+    )
+    parser.add_argument(
+        "--drain_grace_s", type=float, default=None,
+        help="Serving fault tolerance: seconds a SIGTERM'd serving worker "
+             "waits for in-flight requests to finish before exiting — "
+             "admission stops immediately, the lease is revoked after "
+             "(ACCELERATE_DRAIN_GRACE_S; library default 30). Tri-state: "
+             "unset inherits, an explicit 0 scrubs an inherited value back "
+             "to the default.",
+    )
+    parser.add_argument(
         "--straggler_threshold", type=float, default=None,
         help="Cross-host slowness ratio that raises a straggler alert "
              "(ACCELERATE_STRAGGLER_THRESHOLD; library default 1.5): a host "
@@ -343,6 +372,9 @@ def _merge_config(args) -> ClusterConfig:
         ("slo_tpot", "slo_tpot"),
         ("serving_role", "serving_role"),
         ("router_endpoint", "router_endpoint"),
+        ("serving_retry_budget", "serving_retry_budget"),
+        ("serving_lease_ttl", "serving_lease_ttl"),
+        ("drain_grace_s", "drain_grace_s"),
         ("train_window", "train_window"),
         ("xla_preset", "xla_preset"),
         ("zero_sharding", "zero_sharding"),
@@ -447,6 +479,18 @@ def prepare_launch_env(cfg: ClusterConfig, process_id: int | None = None, attemp
         env[ENV_ROUTER_ENDPOINT] = cfg.router_endpoint.strip()
     elif cfg.router_endpoint is not None:
         env.pop(ENV_ROUTER_ENDPOINT, None)
+    # Serving fault-tolerance knobs (serving_net/lease.py): tri-state per the
+    # SLO precedent — an explicit 0 scrubs a stale inherited value back to
+    # the library default instead of forwarding it.
+    for value, env_name in (
+        (cfg.serving_retry_budget, ENV_SERVING_RETRY_BUDGET),
+        (cfg.serving_lease_ttl, ENV_SERVING_LEASE_TTL),
+        (cfg.drain_grace_s, ENV_DRAIN_GRACE_S),
+    ):
+        if value:
+            env[env_name] = str(value)
+        elif value is not None:
+            env.pop(env_name, None)
     # Dispatch amortization: the window K reaches Accelerator.train_window;
     # the XLA preset is installed by PartialState BEFORE backend creation in
     # the worker (libtpu reads LIBTPU_INIT_ARGS once at init).
@@ -640,6 +684,15 @@ def launch_command(args) -> None:
             raise ValueError(
                 f"--serving_role must be one of {'/'.join(SERVING_ROLES)}, "
                 f"got {cfg.serving_role!r}"
+            )
+    for name, value in (
+        ("--serving_retry_budget", cfg.serving_retry_budget),
+        ("--serving_lease_ttl", cfg.serving_lease_ttl),
+        ("--drain_grace_s", cfg.drain_grace_s),
+    ):
+        if value is not None and value < 0:
+            raise ValueError(
+                f"{name} must be >= 0 (0 = library default), got {value}"
             )
     from ..telemetry import metrics_port_from_env
 

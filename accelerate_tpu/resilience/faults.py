@@ -43,6 +43,27 @@ Each fault fires at most once per plan instance, so an auto-resumed run that
 replays the faulting step does not crash-loop on its own injection. The data
 faults (``nan``/``loss_spike``) fire only when the training loop calls
 ``guard_step`` — on a loop without the health guard they stay inert.
+
+Serving chaos (docs/serving.md "Failure semantics"): ``req:<N>=<action>``
+entries target the serving tier instead of the training loop — ``N`` is the
+Nth /v1 request (0-based) the consuming component serves, and the actions are
+
+- ``worker_kill``         the worker dies mid-stream while serving request N
+                          (``os._exit(0)`` after the first token delta — the
+                          real-death analog, run under the launcher; in-process
+                          rigs set ``ServingFrontend.kill_mode = "stream"`` for
+                          a survivable stand-in) — exercises router retry,
+                          probe-failure breakers, and lease eviction;
+- ``handoff_drop``        the Nth prefill→decode chain handoff is dropped in
+                          transit — exercises free-on-ack re-handoff and the
+                          orphaned chain's return to the free list;
+- ``stall:<secs>``        the worker sleeps before admitting request N —
+                          exercises deadline propagation;
+- ``slow_worker:<mult>x`` the worker streams request N's events ``mult``×
+                          slower — exercises retry budgets and SLO booking.
+
+``maybe_fire`` never fires ``req:`` faults; serving components consume them
+through :meth:`FaultPlan.take_serving_fault` (each counts its own requests).
 """
 
 from __future__ import annotations
@@ -67,6 +88,10 @@ _ACTIONS = (
 _DATA_ACTIONS = ("nan", "loss_spike")
 # World-size faults change how many devices the next incarnation sees.
 _RESIZE_ACTIONS = ("shrink", "grow")
+# Serving-scope (``req:N=``) actions: consumed by serving_net components via
+# take_serving_fault, never fired by maybe_fire. ``stall`` is shared with the
+# step scope; the entry's scope decides who consumes it.
+_SERVING_ACTIONS = ("worker_kill", "handoff_drop", "stall", "slow_worker")
 
 
 class SimulatedFault(RuntimeError):
@@ -99,6 +124,19 @@ class Fault:
     action: str
     arg: str | None = None
     fired: bool = False
+    # "step" faults key on the training step; "req" faults key on the Nth
+    # /v1 request the consuming serving component serves.
+    scope: str = "step"
+
+    @property
+    def slow_factor(self) -> float:
+        """The ``slow_worker:<mult>x`` multiplier (parse-validated > 0)."""
+        return float((self.arg or "2").rstrip("xX"))
+
+    @property
+    def stall_s(self) -> float:
+        """The ``stall:<secs>`` duration."""
+        return float(self.arg) if self.arg else 1.0
 
 
 @dataclass
@@ -117,21 +155,33 @@ class FaultPlan:
             try:
                 lhs, action = entry.split("=", 1)
                 kind, step = lhs.split(":", 1)
-                if kind.strip() != "step":
+                kind = kind.strip()
+                if kind not in ("step", "req"):
                     raise ValueError
                 step = int(step)
                 action, _, arg = action.strip().partition(":")
-                if action not in _ACTIONS:
+                if kind == "req":
+                    if action not in _SERVING_ACTIONS:
+                        raise ValueError
+                    if action in ("worker_kill", "handoff_drop") and arg:
+                        raise ValueError  # these take no argument
+                    if action == "stall" and arg:
+                        float(arg)
+                    if action == "slow_worker" and arg:
+                        # '4x' or '4' — the multiplier must be positive.
+                        if float(arg.rstrip("xX")) <= 0:
+                            raise ValueError
+                elif action not in _ACTIONS:
                     raise ValueError
-                if action in ("stall", "hang") and arg:
+                elif action in ("stall", "hang") and arg:
                     float(arg)  # a bad duration must fail at parse, not mid-run
-                if action == "loss_spike" and arg:
+                elif action == "loss_spike" and arg:
                     # '50x' or '50' — the multiplier must be a positive number.
                     if float(arg.rstrip("xX")) <= 0:
                         raise ValueError
-                if action == "nan" and arg:
+                elif action == "nan" and arg:
                     raise ValueError  # nan takes no argument
-                if action in _RESIZE_ACTIONS and arg:
+                elif action in _RESIZE_ACTIONS and arg:
                     # 'shrink:2' halves the device count; the factor must be
                     # an integer >= 2 (1 would be a no-op resize).
                     if int(arg) < 2:
@@ -140,9 +190,12 @@ class FaultPlan:
                 raise ValueError(
                     f"Bad fault-plan entry {entry!r}: expected "
                     "'step:<N>=<action>[:<arg>]' with action in "
-                    f"{'/'.join(_ACTIONS)} (e.g. 'step:37=kill;step:80=partial_ckpt')."
+                    f"{'/'.join(_ACTIONS)} (e.g. 'step:37=kill;step:80=partial_ckpt') "
+                    "or 'req:<N>=<action>[:<arg>]' with action in "
+                    f"{'/'.join(_SERVING_ACTIONS)} (e.g. 'req:0=worker_kill')."
                 ) from None
-            faults.append(Fault(step=step, action=action, arg=arg or None))
+            faults.append(Fault(step=step, action=action, arg=arg or None,
+                                scope=kind))
         return cls(faults=sorted(faults, key=lambda f: f.step))
 
     @classmethod
@@ -154,7 +207,8 @@ class FaultPlan:
     def maybe_fire(self, step: int):
         """Fire every not-yet-fired (non-data) fault scheduled for ``step``."""
         for f in self.faults:
-            if f.fired or f.step != step or f.action in _DATA_ACTIONS:
+            if (f.fired or f.step != step or f.scope != "step"
+                    or f.action in _DATA_ACTIONS):
                 continue
             f.fired = True
             logger.warning(f"Fault injection: firing {f.action} at step {step}")
@@ -189,12 +243,38 @@ class FaultPlan:
         """Consume (at most) one data fault scheduled for ``step`` — called by
         the health guard, which applies it to the observed loss."""
         for f in self.faults:
-            if not f.fired and f.step == step and f.action in _DATA_ACTIONS:
+            if (not f.fired and f.step == step and f.scope == "step"
+                    and f.action in _DATA_ACTIONS):
                 f.fired = True
                 from ..telemetry.flight import get_flight_recorder
 
                 get_flight_recorder().record(
                     "fault_injected", step=step, action=f.action,
+                    arg=f.arg if f.arg else None,
+                )
+                return f
+        return None
+
+    def take_serving_fault(self, index: int, actions=_SERVING_ACTIONS):
+        """Consume (at most) one unfired ``req:``-scope fault scheduled for
+        serving-request ``index`` whose action is in ``actions`` — called by
+        the serving components at their own consumption sites (the frontend
+        counts the /v1 generate+import requests it serves; the handoff relay
+        counts chain exports). Fired-once, like every other fault, and the
+        injection names itself in the flight recorder before the consumer
+        acts on it."""
+        for f in self.faults:
+            if (not f.fired and f.scope == "req" and f.step == index
+                    and f.action in actions):
+                f.fired = True
+                logger.warning(
+                    f"Fault injection: firing serving fault {f.action} at "
+                    f"request {index}"
+                )
+                from ..telemetry.flight import get_flight_recorder
+
+                get_flight_recorder().record(
+                    "fault_injected", request=int(index), action=f.action,
                     arg=f.arg if f.arg else None,
                 )
                 return f
@@ -243,3 +323,13 @@ def reset_active_plan():
     """Forget the cached plan (tests); the next ``active_plan()`` re-reads env."""
     global _active_plan
     _active_plan = _UNSET
+
+
+def serving_fault(index: int, *actions):
+    """The serving components' one-line consumption hook: the process's
+    active plan's :meth:`FaultPlan.take_serving_fault`, or None when no plan
+    is armed (the overwhelmingly common case — one dict read)."""
+    plan = active_plan()
+    if plan is None:
+        return None
+    return plan.take_serving_fault(index, actions or _SERVING_ACTIONS)

@@ -1396,6 +1396,27 @@ class ContinuousBatcher:
         signal GET /v1/stats publishes (host bookkeeping only)."""
         return len(self._queue) + sum(r is not None for r in self._slot_req)
 
+    def release_request(self, rid: int) -> bool:
+        """Retire request ``rid``'s slot and refcount-free its chain —
+        host-side bookkeeping only. This is the free half of the handoff
+        tiers' free-on-ack discipline (serving_net/handoff.py): an exporter
+        keeps the chain resident until the importer acks, then releases it
+        here; a failed handoff releases it too, so pool blocks never leak.
+        Idempotent — returns False when ``rid`` no longer holds a slot."""
+        if not self.paged:
+            return False
+        s = next(
+            (s for s in range(self.B)
+             if self._slot_req[s] is not None and self._slot_req[s].rid == rid),
+            None,
+        )
+        if s is None:
+            return False
+        self._req_times.pop(rid, None)
+        self._free_chain(s)
+        self._publish_pool_gauges()
+        return True
+
     def _plan_chunks(self, remainder: np.ndarray, chunk_size: int) -> list:
         """Split the un-aliased prompt tail into prefill chunks: exact
         ``chunk_size`` pieces (hole-free, block-aligned — registrable for

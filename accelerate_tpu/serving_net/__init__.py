@@ -19,15 +19,26 @@ along the who-runs-what-where vs how-it-lowers seam:
   runs chunked prefill and ships the finished KV block chain to a decode
   host via block-table surgery plus a bounded chain transfer
   (``ops.paged_attention.export_chain_blocks`` / ``import_chain_blocks``).
+- :mod:`.lease` — fault tolerance's discovery substrate: worker
+  registrations are heartbeat-refreshed TTL leases, so the router evicts a
+  dead worker (circuit breaker + retry on a survivor under the same rid)
+  instead of routing at a corpse forever.
 
 See docs/serving.md "Disaggregated serving" for roles, the handoff
-contract, affinity routing, and the SSE wire format.
+contract, affinity routing, and the SSE wire format — and "Failure
+semantics" for leases, retries, drain, and the serving chaos grammar.
 """
 
 from __future__ import annotations
 
-from .frontend import ServingFrontend
-from .handoff import export_chain, import_chain, run_prefill_only
+from .frontend import ServingFrontend, ServingStreamError
+from .handoff import export_chain, import_chain, release_chain, run_prefill_only
+from .lease import (
+    LeaseHeartbeat,
+    drain_grace_from_env,
+    lease_ttl_from_env,
+    retry_budget_from_env,
+)
 from .roles import (
     SERVING_ROLES,
     ServingRole,
@@ -37,13 +48,19 @@ from .roles import (
 from .router import Router
 
 __all__ = [
+    "LeaseHeartbeat",
     "Router",
     "SERVING_ROLES",
     "ServingFrontend",
     "ServingRole",
+    "ServingStreamError",
+    "drain_grace_from_env",
     "export_chain",
     "import_chain",
+    "lease_ttl_from_env",
+    "release_chain",
     "resolve_serving_role",
+    "retry_budget_from_env",
     "router_endpoint_from_env",
     "run_prefill_only",
 ]

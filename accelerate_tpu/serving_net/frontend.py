@@ -26,11 +26,35 @@ it drains staged imports between waves and calls ``engine.run()`` whenever
 work is in flight. The engine's one-window-lookahead loop keeps its
 zero-blocking-transfer discipline; streaming rides the report it already
 fetches (serving.py ``_process_report``).
+
+Failure semantics (docs/serving.md "Failure semantics"):
+
+- Every ``error`` frame carries a ``retryable`` flag (can the router/client
+  re-dispatch this request and expect a different outcome?), and a terminal
+  frame (``done`` or ``error``) is guaranteed on every path — a mid-stream
+  engine exception, a timed-out subscriber, and a dead downstream tier all
+  close the stream explicitly, never silently.
+- The worker's registration is a heartbeat-refreshed TTL lease
+  (:mod:`.lease`); SIGTERM rides the preemption watcher into
+  :meth:`ServingFrontend.drain` — stop admission (503 ``retryable`` with a
+  retry hint), finish in-flight requests inside the grace window, revoke the
+  lease, then shut down.
+- A failed prefill→decode handoff re-enters on the next surviving decode
+  endpoint WITHOUT re-prefilling: the export keeps the chain
+  (``free=False``) until the importer acks (first non-error frame), then
+  frees it — free-on-ack, so a dropped handoff never leaks pool blocks.
+- The serving chaos grammar (``resilience/faults.py`` ``req:N=...``) is
+  consumed here: ``worker_kill`` dies after the request's first streamed
+  delta (``kill_mode`` picks a real ``os._exit`` for launcher drills or a
+  soft in-process death for tests/bench), ``stall`` sleeps before admission,
+  ``slow_worker`` stretches every stream event, ``handoff_drop`` loses the
+  first export POST.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import queue
 import threading
 import time
@@ -39,7 +63,8 @@ import urllib.request
 import numpy as np
 
 from ..logging import get_logger
-from .handoff import export_chain, import_chain, run_prefill_only
+from .handoff import export_chain, import_chain, release_chain, run_prefill_only
+from .lease import LeaseHeartbeat, drain_grace_from_env
 from .roles import ServingRole, resolve_serving_role
 
 logger = get_logger(__name__)
@@ -48,6 +73,40 @@ logger = get_logger(__name__)
 # closes with an error event — a wedged engine must not hold client
 # connections (and their handler threads) forever.
 STREAM_TIMEOUT_S = 300.0
+
+# How long the drain-admission 503 tells clients/routers to back off before
+# retrying AGAINST THE FLEET (the router re-routes immediately; this hint is
+# for direct clients).
+DRAIN_RETRY_AFTER_S = 1.0
+
+# Per-event delay unit for the slow_worker chaos action: the injected delay
+# is <mult> × this per stream event.
+SLOW_WORKER_UNIT_S = 0.05
+
+_DRAIN_COUNTER = None  # telemetry.metrics.cached_handles accessor
+
+
+def _drain_counter():
+    global _DRAIN_COUNTER
+    if _DRAIN_COUNTER is None:
+        from ..telemetry.metrics import cached_handles
+
+        _DRAIN_COUNTER = cached_handles(lambda registry: registry.counter(
+            "accelerate_serving_drained_inflight_total",
+            "In-flight requests finished inside a graceful-drain grace window",
+        ))
+    return _DRAIN_COUNTER()
+
+
+class ServingStreamError(RuntimeError):
+    """An ``error`` SSE frame surfaced client-side (``read_sse_response``).
+    ``retryable`` mirrors the frame's flag: True means re-submitting the
+    request may succeed (worker died, stream broke, fleet draining); False
+    means the request itself is unservable (bad input, deadline exceeded)."""
+
+    def __init__(self, message: str, retryable: bool = True):
+        super().__init__(message)
+        self.retryable = bool(retryable)
 
 
 def sse_event(kind: str, data: dict) -> str:
@@ -82,6 +141,14 @@ class ServingFrontend:
     ``role`` defaults to the launcher env contract
     (:func:`~.roles.resolve_serving_role`)."""
 
+    # How a worker_kill chaos fault dies: "process" is the real thing
+    # (os._exit mid-stream — launcher drills; exit code 0 so the gang
+    # launcher doesn't take the survivors down), "stream" is the in-process
+    # soft death (tests, the bench chaos lever): the stream breaks without a
+    # terminal frame, the heartbeat stops so the lease expires, and every
+    # subsequent handler answers 503 so health probes fail like a corpse's.
+    kill_mode = "process"
+
     def __init__(self, engine, role: str | ServingRole | None = None,
                  stream_timeout_s: float = STREAM_TIMEOUT_S):
         if isinstance(role, ServingRole):
@@ -101,10 +168,26 @@ class ServingFrontend:
         self.stream_timeout_s = float(stream_timeout_s)
         self._lock = threading.Lock()          # engine submission/surgery
         self._streams: dict[int, queue.Queue] = {}
+        self._deadlines: dict[int, float] = {}  # rid -> deadline (wall clock)
         self._imports: queue.Queue = queue.Queue()
         self._wake = threading.Condition()
         self._shutdown = threading.Event()
+        self._draining = threading.Event()
         self._thread: threading.Thread | None = None
+        self._watch_thread: threading.Thread | None = None
+        self._heartbeat: LeaseHeartbeat | None = None
+        self._watcher = None
+        self._server = None
+        self._process_index = 0
+        # Serving chaos state (resilience/faults.py req: grammar): the
+        # frontend counts ITS OWN admission events (/v1/generate +
+        # /v1/import, in arrival order) and handoff exports, so req:N
+        # indexes are deterministic per worker.
+        self._req_seq = 0
+        self._handoff_seq = 0
+        self._kill_rids: set[int] = set()
+        self._slow: dict[int, float] = {}  # rid -> injected per-event delay
+        self._killed = False
         engine.stream = self._on_stream
 
     # ------------------------------------------------------------ lifecycle
@@ -112,14 +195,19 @@ class ServingFrontend:
                 server=None, endpoint: str | None = None):
         """Become the process's serving provider: route ``/v1/*`` here,
         publish the role gauge (``accelerate_serving_role{role=}`` — what
-        /fleet tier rollups group hosts by) and the worker's role+endpoint
-        into the serving KV namespace (what the router discovers), and start
-        the engine loop thread (decoding roles; a prefill worker dispatches
-        synchronously per request, so it needs no loop). ``server`` attaches
-        to one specific :class:`~..telemetry.metrics.MetricsServer` instead
-        of the process-global route (multi-role single-process rigs)."""
+        /fleet tier rollups group hosts by), start the lease heartbeat that
+        keeps the worker's role+endpoint registration alive in the serving
+        KV namespace (what the router discovers — :mod:`.lease`), arm the
+        preemption watcher so SIGTERM drains instead of dropping streams,
+        and start the engine loop thread (decoding roles; a prefill worker
+        dispatches synchronously per request, so it needs no loop).
+        ``server`` attaches to one specific
+        :class:`~..telemetry.metrics.MetricsServer` instead of the
+        process-global route (multi-role single-process rigs)."""
         from ..telemetry.metrics import get_registry, set_serving_provider
 
+        self._process_index = int(process_index)
+        self._server = server
         if server is not None:
             server.set_serving(self)
             if endpoint is None and server.port is not None:
@@ -131,10 +219,22 @@ class ServingFrontend:
             "Serving tier this process runs (1 = the labeled role)",
             labelnames=("role",),
         ).set(1, role=self.role.name)
-        from .router import publish_serving_endpoint
+        from ..telemetry.fleet import metrics_endpoint
 
-        publish_serving_endpoint(self.role.name, process_index=process_index,
-                                 endpoint=endpoint)
+        lease_endpoint = endpoint or metrics_endpoint()
+        if lease_endpoint is not None:
+            self._heartbeat = LeaseHeartbeat(
+                self.role.name, process_index, lease_endpoint
+            ).start()
+        try:
+            # Signal handlers are main-thread-only; a frontend installed off
+            # the main thread still drains when something else (PartialState)
+            # installed the watcher, or when drain() is called directly.
+            from ..resilience.preemption import get_default_watcher
+
+            self._watcher = get_default_watcher(install=True)
+        except Exception:
+            self._watcher = None
         if start_loop is None:
             start_loop = self.role.decodes
         if start_loop and self._thread is None:
@@ -142,18 +242,134 @@ class ServingFrontend:
                 target=self._loop, name="at-serving-loop", daemon=True
             )
             self._thread.start()
+        if self._watcher is not None and self._watch_thread is None:
+            self._watch_thread = threading.Thread(
+                target=self._watch_preemption, name="at-serving-drain",
+                daemon=True,
+            )
+            self._watch_thread.start()
         return self
 
     def uninstall(self):
-        from ..telemetry.metrics import set_serving_provider
+        if self._heartbeat is not None:
+            self._heartbeat.stop(revoke=True)
+            self._heartbeat = None
+        if self._server is not None:
+            self._server.set_serving(None)
+            self._server = None
+        else:
+            from ..telemetry.metrics import set_serving_provider
 
-        set_serving_provider(None)
+            set_serving_provider(None)
         self._shutdown.set()
         with self._wake:
             self._wake.notify_all()
         if self._thread is not None:
             self._thread.join(timeout=5.0)
             self._thread = None
+
+    # ----------------------------------------------------------------- drain
+    def _watch_preemption(self):
+        """Poll the preemption watcher's sticky flag; SIGTERM → drain. Runs
+        on its own daemon thread so prefill workers (no engine loop) drain
+        too."""
+        while not self._shutdown.is_set():
+            try:
+                if self._watcher.poll():
+                    self.drain()
+                    return
+            except Exception:
+                return
+            self._shutdown.wait(timeout=0.2)
+
+    def drain(self, grace_s: float | None = None):
+        """Graceful shutdown, in contract order (docs/serving.md "Failure
+        semantics"): (1) stop admission — new ``/v1/*`` work answers 503
+        ``retryable`` with a retry hint while in-flight streams keep
+        flowing; (2) wait up to ``grace_s`` (default
+        ``ACCELERATE_DRAIN_GRACE_S``) for in-flight requests to finish,
+        booking how many did into
+        ``accelerate_serving_drained_inflight_total``; (3) revoke the lease
+        (the router sees the worker gone on its next discovery, not a TTL
+        later) and shut the loop down. Idempotent; callable from any
+        thread."""
+        if self._draining.is_set():
+            return
+        self._draining.set()
+        grace = float(grace_s if grace_s is not None else drain_grace_from_env())
+        in_flight_at_start = self.in_flight()
+        logger.warning(
+            f"serving worker draining ({self.role.name}): admission stopped, "
+            f"{in_flight_at_start} in flight, grace {grace:.1f}s"
+        )
+        deadline = time.monotonic() + grace
+        while time.monotonic() < deadline and self.in_flight() > 0:
+            self._notify()
+            time.sleep(0.05)
+        still_in_flight = self.in_flight()
+        drained = max(0, in_flight_at_start - still_in_flight)
+        if drained:
+            _drain_counter().inc(drained)
+        from ..telemetry.flight import get_flight_recorder
+
+        get_flight_recorder().record(
+            "serving_drain", role=self.role.name,
+            in_flight_at_sigterm=int(in_flight_at_start),
+            drained=int(drained), abandoned=int(still_in_flight),
+        )
+        self.uninstall()
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    # ----------------------------------------------------------------- chaos
+    def _next_req_seq(self) -> int:
+        with self._lock:
+            seq = self._req_seq
+            self._req_seq += 1
+        return seq
+
+    def _take_admission_fault(self):
+        """Consume an admission-indexed serving fault for this worker's next
+        request; ``stall`` sleeps here (pre-admission, before any lock), the
+        other actions are applied per-rid by :meth:`_arm_request_fault`."""
+        from ..resilience.faults import serving_fault
+
+        fault = serving_fault(self._next_req_seq(),
+                              "worker_kill", "stall", "slow_worker")
+        if fault is not None and fault.action == "stall":
+            time.sleep(fault.stall_s)
+        return fault
+
+    def _arm_request_fault(self, fault, rid: int):
+        """``worker_kill`` arms death after the rid's first streamed delta;
+        ``slow_worker`` stretches its stream events."""
+        if fault is None:
+            return
+        if fault.action == "worker_kill":
+            self._kill_rids.add(rid)
+        elif fault.action == "slow_worker":
+            self._slow[rid] = fault.slow_factor * SLOW_WORKER_UNIT_S
+
+    def _die(self):
+        """The worker_kill chaos action fires: a hard ``os._exit(0)`` under
+        the real launcher (exit 0 so the gang supervisor leaves the
+        survivors up — the point is proving THEIR recovery), or the soft
+        in-process death (see ``kill_mode``)."""
+        logger.warning(f"chaos worker_kill firing ({self.kill_mode} mode)")
+        if self.kill_mode == "process":
+            os._exit(0)
+        self._killed = True
+        if self._heartbeat is not None:
+            self._heartbeat.stop(revoke=False)  # a crash revokes nothing
+            self._heartbeat = None
+
+    def _refuse(self, why: str, retry_after_s: float | None = None):
+        detail = {"error": why, "retryable": True}
+        if retry_after_s is not None:
+            detail["retry_after_s"] = retry_after_s
+        return ("json", 503, detail)
 
     # ---------------------------------------------------------- engine loop
     def _loop(self):
@@ -217,20 +433,39 @@ class ServingFrontend:
     def _stream_response(self, rid: int):
         """The SSE generator behind a local (non-relayed) request: token
         deltas as they land, then the ``done`` frame with the authoritative
-        output + this tier's trace record (TTFT/TPOT inside)."""
+        output + this tier's trace record (TTFT/TPOT inside). A terminal
+        frame is GUARANTEED on every path — timeout, engine error, deadline,
+        and unexpected exception all close with an ``error`` frame carrying
+        ``retryable``."""
         subscriber = self._streams[rid]
+        slow_s = self._slow.get(rid)
+        streamed_any = False
         try:
             while True:
+                deadline_wall = self._deadlines.get(rid)
+                wait_s = self.stream_timeout_s
+                if deadline_wall is not None:
+                    wait_s = min(wait_s, max(0.01, deadline_wall - time.time()))
                 try:
-                    kind, payload = subscriber.get(timeout=self.stream_timeout_s)
+                    kind, payload = subscriber.get(timeout=wait_s)
                 except queue.Empty:
-                    yield sse_event("error", {
-                        "rid": rid,
-                        "error": f"stream timed out after {self.stream_timeout_s}s",
-                    })
+                    if deadline_wall is not None and time.time() >= deadline_wall:
+                        yield sse_event("error", {
+                            "rid": rid, "retryable": False,
+                            "error": "request deadline exceeded",
+                        })
+                    else:
+                        yield sse_event("error", {
+                            "rid": rid, "retryable": True,
+                            "error": f"stream timed out after "
+                                     f"{self.stream_timeout_s}s",
+                        })
                     return
+                if slow_s:
+                    time.sleep(slow_s)
                 if kind == "error":
-                    yield sse_event("error", {"rid": rid, "error": payload})
+                    yield sse_event("error", {"rid": rid, "error": payload,
+                                              "retryable": True})
                     return
                 if kind == "final":
                     record = self._trace_record(rid)
@@ -243,53 +478,104 @@ class ServingFrontend:
                     })
                     return
                 yield sse_event("tokens", {"rid": rid, "tokens": payload})
+                streamed_any = True
+                if rid in self._kill_rids and streamed_any:
+                    # worker_kill: die AFTER the client saw a delta, so the
+                    # drill proves retry de-duplication, not just re-dispatch.
+                    self._kill_rids.discard(rid)
+                    self._die()
+                    return  # soft mode: stream breaks, no terminal frame
+        except GeneratorExit:
+            raise
+        except Exception as exc:  # the terminal-frame guarantee
+            logger.warning(f"serving stream for rid {rid} failed: {exc!r}")
+            yield sse_event("error", {"rid": rid, "retryable": True,
+                                      "error": f"stream failed: {exc}"})
         finally:
             self._streams.pop(rid, None)
+            self._deadlines.pop(rid, None)
+            self._slow.pop(rid, None)
 
     # ------------------------------------------------------------- handlers
     def handle_get(self, path: str, query: dict):
+        if self._killed:
+            return (503, "application/json",
+                    json.dumps({"error": "worker killed (chaos)"}).encode())
         if path == "/v1/stats":
             body = json.dumps(self.stats()).encode()
             return (200, "application/json", body)
         return None
 
     def handle_post(self, path: str, query: dict, body: bytes):
+        if self._killed:
+            return self._refuse("worker killed (chaos)")
         if path == "/v1/prefixes":
+            if self._draining.is_set():
+                # A draining worker must drop out of routing decisions too.
+                return self._refuse("worker draining",
+                                    retry_after_s=DRAIN_RETRY_AFTER_S)
             request = json.loads(body or b"{}")
             prompt = np.asarray(request.get("prompt", []), np.int32)
             return ("json", 200, {
                 "match_tokens": self.engine.prefix_match_tokens(prompt),
-                "in_flight": self.engine.in_flight(),
+                "in_flight": self.in_flight(),
                 "role": self.role.name,
             })
         if path == "/v1/generate":
+            if self._draining.is_set():
+                return self._refuse("worker draining: admission stopped",
+                                    retry_after_s=DRAIN_RETRY_AFTER_S)
             return self._handle_generate(json.loads(body or b"{}"))
         if path == "/v1/import":
             if not self.role.decodes:
                 return ("json", 409, {
-                    "error": f"role {self.role.name!r} does not decode"
+                    "error": f"role {self.role.name!r} does not decode",
+                    "retryable": False,
                 })
+            if self._draining.is_set():
+                return self._refuse("worker draining: admission stopped",
+                                    retry_after_s=DRAIN_RETRY_AFTER_S)
             payload = json.loads(body or b"{}")
             rid = int(payload["rid"])
+            self._arm_request_fault(self._take_admission_fault(), rid)
             self._streams[rid] = queue.Queue()
+            deadline_wall = payload.get("deadline_wall")
+            if deadline_wall is not None:
+                self._deadlines[rid] = float(deadline_wall)
             self._imports.put((payload, None))
             self._notify()
             return ("sse", self._stream_response(rid))
         return None
 
+    def in_flight(self) -> int:
+        """Client-visible in-flight count: requests admitted whose stream
+        has not yet delivered its terminal frame. Strictly ≥ the engine's
+        own count — a slow subscriber keeps a request in flight after the
+        engine freed its slot, and drain must wait for delivery, not just
+        for compute."""
+        return max(self.engine.in_flight(), len(self._streams))
+
     def stats(self) -> dict:
         """The least-loaded routing feed (host bookkeeping only)."""
         return {
             "role": self.role.name,
-            "in_flight": self.engine.in_flight(),
+            "in_flight": self.in_flight(),
             "prefill_chunk": getattr(self.engine, "prefill_chunk", None),
             "pool": self.engine.pool_stats(),
+            "draining": self._draining.is_set(),
         }
 
     def _handle_generate(self, request: dict):
         prompt = np.asarray(request.get("prompt", []), np.int32).reshape(-1)
         if prompt.size == 0:
-            return ("json", 400, {"error": "empty or missing 'prompt'"})
+            return ("json", 400, {"error": "empty or missing 'prompt'",
+                                  "retryable": False})
+        deadline_wall = request.get("deadline_wall")
+        if deadline_wall is not None and time.time() >= float(deadline_wall):
+            # Deadlines propagate end-to-end; admitting dead-on-arrival work
+            # would only burn decode slots the survivors need.
+            return ("json", 400, {"error": "request deadline exceeded",
+                                  "retryable": False})
         kwargs = {}
         for key in ("max_new_tokens", "eos_token_id"):
             if request.get(key) is not None:
@@ -300,6 +586,7 @@ class ServingFrontend:
             kwargs["stop_sequences"] = [
                 np.asarray(s, np.int32) for s in request["stop_sequences"]
             ]
+        fault = self._take_admission_fault()
         with self._lock:
             # The rid is reserved BEFORE submit so the subscriber queue
             # exists when the loop thread emits the first delta — a
@@ -308,38 +595,59 @@ class ServingFrontend:
             rid = (int(request["request_id"])
                    if request.get("request_id") is not None
                    else self.engine._next_rid)
+            self._arm_request_fault(fault, rid)
             if self.role.name == "prefill":
                 decode_endpoint = request.get("decode_endpoint")
                 if not decode_endpoint:
                     return ("json", 400, {
                         "error": "prefill tier needs 'decode_endpoint' "
-                                 "(where the finished chain ships)"
+                                 "(where the finished chain ships)",
+                        "retryable": False,
                     })
                 self.engine.submit(prompt, request_id=rid,
                                    tier=self.role.name, **kwargs)
-                return ("sse", self._relay_prefill(rid, decode_endpoint))
+                return ("sse", self._relay_prefill(
+                    rid, decode_endpoint,
+                    alternates=request.get("decode_endpoints") or (),
+                    deadline_wall=deadline_wall,
+                ))
             self._streams[rid] = queue.Queue()
+            if deadline_wall is not None:
+                self._deadlines[rid] = float(deadline_wall)
             self.engine.submit(prompt, request_id=rid, tier=self.role.name,
                                **kwargs)
         self._notify()
         return ("sse", self._stream_response(rid))
 
     # ---------------------------------------------------------------- relay
-    def _relay_prefill(self, rid: int, decode_endpoint: str):
+    def _relay_prefill(self, rid: int, decode_endpoint: str,
+                       alternates=(), deadline_wall: float | None = None):
         """The prefill tier's generate path: run this request's chunked
         prefill to completion (no decode window ever dispatches here), ship
         the chain, then relay the decode host's stream — prepending this
         tier's record to the final event's trace, so the client's one trace
-        spans prefill chunks AND the handoff leg."""
+        spans prefill chunks AND the handoff leg.
+
+        Free-on-ack re-handoff: the export keeps the chain resident
+        (``free=False``); the first non-error frame from a decode import is
+        the ack that frees it. A failed import (dead host, dropped POST —
+        the ``handoff_drop`` chaos action) moves to the next surviving
+        decode endpoint in ``alternates`` WITHOUT re-prefilling; exhausting
+        them surfaces a retryable error (the router's retry re-enters
+        prefill), and the chain is released on every exit path — a failed
+        handoff never leaks pool blocks."""
         try:
             with self._lock:
                 run_prefill_only(self.engine, rid)
                 payload = export_chain(self.engine, rid,
-                                       endpoint=decode_endpoint)
+                                       endpoint=decode_endpoint, free=False)
         except Exception as exc:
             logger.warning(f"prefill for request {rid} failed: {exc!r}")
-            yield sse_event("error", {"rid": rid, "error": str(exc)})
+            yield sse_event("error", {"rid": rid, "error": str(exc),
+                                      "retryable": True})
             return
+        if deadline_wall is not None:
+            payload["deadline_wall"] = float(deadline_wall)
 
         def finalize(done: dict) -> dict:
             record = self._trace_record(rid)
@@ -347,9 +655,102 @@ class ServingFrontend:
                 done["trace"] = [record] + done.get("trace", [])
             return done
 
-        yield from relay_generate(
-            f"http://{decode_endpoint}/v1/import", payload, finalize=finalize
-        )
+        from ..resilience.faults import serving_fault
+
+        with self._lock:
+            handoff_seq = self._handoff_seq
+            self._handoff_seq += 1
+        dropped = serving_fault(handoff_seq, "handoff_drop")
+        targets = [decode_endpoint] + [ep for ep in alternates
+                                       if ep != decode_endpoint]
+        acked = False
+        try:
+            for attempt, endpoint in enumerate(targets):
+                if dropped is not None and attempt == 0:
+                    # The chaos action: this POST never happens — exactly a
+                    # payload lost on the wire before the importer saw it.
+                    logger.warning(
+                        f"chaos handoff_drop: dropping export of rid {rid} "
+                        f"to {endpoint}"
+                    )
+                    self._book_handoff_retry(rid, attempt + 1, endpoint)
+                    continue
+                url = f"http://{endpoint}/v1/import"
+                try:
+                    req = urllib.request.Request(
+                        url, data=json.dumps(payload).encode(),
+                        headers={"Content-Type": "application/json"},
+                    )
+                    response = urllib.request.urlopen(
+                        req, timeout=self.stream_timeout_s)
+                except Exception as exc:
+                    logger.warning(
+                        f"handoff of rid {rid} to {endpoint} failed: {exc!r}"
+                    )
+                    self._book_handoff_retry(rid, attempt + 1, endpoint)
+                    continue
+                leg_failed = False
+                with response:
+                    for kind, data in iter_sse(response):
+                        if not acked:
+                            if kind == "error":
+                                detail = json.loads(data)
+                                if detail.get("retryable") is False:
+                                    # Unservable anywhere: surface as-is.
+                                    with self._lock:
+                                        release_chain(self.engine, rid)
+                                    acked = True  # chain handled
+                                    detail.setdefault("rid", rid)
+                                    yield sse_event("error", detail)
+                                    return
+                                logger.warning(
+                                    f"decode import of rid {rid} on "
+                                    f"{endpoint} refused: {detail.get('error')}"
+                                )
+                                self._book_handoff_retry(rid, attempt + 1,
+                                                         endpoint)
+                                leg_failed = True
+                                break
+                            # First non-error frame: the importer owns the
+                            # chain now — free our copy (free-on-ack).
+                            acked = True
+                            with self._lock:
+                                release_chain(self.engine, rid)
+                        if kind == "done" and finalize is not None:
+                            try:
+                                done = finalize(json.loads(data))
+                                yield sse_event("done", done)
+                                continue
+                            except (ValueError, TypeError):
+                                pass
+                        yield f"event: {kind}\ndata: {data}\n\n"
+                if acked:
+                    return
+                if not leg_failed:
+                    # Stream ended before any frame: the importer died
+                    # between accepting the POST and streaming.
+                    self._book_handoff_retry(rid, attempt + 1, endpoint)
+            yield sse_event("error", {
+                "rid": rid, "retryable": True,
+                "error": f"handoff failed on all {len(targets)} decode "
+                         "endpoint(s)",
+            })
+        finally:
+            if not acked:
+                with self._lock:
+                    release_chain(self.engine, rid)
+
+    def _book_handoff_retry(self, rid: int, attempt: int, endpoint: str):
+        """One failed handoff leg: the shared retries counter (reason
+        ``handoff_failed``), this tier's tracer retry leg, and the flight
+        recorder (via the tracer)."""
+        from .router import _fault_counters
+
+        retries, _, _, _ = _fault_counters()
+        retries.inc(reason="handoff_failed")
+        if self.engine.tracer is not None:
+            self.engine.tracer.retry(rid, attempt, "handoff_failed",
+                                     endpoint=endpoint)
 
 
 def relay_generate(url: str, request: dict, finalize=None,
@@ -368,7 +769,8 @@ def relay_generate(url: str, request: dict, finalize=None,
         response = urllib.request.urlopen(req, timeout=timeout_s)
     except Exception as exc:
         yield sse_event("error", {
-            "error": f"downstream tier {url} unreachable: {exc}"
+            "error": f"downstream tier {url} unreachable: {exc}",
+            "retryable": True,
         })
         return
     with response:
@@ -385,18 +787,24 @@ def relay_generate(url: str, request: dict, finalize=None,
 
 def read_sse_response(fp) -> dict:
     """Drain one generate stream client-side: returns ``{"tokens": [...],
-    "deltas": [...], "done": {...}}`` (raises on an ``error`` frame) — the
-    drill's and the tests' client helper, so they consume the REAL wire
-    format, not a shortcut."""
+    "deltas": [...], "done": {...}}`` — the drill's and the tests' client
+    helper, so they consume the REAL wire format, not a shortcut. An
+    ``error`` frame (or a stream that dies without a terminal frame) raises
+    :class:`ServingStreamError`, whose ``retryable`` mirrors the frame's
+    flag so callers know whether re-submitting can help."""
     deltas, done = [], None
     for kind, data in iter_sse(fp):
         payload = json.loads(data)
         if kind == "error":
-            raise RuntimeError(f"serving stream error: {payload.get('error')}")
+            raise ServingStreamError(
+                f"serving stream error: {payload.get('error')}",
+                retryable=payload.get("retryable", True),
+            )
         if kind == "tokens":
             deltas.append(payload["tokens"])
         elif kind == "done":
             done = payload
     if done is None:
-        raise RuntimeError("serving stream closed without a done event")
+        raise ServingStreamError("serving stream closed without a done event",
+                                 retryable=True)
     return {"tokens": done["tokens"], "deltas": deltas, "done": done}

@@ -147,12 +147,17 @@ def run_prefill_only(engine, rid: int) -> None:
 
 
 # ------------------------------------------------------------------ export
-def export_chain(engine, rid: int, endpoint: str | None = None) -> dict:
+def export_chain(engine, rid: int, endpoint: str | None = None,
+                 free: bool = True) -> dict:
     """Lift request ``rid``'s finished prefill off ``engine``: the written
     chain blocks' contents, the slot's armed decode state, and the request's
-    identity/controls, as one JSON-safe payload. The chain is refcount-freed
-    here (blocks return to the exporter's pool the moment they're copied
-    out) and the tracer books the ``out`` leg, closing this tier's record as
+    identity/controls, as one JSON-safe payload. With ``free=True`` the
+    chain is refcount-freed here (blocks return to the exporter's pool the
+    moment they're copied out); the relay path passes ``free=False`` and
+    frees only once the importer ACKS the shipped chain
+    (:func:`release_chain`) — free-on-ack, so an import that fails mid-wire
+    leaves the chain intact for re-handoff to a surviving decode host. The
+    tracer books the ``out`` leg either way, closing this tier's record as
     ``handed_off``."""
     if not engine.paged:
         raise ValueError("chain export requires a paged engine")
@@ -225,10 +230,18 @@ def export_chain(engine, rid: int, endpoint: str | None = None) -> dict:
         engine.tracer.handoff(rid, "out", bytes=nbytes, blocks=n_data,
                               endpoint=endpoint)
     _book_handoff("out", nbytes, n_data)
-    engine._req_times.pop(rid, None)
-    engine._free_chain(s)
-    engine._publish_pool_gauges()
+    if free:
+        engine.release_request(rid)
     return payload
+
+
+def release_chain(engine, rid: int) -> bool:
+    """Free an exported-but-retained chain (``export_chain(...,
+    free=False)``): the importer acked — or every handoff target failed and
+    the chain is being abandoned. Idempotent (False when ``rid`` holds no
+    slot), so relay error paths can release unconditionally without
+    double-free risk."""
+    return bool(engine.release_request(rid))
 
 
 # ------------------------------------------------------------------ import

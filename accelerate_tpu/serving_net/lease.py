@@ -1,0 +1,171 @@
+"""TTL leases over the serving registry — liveness as a first-class fact.
+
+The serving KV namespace (``router.SERVING_KV_NAMESPACE``) records
+*registration*, and coordination-service keys outlive their writers: a
+worker that dies mid-stream stays in the registry forever, and the router
+keeps routing live traffic at a corpse. This module turns each registration
+into a **lease**: the published value carries a wall-clock expiry
+(``role|endpoint|expires=<unix>``), a :class:`LeaseHeartbeat` thread
+re-publishes it every ``ttl/3`` (so one missed beat never evicts), and the
+router treats an expired lease as an eviction — no distributed deletes, no
+failure detector beyond the clock. Wall clocks cross processes (the handoff
+payload's rebasing discipline); the TTL is chosen coarse enough (seconds)
+that NTP-grade skew is noise.
+
+Graceful exits don't wait for expiry: :func:`revoke_serving_endpoint`
+deletes the key outright (the drain sequence's "revoke its lease" step —
+docs/serving.md "Failure semantics").
+
+Launcher contract (tri-state per the SLO precedent): ``launch
+--serving_lease_ttl / --serving_retry_budget / --drain_grace_s`` export
+``ACCELERATE_SERVING_LEASE_TTL`` / ``ACCELERATE_SERVING_RETRY_BUDGET`` /
+``ACCELERATE_DRAIN_GRACE_S``; an explicit 0 scrubs an inherited value back
+to the library default. Everything here is host-side bookkeeping — leases,
+heartbeats, and expiry checks never touch a device.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from ..logging import get_logger
+from ..utils.constants import (
+    ENV_DRAIN_GRACE_S,
+    ENV_SERVING_LEASE_TTL,
+    ENV_SERVING_RETRY_BUDGET,
+)
+
+logger = get_logger(__name__)
+
+# How long a published serving lease stays valid without a heartbeat refresh.
+DEFAULT_LEASE_TTL_S = 15.0
+# How many times the router re-dispatches a failed request on a surviving
+# worker (under the same rid) before surfacing the error to the client.
+DEFAULT_RETRY_BUDGET = 2
+# How long a SIGTERM'd serving worker waits for in-flight requests to finish
+# before it exits anyway.
+DEFAULT_DRAIN_GRACE_S = 30.0
+# Refresh cadence as a fraction of the TTL: a lease gets ~3 beats per TTL,
+# so one dropped beat (GC pause, network blip) never reads as death.
+HEARTBEAT_FRACTION = 1.0 / 3.0
+
+
+def _positive_env(env_name: str, default, cast):
+    raw = os.environ.get(env_name, "").strip()
+    if not raw:
+        return default
+    try:
+        value = cast(float(raw)) if cast is int else cast(raw)
+    except ValueError:
+        raise ValueError(
+            f"{env_name}={raw!r} must be a number (0/unset = library default "
+            f"{default})"
+        ) from None
+    return value if value > 0 else default
+
+
+def lease_ttl_from_env() -> float:
+    """The fleet's serving-lease TTL in seconds (``ACCELERATE_SERVING_LEASE_TTL``)."""
+    return _positive_env(ENV_SERVING_LEASE_TTL, DEFAULT_LEASE_TTL_S, float)
+
+
+def retry_budget_from_env() -> int:
+    """The router's per-request retry budget (``ACCELERATE_SERVING_RETRY_BUDGET``)."""
+    return _positive_env(ENV_SERVING_RETRY_BUDGET, DEFAULT_RETRY_BUDGET, int)
+
+
+def drain_grace_from_env() -> float:
+    """The drain grace window in seconds (``ACCELERATE_DRAIN_GRACE_S``)."""
+    return _positive_env(ENV_DRAIN_GRACE_S, DEFAULT_DRAIN_GRACE_S, float)
+
+
+# ------------------------------------------------------------ wire encoding
+def encode_lease(role: str, endpoint: str, ttl_s: float | None,
+                 now: float | None = None) -> str:
+    """The registry value: ``role|endpoint|expires=<unix wall clock>``.
+    ``ttl_s`` None/0 publishes a non-expiring registration (the pre-lease
+    wire format stays parseable — see :func:`parse_lease`)."""
+    if not ttl_s or ttl_s <= 0:
+        return f"{role}|{endpoint}"
+    expires = (now if now is not None else time.time()) + float(ttl_s)
+    return f"{role}|{endpoint}|expires={expires:.3f}"
+
+
+def parse_lease(value: str) -> dict | None:
+    """``{"role", "endpoint", "expires"}`` from a registry value — tolerant
+    of the pre-lease ``role|endpoint`` format (``expires`` None = never).
+    Returns None for values with no endpoint (unparseable)."""
+    role, _, rest = value.partition("|")
+    endpoint, _, tail = rest.partition("|")
+    if not endpoint:
+        return None
+    expires = None
+    if tail.startswith("expires="):
+        try:
+            expires = float(tail[len("expires="):])
+        except ValueError:
+            expires = None
+    return {"role": role, "endpoint": endpoint, "expires": expires}
+
+
+def lease_expired(lease: dict, now: float | None = None) -> bool:
+    expires = lease.get("expires")
+    if expires is None:
+        return False
+    return (now if now is not None else time.time()) > expires
+
+
+# --------------------------------------------------------------- heartbeat
+class LeaseHeartbeat:
+    """Re-publish one worker's serving lease every ``ttl * HEARTBEAT_FRACTION``
+    seconds until stopped — started by ``ServingFrontend.install`` /
+    ``Router.install``, stopped (and the lease revoked) by drain/uninstall.
+    Pure host work on its own daemon thread: a beat is one KV write."""
+
+    def __init__(self, role: str, process_index: int, endpoint: str,
+                 ttl_s: float | None = None):
+        self.role = str(role)
+        self.process_index = int(process_index)
+        self.endpoint = str(endpoint)
+        self.ttl_s = float(ttl_s if ttl_s is not None else lease_ttl_from_env())
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def beat(self):
+        """Publish one lease refresh (also the initial registration)."""
+        from .router import publish_serving_endpoint
+
+        publish_serving_endpoint(self.role, process_index=self.process_index,
+                                 endpoint=self.endpoint, ttl_s=self.ttl_s)
+
+    def start(self) -> "LeaseHeartbeat":
+        if self._thread is None:
+            self.beat()
+            self._thread = threading.Thread(
+                target=self._run, name="at-serving-lease", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def _run(self):
+        interval = max(0.05, self.ttl_s * HEARTBEAT_FRACTION)
+        while not self._stop.wait(interval):
+            try:
+                self.beat()
+            except Exception as exc:  # a flaky KV write must not kill the beat
+                logger.warning(f"serving lease refresh failed: {exc!r}")
+
+    def stop(self, revoke: bool = False):
+        """Stop refreshing; ``revoke`` also deletes the registration outright
+        (graceful exit — the router sees the worker gone on its next
+        discovery instead of waiting out the TTL)."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+        if revoke:
+            from .router import revoke_serving_endpoint
+
+            revoke_serving_endpoint(self.process_index)

@@ -23,6 +23,26 @@ Routing policy (per request, all host-side lookups):
   exists (the decode tier's TPOT is protected from long prefills); the
   chosen decode worker rides along as the chain's handoff target, so
   affinity still decides where the request ultimately DECODES.
+
+Fault tolerance (docs/serving.md "Failure semantics"):
+
+- **Lease eviction**: registrations are TTL leases (:mod:`.lease`); a worker
+  whose lease expires (its heartbeat stopped) is evicted — dropped from the
+  candidate set, its affinity/load caches invalidated so a retry can never
+  re-pick it.
+- **Circuit breakers**: per-worker closed → open (after N consecutive failed
+  probes/dispatches) → half-open (one trial after a cooldown), so a flapping
+  host absorbs no live traffic while it flaps.
+- **Retry under the same rid**: a failed dispatch (connect error, retryable
+  worker error, or a stream that dies without a terminal frame) re-routes to
+  a surviving worker with exponential backoff inside a bounded budget; token
+  deltas already streamed to the client are de-duplicated, so the client
+  sees ONE contiguous stream. Deadlines (``deadline_wall``) propagate on
+  every dispatch so no client ever hangs.
+- **Degradation ladder**: prefill tier lost → multi-chunk prompts route to
+  decode-as-unified (booked ``accelerate_serving_degraded_total``); every
+  decode-capable worker lost → a fast 503 with ``retry_after_s``, the shed
+  booked through the SLO sentinel (``availability`` breach target).
 """
 
 from __future__ import annotations
@@ -30,28 +50,42 @@ from __future__ import annotations
 import json
 import threading
 import time
+import urllib.error
 import urllib.request
 
 from ..logging import get_logger
 from ..telemetry.fleet import _kv_client, metrics_endpoint
 from ..telemetry.slo import arbitrate_serving_tier
-from .frontend import relay_generate, sse_event
+from .frontend import STREAM_TIMEOUT_S, sse_event
+from .lease import encode_lease, lease_expired, parse_lease, retry_budget_from_env
 
 logger = get_logger(__name__)
 
 # Coordination-service KV namespace for serving-role registration — one key
-# per rank holding "role|host:port", the same persistent-fact discipline as
-# the metrics registry (telemetry/fleet.py KV_NAMESPACE).
+# per rank holding "role|host:port|expires=<unix>", the same persistent-fact
+# discipline as the metrics registry (telemetry/fleet.py KV_NAMESPACE) with
+# the lease expiry layered on top (lease.py).
 SERVING_KV_NAMESPACE = "at_fleet/serving"
 
 # How long one worker gets to answer an affinity/stats probe before routing
 # falls back without it — a dead worker must not stall admission.
 PROBE_TIMEOUT_S = 3.0
 
+# Circuit-breaker defaults: consecutive probe/dispatch failures before a
+# worker opens, and how long it stays open before one half-open trial.
+BREAKER_FAILURES = 3
+BREAKER_COOLDOWN_S = 5.0
+
+# Retry backoff: base * 2^(attempt-1), capped — small enough that a retried
+# request still beats its deadline, large enough to ride out a GC pause.
+BACKOFF_BASE_S = 0.1
+BACKOFF_CAP_S = 2.0
+
 _LOCK = threading.Lock()
-_LOCAL_WORKERS: dict[int, dict] = {}  # rank -> {"role", "endpoint"} (in-process)
+_LOCAL_WORKERS: dict[int, dict] = {}  # rank -> {"role", "endpoint", "expires"}
 
 _ROUTER_COUNTERS = None  # telemetry.metrics.cached_handles accessor
+_FAULT_COUNTERS = None   # retry/eviction/degradation handles
 
 
 def _router_counters():
@@ -75,18 +109,62 @@ def _router_counters():
     return _ROUTER_COUNTERS()
 
 
+def _fault_counters():
+    """(retries{reason=}, evictions{reason=}, degraded{mode=},
+    breaker_state{endpoint=}) — the fault-tolerance series /fleet rolls up
+    and the BENCH_SERVING_CHAOS lever snapshots."""
+    global _FAULT_COUNTERS
+    if _FAULT_COUNTERS is None:
+        from ..telemetry.metrics import cached_handles
+
+        _FAULT_COUNTERS = cached_handles(lambda registry: (
+            registry.counter(
+                "accelerate_serving_retries_total",
+                "Request dispatches retried on a surviving worker, by reason",
+                labelnames=("reason",),
+            ),
+            registry.counter(
+                "accelerate_serving_evictions_total",
+                "Serving workers evicted from the router's candidate set",
+                labelnames=("reason",),
+            ),
+            registry.counter(
+                "accelerate_serving_degraded_total",
+                "Requests served in an explicitly degraded mode",
+                labelnames=("mode",),
+            ),
+            registry.gauge(
+                "accelerate_serving_breaker_state",
+                "Per-worker circuit breaker (0 closed, 1 half-open, 2 open)",
+                labelnames=("endpoint",),
+            ),
+        ))
+    return _FAULT_COUNTERS()
+
+
 def publish_serving_endpoint(role: str, process_index: int = 0,
-                             endpoint: str | None = None) -> str | None:
+                             endpoint: str | None = None,
+                             ttl_s: float | None = None) -> str | None:
     """Register this worker's serving role + endpoint in the fleet KV
-    namespace (``ServingFrontend.install`` calls this). ``endpoint``
+    namespace as a TTL lease (``ServingFrontend.install`` calls this once,
+    then a :class:`~.lease.LeaseHeartbeat` refreshes it). ``endpoint``
     defaults to the already-published metrics endpoint — the /v1 API lives
-    on the same port. Returns the published ``role|host:port``."""
+    on the same port; ``ttl_s`` defaults to the launcher env contract
+    (``ACCELERATE_SERVING_LEASE_TTL``). Returns the published value."""
     endpoint = endpoint or metrics_endpoint()
     if endpoint is None:
         return None
-    value = f"{role}|{endpoint}"
+    if ttl_s is None:
+        from .lease import lease_ttl_from_env
+
+        ttl_s = lease_ttl_from_env()
+    now = time.time()
+    value = encode_lease(role, endpoint, ttl_s, now=now)
     with _LOCK:
-        _LOCAL_WORKERS[int(process_index)] = {"role": role, "endpoint": endpoint}
+        _LOCAL_WORKERS[int(process_index)] = {
+            "role": role, "endpoint": endpoint,
+            "expires": (now + ttl_s) if ttl_s and ttl_s > 0 else None,
+        }
     client = _kv_client()
     if client is not None:
         key = f"{SERVING_KV_NAMESPACE}/{int(process_index)}"
@@ -101,19 +179,38 @@ def publish_serving_endpoint(role: str, process_index: int = 0,
     return value
 
 
+def revoke_serving_endpoint(process_index: int = 0):
+    """Delete this worker's serving registration outright — the graceful
+    path (drain, uninstall): the router sees the worker gone on its next
+    discovery instead of waiting out the lease TTL."""
+    with _LOCK:
+        _LOCAL_WORKERS.pop(int(process_index), None)
+    client = _kv_client()
+    if client is not None:
+        try:
+            client.key_value_delete(
+                f"{SERVING_KV_NAMESPACE}/{int(process_index)}")
+        except Exception:
+            pass
+
+
 def discover_serving_workers(num_processes: int,
                              timeout_ms: int = 10_000) -> list[dict]:
-    """``[{"rank", "role", "endpoint"}]`` for every rank that has registered
-    a serving role — the fair-total-budget read discipline of
+    """``[{"rank", "role", "endpoint", "expires"}]`` for every rank holding a
+    LIVE serving lease — the fair-total-budget read discipline of
     :func:`~..telemetry.fleet.discover_endpoints`; an unregistered rank is
-    absent, never an exception. Without a distributed client returns the
-    in-process registrations."""
+    absent, never an exception, and an expired lease is absent too (the
+    dead-worker case leases exist for: coordination-service keys outlive
+    their writers). Without a distributed client returns the in-process
+    registrations, same expiry rule."""
+    now = time.time()
     client = _kv_client()
     if client is None or num_processes <= 1:
         with _LOCK:
             return [
                 {"rank": rank, **spec}
                 for rank, spec in sorted(_LOCAL_WORKERS.items())
+                if not lease_expired(spec, now)
             ]
     workers = []
     ranks = list(range(int(num_processes)))
@@ -129,9 +226,9 @@ def discover_serving_workers(num_processes: int,
             )
         except Exception:
             continue  # not registered (yet) — degradation, not failure
-        role, _, endpoint = value.partition("|")
-        if endpoint:
-            workers.append({"rank": rank, "role": role, "endpoint": endpoint})
+        lease = parse_lease(value)
+        if lease is not None and not lease_expired(lease, now):
+            workers.append({"rank": rank, **lease})
     return workers
 
 
@@ -155,6 +252,63 @@ def _get_json(url: str, timeout_s: float = PROBE_TIMEOUT_S) -> dict:
         return json.loads(response.read().decode("utf-8", "replace"))
 
 
+class _Breaker:
+    """One worker's circuit breaker: ``closed`` (healthy) → ``open`` after
+    ``failures`` consecutive probe/dispatch failures (no traffic) →
+    ``half_open`` after ``cooldown_s`` (exactly one trial request; success
+    closes, failure re-opens). Host-side state only."""
+
+    STATES = ("closed", "half_open", "open")
+
+    def __init__(self, failures: int = BREAKER_FAILURES,
+                 cooldown_s: float = BREAKER_COOLDOWN_S):
+        self.failure_threshold = int(failures)
+        self.cooldown_s = float(cooldown_s)
+        self.state = "closed"
+        self.consecutive = 0
+        self.opened_at = 0.0
+        self._trial_out = False
+
+    def allows(self, now: float) -> bool:
+        if self.state == "closed":
+            return True
+        if self.state == "open":
+            if now - self.opened_at >= self.cooldown_s:
+                self.state = "half_open"
+                self._trial_out = True
+                return True
+            return False
+        # half_open: one trial in flight at a time
+        if self._trial_out:
+            return False
+        self._trial_out = True
+        return True
+
+    def ok(self):
+        self.state = "closed"
+        self.consecutive = 0
+        self._trial_out = False
+
+    def fail(self, now: float) -> bool:
+        """Record one failure; returns True when this failure TRIPPED the
+        breaker open (closed/half-open → open transition)."""
+        self.consecutive += 1
+        trip = (self.state == "half_open"
+                or (self.state == "closed"
+                    and self.consecutive >= self.failure_threshold))
+        if trip or self.state == "open":
+            self.state = "open"
+            self.opened_at = now
+            self._trial_out = False
+        return trip
+
+    def permit_trial(self):
+        """Skip the remaining cooldown — the next ``allows`` grants a trial
+        (a re-registered worker re-earns trust instead of waiting it out)."""
+        if self.state == "open":
+            self.opened_at = -float("inf")
+
+
 class Router:
     """The /v1 provider for the router role; see module docstring.
 
@@ -162,10 +316,19 @@ class Router:
     tests, ad-hoc operator use); otherwise every routing decision re-reads
     the KV registry through a short cache, so workers that register late (or
     re-register after an elastic restart) are picked up live. ``slo`` is the
-    fleet's :class:`~..serving.SLOTargets` for tier arbitration."""
+    fleet's :class:`~..serving.SLOTargets` for tier arbitration.
+    ``retry_budget`` bounds re-dispatches per request (None = the launcher
+    env contract, ``ACCELERATE_SERVING_RETRY_BUDGET``); the breaker/backoff
+    knobs exist for drills — the defaults are the production contract."""
 
     def __init__(self, workers=None, num_processes: int = 1, slo=None,
-                 cache_s: float = 2.0, trace_requests: bool = True):
+                 cache_s: float = 2.0, trace_requests: bool = True,
+                 retry_budget: int | None = None,
+                 breaker_failures: int = BREAKER_FAILURES,
+                 breaker_cooldown_s: float = BREAKER_COOLDOWN_S,
+                 backoff_base_s: float = BACKOFF_BASE_S,
+                 backoff_cap_s: float = BACKOFF_CAP_S,
+                 retry_after_s: float = 2.0):
         self._static = workers is not None
         self._workers = [dict(w) for w in workers] if workers else []
         self.num_processes = int(num_processes)
@@ -175,10 +338,22 @@ class Router:
             slo = serving_slo_from_env()
         self.slo = slo
         self.cache_s = float(cache_s)
+        self.retry_budget = (int(retry_budget) if retry_budget is not None
+                             else retry_budget_from_env())
+        self.breaker_failures = int(breaker_failures)
+        self.breaker_cooldown_s = float(breaker_cooldown_s)
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_cap_s = float(backoff_cap_s)
+        self.retry_after_s = float(retry_after_s)
         self._cached_at = 0.0
         self._prefill_chunk: int | None = None
+        self._prefill_chunk_ep: str | None = None
+        self._had_prefill_tier = False
+        self._breakers: dict[str, _Breaker] = {}
+        self._evicted: dict[str, str] = {}  # endpoint -> eviction reason
         self._next_rid = 0
         self._lock = threading.Lock()
+        self._heartbeat = None
         if trace_requests:
             from ..telemetry.requests import RequestTracer
 
@@ -190,8 +365,9 @@ class Router:
                 endpoint: str | None = None):
         """Become this process's serving provider and register the router
         role in the fleet KV namespace (clients discover the front door the
-        same way the router discovers workers). ``server`` attaches to one
-        specific MetricsServer instead of the process-global route."""
+        same way the router discovers workers — heartbeat-leased like any
+        worker). ``server`` attaches to one specific MetricsServer instead
+        of the process-global route."""
         from ..telemetry.metrics import get_registry, set_serving_provider
 
         if server is not None:
@@ -205,9 +381,21 @@ class Router:
             "Serving tier this process runs (1 = the labeled role)",
             labelnames=("role",),
         ).set(1, role="router")
-        publish_serving_endpoint("router", process_index=process_index,
-                                 endpoint=endpoint)
+        if endpoint is not None or metrics_endpoint() is not None:
+            from .lease import LeaseHeartbeat
+
+            self._heartbeat = LeaseHeartbeat(
+                "router", process_index,
+                endpoint or metrics_endpoint(),
+            ).start()
         return self
+
+    def shutdown(self):
+        """Stop the lease heartbeat and revoke the router's registration
+        (graceful exit — the drill's teardown path)."""
+        if self._heartbeat is not None:
+            self._heartbeat.stop(revoke=True)
+            self._heartbeat = None
 
     # ------------------------------------------------------------- discovery
     def workers(self) -> list[dict]:
@@ -216,30 +404,116 @@ class Router:
         now = time.monotonic()
         with self._lock:
             if self._workers and now - self._cached_at < self.cache_s:
-                return self._workers
+                return list(self._workers)
         found = discover_serving_workers(self.num_processes)
         with self._lock:
-            if found:
-                self._workers = found
-                self._cached_at = now
-            return self._workers
+            known = {w["endpoint"] for w in self._workers}
+            self._workers = found
+            self._cached_at = now
+        fresh = {w["endpoint"] for w in found}
+        # A worker that vanished from discovery lost its lease (expired or
+        # revoked): evict it so retries and affinity can never re-pick it.
+        for endpoint in known - fresh:
+            self._evict(endpoint, "lease_expired")
+        # A previously lease-evicted worker whose heartbeat resumed re-earns
+        # trust through one half-open trial instead of a full cooldown.
+        for worker in found:
+            if self._evicted.get(worker["endpoint"]) == "lease_expired":
+                self._evicted.pop(worker["endpoint"], None)
+                breaker = self._breakers.get(worker["endpoint"])
+                if breaker is not None:
+                    breaker.permit_trial()
+        return found
+
+    def _breaker(self, endpoint: str) -> _Breaker:
+        breaker = self._breakers.get(endpoint)
+        if breaker is None:
+            breaker = self._breakers[endpoint] = _Breaker(
+                self.breaker_failures, self.breaker_cooldown_s)
+        return breaker
+
+    def _publish_breaker(self, endpoint: str):
+        state = self._breakers[endpoint].state
+        _, _, _, breaker_gauge = _fault_counters()
+        breaker_gauge.set(float(_Breaker.STATES.index(state)),
+                          endpoint=endpoint)
+
+    def _probe_ok(self, endpoint: str):
+        breaker = self._breakers.get(endpoint)
+        if breaker is not None and breaker.state != "closed":
+            breaker.ok()
+            self._publish_breaker(endpoint)
+        elif breaker is not None:
+            breaker.ok()
+
+    def _probe_failed(self, endpoint: str):
+        """One failed probe/dispatch against ``endpoint``; trips the breaker
+        (and books an eviction) after the consecutive-failure threshold."""
+        breaker = self._breaker(endpoint)
+        tripped = breaker.fail(time.monotonic())
+        self._publish_breaker(endpoint)
+        if tripped:
+            self._evict(endpoint, "probe_failures")
+
+    def _evict(self, endpoint: str, reason: str):
+        """Drop ``endpoint`` from the candidate set: open its breaker, book
+        the eviction, and invalidate every cache that could hand it back —
+        the worker cache (so re-routing re-discovers) and the prefill-chunk
+        cache when this endpoint supplied it (a dead tier must not keep
+        shaping arbitration)."""
+        if self._evicted.get(endpoint) == reason:
+            return
+        self._evicted[endpoint] = reason
+        breaker = self._breaker(endpoint)
+        breaker.state = "open"
+        breaker.opened_at = time.monotonic()
+        self._publish_breaker(endpoint)
+        _, evictions, _, _ = _fault_counters()
+        evictions.inc(reason=reason)
+        with self._lock:
+            self._workers = [w for w in self._workers
+                             if w["endpoint"] != endpoint]
+            if not self._static:
+                self._cached_at = 0.0
+            if self._prefill_chunk_ep == endpoint:
+                self._prefill_chunk_ep = None
+        logger.warning(f"serving worker {endpoint} evicted ({reason})")
+        from ..telemetry.flight import get_flight_recorder
+
+        get_flight_recorder().record("serving_eviction", endpoint=endpoint,
+                                     reason=reason)
+
+    def _available(self, workers: list[dict]) -> list[dict]:
+        """Candidates whose breaker admits traffic right now (closed, or one
+        half-open trial after cooldown)."""
+        now = time.monotonic()
+        out = []
+        for worker in workers:
+            breaker = self._breakers.get(worker["endpoint"])
+            if breaker is None or breaker.allows(now):
+                out.append(worker)
+        return out
 
     def _prefill_chunk_of(self, endpoint: str) -> int:
         """The prefill tier's chunk size (what tier arbitration counts
-        chunks with) — fetched once from the worker's /v1/stats and cached;
-        0 (unknown) degrades arbitration to single-chunk behavior."""
-        if self._prefill_chunk is None:
+        chunks with) — fetched from the worker's /v1/stats and cached per
+        endpoint (an eviction invalidates the binding, so a replacement
+        prefill tier is re-probed); 0 (unknown) degrades arbitration to
+        single-chunk behavior."""
+        if self._prefill_chunk is None or self._prefill_chunk_ep != endpoint:
             try:
                 stats = _get_json(f"http://{endpoint}/v1/stats")
                 self._prefill_chunk = int(stats.get("prefill_chunk") or 0)
+                self._prefill_chunk_ep = endpoint
             except Exception:
-                return 0
+                return self._prefill_chunk or 0
         return self._prefill_chunk
 
     # --------------------------------------------------------------- routing
     def _pick_decode(self, prompt: list, candidates: list[dict]):
         """Affinity first, least-loaded on ties; a worker that fails its
-        probe drops out of this decision, not out of the fleet."""
+        probe drops out of this decision AND feeds its circuit breaker —
+        enough consecutive failures evict it from the fleet."""
         probed = []
         for worker in candidates:
             try:
@@ -249,11 +523,13 @@ class Router:
                 )
                 probed.append((worker, int(answer.get("match_tokens", 0)),
                                int(answer.get("in_flight", 0))))
+                self._probe_ok(worker["endpoint"])
             except Exception as exc:
                 logger.warning(
                     f"serving worker {worker['endpoint']} failed its affinity "
                     f"probe ({exc!r}); routing around it"
                 )
+                self._probe_failed(worker["endpoint"])
         if not probed:
             return None, 0
         best_match = max(match for _, match, _ in probed)
@@ -261,44 +537,68 @@ class Router:
         worker = min(tied, key=lambda t: t[2])[0]
         return worker, best_match
 
-    def route(self, request: dict):
-        """One admission decision: assign the fleet rid, arbitrate the entry
-        tier, pick workers, and return ``(rid, url, outbound_request)`` —
-        the relay target. Raises RuntimeError when no worker can serve."""
+    def route(self, request: dict, rid: int | None = None, exclude=()):
+        """One admission (or re-dispatch) decision: assign the fleet rid,
+        arbitrate the entry tier, pick workers, and return ``(rid, url,
+        outbound_request)`` — the relay target. ``rid`` not-None marks a
+        retry leg (same rid, no re-admission bookkeeping); ``exclude`` drops
+        endpoints that already failed this request. Raises RuntimeError when
+        no worker can serve (the 503 shed path)."""
         prompt = list(request.get("prompt") or [])
         if not prompt:
             raise ValueError("empty or missing 'prompt'")
-        workers = self.workers()
+        workers = self._available(self.workers())
+        workers = [w for w in workers if w["endpoint"] not in exclude]
         decode_candidates = [w for w in workers
                              if w["role"] in ("decode", "unified")]
         prefill_candidates = [w for w in workers if w["role"] == "prefill"]
+        _, _, degraded, _ = _fault_counters()
         if not decode_candidates:
+            # The ladder's floor: nothing can decode — shed fast, explicitly.
+            degraded.inc(mode="no_decode")
             raise RuntimeError(
-                "no decode-capable serving worker registered "
+                "no decode-capable serving worker available "
                 f"({len(workers)} workers known)"
             )
         decode_worker, match = self._pick_decode(prompt, decode_candidates)
         if decode_worker is None:
+            degraded.inc(mode="no_decode")
             raise RuntimeError("every decode-capable worker failed its probe")
         prefill_chunk = (
             self._prefill_chunk_of(prefill_candidates[0]["endpoint"])
-            if prefill_candidates else 0
+            if prefill_candidates else (self._prefill_chunk or 0)
         )
         tier = arbitrate_serving_tier(
             len(prompt), self.slo, prefill_chunk=prefill_chunk,
             have_prefill_tier=bool(prefill_candidates),
         )
-        with self._lock:
-            rid = self._next_rid
-            self._next_rid += 1
-        if self.tracer is not None:
-            self.tracer.submit(rid, len(prompt), tier="router")
-            self.tracer.admit(rid, decision=f"route_{tier}",
-                              aliased_blocks=0, chunks=1)
-        routed, affinity_hits = _router_counters()
-        routed.inc(tier=tier)
-        if match > 0:
-            affinity_hits.inc()
+        if prefill_candidates:
+            self._had_prefill_tier = True
+        elif (self._had_prefill_tier and prefill_chunk > 0
+                and len(prompt) > prefill_chunk):
+            # Rung one of the ladder: this prompt would have entered the
+            # prefill tier, but that tier is gone — decode-as-unified, booked
+            # so the degradation is explicit, not silent.
+            degraded.inc(mode="prefill_lost")
+            from ..telemetry.flight import get_flight_recorder
+
+            get_flight_recorder().record(
+                "serving_degraded", mode="prefill_lost",
+                prompt_tokens=len(prompt),
+            )
+        first_leg = rid is None
+        if first_leg:
+            with self._lock:
+                rid = self._next_rid
+                self._next_rid += 1
+            if self.tracer is not None:
+                self.tracer.submit(rid, len(prompt), tier="router")
+                self.tracer.admit(rid, decision=f"route_{tier}",
+                                  aliased_blocks=0, chunks=1)
+            routed, affinity_hits = _router_counters()
+            routed.inc(tier=tier)
+            if match > 0:
+                affinity_hits.inc()
         outbound = {key: value for key, value in request.items()
                     if key != "request_id"}
         outbound["request_id"] = rid
@@ -308,6 +608,13 @@ class Router:
                 key=lambda w: self._in_flight_of(w["endpoint"]),
             )
             outbound["decode_endpoint"] = decode_worker["endpoint"]
+            # Re-handoff targets, preference order: a failed import tries the
+            # next surviving decode worker without re-prefilling.
+            outbound["decode_endpoints"] = (
+                [decode_worker["endpoint"]]
+                + [w["endpoint"] for w in decode_candidates
+                   if w["endpoint"] != decode_worker["endpoint"]]
+            )
             return rid, f"http://{prefill_worker['endpoint']}/v1/generate", outbound
         return rid, f"http://{decode_worker['endpoint']}/v1/generate", outbound
 
@@ -328,29 +635,177 @@ class Router:
         if path != "/v1/generate":
             return None
         request = json.loads(body or b"{}")
+        # End-to-end deadline: the client's timeout_s (or the stream-timeout
+        # default) becomes a wall-clock deadline every downstream dispatch
+        # carries — a retried request never outlives what the client waits.
+        if request.get("deadline_wall") is None:
+            timeout_s = float(request.get("timeout_s") or STREAM_TIMEOUT_S)
+            request["deadline_wall"] = time.time() + timeout_s
         try:
             rid, url, outbound = self.route(request)
         except ValueError as exc:
-            return ("json", 400, {"error": str(exc)})
+            return ("json", 400, {"error": str(exc), "retryable": False})
         except RuntimeError as exc:
-            return ("json", 503, {"error": str(exc)})
+            return self._shed(exc)
+        return ("sse", self._relay_with_retry(rid, request, url, outbound))
 
-        def finalize(done: dict) -> dict:
+    def _shed(self, exc, rid=None):
+        """The ladder's floor: a fast, explicit 503 with a retry hint — and
+        the shed booked through the SLO sentinel, so availability loss lands
+        in the same counter/flight/warning path as every other breach."""
+        from ..telemetry.slo import record_breach
+
+        record_breach("availability", 1.0, 0.0, rid=rid)
+        return ("json", 503, {
+            "error": str(exc),
+            "retryable": True,
+            "retry_after_s": self.retry_after_s,
+        })
+
+    def _finalize(self, rid: int, done: dict) -> dict:
+        if self.tracer is not None:
+            self.tracer.finish(rid, len(done.get("tokens", [])),
+                               tpot_s=done.get("tpot_s"))
+            record = next(
+                (r for r in self.tracer.records() if r["rid"] == rid),
+                None,
+            )
+            if record is not None:
+                done["trace"] = [record] + done.get("trace", [])
+        return done
+
+    def _relay_with_retry(self, rid: int, request: dict, url: str,
+                          outbound: dict):
+        """The relay generator behind every routed request: stream the
+        chosen worker's SSE frames through, and on a retryable failure
+        (connect error, retryable error frame, or a stream that ends without
+        ``done``/``error``) re-route to a surviving worker under the SAME
+        rid with exponential backoff, inside the retry budget and deadline.
+
+        Token deltas are de-duplicated across legs: a retried worker replays
+        the whole generation (greedy decode is deterministic, so the replay
+        is bit-identical), and only the not-yet-delivered tail is forwarded —
+        the client sees ONE contiguous stream. A terminal frame (``done`` or
+        ``error``) is guaranteed on every path."""
+        deadline_wall = float(outbound.get("deadline_wall")
+                              or time.time() + STREAM_TIMEOUT_S)
+        retries, _, _, _ = _fault_counters()
+        delivered = 0   # token deltas already forwarded to the client
+        attempt = 0
+        failed: set[str] = set()
+        while True:
+            endpoint = url.split("/")[2]
+            leg_seen = 0
+            failure = None
+            timeout_s = max(0.05, deadline_wall - time.time())
+            req = urllib.request.Request(
+                url, data=json.dumps(outbound).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            response = None
+            try:
+                response = urllib.request.urlopen(req, timeout=timeout_s)
+            except urllib.error.HTTPError as exc:
+                try:
+                    detail = json.loads(exc.read().decode("utf-8", "replace"))
+                except Exception:
+                    detail = {}
+                if detail.get("retryable") is False:
+                    yield sse_event("error", {
+                        "rid": rid, "retryable": False,
+                        "error": detail.get("error", str(exc)),
+                    })
+                    if self.tracer is not None:
+                        self.tracer.cancel(rid)
+                    return
+                failure = "dispatch_failed"
+            except Exception:
+                failure = "dispatch_failed"
+            if response is not None:
+                from .frontend import iter_sse
+
+                try:
+                    with response:
+                        for kind, data in iter_sse(response):
+                            if kind == "tokens":
+                                payload = json.loads(data)
+                                tokens = payload.get("tokens", [])
+                                start = leg_seen
+                                leg_seen += len(tokens)
+                                if leg_seen <= delivered:
+                                    continue  # replayed prefix: already sent
+                                fresh = tokens[max(0, delivered - start):]
+                                delivered = leg_seen
+                                yield sse_event("tokens",
+                                                {"rid": rid, "tokens": fresh})
+                            elif kind == "done":
+                                try:
+                                    payload = self._finalize(rid,
+                                                             json.loads(data))
+                                except (ValueError, TypeError):
+                                    yield f"event: done\ndata: {data}\n\n"
+                                    return
+                                yield sse_event("done", payload)
+                                return
+                            elif kind == "error":
+                                payload = json.loads(data)
+                                if payload.get("retryable", True):
+                                    failure = "worker_error"
+                                    break
+                                payload.setdefault("rid", rid)
+                                yield sse_event("error", payload)
+                                if self.tracer is not None:
+                                    self.tracer.cancel(rid)
+                                return
+                            else:
+                                yield f"event: {kind}\ndata: {data}\n\n"
+                except Exception:
+                    failure = "stream_broken"
+                if failure is None:
+                    # EOF without a terminal frame: the worker died mid-stream.
+                    failure = "stream_broken"
+            # ---------------------------------------------------- retry leg
+            self._probe_failed(endpoint)
+            failed.add(endpoint)
+            attempt += 1
+            remaining = deadline_wall - time.time()
+            if attempt > self.retry_budget or remaining <= 0:
+                reason = ("deadline exceeded" if remaining <= 0
+                          else f"retry budget ({self.retry_budget}) exhausted")
+                yield sse_event("error", {
+                    "rid": rid, "retryable": False,
+                    "error": f"request failed after {attempt} dispatch(es): "
+                             f"{failure}; {reason}",
+                })
+                if self.tracer is not None:
+                    self.tracer.cancel(rid)
+                return
+            retries.inc(reason=failure)
             if self.tracer is not None:
-                self.tracer.finish(rid, len(done.get("tokens", [])),
-                                   tpot_s=done.get("tpot_s"))
-                record = next(
-                    (r for r in self.tracer.records() if r["rid"] == rid),
-                    None,
-                )
-                if record is not None:
-                    done["trace"] = [record] + done.get("trace", [])
-            return done
+                self.tracer.retry(rid, attempt, failure, endpoint=endpoint)
+            backoff = min(self.backoff_cap_s,
+                          self.backoff_base_s * (2 ** (attempt - 1)))
+            time.sleep(min(backoff, max(0.0, remaining)))
+            try:
+                _, url, outbound = self.route(request, rid=rid, exclude=failed)
+            except (ValueError, RuntimeError) as exc:
+                # No surviving worker for the retry: shed explicitly (booked
+                # like any availability loss), terminal error to the client.
+                from ..telemetry.slo import record_breach
 
-        return ("sse", relay_generate(url, outbound, finalize=finalize))
+                record_breach("availability", 1.0, 0.0, rid=rid)
+                yield sse_event("error", {
+                    "rid": rid, "retryable": True,
+                    "retry_after_s": self.retry_after_s,
+                    "error": f"no surviving worker for retry: {exc}",
+                })
+                if self.tracer is not None:
+                    self.tracer.cancel(rid)
+                return
 
     def stats(self) -> dict:
         routed, affinity_hits = _router_counters()
+        retries, evictions, degraded, _ = _fault_counters()
         by_tier = {key[0]: int(v)
                    for key, v in routed.series_values().items()}
         total = sum(by_tier.values())
@@ -361,4 +816,14 @@ class Router:
             "routed": by_tier,
             "affinity_hits": hits,
             "affinity_hit_rate": round(hits / total, 6) if total else None,
+            "retries": {key[0]: int(v)
+                        for key, v in retries.series_values().items()},
+            "evictions": dict(self._evicted),
+            "evictions_total": {key[0]: int(v)
+                                for key, v in evictions.series_values().items()},
+            "degraded": {key[0]: int(v)
+                         for key, v in degraded.series_values().items()},
+            "breakers": {endpoint: breaker.state
+                         for endpoint, breaker in self._breakers.items()},
+            "retry_budget": self.retry_budget,
         }

@@ -570,6 +570,26 @@ class FleetAggregator:
                     families, "accelerate_serving_router_affinity_hits_total")
                 tier["affinity_hits"] = (
                     tier.get("affinity_hits", 0) + int(hits or 0))
+            # Fault-tolerance rollups (docs/serving.md "Failure semantics"):
+            # retry legs and eviction/degradation counts by labeled reason,
+            # plus in-flight requests saved by graceful drains — the /fleet
+            # pane where "did the fleet recover?" is answered.
+            for metric, field, label in (
+                ("accelerate_serving_retries_total", "retries", "reason"),
+                ("accelerate_serving_evictions_total", "evictions", "reason"),
+                ("accelerate_serving_degraded_total", "degraded", "mode"),
+            ):
+                for key, value in families.get(metric, {}).get(
+                        "series", {}).items():
+                    m = re.search(rf'{label}="([^"]*)"', key)
+                    bucket = tier.setdefault(field, {})
+                    name = m.group(1) if m else "unknown"
+                    bucket[name] = bucket.get(name, 0) + int(value)
+            drained = _series_value(
+                families, "accelerate_serving_drained_inflight_total")
+            if drained:
+                tier["drained_in_flight"] = (
+                    tier.get("drained_in_flight", 0) + int(drained))
         for tier in tiers.values():
             for prefix in ("ttft", "tpot"):
                 count = tier.pop(f"{prefix}_count")

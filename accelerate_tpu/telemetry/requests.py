@@ -98,6 +98,7 @@ class RequestTracer:
             "tpot_s": None,
             "total_s": None,
             "handoff": None,
+            "retries": [],
             "breached": [],
         }
         self._ring[rid] = record
@@ -225,6 +226,29 @@ class RequestTracer:
             "handoff", rid=int(rid), direction=str(direction),
             bytes=int(bytes), blocks=int(blocks),
         )
+
+    def retry(self, rid: int, attempt: int, reason: str,
+              endpoint: str | None = None):
+        """Book one retry leg on the record: the router re-dispatched ``rid``
+        after ``endpoint`` failed it (``reason``: ``dispatch_failed`` /
+        ``stream_broken`` / ``worker_error`` / ``handoff_failed``). The legs
+        accumulate in dispatch order, so a trace shows WHERE each attempt
+        died — and a flight-recorder event lands next to the fault that
+        caused it."""
+        record = self._get(rid)
+        if record is None:
+            return
+        record.setdefault("retries", []).append({
+            "attempt": int(attempt),
+            "reason": str(reason),
+            "endpoint": endpoint,
+            "at_s": round(max(0.0, self._clock() - record["submit_t"]), 6),
+        })
+        from .flight import get_flight_recorder
+
+        get_flight_recorder().record("serving_retry", rid=int(rid),
+                                     attempt=int(attempt), reason=str(reason),
+                                     endpoint=endpoint)
 
     def cancel(self, rid: int):
         """The request's engine state was wiped before it finished

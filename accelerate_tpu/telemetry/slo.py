@@ -39,8 +39,11 @@ from ..logging import get_logger
 logger = get_logger(__name__)
 
 # Breach targets are a closed vocabulary so dashboards and the fleet
-# aggregator can enumerate the label values.
-BREACH_TARGETS = ("step_time", "mfu", "ttft", "tpot")
+# aggregator can enumerate the label values. ``availability`` books a shed
+# request (the serving degradation ladder's floor — router 503s because no
+# decode-capable worker survived); its value/threshold are request counts,
+# not seconds.
+BREACH_TARGETS = ("step_time", "mfu", "ttft", "tpot", "availability")
 
 _BREACH_HANDLES = None  # metrics.cached_handles accessor
 

@@ -102,6 +102,17 @@ ENV_SLO_TPOT = "ACCELERATE_SLO_TPOT"
 # clients point at the fleet); tri-state like profile_steps ('' scrubs).
 ENV_SERVING_ROLE = "ACCELERATE_SERVING_ROLE"
 ENV_ROUTER_ENDPOINT = "ACCELERATE_ROUTER_ENDPOINT"
+# Serving-tier fault tolerance (serving_net/lease.py; docs/serving.md
+# "Failure semantics"): how many times the router re-dispatches a failed
+# request on a surviving worker under the same rid, how long a worker's
+# heartbeat-refreshed discovery lease stays valid without a refresh, and how
+# long a SIGTERM'd serving worker waits for in-flight requests before it
+# exits. All three are tri-state per the SLO precedent — unset = library
+# default (2 retries / 15 s TTL / 30 s grace), an explicit 0 scrubs an
+# inherited value back to the default.
+ENV_SERVING_RETRY_BUDGET = "ACCELERATE_SERVING_RETRY_BUDGET"
+ENV_SERVING_LEASE_TTL = "ACCELERATE_SERVING_LEASE_TTL"
+ENV_DRAIN_GRACE_S = "ACCELERATE_DRAIN_GRACE_S"
 # Dispatch amortization (docs/performance.md "Dispatch amortization"): the
 # default K for Accelerator.build_train_window (1 = one dispatch per step),
 # and the curated XLA latency-hiding flag preset installed into

@@ -84,7 +84,15 @@ import numpy as np
 # handoff chains/blocks/bytes shipped prefill → decode, per-tier TTFT/TPOT,
 # and the bit-identical-output parity verdict vs one unified engine. Absent
 # otherwise; composes with BENCH_SERVING (both land under detail.serving).
-BENCH_SCHEMA_VERSION = 12
+# v13 = serving chaos lever (serving_net/ fault tolerance): BENCH_SERVING_CHAOS=1
+# drives the same prompt mix through a 2-decode-worker router rig twice —
+# clean, then with a mid-stream worker_kill armed via the req: fault grammar
+# (benchmarks/serving_chaos_profile.py) — and embeds detail.serving.chaos:
+# recovered/lost request counts, the added-TTFT and added-completion-latency
+# the recovered request paid under fault, the router's retry/eviction
+# rollups, and the bit-identical-output verdict clean vs faulted. Absent
+# otherwise; composes with the other serving levers under detail.serving.
+BENCH_SCHEMA_VERSION = 13
 
 
 class BenchAuditFailure(RuntimeError):
@@ -700,6 +708,28 @@ def run_one(mode: str):
                 pass
         serving_summary = dict(serving_summary or {})
         serving_summary["routing"] = routing_summary
+
+    # Serving chaos lever (schema v13): BENCH_SERVING_CHAOS=1 runs the
+    # clean-vs-faulted comparative rig (benchmarks/serving_chaos_profile.py
+    # — mid-stream worker_kill, retry on the survivor) and embeds the
+    # recovery payload under detail.serving.chaos.
+    if os.environ.get("BENCH_SERVING_CHAOS", "0") == "1":
+        bench_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "benchmarks")
+        sys.path.insert(0, bench_dir)
+        try:
+            import serving_chaos_profile
+
+            chaos_summary = serving_chaos_profile.summarize()
+        except Exception as exc:  # the lever must never take the row down
+            chaos_summary = {"error": f"{type(exc).__name__}: {exc}"[:300]}
+        finally:
+            try:
+                sys.path.remove(bench_dir)
+            except ValueError:
+                pass
+        serving_summary = dict(serving_summary or {})
+        serving_summary["chaos"] = chaos_summary
 
     print(
         json.dumps(

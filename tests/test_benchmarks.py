@@ -106,3 +106,29 @@ def test_serving_decode_profile_smoke():
     assert len(by_probe["wave_paged"]["ttft_s"]) == 6
     assert "max_decode_step_stall_s" in by_probe["prefill_chunked"]
     assert "stall_ratio_chunked_vs_no_admit" in by_probe["headline"]
+
+
+def test_serving_chaos_profile_smoke():
+    """The fault-tolerance comparative harness (clean pass vs mid-stream
+    worker_kill) runs end-to-end in small mode: the recovered request count
+    is exactly the one faulted request, nothing is lost, and the faulted
+    pass's streams are bit-identical to the clean pass's. Latency deltas are
+    recorded, not asserted — small-mode numbers are dispatch-dominated; they
+    mean something on a real chip (BENCH_SERVING_CHAOS=1, schema v13)."""
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO_ROOT, "benchmarks", "serving_chaos_profile.py")],
+        capture_output=True,
+        text=True,
+        timeout=420,
+        env={**os.environ, "BENCH_PROFILE_SMALL": "1"},
+        cwd=REPO_ROOT,
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    records = [json.loads(line) for line in proc.stdout.strip().splitlines()]
+    by_probe = {r["probe"]: r for r in records}
+    assert by_probe["headline"]["outputs_identical"] is True
+    assert by_probe["recovery"]["recovered_requests"] == 1
+    assert by_probe["recovery"]["lost_requests"] == 0
+    assert by_probe["recovery"]["retries"].get("stream_broken", 0) >= 1
+    assert by_probe["fault_tax"]["added_latency_under_fault_s"] is not None

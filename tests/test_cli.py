@@ -296,6 +296,9 @@ def test_config_wizard_roundtrips_through_launch(tmp_path):
         "yes",               # configure disaggregated serving tiers?
         "prefill",           # serving role for the launched workers
         "127.0.0.1:9876",    # router endpoint
+        "3",                 # router retry budget per failed request
+        "2.5",               # worker discovery lease TTL (s)
+        "0",                 # SIGTERM drain grace (0 = library default)
         "yes",               # configure dispatch amortization?
         "4",                 # train window K
         "latency",           # xla latency-hiding preset
@@ -323,6 +326,9 @@ def test_config_wizard_roundtrips_through_launch(tmp_path):
     assert cfg.slo_step_time == 0.3 and cfg.slo_ttft == 0.5 and cfg.slo_tpot == 0.0
     assert cfg.serving_role == "prefill"
     assert cfg.router_endpoint == "127.0.0.1:9876"
+    assert cfg.serving_retry_budget == 3.0
+    assert cfg.serving_lease_ttl == 2.5
+    assert cfg.drain_grace_s == 0.0  # explicit scrub, not unspecified
     assert cfg.train_window == 4 and cfg.xla_preset == "latency"
     assert cfg.zero_sharding is True
     assert cfg.kernels == "pallas"
@@ -374,6 +380,14 @@ def test_config_wizard_roundtrips_through_launch(tmp_path):
         "assert resolve_serving_role().name == 'prefill'\n"
         "assert acc.state.serving_role.name == 'prefill'\n"
         "assert router_endpoint_from_env() == '127.0.0.1:9876'\n"
+        "assert os.environ.get('ACCELERATE_SERVING_RETRY_BUDGET') == '3.0'\n"
+        "assert os.environ.get('ACCELERATE_SERVING_LEASE_TTL') == '2.5'\n"
+        "assert 'ACCELERATE_DRAIN_GRACE_S' not in os.environ\n"
+        "from accelerate_tpu.serving_net.lease import (retry_budget_from_env, "
+        "lease_ttl_from_env, drain_grace_from_env)\n"
+        "assert retry_budget_from_env() == 3\n"
+        "assert lease_ttl_from_env() == 2.5\n"
+        "assert drain_grace_from_env() == 30.0\n"
         "assert os.environ.get('ACCELERATE_TRAIN_WINDOW') == '4'\n"
         "assert acc.train_window == 4\n"
         "assert os.environ.get('ACCELERATE_XLA_PRESET') == 'latency'\n"
