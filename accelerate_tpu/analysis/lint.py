@@ -80,6 +80,7 @@ _ASARRAY_SCOPE = (
     "serving.py",
     "utils/operations.py",
     "telemetry/",
+    "serving_net/",
     "health/",
     "optimizer.py",
     "scheduler.py",

@@ -19,7 +19,9 @@ from .fingerprint import fingerprint_command_parser
 from .launch import launch_command_parser
 from .merge import merge_command_parser
 from .profile import blackbox_command_parser, profile_command_parser
+from .report import report_command_parser
 from .test import test_command_parser
+from .timeline import timeline_command_parser
 from .top import top_command_parser
 from .tpu import tpu_command_parser
 from .tune import tune_command_parser
@@ -46,6 +48,8 @@ def main() -> None:
     blackbox_command_parser(subparsers=subparsers)
     tune_command_parser(subparsers=subparsers)
     top_command_parser(subparsers=subparsers)
+    timeline_command_parser(subparsers=subparsers)
+    report_command_parser(subparsers=subparsers)
 
     args = parser.parse_args()
     if not hasattr(args, "func"):

@@ -148,6 +148,7 @@ def get_user_input() -> ClusterConfig:
     telemetry, metrics_port, straggler_threshold = None, 0, 0.0
     profile_steps, profile_slow_zscore = None, None
     fleet_metrics, slo_step_time, slo_ttft, slo_tpot = None, None, None, None
+    journal_dir, trace_ring, flight_ring = None, None, None
     if _yesno(
         "Do you want to configure observability (step timeline, metrics "
         "endpoint, straggler alerts, profiling, fleet aggregation, SLOs)?",
@@ -189,6 +190,18 @@ def get_user_input() -> ClusterConfig:
         slo_tpot = _ask(
             "  SLO target: serving time-per-output-token in seconds "
             "(0 = no target)", 0.0, float,
+        )
+        journal_dir = _ask(
+            "  durable telemetry journal directory (per-rank JSONL merged by "
+            "`accelerate-tpu timeline`/`report`; '' = off)", ""
+        )
+        trace_ring = _ask(
+            "  request-trace ring capacity (completed request records kept "
+            "in memory; 0 = library default 1024)", 0, int
+        )
+        flight_ring = _ask(
+            "  flight-recorder ring size (forensic events in the crash "
+            "dump; 0 = library default 2048)", 0, int
         )
     # Disaggregated serving (serving_net/): declining leaves both None —
     # nothing exported, an inherited ACCELERATE_SERVING_ROLE /
@@ -316,6 +329,9 @@ def get_user_input() -> ClusterConfig:
         slo_step_time=slo_step_time,
         slo_ttft=slo_ttft,
         slo_tpot=slo_tpot,
+        journal_dir=journal_dir,
+        trace_ring=trace_ring,
+        flight_ring=flight_ring,
         serving_role=serving_role,
         router_endpoint=router_endpoint,
         serving_retry_budget=serving_retry_budget,

@@ -157,6 +157,17 @@ class ClusterConfig:
     serving_retry_budget: float | None = None
     serving_lease_ttl: float | None = None
     drain_grace_s: float | None = None
+    # Durable telemetry journal (telemetry/journal.py; docs/observability.md
+    # "Telemetry journal & fleet timeline"). ``journal_dir`` is TRI-state per
+    # the router_endpoint precedent: None = unspecified (inherited
+    # ACCELERATE_JOURNAL_DIR flows), a path arms per-rank journaling, an
+    # explicit '' scrubs a stale inherited directory. The ring capacities
+    # are TRI-state ints per the tune_budget precedent: None = unspecified,
+    # > 0 exported (ACCELERATE_TRACE_RING / ACCELERATE_FLIGHT_RING), an
+    # explicit 0 scrubs back to the library defaults (1024 / 2048).
+    journal_dir: str | None = None
+    trace_ring: int | None = None
+    flight_ring: int | None = None
     # Dispatch amortization (docs/performance.md): ``train_window`` is the K
     # Accelerator.build_train_window fuses per dispatch (tri-state like
     # ``telemetry``: None = unspecified, an inherited ACCELERATE_TRAIN_WINDOW

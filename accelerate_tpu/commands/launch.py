@@ -31,9 +31,11 @@ from ..utils.constants import (
     ENV_ELASTIC,
     ENV_FAULT_PLAN,
     ENV_FLEET_METRICS,
+    ENV_FLIGHT_RING,
     ENV_GUARD_NUMERICS,
     ENV_HANDLE_PREEMPTION,
     ENV_HANG_TIMEOUT,
+    ENV_JOURNAL_DIR,
     ENV_MESH_SHAPE,
     ENV_METRICS_PORT,
     ENV_MIN_DATA_PARALLEL,
@@ -55,6 +57,7 @@ from ..utils.constants import (
     ENV_SPIKE_ZSCORE,
     ENV_STRAGGLER_THRESHOLD,
     ENV_TELEMETRY,
+    ENV_TRACE_RING,
     ENV_TRAIN_WINDOW,
     ENV_TUNE_BUDGET,
     ENV_XLA_PRESET,
@@ -246,6 +249,30 @@ def launch_command_parser(subparsers=None) -> argparse.ArgumentParser:
              "to the default.",
     )
     parser.add_argument(
+        "--journal_dir", default=None,
+        help="Durable telemetry journal directory (ACCELERATE_JOURNAL_DIR; "
+             "docs/observability.md 'Telemetry journal'): each worker "
+             "appends its step/span/request/flight/goodput streams to "
+             "journal_<rank>.jsonl here, flushed per record so the tail "
+             "survives SIGKILL; `accelerate-tpu timeline`/`report` read it "
+             "back. Tri-state: unset inherits, '' scrubs an inherited value "
+             "(journaling off).",
+    )
+    parser.add_argument(
+        "--trace_ring", type=int, default=None,
+        help="RequestTracer ring capacity — completed request records "
+             "retained in memory (ACCELERATE_TRACE_RING; library default "
+             "1024). Tri-state: unset inherits, an explicit 0 scrubs an "
+             "inherited value back to the default.",
+    )
+    parser.add_argument(
+        "--flight_ring", type=int, default=None,
+        help="Flight-recorder ring size — forensic events retained for the "
+             "crash dump (ACCELERATE_FLIGHT_RING; library default 2048). "
+             "Tri-state: unset inherits, an explicit 0 scrubs an inherited "
+             "value back to the default.",
+    )
+    parser.add_argument(
         "--straggler_threshold", type=float, default=None,
         help="Cross-host slowness ratio that raises a straggler alert "
              "(ACCELERATE_STRAGGLER_THRESHOLD; library default 1.5): a host "
@@ -375,6 +402,9 @@ def _merge_config(args) -> ClusterConfig:
         ("serving_retry_budget", "serving_retry_budget"),
         ("serving_lease_ttl", "serving_lease_ttl"),
         ("drain_grace_s", "drain_grace_s"),
+        ("journal_dir", "journal_dir"),
+        ("trace_ring", "trace_ring"),
+        ("flight_ring", "flight_ring"),
         ("train_window", "train_window"),
         ("xla_preset", "xla_preset"),
         ("zero_sharding", "zero_sharding"),
@@ -489,6 +519,21 @@ def prepare_launch_env(cfg: ClusterConfig, process_id: int | None = None, attemp
     ):
         if value:
             env[env_name] = str(value)
+        elif value is not None:
+            env.pop(env_name, None)
+    # Telemetry journal (telemetry/journal.py): tri-state per the
+    # router_endpoint precedent — a path arms per-rank journaling, an
+    # explicit '' scrubs a stale inherited directory (journaling off).
+    if cfg.journal_dir and cfg.journal_dir.strip():
+        env[ENV_JOURNAL_DIR] = os.path.expanduser(cfg.journal_dir.strip())
+    elif cfg.journal_dir is not None:
+        env.pop(ENV_JOURNAL_DIR, None)
+    # Forensic ring capacities: tri-state per the tune_budget precedent —
+    # an explicit 0 scrubs a stale inherited value back to the defaults.
+    for value, env_name in ((cfg.trace_ring, ENV_TRACE_RING),
+                            (cfg.flight_ring, ENV_FLIGHT_RING)):
+        if value:
+            env[env_name] = str(int(value))
         elif value is not None:
             env.pop(env_name, None)
     # Dispatch amortization: the window K reaches Accelerator.train_window;
@@ -693,6 +738,12 @@ def launch_command(args) -> None:
         if value is not None and value < 0:
             raise ValueError(
                 f"{name} must be >= 0 (0 = library default), got {value}"
+            )
+    for name, value in (("--trace_ring", cfg.trace_ring),
+                        ("--flight_ring", cfg.flight_ring)):
+        if value is not None and value < 0:
+            raise ValueError(
+                f"{name} must be >= 0 entries (0 = library default), got {value}"
             )
     from ..telemetry import metrics_port_from_env
 

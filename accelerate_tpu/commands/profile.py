@@ -103,7 +103,11 @@ def blackbox_command_parser(subparsers=None) -> argparse.ArgumentParser:
         parser = subparsers.add_parser("blackbox", description=description)
     else:
         parser = argparse.ArgumentParser("accelerate-tpu blackbox", description=description)
-    parser.add_argument("dump", help="flight_*.json dump written by the flight recorder")
+    parser.add_argument(
+        "dump",
+        help="flight_*.json dump written by the flight recorder, or a "
+             "directory of them (merged in time order with host labels)",
+    )
     parser.add_argument(
         "--last", type=int, default=0,
         help="Only render the last N events (default: all retained)",
@@ -123,7 +127,53 @@ def _event_detail(event: dict) -> str:
     return " ".join(parts)
 
 
+def _blackbox_directory(args) -> None:
+    """Merge every flight dump in a directory into one fleet timeline,
+    events interleaved by wall time and labelled with the dumping host."""
+    import glob
+    import os
+
+    paths = sorted(glob.glob(os.path.join(args.dump, "flight_*.json")))
+    if not paths:
+        raise SystemExit(f"blackbox: no flight_*.json dumps in {args.dump!r}")
+    merged = []
+    for path in paths:
+        try:
+            with open(path) as fh:
+                dump = json.load(fh)
+        except (OSError, ValueError) as exc:
+            print(f"blackbox: skipping unreadable dump {path}: {exc}")
+            continue
+        host = dump.get("process_index", "?")
+        print(
+            f"dump host {host}: reason={dump.get('reason')!r} "
+            f"pid {dump.get('pid')} events {dump.get('events_retained')} "
+            f"retained ({path})"
+        )
+        for event in dump.get("events", []):
+            merged.append((host, event))
+    if args.last > 0:
+        merged.sort(key=lambda pair: pair[1].get("wall", 0))
+        merged = merged[-args.last:]
+    else:
+        merged.sort(key=lambda pair: pair[1].get("wall", 0))
+    wall_base = merged[0][1].get("wall", 0) if merged else 0
+    print(f"merged timeline ({len(merged)} events; t is seconds since first event):")
+    for host, event in merged:
+        step = f" step={event['step']}" if "step" in event else ""
+        detail = _event_detail(event)
+        print(
+            f"  t={event.get('wall', 0) - wall_base:>10.3f}  host={host!s:<4}"
+            f"{event.get('kind', '?'):<20}{step}{'  ' + detail if detail else ''}"
+        )
+
+
 def blackbox_command(args) -> None:
+    import os
+
+    if os.path.isdir(args.dump):
+        _blackbox_directory(args)
+        return
     with open(args.dump) as fh:
         dump = json.load(fh)
     events = dump.get("events", [])

@@ -1,0 +1,122 @@
+"""`accelerate-tpu report` — run-over-run regression reports from journals.
+
+Every journaled run finalizes with a ``run_summary`` record (step-time
+quantiles, MFU, goodput fraction, TTFT/TPOT, breach/retry/restart counts,
+fingerprint hash — telemetry/journal.py:finalize_run). This command
+extracts it (``--journal`` accepts a journal directory or a summary JSON a
+previous ``--out`` wrote), optionally compares against a previous run
+(``--compare``) with deltas classified regression / improvement / benign
+(the analysis/fingerprint.py classify_drift idiom), and exits 1 when any
+field regressed — the CI gate shape.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from ..utils.constants import ENV_JOURNAL_DIR
+
+
+def report_command_parser(subparsers=None) -> argparse.ArgumentParser:
+    description = "Summarize a journaled run; compare against a previous one"
+    if subparsers is not None:
+        parser = subparsers.add_parser("report", description=description)
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu report", description=description)
+    parser.add_argument(
+        "--journal", default=None,
+        help="Journal directory (or a summary JSON from a previous --out); "
+             f"default: ${ENV_JOURNAL_DIR}",
+    )
+    parser.add_argument(
+        "--compare", default=None,
+        help="Previous run to diff against (journal directory or summary JSON); "
+             "exits 1 if any field regressed",
+    )
+    parser.add_argument(
+        "--tolerance", type=float, default=0.1,
+        help="Relative slack before a metric delta counts as a "
+             "regression/improvement (default: 0.10)",
+    )
+    parser.add_argument(
+        "--out", default=None,
+        help="Write the current run's summary JSON here (feed to a later --compare)",
+    )
+    parser.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="Machine-readable output on stdout",
+    )
+    if subparsers is not None:
+        parser.set_defaults(func=report_command)
+    return parser
+
+
+_SUMMARY_ORDER = (
+    "steps", "wall_s", "step_p50", "step_p90", "step_mean", "step_max",
+    "tokens_per_s", "mfu", "loss", "goodput_fraction", "restarts",
+    "ttft_mean", "ttft_max", "ttft_count", "tpot_mean", "tpot_max",
+    "breaches", "retries", "evictions", "fingerprint",
+)
+
+
+def _print_summary(summary: dict) -> None:
+    print("run summary:")
+    for field in _SUMMARY_ORDER:
+        value = summary.get(field)
+        if value is None:
+            continue
+        if isinstance(value, float):
+            value = f"{value:.6g}"
+        print(f"  {field:<18} {value}")
+
+
+def report_command(args) -> None:
+    from ..telemetry.collect import compare_runs, load_summary
+
+    source = args.journal or os.environ.get(ENV_JOURNAL_DIR, "").strip()
+    if not source:
+        raise SystemExit(
+            f"report: no journal source — pass --journal or set {ENV_JOURNAL_DIR}"
+        )
+    summary = load_summary(source)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as fh:
+            json.dump(summary, fh, indent=1)
+
+    rows: list[dict] = []
+    if args.compare:
+        rows = compare_runs(load_summary(args.compare), summary,
+                            tolerance=args.tolerance)
+    regressions = [r for r in rows if r["kind"] == "regression"]
+
+    if args.as_json:
+        print(json.dumps({"summary": summary, "comparison": rows,
+                          "regressions": len(regressions)}, indent=1))
+    else:
+        _print_summary(summary)
+        if args.compare:
+            print(f"comparison vs {args.compare} (tolerance ±{args.tolerance:.0%}):")
+            for row in rows:
+                marker = {"regression": "!", "improvement": "+",
+                          "note": "*"}.get(row["kind"], " ")
+                print(f"  {marker} {row['field']:<18} {row['kind']:<12} {row['detail']}")
+            if regressions:
+                fields = ", ".join(r["field"] for r in regressions)
+                print(f"REGRESSION: {fields}", file=sys.stderr)
+            else:
+                print("no regressions")
+    if regressions:
+        raise SystemExit(1)
+
+
+def main() -> None:  # pragma: no cover - thin shim
+    parser = report_command_parser()
+    args = parser.parse_args()
+    report_command(args)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -1,0 +1,117 @@
+"""`accelerate-tpu timeline` — assemble the fleet's journals into one trace.
+
+Merges every rank's durable telemetry journal (telemetry/journal.py) into a
+single Chrome-trace/Perfetto JSON where a request's router→prefill→handoff→
+decode legs render as causally linked flow arrows under its rid, per-host
+wall-clock skew corrected via the journaled ``clock_sync`` exchange. Input
+is either a shared journal directory (``--journal-dir``, defaulting to
+``ACCELERATE_JOURNAL_DIR``) or live worker metrics endpoints
+(``--endpoints host:port,...`` → ``GET /journal?since=``). Pure host-side
+post-processing — no backend, no devices touched.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from ..utils.constants import ENV_JOURNAL_DIR
+
+
+def timeline_command_parser(subparsers=None) -> argparse.ArgumentParser:
+    description = "Merge per-host telemetry journals into one Chrome-trace timeline"
+    if subparsers is not None:
+        parser = subparsers.add_parser("timeline", description=description)
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu timeline", description=description)
+    parser.add_argument(
+        "--journal-dir", default=None,
+        help="Directory of journal_<rank>.jsonl files "
+             f"(default: ${ENV_JOURNAL_DIR})",
+    )
+    parser.add_argument(
+        "--endpoints", default=None,
+        help="Comma-separated host:port metrics endpoints to tail over HTTP "
+             "instead of (or in addition to) --journal-dir",
+    )
+    parser.add_argument(
+        "--out", default="trace.json",
+        help="Output Chrome-trace file (open in chrome://tracing or Perfetto)",
+    )
+    parser.add_argument(
+        "--rid", type=int, default=None,
+        help="Keep only this request id's legs (plus their flow links)",
+    )
+    parser.add_argument(
+        "--steps", default=None,
+        help="Keep only step range 'A-B' (or a single step 'A') and events "
+             "inside its corrected time window",
+    )
+    if subparsers is not None:
+        parser.set_defaults(func=timeline_command)
+    return parser
+
+
+def _gather(args) -> dict[int, list]:
+    from ..telemetry.collect import fetch_journal, read_journal_dir
+
+    journal_dir = args.journal_dir or os.environ.get(ENV_JOURNAL_DIR, "").strip()
+    by_host: dict[int, list] = {}
+    if journal_dir:
+        by_host.update(read_journal_dir(journal_dir))
+    if args.endpoints:
+        for endpoint in args.endpoints.split(","):
+            endpoint = endpoint.strip()
+            if not endpoint:
+                continue
+            try:
+                payload = fetch_journal(endpoint)
+            except Exception as exc:  # noqa: BLE001 - surface which host failed
+                print(f"timeline: endpoint {endpoint} unreachable: {exc}",
+                      file=sys.stderr)
+                continue
+            host = int(payload.get("host", 0))
+            merged = by_host.setdefault(host, [])
+            seen = {r.get("seq") for r in merged}
+            merged.extend(r for r in payload.get("records", [])
+                          if r.get("seq") not in seen)
+            merged.sort(key=lambda r: r.get("seq", 0))
+    return by_host
+
+
+def timeline_command(args) -> None:
+    from ..telemetry.collect import chrome_trace
+
+    if not (args.journal_dir or os.environ.get(ENV_JOURNAL_DIR, "").strip()
+            or args.endpoints):
+        raise SystemExit(
+            "timeline: no journal source — pass --journal-dir / --endpoints "
+            f"or set {ENV_JOURNAL_DIR}"
+        )
+    by_host = _gather(args)
+    if not by_host:
+        raise SystemExit("timeline: no journal records found")
+    trace = chrome_trace(by_host, rid=args.rid, steps=args.steps)
+    with open(args.out, "w", encoding="utf-8") as fh:
+        json.dump(trace, fh)
+    slices = sum(1 for e in trace["traceEvents"] if e.get("ph") == "X")
+    flows = sum(1 for e in trace["traceEvents"] if e.get("ph") in ("s", "t", "f"))
+    hosts = trace.get("otherData", {}).get("hosts", [])
+    skew = trace.get("otherData", {}).get("skew", {})
+    print(f"timeline: {slices} slices / {flows} flow links from "
+          f"{len(hosts)} host(s) -> {args.out}")
+    if any(abs(s) > 1e-6 for s in skew.values()):
+        corrected = " ".join(f"host{h}={s:+.3f}s" for h, s in sorted(skew.items()))
+        print(f"timeline: clock skew corrected: {corrected}")
+
+
+def main() -> None:  # pragma: no cover - thin shim
+    parser = timeline_command_parser()
+    args = parser.parse_args()
+    timeline_command(args)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -75,6 +75,19 @@ class GoodputLedger:
         with self._lock:
             self.seconds[category] += float(seconds)
             self.counts[category] += count
+        # Durable delta (telemetry/journal.py): badput transitions (compiles,
+        # checkpoint saves/restores, resharding, profiling overhead) land in
+        # the per-host journal as they happen, so the fleet timeline renders
+        # where the wall-clock went. ``step`` is excluded — the telemetry
+        # hook journals every step boundary already, richer.
+        if category != GOODPUT_CATEGORY:
+            try:
+                from ..telemetry.journal import journal_event
+
+                journal_event("goodput", category=category,
+                              seconds=round(float(seconds), 6), count=count)
+            except Exception:
+                pass
 
     @contextmanager
     def track(self, category: str):
@@ -95,6 +108,13 @@ class GoodputLedger:
             self.restarts += 1
             self.seconds["restart"] += float(downtime_s)
             self.counts["restart"] += 1
+        try:
+            from ..telemetry.journal import journal_event
+
+            journal_event("goodput", category="restart",
+                          seconds=round(float(downtime_s), 6), count=1)
+        except Exception:
+            pass
 
     def mark_process_start(self, attempt: int = 0):
         """Called by ``PartialState`` at process birth: a nonzero

@@ -63,6 +63,7 @@ import urllib.request
 import numpy as np
 
 from ..logging import get_logger
+from ..utils.transfer import host_view
 from .handoff import export_chain, import_chain, release_chain, run_prefill_only
 from .lease import LeaseHeartbeat, drain_grace_from_env
 from .roles import ServingRole, resolve_serving_role
@@ -411,7 +412,7 @@ class ServingFrontend:
         """The engine's streaming sink (runs on the loop thread, fed from
         the report the loop already fetches)."""
         kind = "final" if final else "tokens"
-        self._push(rid, (kind, [int(t) for t in np.asarray(tokens).reshape(-1)]))
+        self._push(rid, (kind, [int(t) for t in host_view(tokens).reshape(-1)]))
 
     def _push(self, rid: int, item):
         subscriber = self._streams.get(rid)

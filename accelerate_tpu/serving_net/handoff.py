@@ -37,7 +37,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.paged_attention import export_chain_blocks, import_chain_blocks
-from ..utils.transfer import host_fetch
+from ..utils.transfer import host_fetch, host_view
 
 PAYLOAD_VERSION = 1
 
@@ -72,16 +72,26 @@ def _handoff_counters():
     return _HANDOFF_COUNTERS()
 
 
-def _book_handoff(direction: str, nbytes: int, blocks: int):
+def _book_handoff(direction: str, nbytes: int, blocks: int,
+                  rid: int | None = None):
     counter_bytes, counter_chains, counter_blocks = _handoff_counters()
     counter_bytes.inc(int(nbytes), direction=direction)
     counter_chains.inc(direction=direction)
     counter_blocks.inc(int(blocks), direction=direction)
+    # Durable wire-level leg (telemetry/journal.py): tracer-less engines
+    # (relay tiers) still land their handoff legs in the per-host journal,
+    # so a fleet timeline shows chain movement even where no RequestTracer
+    # is attached. No-op when journaling is off.
+    from ..telemetry.journal import journal_event
+
+    journal_event("handoff_wire", rid=rid, direction=str(direction),
+                  bytes=int(nbytes), blocks=int(blocks))
 
 
 # ------------------------------------------------------------ wire encoding
 def _encode(arr) -> dict:
-    arr = np.asarray(arr)
+    # host_view: a device-resident chain fetches counted; host data passes.
+    arr = host_view(arr)
     return {
         "dtype": str(arr.dtype),
         "shape": list(arr.shape),
@@ -229,7 +239,7 @@ def export_chain(engine, rid: int, endpoint: str | None = None,
     if engine.tracer is not None:
         engine.tracer.handoff(rid, "out", bytes=nbytes, blocks=n_data,
                               endpoint=endpoint)
-    _book_handoff("out", nbytes, n_data)
+    _book_handoff("out", nbytes, n_data, rid=rid)
     if free:
         engine.release_request(rid)
     return payload
@@ -349,7 +359,7 @@ def import_chain(engine, payload: dict, endpoint: str | None = None) -> int:
                              tier="decode")
         engine.tracer.handoff(rid, "in", bytes=nbytes, blocks=n_data,
                               endpoint=endpoint)
-    _book_handoff("in", nbytes, n_data)
+    _book_handoff("in", nbytes, n_data, rid=rid)
     engine._peak_consumed_slots = max(
         engine._peak_consumed_slots, engine.blocks_in_use * engine.block_size
     )

@@ -61,6 +61,14 @@ from .flight import (
     record_event,
     reset_flight_recorder,
 )
+from .journal import (
+    TelemetryJournal,
+    exchange_clock_sync,
+    get_journal,
+    journal_event,
+    reset_journal,
+    set_journal,
+)
 from .metrics import (
     Counter,
     Gauge,
@@ -103,17 +111,22 @@ __all__ = [
     "StepTimeline",
     "StragglerMonitor",
     "Telemetry",
+    "TelemetryJournal",
     "breach_counts",
     "device_memory_stats",
     "device_peak_flops",
     "discover_endpoints",
+    "exchange_clock_sync",
     "get_flight_recorder",
+    "get_journal",
     "get_profile_manager",
     "get_registry",
     "get_span_ring",
     "get_telemetry",
     "install_default_collectors",
     "install_fleet_provider",
+    "journal_event",
+    "live_telemetry",
     "metrics_endpoint",
     "metrics_port_from_env",
     "parse_profile_steps",
@@ -122,9 +135,11 @@ __all__ = [
     "record_event",
     "reset_fleet",
     "reset_flight_recorder",
+    "reset_journal",
     "reset_profile_manager",
     "reset_spans",
     "reset_telemetry",
+    "set_journal",
     "set_profile_manager",
     "set_telemetry",
     "slo_targets_from_env",
@@ -367,6 +382,7 @@ class Telemetry:
                 self.profiler.step_boundary(step=step, wall_s=wall, steps=window)
                 self.flight.note_step(step=step, wall_s=wall, steps=window,
                                       transfers=_transfer_snapshot())
+                self._journal_step(step, wall, window, tokens)
                 if self.slo is not None and wall is not None:
                     self.slo.observe_step(wall, steps=window, step=step,
                                           mfu=self.timeline.last_mfu)
@@ -412,9 +428,25 @@ class Telemetry:
         self.profiler.step_boundary(wall_s=wall, steps=steps)
         self.flight.note_step(wall_s=wall, steps=steps,
                               transfers=_transfer_snapshot())
+        self._journal_step(None, wall, steps, tokens)
         if self.slo is not None and wall is not None:
             self.slo.observe_step(wall, steps=steps,
                                   mfu=self.timeline.last_mfu)
+
+    def _journal_step(self, step, wall, steps, tokens):
+        """Durable step-boundary record (telemetry/journal.py). Every field
+        is host bookkeeping the boundary already produced — ``loss`` is the
+        timeline's last DRAINED value (never a device fetch), so
+        journaling-on adds zero blocking transfers versus journaling-off
+        (the comparative pin in tests/test_journal.py). No-op when
+        journaling is off (one global read)."""
+        if wall is None:
+            return  # baseline boundary: covers trace+compile, not a step
+        journal_event(
+            "step", step=step, wall_s=round(float(wall), 6), steps=int(steps),
+            tokens=None if tokens is None else int(tokens),
+            mfu=self.timeline.last_mfu, loss=self.timeline.last_loss,
+        )
 
     # --------------------------------------------------------------- reading
     def summary(self) -> dict:
@@ -460,6 +492,13 @@ def get_telemetry() -> Telemetry:
         # warn spuriously); otherwise run the same shared env install.
         telemetry.server = default_server() or start_endpoint_from_env()
         _DEFAULT = telemetry
+    return _DEFAULT
+
+
+def live_telemetry() -> Telemetry | None:
+    """The default instance IF one exists — the peek cold paths use
+    (journal.finalize_run) so assembling a run summary in a process that
+    never built telemetry doesn't construct one as a side effect."""
     return _DEFAULT
 
 

@@ -1,0 +1,371 @@
+"""Fleet journal collection — merge per-host journals into ONE causal view.
+
+The journal (:mod:`.journal`) leaves one JSONL file per host; this module is
+the read side the CLIs drive:
+
+- :func:`read_journal_dir` / :func:`fetch_journal` gather every rank's
+  records (shared filesystem, or the ``GET /journal?since=`` tail each
+  worker's metrics server exposes);
+- :func:`clock_skew` recovers the per-host wall-clock skew from the latest
+  barrier-aligned ``clock_sync`` record (journal.exchange_clock_sync);
+- :func:`chrome_trace` renders the merged, skew-corrected fleet into one
+  Chrome-trace/Perfetto JSON: one ``pid`` per host, lanes (``tid``) for
+  steps / request legs / spans / flight events / goodput deltas, and flow
+  arrows binding a request's router→prefill→handoff→decode legs under its
+  rid — ``accelerate-tpu timeline``;
+- :func:`latest_run_summary` / :func:`compare_runs` power ``accelerate-tpu
+  report``: run-over-run deltas classified regression / improvement /
+  benign (the analysis/fingerprint.py ``classify_drift`` idiom), exit 1 on
+  regression.
+
+Everything here is cold-path host code over already-written files — the
+collector never touches a device.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+
+# Chrome-trace lanes (tid) inside each host's pid row.
+TID_STEPS = 0
+TID_REQUESTS = 1
+TID_SPANS = 2
+TID_EVENTS = 3
+TID_GOODPUT = 4
+
+_TID_NAMES = {
+    TID_STEPS: "steps",
+    TID_REQUESTS: "requests",
+    TID_SPANS: "spans",
+    TID_EVENTS: "events",
+    TID_GOODPUT: "goodput",
+}
+
+# run_summary fields by direction, for :func:`compare_runs` (the
+# classify_drift idiom: one directional rule per field class).
+LOWER_BETTER = ("step_p50", "step_p90", "step_mean", "step_max",
+                "ttft_mean", "ttft_max", "tpot_mean", "tpot_max")
+HIGHER_BETTER = ("mfu", "tokens_per_s", "goodput_fraction")
+COUNT_WORSE = ("breaches", "retries", "restarts", "evictions")
+
+
+# ------------------------------------------------------------------ gathering
+def read_journal_dir(directory: str) -> dict[int, list]:
+    """All retained records per host from ``journal_<rank>.jsonl`` files
+    (rotated ``.1`` generations included), each host's records seq-ordered."""
+    by_host: dict[int, list] = {}
+    for path in sorted(glob.glob(os.path.join(directory, "journal_*.jsonl*"))):
+        match = re.search(r"journal_(\d+)\.jsonl(\.1)?$", path)
+        if match is None:
+            continue
+        host = int(match.group(1))
+        records = by_host.setdefault(host, [])
+        try:
+            with open(path, encoding="utf-8") as fh:
+                for raw in fh:
+                    try:
+                        records.append(json.loads(raw))
+                    except ValueError:
+                        continue  # torn tail line of a live file
+        except OSError:
+            continue
+    for records in by_host.values():
+        records.sort(key=lambda r: r.get("seq", 0))
+    return {h: r for h, r in by_host.items() if r}
+
+
+def fetch_journal(endpoint: str, since: int = 0, timeout_s: float = 10.0) -> dict:
+    """One worker's journal tail over its metrics server
+    (``GET http://<endpoint>/journal?since=N``) — the live-fleet gather path
+    when the collector has no shared filesystem. Returns the tail payload
+    (schema_version/host/next/records); raises on transport errors so the
+    CLI can report which host was unreachable."""
+    from urllib.request import urlopen
+
+    url = f"http://{endpoint}/journal?since={int(since)}"
+    with urlopen(url, timeout=timeout_s) as response:
+        return json.loads(response.read().decode())
+
+
+# ------------------------------------------------------------ clock alignment
+def clock_skew(records_by_host: dict[int, list]) -> dict[int, float]:
+    """Per-host wall-clock skew versus rank 0, from the LATEST ``clock_sync``
+    record anywhere in the fleet (every rank journals the full map, so any
+    surviving journal recovers it). Hosts absent from the map — or a fleet
+    that never synced — correct by 0.0 (merge falls back to raw wall)."""
+    best = None
+    for records in records_by_host.values():
+        for record in records:
+            if record.get("kind") != "clock_sync":
+                continue
+            if best is None or record.get("wall", 0) > best.get("wall", 0):
+                best = record
+    skew: dict[int, float] = {}
+    if best is not None:
+        for rank, value in (best.get("skew") or {}).items():
+            try:
+                skew[int(rank)] = float(value)
+            except (TypeError, ValueError):
+                continue
+    return skew
+
+
+def corrected_wall(record: dict, skew: dict[int, float]) -> float:
+    """A record's wall stamp mapped onto rank 0's clock."""
+    return float(record.get("wall", 0.0)) - skew.get(int(record.get("host", 0)), 0.0)
+
+
+def merge_records(records_by_host: dict[int, list]) -> list:
+    """Every host's records in one skew-corrected causal order; each record
+    gains ``t`` (corrected wall seconds)."""
+    skew = clock_skew(records_by_host)
+    merged = []
+    for records in records_by_host.values():
+        for record in records:
+            merged.append(dict(record, t=corrected_wall(record, skew)))
+    merged.sort(key=lambda r: r["t"])
+    return merged
+
+
+# ------------------------------------------------------------- chrome tracing
+def _parse_steps(spec: str | None) -> tuple[int, int] | None:
+    """``"A-B"`` / ``"A"`` → inclusive step range."""
+    if not spec:
+        return None
+    match = re.fullmatch(r"(\d+)(?:-(\d+))?", spec.strip())
+    if match is None:
+        raise ValueError(f"--steps expects 'A' or 'A-B', got {spec!r}")
+    lo = int(match.group(1))
+    hi = int(match.group(2)) if match.group(2) else lo
+    return (lo, hi)
+
+
+def chrome_trace(records_by_host: dict[int, list], rid: int | None = None,
+                 steps: str | None = None) -> dict:
+    """The merged fleet as one Chrome-trace JSON (``chrome://tracing`` /
+    Perfetto ``traceEvents`` format): pid = host rank, lanes per stream,
+    ``ts``/``dur`` in microseconds rebased to the earliest corrected stamp.
+    A request's legs carry flow arrows (``ph: s/t/f`` sharing ``id=rid``) so
+    router→prefill→handoff→decode render causally linked across hosts.
+    ``rid`` keeps one request's legs; ``steps`` ("A-B") keeps that step
+    range plus everything inside its corrected time window."""
+    skew = clock_skew(records_by_host)
+    step_range = _parse_steps(steps)
+    rows = []  # (host, corrected_t, record)
+    for host, records in records_by_host.items():
+        for record in records:
+            rows.append((host, corrected_wall(record, skew), record))
+    if not rows:
+        return {"traceEvents": [], "displayTimeUnit": "ms"}
+    t_base = min(t for _, t, _ in rows)
+
+    def us(t: float) -> float:
+        return round((t - t_base) * 1e6, 1)
+
+    if step_range is not None:
+        window = [t for _, t, r in rows
+                  if r.get("kind") == "step" and r.get("step") is not None
+                  and step_range[0] <= r["step"] <= step_range[1]]
+        if window:
+            lo, hi = min(window), max(window)
+            # A step record's stamp is the boundary END; open the window by
+            # the longest kept step so the step's own body stays inside.
+            pad = max((r.get("wall_s", 0.0) * r.get("steps", 1)
+                       for _, t, r in rows
+                       if r.get("kind") == "step" and t in window), default=0.0)
+            rows = [(h, t, r) for h, t, r in rows if lo - pad - 1.0 <= t <= hi + 1.0]
+        else:
+            rows = []
+    if rid is not None:
+        rows = [(h, t, r) for h, t, r in rows if r.get("rid") == rid]
+
+    events: list = []
+    hosts_used: set[int] = set()
+    lanes_used: set[tuple[int, int]] = set()
+    rid_legs: dict[int, list] = {}
+    for host, t, record in sorted(rows, key=lambda x: x[1]):
+        kind = record.get("kind")
+        args = {k: v for k, v in record.items()
+                if k not in ("seq", "host", "t_s", "wall", "kind")}
+        if kind == "step":
+            dur = max(float(record.get("wall_s", 0.0)) * int(record.get("steps", 1)), 1e-6)
+            step = record.get("step")
+            name = f"step {step}" if step is not None else f"window x{record.get('steps', 1)}"
+            tid = TID_STEPS
+            events.append({"ph": "X", "pid": host, "tid": tid, "name": name,
+                           "cat": "step", "ts": us(t - dur), "dur": round(dur * 1e6, 1),
+                           "args": args})
+        elif kind == "span":
+            dur = max(float(record.get("duration_s", 0.0)), 1e-6)
+            tid = TID_SPANS
+            events.append({"ph": "X", "pid": host, "tid": tid,
+                           "name": str(record.get("name")), "cat": "span",
+                           "ts": us(t - dur), "dur": round(dur * 1e6, 1),
+                           "args": args})
+        elif kind == "request_leg":
+            tid = TID_REQUESTS
+            name = f"{record.get('tier', '?')}:{record.get('leg', '?')}"
+            event = {"ph": "X", "pid": host, "tid": tid, "name": name,
+                     "cat": "request", "ts": us(t), "dur": 1, "args": args}
+            events.append(event)
+            if record.get("rid") is not None:
+                rid_legs.setdefault(int(record["rid"]), []).append(event)
+        elif kind in ("flight", "handoff_wire", "goodput"):
+            if kind == "goodput":
+                dur = max(float(record.get("seconds", 0.0)), 1e-6)
+                tid = TID_GOODPUT
+                events.append({"ph": "X", "pid": host, "tid": tid,
+                               "name": f"goodput:{record.get('category')}",
+                               "cat": "goodput", "ts": us(t - dur),
+                               "dur": round(dur * 1e6, 1), "args": args})
+            else:
+                tid = TID_EVENTS
+                label = (record.get("event") if kind == "flight"
+                         else f"handoff_wire:{record.get('direction')}")
+                event = {"ph": "X", "pid": host, "tid": tid,
+                         "name": str(label), "cat": "event",
+                         "ts": us(t), "dur": 1, "args": args}
+                events.append(event)
+                if record.get("rid") is not None:
+                    rid_legs.setdefault(int(record["rid"]), []).append(event)
+        else:
+            # journal_open / clock_sync / run_summary: bookkeeping, not lanes.
+            continue
+        hosts_used.add(host)
+        lanes_used.add((host, tid))
+
+    # Flow arrows: one chain per rid through its legs in corrected order —
+    # the causal link a cross-host retry/handoff renders as.
+    for rid_key, legs in rid_legs.items():
+        if len(legs) < 2:
+            continue
+        for i, leg in enumerate(legs):
+            phase = "s" if i == 0 else ("f" if i == len(legs) - 1 else "t")
+            flow = {"ph": phase, "pid": leg["pid"], "tid": leg["tid"],
+                    "name": f"rid {rid_key}", "cat": "request",
+                    "id": rid_key, "ts": leg["ts"]}
+            if phase == "f":
+                flow["bp"] = "e"  # bind to the enclosing slice
+            events.append(flow)
+
+    metadata = []
+    for host in sorted(hosts_used):
+        metadata.append({"ph": "M", "pid": host, "name": "process_name",
+                         "args": {"name": f"host {host}"}})
+    for host, tid in sorted(lanes_used):
+        metadata.append({"ph": "M", "pid": host, "tid": tid,
+                         "name": "thread_name",
+                         "args": {"name": _TID_NAMES.get(tid, str(tid))}})
+    return {
+        "traceEvents": metadata + events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "hosts": sorted(hosts_used),
+            "skew": {str(h): s for h, s in clock_skew(records_by_host).items()},
+            "t_base_wall": t_base,
+        },
+    }
+
+
+# ------------------------------------------------------------------ reporting
+def latest_run_summary(records_by_host: dict[int, list]) -> dict | None:
+    """The newest ``run_summary`` record in the fleet (rank 0's preferred on
+    a wall-clock tie — it owns the canonical timeline)."""
+    best = None
+    for host in sorted(records_by_host):
+        for record in records_by_host[host]:
+            if record.get("kind") != "run_summary":
+                continue
+            if best is None or record.get("wall", 0) > best.get("wall", 0):
+                best = record
+    return best
+
+
+def _fleet_leg_aggregates(records_by_host: dict[int, list]) -> dict:
+    """TTFT/TPOT moments over EVERY host's request legs. A per-host
+    ``run_summary`` only sees the legs its own process booked — on a
+    disaggregated rig the router host finalizes but the decode tier owns
+    first_token — so the collector recomputes the fleet truth."""
+    aggregates: dict = {}
+    for name, field in (("ttft", "ttft_s"), ("tpot", "tpot_s")):
+        values = [record[field] for records in records_by_host.values()
+                  for record in records
+                  if record.get("kind") == "request_leg"
+                  and isinstance(record.get(field), (int, float))]
+        if values:
+            aggregates[f"{name}_mean"] = round(sum(values) / len(values), 6)
+            aggregates[f"{name}_max"] = round(max(values), 6)
+            aggregates[f"{name}_count"] = len(values)
+    return aggregates
+
+
+def load_summary(path: str) -> dict:
+    """A run summary from a journal directory (latest ``run_summary``
+    record, its TTFT/TPOT fields widened to the whole fleet's legs) or a
+    JSON file a previous ``report --out`` wrote."""
+    if os.path.isdir(path):
+        records_by_host = read_journal_dir(path)
+        summary = latest_run_summary(records_by_host)
+        if summary is None:
+            raise ValueError(
+                f"no run_summary record in {path!r} — the run never "
+                "finalized (bench.py finalizes when journaling is armed; "
+                "call TelemetryJournal.finalize_run from custom loops)"
+            )
+        return dict(summary, **_fleet_leg_aggregates(records_by_host))
+    with open(path, encoding="utf-8") as fh:
+        data = json.load(fh)
+    if not isinstance(data, dict):
+        raise ValueError(f"{path!r} is not a run-summary JSON object")
+    return data
+
+
+def _row(field: str, kind: str, prev, current, detail: str) -> dict:
+    return {"field": field, "kind": kind, "prev": prev, "current": current,
+            "detail": detail}
+
+
+def compare_runs(prev: dict, current: dict, tolerance: float = 0.1) -> list[dict]:
+    """Classify run-over-run deltas (the classify_drift idiom): each
+    comparable field becomes one row with ``kind`` regression / improvement
+    / benign (or ``note`` for the fingerprint identity line). ``tolerance``
+    is the relative slack both directions; count fields regress on ANY
+    increase. The caller exits 1 when any row is a regression."""
+    rows: list[dict] = []
+    fp_prev, fp_cur = prev.get("fingerprint"), current.get("fingerprint")
+    if fp_prev and fp_cur and fp_prev != fp_cur:
+        rows.append(_row(
+            "fingerprint", "note", fp_prev, fp_cur,
+            "program identity changed between runs — deltas below may be "
+            "intended",
+        ))
+
+    def numeric(summary, field):
+        value = summary.get(field)
+        return float(value) if isinstance(value, (int, float)) else None
+
+    for field in LOWER_BETTER + HIGHER_BETTER:
+        p, c = numeric(prev, field), numeric(current, field)
+        if p is None or c is None:
+            continue
+        delta = (c - p) / max(abs(p), 1e-9)
+        worse = delta > tolerance if field in LOWER_BETTER else delta < -tolerance
+        better = delta < -tolerance if field in LOWER_BETTER else delta > tolerance
+        kind = "regression" if worse else ("improvement" if better else "benign")
+        rows.append(_row(field, kind, p, c,
+                         f"{delta:+.1%} vs previous (tolerance ±{tolerance:.0%})"))
+    for field in COUNT_WORSE:
+        p, c = prev.get(field), current.get(field)
+        if not isinstance(p, (int, float)) or not isinstance(c, (int, float)):
+            continue
+        if c > p:
+            kind, detail = "regression", f"count rose {int(p)} → {int(c)}"
+        elif c < p:
+            kind, detail = "improvement", f"count fell {int(p)} → {int(c)}"
+        else:
+            kind, detail = "benign", f"unchanged at {int(c)}"
+        rows.append(_row(field, kind, p, c, detail))
+    return rows

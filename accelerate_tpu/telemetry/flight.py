@@ -78,6 +78,12 @@ class FlightRecorder:
             if data:
                 event.update(data)
             self._ring.append(event)
+            # Durable tee (telemetry/journal.py): every flight event also
+            # lands in the per-host journal when one is armed — the ring is
+            # scrape-or-lose, the journal survives the SIGKILL.
+            tap = _JOURNAL_TAP
+            if tap is not None:
+                tap(kind, step, data)
         except Exception:
             pass  # the black box must never take the plane down
 
@@ -208,16 +214,43 @@ def dump_dir() -> str:
 _RECORDER: FlightRecorder | None = None
 _EXCEPTHOOK_INSTALLED = False
 _LOCK = threading.Lock()
+_JOURNAL_TAP = None
+
+
+def set_journal_tap(tap):
+    """Install (or clear, with None) the journal's flight-event tee — called
+    by telemetry/journal.py when a journal arms; the recorder itself imports
+    nothing from the journal (injected-provider idiom, metrics.py:300)."""
+    global _JOURNAL_TAP
+    _JOURNAL_TAP = tap
+
+
+def ring_capacity_from_env(env_name: str, default: int) -> int:
+    """Resolve an event-ring capacity from the launch env (tri-state: unset
+    or an explicit 0 → the library default; a positive int sets it). Garbage
+    raises — ``accelerate-tpu launch`` validates before export, so a bad
+    value fails at the front door, not inside a worker's telemetry stack."""
+    raw = os.environ.get(env_name, "").strip()
+    if not raw:
+        return default
+    value = int(raw)  # ValueError on garbage — launch-time validation's job
+    if value < 0:
+        raise ValueError(f"{env_name} must be >= 0, got {value}")
+    return value if value > 0 else default
 
 
 def get_flight_recorder() -> FlightRecorder:
     """The process-wide black box; created (and the crash excepthook
-    installed) on first use."""
+    installed) on first use. Ring size honors ACCELERATE_FLIGHT_RING."""
     global _RECORDER
     if _RECORDER is None:
         with _LOCK:
             if _RECORDER is None:
-                _RECORDER = FlightRecorder()
+                from ..utils.constants import ENV_FLIGHT_RING
+
+                _RECORDER = FlightRecorder(
+                    capacity=ring_capacity_from_env(ENV_FLIGHT_RING, 2048)
+                )
                 _install_excepthook()
     return _RECORDER
 

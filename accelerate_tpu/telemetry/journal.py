@@ -1,0 +1,406 @@
+"""Durable telemetry journal — the run history a dead host leaves behind.
+
+The live observability plane (metrics registry, span ring, flight recorder,
+request tracer) is scrape-or-lose: a SIGKILL'd host takes its rings with it,
+and a cross-host incident leaves three uncorrelated dumps. The journal fixes
+both halves. Each host appends every stream the process ALREADY pays for —
+step/window boundaries (tokens/MFU from the step timeline), span records,
+flight-recorder events, request-trace legs incl. handoff/retry/drain, SLO
+breaches, goodput ledger deltas — to one JSONL file under
+``ACCELERATE_JOURNAL_DIR``, line-buffered and flushed per record exactly like
+``tracking.JSONTracker`` (a preempted or OOM-killed run loses nothing), and
+bounded by size-based rotation (one ``.1`` generation, so a host's journal
+occupies at most ~2x :data:`DEFAULT_MAX_BYTES`).
+
+Every record is stamped with the causal key the collector
+(:mod:`.collect`) needs to reassemble a fleet: ``host`` (process index),
+``t_s`` (host monotonic since journal open), ``wall`` (host wall clock), and
+``rid``/``step`` where applicable. ``wall`` clocks skew across hosts, so
+:func:`exchange_clock_sync` runs the coordination-KV barrier idiom
+(utils/agreement.py — works on collective-less rigs): ranks align at a
+barrier, stamp ``(monotonic, wall)`` on release, and all-gather the stamps;
+the per-rank wall delta versus rank 0 IS the skew the collector subtracts.
+
+Emission discipline matches the flight recorder: one record is a dict build,
+a ``json.dumps``, and a buffered write — no locks beyond a short file mutex,
+no device transfers, EVER (records carry only already-paid host bookkeeping;
+tests/test_journal.py pins journaling-on == journaling-off blocking-transfer
+counts). ``emit`` never raises: the journal must never take the run down.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+
+JOURNAL_SCHEMA_VERSION = 1
+
+# Rotation bound for the live file: crossing it moves the file to ``<name>.1``
+# (replacing the previous generation), so retention is bounded at ~2x this.
+DEFAULT_MAX_BYTES = 64 * 1024 * 1024
+
+# Coordination-KV namespace of the clock exchange (fleet.py persistent-key
+# idiom); a per-call counter keeps repeated syncs collision-free.
+CLOCK_NAMESPACE = "at_journal/clock"
+
+_SYNC_COUNT = itertools.count()
+
+
+def _host_index() -> int:
+    from ..utils.constants import ENV_PROCESS_ID
+
+    try:
+        return int(os.environ.get(ENV_PROCESS_ID, "0") or 0)
+    except ValueError:
+        return 0
+
+
+class TelemetryJournal:
+    """Append-only per-host JSONL journal; see module docstring.
+
+    ``clock``/``wall_clock`` are injectable for deterministic tests (the
+    multi-host drill injects an artificial wall skew per rank and asserts the
+    collector corrects it). Reopening an existing journal resumes the ``seq``
+    counter from the last retained record, so appends from a restarted
+    process never reuse sequence numbers the ``since=`` tail contract relies
+    on."""
+
+    def __init__(self, directory: str, process_index: int | None = None,
+                 max_bytes: int = DEFAULT_MAX_BYTES,
+                 clock=time.monotonic, wall_clock=time.time):
+        self.directory = directory
+        self.host = _host_index() if process_index is None else int(process_index)
+        self.max_bytes = int(max_bytes)
+        if self.max_bytes <= 0:
+            raise ValueError(f"max_bytes must be positive, got {max_bytes}")
+        self._clock = clock
+        self._wall = wall_clock
+        self._t0 = clock()
+        self._lock = threading.Lock()
+        os.makedirs(directory, exist_ok=True)
+        self.path = os.path.join(directory, f"journal_{self.host}.jsonl")
+        self._seq = itertools.count(_resume_seq(self.path))
+        # Line-buffered handle, flushed per record — the JSONTracker
+        # durability precedent: a SIGKILL'd host loses nothing.
+        self._file = open(self.path, "a", buffering=1, encoding="utf-8")
+        self.counts: dict[str, int] = {}
+        self._ttft = [0, 0.0, 0.0]  # count, sum, max
+        self._tpot = [0, 0.0, 0.0]
+        self.emit("journal_open", pid=os.getpid(),
+                  schema_version=JOURNAL_SCHEMA_VERSION)
+
+    # -------------------------------------------------------------- recording
+    def emit(self, kind: str, step=None, rid=None, **data):
+        """Append one record; returns it (None on failure — the journal must
+        never take the run down). Safe on any thread."""
+        try:
+            with self._lock:
+                record = {
+                    "seq": next(self._seq),
+                    "host": self.host,
+                    "t_s": round(self._clock() - self._t0, 6),
+                    "wall": round(self._wall(), 6),
+                    "kind": str(kind),
+                }
+                if step is not None:
+                    record["step"] = int(step)
+                if rid is not None:
+                    record["rid"] = int(rid)
+                if data:
+                    record.update(data)
+                self._file.write(json.dumps(record, default=str) + "\n")
+                self._file.flush()
+                self._observe(kind, data)
+                if self._file.tell() >= self.max_bytes:
+                    self._rotate()
+                return record
+        except Exception:
+            return None
+
+    def _observe(self, kind: str, data: dict):
+        """Running aggregates for :meth:`finalize_run` — count by kind (flight
+        events and request legs sub-keyed) and TTFT/TPOT moments."""
+        key = kind
+        if kind == "flight":
+            key = f"flight:{data.get('event')}"
+        self.counts[key] = self.counts.get(key, 0) + 1
+        if kind == "request_leg":
+            leg = data.get("leg")
+            lkey = f"leg:{leg}"
+            self.counts[lkey] = self.counts.get(lkey, 0) + 1
+            for field, agg in (("ttft_s", self._ttft), ("tpot_s", self._tpot)):
+                value = data.get(field)
+                if isinstance(value, (int, float)):
+                    agg[0] += 1
+                    agg[1] += float(value)
+                    agg[2] = max(agg[2], float(value))
+
+    def _rotate(self):
+        """Size-based rotation: live file becomes ``.1`` (replacing the
+        previous generation); ``seq`` keeps counting across the boundary so
+        ``tail(since=)`` stays monotonic."""
+        self._file.close()
+        os.replace(self.path, self.path + ".1")
+        self._file = open(self.path, "a", buffering=1, encoding="utf-8")
+
+    # ---------------------------------------------------------------- reading
+    def tail(self, since: int = 0, limit: int = 4096) -> dict:
+        """Retained records with ``seq >= since`` (rotated generation
+        included), oldest first, capped at ``limit`` — the payload behind
+        ``GET /journal?since=`` on the metrics server."""
+        records = []
+        for path in (self.path + ".1", self.path):
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    for raw in fh:
+                        try:
+                            record = json.loads(raw)
+                        except ValueError:
+                            continue  # torn tail line of a live file
+                        if int(record.get("seq", -1)) >= since:
+                            records.append(record)
+            except OSError:
+                continue
+        records.sort(key=lambda r: r.get("seq", 0))
+        if limit and len(records) > limit:
+            records = records[-limit:]
+        nxt = records[-1]["seq"] + 1 if records else since
+        return {
+            "schema_version": JOURNAL_SCHEMA_VERSION,
+            "host": self.host,
+            "next": nxt,
+            "records": records,
+        }
+
+    # ------------------------------------------------------------- run close
+    def finalize_run(self, extra: dict | None = None) -> dict:
+        """Assemble and journal this run's ``run_summary`` record — the unit
+        ``accelerate-tpu report --compare`` classifies run-over-run. Cold
+        path: pulls the live timeline/goodput summaries (which may drain a
+        retained loss) plus the journal's own running aggregates."""
+        summary: dict = {"records": self.counts.copy()}
+        try:
+            from . import live_telemetry
+
+            telemetry = live_telemetry()
+        except Exception:
+            telemetry = None
+        if telemetry is not None:
+            try:
+                tl = telemetry.timeline.summary()
+                summary.update({
+                    "steps": tl.get("steps"),
+                    "dispatches": tl.get("dispatches"),
+                    "step_p50": tl["step_s"]["p50"],
+                    "step_p90": tl["step_s"]["p90"],
+                    "step_mean": tl["step_s"]["mean"],
+                    "step_max": tl["step_s"]["max"],
+                    "tokens_per_s": tl.get("tokens_per_s"),
+                    "mfu": tl.get("mfu_estimate"),
+                    "loss": tl.get("last_loss"),
+                })
+            except Exception:
+                pass
+        try:
+            from ..resilience.goodput import get_ledger
+
+            ledger = get_ledger().summary()
+            summary["goodput_fraction"] = ledger["goodput_fraction"]
+            summary["restarts"] = ledger["restarts"]
+            summary["wall_s"] = ledger["wall_s"]
+        except Exception:
+            pass
+        for name, (count, total, peak) in (("ttft", self._ttft),
+                                           ("tpot", self._tpot)):
+            if count:
+                summary[f"{name}_mean"] = round(total / count, 6)
+                summary[f"{name}_max"] = round(peak, 6)
+                summary[f"{name}_count"] = count
+        summary["breaches"] = self.counts.get("flight:slo_breach", 0)
+        summary["retries"] = max(self.counts.get("leg:retry", 0),
+                                 self.counts.get("flight:serving_retry", 0))
+        summary["evictions"] = sum(
+            n for key, n in self.counts.items()
+            if key.startswith("flight:") and
+            ("evict" in key or "preempt" in key)
+        )
+        if extra:
+            summary.update(extra)
+        record = self.emit("run_summary", **summary)
+        if record is None:
+            record = dict(summary, kind="run_summary", host=self.host)
+        return record
+
+    def close(self):
+        try:
+            self._file.close()
+        except Exception:
+            pass
+
+    # --------------------------------------------------------------- taps in
+    def _flight_tap(self, kind: str, step, data: dict):
+        """Mirror a flight-recorder event (installed via
+        ``flight.set_journal_tap``). ``step`` boundary events are skipped —
+        the telemetry hook journals a richer ``step`` record for the same
+        boundary (tokens/MFU), and double-writing the steady state would
+        halve retention for nothing."""
+        if kind == "step":
+            return
+        rid = data.get("rid")
+        payload = {k: v for k, v in data.items() if k != "rid"}
+        self.emit("flight", step=step, rid=rid, event=kind, **payload)
+
+
+def _resume_seq(path: str) -> int:
+    """Next seq for an existing journal file (0 for a fresh one): read the
+    last parseable line's seq from the file tail."""
+    try:
+        with open(path, "rb") as fh:
+            fh.seek(0, os.SEEK_END)
+            size = fh.tell()
+            fh.seek(max(0, size - 65536))
+            lines = fh.read().splitlines()
+        for raw in reversed(lines):
+            try:
+                return int(json.loads(raw)["seq"]) + 1
+            except Exception:
+                continue
+    except OSError:
+        pass
+    return 0
+
+
+# ------------------------------------------------------ process-wide default
+_JOURNAL: TelemetryJournal | None = None
+_RESOLVED = False
+_LOCK = threading.Lock()
+
+
+def get_journal() -> TelemetryJournal | None:
+    """The process-wide journal, created from ``ACCELERATE_JOURNAL_DIR`` on
+    first use; None when the env is unset/empty (journaling off — the
+    tri-state launch contract's disabled leg costs one global read)."""
+    global _JOURNAL, _RESOLVED
+    if _RESOLVED:
+        return _JOURNAL
+    with _LOCK:
+        if _RESOLVED:
+            return _JOURNAL
+        from ..utils.constants import ENV_JOURNAL_DIR
+
+        directory = os.environ.get(ENV_JOURNAL_DIR, "").strip()
+        if directory:
+            try:
+                journal = TelemetryJournal(directory)
+            except Exception:
+                journal = None
+            if journal is not None:
+                _install(journal)
+            _JOURNAL = journal
+        _RESOLVED = True
+    return _JOURNAL
+
+
+def set_journal(journal: TelemetryJournal | None):
+    """Install a specific journal instance (tests, custom clocks)."""
+    global _JOURNAL, _RESOLVED
+    _JOURNAL = journal
+    _RESOLVED = True
+    if journal is not None:
+        _install(journal)
+
+
+def reset_journal():
+    """Drop (and close) the process journal and unhook its taps — tests."""
+    global _JOURNAL, _RESOLVED
+    journal = _JOURNAL
+    _JOURNAL = None
+    _RESOLVED = False
+    if journal is not None:
+        journal.close()
+    try:
+        from .flight import set_journal_tap
+
+        set_journal_tap(None)
+    except Exception:
+        pass
+    try:
+        from .metrics import set_journal_provider
+
+        set_journal_provider(None)
+    except Exception:
+        pass
+
+
+def _install(journal: TelemetryJournal):
+    """Wire the journal into the streams that push to it: the flight
+    recorder's tee and the metrics server's ``GET /journal`` provider."""
+    try:
+        from .flight import set_journal_tap
+
+        set_journal_tap(journal._flight_tap)
+    except Exception:
+        pass
+    try:
+        from .metrics import set_journal_provider
+
+        set_journal_provider(journal.tail)
+    except Exception:
+        pass
+
+
+def journal_event(kind: str, step=None, rid=None, **data):
+    """Emit into the process journal IF journaling is armed — the cheap
+    spelling hot paths use (disabled cost: one global read)."""
+    journal = _JOURNAL if _RESOLVED else get_journal()
+    if journal is None:
+        return None
+    return journal.emit(kind, step=step, rid=rid, **data)
+
+
+# ------------------------------------------------------------ clock exchange
+def exchange_clock_sync(num_processes: int | None = None,
+                        process_index: int | None = None,
+                        timeout_ms: int = 60_000) -> dict[int, float]:
+    """Barrier-aligned wall-clock exchange: every rank stamps ``(monotonic,
+    wall)`` immediately after a coordination-KV barrier releases (so all
+    stamps are taken within the barrier's release jitter), all-gathers the
+    stamps, and journals the resulting skew map. Returns ``{rank: skew_s}``
+    — each rank's wall-clock delta versus rank 0, the correction
+    :mod:`.collect` subtracts when merging fleets. Single-process (no
+    distributed client): ``{0: 0.0}``."""
+    from ..utils.agreement import kv_all_gather
+    from ..utils.constants import ENV_NUM_PROCESSES, ENV_PROCESS_ID
+
+    if num_processes is None:
+        num_processes = int(os.environ.get(ENV_NUM_PROCESSES, "1") or 1)
+    if process_index is None:
+        process_index = int(os.environ.get(ENV_PROCESS_ID, "0") or 0)
+    call = next(_SYNC_COUNT)
+    journal = _JOURNAL if _RESOLVED else get_journal()
+    wall_clock = journal._wall if journal is not None else time.time
+    if num_processes > 1:
+        # Phase 1 aligns the ranks; the stamp is taken the instant the
+        # barrier releases, so phase 2 gathers near-simultaneous readings.
+        kv_all_gather("ready", num_processes, process_index,
+                      f"{CLOCK_NAMESPACE}/align{call}", timeout_ms=timeout_ms)
+    mono, wall = time.monotonic(), wall_clock()
+    stamps = kv_all_gather(
+        f"{mono:.9f},{wall:.9f}", num_processes, process_index,
+        f"{CLOCK_NAMESPACE}/stamp{call}", timeout_ms=timeout_ms,
+    )
+    offsets: dict[int, dict] = {}
+    for rank, value in enumerate(stamps):
+        m, w = (float(part) for part in str(value).split(","))
+        offsets[rank] = {"mono": m, "wall": w}
+    base = offsets.get(0, {"wall": wall})["wall"]
+    skew = {rank: round(off["wall"] - base, 6) for rank, off in offsets.items()}
+    journal_event(
+        "clock_sync",
+        offsets={str(rank): off for rank, off in offsets.items()},
+        skew={str(rank): s for rank, s in skew.items()},
+    )
+    return skew

@@ -368,6 +368,26 @@ def serving_provider():
     return _SERVING_PROVIDER
 
 
+# Telemetry-journal tail provider (telemetry/journal.py installs the armed
+# journal's ``tail`` here) — the same injected-hook pattern as the profile
+# trigger, so the collector (commands/timeline.py) can pull any live host's
+# journal over the HTTP server every worker already runs, without this
+# module importing the journal.
+_JOURNAL_PROVIDER = None
+
+
+def set_journal_provider(provider):
+    """``provider(since: int) -> dict`` (a ``TelemetryJournal.tail`` payload:
+    schema_version/host/next/records) serves GET /journal?since=N; None
+    uninstalls (503 until a journal is armed)."""
+    global _JOURNAL_PROVIDER
+    _JOURNAL_PROVIDER = provider
+
+
+def journal_provider():
+    return _JOURNAL_PROVIDER
+
+
 class _MetricsHandler(BaseHTTPRequestHandler):
     registry: MetricsRegistry = None
 
@@ -380,6 +400,31 @@ class _MetricsHandler(BaseHTTPRequestHandler):
             body, ctype = b"ok\n", "text/plain"
         elif path.startswith("/v1/"):
             self._serve_v1_get(path)
+            return
+        elif path == "/journal":
+            provider = _JOURNAL_PROVIDER
+            if provider is None:
+                self._respond_json(
+                    503,
+                    {"error": "no telemetry journal armed in this process "
+                              "(set ACCELERATE_JOURNAL_DIR / launch "
+                              "--journal_dir)"},
+                )
+                return
+            from urllib.parse import parse_qs, urlparse
+
+            try:
+                since = int(parse_qs(urlparse(self.path).query)
+                            .get("since", ["0"])[0])
+            except (ValueError, TypeError):
+                self._respond_json(
+                    400, {"error": "since must be an integer sequence number"}
+                )
+                return
+            try:
+                self._respond_json(200, provider(since))
+            except Exception as exc:  # a bad tail must not kill the server
+                self._respond_json(500, {"error": repr(exc)})
             return
         elif path in ("/fleet", "/fleet/metrics"):
             provider = _FLEET_PROVIDER

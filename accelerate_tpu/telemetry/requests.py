@@ -36,6 +36,8 @@ from collections import OrderedDict
 # Ring bound on retained request records (the serving engine's _SLO_HISTORY
 # idiom): a long-lived engine serves unbounded requests; the Prometheus
 # histograms keep the full distributions, the ring keeps the recent evidence.
+# Tunable per launch via ACCELERATE_TRACE_RING (tri-state; an explicit 0
+# scrubs an inherited value back to this default).
 DEFAULT_CAPACITY = 1024
 
 # Decode windows a TTFT-breach-armed capture traces.
@@ -53,11 +55,17 @@ class RequestTracer:
     ``slo`` is the engine's :class:`~..serving.SLOTargets` (None = no breach
     evaluation); ``arm_profile_on_breach`` lets a TTFT breach arm a trace
     capture through the installed profile trigger; ``clock`` is injectable
-    for deterministic tests.
+    for deterministic tests. ``capacity=None`` (the engine default) resolves
+    from ACCELERATE_TRACE_RING, falling back to :data:`DEFAULT_CAPACITY`.
     """
 
-    def __init__(self, capacity: int = DEFAULT_CAPACITY, slo=None,
+    def __init__(self, capacity: int | None = None, slo=None,
                  arm_profile_on_breach: bool = True, clock=time.monotonic):
+        if capacity is None:
+            from .flight import ring_capacity_from_env
+            from ..utils.constants import ENV_TRACE_RING
+
+            capacity = ring_capacity_from_env(ENV_TRACE_RING, DEFAULT_CAPACITY)
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         self.capacity = int(capacity)
@@ -71,6 +79,17 @@ class RequestTracer:
     # ------------------------------------------------------------- recording
     def _get(self, rid: int) -> dict | None:
         return self._ring.get(rid)
+
+    def _journal(self, record: dict, leg: str, **fields):
+        """Durable leg emission (telemetry/journal.py): each lifecycle point
+        also lands a ``request_leg`` record — rid + tier are the causal keys
+        the fleet-timeline collector joins cross-host legs on. Host
+        bookkeeping only (the fields are already on the record); a no-op
+        when journaling is off."""
+        from .journal import journal_event
+
+        journal_event("request_leg", rid=record["rid"], leg=leg,
+                      tier=record["tier"], **fields)
 
     def submit(self, rid: int, prompt_tokens: int, submit_t: float | None = None,
                tier: str = "unified"):
@@ -105,6 +124,7 @@ class RequestTracer:
         self.total += 1
         while len(self._ring) > self.capacity:
             self._ring.popitem(last=False)  # overwrite-oldest
+        self._journal(record, "submit", prompt_tokens=int(prompt_tokens))
 
     def admit(self, rid: int, decision: str = "admit", aliased_blocks: int = 0,
               chunks: int = 1):
@@ -128,6 +148,8 @@ class RequestTracer:
             "admission", rid=int(rid), decision=str(decision),
             queue_wait_s=record["queue_wait_s"],
         )
+        self._journal(record, "admit", decision=str(decision),
+                      queue_wait_s=record["queue_wait_s"])
 
     def defer(self, rid: int):
         """A prefill chunk deferred in favor of decode (TPOT pacing). Counted
@@ -151,6 +173,8 @@ class RequestTracer:
         record["chunks"].append(int(tokens))
         if final:
             record["state"] = "decode"
+        self._journal(record, "prefill_chunk", tokens=int(tokens),
+                      final=bool(final))
 
     def first_token(self, rid: int, at: float | None = None):
         """First sampled token observed for ``rid`` (the engine calls this
@@ -162,6 +186,7 @@ class RequestTracer:
             return
         now = float(at if at is not None else self._clock())
         record["ttft_s"] = round(max(0.0, now - record["submit_t"]), 6)
+        self._journal(record, "first_token", ttft_s=record["ttft_s"])
         target = getattr(self.slo, "ttft_s", None) if self.slo is not None else None
         if target is not None and record["ttft_s"] > target:
             record["breached"].append("ttft")
@@ -197,6 +222,8 @@ class RequestTracer:
                 from .slo import record_breach
 
                 record_breach("tpot", record["tpot_s"], target, rid=rid)
+        self._journal(record, "finish", tokens_out=int(tokens_out),
+                      tpot_s=record["tpot_s"], total_s=record["total_s"])
 
     def handoff(self, rid: int, direction: str, bytes: int = 0, blocks: int = 0,
                 endpoint: str | None = None):
@@ -226,6 +253,9 @@ class RequestTracer:
             "handoff", rid=int(rid), direction=str(direction),
             bytes=int(bytes), blocks=int(blocks),
         )
+        self._journal(record, "handoff", direction=str(direction),
+                      bytes=int(bytes), blocks=int(blocks),
+                      endpoint=endpoint)
 
     def retry(self, rid: int, attempt: int, reason: str,
               endpoint: str | None = None):
@@ -249,6 +279,8 @@ class RequestTracer:
         get_flight_recorder().record("serving_retry", rid=int(rid),
                                      attempt=int(attempt), reason=str(reason),
                                      endpoint=endpoint)
+        self._journal(record, "retry", attempt=int(attempt),
+                      reason=str(reason), endpoint=endpoint)
 
     def cancel(self, rid: int):
         """The request's engine state was wiped before it finished
@@ -256,6 +288,7 @@ class RequestTracer:
         record = self._get(rid)
         if record is not None and record["state"] not in ("finished", "cancelled"):
             record["state"] = "cancelled"
+            self._journal(record, "cancel")
 
     def _arm_profile(self, rid: int):
         """Arm a trace capture through the trigger the profiler installed on

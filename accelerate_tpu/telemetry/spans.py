@@ -130,3 +130,12 @@ def span(name: str, ring: SpanRing | None = None, record_metric: bool = True):
                 _span_hist().observe(duration, name=name)
             except Exception:  # pragma: no cover - instrumentation never raises
                 pass
+            # Durable tee (telemetry/journal.py): no-op when journaling is
+            # off; pure host bookkeeping (the record above) when on.
+            try:
+                from .journal import journal_event
+
+                journal_event("span", name=name, path=path,
+                              depth=len(stack), duration_s=round(duration, 6))
+            except Exception:  # pragma: no cover - instrumentation never raises
+                pass

@@ -186,6 +186,14 @@ class StepTimeline:
         and a model flop count are both known) — the SLO sentinel's MFU feed."""
         return self._last_mfu
 
+    @property
+    def last_loss(self) -> float | None:
+        """Most recently DRAINED loss (None until a retained device scalar
+        materialized and a ``summary()`` drained it) — a plain attribute
+        read, so hot-path consumers (the journal's step records) can carry a
+        loss without ever forcing a device fetch."""
+        return self._last_loss
+
     # ------------------------------------------------------------- recording
     def step_end(self, step: int | None = None, tokens: int | None = None,
                  loss=None, steps: int = 1) -> float | None:

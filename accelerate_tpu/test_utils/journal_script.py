@@ -1,0 +1,283 @@
+"""Fleet telemetry-journal drill, run under the real 3-process launcher::
+
+    AT_JOURNAL_SKEW=0,120,-45 accelerate-tpu launch --cpu --num_processes 3 \
+        --journal_dir <shared tmp> --trace_ring 512 --flight_ring 4096 \
+        -m accelerate_tpu.test_utils.journal_script
+
+Proves the tentpole property ``tests/test_journal.py`` pins: every rank
+journals its streams durably to the shared ``--journal_dir`` (the launch
+flag reaches every worker as ACCELERATE_JOURNAL_DIR — asserted in-script,
+like the ring sizes), the coordination-KV clock exchange recovers each
+rank's injected artificial wall skew, and ``accelerate-tpu timeline`` then
+merges the fleet into ONE valid Chrome-trace file where a retried request's
+router → prefill → handoff → decode legs are causally linked under its rid
+with the cross-host skew corrected (the whole request spans seconds in the
+corrected trace, not the ±minutes the injected skews would smear it across).
+
+Topology mirrors the chaos drill's phase B: rank 0 runs the prefill tier,
+the router, and the client; ranks 1 and 2 decode. Rank 0's first chain
+export is dropped on the wire (``req:0=handoff_drop``), so the drilled
+request carries a real ``handoff_failed`` retry leg plus a second, clean
+handoff. Rank 0 finishes by driving ``accelerate-tpu report``: clean
+self-compare exits 0, an injected regression exits 1.
+
+Each rank injects ``AT_JOURNAL_SKEW[rank]`` seconds into its journal's wall
+clock (the injectable-``wall_clock`` seam), so both the journal records AND
+the clock-exchange stamps are consistently skewed — exactly what a rig of
+hosts with drifted clocks produces.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import numpy as np
+
+from accelerate_tpu import PartialState
+from accelerate_tpu.telemetry import start_default_server
+from accelerate_tpu.telemetry.fleet import publish_metrics_endpoint
+from accelerate_tpu.telemetry.journal import (
+    TelemetryJournal,
+    exchange_clock_sync,
+    set_journal,
+)
+from accelerate_tpu.utils.agreement import kv_all_gather
+from accelerate_tpu.utils.constants import (
+    ENV_FLIGHT_RING,
+    ENV_JOURNAL_DIR,
+    ENV_TRACE_RING,
+)
+
+from .disagg_script import MAX_NEW, _engine, _generate, _model
+
+PROMPT_LEN = 21  # > chunk: prefill entry + handoff to a decode tier
+
+
+def _injected_skews(num_processes: int) -> list[float]:
+    raw = os.environ.get("AT_JOURNAL_SKEW", "")
+    if not raw:
+        return [0.0] * num_processes
+    skews = [float(part) for part in raw.split(",")]
+    assert len(skews) == num_processes, (skews, num_processes)
+    return skews
+
+
+def _assert_env_contract(journal_dir: str):
+    """The launch flags must have reached this worker's env (tri-state
+    export leg) and the ring constructors must resolve them."""
+    from accelerate_tpu.telemetry.flight import (
+        get_flight_recorder,
+        ring_capacity_from_env,
+    )
+    from accelerate_tpu.telemetry.requests import RequestTracer
+
+    assert os.environ.get(ENV_JOURNAL_DIR) == journal_dir, (
+        os.environ.get(ENV_JOURNAL_DIR), journal_dir)
+    assert os.environ.get(ENV_TRACE_RING) == "512", os.environ.get(ENV_TRACE_RING)
+    assert os.environ.get(ENV_FLIGHT_RING) == "4096", os.environ.get(ENV_FLIGHT_RING)
+    assert ring_capacity_from_env(ENV_TRACE_RING, 1024) == 512
+    assert RequestTracer().capacity == 512
+    assert get_flight_recorder().capacity == 4096
+
+
+def _assert_timeline(journal_dir: str, rid: int, skews: list[float]):
+    """Rank 0: drive the real CLI over the shared journals and assert the
+    merged trace is valid, causally linked, and skew-corrected."""
+    out = os.path.join(journal_dir, "trace.json")
+    proc = subprocess.run(
+        [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli",
+         "timeline", "--journal-dir", journal_dir, "--out", out],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout[-800:] + proc.stderr[-800:]
+    with open(out, encoding="utf-8") as fh:
+        trace = json.load(fh)
+    events = trace["traceEvents"]
+    assert events, "empty merged trace"
+
+    # The recovered skew map matches the injected per-rank deltas (barrier
+    # release jitter is the tolerance).
+    recovered = {int(h): float(s) for h, s in trace["otherData"]["skew"].items()}
+    for rank, injected in enumerate(skews):
+        assert rank in recovered, recovered
+        assert abs(recovered[rank] - injected) < 2.0, (recovered, skews)
+
+    # One rid, every tier, causally linked: request legs from all three
+    # tiers (incl. the handoff_failed retry and the handoff itself) under
+    # the drilled rid, with flow arrows spanning more than one host pid.
+    legs = [e for e in events if e.get("ph") == "X"
+            and e.get("cat") == "request" and e.get("args", {}).get("rid") == rid]
+    tiers = {e["name"].split(":")[0] for e in legs}
+    assert {"router", "prefill", "decode"} <= tiers, tiers
+    leg_names = {e["name"].split(":")[1] for e in legs}
+    assert "retry" in leg_names and "handoff" in leg_names, leg_names
+    retry = next(e for e in legs if e["name"].endswith(":retry"))
+    assert retry["args"].get("reason") == "handoff_failed", retry
+    flows = [e for e in events if e.get("ph") in ("s", "t", "f")
+             and e.get("id") == rid]
+    assert {e["ph"] for e in flows} >= {"s", "f"}, flows
+    assert len({e["pid"] for e in flows}) >= 2, (
+        f"rid {rid} flow never crossed hosts: {flows}")
+
+    # Skew actually corrected: the request's corrected legs span seconds;
+    # uncorrected, the injected skews would smear them across minutes.
+    span_s = (max(e["ts"] for e in legs) - min(e["ts"] for e in legs)) / 1e6
+    smear = max(skews) - min(skews)
+    assert span_s < min(60.0, smear / 2), (
+        f"rid legs span {span_s:.1f}s — skew not corrected (injected "
+        f"smear {smear:.0f}s)")
+
+    # --rid filtering keeps exactly that request's lanes.
+    out_rid = os.path.join(journal_dir, "trace_rid.json")
+    proc = subprocess.run(
+        [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli",
+         "timeline", "--journal-dir", journal_dir, "--out", out_rid,
+         "--rid", str(rid)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout[-800:] + proc.stderr[-800:]
+    with open(out_rid, encoding="utf-8") as fh:
+        filtered = json.load(fh)["traceEvents"]
+    kept = [e for e in filtered if e.get("ph") == "X"]
+    assert kept and all(e.get("args", {}).get("rid") == rid for e in kept), kept
+    print("JOURNAL_TIMELINE_OK")
+
+
+def _assert_report(journal_dir: str):
+    """Rank 0: `report` round trip — clean self-compare exits 0, an
+    injected regression exits 1."""
+    summary_path = os.path.join(journal_dir, "summary.json")
+    proc = subprocess.run(
+        [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli",
+         "report", "--journal", journal_dir, "--out", summary_path],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout[-800:] + proc.stderr[-800:]
+    clean = subprocess.run(
+        [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli",
+         "report", "--journal", journal_dir, "--compare", summary_path],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert clean.returncode == 0, clean.stdout[-800:] + clean.stderr[-800:]
+    assert "no regressions" in clean.stdout, clean.stdout
+
+    with open(summary_path, encoding="utf-8") as fh:
+        summary = json.load(fh)
+    assert summary.get("retries", 0) >= 1, summary  # the dropped handoff
+    assert summary.get("ttft_mean") is not None, summary
+    doctored = dict(summary)
+    doctored["ttft_mean"] = summary["ttft_mean"] / 4  # "previous run was 4x faster"
+    doctored["retries"] = 0
+    prev_path = os.path.join(journal_dir, "prev.json")
+    with open(prev_path, "w", encoding="utf-8") as fh:
+        json.dump(doctored, fh)
+    regressed = subprocess.run(
+        [sys.executable, "-m", "accelerate_tpu.commands.accelerate_cli",
+         "report", "--journal", journal_dir, "--compare", prev_path],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert regressed.returncode == 1, (
+        regressed.returncode, regressed.stdout[-800:])
+    assert "REGRESSION" in regressed.stderr, regressed.stderr
+    print("JOURNAL_REPORT_OK")
+
+
+def main():
+    state = PartialState()
+    assert state.num_processes >= 3, "run under `launch --num_processes 3`"
+    rank = state.process_index
+    role = "prefill" if rank == 0 else "decode"
+    journal_dir = os.environ.get(ENV_JOURNAL_DIR, "")
+    assert journal_dir, f"{ENV_JOURNAL_DIR} must reach the workers"
+    skews = _injected_skews(state.num_processes)
+    _assert_env_contract(journal_dir)
+
+    # This rank's journal on a deliberately skewed wall clock — records and
+    # clock-exchange stamps both read it, like a host with a drifted clock.
+    my_skew = skews[rank]
+    journal = TelemetryJournal(journal_dir, process_index=rank,
+                               wall_clock=lambda: time.time() + my_skew)
+    set_journal(journal)
+    skew_map = exchange_clock_sync(state.num_processes, rank)
+    assert abs(skew_map[rank] - (my_skew - skews[0])) < 2.0, (skew_map, skews)
+
+    from accelerate_tpu.resilience.faults import FaultPlan, set_active_plan
+    from accelerate_tpu.serving_net import Router, ServingFrontend
+    from accelerate_tpu.telemetry.fleet import _kv_client
+
+    model = _model()
+    server = start_default_server(0)
+    endpoint = publish_metrics_endpoint(process_index=rank, server=server)
+    assert endpoint is not None, "metrics endpoint registration failed"
+    engine = _engine(model)
+    frontend = ServingFrontend(engine, role=role)
+    if rank == 0:
+        # Drop this rank's first chain export on the wire: the drilled
+        # request must re-enter and carry a real handoff_failed retry leg.
+        set_active_plan(FaultPlan.parse("req:0=handoff_drop"))
+    frontend.install(process_index=rank, endpoint=endpoint)
+
+    kv_all_gather("ready", state.num_processes, rank,
+                  namespace="at_journal_drill/ready")
+    client = _kv_client()
+
+    if rank == 0:
+        from accelerate_tpu.telemetry.collect import read_journal_dir
+        from accelerate_tpu.telemetry.metrics import MetricsServer
+
+        router_server = MetricsServer(0, host="127.0.0.1")
+        router_port = router_server.start()
+        router = Router(num_processes=state.num_processes)
+        router_server.set_serving(router)
+
+        rng = np.random.default_rng(23)
+        prompt = rng.integers(1, 256, (PROMPT_LEN,)).astype(np.int32)
+        result = _generate(f"127.0.0.1:{router_port}", prompt)
+        set_active_plan(None)
+        assert len(result["tokens"]) == MAX_NEW, result["tokens"]
+        rid = result["done"]["trace"][0]["rid"]
+
+        # This rank's own journal over the metrics server's tail route.
+        with urllib.request.urlopen(
+                f"http://{endpoint}/journal?since=0", timeout=10.0) as resp:
+            tail = json.loads(resp.read())
+        assert tail["records"] and tail["host"] == 0, tail
+        with urllib.request.urlopen(
+                f"http://{endpoint}/journal?since={tail['next']}",
+                timeout=10.0) as resp:
+            empty = json.loads(resp.read())
+        assert empty["records"] == [], empty
+
+        # Every tier journals its legs as they happen (flushed per record);
+        # wait for the decode tier's finish leg to land on the shared dir.
+        deadline = time.monotonic() + 90.0
+        while time.monotonic() < deadline:
+            merged = [r for records in read_journal_dir(journal_dir).values()
+                      for r in records
+                      if r.get("kind") == "request_leg" and r.get("rid") == rid]
+            if any(r.get("leg") == "finish" for r in merged):
+                break
+            time.sleep(0.25)
+        else:
+            raise AssertionError(f"rid {rid} finish leg never journaled")
+
+        journal.finalize_run(extra={"fingerprint": "journal-drill"})
+        _assert_timeline(journal_dir, rid, skews)
+        _assert_report(journal_dir)
+
+        client.key_value_set("at_journal_drill/done", "1")
+        router_server.stop()
+    else:
+        client.blocking_key_value_get("at_journal_drill/done", 480_000)
+
+    frontend.uninstall()
+    print(f"JOURNAL_OK rank={rank} role={role} skew={my_skew}")
+
+
+if __name__ == "__main__":
+    main()

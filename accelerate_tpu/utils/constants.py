@@ -113,6 +113,19 @@ ENV_ROUTER_ENDPOINT = "ACCELERATE_ROUTER_ENDPOINT"
 ENV_SERVING_RETRY_BUDGET = "ACCELERATE_SERVING_RETRY_BUDGET"
 ENV_SERVING_LEASE_TTL = "ACCELERATE_SERVING_LEASE_TTL"
 ENV_DRAIN_GRACE_S = "ACCELERATE_DRAIN_GRACE_S"
+# Durable telemetry journal (telemetry/journal.py; docs/observability.md
+# "Telemetry journal & fleet timeline"): a directory arms the append-only
+# per-host JSONL journal that sinks every stream the process already pays for
+# (step boundaries, spans, flight events, request legs, SLO breaches, goodput
+# deltas) — SIGKILL-durable per the JSONTracker flush-per-record precedent,
+# bounded by size rotation. Tri-state like profile_steps: unset = journaling
+# off (zero cost), a path = on, an explicit '' scrubs an inherited value.
+# The ring-size knobs tune the in-memory RequestTracer / FlightRecorder
+# retention (tri-state per the SLO precedent — an explicit 0 scrubs an
+# inherited value back to the library defaults of 1024 / 2048 events).
+ENV_JOURNAL_DIR = "ACCELERATE_JOURNAL_DIR"
+ENV_TRACE_RING = "ACCELERATE_TRACE_RING"
+ENV_FLIGHT_RING = "ACCELERATE_FLIGHT_RING"
 # Dispatch amortization (docs/performance.md "Dispatch amortization"): the
 # default K for Accelerator.build_train_window (1 = one dispatch per step),
 # and the curated XLA latency-hiding flag preset installed into

@@ -92,7 +92,14 @@ import numpy as np
 # the recovered request paid under fault, the router's retry/eviction
 # rollups, and the bit-identical-output verdict clean vs faulted. Absent
 # otherwise; composes with the other serving levers under detail.serving.
-BENCH_SCHEMA_VERSION = 13
+# v14 = durable telemetry journal (telemetry/journal.py): when
+# ACCELERATE_JOURNAL_DIR is armed the run finalizes a run_summary record
+# (step-time quantiles, MFU, goodput fraction, TTFT/TPOT, breach/retry
+# counts, fingerprint hash — `accelerate-tpu report` compares runs from it)
+# and stamps detail.journal with the journal directory + per-kind record
+# counts, so a bench row is joinable to its full causal timeline
+# (`accelerate-tpu timeline`). Absent when journaling is off.
+BENCH_SCHEMA_VERSION = 14
 
 
 class BenchAuditFailure(RuntimeError):
@@ -731,6 +738,28 @@ def run_one(mode: str):
         serving_summary = dict(serving_summary or {})
         serving_summary["chaos"] = chaos_summary
 
+    # Durable journal (schema v14): when ACCELERATE_JOURNAL_DIR armed a
+    # journal, finalize this run's run_summary record (fingerprint hash
+    # joined in so `accelerate-tpu report` can flag identity changes) and
+    # point the row at the journal for `accelerate-tpu timeline`.
+    journal_summary = None
+    try:
+        from accelerate_tpu.telemetry.journal import get_journal
+
+        _journal = get_journal()
+        if _journal is not None:
+            _journal.finalize_run(
+                extra={"fingerprint": fingerprint_summary["hash"],
+                       "config": f"bench_{mode}"}
+            )
+            journal_summary = {
+                "dir": _journal.directory,
+                "path": _journal.path,
+                "records": dict(_journal.counts),
+            }
+    except Exception:  # the journal must never take the row down
+        journal_summary = None
+
     print(
         json.dumps(
             {
@@ -793,6 +822,7 @@ def run_one(mode: str):
                     "audit": audit_summary,
                     "memory": memory_summary,
                     "fingerprint": fingerprint_summary,
+                    **({"journal": journal_summary} if journal_summary else {}),
                     **({"serving": serving_summary} if serving_summary else {}),
                     # Profiling (telemetry/profiler.py): present only when a
                     # trace capture engaged during this config — the capture

@@ -82,10 +82,12 @@ def _reset_forensics():
     yield
     from accelerate_tpu.telemetry.fleet import reset_fleet
     from accelerate_tpu.telemetry.flight import reset_flight_recorder
+    from accelerate_tpu.telemetry.journal import reset_journal
     from accelerate_tpu.telemetry.profiler import reset_profile_manager
     from accelerate_tpu.telemetry.traceview import attach_collective_axes
 
     reset_profile_manager()
+    reset_journal()  # closes the file + uninstalls the flight/metrics taps
     reset_flight_recorder()
     reset_fleet()  # endpoint registry + /fleet provider are process-wide
     attach_collective_axes(None)  # Accelerator.audit attaches a module global

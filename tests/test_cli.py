@@ -293,6 +293,9 @@ def test_config_wizard_roundtrips_through_launch(tmp_path):
         "0.3",               # SLO target: per-step wall time (s)
         "0.5",               # SLO target: serving TTFT (s)
         "0",                 # SLO target: serving TPOT (0 = no target)
+        str(tmp_path / "journal"),  # durable telemetry journal directory
+        "512",               # request-trace ring capacity
+        "4096",              # flight-recorder ring size
         "yes",               # configure disaggregated serving tiers?
         "prefill",           # serving role for the launched workers
         "127.0.0.1:9876",    # router endpoint
@@ -324,6 +327,8 @@ def test_config_wizard_roundtrips_through_launch(tmp_path):
     assert cfg.profile_steps == "10-12" and cfg.profile_slow_zscore == 5.5
     assert cfg.fleet_metrics is False  # explicit decline, not unspecified
     assert cfg.slo_step_time == 0.3 and cfg.slo_ttft == 0.5 and cfg.slo_tpot == 0.0
+    assert cfg.journal_dir == str(tmp_path / "journal")
+    assert cfg.trace_ring == 512 and cfg.flight_ring == 4096
     assert cfg.serving_role == "prefill"
     assert cfg.router_endpoint == "127.0.0.1:9876"
     assert cfg.serving_retry_budget == 3.0
