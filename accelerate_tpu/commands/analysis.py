@@ -318,6 +318,22 @@ def memcheck_command_parser(subparsers=None) -> argparse.ArgumentParser:
              "multiple the future Pallas kernel wants)",
     )
     parser.add_argument(
+        "--serving-kv-quant", choices=("none", "int8"), default="none",
+        help="Serving mode: price the pool at this storage dtype. int8 "
+             "stores blocks quantized with per-token f32 scales (k_scale/"
+             "v_scale ride the kv_pool class), roughly doubling tokens per "
+             "HBM byte — the audit prices blocks AND scales, so the budget "
+             "gate covers the real layout, not the naive blocks/2 estimate.",
+    )
+    parser.add_argument(
+        "--serving-spec-k", type=int, default=0,
+        help="Serving mode: audit with speculative decoding at this draft "
+             "depth. Prices the draft model's weights and its mirror KV "
+             "pool (the draft_params/draft_pool classes of the verify "
+             "program) — residency a spec-decode launch pays on top of the "
+             "target's, and the OOM-before-launch gate must see it.",
+    )
+    parser.add_argument(
         "--serving-role", choices=("unified", "prefill", "decode"),
         default="unified",
         help="Serving mode: size the pool for this disaggregated tier "
@@ -349,14 +365,18 @@ def memcheck_command_parser(subparsers=None) -> argparse.ArgumentParser:
 
 
 def _build_serving_artifact(slots: int, blocks: int, block_size: int,
-                            role: str = "unified"):
+                            role: str = "unified", kv_quant: str | None = None,
+                            speculative_k: int = 0):
     """The serving analog of ``_build_tiny_artifact``: a tiny paged
     ContinuousBatcher whose compiled decode window is the audited program.
     Returns ``(engine, built, args)`` — the pool rides the program's
     ``_audit_meta.memory_classes`` join as the ``kv_pool`` class. A
     ``prefill`` role audits the chunked-prefill program instead: that is
     the ONLY program a disaggregated prefill host compiles, so its peak
-    deliberately excludes the decode window's lookahead buffers."""
+    deliberately excludes the decode window's lookahead buffers. With
+    ``speculative_k`` the audited decode program is the verify window —
+    the one that holds target pool + draft pool + both param sets live —
+    so the gate prices the draft model's full residency."""
     import jax
 
     from ..models import Llama, LlamaConfig
@@ -368,10 +388,13 @@ def _build_serving_artifact(slots: int, blocks: int, block_size: int,
         model, batch_slots=slots, max_new_tokens=32,
         max_cache_len=blocks * block_size, bucket_sizes=(16, 32, 64),
         sync_every=4, paged=True, block_size=block_size, num_blocks=blocks,
+        kv_quant=kv_quant, speculative_k=speculative_k,
     )
     if role == "prefill":
         P = engine.prefill_chunk
         return engine, engine._chunk_fn(P), engine._chunk_args(P)
+    if speculative_k:
+        return engine, engine._spec_verify(), engine._verify_args()
     return engine, engine._decode(), engine._decode_args()
 
 
@@ -391,9 +414,12 @@ def memcheck_command(args) -> None:
         from ..analysis.memory import memory_report_from_built
 
         role = getattr(args, "serving_role", "unified")
+        kv_quant = getattr(args, "serving_kv_quant", "none")
+        spec_k = getattr(args, "serving_spec_k", 0)
         engine, built, built_args = _build_serving_artifact(
             args.serving_slots, args.serving_blocks, args.serving_block_size,
-            role=role,
+            role=role, kv_quant=None if kv_quant == "none" else kv_quant,
+            speculative_k=spec_k,
         )
         report = memory_report_from_built(built, *built_args, budget_bytes=budget)
         failures = []
@@ -401,7 +427,8 @@ def memcheck_command(args) -> None:
             report.classes["kv_pool"].per_device_bytes
             if "kv_pool" in report.classes else 0
         )
-        program = "chunked-prefill" if role == "prefill" else "decode-window"
+        program = "chunked-prefill" if role == "prefill" else (
+            "verify-window" if spec_k else "decode-window")
         if not report.fits:
             failures.append(
                 f"predicted serving OOM: {program} peak "
@@ -413,6 +440,17 @@ def memcheck_command(args) -> None:
         payload["kv_pool_bytes_per_device"] = pool_bytes
         payload["pool"] = engine.pool_stats()
         payload["serving_role"] = role
+        if spec_k:
+            # Draft residency the spec launch pays on top of the target's —
+            # priced from the verify program's memory classes, not estimated.
+            payload["draft_pool_bytes_per_device"] = (
+                report.classes["draft_pool"].per_device_bytes
+                if "draft_pool" in report.classes else 0
+            )
+            payload["draft_params_bytes_per_device"] = (
+                report.classes["draft_params"].per_device_bytes
+                if "draft_params" in report.classes else 0
+            )
         if role == "decode":
             # Import headroom: a decode tier refuses a chain import
             # (serving_net/handoff.py) when the free list cannot cover the

@@ -234,6 +234,28 @@ def get_user_input() -> ClusterConfig:
             "  SIGTERM drain grace in seconds "
             "(0 = library default 30)", 0.0, float,
         )
+    # Serving decode-speed levers (serving.py): declining leaves all three
+    # UNSPECIFIED so inherited ACCELERATE_SPECULATIVE_K / DRAFT_MODEL /
+    # KV_QUANT flow through at launch; answering — even with the defaults
+    # 0/''/'off' — is an explicit choice that scrubs stale values.
+    speculative_k, draft_model, kv_quant = None, None, None
+    if _yesno(
+        "Do you want to configure serving decode-speed levers (speculative "
+        "decoding, int8 KV-cache quantization)?", False,
+    ):
+        speculative_k = _ask(
+            "  speculative draft depth k (draft tokens verified per window; "
+            "0 = off)", 0, int,
+        )
+        draft_model = _ask(
+            "  draft model preset (LlamaConfig classmethod, e.g. tiny; "
+            "'' = engine default)", "",
+        )
+        kv_quant = _ask(
+            "  KV-cache pool quantization (off = full precision; int8 = "
+            "~2x tokens per HBM byte, dequant in the paged kernels)",
+            "off", str, ["off", "int8"],
+        )
     # Tri-state like the health section: declining leaves both UNSPECIFIED
     # (None / '') so an inherited ACCELERATE_TRAIN_WINDOW/XLA_PRESET still
     # flows through at launch; answering — even with the defaults 1/'off' —
@@ -332,6 +354,9 @@ def get_user_input() -> ClusterConfig:
         journal_dir=journal_dir,
         trace_ring=trace_ring,
         flight_ring=flight_ring,
+        speculative_k=speculative_k,
+        draft_model=draft_model,
+        kv_quant=kv_quant,
         serving_role=serving_role,
         router_endpoint=router_endpoint,
         serving_retry_budget=serving_retry_budget,

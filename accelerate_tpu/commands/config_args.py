@@ -157,6 +157,17 @@ class ClusterConfig:
     serving_retry_budget: float | None = None
     serving_lease_ttl: float | None = None
     drain_grace_s: float | None = None
+    # Serving decode-speed levers (serving.py; docs/serving.md "Speculative
+    # decoding" / "Quantized KV cache"). ``speculative_k`` is TRI-state per
+    # the tune_budget precedent (None = unspecified, > 0 exported as
+    # ACCELERATE_SPECULATIVE_K, an explicit 0 scrubs — speculation off);
+    # ``draft_model`` names the LlamaConfig preset the engine builds the
+    # draft from (None = unspecified, '' scrubs; ACCELERATE_DRAFT_MODEL);
+    # ``kv_quant`` is the pool storage dtype ('int8'; None = unspecified,
+    # an explicit 'off'/'none' scrubs; ACCELERATE_KV_QUANT).
+    speculative_k: int | None = None
+    draft_model: str | None = None
+    kv_quant: str | None = None
     # Durable telemetry journal (telemetry/journal.py; docs/observability.md
     # "Telemetry journal & fleet timeline"). ``journal_dir`` is TRI-state per
     # the router_endpoint precedent: None = unspecified (inherited

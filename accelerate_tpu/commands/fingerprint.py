@@ -56,10 +56,17 @@ _TRAIN_CONFIGS = {
 # Configs extracted with the Pallas kernel layer pinned to interpret mode
 # (byte-stable on the CPU fingerprint rig; the compiled-Mosaic program is a
 # TPU-rig artifact the CPU goldens deliberately do not cover).
-_KERNEL_CONFIGS = ("step_zero_kernel", "decode_paged_kernel")
+# `decode_paged_int8` pins the dequant-in-DMA gather inventory: a silently
+# vanished dequant kernel classifies as a violation, not silence.
+# `spec_verify` pins the speculative verify program — draft scan + one
+# multi-token target forward + block-table truncation commit — whose
+# donation contract (pool, draft pool, state) is the rejection-surgery seam.
+_KERNEL_CONFIGS = ("step_zero_kernel", "decode_paged_kernel",
+                   "decode_paged_int8", "spec_verify")
 
 CONFIG_NAMES = tuple(_TRAIN_CONFIGS) + ("decode", "decode_paged",
-                                        "decode_paged_kernel", "prefill_paged")
+                                        "decode_paged_kernel", "prefill_paged",
+                                        "decode_paged_int8", "spec_verify")
 
 
 def _reset_singletons():
@@ -133,7 +140,8 @@ def _decode_fingerprint(name: str = "decode"):
     model = Llama(cfg)
     model.init_params(jax.random.key(0))
     kwargs = {}
-    if name in ("decode_paged", "decode_paged_kernel", "prefill_paged"):
+    if name in ("decode_paged", "decode_paged_kernel", "prefill_paged",
+                "decode_paged_int8", "spec_verify"):
         # The paged decode window: its committed golden pins the block-table
         # gather inventory and the pool+state donation contract, so the
         # ROADMAP item 3 kernel swap (or any regression in the gather
@@ -141,6 +149,14 @@ def _decode_fingerprint(name: str = "decode"):
         # `_kernel` variant runs the Pallas chain-walk assembly
         # (op `paged_gather`) and pins its pallas_call inventory instead.
         kwargs = dict(paged=True, block_size=4)
+    if name == "decode_paged_int8":
+        # int8 KV pool: the golden pins the dequant-in-DMA gather kernel
+        # (`paged_gather_dequant_kernel`) plus the per-block scale plumbing.
+        kwargs["kv_quant"] = "int8"
+    if name == "spec_verify":
+        # Draft == target keeps the golden self-contained (no preset drift);
+        # the program contract is draft-independent.
+        kwargs.update(speculative_k=2, draft_model=model)
     engine = ContinuousBatcher(
         model, batch_slots=2, max_new_tokens=4, max_cache_len=64,
         bucket_sizes=(8,), sync_every=2, **kwargs,
@@ -152,6 +168,8 @@ def _decode_fingerprint(name: str = "decode"):
             # contract — chunked prefill writing the paged pool through the
             # block table, first-token sampling — needs its own golden.
             return engine.fingerprint_prefill(config=name)
+        if name == "spec_verify":
+            return engine.fingerprint_verify(config=name)
         return engine.fingerprint_decode(config=name)
     finally:
         _reset_singletons()
@@ -175,7 +193,7 @@ def extract_config(name: str):
         os.environ.pop(ENV_KERNELS, None)
     try:
         if name in ("decode", "decode_paged", "decode_paged_kernel",
-                    "prefill_paged"):
+                    "prefill_paged", "decode_paged_int8", "spec_verify"):
             return _decode_fingerprint(name)
         if name not in _TRAIN_CONFIGS:
             raise SystemExit(
@@ -319,6 +337,16 @@ def fingerprint_command(args) -> None:
                 print(f"{name}: chunked-prefill program of a prefill-only "
                       "serving tier (paged pool writes through the block "
                       "table + first-token sampling; no decode window)")
+                continue
+            if name == "decode_paged_int8":
+                print(f"{name}: paged decode window over an int8-quantized "
+                      "KV pool with the dequant-in-DMA gather kernel "
+                      "engaged (ACCELERATE_KERNELS=interpret)")
+                continue
+            if name == "spec_verify":
+                print(f"{name}: speculative verify program — k-draft scan + "
+                      "one multi-token target forward + block-table "
+                      "truncation commit (ACCELERATE_KERNELS=interpret)")
                 continue
             if name == "step_zero_kernel":
                 print(f"{name}: window=1 optimizer=adamw zero=on mesh=dp8 "

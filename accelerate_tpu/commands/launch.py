@@ -54,6 +54,9 @@ from ..utils.constants import (
     ENV_SLO_STEP_TIME,
     ENV_SLO_TPOT,
     ENV_SLO_TTFT,
+    ENV_SPECULATIVE_K,
+    ENV_DRAFT_MODEL,
+    ENV_KV_QUANT,
     ENV_SPIKE_ZSCORE,
     ENV_STRAGGLER_THRESHOLD,
     ENV_TELEMETRY,
@@ -249,6 +252,33 @@ def launch_command_parser(subparsers=None) -> argparse.ArgumentParser:
              "to the default.",
     )
     parser.add_argument(
+        "--speculative_k", type=int, default=None,
+        help="Speculative decoding draft depth for the paged serving engine "
+             "(ACCELERATE_SPECULATIVE_K; docs/serving.md 'Speculative "
+             "decoding'): a draft model proposes k tokens per slot and the "
+             "target verifies the whole window in one paged forward — greedy "
+             "outputs stay bit-identical to non-speculative decode. "
+             "Tri-state: unset inherits, an explicit 0 scrubs an inherited "
+             "value (speculation off).",
+    )
+    parser.add_argument(
+        "--draft_model", default=None,
+        help="Draft model preset for speculative decoding "
+             "(ACCELERATE_DRAFT_MODEL): a LlamaConfig classmethod name, e.g. "
+             "'tiny' (the default when --speculative_k is set). The engine "
+             "builds the draft at the target's vocab. Tri-state: unset "
+             "inherits, '' scrubs an inherited value.",
+    )
+    parser.add_argument(
+        "--kv_quant", default=None,
+        help="KV-cache pool storage quantization for the paged serving "
+             "engine (ACCELERATE_KV_QUANT; docs/serving.md 'Quantized KV "
+             "cache'): 'int8' stores pool blocks int8 with per-token scales "
+             "(~2x tokens per HBM byte; dequantized in the paged kernels' "
+             "DMA step). Tri-state: unset inherits, an explicit 'off'/'none' "
+             "scrubs an inherited value (full-precision pool).",
+    )
+    parser.add_argument(
         "--journal_dir", default=None,
         help="Durable telemetry journal directory (ACCELERATE_JOURNAL_DIR; "
              "docs/observability.md 'Telemetry journal'): each worker "
@@ -402,6 +432,9 @@ def _merge_config(args) -> ClusterConfig:
         ("serving_retry_budget", "serving_retry_budget"),
         ("serving_lease_ttl", "serving_lease_ttl"),
         ("drain_grace_s", "drain_grace_s"),
+        ("speculative_k", "speculative_k"),
+        ("draft_model", "draft_model"),
+        ("kv_quant", "kv_quant"),
         ("journal_dir", "journal_dir"),
         ("trace_ring", "trace_ring"),
         ("flight_ring", "flight_ring"),
@@ -521,6 +554,21 @@ def prepare_launch_env(cfg: ClusterConfig, process_id: int | None = None, attemp
             env[env_name] = str(value)
         elif value is not None:
             env.pop(env_name, None)
+    # Speculative decoding + KV quantization (serving.py decode-speed
+    # levers): tri-state per the SLO precedent — an explicit 0 / 'off'
+    # scrubs a stale inherited value instead of forwarding it.
+    if cfg.speculative_k and cfg.speculative_k > 0:
+        env[ENV_SPECULATIVE_K] = str(int(cfg.speculative_k))
+    elif cfg.speculative_k is not None:
+        env.pop(ENV_SPECULATIVE_K, None)
+    if cfg.draft_model and cfg.draft_model.strip():
+        env[ENV_DRAFT_MODEL] = cfg.draft_model.strip()
+    elif cfg.draft_model is not None:
+        env.pop(ENV_DRAFT_MODEL, None)
+    if cfg.kv_quant and cfg.kv_quant.strip().lower() not in ("off", "none"):
+        env[ENV_KV_QUANT] = cfg.kv_quant.strip().lower()
+    elif cfg.kv_quant is not None:
+        env.pop(ENV_KV_QUANT, None)
     # Telemetry journal (telemetry/journal.py): tri-state per the
     # router_endpoint precedent — a path arms per-rank journaling, an
     # explicit '' scrubs a stale inherited directory (journaling off).
@@ -745,6 +793,16 @@ def launch_command(args) -> None:
             raise ValueError(
                 f"{name} must be >= 0 entries (0 = library default), got {value}"
             )
+    if cfg.speculative_k is not None and cfg.speculative_k < 0:
+        raise ValueError(
+            f"--speculative_k must be >= 0 draft tokens (0 = off), got "
+            f"{cfg.speculative_k}"
+        )
+    if cfg.kv_quant and cfg.kv_quant.strip().lower() not in ("int8", "off",
+                                                             "none"):
+        raise ValueError(
+            f"--kv_quant must be int8 or off/none, got {cfg.kv_quant!r}"
+        )
     from ..telemetry import metrics_port_from_env
 
     # An inherited ACCELERATE_METRICS_PORT of "0" means "no endpoint"

@@ -58,6 +58,8 @@ _SUMMARY_ORDER = (
     "steps", "wall_s", "step_p50", "step_p90", "step_mean", "step_max",
     "tokens_per_s", "mfu", "loss", "goodput_fraction", "restarts",
     "ttft_mean", "ttft_max", "ttft_count", "tpot_mean", "tpot_max",
+    "spec_proposed_tokens", "spec_accepted_tokens", "spec_acceptance_rate",
+    "accepted_tokens_per_s",
     "breaches", "retries", "evictions", "fingerprint",
 )
 

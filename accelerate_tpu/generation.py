@@ -655,6 +655,39 @@ def _unwrap(model):
     return model, getattr(model, "params", None)
 
 
+def _precision_variant(module, precision: str):
+    """A shallow config variant of ``module`` with ``matmul_precision`` set —
+    the serving-side int8 weight-quantization switch (ops/int8.py): the model
+    zoo routes every projection through ``ops.int8.matmul(a, b,
+    precision=config.matmul_precision)``, so flipping the config field is the
+    whole plumb and the params (dynamically quantized inside the matmul) are
+    shared bit-for-bit with the full-precision module. Variants are memoized
+    ON the original module: each one keeps its own ``_generate_fns`` compile
+    cache, so repeated ``generate(..., matmul_precision='int8')`` calls reuse
+    one compiled program instead of re-tracing per call."""
+    import copy
+    import dataclasses
+
+    cfg = getattr(module, "config", None)
+    if cfg is None or not hasattr(cfg, "matmul_precision"):
+        raise ValueError(
+            f"model {type(module).__name__} has no matmul_precision config "
+            "field; int8 serving needs a zoo model routed through ops.int8.matmul"
+        )
+    if precision == cfg.matmul_precision:
+        return module
+    variants = module.__dict__.setdefault("_precision_variants", {})
+    if precision not in variants:
+        clone = copy.copy(module)
+        clone.config = dataclasses.replace(cfg, matmul_precision=precision)
+        # A fresh compile/variant cache: the clone must never share compiled
+        # programs (or further variants) with the original module.
+        clone.__dict__.pop("_generate_fns", None)
+        clone.__dict__.pop("_precision_variants", None)
+        variants[precision] = clone
+    return variants[precision]
+
+
 def generate(
     model,
     input_ids,
@@ -676,6 +709,7 @@ def generate(
     do_sample: bool = False,
     assistant_model=None,
     num_draft_tokens: int = 5,
+    matmul_precision: str | None = None,
 ):
     """Generate ``max_new_tokens`` continuations for a batch of prompts.
 
@@ -691,6 +725,21 @@ def generate(
     ``decoder_start_token_id``, so there is no prompt to include.
     """
     from .big_modeling import StreamedScanModel
+
+    # Opt-in serving dtype policy (ISSUE 20 lever c): run the forward's
+    # matmuls through the kernel-backed int8 path. Applied via a memoized
+    # module variant so compiled programs are still cached per (module,
+    # precision) — see _precision_variant.
+    if matmul_precision in ("", "default"):
+        matmul_precision = None
+    if matmul_precision is not None and (
+        assistant_model is not None or num_beams > 1
+        or isinstance(model, StreamedScanModel)
+    ):
+        raise ValueError(
+            "matmul_precision supports the plain decoder-only generate path "
+            "(no assistant_model/num_beams/StreamedScanModel)"
+        )
 
     if assistant_model is not None:
         # transformers' generate(assistant_model=...) entry point: route to
@@ -755,6 +804,8 @@ def generate(
         module, mparams = model, None
     else:
         module, mparams = _unwrap(model)
+        if matmul_precision is not None:
+            module = _precision_variant(module, matmul_precision)
 
     # Token prompts cast to int32. Float arrays pass through unchanged ONLY
     # for encoder-decoders, whose "prompt" may be continuous encoder input

@@ -40,6 +40,30 @@ def quantize_rowwise(t, axis):
     return q, scale
 
 
+def quantize_kv(t):
+    """Per-token KV quantization for the paged pool (``kv_quant="int8"``).
+
+    ``t``: ``(..., H, D)`` K or V rows. The scale is absmax over the trailing
+    (heads, head_dim) axes mapped to 127 — ONE scale per token row, so a pool
+    block carries a ``(bs,)`` scale vector next to its int8 payload and a
+    token written once is never rescaled (blocks fill incrementally at
+    scatter time; a per-block running amax would force rewrites of
+    already-committed rows). Returns ``(int8 t-shaped, float32 (...,)
+    scales)``. Round-trip error is bounded by ``amax/254`` per token row
+    (half a quantization step) — tests/test_speculative.py pins it."""
+    amax = jnp.max(jnp.abs(t.astype(jnp.float32)), axis=(-2, -1))
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(t.astype(jnp.float32) / scale[..., None, None]), -127, 127)
+    return q.astype(jnp.int8), scale.astype(jnp.float32)
+
+
+def dequantize_kv(q, scale, dtype=jnp.float32):
+    """Inverse of :func:`quantize_kv`: ``int8 (..., H, D)`` + ``(...,)``
+    scales → ``dtype``. This exact expression (f32 multiply, then cast) is
+    the parity seam the Pallas dequant-in-DMA kernels replicate."""
+    return (q.astype(jnp.float32) * scale[..., None, None].astype(jnp.float32)).astype(dtype)
+
+
 @jax.custom_vjp
 def int8_matmul(x, w):
     """x @ w with both operands dynamically quantized to int8.

@@ -63,22 +63,43 @@ import jax.numpy as jnp
 from .attention import cached_attention
 
 
-def init_kv_pool(module, num_blocks: int, block_size: int, dtype=jnp.bfloat16):
+def init_kv_pool(module, num_blocks: int, block_size: int, dtype=jnp.bfloat16,
+                 quant: str | None = None):
     """Allocate the per-layer block pool for ``module``'s cache layout.
 
     Returns ``{"k": (L, N, bs, Hkv, D), "v": same, "mask": (N, bs) int32}``
     with ``N = num_blocks + 1`` — block 0 is the reserved trash block (see
     module docstring). The layer/head/dim axes are probed from the module's
     own ``init_cache`` so every cached decoder family (Llama/GPT-2/GPT-X)
-    gets its exact layout without a second cache contract."""
+    gets its exact layout without a second cache contract.
+
+    ``quant="int8"`` stores the K/V payloads as int8 and adds per-block scale
+    tables ``{"k_scale": (L, N, bs) float32, "v_scale": same}`` — one scale
+    per token row per layer (``ops/int8.quantize_kv``), so the pool costs
+    ``1 + 8/(2·Hkv·D)`` bytes per bf16 element instead of 2: ~1.9x the
+    chains per HBM byte at realistic head counts. Dequantization happens at
+    view-assembly time (``gather_view`` / the Pallas DMA kernels), never in
+    the pool itself."""
+    if quant not in (None, "int8"):
+        raise ValueError(f"kv pool quant must be None or 'int8', got {quant!r}")
     probe = module.init_cache(1, block_size, dtype=dtype)
     L, _, _, hkv, hd = probe["k"].shape
     n = num_blocks + 1
-    return {
-        "k": jnp.zeros((L, n, block_size, hkv, hd), dtype),
-        "v": jnp.zeros((L, n, block_size, hkv, hd), dtype),
+    store = jnp.int8 if quant == "int8" else dtype
+    pool = {
+        "k": jnp.zeros((L, n, block_size, hkv, hd), store),
+        "v": jnp.zeros((L, n, block_size, hkv, hd), store),
         "mask": jnp.zeros((n, block_size), jnp.int32),
     }
+    if quant == "int8":
+        pool["k_scale"] = jnp.zeros((L, n, block_size), jnp.float32)
+        pool["v_scale"] = jnp.zeros((L, n, block_size), jnp.float32)
+    return pool
+
+
+def pool_is_quantized(pool) -> bool:
+    """Whether a pool carries int8 payloads + per-block scale tables."""
+    return "k_scale" in pool
 
 
 def export_chain_blocks(pool, block_ids):
@@ -91,11 +112,17 @@ def export_chain_blocks(pool, block_ids):
     of the paged layout: ownership moves block-by-block without copying the
     cache. Pure gather; safe to jit or call eagerly."""
     ids = jnp.asarray(block_ids, jnp.int32)
-    return {
+    chain = {
         "k": jnp.take(pool["k"], ids, axis=1),
         "v": jnp.take(pool["v"], ids, axis=1),
         "mask": jnp.take(pool["mask"], ids, axis=0),
     }
+    if pool_is_quantized(pool):
+        # Quantized chains ship int8 payloads + their scales: the handoff
+        # wire cost drops with the pool, and the importer splices verbatim.
+        chain["k_scale"] = jnp.take(pool["k_scale"], ids, axis=1)
+        chain["v_scale"] = jnp.take(pool["v_scale"], ids, axis=1)
+    return chain
 
 
 def import_chain_blocks(pool, block_ids, chain):
@@ -107,20 +134,37 @@ def import_chain_blocks(pool, block_ids, chain):
     reused blocks are overwritten rather than frontier-masked. Returns the
     updated pool (donation-friendly: one scatter per array)."""
     ids = jnp.asarray(block_ids, jnp.int32)
-    return {
-        "k": pool["k"].at[:, ids].set(chain["k"]),
-        "v": pool["v"].at[:, ids].set(chain["v"]),
+    out = {
+        "k": pool["k"].at[:, ids].set(chain["k"].astype(pool["k"].dtype)),
+        "v": pool["v"].at[:, ids].set(chain["v"].astype(pool["v"].dtype)),
         "mask": pool["mask"].at[ids].set(chain["mask"]),
     }
+    if pool_is_quantized(pool):
+        if "k_scale" not in chain:
+            raise ValueError(
+                "import_chain_blocks: quantized pool but the chain carries no "
+                "scales — exporter and importer must agree on kv_quant"
+            )
+        out["k_scale"] = pool["k_scale"].at[:, ids].set(chain["k_scale"])
+        out["v_scale"] = pool["v_scale"].at[:, ids].set(chain["v_scale"])
+    return out
 
 
-def gather_block_view(pool_kv, block_tables, *, active=None):
+def gather_block_view(pool_kv, block_tables, *, active=None, scales=None,
+                      out_dtype=None):
     """Materialize per-slot contiguous KV views from the pool.
 
     ``pool_kv``: ``(..., N, bs, H, D)`` (a single layer or the L-stacked
     pool); ``block_tables``: ``(B, M)`` int32 block ids. Returns
     ``(..., B, M*bs, H, D)`` — slot ``b``'s chain left-packed in table order.
     This is the reference XLA-gather lowering of paged attention.
+
+    ``scales`` (``(..., N, bs)`` per-block scale tables of a quantized pool)
+    arms the dequant seam: the int8 view is gathered together with its
+    scales and dequantized per token row (``q.astype(f32) * scale``, then a
+    cast to ``out_dtype`` — float32 by default). This exact expression is
+    what the Pallas chain-walk kernel replays after its DMA, so reference
+    and kernel stay bit-identical on active slots.
 
     ``active`` (per-slot flags) is accepted for signature parity with the
     chain-walk kernel (``ops/pallas/paged_decode.gather_block_view_kernel``,
@@ -130,20 +174,30 @@ def gather_block_view(pool_kv, block_tables, *, active=None):
     del active  # reference computes all slots; masks make the garbage inert
     m = block_tables.shape[-1]
     view = jnp.take(pool_kv, block_tables, axis=-4)  # (..., B, M, bs, H, D)
-    return view.reshape(view.shape[:-4] + (m * view.shape[-3],) + view.shape[-2:])
+    view = view.reshape(view.shape[:-4] + (m * view.shape[-3],) + view.shape[-2:])
+    if scales is None:
+        return view if out_dtype is None else view.astype(out_dtype)
+    s = jnp.take(scales, block_tables, axis=-2)  # (..., B, M, bs)
+    s = s.reshape(s.shape[:-2] + (m * s.shape[-1],))
+    deq = view.astype(jnp.float32) * s[..., None, None].astype(jnp.float32)
+    return deq.astype(out_dtype if out_dtype is not None else jnp.float32)
 
 
-def gather_view(pool_kv, block_tables, *, active=None, backend=None):
+def gather_view(pool_kv, block_tables, *, active=None, scales=None,
+                out_dtype=None, backend=None):
     """Registry-dispatched view assembly (op ``paged_gather``): the Pallas
     chain-walk kernel when ``ACCELERATE_KERNELS`` (or ``backend``) selects
     it, the XLA-gather reference otherwise. Bit-identical for active slots
-    (pure data movement); the kernel skips ``active == 0`` slots."""
+    (pure data movement, or gather+dequant when ``scales`` arms the int8
+    path); the kernel skips ``active == 0`` slots."""
     from .registry import dispatch, resolve_backend
 
     if resolve_backend("paged_gather", backend) == "reference":
-        return gather_block_view(pool_kv, block_tables, active=active)
+        return gather_block_view(pool_kv, block_tables, active=active,
+                                 scales=scales, out_dtype=out_dtype)
     return dispatch(
-        "paged_gather", pool_kv, block_tables, active=active, backend=backend
+        "paged_gather", pool_kv, block_tables, active=active, scales=scales,
+        out_dtype=out_dtype, backend=backend,
     )
 
 
@@ -156,7 +210,8 @@ def gather_block_mask(pool_mask, block_tables):
 
 def paged_attention_reference(q, k_pool, v_pool, block_tables, *, q_positions,
                               pool_mask=None, window=None, softcap=None,
-                              scale=None, active=None):
+                              scale=None, active=None, k_scale=None,
+                              v_scale=None):
     """The reference lowering: gather each slot's chain to a contiguous view,
     then run the hole-tolerant :func:`~.attention.cached_attention`
     (causality on chain-slot order, validity from the gathered mask, sliding
@@ -164,10 +219,13 @@ def paged_attention_reference(q, k_pool, v_pool, block_tables, *, q_positions,
     Pallas kernel must match it bit-for-bit on active slots on the test
     vectors in tests/test_paged_attention.py and tests/test_kernels.py.
     ``active`` is accepted for kernel-signature parity and ignored (the
-    reference computes masked garbage for inactive slots)."""
+    reference computes masked garbage for inactive slots). ``k_scale`` /
+    ``v_scale`` (``(N, bs)`` per-block scale tables) arm the int8-pool path:
+    views dequantize to float32 before the shared attention math, mirroring
+    the kernel's dequant-in-DMA step."""
     del active
-    k_view = gather_block_view(k_pool, block_tables)
-    v_view = gather_block_view(v_pool, block_tables)
+    k_view = gather_block_view(k_pool, block_tables, scales=k_scale)
+    v_view = gather_block_view(v_pool, block_tables, scales=v_scale)
     kv_mask = (
         gather_block_mask(pool_mask, block_tables) if pool_mask is not None else None
     )
@@ -179,7 +237,7 @@ def paged_attention_reference(q, k_pool, v_pool, block_tables, *, q_positions,
 
 def paged_attention(q, k_pool, v_pool, block_tables, *, q_positions,
                     pool_mask=None, window=None, softcap=None, scale=None,
-                    active=None, backend=None):
+                    active=None, k_scale=None, v_scale=None, backend=None):
     """Attention of a query chunk against block-table-addressed KV pools.
 
     q: ``(B, S, H, D)``; k_pool/v_pool: ``(N, bs, Hkv, D)`` (one layer);
@@ -199,10 +257,11 @@ def paged_attention(q, k_pool, v_pool, block_tables, *, q_positions,
         return paged_attention_reference(
             q, k_pool, v_pool, block_tables, q_positions=q_positions,
             pool_mask=pool_mask, window=window, softcap=softcap, scale=scale,
-            active=active,
+            active=active, k_scale=k_scale, v_scale=v_scale,
         )
     return dispatch(
         "paged_decode", q, k_pool, v_pool, block_tables,
         q_positions=q_positions, pool_mask=pool_mask, window=window,
-        softcap=softcap, scale=scale, active=active, backend=backend,
+        softcap=softcap, scale=scale, active=active, k_scale=k_scale,
+        v_scale=v_scale, backend=backend,
     )

@@ -63,7 +63,8 @@ def _norm_active(active, batch: int):
 
 def paged_attention_kernel(q, k_pool, v_pool, block_tables, *, q_positions,
                            pool_mask=None, window=None, softcap=None,
-                           scale=None, active=None, interpret: bool = False):
+                           scale=None, active=None, k_scale=None,
+                           v_scale=None, interpret: bool = False):
     """Fused paged decode attention: q + pools + block tables → attention out.
 
     Signature-compatible with ``paged_attention_reference`` plus ``active``:
@@ -71,6 +72,14 @@ def paged_attention_kernel(q, k_pool, v_pool, block_tables, *, q_positions,
     drained slots) skip the chain walk entirely and return zeros. Shapes:
     q ``(B, S, H, D)``; pools ``(N, bs, Hkv, D)``; tables ``(B, M)``;
     q_positions ``(S,)`` or ``(B, S)``; pool_mask ``(N, bs)``.
+
+    ``k_scale`` / ``v_scale`` (``(N, bs)`` float32) arm the **int8-pool
+    dequant-in-DMA path**: the chain walk DMAs each int8 block *and its
+    scale row* into VMEM scratch, dequantizes there (``q.astype(f32) *
+    scale`` — the exact ``ops/int8.dequantize_kv`` expression the reference
+    gather replays), and feeds the shared attention math float32 views. HBM
+    traffic halves with the pool; nothing ever rematerializes the bf16
+    chain in HBM.
     """
     B, S, H, D = q.shape
     N, bs, Hkv, _ = k_pool.shape
@@ -80,21 +89,32 @@ def paged_attention_kernel(q, k_pool, v_pool, block_tables, *, q_positions,
     act = _norm_active(active, B)
     tables = jnp.asarray(block_tables).astype(jnp.int32)
     has_mask = pool_mask is not None
-    out_dtype = jnp.result_type(q.dtype, v_pool.dtype)
+    quant = k_scale is not None
+    if quant and v_scale is None:
+        raise ValueError("paged_decode: k_scale set without v_scale")
+    out_dtype = (jnp.result_type(q.dtype, jnp.float32) if quant
+                 else jnp.result_type(q.dtype, v_pool.dtype))
 
     def body(tbl_ref, act_ref, q_ref, pos_ref, k_ref, v_ref, *rest):
-        if has_mask:
-            m_ref, o_ref, k_scr, v_scr, m_scr, sems = rest
-        else:
-            o_ref, k_scr, v_scr, sems = rest
-            m_ref = m_scr = None
+        rest = list(rest)
+        m_ref = rest.pop(0) if has_mask else None
+        ks_ref = rest.pop(0) if quant else None
+        vs_ref = rest.pop(0) if quant else None
+        o_ref = rest.pop(0)
+        k_scr = rest.pop(0)
+        v_scr = rest.pop(0)
+        m_scr = rest.pop(0) if has_mask else None
+        ks_scr = rest.pop(0) if quant else None
+        vs_scr = rest.pop(0) if quant else None
+        sems = rest.pop(0)
         b = pl.program_id(0)
 
         @pl.when(act_ref[b] != 0)
         def _():
             # Walk the slot's chain: per-block DMA from the HBM pools into
             # VMEM scratch. Copies for one chain slot start together (k, v,
-            # mask overlap each other); the chain itself is short (M blocks).
+            # mask and scales overlap each other); the chain itself is short
+            # (M blocks).
             for j in range(M):
                 idx = tbl_ref[b, j]
                 copies = [
@@ -105,12 +125,24 @@ def paged_attention_kernel(q, k_pool, v_pool, block_tables, *, q_positions,
                     copies.append(
                         pltpu.make_async_copy(m_ref.at[idx], m_scr.at[j], sems.at[2])
                     )
+                if quant:
+                    copies.append(
+                        pltpu.make_async_copy(ks_ref.at[idx], ks_scr.at[j], sems.at[3])
+                    )
+                    copies.append(
+                        pltpu.make_async_copy(vs_ref.at[idx], vs_scr.at[j], sems.at[4])
+                    )
                 for c in copies:
                     c.start()
                 for c in copies:
                     c.wait()
             k_view = k_scr[:].reshape(T, Hkv, D)
             v_view = v_scr[:].reshape(T, Hkv, D)
+            if quant:
+                # Dequant at the VMEM seam: identical expression to the
+                # reference's gather_block_view(scales=...) lowering.
+                k_view = k_view.astype(jnp.float32) * ks_scr[:].reshape(T)[:, None, None]
+                v_view = v_view.astype(jnp.float32) * vs_scr[:].reshape(T)[:, None, None]
             kv_mask = m_scr[:].reshape(1, T) if has_mask else None
             # The reference's exact math on the assembled chain: per-slot
             # attention is independent across B, so the single-slot call is
@@ -136,15 +168,21 @@ def paged_attention_kernel(q, k_pool, v_pool, block_tables, *, q_positions,
         pltpu.VMEM((M, bs, Hkv, D), k_pool.dtype),
         pltpu.VMEM((M, bs, Hkv, D), v_pool.dtype),
     ]
-    operands = [q, pos]
+    operands = [q, pos, k_pool, v_pool]
     n_sems = 2
     if has_mask:
         in_specs.append(pl.BlockSpec(memory_space=pltpu.ANY))
         scratch.append(pltpu.VMEM((M, bs), jnp.asarray(pool_mask).dtype))
         n_sems = 3
-        operands = [q, pos, k_pool, v_pool, jnp.asarray(pool_mask)]
-    else:
-        operands = [q, pos, k_pool, v_pool]
+        operands.append(jnp.asarray(pool_mask))
+    if quant:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.ANY))
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.ANY))
+        scratch.append(pltpu.VMEM((M, bs), jnp.float32))
+        scratch.append(pltpu.VMEM((M, bs), jnp.float32))
+        n_sems = 5  # scale sems sit at fixed indices 3/4 past the mask's
+        operands.append(jnp.asarray(k_scale).astype(jnp.float32))
+        operands.append(jnp.asarray(v_scale).astype(jnp.float32))
     scratch.append(pltpu.SemaphoreType.DMA((n_sems,)))
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -164,6 +202,7 @@ def paged_attention_kernel(q, k_pool, v_pool, block_tables, *, q_positions,
 
 
 def gather_block_view_kernel(pool_kv, block_tables, *, active=None,
+                             scales=None, out_dtype=None,
                              interpret: bool = False):
     """Chain-walk view assembly: pool + tables → per-slot contiguous views.
 
@@ -171,17 +210,36 @@ def gather_block_view_kernel(pool_kv, block_tables, *, active=None,
     ``active`` flag is set (pure data movement), zeros for skipped slots.
     ``pool_kv`` is ``(L, N, bs, H, D)`` (the engine's L-stacked pool) or
     ``(N, bs, H, D)`` (a single layer); output matches the reference shape
-    ``(..., B, M*bs, H, D)``."""
+    ``(..., B, M*bs, H, D)``.
+
+    ``scales`` (``(..., N, bs)`` float32, the quantized pool's per-block
+    scale tables) arms the **dequant-in-DMA** path: each int8 block and its
+    scale row DMA into VMEM scratch, dequantize there (``q.astype(f32) *
+    scale`` — exactly ``gather_block_view``'s lowering), and the view lands
+    in ``out_dtype`` (float32 default). The serving engine compiles THIS
+    kernel into its decode program when ``kv_quant="int8"`` — the
+    fingerprint config ``decode_paged_int8`` pins its presence."""
     squeeze = pool_kv.ndim == 4
     if squeeze:
         pool_kv = pool_kv[None]
+        if scales is not None:
+            scales = scales[None]
     L, N, bs, Hkv, D = pool_kv.shape
     B, M = block_tables.shape
     T = M * bs
     act = _norm_active(active, B)
     tables = jnp.asarray(block_tables).astype(jnp.int32)
+    quant = scales is not None
+    # Quant path casts in-kernel (dequant writes o_ref.dtype); the plain path
+    # is a pure DMA, so any requested out_dtype applies after the call.
+    out_dt = ((out_dtype if out_dtype is not None else jnp.float32)
+              if quant else pool_kv.dtype)
 
-    def body(tbl_ref, act_ref, pool_ref, o_ref, sem):
+    def body(tbl_ref, act_ref, pool_ref, *rest):
+        if quant:
+            s_ref, o_ref, blk_scr, s_scr, sems = rest
+        else:
+            (o_ref, sems) = rest
         l = pl.program_id(0)
         b = pl.program_id(1)
 
@@ -189,34 +247,59 @@ def gather_block_view_kernel(pool_kv, block_tables, *, active=None,
         def _():
             for j in range(M):
                 idx = tbl_ref[b, j]
-                dma = pltpu.make_async_copy(
-                    pool_ref.at[l, idx],
-                    o_ref.at[0, 0, pl.ds(j * bs, bs)],
-                    sem,
-                )
-                dma.start()
-                dma.wait()
+                if quant:
+                    copies = [
+                        pltpu.make_async_copy(pool_ref.at[l, idx], blk_scr,
+                                              sems.at[0]),
+                        pltpu.make_async_copy(s_ref.at[l, idx], s_scr.at[0],
+                                              sems.at[1]),
+                    ]
+                    for c in copies:
+                        c.start()
+                    for c in copies:
+                        c.wait()
+                    deq = blk_scr[:].astype(jnp.float32) * s_scr[0][:, None, None]
+                    o_ref[0, 0, pl.ds(j * bs, bs)] = deq.astype(o_ref.dtype)
+                else:
+                    dma = pltpu.make_async_copy(
+                        pool_ref.at[l, idx],
+                        o_ref.at[0, 0, pl.ds(j * bs, bs)],
+                        sems.at[0],
+                    )
+                    dma.start()
+                    dma.wait()
 
         @pl.when(act_ref[b] == 0)
         def _():
             o_ref[:] = jnp.zeros_like(o_ref)
 
+    in_specs = [pl.BlockSpec(memory_space=pltpu.ANY)]
+    operands = [pool_kv]
+    scratch: list = []
+    if quant:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.ANY))
+        operands.append(jnp.asarray(scales).astype(jnp.float32))
+        scratch = [pltpu.VMEM((bs, Hkv, D), pool_kv.dtype),
+                   pltpu.VMEM((1, bs), jnp.float32)]
+    scratch.append(pltpu.SemaphoreType.DMA((2,)))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(L, B),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, 1, T, Hkv, D), lambda l, b, tbl, act: (l, b, 0, 0, 0)
         ),
-        scratch_shapes=[pltpu.SemaphoreType.DMA],
+        scratch_shapes=scratch,
     )
     out = pl.pallas_call(
         body,
-        out_shape=jax.ShapeDtypeStruct((L, B, T, Hkv, D), pool_kv.dtype),
+        out_shape=jax.ShapeDtypeStruct((L, B, T, Hkv, D), out_dt),
         grid_spec=grid_spec,
         interpret=interpret,
-        name="paged_gather_kernel",
-    )(tables, act, pool_kv)
+        name="paged_gather_dequant_kernel" if quant else "paged_gather_kernel",
+    )(tables, act, *operands)
+    if not quant and out_dtype is not None:
+        out = out.astype(out_dtype)
     return out[0] if squeeze else out
 
 

@@ -98,6 +98,7 @@ outputs are bit-identical to the contiguous engine and to per-request
 
 from __future__ import annotations
 
+import os
 import time
 from collections import deque
 from dataclasses import dataclass
@@ -108,6 +109,7 @@ import jax
 import jax.numpy as jnp
 
 from .generation import _unwrap, left_align, mask_positions
+from .ops.int8 import quantize_kv
 from .ops.paged_attention import gather_block_mask, gather_view, init_kv_pool
 from .utils.environment import safe_donate_argnums
 from .utils.transfer import host_fetch
@@ -115,6 +117,7 @@ from .utils.transfer import host_fetch
 
 _SERVING_COUNTERS = None  # telemetry.metrics.cached_handles accessor
 _SERVING_SLO_METRICS = None
+_SERVING_SPEC_METRICS = None
 
 
 def _serving_counters():
@@ -166,6 +169,32 @@ def _slo_metrics():
             ),
         ))
     return _SERVING_SLO_METRICS()
+
+
+def _spec_metrics():
+    """(proposed_total, accepted_total, acceptance_gauge) — the speculative-
+    decoding telemetry handles (docs/observability.md): cumulative draft
+    tokens proposed/accepted plus the running acceptance-rate gauge, hoisted
+    like the request counters so each verify round pays only the inc/set."""
+    global _SERVING_SPEC_METRICS
+    if _SERVING_SPEC_METRICS is None:
+        from .telemetry.metrics import cached_handles
+
+        _SERVING_SPEC_METRICS = cached_handles(lambda registry: (
+            registry.counter(
+                "accelerate_spec_proposed_tokens_total",
+                "Draft tokens proposed by the speculative decoder",
+            ),
+            registry.counter(
+                "accelerate_spec_accepted_tokens_total",
+                "Draft tokens accepted by the target verifier",
+            ),
+            registry.gauge(
+                "accelerate_spec_acceptance_rate",
+                "Cumulative accepted/proposed draft-token ratio",
+            ),
+        ))
+    return _SERVING_SPEC_METRICS()
 
 
 @dataclass
@@ -263,9 +292,25 @@ class ContinuousBatcher:
         max_tokens_per_request: int | None = None,
         slo: SLOTargets | None = None,
         kernels: str | None = None,
+        speculative_k: int = 0,
+        draft_model=None,
+        kv_quant: str | None = None,
+        matmul_precision: str | None = None,
         trace_requests: bool = True,
     ):
         module, mparams = _unwrap(model)
+        # Weight-quantized serving (opt-in dtype policy): swap the model's
+        # matmul primitive for the kernel-backed int8 path (ops/int8.py) via a
+        # memoized config variant — the params are untouched (dynamic
+        # quantization happens inside the matmul), so the SAME checkpoint
+        # serves both precisions.
+        if matmul_precision in ("", "default"):
+            matmul_precision = None
+        if matmul_precision is not None:
+            from .generation import _precision_variant
+
+            module = _precision_variant(module, matmul_precision)
+        self.matmul_precision = matmul_precision
         self.module = module
         self.params = params if params is not None else mparams
         if self.params is None:
@@ -289,6 +334,25 @@ class ContinuousBatcher:
         # at most K-1 extra steps and the cache consumes at most K-1 extra
         # columns per wave, both accounted for in the capacity reservation.
         self.sync_every = sync_every
+        # ----------------------------------------------- decode-speed levers
+        # Speculative decoding + int8 KV blocks (ISSUE 20): constructor args
+        # win; unset values resolve from the launcher env contract
+        # (ACCELERATE_SPECULATIVE_K / _DRAFT_MODEL / _KV_QUANT) so a serving
+        # tier picks them up with zero code, like kernels/SLO targets.
+        from .utils.constants import ENV_KV_QUANT, ENV_SPECULATIVE_K
+
+        if not speculative_k:
+            speculative_k = int(os.environ.get(ENV_SPECULATIVE_K, "0") or 0)
+        self.speculative_k = int(speculative_k)
+        if self.speculative_k < 0:
+            raise ValueError(f"speculative_k must be >= 0, got {speculative_k}")
+        if kv_quant is None:
+            kv_quant = os.environ.get(ENV_KV_QUANT) or None
+        if kv_quant in ("", "none", "off"):
+            kv_quant = None
+        if kv_quant not in (None, "int8"):
+            raise ValueError(f"kv_quant must be None or 'int8', got {kv_quant!r}")
+        self.kv_quant = kv_quant
         # ---------------------------------------------------- paged KV mode
         # paged=True swaps the contiguous (B, max_cache_len) cache for a
         # block pool (ops/paged_attention.py): `num_blocks` blocks of
@@ -343,9 +407,13 @@ class ContinuousBatcher:
             # <=prefill_chunk remainder up to at most _bucket(prefill_chunk)
             # (coarse bucket lists round far past prefill_chunk itself), so
             # that is the padding the static table must budget for.
+            # Spec-decode verify rounds write (k+1)-token windows instead of
+            # sync_every-token ones, so the post-finish slack is measured in
+            # the LARGER of the two window widths.
+            self._decode_slack = 3 * max(self.sync_every, self.speculative_k + 1)
             worst_chain = (
                 self.max_tokens_per_request + self._bucket(self.prefill_chunk)
-                + 3 * self.sync_every
+                + self._decode_slack
             )
             self.max_blocks_per_slot = -(-worst_chain // self.block_size)
         else:
@@ -354,6 +422,10 @@ class ContinuousBatcher:
                                 ("max_tokens_per_request", max_tokens_per_request)):
                 if value is not None:
                     raise ValueError(f"{name} requires paged=True")
+            if self.speculative_k:
+                raise ValueError("speculative_k requires paged=True")
+            if self.kv_quant:
+                raise ValueError("kv_quant requires paged=True")
         # Pallas kernel-layer spec for the engine's compiled programs
         # (ops/registry.py; docs/kernels.md): None = the launcher contract
         # (ACCELERATE_KERNELS) resolved at trace time; an explicit string
@@ -367,6 +439,28 @@ class ContinuousBatcher:
 
             parse_kernel_spec(kernels)  # validate eagerly
         self.kernels = kernels
+        # Speculative decoding: resolve the draft model. Its paged pool
+        # mirrors the target pool's block geometry exactly, so ONE set of
+        # host block tables / free-list bookkeeping indexes both.
+        self._draft_module = None
+        self._draft_params = None
+        if self.speculative_k:
+            if draft_model is None:
+                from .utils.constants import ENV_DRAFT_MODEL
+
+                draft_model = self._build_draft_from_preset(
+                    os.environ.get(ENV_DRAFT_MODEL) or "tiny"
+                )
+            d_module, d_params = _unwrap(draft_model)
+            if d_params is None:
+                raise ValueError(
+                    "draft model has no params; init it first or pass a "
+                    "prepared/initialized model as draft_model="
+                )
+            self._draft_module = d_module
+            self._draft_params = d_params
+        elif draft_model is not None:
+            raise ValueError("draft_model requires speculative_k > 0")
         self._rng = rng if rng is not None else jax.random.key(0)
         self._queue: deque[_Request] = deque()
         self._next_rid = 0
@@ -375,7 +469,12 @@ class ContinuousBatcher:
         self._prefix_fns: dict[int, object] = {}
         self._chunk_fns: dict[int, object] = {}
         self._decode_fn = None
+        self._verify_fn = None
         self._compact_fn = None
+        # Cumulative speculative-decoding ledger (host side, both exposed via
+        # spec_report() and the accelerate_spec_* metrics handles).
+        self._spec_proposed = 0
+        self._spec_accepted = 0
         # SLO/throughput accounting (both modes): per-request wall-clock
         # marks and the admission loop's decision tallies. Both ring-bounded
         # (_SLO_HISTORY): a long-lived engine serves unbounded requests, and
@@ -418,6 +517,30 @@ class ContinuousBatcher:
         self.stream = None
         self._streamed: dict[int, int] = {}
         self.reset()
+
+    def _build_draft_from_preset(self, preset: str):
+        """Materialize the env-named draft model (``ACCELERATE_DRAFT_MODEL``,
+        default ``tiny``): a zoo config preset re-shaped to the target's
+        vocabulary and position budget, deterministically initialized (fixed
+        seed) so every host of a serving fleet builds the SAME draft weights.
+        Checkpointed drafts pass ``draft_model=`` directly instead."""
+        from .models.llama import Llama, LlamaConfig
+
+        factory = getattr(LlamaConfig, preset, None)
+        if factory is None or not callable(factory):
+            raise ValueError(
+                f"unknown draft-model preset {preset!r} (a LlamaConfig "
+                "classmethod name like 'tiny')"
+            )
+        overrides = {}
+        tcfg = getattr(self.module, "config", None)
+        if tcfg is not None and hasattr(tcfg, "vocab_size"):
+            overrides["vocab_size"] = tcfg.vocab_size
+        if tcfg is not None and hasattr(tcfg, "max_position_embeddings"):
+            overrides["max_position_embeddings"] = tcfg.max_position_embeddings
+        d_module = Llama(factory(**overrides))
+        d_module.init_params(jax.random.key(0))
+        return d_module
 
     # ------------------------------------------------------------- lifecycle
     def reset(self, keep_prefix: bool = True):
@@ -474,7 +597,18 @@ class ContinuousBatcher:
         ``set_prefix``), but all resident blocks are dropped."""
         B = self.B
         self._pool = init_kv_pool(
-            self.module, self.num_blocks, self.block_size, dtype=self.cache_dtype
+            self.module, self.num_blocks, self.block_size,
+            dtype=self.cache_dtype, quant=self.kv_quant,
+        )
+        # The draft pool mirrors the target pool's block geometry (same
+        # num_blocks/block_size/max_blocks_per_slot), so a chain's block i
+        # holds target KV in self._pool AND draft KV in self._draft_pool
+        # under the SAME host table entry. It stays unquantized: the draft is
+        # tiny, its pool a rounding error next to the target's.
+        self._draft_pool = (
+            init_kv_pool(self._draft_module, self.num_blocks, self.block_size,
+                         dtype=self.cache_dtype)
+            if self.speculative_k else None
         )
         self._tok = jnp.full((B,), self.pad, jnp.int32)
         self._pos = jnp.zeros((B,), jnp.int32)
@@ -611,9 +745,17 @@ class ContinuousBatcher:
         k/v arrays, or the paged pool (trash block included). The denominator
         of the serving bench's admitted-tokens-per-cache-byte capacity
         metric, and the quantity ``accelerate-tpu memcheck --serving`` gates
-        against the HBM budget."""
+        against the HBM budget. A quantized pool (``kv_quant="int8"``) prices
+        its per-token scale planes too; speculative decoding adds the draft
+        pool's blocks — both layouts the memcheck gate must cover."""
         store = self._pool if self.paged else self._cache
-        return int(store["k"].nbytes + store["v"].nbytes)
+        total = int(store["k"].nbytes + store["v"].nbytes)
+        if "k_scale" in store:
+            total += int(store["k_scale"].nbytes + store["v_scale"].nbytes)
+        draft = getattr(self, "_draft_pool", None)
+        if draft is not None:
+            total += int(draft["k"].nbytes + draft["v"].nbytes)
+        return total
 
     @property
     def kv_consumed_slots_peak(self) -> int:
@@ -637,6 +779,26 @@ class ContinuousBatcher:
             "shared_blocks": len(self._block_key),
             "max_blocks_per_slot": self.max_blocks_per_slot,
             "pool_bytes": self.kv_cache_bytes,
+            "kv_quant": self.kv_quant,
+            "speculative_k": self.speculative_k,
+            "draft_pool_bytes": (
+                int(self._draft_pool["k"].nbytes + self._draft_pool["v"].nbytes)
+                if self._draft_pool is not None else 0
+            ),
+        }
+
+    def spec_report(self) -> dict:
+        """Cumulative speculative-decoding acceptance ledger (host-side, no
+        device readback beyond what verify rounds already paid): draft tokens
+        proposed/accepted and the acceptance rate — the serving analog of
+        slo_report(), consumed by bench.py's BENCH_SPEC cell and the journal
+        run_summary's accepted-tokens/s fields."""
+        proposed, accepted = self._spec_proposed, self._spec_accepted
+        return {
+            "speculative_k": self.speculative_k,
+            "proposed_tokens": proposed,
+            "accepted_tokens": accepted,
+            "acceptance_rate": accepted / proposed if proposed else None,
         }
 
     def slo_report(self) -> dict:
@@ -933,9 +1095,18 @@ class ContinuousBatcher:
         # drained slots — their view rows are masked garbage on the reference
         # path and zeros on the kernel path; attention provably ignores both).
         active = lens > 0
-        view_k = gather_view(pool["k"], tables, active=active,
+        # int8 pools (kv_quant) dequantize HERE, at view assembly: the Pallas
+        # gather kernel folds the per-token rescale into its DMA-to-VMEM step
+        # (ops/pallas/paged_decode.py), the reference path multiplies after
+        # the gather — bit-identical either way (the registry parity seam).
+        scales_k = pool.get("k_scale")
+        scales_v = pool.get("v_scale")
+        out_dt = self.cache_dtype if scales_k is not None else None
+        view_k = gather_view(pool["k"], tables, active=active, scales=scales_k,
+                             out_dtype=out_dt,
                              backend=self.kernels)      # (L, B, T, Hkv, D)
-        view_v = gather_view(pool["v"], tables, active=active,
+        view_v = gather_view(pool["v"], tables, active=active, scales=scales_v,
+                             out_dtype=out_dt,
                              backend=self.kernels)
         vmask = gather_block_mask(pool["mask"], tables)  # (B, T)
         b = vmask.shape[0]
@@ -951,6 +1122,30 @@ class ContinuousBatcher:
             ),
         }
 
+    def _scatter_pool(self, pool, blk, off, k_new, v_new, mask_new):
+        """Append freshly written view columns onto chain tails — the single
+        pool write point shared by the chunk / decode-window / spec-verify
+        programs. An int8 pool (``kv_quant``) quantizes the written rows here,
+        one (int8 payload, f32 scale) pair per token row (ops/int8.quantize_kv
+        — a committed row is never rescaled, which is what lets blocks fill
+        incrementally), and dequantizes at view assembly, so the quantization
+        seam is invisible to the model forward."""
+        if "k_scale" in pool:
+            qk, sk = quantize_kv(k_new)
+            qv, sv = quantize_kv(v_new)
+            return {
+                "k": pool["k"].at[:, blk, off].set(qk),
+                "v": pool["v"].at[:, blk, off].set(qv),
+                "k_scale": pool["k_scale"].at[:, blk, off].set(sk),
+                "v_scale": pool["v_scale"].at[:, blk, off].set(sv),
+                "mask": pool["mask"].at[blk, off].set(mask_new),
+            }
+        return {
+            "k": pool["k"].at[:, blk, off].set(k_new),
+            "v": pool["v"].at[:, blk, off].set(v_new),
+            "mask": pool["mask"].at[blk, off].set(mask_new),
+        }
+
     def _chunk_fn(self, P: int):
         """Compiled prefill of ONE ``P``-token chunk of one slot's prompt
         against the paged pool: gather the slot chains, run the whole (B, P)
@@ -964,12 +1159,15 @@ class ContinuousBatcher:
         if P in self._chunk_fns:
             return self._chunk_fns[P]
         module = self.module
+        d_module = self._draft_module
         pad = self.pad
         bs = self.block_size
         t = self.max_blocks_per_slot * bs
+        spec = bool(self.speculative_k)
 
-        def run(params, pool, state, tables, lens, slot, chunk_row, mask_row,
-                base_pos, is_final, rid, base_rng, req_max, req_temp, req_eos):
+        def body(params, pool, state, tables, lens, slot, chunk_row, mask_row,
+                 base_pos, is_final, rid, base_rng, req_max, req_temp, req_eos,
+                 d_params=None, d_pool=None):
             (tok, pos, n_out, active, out_buf, keys,
              slot_max, slot_temp, slot_eos) = state
             B = tok.shape[0]
@@ -985,11 +1183,28 @@ class ContinuousBatcher:
             idx = lens[slot] + jnp.arange(P)
             blk = tables[slot][idx // bs]
             off = idx % bs
-            pool = {
-                "k": pool["k"].at[:, blk, off].set(out["cache"]["k"][:, slot, t:t + P]),
-                "v": pool["v"].at[:, blk, off].set(out["cache"]["v"][:, slot, t:t + P]),
-                "mask": pool["mask"].at[blk, off].set(jnp.where(blk != 0, mask_row, 0)),
-            }
+            pool = self._scatter_pool(
+                pool, blk, off,
+                out["cache"]["k"][:, slot, t:t + P],
+                out["cache"]["v"][:, slot, t:t + P],
+                jnp.where(blk != 0, mask_row, 0),
+            )
+            if spec:
+                # Speculative mode: the draft model prefills the SAME chunk
+                # into its mirrored pool inside this program, so every
+                # resident chain (including aliased shared-prefix blocks,
+                # which are written exactly once, here) carries draft KV by
+                # the time the first verify round needs it.
+                d_cache = self._paged_view_cache(d_pool, tables, lens, P)
+                d_out = d_module.apply(
+                    d_params, input_ids=ids, attention_mask=mask, cache=d_cache,
+                    positions=mask_positions(mask) + base_pos)
+                d_pool = self._scatter_pool(
+                    d_pool, blk, off,
+                    d_out["cache"]["k"][:, slot, t:t + P],
+                    d_out["cache"]["v"][:, slot, t:t + P],
+                    jnp.where(blk != 0, mask_row, 0),
+                )
             real = jnp.sum(mask_row).astype(jnp.int32)
             key = jax.random.fold_in(base_rng, rid)  # the request's own stream
             keys = keys.at[slot].set(key)
@@ -1009,9 +1224,37 @@ class ContinuousBatcher:
             active = active.at[slot].set(is_final & ~done0)
             state = (tok, pos, n_out, active, out_buf, keys,
                      slot_max, slot_temp, slot_eos)
+            if spec:
+                return pool, d_pool, state
             return pool, state
 
-        effective_donate = safe_donate_argnums((1, 2))
+        if spec:
+            def run(params, d_params, pool, d_pool, state, tables, lens, slot,
+                    chunk_row, mask_row, base_pos, is_final, rid, base_rng,
+                    req_max, req_temp, req_eos):
+                return body(params, pool, state, tables, lens, slot, chunk_row,
+                            mask_row, base_pos, is_final, rid, base_rng,
+                            req_max, req_temp, req_eos, d_params, d_pool)
+
+            donations = (2, 3, 4)
+            donated_leaves = (
+                len(jax.tree_util.tree_leaves(self._pool))
+                + len(jax.tree_util.tree_leaves(self._draft_pool))
+                + len(jax.tree_util.tree_leaves(self._state_tuple()))
+            )
+        else:
+            def run(params, pool, state, tables, lens, slot, chunk_row,
+                    mask_row, base_pos, is_final, rid, base_rng, req_max,
+                    req_temp, req_eos):
+                return body(params, pool, state, tables, lens, slot, chunk_row,
+                            mask_row, base_pos, is_final, rid, base_rng,
+                            req_max, req_temp, req_eos)
+
+            donations = (1, 2)
+            donated_leaves = len(jax.tree_util.tree_leaves(self._pool)) + len(
+                jax.tree_util.tree_leaves(self._state_tuple())
+            )
+        effective_donate = safe_donate_argnums(donations)
         fn = jax.jit(run, donate_argnums=effective_donate)
         param_leaves = jax.tree_util.tree_leaves(self.params)
         from .ops.registry import resolved_backends
@@ -1020,22 +1263,25 @@ class ContinuousBatcher:
         # prefill-ONLY host (serving_net roles) never builds the decode
         # program, so memcheck --serving --serving-role prefill and the
         # `prefill_paged` fingerprint golden price/pin THIS program instead.
+        memory_classes = {
+            "kv_pool": (lambda: self._pool, lambda: None),
+            "params": (lambda: self.params, lambda: None),
+        }
+        if spec:
+            memory_classes["draft_pool"] = (lambda: self._draft_pool, lambda: None)
+            memory_classes["draft_params"] = (lambda: self._draft_params, lambda: None)
         fn._audit_meta = {
             "builder": "serving_prefill_chunk",
             "compute_dtype": (
                 str(np.dtype(param_leaves[0].dtype).name) if param_leaves else None
             ),
-            "expected_donations": (1, 2),
-            "expected_donated_leaves": len(jax.tree_util.tree_leaves(self._pool))
-            + len(jax.tree_util.tree_leaves(self._state_tuple())),
+            "expected_donations": donations,
+            "expected_donated_leaves": donated_leaves,
             "donation_dropped_by_policy": not effective_donate,
             "kernels": {"spec": self.kernels,
                         "backends": resolved_backends(self.kernels)},
             "jaxpr_thunk": lambda *a, **k: jax.make_jaxpr(run)(*a, **k),
-            "memory_classes": {
-                "kv_pool": (lambda: self._pool, lambda: None),
-                "params": (lambda: self.params, lambda: None),
-            },
+            "memory_classes": memory_classes,
         }
         self._chunk_fns[P] = fn
         return fn
@@ -1112,11 +1358,10 @@ class ContinuousBatcher:
             )
             off = (idx % bs).astype(jnp.int32)
             wm = cache["kv_mask"][:, t:t + w]
-            pool = {
-                "k": pool["k"].at[:, blk, off].set(cache["k"][:, :, t:t + w]),
-                "v": pool["v"].at[:, blk, off].set(cache["v"][:, :, t:t + w]),
-                "mask": pool["mask"].at[blk, off].set(jnp.where(blk != 0, wm, 0)),
-            }
+            pool = self._scatter_pool(
+                pool, blk, off, cache["k"][:, :, t:t + w],
+                cache["v"][:, :, t:t + w], jnp.where(blk != 0, wm, 0),
+            )
             report = jax.lax.optimization_barrier((state[3], state[2], state[4]))
             return pool, state, report
 
@@ -1155,6 +1400,172 @@ class ContinuousBatcher:
             },
         }
         return self._decode_fn
+
+    def _spec_verify(self):
+        """Compiled speculative verify round (``speculative_k`` = k > 0): ONE
+        program that (1) runs k+1 greedy single-token draft steps over the
+        draft pool's chain view — the tokens it FEEDS are exactly
+        ``[current_token, d_0 .. d_{k-1}]``, so after the scan the draft
+        cache holds KV for every window column — then (2) verifies all k
+        proposals in ONE target forward over a (k+1)-token window (the
+        chunked-prefill multi-token machinery), sampling the target's choice
+        at every position with the SAME per-request stream indices
+        (``fold_in(key, n_out + j)``) the plain decode window would use.
+
+        Acceptance is the longest matched prefix of (choices, drafts); the
+        fix-up token at the first mismatch is the target's own choice, so for
+        every EMITTED position the logits are conditioned on exactly the
+        tokens the non-speculative engine would have fed — greedy output is
+        bit-identical to non-speculative BY CONSTRUCTION, and sampled output
+        stays traffic-independent (tests/test_speculative.py pins both).
+
+        Rejection is block-table truncation, the same surgery compaction
+        uses: rejected window columns' writes land in the trash block with a
+        zero mask and the host simply does not advance the chain frontier
+        past them — no device scrub. Returns ``(pool, d_pool, state,
+        produced, report)``: ``produced`` (tokens committed per slot, current
+        + accepted drafts) is fetched eagerly — the one blocking (B,)
+        readback a verify round pays for k-fold fewer target passes —
+        while ``report`` is the usual barrier'd (active, n_out, out_buf)
+        handle processed one round late."""
+        if self._verify_fn is not None:
+            return self._verify_fn
+        module = self.module
+        d_module = self._draft_module
+        pad = self.pad
+        bs = self.block_size
+        t = self.max_blocks_per_slot * bs
+        k = self.speculative_k
+        S = k + 1
+
+        def run(params, d_params, pool, d_pool, tables, lens, commit,
+                force_stop, state):
+            (tok, pos, n_out, active, out_buf, keys,
+             slot_max, slot_temp, slot_eos) = state
+            B = tok.shape[0]
+            active = active & ~force_stop & commit
+            # --- draft leg: k+1 greedy steps. The last proposal (fed
+            # nothing) is discarded, but feeding k+1 steps means the last
+            # ACCEPTED draft token's draft-KV is written too — without it a
+            # fully-accepted round would leave the draft chain one column
+            # short of the target chain.
+            d_cache = self._paged_view_cache(d_pool, tables, lens, S)
+
+            def d_step(carry, _):
+                d_cache, d_tok, d_pos = carry
+                feed = jnp.where(active, d_tok, pad)
+                d_out = d_module.apply(d_params, input_ids=feed[:, None],
+                                       cache=d_cache, positions=d_pos[:, None])
+                nxt = jnp.argmax(d_out["logits"][:, -1], axis=-1).astype(jnp.int32)
+                return (d_out["cache"], nxt, d_pos + 1), feed
+
+            (d_cache, _, _), fed = jax.lax.scan(
+                d_step, (d_cache, tok, pos), None, length=S
+            )
+            ids = fed.T  # (B, S): [cur, d_0 .. d_{k-1}] per row
+            # --- target leg: ONE forward over the whole window.
+            cache = self._paged_view_cache(pool, tables, lens, S)
+            mask = jnp.broadcast_to(active[:, None], (B, S)).astype(jnp.int32)
+            out = module.apply(
+                params, input_ids=jnp.where(active[:, None], ids, pad),
+                attention_mask=mask, cache=cache,
+                positions=pos[:, None] + jnp.arange(S)[None],
+            )
+            choices = jnp.stack(
+                [self._sample_rows(out["logits"][:, j], keys, n_out + j, slot_temp)
+                 for j in range(S)], axis=1)            # (B, S)
+            # --- acceptance: longest matched prefix; position j (if emitted)
+            # emits choices[:, j]. n_acc = index of first mismatch (k when
+            # every draft matched), so positions 0..n_acc are emittable.
+            match = choices[:, :k] == ids[:, 1:]        # (B, k)
+            n_acc = jnp.argmin(
+                jnp.concatenate([match, jnp.zeros((B, 1), bool)], axis=1)
+                .astype(jnp.int32), axis=1)
+            j_idx = jnp.arange(S)[None]
+            noteos = choices != slot_eos[:, None]
+            # Every emission cutoff (mismatch, per-request length, prior eos)
+            # is monotone in j, so the emit mask is a per-row prefix and
+            # `produced` is its length (>= 1 for active rows: position 0 is
+            # the non-spec step the window subsumes).
+            prior_ok = jnp.concatenate(
+                [jnp.ones((B, 1), bool),
+                 jnp.cumprod(noteos[:, :-1].astype(jnp.int32), axis=1).astype(bool)],
+                axis=1)
+            em = (active[:, None] & (j_idx <= n_acc[:, None])
+                  & (n_out[:, None] + j_idx < slot_max[:, None]) & prior_ok)
+            produced = jnp.sum(em.astype(jnp.int32), axis=1)  # (B,)
+            rows = jnp.arange(B)
+            for j in range(S):
+                emit_idx = jnp.clip(n_out + j, 0, self.max_new - 1)
+                cur_v = out_buf[rows, emit_idx]
+                out_buf = out_buf.at[rows, emit_idx].set(
+                    jnp.where(em[:, j], choices[:, j], cur_v))
+            n_out2 = n_out + produced
+            last = choices[rows, jnp.clip(produced - 1, 0, S - 1)]
+            tok2 = jnp.where(produced > 0, last, tok)
+            eos_hit = jnp.any(em & ~noteos, axis=1)
+            still = active & ~eos_hit & (n_out2 < slot_max)
+            state = (tok2, pos + produced, n_out2, still, out_buf, keys,
+                     slot_max, slot_temp, slot_eos)
+            # --- commit: window column j holds the KV of INPUT token j (cur
+            # at j=0, accepted draft = emitted choice after). Exactly the
+            # first `produced` columns belong to the final sequence — the
+            # round's last emitted choice becomes the next current token,
+            # whose KV is written next round — so everything past them never
+            # commits (trash block, zero mask): rejection without a scrub.
+            idx = lens[:, None] + jnp.arange(S)[None]
+            wvalid = active[:, None] & (jnp.arange(S)[None] < produced[:, None])
+            blk = jnp.where(
+                wvalid,
+                jnp.take_along_axis(
+                    tables,
+                    jnp.clip(idx // bs, 0, tables.shape[1] - 1).astype(jnp.int32),
+                    axis=1),
+                0)
+            off = (idx % bs).astype(jnp.int32)
+            vcache = out["cache"]
+            pool = self._scatter_pool(
+                pool, blk, off, vcache["k"][:, :, t:t + S],
+                vcache["v"][:, :, t:t + S],
+                jnp.where(blk != 0, vcache["kv_mask"][:, t:t + S], 0),
+            )
+            d_pool = self._scatter_pool(
+                d_pool, blk, off, d_cache["k"][:, :, t:t + S],
+                d_cache["v"][:, :, t:t + S],
+                jnp.where(blk != 0, d_cache["kv_mask"][:, t:t + S], 0),
+            )
+            report = jax.lax.optimization_barrier((state[3], state[2], state[4]))
+            return pool, d_pool, state, produced, report
+
+        effective_donate = safe_donate_argnums((2, 3, 8))
+        self._verify_fn = jax.jit(run, donate_argnums=effective_donate)
+        donated_leaves = (
+            len(jax.tree_util.tree_leaves(self._pool))
+            + len(jax.tree_util.tree_leaves(self._draft_pool))
+            + len(jax.tree_util.tree_leaves(self._state_tuple()))
+        )
+        param_leaves = jax.tree_util.tree_leaves(self.params)
+        from .ops.registry import resolved_backends
+
+        self._verify_fn._audit_meta = {
+            "builder": "serving_spec_verify",
+            "compute_dtype": (
+                str(np.dtype(param_leaves[0].dtype).name) if param_leaves else None
+            ),
+            "expected_donations": (2, 3, 8),
+            "expected_donated_leaves": donated_leaves,
+            "donation_dropped_by_policy": not effective_donate,
+            "kernels": {"spec": self.kernels,
+                        "backends": resolved_backends(self.kernels)},
+            "jaxpr_thunk": lambda *a, **kw: jax.make_jaxpr(run)(*a, **kw),
+            "memory_classes": {
+                "kv_pool": (lambda: self._pool, lambda: None),
+                "draft_pool": (lambda: self._draft_pool, lambda: None),
+                "params": (lambda: self.params, lambda: None),
+                "draft_params": (lambda: self._draft_params, lambda: None),
+            },
+        }
+        return self._verify_fn
 
     def _decode(self):
         """Compiled ``sync_every``-token window for all B slots — ONE program
@@ -1269,20 +1680,57 @@ class ContinuousBatcher:
             self._decode(), *self._decode_args(), config=config, **kwargs
         )
 
+    def _verify_args(self):
+        """The spec-verify program's full argument tuple against the engine's
+        current pools/state (value-independent, like ``_decode_args``)."""
+        if not self.speculative_k:
+            raise ValueError(
+                "the spec-verify program exists only with speculative_k > 0"
+            )
+        return (
+            self.params, self._draft_params, self._pool, self._draft_pool,
+            jnp.asarray(self._tables_np),
+            jnp.asarray(self._slot_len, dtype=jnp.int32),
+            jnp.asarray([m == "decode" for m in self._slot_mode]),
+            jnp.zeros((self.B,), bool), self._state_tuple(),
+        )
+
+    def audit_verify(self, **kwargs):
+        """Statically audit the compiled speculative verify round (donation
+        aliasing over both pools + state, kernel inventory, memory classes).
+        Lowers and compiles but never decodes a token."""
+        from .analysis import audit_built
+
+        return audit_built(self._spec_verify(), *self._verify_args(), **kwargs)
+
+    def fingerprint_verify(self, config: str = "spec_verify", **kwargs):
+        """Canonical fingerprint of the compiled speculative verify round —
+        the spec-decoding entry in the drift-gate matrix (a silently vanished
+        draft leg or dequant seam classifies as violation). Lowers and
+        compiles but never decodes a token."""
+        from .analysis.fingerprint import fingerprint_built
+
+        return fingerprint_built(
+            self._spec_verify(), *self._verify_args(), config=config, **kwargs
+        )
+
     def _chunk_args(self, P: int):
         """The ``P``-token chunk program's full argument tuple against the
         engine's current pool/state — what the prefill-tier audit/fingerprint
         lower with (value-independent, like ``_decode_args``)."""
         if not self.paged:
             raise ValueError("the chunk program exists only in paged mode")
-        return (
-            self.params, self._pool, self._state_tuple(),
+        tail = (
             jnp.asarray(self._tables_np),
             jnp.asarray(self._slot_len, dtype=jnp.int32), jnp.int32(0),
             jnp.zeros((P,), jnp.int32), jnp.ones((P,), jnp.int32),
             jnp.int32(0), jnp.asarray(True), jnp.int32(0), self._rng,
             jnp.int32(self.max_new), jnp.float32(0.0), jnp.int32(self.eos),
         )
+        if self.speculative_k:
+            return (self.params, self._draft_params, self._pool,
+                    self._draft_pool, self._state_tuple()) + tail
+        return (self.params, self._pool, self._state_tuple()) + tail
 
     def fingerprint_prefill(self, config: str = "prefill_paged", **kwargs):
         """Canonical fingerprint of the compiled ``prefill_chunk``-token
@@ -1506,7 +1954,7 @@ class ContinuousBatcher:
                 c.size if i + 1 < len(chunks) else self._bucket(c.size)
                 for i, c in enumerate(chunks)
             )
-            need = aligned + (req.max_new - 1) + 3 * self.sync_every
+            need = aligned + (req.max_new - 1) + self._decode_slack
             if escalated and need > self.max_blocks_per_slot * bs:
                 # Escalation's extra bucket padding would overflow the static
                 # table; fall back to the standard chunk plan.
@@ -1516,7 +1964,7 @@ class ContinuousBatcher:
                     c.size if i + 1 < len(chunks) else self._bucket(c.size)
                     for i, c in enumerate(chunks)
                 )
-                need = aligned + (req.max_new - 1) + 3 * self.sync_every
+                need = aligned + (req.max_new - 1) + self._decode_slack
             if need > self.max_blocks_per_slot * bs:
                 raise AssertionError(
                     f"internal: chain need {need} exceeds the static table "
@@ -1605,13 +2053,22 @@ class ContinuousBatcher:
             mrow_j = jnp.ones((p,), jnp.int32)
         req = self._slot_req[s]
         c0 = int(self._slot_len[s])
-        self._pool, state = self._chunk_fn(p)(
-            self.params, self._pool, state, jnp.asarray(self._tables_np),
+        tail = (
+            jnp.asarray(self._tables_np),
             jnp.asarray(self._slot_len, dtype=jnp.int32), jnp.int32(s),
             row_j, mrow_j, jnp.int32(self._slot_base[s]), jnp.asarray(final),
             jnp.int32(req.rid), self._rng, jnp.int32(req.max_new),
             jnp.float32(req.temperature), jnp.int32(req.eos),
         )
+        if self.speculative_k:
+            self._pool, self._draft_pool, state = self._chunk_fn(p)(
+                self.params, self._draft_params, self._pool, self._draft_pool,
+                state, *tail,
+            )
+        else:
+            self._pool, state = self._chunk_fn(p)(
+                self.params, self._pool, state, *tail,
+            )
         self._sync(state)  # instance fields track the LIVE (post-donation) buffers
         self._log_dispatch(f"chunk:{p}")
         if self.tracer is not None:
@@ -1626,19 +2083,48 @@ class ContinuousBatcher:
 
     def _dispatch_decode(self, state, force_stop: np.ndarray):
         commit = np.asarray([m == "decode" for m in self._slot_mode], bool)
+        window = (self.speculative_k + 1) if self.speculative_k else self.sync_every
         for s in np.nonzero(commit)[0]:
-            if self._slot_len[s] + self.sync_every > len(self._slot_blocks[s]) * self.block_size:
+            if self._slot_len[s] + window > len(self._slot_blocks[s]) * self.block_size:
                 raise AssertionError(
                     "internal: slot chain reservation exhausted mid-request"
                 )
-        self._pool, state, report = self._decode()(
-            self.params, self._pool, jnp.asarray(self._tables_np),
-            jnp.asarray(self._slot_len, dtype=jnp.int32), jnp.asarray(commit),
-            jnp.asarray(force_stop), state,
-        )
-        self._sync(state)
-        self._slot_len[commit] += self.sync_every
-        self._log_dispatch("decode")
+        produced_np = None
+        if self.speculative_k:
+            (self._pool, self._draft_pool, state, produced,
+             report) = self._spec_verify()(
+                self.params, self._draft_params, self._pool, self._draft_pool,
+                jnp.asarray(self._tables_np),
+                jnp.asarray(self._slot_len, dtype=jnp.int32),
+                jnp.asarray(commit), jnp.asarray(force_stop), state,
+            )
+            self._sync(state)
+            # The one blocking readback a verify round pays (traded for
+            # k-fold fewer target passes): each chain's frontier advances by
+            # the slot's COMMITTED count — not advancing past rejected
+            # columns IS the block-table truncation.
+            produced_np = np.asarray(host_fetch(produced), np.int64)
+            self._slot_len += produced_np
+            live = produced_np > 0
+            proposed = int(live.sum()) * self.speculative_k
+            if proposed:
+                accepted = int((produced_np[live] - 1).sum())
+                self._spec_proposed += proposed
+                self._spec_accepted += accepted
+                prop_c, acc_c, rate_g = _spec_metrics()
+                prop_c.inc(proposed)
+                acc_c.inc(accepted)
+                rate_g.set(self._spec_accepted / max(1, self._spec_proposed))
+            self._log_dispatch(f"verify:{self.speculative_k}")
+        else:
+            self._pool, state, report = self._decode()(
+                self.params, self._pool, jnp.asarray(self._tables_np),
+                jnp.asarray(self._slot_len, dtype=jnp.int32), jnp.asarray(commit),
+                jnp.asarray(force_stop), state,
+            )
+            self._sync(state)
+            self._slot_len[commit] += self.sync_every
+            self._log_dispatch("decode")
         # Tag the report with the occupants it describes: by the time it is
         # processed (one window later), a collected slot may already host a
         # NEW request — its rows in this report belong to the old one.
@@ -1648,9 +2134,15 @@ class ContinuousBatcher:
             for s in range(self.B)
         ]
         if self.tracer is not None:
-            for rid in req_map:
-                if rid is not None:
-                    self.tracer.decode_window(rid)
+            for s, rid in enumerate(req_map):
+                if rid is None:
+                    continue
+                self.tracer.decode_window(rid)
+                if produced_np is not None and produced_np[s] > 0:
+                    self.tracer.spec_round(
+                        rid, proposed=self.speculative_k,
+                        accepted=int(produced_np[s] - 1),
+                    )
         return state, (report, req_map)
 
     def _process_report(self, report, force_stop: np.ndarray):

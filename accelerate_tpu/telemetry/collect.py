@@ -48,7 +48,8 @@ _TID_NAMES = {
 # classify_drift idiom: one directional rule per field class).
 LOWER_BETTER = ("step_p50", "step_p90", "step_mean", "step_max",
                 "ttft_mean", "ttft_max", "tpot_mean", "tpot_max")
-HIGHER_BETTER = ("mfu", "tokens_per_s", "goodput_fraction")
+HIGHER_BETTER = ("mfu", "tokens_per_s", "goodput_fraction",
+                 "spec_acceptance_rate", "accepted_tokens_per_s")
 COUNT_WORSE = ("breaches", "retries", "restarts", "evictions")
 
 

@@ -89,6 +89,7 @@ class TelemetryJournal:
         self.counts: dict[str, int] = {}
         self._ttft = [0, 0.0, 0.0]  # count, sum, max
         self._tpot = [0, 0.0, 0.0]
+        self._spec = [0, 0]  # proposed, accepted draft tokens (finish legs)
         self.emit("journal_open", pid=os.getpid(),
                   schema_version=JOURNAL_SCHEMA_VERSION)
 
@@ -137,6 +138,9 @@ class TelemetryJournal:
                     agg[0] += 1
                     agg[1] += float(value)
                     agg[2] = max(agg[2], float(value))
+            if isinstance(data.get("spec_proposed"), int):
+                self._spec[0] += data["spec_proposed"]
+                self._spec[1] += int(data.get("spec_accepted", 0))
 
     def _rotate(self):
         """Size-based rotation: live file becomes ``.1`` (replacing the
@@ -219,6 +223,18 @@ class TelemetryJournal:
                 summary[f"{name}_mean"] = round(total / count, 6)
                 summary[f"{name}_max"] = round(peak, 6)
                 summary[f"{name}_count"] = count
+        if self._spec[0]:
+            # Speculative decode: acceptance rate is the draft-quality
+            # signal; accepted-tokens/s is the run-over-run speed unit
+            # (tokens the target did NOT have to decode one-by-one).
+            summary["spec_proposed_tokens"] = self._spec[0]
+            summary["spec_accepted_tokens"] = self._spec[1]
+            summary["spec_acceptance_rate"] = round(
+                self._spec[1] / self._spec[0], 6)
+            wall = summary.get("wall_s")
+            if wall:
+                summary["accepted_tokens_per_s"] = round(
+                    self._spec[1] / wall, 6)
         summary["breaches"] = self.counts.get("flight:slo_breach", 0)
         summary["retries"] = max(self.counts.get("leg:retry", 0),
                                  self.counts.get("flight:serving_retry", 0))

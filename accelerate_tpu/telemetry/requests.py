@@ -113,6 +113,8 @@ class RequestTracer:
             "chunks": [],
             "ttft_s": None,
             "decode_windows": 0,
+            "spec_proposed": 0,
+            "spec_accepted": 0,
             "tokens_out": None,
             "tpot_s": None,
             "total_s": None,
@@ -202,6 +204,19 @@ class RequestTracer:
         if record is not None:
             record["decode_windows"] += 1
 
+    def spec_round(self, rid: int, *, proposed: int, accepted: int):
+        """One speculative verify round for ``rid``: the draft proposed
+        ``proposed`` tokens, the target accepted ``accepted`` of them (the
+        window's +1 bonus token is NOT counted — acceptance rate stays the
+        draft-quality signal). Host bookkeeping only; the counts ride the
+        record so ``summary()`` and the journal ``run_summary`` can report
+        per-run acceptance without another device fetch."""
+        record = self._get(rid)
+        if record is None:
+            return
+        record["spec_proposed"] += int(proposed)
+        record["spec_accepted"] += int(accepted)
+
     def finish(self, rid: int, tokens_out: int, tpot_s: float | None = None,
                at: float | None = None):
         record = self._get(rid)
@@ -222,8 +237,15 @@ class RequestTracer:
                 from .slo import record_breach
 
                 record_breach("tpot", record["tpot_s"], target, rid=rid)
-        self._journal(record, "finish", tokens_out=int(tokens_out),
-                      tpot_s=record["tpot_s"], total_s=record["total_s"])
+        fields = dict(tokens_out=int(tokens_out), tpot_s=record["tpot_s"],
+                      total_s=record["total_s"])
+        if record["spec_proposed"]:
+            # Spec tallies ride the finish leg (one field, not one record per
+            # verify round) — finalize_run aggregates accepted-tokens/s from
+            # here.
+            fields["spec_proposed"] = record["spec_proposed"]
+            fields["spec_accepted"] = record["spec_accepted"]
+        self._journal(record, "finish", **fields)
 
     def handoff(self, rid: int, direction: str, bytes: int = 0, blocks: int = 0,
                 endpoint: str | None = None):
@@ -334,11 +356,18 @@ class RequestTracer:
         states: dict = {}
         for r in records:
             states[r["state"]] = states.get(r["state"], 0) + 1
+        proposed = sum(r.get("spec_proposed", 0) for r in records)
+        accepted = sum(r.get("spec_accepted", 0) for r in records)
         return {
             "total": self.total,
             "retained": len(records),
             "states": states,
             "breaches": self.breaches,
+            "spec": {
+                "proposed_tokens": proposed,
+                "accepted_tokens": accepted,
+                "acceptance_rate": (accepted / proposed) if proposed else None,
+            },
             "ttft_s": {"p50": _quantile(ttft, 0.5), "p90": _quantile(ttft, 0.9),
                        "max": ttft[-1] if ttft else 0.0},
             "tpot_s": {"p50": _quantile(tpot, 0.5), "p90": _quantile(tpot, 0.9),

@@ -157,6 +157,19 @@ ENV_ZERO_SHARDING = "ACCELERATE_ZERO_SHARDING"
 # ``--kernels`` (tri-state; an explicit off scrubs an inherited env).
 ENV_KERNELS = "ACCELERATE_KERNELS"
 
+# Serving decode-speed levers (serving.py; docs/serving.md "Speculative
+# decoding" / "Quantized KV blocks"): how many draft tokens each verify round
+# proposes per slot (0 = speculation off), which zoo config preset builds the
+# deterministically-initialized draft model when the engine isn't handed one
+# (``tiny`` default; checkpointed drafts pass draft_model= in code), and the
+# paged pool's block storage dtype (``int8`` = quantized blocks with
+# per-token scales; unset/empty = the cache dtype). All tri-state per the
+# kernels precedent — the launcher scrubs an explicit 0/empty so a stale
+# inherited value never leaks into a child run.
+ENV_SPECULATIVE_K = "ACCELERATE_SPECULATIVE_K"
+ENV_DRAFT_MODEL = "ACCELERATE_DRAFT_MODEL"
+ENV_KV_QUANT = "ACCELERATE_KV_QUANT"
+
 # ``dcn`` is the slice axis of a multi-slice pod: replicas connected by
 # data-center network rather than ICI. It is outermost so only the axes meant
 # to cross slices (data parallelism / LocalSGD replicas) ever ride DCN; all

@@ -99,7 +99,17 @@ import numpy as np
 # and stamps detail.journal with the journal directory + per-kind record
 # counts, so a bench row is joinable to its full causal timeline
 # (`accelerate-tpu timeline`). Absent when journaling is off.
-BENCH_SCHEMA_VERSION = 14
+# v15 = decode-speed levers on the paged serving engine, one cell each:
+# BENCH_SPEC=1 embeds detail.serving.spec (benchmarks/spec_decode_profile.py
+# — speculative-decode waves vs baseline at bit-identical outputs, with
+# acceptance rate and accepted-tokens/s), BENCH_KV_QUANT=1 embeds
+# detail.serving.kv_quant (benchmarks/kv_quant_profile.py — int8 pool
+# capacity_x, dequant-gather tax, output-divergence fraction), and
+# BENCH_INT8_SERVING=1 embeds detail.serving.int8_serving
+# (benchmarks/int8_serving_profile.py — weight-quantized serving wave vs
+# default precision). All compose with the other serving levers under
+# detail.serving; absent when unarmed.
+BENCH_SCHEMA_VERSION = 15
 
 
 class BenchAuditFailure(RuntimeError):
@@ -737,6 +747,32 @@ def run_one(mode: str):
                 pass
         serving_summary = dict(serving_summary or {})
         serving_summary["chaos"] = chaos_summary
+
+    # Decode-speed levers (schema v15): each embeds its own cell under
+    # detail.serving so the three compounding levers — speculation, int8 KV
+    # blocks, int8 weights — report independently and compose with
+    # BENCH_SERVING's base wave in one trajectory.
+    for lever_env, lever_key, lever_module in (
+        ("BENCH_SPEC", "spec", "spec_decode_profile"),
+        ("BENCH_KV_QUANT", "kv_quant", "kv_quant_profile"),
+        ("BENCH_INT8_SERVING", "int8_serving", "int8_serving_profile"),
+    ):
+        if os.environ.get(lever_env, "0") != "1":
+            continue
+        bench_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "benchmarks")
+        sys.path.insert(0, bench_dir)
+        try:
+            lever_summary = __import__(lever_module).summarize()
+        except Exception as exc:  # the lever must never take the row down
+            lever_summary = {"error": f"{type(exc).__name__}: {exc}"[:300]}
+        finally:
+            try:
+                sys.path.remove(bench_dir)
+            except ValueError:
+                pass
+        serving_summary = dict(serving_summary or {})
+        serving_summary[lever_key] = lever_summary
 
     # Durable journal (schema v14): when ACCELERATE_JOURNAL_DIR armed a
     # journal, finalize this run's run_summary record (fingerprint hash

@@ -104,6 +104,41 @@ def test_tune_budget_tristate_launch_contract(monkeypatch):
     assert _merge_config(args).tune_budget == 5
 
 
+def test_decode_lever_flags_tristate_launch_contract(monkeypatch):
+    """--speculative_k / --draft_model / --kv_quant ride the launcher
+    tri-state contract: None = unspecified (inherited env flows through),
+    a real value exports, the explicit default (0 / '' / off) scrubs a
+    stale inherited value from the worker env."""
+    monkeypatch.setenv("ACCELERATE_SPECULATIVE_K", "9")
+    monkeypatch.setenv("ACCELERATE_DRAFT_MODEL", "stale")
+    monkeypatch.setenv("ACCELERATE_KV_QUANT", "int8")
+    env = prepare_launch_env(ClusterConfig())  # unspecified → inherited flows
+    assert env["ACCELERATE_SPECULATIVE_K"] == "9"
+    assert env["ACCELERATE_DRAFT_MODEL"] == "stale"
+    assert env["ACCELERATE_KV_QUANT"] == "int8"
+    env = prepare_launch_env(
+        ClusterConfig(speculative_k=4, draft_model="tiny", kv_quant="int8")
+    )
+    assert env["ACCELERATE_SPECULATIVE_K"] == "4"
+    assert env["ACCELERATE_DRAFT_MODEL"] == "tiny"
+    assert env["ACCELERATE_KV_QUANT"] == "int8"
+    env = prepare_launch_env(  # explicit defaults scrub
+        ClusterConfig(speculative_k=0, draft_model="", kv_quant="off")
+    )
+    assert "ACCELERATE_SPECULATIVE_K" not in env
+    assert "ACCELERATE_DRAFT_MODEL" not in env
+    assert "ACCELERATE_KV_QUANT" not in env
+    # The flags reach the merge like every other launcher knob.
+    args = launch_command_parser().parse_args(
+        ["--cpu", "--speculative_k", "3", "--draft_model", "tiny",
+         "--kv_quant", "int8", "script.py"]
+    )
+    merged = _merge_config(args)
+    assert merged.speculative_k == 3
+    assert merged.draft_model == "tiny"
+    assert merged.kv_quant == "int8"
+
+
 def test_ep_size_flag_reaches_mesh_env():
     """--ep_size must survive the flag→ClusterConfig merge and land in the
     serialized mesh (regression: the merge list once dropped it silently)."""
@@ -302,6 +337,10 @@ def test_config_wizard_roundtrips_through_launch(tmp_path):
         "3",                 # router retry budget per failed request
         "2.5",               # worker discovery lease TTL (s)
         "0",                 # SIGTERM drain grace (0 = library default)
+        "yes",               # configure serving decode-speed levers?
+        "4",                 # speculative draft depth k
+        "tiny",              # draft model preset
+        "int8",              # KV-cache pool quantization
         "yes",               # configure dispatch amortization?
         "4",                 # train window K
         "latency",           # xla latency-hiding preset
@@ -334,6 +373,8 @@ def test_config_wizard_roundtrips_through_launch(tmp_path):
     assert cfg.serving_retry_budget == 3.0
     assert cfg.serving_lease_ttl == 2.5
     assert cfg.drain_grace_s == 0.0  # explicit scrub, not unspecified
+    assert cfg.speculative_k == 4 and cfg.draft_model == "tiny"
+    assert cfg.kv_quant == "int8"
     assert cfg.train_window == 4 and cfg.xla_preset == "latency"
     assert cfg.zero_sharding is True
     assert cfg.kernels == "pallas"
@@ -393,6 +434,9 @@ def test_config_wizard_roundtrips_through_launch(tmp_path):
         "assert retry_budget_from_env() == 3\n"
         "assert lease_ttl_from_env() == 2.5\n"
         "assert drain_grace_from_env() == 30.0\n"
+        "assert os.environ.get('ACCELERATE_SPECULATIVE_K') == '4'\n"
+        "assert os.environ.get('ACCELERATE_DRAFT_MODEL') == 'tiny'\n"
+        "assert os.environ.get('ACCELERATE_KV_QUANT') == 'int8'\n"
         "assert os.environ.get('ACCELERATE_TRAIN_WINDOW') == '4'\n"
         "assert acc.train_window == 4\n"
         "assert os.environ.get('ACCELERATE_XLA_PRESET') == 'latency'\n"

@@ -434,6 +434,17 @@ def test_goldens_committed_for_full_matrix():
     # block-table gather program and the pool+state donation contract.
     assert _golden("decode_paged")["builder"] == "serving_decode_paged"
     assert _golden("decode_paged")["donation"]["expected_argnums"] == [1, 6]
+    # The int8-pool decode golden pins the dequant-in-DMA kernel inventory —
+    # a silently vanished dequant kernel classifies as a violation, not a
+    # quiet fallback to a full-precision gather.
+    int8 = _golden("decode_paged_int8")
+    assert int8["builder"] == "serving_decode_paged"
+    assert int8["kernels"]["counts"]["paged_gather_dequant_kernel"] == 2
+    # The spec-verify golden pins the draft scan + multi-token verify forward
+    # and its pool/state donation contract (target pool, draft pool, state).
+    spec = _golden("spec_verify")
+    assert spec["builder"] == "serving_spec_verify"
+    assert spec["donation"]["expected_argnums"] == [2, 3, 8]
 
 
 @pytest.mark.slow
